@@ -1,0 +1,68 @@
+"""paddle_tpu: a TPU-native deep-learning framework (JAX/XLA/Pallas/pjit).
+
+Brand-new framework providing the capability surface of the reference
+(PaddlePaddle, see SURVEY.md) with a TPU-first architecture:
+  - eager Tensor API with tape autograd over jax.vjp (framework/),
+  - whole-step compilation via jit/to_static (jit/),
+  - SPMD distributed training over jax.sharding meshes (distributed/),
+  - Pallas kernels for attention-class ops (ops/pallas/).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# Paddle parity: int64/float64 tensors exist (reference defaults to int64
+# indices); x64 must be enabled before first backend use.  Perf-critical
+# model code in this repo uses int32/bfloat16 explicitly (TPU-friendly).
+_jax.config.update("jax_enable_x64", True)
+
+from .framework.tensor import Tensor, Parameter, to_tensor
+from .framework import dtype as _dtype_mod
+from .framework.dtype import (
+    bfloat16, float16, float32, float64, int8, int16, int32, int64,
+    uint8, bool_ as bool, complex64, complex128,
+    set_default_dtype, get_default_dtype, finfo, iinfo,
+)
+from .framework.device import (
+    set_device, get_device, device_count, CPUPlace, TPUPlace, CUDAPlace,
+    is_compiled_with_cuda, is_compiled_with_xpu,
+)
+from .framework.tape import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
+from .framework.random import seed, get_rng_state, set_rng_state
+from .framework.flags import set_flags, get_flags
+
+from .tensor import *  # noqa: F401,F403  (functional tensor API)
+from .tensor import linalg  # noqa: F401
+from .tensor.logic import is_tensor  # noqa: F401
+
+from . import autograd  # noqa: F401
+from .autograd import grad  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from . import distributed  # noqa: F401
+from . import static  # noqa: F401
+
+
+def disable_static():
+    """Eager is the default and only eager/static switch is a no-op shim."""
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is eager-first; use paddle_tpu.jit.to_static for "
+        "whole-graph compilation (XLA replaces the static Program stack).")
+
+
+def in_dynamic_mode() -> bool:
+    return True

@@ -1,0 +1,225 @@
+"""User-facing autograd utilities.
+
+Capability parity: python/paddle/autograd/ in the reference — backward(),
+paddle.grad partial graphs, PyLayer custom autograd
+(reference: python/paddle/autograd/py_layer.py, paddle/fluid/eager/pylayer/),
+jacobian/hessian (python/paddle/autograd/autograd.py).
+
+TPU-native: jacobian/hessian delegate to jax.jacrev/jacfwd (functional
+transforms the reference lacks natively); PyLayer records a custom GradNode on
+the same tape as built-in ops.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import tape as _tape
+from ..framework.tape import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
+from ..framework.tensor import Tensor, wrap_array
+from ..framework import dtype as dtypes
+
+__all__ = [
+    "backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+    "is_grad_enabled", "PyLayer", "PyLayerContext", "jacobian", "hessian",
+    "vjp", "jvp", "saved_tensors_hooks",
+]
+
+
+def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    _tape.run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """reference: paddle.grad (python/paddle/base/dygraph/base.py grad)."""
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph in eager tape mode is not supported; use "
+            "paddle_tpu.autograd.jacobian/hessian (jax.jacfwd/jacrev) for "
+            "higher-order derivatives — the TPU-native path.")
+    retain = bool(retain_graph) if retain_graph is not None else False
+    return _tape.calc_gradient(outputs, inputs, grad_outputs,
+                               retain_graph=retain, allow_unused=allow_unused)
+
+
+class PyLayerContext:
+    """reference: python/paddle/autograd/py_layer.py PyLayerContext."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op (reference: paddle.autograd.PyLayer).
+
+    Subclass with @staticmethod forward(ctx, *args) and backward(ctx, *grads).
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+
+        if not _tape.is_grad_enabled():
+            return outputs
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        diff_inputs = [t for t in tensor_inputs
+                       if not t.stop_gradient and dtypes.is_floating_point(t.dtype)]
+        if not diff_inputs:
+            return outputs
+
+        edges = [_tape.Edge(t._grad_node, t._node_out_idx, t) for t in diff_inputs]
+        tensor_outs = [t for t in out_list if isinstance(t, Tensor)]
+        out_metas = [(tuple(t._data.shape), t._data.dtype) for t in tensor_outs]
+
+        def vjp_fn(cotangents):
+            cot_tensors = [wrap_array(c) for c in cotangents]
+            with no_grad():
+                grads = cls.backward(ctx, *cot_tensors)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            grad_arrays = []
+            gi = 0
+            # paddle contract: backward returns one grad per *forward tensor
+            # input*; align to diff inputs, skipping Nones.
+            per_input = list(grads)
+            if len(per_input) == len(tensor_inputs):
+                aligned = [g for g, t in zip(per_input, tensor_inputs)
+                           if t in diff_inputs]
+            else:
+                aligned = per_input
+            for g in aligned:
+                grad_arrays.append(None if g is None else
+                                   (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+            return tuple(grad_arrays)
+
+        node = _tape.GradNode(cls.__name__, vjp_fn, edges, len(tensor_outs), out_metas)
+        for i, t in enumerate(tensor_outs):
+            if dtypes.is_floating_point(t.dtype):
+                t.stop_gradient = False
+                t._grad_node = node
+                t._node_out_idx = i
+        return outputs
+
+
+def _functionalize(func, inputs):
+    """Build an array-level function from a Tensor-level one."""
+    single_in = isinstance(inputs, Tensor)
+    in_list = [inputs] if single_in else list(inputs)
+
+    def fn(*arrays):
+        with no_grad():
+            ts = [wrap_array(a) for a in arrays]
+            out = func(*ts)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+    return fn, [t._data for t in in_list], single_in
+
+
+def jacobian(func_or_ys, inputs=None, create_graph=False, batch_axis=None):
+    """Jacobian — TPU-native via jax.jacrev.
+
+    Usage (functional): jacobian(func, xs).
+    """
+    if callable(func_or_ys):
+        fn, arrays, single_in = _functionalize(func_or_ys, inputs)
+        jac = jax.jacrev(fn, argnums=tuple(range(len(arrays))))(*arrays)
+        out = jax.tree_util.tree_map(wrap_array, jac)
+        if single_in and isinstance(out, tuple) and len(out) == 1:
+            return out[0]
+        return out
+    raise TypeError("jacobian expects a callable first argument")
+
+
+def hessian(func, inputs, create_graph=False, batch_axis=None):
+    fn, arrays, single_in = _functionalize(func, inputs)
+    hes = jax.hessian(fn, argnums=tuple(range(len(arrays))))(*arrays)
+    out = jax.tree_util.tree_map(wrap_array, hes)
+    if single_in and isinstance(out, tuple) and len(out) == 1:
+        o = out[0]
+        return o[0] if isinstance(o, tuple) and len(o) == 1 else o
+    return out
+
+
+def vjp(func, xs, v=None):
+    fn, arrays, single_in = _functionalize(func, xs)
+    out, vjp_fn = jax.vjp(fn, *arrays)
+    if v is None:
+        cots = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        vs = [v] if isinstance(v, Tensor) else list(v)
+        cots = tuple(t._data for t in vs)
+        if not isinstance(out, tuple):
+            cots = cots[0]
+    grads = vjp_fn(cots)
+    outs_t = jax.tree_util.tree_map(wrap_array, out)
+    grads_t = [wrap_array(g) for g in grads]
+    return outs_t, (grads_t[0] if single_in else grads_t)
+
+
+def jvp(func, xs, v=None):
+    fn, arrays, single_in = _functionalize(func, xs)
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        vs = [v] if isinstance(v, Tensor) else list(v)
+        tangents = [t._data for t in vs]
+    out, tangent_out = jax.jvp(fn, tuple(arrays), tuple(tangents))
+    outs_t = jax.tree_util.tree_map(wrap_array, out)
+    tan_t = jax.tree_util.tree_map(wrap_array, tangent_out)
+    return outs_t, tan_t
+
+
+class saved_tensors_hooks:
+    """API-parity shim (reference: paddle.autograd.saved_tensors_hooks).
+
+    On TPU, residual placement is XLA's decision; hooks are accepted and
+    applied to PyLayer-saved tensors only.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,121 @@
+"""Device management.
+
+Capability parity with the reference's Place/device API
+(reference: python/paddle/device/__init__.py set_device:281,
+paddle/phi/common/place.h).  TPU-native: devices are JAX devices; there are no
+per-device streams to manage (XLA owns scheduling), but the Place/device API
+surface is preserved so user code ports unchanged.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+
+class Place:
+    """A device place, e.g. Place('tpu', 0) (reference: phi::Place)."""
+
+    __slots__ = ("device_type", "device_id")
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _platform_matches(d.platform, self.device_type)]
+        if not devs:
+            devs = jax.devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+def TPUPlace(device_id: int = 0) -> Place:
+    return Place("tpu", device_id)
+
+
+def CPUPlace() -> Place:
+    return Place("cpu", 0)
+
+
+def CUDAPlace(device_id: int = 0) -> Place:  # compat shim; maps to accelerator
+    return Place(_default_platform(), device_id)
+
+
+def _platform_matches(platform: str, device_type: str) -> bool:
+    if device_type in ("gpu", "cuda"):
+        return platform in ("gpu", "cuda", "rocm")
+    return platform == device_type
+
+
+@functools.lru_cache(maxsize=None)
+def _default_platform() -> str:
+    return jax.devices()[0].platform
+
+
+_current_place: Optional[Place] = None
+
+
+def set_device(device: str) -> Place:
+    """reference: python/paddle/device/__init__.py:281.
+
+    Accepts 'tpu', 'tpu:0', 'cpu', 'gpu:0' (mapped to the available
+    accelerator).
+    """
+    global _current_place
+    if ":" in device:
+        kind, idx = device.split(":", 1)
+        place = Place(kind, int(idx))
+    else:
+        place = Place(device, 0)
+    if place.device_type in ("gpu", "cuda") and _default_platform() == "tpu":
+        # Port-compat: user scripts that say set_device('gpu') run on TPU.
+        place = Place("tpu", place.device_id)
+    _current_place = place
+    return place
+
+
+def get_device() -> str:
+    place = get_current_place()
+    return f"{place.device_type}:{place.device_id}"
+
+
+def get_current_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = Place(_default_platform(), 0)
+    return _current_place
+
+
+def device_count(device_type: Optional[str] = None) -> int:
+    if device_type is None:
+        return len(jax.devices())
+    return len([d for d in jax.devices() if _platform_matches(d.platform, device_type)])
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def synchronize() -> None:
+    """Block until all queued device work is complete (stream sync analog)."""
+    (jax.device_put(0) + 0).block_until_ready()

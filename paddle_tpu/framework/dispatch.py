@@ -1,0 +1,177 @@
+"""Op dispatch: the single chokepoint every eager op goes through.
+
+Capability parity with the reference's generated ``*_ad_func`` + phi API
+dispatch (reference: paddle/fluid/eager/auto_code_generator/generator/
+eager_gen.py:365 forward template, paddle/phi/api/generator/api_gen.py,
+paddle/phi/core/kernel_factory.cc:267 SelectKernelOrThrowError).
+
+TPU-native design: there is no KernelKey registry — XLA is the only backend.
+``call_op``:
+  1. flattens (args, kwargs), unwraps Tensor leaves to jax.Arrays,
+  2. applies AMP autocast if active (reference: eager_gen.py:675),
+  3. if the tape is live and any floating input requires grad, runs
+     ``jax.vjp`` over the pure function and records a GradNode,
+  4. wraps outputs, stamping tape edges.
+The op table (OP_REGISTRY) is data: name → OpDef{fn, spmd_rule, ...} — the
+"op definitions are data, not code" lesson from SURVEY §1 (5 consumers of one
+YAML schema); here the registry feeds dispatch, to_static, and the sharding
+propagation rules.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.tree_util as jtu
+
+from . import dtype as dtypes
+from . import tape as _tape
+from .flags import get_flag
+from .tensor import Tensor, wrap_array
+
+
+@dataclass
+class OpDef:
+    name: str
+    fn: Callable            # pure function over jax arrays
+    wrapper: Callable       # user-facing tensor function
+    spmd_rule: Optional[Callable] = None   # sharding propagation rule (SURVEY #15)
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+OP_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_spmd_rule(name: str, rule: Callable) -> None:
+    if name in OP_REGISTRY:
+        OP_REGISTRY[name].spmd_rule = rule
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+# AMP autocast hook, installed by paddle_tpu.amp (avoids circular import).
+_amp_cast_hook: Optional[Callable] = None
+
+
+def set_amp_cast_hook(hook: Optional[Callable]) -> None:
+    global _amp_cast_hook
+    _amp_cast_hook = hook
+
+
+def call_op(name: str, fn: Callable, args: tuple, kwargs: dict):
+    """Execute ``fn`` (a pure jax-array function) with tape recording."""
+    if _amp_cast_hook is not None:
+        args, kwargs = _amp_cast_hook(name, args, kwargs)
+
+    leaves, treedef = jtu.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    tensor_idx = [i for i, l in enumerate(leaves) if _is_tensor(l)]
+    arrays = [leaves[i]._data for i in tensor_idx]
+
+    record = False
+    diff_pos = []   # positions (within tensor_idx) that are differentiable
+    if _tape.is_grad_enabled():
+        for p, i in enumerate(tensor_idx):
+            t = leaves[i]
+            if not t.stop_gradient and dtypes.is_floating_point(t.dtype):
+                diff_pos.append(p)
+        record = bool(diff_pos)
+
+    def _call_with(arrs):
+        new_leaves = list(leaves)
+        for i, a in zip(tensor_idx, arrs):
+            new_leaves[i] = a
+        a2, k2 = jtu.tree_unflatten(treedef, new_leaves)
+        return fn(*a2, **k2)
+
+    if not record:
+        out = _call_with(arrays)
+        result, _, _ = _wrap_outputs(out)
+        _check_nan_inf(name, result)
+        return result
+
+    # Differentiate w.r.t. the requires-grad floating inputs only; others are
+    # baked into the closure as constants (reference: eager_gen.py records
+    # TensorWrappers only for inputs needed by the grad node).
+    diff_arrays = [arrays[p] for p in diff_pos]
+
+    def _pure(*diff_args):
+        full = list(arrays)
+        for p, a in zip(diff_pos, diff_args):
+            full[p] = a
+        return _call_with(full)
+
+    out_arrays, vjp_fn = jax.vjp(_pure, *diff_arrays)
+
+    edges = []
+    for p in diff_pos:
+        t = leaves[tensor_idx[p]]
+        edges.append(_tape.Edge(t._grad_node, t._node_out_idx, t))
+
+    result, flat_outs, out_treedef = _wrap_outputs(out_arrays)
+    out_metas = [(tuple(a.shape), a.dtype) for a in flat_outs]
+    node = _tape.GradNode(name, vjp_fn, edges, len(flat_outs), out_metas,
+                          out_treedef)
+
+    # Stamp tape metadata on floating outputs.
+    _stamp_outputs(result, node)
+    _check_nan_inf(name, result)
+    return result
+
+
+def _wrap_outputs(out):
+    """Wrap jax arrays (possibly nested in tuple/list/dict) into Tensors."""
+    flat, treedef = jtu.tree_flatten(out)
+    wrapped = []
+    arrays = []
+    for a in flat:
+        arrays.append(a)
+        wrapped.append(wrap_array(a))
+    return jtu.tree_unflatten(treedef, wrapped), arrays, treedef
+
+
+def _stamp_outputs(result, node):
+    flat, _ = jtu.tree_flatten(result, is_leaf=_is_tensor)
+    idx = 0
+    for t in flat:
+        if _is_tensor(t):
+            if dtypes.is_floating_point(t.dtype):
+                t.stop_gradient = False
+                t._grad_node = node
+                t._node_out_idx = idx
+            idx += 1
+
+
+def _check_nan_inf(name, result):
+    if not get_flag("check_nan_inf"):
+        return
+    import jax.numpy as jnp
+    flat, _ = jtu.tree_flatten(result, is_leaf=_is_tensor)
+    for t in flat:
+        if _is_tensor(t) and dtypes.is_floating_point(t.dtype):
+            if bool(jnp.any(~jnp.isfinite(t._data))):
+                msg = f"nan/inf detected in output of op '{name}'"
+                if get_flag("check_nan_inf_level", 0) == 0:
+                    raise FloatingPointError(msg)
+                print("WARNING:", msg)
+
+
+def def_op(name: str, spmd_rule: Optional[Callable] = None, **meta):
+    """Define a user-facing op from a pure jax-array function.
+
+    Usage::
+
+        @def_op("matmul")
+        def matmul(x, y, transpose_x=False, transpose_y=False): ...
+    """
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return call_op(name, fn, args, kwargs)
+        OP_REGISTRY[name] = OpDef(name, fn, wrapper, spmd_rule, meta)
+        wrapper.raw_fn = fn
+        return wrapper
+    return deco

@@ -1,0 +1,112 @@
+"""Dtype registry and promotion for the TPU-native framework.
+
+Capability parity with the reference's DataType enum and promotion rules
+(reference: paddle/phi/common/data_type.h, python/paddle/framework/dtype.py),
+re-expressed over JAX/XLA dtypes.  TPU-first notes: bfloat16 is the preferred
+half-precision type (MXU native); float64 is discouraged (emulated on TPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects are numpy dtypes (jnp dtypes are numpy dtypes,
+# with ml_dtypes extension types for bfloat16/fp8).
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float16 = jnp.dtype(jnp.float16)
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float64)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int64)
+uint8 = jnp.dtype(jnp.uint8)
+uint16 = jnp.dtype(jnp.uint16)
+uint32 = jnp.dtype(jnp.uint32)
+uint64 = jnp.dtype(jnp.uint64)
+bool_ = jnp.dtype(jnp.bool_)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex128)
+float8_e4m3fn = jnp.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = jnp.dtype(ml_dtypes.float8_e5m2)
+
+_STR_TO_DTYPE = {
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float16": float16, "fp16": float16, "half": float16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn, "float8_e5m2": float8_e5m2,
+}
+
+_FLOATING = {bfloat16, float16, float32, float64, float8_e4m3fn, float8_e5m2}
+_INTEGER = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+_COMPLEX = {complex64, complex128}
+
+_default_dtype = float32
+
+
+def set_default_dtype(d) -> None:
+    """Set the default floating dtype (reference: paddle.set_default_dtype)."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in _FLOATING:
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(d):
+    """Normalize a user dtype spec (str / np.dtype / python type) to np.dtype."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        key = d.lower()
+        if key.startswith("paddle."):
+            key = key.split(".", 1)[1]
+        if key not in _STR_TO_DTYPE:
+            raise ValueError(f"unsupported dtype string: {d}")
+        return _STR_TO_DTYPE[key]
+    if d is float:
+        return _default_dtype
+    if d is int:
+        return int64
+    if d is bool:
+        return bool_
+    return jnp.dtype(d)
+
+
+def is_floating_point(d) -> bool:
+    return convert_dtype(d) in _FLOATING
+
+
+def is_integer(d) -> bool:
+    return convert_dtype(d) in _INTEGER
+
+
+def is_complex(d) -> bool:
+    return convert_dtype(d) in _COMPLEX
+
+
+def promote_types(a, b):
+    return jnp.promote_types(convert_dtype(a), convert_dtype(b))
+
+
+def finfo(d):
+    return jnp.finfo(convert_dtype(d))
+
+
+def iinfo(d):
+    return jnp.iinfo(convert_dtype(d))
+
+
+def dtype_name(d) -> str:
+    d = convert_dtype(d)
+    return str(d.name) if hasattr(d, "name") else str(d)

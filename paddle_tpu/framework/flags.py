@@ -1,0 +1,95 @@
+"""Runtime flag registry.
+
+Capability parity with the reference's global flag system
+(reference: paddle/common/flags.cc — 185 PHI_DEFINE_* flags; python
+paddle.set_flags/get_flags).  TPU-native: flags are plain Python values with
+env-var ingestion (``FLAGS_*``), consulted by the runtime (allocator knobs are
+no-ops on TPU where PJRT owns memory, but the API surface is preserved).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.Lock()
+_registry: Dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "type", "help", "on_change")
+
+    def __init__(self, name, default, type_, help_, on_change=None):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.type = type_
+        self.help = help_
+        self.on_change = on_change
+
+
+def _parse(type_, raw: str):
+    if type_ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return type_(raw)
+
+
+def define_flag(name: str, default: Any, help_: str = "",
+                type_: Optional[type] = None,
+                on_change: Optional[Callable[[Any], None]] = None) -> None:
+    """Register a flag; env var FLAGS_<name> overrides the default."""
+    type_ = type_ or type(default)
+    with _lock:
+        if name in _registry:
+            return
+        flag = _Flag(name, default, type_, help_, on_change)
+        env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            try:
+                flag.value = _parse(type_, env)
+            except (TypeError, ValueError):
+                pass
+        _registry[name] = flag
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """reference: python/paddle/base/framework.py set_flags."""
+    for name, value in flags.items():
+        key = name[6:] if name.startswith("FLAGS_") else name
+        with _lock:
+            if key not in _registry:
+                define_flag(key, value)
+                continue
+            flag = _registry[key]
+            flag.value = _parse(flag.type, value) if isinstance(value, str) else value
+            cb = flag.on_change
+        if cb is not None:
+            cb(get_flag(key))
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for name in names:
+        key = name[6:] if name.startswith("FLAGS_") else name
+        out["FLAGS_" + key] = get_flag(key)
+    return out
+
+
+def get_flag(name: str, default: Any = None) -> Any:
+    with _lock:
+        flag = _registry.get(name)
+        return flag.value if flag is not None else default
+
+
+# Core flags (subset of reference paddle/common/flags.cc relevant on TPU).
+define_flag("check_nan_inf", False, "scan op outputs for nan/inf (numerical sanitizer)")
+define_flag("check_nan_inf_level", 0, "0: raise on nan/inf; >0: log only")
+define_flag("benchmark", False, "sync after every op for timing")
+define_flag("eager_op_jit", True, "cache-jit eager ops instead of op-by-op dispatch")
+define_flag("log_level", 0, "framework verbose log level (VLOG analog)")
+define_flag("use_stride_kernel", False, "kept for API parity; strides are XLA-internal on TPU")
+define_flag("allocator_strategy", "pjrt", "memory is owned by PJRT on TPU; informational")
+define_flag("tracer_mgpu_memory_fraction", 1.0, "informational on TPU")
+define_flag("comm_timeout_seconds", 600, "collective watchdog timeout (host-side)")

@@ -1,0 +1,41 @@
+"""paddle.save/load analog (filled out with nn/optimizer state_dict support)."""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from .tensor import Tensor, to_tensor
+
+
+def _to_numpy_tree(obj):
+    if isinstance(obj, Tensor):
+        return ("__tensor__", np.asarray(obj._data))
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_numpy_tree(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_numpy_tree(obj):
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__tensor__":
+        return to_tensor(obj[1])
+    if isinstance(obj, dict):
+        return {k: _from_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_numpy_tree(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    """reference: paddle.save (python/paddle/framework/io.py)."""
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path, **kwargs):
+    with open(path, "rb") as f:
+        return _from_numpy_tree(pickle.load(f))

@@ -1,0 +1,138 @@
+"""Stateful RNG facade over JAX's functional PRNG.
+
+Capability parity with the reference's per-device Generator
+(reference: paddle/phi/core/generator.cc, generator.h:32) and the
+model-parallel RNG state tracker
+(reference: python/paddle/distributed/fleet/layers/mpu/random.py).
+
+TPU-native design: a global ``Generator`` owns a jax PRNG key and splits a
+fresh subkey per draw, so the eager API is stateful (paddle-style) while every
+underlying op stays functional/traceable.  Inside ``jit`` tracing, random ops
+fold the key in as a constant per trace — use seeded generators for
+reproducibility across runs.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+
+
+class Generator:
+    """Stateful key-splitting generator (reference: phi::Generator)."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._offset = 0
+
+    def manual_seed(self, seed: int) -> "Generator":
+        with self._lock:
+            self._seed = seed
+            self._key = jax.random.key(seed)
+            self._offset = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def split_key(self):
+        """Return a fresh subkey; advances internal state."""
+        with self._lock:
+            self._offset += 1
+            return jax.random.fold_in(self._key, self._offset)
+
+    def get_state(self):
+        with self._lock:
+            return {"seed": self._seed, "offset": self._offset}
+
+    def set_state(self, state) -> None:
+        with self._lock:
+            self._seed = int(state["seed"])
+            self._key = jax.random.key(self._seed)
+            self._offset = int(state["offset"])
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(value: int) -> Generator:
+    """reference: paddle.seed."""
+    _default_generator.manual_seed(value)
+    RNGStatesTracker.global_tracker().reset_with_base_seed(value)
+    return _default_generator
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state) -> None:
+    _default_generator.set_state(state)
+
+
+def split_key():
+    return _default_generator.split_key()
+
+
+class RNGStatesTracker:
+    """Named RNG states for model-parallel-deterministic dropout.
+
+    reference: fleet/layers/mpu/random.py get_rng_state_tracker — TP ranks need
+    identical dropout masks for replicated activations and distinct masks for
+    sharded ones; named generators provide both.
+    """
+
+    _global: Optional["RNGStatesTracker"] = None
+
+    def __init__(self):
+        self._states: Dict[str, Generator] = {}
+        self._base_seed = 0
+
+    @classmethod
+    def global_tracker(cls) -> "RNGStatesTracker":
+        if cls._global is None:
+            cls._global = RNGStatesTracker()
+        return cls._global
+
+    def reset_with_base_seed(self, base_seed: int) -> None:
+        self._base_seed = base_seed
+        for name, gen in self._states.items():
+            gen.manual_seed(base_seed + (hash(name) % (1 << 30)))
+
+    def add(self, name: str, seed_: int) -> None:
+        self._states[name] = Generator(seed_)
+
+    def get(self, name: str) -> Generator:
+        if name not in self._states:
+            self.add(name, self._base_seed + (hash(name) % (1 << 30)))
+        return self._states[name]
+
+    class _Scope:
+        def __init__(self, tracker, name):
+            self.tracker, self.name = tracker, name
+
+        def __enter__(self):
+            global _default_generator
+            self._saved = _default_generator
+            _default_generator = self.tracker.get(self.name)
+            return _default_generator
+
+        def __exit__(self, *exc):
+            global _default_generator
+            _default_generator = self._saved
+            return False
+
+    def rng_state(self, name: str = "model-parallel-rng"):
+        """Context manager: draws inside use the named generator."""
+        return RNGStatesTracker._Scope(self, name)
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return RNGStatesTracker.global_tracker()

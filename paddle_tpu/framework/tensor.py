@@ -1,0 +1,327 @@
+"""The eager Tensor: a paddle-semantics wrapper over ``jax.Array``.
+
+Capability parity with the reference's eager Tensor
+(reference: paddle/fluid/pybind/eager.cc Tensor type, eager_method.cc methods,
+eager_properties.cc; phi::DenseTensor paddle/phi/core/dense_tensor.h:37).
+
+TPU-native design: the payload is an immutable ``jax.Array`` (device-resident,
+async); "in-place" mutation rebinds the payload functionally (XLA has no
+aliasing mutation), matching the reference's API while staying trace-safe.
+Autograd metadata (stop_gradient / grad / tape node) lives on the wrapper,
+mirroring egr::AutogradMeta.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+from . import tape as _tape
+from .device import Place, get_current_place
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_grad_node",
+                 "_node_out_idx", "name", "persistable", "_grad_hooks",
+                 "__weakref__", "dist_attr", "_pp_meta")
+
+    # ------------------------------------------------------------------ init
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
+                 name: Optional[str] = None):
+        if data is None:
+            arr = jnp.zeros((), dtypes.get_default_dtype())
+        else:
+            arr = _coerce_array(data, dtype)
+        self._init_from_array(arr, stop_gradient=stop_gradient, name=name)
+
+    def _init_from_array(self, arr, stop_gradient=True, name=None):
+        self._data = arr
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._node_out_idx = 0
+        self.name = name or ""
+        self.persistable = False
+        self._grad_hooks = []
+        self.dist_attr = None
+        self._pp_meta = None
+
+    # ------------------------------------------------------------ properties
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self) -> Place:
+        try:
+            dev = next(iter(self._data.devices()))
+            return Place(dev.platform, dev.id)
+        except Exception:
+            return get_current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from .. import tensor as T
+        return T.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        from .. import tensor as T
+        perm = list(range(self.ndim))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        return T.transpose(self, perm)
+
+    def numel(self) -> int:
+        return self.size
+
+    def dim(self) -> int:
+        return self.ndim
+
+    # ------------------------------------------------------------- transfers
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        from ..framework.dispatch import call_op
+        d = dtypes.convert_dtype(dtype)
+        return call_op("cast", lambda x: x.astype(d), (self,), {})
+
+    cast = astype
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a.lower() in ("cpu", "tpu", "gpu"):
+                continue  # single logical device space under PJRT
+            try:
+                dtype = dtypes.convert_dtype(a)
+            except (ValueError, TypeError):
+                pass
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    def cpu(self) -> "Tensor":
+        return self
+
+    def cuda(self, *a, **k) -> "Tensor":
+        return self
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    # -------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph: bool = False) -> None:
+        """reference: eager_functions.cc run_backward → backward.cc:105."""
+        _tape.run_backward([self], [grad_tensor] if grad_tensor is not None else None,
+                           retain_graph=retain_graph)
+
+    def detach(self) -> "Tensor":
+        t = Tensor.__new__(Tensor)
+        t._init_from_array(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self._node_out_idx = 0
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..framework.dispatch import call_op
+        return call_op("clone", lambda x: x + jnp.zeros((), x.dtype), (self,), {})
+
+    def register_hook(self, hook):
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def clear_gradient(self, set_to_zero: bool = True) -> None:
+        if set_to_zero and self.grad is not None:
+            self.grad._data = jnp.zeros_like(self.grad._data)
+        else:
+            self.grad = None
+
+    def clear_grad(self) -> None:
+        self.clear_gradient(set_to_zero=False)
+
+    def retain_grads(self) -> None:
+        # Non-leaf grads: register a hook that stashes the cotangent.
+        if self._grad_node is None:
+            return
+
+        def _stash(g):
+            if self.grad is None:
+                self.grad = g
+            else:
+                self.grad._data = self.grad._data + g._data
+            return None
+        self._grad_hooks.append(_stash)
+
+    # ------------------------------------------------------------- mutation
+    def _check_inplace(self):
+        if _tape.is_grad_enabled() and not self.stop_gradient and self.is_leaf:
+            raise RuntimeError(
+                "Leaf Tensor that requires grad is being used in an in-place "
+                "operation; wrap in paddle_tpu.no_grad() (reference: eager "
+                "inplace version check).")
+
+    def set_value(self, value) -> None:
+        arr = _coerce_array(value, None)
+        if tuple(arr.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self._data.shape}")
+        self._data = arr.astype(self._data.dtype)
+
+    def copy_(self, other, blocking: bool = True) -> "Tensor":
+        src = other._data if isinstance(other, Tensor) else _coerce_array(other, None)
+        self._data = src.astype(self._data.dtype)
+        return self
+
+    def fill_(self, value) -> "Tensor":
+        self._check_inplace()
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self) -> "Tensor":
+        self._check_inplace()
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    # --------------------------------------------------------------- dunder
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_str = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}"
+                f"{grad_str},\n       {np.asarray(self._data)})")
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    # numpy interop (one-way: exporting a Tensor detaches it from the tape)
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def block_until_ready(self) -> "Tensor":
+        self._data.block_until_ready()
+        return self
+
+    # value_and_placement helpers used by distributed code
+    def is_dist(self) -> bool:
+        return self.dist_attr is not None
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: python/paddle/base/framework.py Parameter /
+    EagerParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.persistable = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _coerce_array(data, dtype):
+    d = dtypes.convert_dtype(dtype) if dtype is not None else None
+    if isinstance(data, Tensor):
+        arr = data._data
+    elif isinstance(data, (jax.Array,)):
+        arr = data
+    elif isinstance(data, np.ndarray):
+        if d is None and data.dtype == np.float64:
+            d = dtypes.get_default_dtype()
+        if d is None and data.dtype == np.int64:
+            d = dtypes.int64
+        arr = jnp.asarray(data, d)
+        d = None
+    elif isinstance(data, (bool, int, float, complex)):
+        if d is None:
+            if isinstance(data, bool):
+                d = dtypes.bool_
+            elif isinstance(data, int):
+                d = dtypes.int64
+            elif isinstance(data, float):
+                d = dtypes.get_default_dtype()
+            else:
+                d = dtypes.complex64
+        arr = jnp.asarray(data, d)
+        d = None
+    elif isinstance(data, (list, tuple)):
+        npa = np.asarray([x.numpy() if isinstance(x, Tensor) else x for x in data]) \
+            if any(isinstance(x, Tensor) for x in data) else np.asarray(data)
+        return _coerce_array(npa, d)
+    else:
+        arr = jnp.asarray(data)
+    if d is not None:
+        arr = arr.astype(d)
+    return arr
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """reference: paddle.to_tensor (python/paddle/tensor/creation.py)."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def wrap_array(arr, stop_gradient: bool = True, name: str = "") -> Tensor:
+    t = Tensor.__new__(Tensor)
+    t._init_from_array(arr, stop_gradient=stop_gradient, name=name)
+    return t

@@ -1,0 +1,4 @@
+"""paddle.Model high-level API (fleshed out in hapi build step)."""
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network

@@ -1,0 +1,230 @@
+"""Tensor functional API + Tensor method patching.
+
+Capability parity: python/paddle/tensor/__init__.py — the reference patches
+~400 methods onto its eager Tensor (eager_math_op_patch.cc); we do the same in
+Python at import time.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, Parameter, to_tensor, wrap_array
+from ..framework.dispatch import call_op, def_op
+from ..framework import dtype as dtypes
+
+from .math import *        # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .creation import *    # noqa: F401,F403
+from .logic import *       # noqa: F401,F403
+from .search import *      # noqa: F401,F403
+from . import linalg       # noqa: F401
+from . import math as _math
+from . import manipulation as _manip
+from . import logic as _logic
+from . import search as _search
+from . import creation as _creation
+
+
+@def_op("einsum_")
+def _einsum(equation, operands):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    return _einsum(equation, list(operands))
+
+
+@def_op("getitem")
+def _getitem(x, idx):
+    return x[idx]
+
+
+@def_op("setitem")
+def _setitem(x, idx, value):
+    return x.at[idx].set(jnp.asarray(value, x.dtype) if not hasattr(value, "dtype")
+                         else value.astype(x.dtype))
+
+
+def _norm_index(item):
+    """Unwrap Tensor indices (kept as op inputs via the dispatch flattener)."""
+    if isinstance(item, tuple):
+        return tuple(_norm_index(i) for i in item)
+    if isinstance(item, list):
+        if any(isinstance(i, (builtins.slice, type(None), type(Ellipsis))) for i in item):
+            return tuple(_norm_index(i) for i in item)
+        return jnp.asarray(np.asarray(item))
+    return item
+
+
+def _tensor_getitem(self, item):
+    return _getitem(self, _norm_index(item))
+
+
+def _tensor_setitem(self, item, value):
+    out = _setitem(self, _norm_index(item), value)
+    # adopt the functional result (in-place semantics; reference: eager
+    # __setitem__ writes through a view)
+    self._data = out._data
+    self._grad_node = out._grad_node
+    self._node_out_idx = out._node_out_idx
+    self.stop_gradient = out.stop_gradient and self.stop_gradient
+
+
+_BINOPS = {
+    "__add__": _math.add, "__sub__": _math.subtract, "__mul__": _math.multiply,
+    "__truediv__": _math.divide, "__floordiv__": _math.floor_divide,
+    "__mod__": _math.remainder, "__pow__": _math.pow,
+    "__matmul__": _math.matmul,
+    "__eq__": _logic.equal, "__ne__": _logic.not_equal,
+    "__gt__": _logic.greater_than, "__ge__": _logic.greater_equal,
+    "__lt__": _logic.less_than, "__le__": _logic.less_equal,
+    "__and__": _logic.bitwise_and, "__or__": _logic.bitwise_or,
+    "__xor__": _logic.bitwise_xor,
+    "__lshift__": _logic.bitwise_left_shift,
+    "__rshift__": _logic.bitwise_right_shift,
+}
+
+_RBINOPS = {
+    "__radd__": _math.add, "__rmul__": _math.multiply,
+}
+
+
+def _make_bin(fn):
+    def method(self, other):
+        if isinstance(other, (list, tuple, np.ndarray)):
+            other = to_tensor(other)
+        return fn(self, other)
+    return method
+
+
+def _make_rbin(fn, swap=False):
+    def method(self, other):
+        if not isinstance(other, Tensor):
+            other = to_tensor(np.asarray(other)) if isinstance(other, (list, tuple, np.ndarray)) else other
+        if swap:
+            return fn(other, self)
+        return fn(self, other)
+    return method
+
+
+def _rsub(self, other):
+    return _math.subtract(to_tensor(other) if not isinstance(other, (Tensor, int, float)) else other, self) \
+        if isinstance(other, Tensor) else call_op("rsub", lambda x: other - x, (self,), {})
+
+
+def _rdiv(self, other):
+    return call_op("rdiv", lambda x: other / x, (self,), {})
+
+
+def _rpow(self, other):
+    return call_op("rpow", lambda x: other ** x, (self,), {})
+
+
+def _rmatmul(self, other):
+    return _math.matmul(to_tensor(other), self)
+
+
+_METHODS = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "maximum", "minimum", "fmax", "fmin", "matmul", "bmm", "mm",
+    "mv", "dot", "inner", "outer", "kron", "cross", "addmm", "trace",
+    "diagonal", "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt",
+    "rsqrt", "abs", "sign", "sin", "cos", "tan", "asin", "acos", "atan",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "floor", "ceil",
+    "round", "trunc", "frac", "reciprocal", "square", "neg", "erf", "erfinv",
+    "digamma", "lgamma", "angle", "conj", "real", "imag", "deg2rad",
+    "rad2deg", "clip", "nan_to_num", "lerp", "scale", "atan2", "logit",
+    "sigmoid", "heaviside",
+    # reductions
+    "sum", "mean", "max", "min", "amax", "amin", "prod", "logsumexp", "all",
+    "any", "cumsum", "cumprod", "diff", "isnan", "isinf", "isfinite",
+    "count_nonzero", "nansum", "nanmean",
+    # manipulation
+    "reshape", "transpose", "concat", "split", "chunk", "squeeze",
+    "unsqueeze", "flatten", "expand", "expand_as", "broadcast_to", "tile",
+    "flip", "roll", "rot90", "moveaxis", "gather", "gather_nd",
+    "take_along_axis", "put_along_axis", "scatter", "scatter_nd_add",
+    "index_select", "index_add", "masked_select", "masked_fill", "where",
+    "repeat_interleave", "pad", "cast", "slice", "tril", "triu", "diag",
+    "unbind", "unstack", "unique", "tensordot",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor", "isclose",
+    "allclose", "equal_all",
+    # search/stat
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+    "nonzero", "searchsorted", "index_sample", "std", "var", "median",
+    "quantile", "histogram", "bincount",
+    # creation-like
+    "zeros_like", "ones_like", "full_like",
+    # linalg (subset as methods)
+    "norm", "dist", "cholesky", "inv", "pinv", "det",
+]
+
+_NAMESPACES = [_math, _manip, _logic, _search, _creation, linalg]
+
+
+def _find_fn(name):
+    for ns in _NAMESPACES:
+        if hasattr(ns, name):
+            return getattr(ns, name)
+    return None
+
+
+_INPLACE_BASE = [
+    "add", "subtract", "multiply", "divide", "remainder", "pow", "clip",
+    "scale", "floor", "ceil", "round", "exp", "sqrt", "rsqrt", "reciprocal",
+    "tanh", "sigmoid", "abs", "neg", "cast", "squeeze", "unsqueeze",
+    "reshape", "flatten", "masked_fill", "lerp", "trunc",
+]
+
+
+def _make_method(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+    return method
+
+
+def _make_inplace(fn):
+    def method(self, *args, **kwargs):
+        self._check_inplace()
+        out = fn(self, *args, **kwargs)
+        self._data = out._data
+        self._grad_node = out._grad_node
+        self._node_out_idx = out._node_out_idx
+        self.stop_gradient = out.stop_gradient and self.stop_gradient
+        return self
+    return method
+
+
+def monkey_patch_tensor():
+    for name, fn in _BINOPS.items():
+        setattr(Tensor, name, _make_bin(fn))
+    for name, fn in _RBINOPS.items():
+        setattr(Tensor, name, _make_rbin(fn))
+    Tensor.__rsub__ = _rsub
+    Tensor.__rtruediv__ = _rdiv
+    Tensor.__rpow__ = _rpow
+    Tensor.__rmatmul__ = _rmatmul
+    Tensor.__neg__ = lambda self: _math.neg(self)
+    Tensor.__abs__ = lambda self: _math.abs(self)
+    Tensor.__invert__ = lambda self: _logic.logical_not(self)
+    Tensor.__getitem__ = _tensor_getitem
+    Tensor.__setitem__ = _tensor_setitem
+    Tensor.__hash__ = object.__hash__
+    for name in _METHODS:
+        fn = _find_fn(name)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, _make_method(fn))
+    for name in _INPLACE_BASE:
+        fn = _find_fn(name)
+        if fn is not None:
+            setattr(Tensor, name + "_", _make_inplace(fn))
+
+
+monkey_patch_tensor()

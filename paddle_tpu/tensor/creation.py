@@ -1,0 +1,234 @@
+"""Creation + random ops.
+
+Capability parity: python/paddle/tensor/creation.py + random.py in the
+reference.  Random draws go through the stateful Generator facade
+(framework/random.py) so the eager API is paddle-like while staying
+functional under the hood.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import call_op
+from ..framework.tensor import Tensor, to_tensor, wrap_array
+from ..framework import dtype as dtypes
+from ..framework import random as _random
+
+
+def _d(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else dtypes.get_default_dtype()
+    return dtypes.convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data).reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return wrap_array(jnp.zeros(_shape(shape), _d(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return wrap_array(jnp.ones(_shape(shape), _d(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return wrap_array(jnp.full(_shape(shape), fill_value, _d(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return call_op("zeros_like", lambda a: jnp.zeros_like(a, _d(dtype, a.dtype) if dtype else None), (x,), {})
+
+
+def ones_like(x, dtype=None, name=None):
+    return call_op("ones_like", lambda a: jnp.ones_like(a, _d(dtype, a.dtype) if dtype else None), (x,), {})
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return call_op("full_like", lambda a: jnp.full_like(a, fill_value, dtype=_d(dtype, a.dtype) if dtype else None), (x,), {})
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = dtypes.int64 if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step)) \
+            else dtypes.get_default_dtype()
+    return wrap_array(jnp.arange(start, end, step, _d(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return wrap_array(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=_d(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return wrap_array(jnp.logspace(_v(start), _v(stop), int(_v(num)),
+                                   base=_v(base), dtype=_d(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return wrap_array(jnp.eye(int(num_rows),
+                              int(num_columns) if num_columns else None,
+                              dtype=_d(dtype)))
+
+
+def assign(x, output=None):
+    src = to_tensor(x) if not isinstance(x, Tensor) else x
+    out = call_op("assign", lambda a: a + jnp.zeros((), a.dtype), (src,), {})
+    if output is not None:
+        output._data = out._data
+        return output
+    return out
+
+
+def clone(x):
+    return x.clone()
+
+
+def tril_(x, diagonal=0):
+    from .manipulation import tril
+    return tril(x, diagonal)
+
+
+def triu_(x, diagonal=0):
+    from .manipulation import triu
+    return triu(x, diagonal)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return wrap_array(jnp.asarray(np.stack([r, c]), _d(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col or row
+    r, c = np.triu_indices(row, offset, col)
+    return wrap_array(jnp.asarray(np.stack([r, c]), _d(dtype)))
+
+
+def complex(real, imag):
+    return call_op("complex", lambda r, i: jax.lax.complex(r, i), (real, imag), {})
+
+
+def polar(abs, angle):
+    return call_op("polar", lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)),
+                   (abs, angle), {})
+
+
+# ------------------------------------------------------------------ random
+def rand(shape, dtype=None, name=None):
+    key = _random.split_key()
+    return wrap_array(jax.random.uniform(key, _shape(shape), _d(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    key = _random.split_key()
+    return wrap_array(jax.random.normal(key, _shape(shape), _d(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = _random.split_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return wrap_array(jax.random.normal(key, shp) * s + m)
+    return wrap_array(
+        jax.random.normal(key, _shape(shape or [1]), dtypes.get_default_dtype())
+        * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else _random.split_key()
+    return wrap_array(jax.random.uniform(
+        key, _shape(shape), _d(dtype), minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = _random.split_key()
+    return wrap_array(jax.random.randint(
+        key, _shape(shape), low, high, _d(dtype, dtypes.int64)))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    return randint(low, high, tuple(x.shape), dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = _random.split_key()
+    return wrap_array(jax.random.permutation(key, int(n)).astype(_d(dtype)))
+
+
+def bernoulli(x, name=None):
+    key = _random.split_key()
+    return call_op("bernoulli",
+                   lambda p: jax.random.bernoulli(key, p).astype(p.dtype), (x,), {})
+
+
+def poisson(x, name=None):
+    key = _random.split_key()
+    return call_op("poisson",
+                   lambda lam: jax.random.poisson(key, lam).astype(lam.dtype), (x,), {})
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = _random.split_key()
+
+    def _fn(probs):
+        logits = jnp.log(jnp.maximum(probs, 1e-30))
+        if replacement:
+            return jax.random.categorical(key, logits, shape=probs.shape[:-1] + (num_samples,))
+        # without replacement: gumbel top-k
+        g = jax.random.gumbel(key, probs.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    return call_op("multinomial", lambda p: _fn(p).astype(jnp.int64), (x,), {})
+
+
+def exponential_(x, lam=1.0):
+    key = _random.split_key()
+    x._data = jax.random.exponential(key, x._data.shape, x._data.dtype) / lam
+    return x
+
+
+def rand_like(x, dtype=None):
+    return rand(tuple(x.shape), dtype or x.dtype)
+
+
+def randn_like(x, dtype=None):
+    return randn(tuple(x.shape), dtype or x.dtype)
+
+
+def empty_strided(shape, stride, dtype=None):
+    return zeros(shape, dtype)

@@ -1,0 +1,192 @@
+"""Linear algebra ops (paddle.linalg surface).
+
+Capability parity: python/paddle/tensor/linalg.py + python/paddle/linalg.py.
+Decompositions route through jax.numpy.linalg / jax.scipy.linalg — XLA lowers
+them natively (QR/SVD/Cholesky/Eigh run on TPU; general eig falls back to
+host, same caveat class as the reference's magma-backed paths).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import def_op
+from .math import matmul, bmm, dot, mv  # noqa: F401  (re-export parity)
+
+
+@def_op("norm")
+def norm(x, p=None, axis=None, keepdim=False):
+    if p in (None, "fro") and axis is None:
+        return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x))))
+    if isinstance(axis, (list, tuple)) and len(axis) == 2:
+        return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+    ax = axis if axis is None else int(axis) if not isinstance(axis, (list, tuple)) else tuple(axis)
+    if p is None or p == "fro":
+        p = 2
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=ax, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=ax, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=ax, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=ax, keepdims=keepdim),
+                     1.0 / p)
+
+
+@def_op("vector_norm")
+def vector_norm(x, p=2.0, axis=None, keepdim=False):
+    ax = axis if axis is None or isinstance(axis, int) else tuple(axis)
+    return jnp.linalg.norm(x.reshape(-1) if axis is None else x,
+                           ord=p, axis=ax if axis is not None else None,
+                           keepdims=keepdim if axis is not None else False)
+
+
+@def_op("matrix_norm")
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+@def_op("dist")
+def dist(x, y, p=2)               :
+    return jnp.linalg.norm((x - y).reshape(-1), ord=p)
+
+
+@def_op("cholesky")
+def cholesky(x, upper=False):
+    out = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(out, -1, -2).conj() if upper else out
+
+
+@def_op("cholesky_solve")
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@def_op("qr")
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@def_op("svd")
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@def_op("svdvals")
+def svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+@def_op("eig")
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+@def_op("eigh")
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+@def_op("eigvals")
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+@def_op("eigvalsh")
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@def_op("inv")
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+@def_op("pinv")
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@def_op("solve")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@def_op("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@def_op("lstsq")
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@def_op("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@def_op("slogdet")
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@def_op("matrix_power")
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@def_op("matrix_rank")
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@def_op("multi_dot")
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+@def_op("cond")
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@def_op("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@def_op("corrcoef")
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@def_op("householder_product")
+def householder_product(x, tau):
+    m, n = x.shape[-2], x.shape[-1]
+    eye = jnp.eye(m, dtype=x.dtype)
+
+    def body(i, q):
+        v = jnp.where(jnp.arange(m) < i, 0.0, x[..., :, i])
+        v = v.at[i].set(1.0)
+        h = eye - tau[..., i] * jnp.outer(v, v)
+        return q @ h
+    q = eye
+    for i in range(n):
+        q = body(i, q)
+    return q[..., :, :n]
+
+
+@def_op("pca_lowrank")
+def pca_lowrank(x, q=None, center=True, niter=2):
+    if center:
+        x = x - jnp.mean(x, axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(x, full_matrices=False)
+    q = q or min(x.shape[-2:])
+    return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]

@@ -1,0 +1,59 @@
+"""Comparison / logical / bitwise ops.
+
+Capability parity: python/paddle/tensor/logic.py in the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.dispatch import def_op
+from ..framework.tensor import Tensor
+
+_BINARY = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+    "less_than": jnp.less, "less_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "bitwise_left_shift": jnp.left_shift, "bitwise_right_shift": jnp.right_shift,
+}
+
+_g = globals()
+for _name, _fn in _BINARY.items():
+    _g[_name] = def_op(_name)(_fn)
+
+
+@def_op("logical_not")
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@def_op("bitwise_not")
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@def_op("isclose")
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@def_op("allclose")
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@def_op("equal_all")
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+@def_op("is_empty")
+def is_empty(x):
+    return jnp.asarray(x.size == 0)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)

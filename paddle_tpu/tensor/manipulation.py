@@ -1,0 +1,509 @@
+"""Shape/layout manipulation ops.
+
+Capability parity: python/paddle/tensor/manipulation.py in the reference.
+All static-shape friendly (XLA requires static shapes under jit); ops that are
+inherently dynamic-shape (masked_select, nonzero) work eagerly and document
+the jit caveat.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.dispatch import def_op, call_op
+from ..framework.tensor import Tensor
+from ..framework import dtype as dtypes
+
+
+def _static(v):
+    """Coerce possibly-Tensor shape args to python ints (shapes are static)."""
+    if isinstance(v, Tensor):
+        return [int(s) for s in np.asarray(v._data).reshape(-1)]
+    if isinstance(v, (list, tuple)):
+        return [int(s.item()) if isinstance(s, Tensor) else int(s) for s in v]
+    return int(v)
+
+
+@def_op("reshape")
+def _reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape):
+    return _reshape(x, tuple(_static(shape)))
+
+
+@def_op("transpose")
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def t(x):
+    return transpose(x, list(range(x.ndim))[::-1])
+
+
+@def_op("concat_")
+def _concat(xs, axis):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    axis = axis.item() if isinstance(axis, Tensor) else int(axis)
+    return _concat(list(x), axis)
+
+
+@def_op("stack_")
+def _stack(xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack(list(x), int(axis))
+
+
+@def_op("split_")
+def _split(x, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    idx = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis.item() if isinstance(axis, Tensor) else axis)
+    if isinstance(num_or_sections, (list, tuple)):
+        secs = list(num_or_sections)
+        if any(s == -1 for s in secs):
+            total = x.shape[axis] if isinstance(x, Tensor) else x.shape[axis]
+            rest = total - builtins.sum(s for s in secs if s != -1)
+            secs = [rest if s == -1 else s for s in secs]
+        out = _split(x, secs, axis)
+    else:
+        out = _split(x, int(num_or_sections), axis)
+    return list(out)
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+@def_op("squeeze")
+def _squeeze(x, axis):
+    return jnp.squeeze(x, axis=axis)
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return _squeeze(x, None)
+    if isinstance(axis, (list, tuple)):
+        ax = tuple(a for a in axis if x.shape[a] == 1)
+        if not ax:
+            return x.clone() if isinstance(x, Tensor) else x
+        return _squeeze(x, ax)
+    if x.shape[axis] != 1:
+        return x.clone() if isinstance(x, Tensor) else x
+    return _squeeze(x, int(axis))
+
+
+@def_op("unsqueeze")
+def _unsqueeze(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = _static(axis)
+        axis = axis[0] if len(axis) == 1 else tuple(axis)
+    elif isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    else:
+        axis = int(axis)
+    return _unsqueeze(x, axis)
+
+
+@def_op("flatten_")
+def _flatten(x, start, stop):
+    shape = x.shape
+    stop = stop if stop >= 0 else len(shape) + stop
+    new = shape[:start] + (-1,) + shape[stop + 1:]
+    return jnp.reshape(x, new)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _flatten(x, int(start_axis), int(stop_axis))
+
+
+@def_op("expand_")
+def _expand(x, shape):
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s in (-1,) else s
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape, name=None):
+    return _expand(x, tuple(_static(shape)))
+
+
+def expand_as(x, y):
+    return _expand(x, tuple(y.shape))
+
+
+def broadcast_to(x, shape):
+    return expand(x, shape)
+
+
+@def_op("broadcast_tensors")
+def broadcast_tensors(inputs):
+    return tuple(jnp.broadcast_arrays(*inputs))
+
+
+@def_op("tile_")
+def _tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+def tile(x, repeat_times, name=None):
+    return _tile(x, tuple(_static(repeat_times)))
+
+
+@def_op("flip")
+def flip(x, axis):
+    return jnp.flip(x, axis=axis if isinstance(axis, int) else tuple(axis))
+
+
+@def_op("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@def_op("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@def_op("moveaxis")
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@def_op("swapaxes")
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+transpose_ = swapaxes
+
+
+@def_op("unbind_")
+def _unbind(x, axis):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def unbind(x, axis=0):
+    return list(_unbind(x, int(axis)))
+
+
+def unstack(x, axis=0, num=None):
+    return unbind(x, axis)
+
+
+@def_op("gather")
+def gather(x, index, axis=0):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, index, axis=axis)
+
+
+@def_op("gather_nd")
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@def_op("take_along_axis")
+def take_along_axis(x, indices, axis, broadcast=True):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@def_op("put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    if reduce == "add":
+        return _put_along(x, indices, values, axis, "add")
+    if reduce in ("mul", "multiply"):
+        return _put_along(x, indices, values, axis, "mul")
+    return _put_along(x, indices, values, axis, "assign")
+
+
+def _put_along(x, indices, values, axis, mode):
+    values = jnp.broadcast_to(values, indices.shape) \
+        if jnp.ndim(values) else jnp.full(indices.shape, values, x.dtype)
+    idx = []
+    for d in range(x.ndim):
+        if d == axis:
+            idx.append(indices)
+        else:
+            shape = [1] * x.ndim
+            shape[d] = x.shape[d]
+            idx.append(jnp.arange(x.shape[d]).reshape(shape))
+    idx = tuple(jnp.broadcast_arrays(*idx))
+    at = x.at[idx]
+    return {"assign": at.set, "add": at.add, "mul": at.multiply}[mode](values)
+
+
+@def_op("scatter")
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+@def_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@def_op("scatter_nd")
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(tuple(shape), updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return zeros.at[idx].add(updates)
+
+
+@def_op("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@def_op("index_add")
+def index_add(x, index, axis, value):
+    idx = [builtins.slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+@def_op("index_put")
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+@def_op("masked_select")
+def masked_select(x, mask):
+    # Dynamic output shape: eager-only (document; reference has same op on GPU).
+    return x[mask]
+
+
+@def_op("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+@def_op("where_")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+        return nonzero(condition, as_tuple=True)
+    return _where(condition, x, y)
+
+
+@def_op("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@def_op("pad_")
+def _pad(x, pad_width, mode, value):
+    if mode == "constant":
+        return jnp.pad(x, pad_width, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, pad_width, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """reference: paddle.nn.functional.pad semantics (last-dims-first pairs)."""
+    pad = _static(pad) if not isinstance(pad, (list, tuple)) else [
+        int(p.item()) if isinstance(p, Tensor) else int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        npairs = len(pad) // 2
+        width = [(0, 0)] * (nd - npairs)
+        # paddle: pads apply to the last npairs spatial dims, ordered from the
+        # last-but-one... For NCHW 4-d with len(pad)==4: (left,right,top,bottom)
+        # applies to W then H? Reference: pad=[l, r, t, b] pads dims (W: l,r) is
+        # index 0-1 on dim -1 and 2-3 on dim -2.
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(npairs)]
+        for i, pr in enumerate(pairs):
+            width[nd - 1 - i] = pr
+        if data_format in ("NHWC", "NDHWC", "NLC") and npairs < nd:
+            # channel-last: spatial dims end at -2
+            width = [(0, 0)] * nd
+            for i, pr in enumerate(pairs):
+                width[nd - 2 - i] = pr
+    return _pad(x, tuple(width), mode, value)
+
+
+@def_op("cast")
+def _cast(x, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    return _cast(x, dtypes.convert_dtype(dtype))
+
+
+@def_op("slice_")
+def _slice(x, axes, starts, ends):
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(st, en)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):
+    return _slice(x, tuple(axes), tuple(_static(starts)), tuple(_static(ends)))
+
+
+@def_op("strided_slice")
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(st, en, sd)
+    return x[tuple(idx)]
+
+
+@def_op("crop")
+def crop(x, shape, offsets):
+    idx = tuple(builtins.slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+@def_op("tril")
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@def_op("triu")
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@def_op("diag")
+def diag(x, offset=0, padding_value=0.0):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0.0:
+            mask = jnp.eye(*out.shape, k=offset, dtype=bool)
+            out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+        return out
+    return jnp.diag(x, k=offset)
+
+
+@def_op("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def emb(v):
+        n = v.shape[-1] + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        i = jnp.arange(v.shape[-1])
+        r = i + builtins.max(0, -offset)
+        c = i + builtins.max(0, offset)
+        return out.at[..., r, c].set(v)
+    return emb(x)
+
+
+@def_op("diagflat")
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@def_op("meshgrid_")
+def _meshgrid(xs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(_meshgrid(list(args)))
+
+
+@def_op("unique_")
+def _unique(x, return_index, return_inverse, return_counts, axis):
+    return jnp.unique(x, return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64"):
+    out = _unique(x, return_index, return_inverse, return_counts, axis)
+    return out
+
+
+@def_op("one_hot")
+def _one_hot(x, num_classes):
+    return jnp.eye(num_classes, dtype=jnp.float32)[x]
+
+
+def one_hot(x, num_classes):
+    return _one_hot(x, int(num_classes))
+
+
+@def_op("as_strided")
+def as_strided(x, shape, stride, offset=0):
+    flat = x.reshape(-1)[offset:]
+    idx = np.zeros(tuple(shape), dtype=np.int64)
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        sl = [None] * len(shape)
+        sl[d] = builtins.slice(None)
+        idx = idx + np.arange(s).reshape(
+            [1 if i != d else -1 for i in range(len(shape))]) * st
+    return flat[idx]
+
+
+@def_op("view")
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, tuple(shape_or_dtype))
+    return x.view(shape_or_dtype) if hasattr(x, "view") else x
+
+
+@def_op("numel_op")
+def numel(x):
+    return jnp.asarray(np.prod(x.shape), jnp.int64)
+
+
+@def_op("shard_index")
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    size = (index_num + nshards - 1) // nshards
+    lo, hi = shard_id * size, (shard_id + 1) * size
+    inside = (x >= lo) & (x < hi)
+    return jnp.where(inside, x - lo, ignore_value)
+
+
+@def_op("tensordot")
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@def_op("atleast_1d")
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+@def_op("atleast_2d")
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+@def_op("atleast_3d")
+def atleast_3d(x):
+    return jnp.atleast_3d(x)

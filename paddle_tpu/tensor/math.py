@@ -1,0 +1,369 @@
+"""Math + reduction ops (pure jax-array kernels behind the op dispatch).
+
+Capability parity with the reference's tensor math surface
+(reference: python/paddle/tensor/math.py, ops.yaml entries; e.g. matmul at
+paddle/phi/ops/yaml/inconsistent/dygraph_ops.yaml:232).  Every op here is a
+pure function over jax arrays registered through ``def_op`` — XLA is the
+kernel backend; grads come from jax.vjp at the dispatch layer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.dispatch import def_op, call_op
+from ..framework import dtype as dtypes
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# --------------------------------------------------------------- elementwise
+@def_op("add")
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@def_op("subtract")
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@def_op("multiply")
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@def_op("divide")
+def divide(x, y):
+    return jnp.true_divide(x, y)
+
+
+@def_op("floor_divide")
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@def_op("remainder")
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+floor_mod = remainder
+
+
+@def_op("pow")
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@def_op("maximum")
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@def_op("minimum")
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@def_op("fmax")
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@def_op("fmin")
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@def_op("atan2")
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@def_op("hypot")
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@def_op("copysign")
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+@def_op("nextafter")
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+@def_op("heaviside")
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@def_op("gcd")
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@def_op("lcm")
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@def_op("logaddexp")
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@def_op("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@def_op("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+# unary
+_UNARY = {
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log2": jnp.log2,
+    "log10": jnp.log10, "log1p": jnp.log1p, "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x), "abs": jnp.abs, "sign": jnp.sign,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "asin": jnp.arcsin,
+    "acos": jnp.arccos, "atan": jnp.arctan, "sinh": jnp.sinh,
+    "cosh": jnp.cosh, "tanh": jnp.tanh, "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh, "atanh": jnp.arctanh, "floor": jnp.floor,
+    "ceil": jnp.ceil, "round": jnp.round, "trunc": jnp.trunc,
+    "frac": lambda x: x - jnp.trunc(x), "reciprocal": jnp.reciprocal,
+    "square": jnp.square, "neg": jnp.negative, "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv, "digamma": jax.scipy.special.digamma,
+    "lgamma": jax.scipy.special.gammaln, "i0": jax.scipy.special.i0,
+    "i0e": jax.scipy.special.i0e, "i1": jax.scipy.special.i1,
+    "i1e": jax.scipy.special.i1e, "angle": jnp.angle, "conj": jnp.conj,
+    "real": jnp.real, "imag": jnp.imag, "deg2rad": jnp.deg2rad,
+    "rad2deg": jnp.rad2deg, "sigmoid": jax.nn.sigmoid,
+    "logit": jax.scipy.special.logit, "rint": jnp.rint,
+}
+
+_g = globals()
+for _name, _fn in _UNARY.items():
+    _g[_name] = def_op(_name)(_fn)
+negative = _g["neg"]
+
+
+@def_op("clip")
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@def_op("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@def_op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@def_op("multiplex")
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)
+    idx = index.reshape(-1)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+# ------------------------------------------------------------------- matmul
+@def_op("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    # bf16-friendly: keep inputs as-is; XLA maps to MXU.
+    return jnp.matmul(x, y)
+
+
+@def_op("bmm")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@def_op("mm")
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+@def_op("mv")
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@def_op("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@def_op("inner")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@def_op("outer")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@def_op("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@def_op("cross")
+def cross(x, y, axis=9):
+    ax = axis if axis != 9 else next(
+        i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=ax)
+
+
+@def_op("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@def_op("trace")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@def_op("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# --------------------------------------------------------------- reductions
+@def_op("sum")
+def sum(x, axis=None, dtype=None, keepdim=False):
+    d = dtypes.convert_dtype(dtype) if dtype is not None else None
+    return jnp.sum(x, axis=_axis(axis), dtype=d, keepdims=keepdim)
+
+
+@def_op("mean")
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@def_op("max")
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@def_op("min")
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@def_op("amax")
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@def_op("amin")
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@def_op("prod")
+def prod(x, axis=None, keepdim=False, dtype=None):
+    d = dtypes.convert_dtype(dtype) if dtype is not None else None
+    return jnp.prod(x, axis=_axis(axis), dtype=d, keepdims=keepdim)
+
+
+@def_op("logsumexp")
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@def_op("all")
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@def_op("any")
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@def_op("cumsum")
+def cumsum(x, axis=None):
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=int(axis))
+
+
+@def_op("cumprod")
+def cumprod(x, dim=None):
+    if dim is None:
+        return jnp.cumprod(x.reshape(-1))
+    return jnp.cumprod(x, axis=int(dim))
+
+
+@def_op("cummax")
+def cummax(x, axis=-1):
+    vals = lax.associative_scan(jnp.maximum, x, axis=axis)
+    return vals
+
+
+@def_op("cummin")
+def cummin(x, axis=-1):
+    return lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+@def_op("diff")
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@def_op("isnan")
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@def_op("isinf")
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@def_op("isfinite")
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@def_op("count_nonzero")
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@def_op("nansum")
+def nansum(x, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@def_op("nanmean")
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def increment(x, value=1.0):
+    return call_op("increment", lambda a: a + value, (x,), {})

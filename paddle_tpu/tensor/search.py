@@ -1,0 +1,174 @@
+"""Search / sort / stat ops.
+
+Capability parity: python/paddle/tensor/search.py + stat.py in the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import def_op
+from ..framework import dtype as dtypes
+
+
+@def_op("argmax")
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtypes.convert_dtype(dtype))
+
+
+@def_op("argmin")
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtypes.convert_dtype(dtype))
+
+
+@def_op("argsort")
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+@def_op("sort")
+def sort(x, axis=-1, descending=False, stable=True):
+    out = jnp.sort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+@def_op("topk")
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if axis not in (-1, x.ndim - 1):
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    if largest:
+        vals, idx = jax.lax.top_k(xm, k)
+    else:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    if axis not in (-1, x.ndim - 1):
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@def_op("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False):
+    s = jnp.sort(x, axis=axis)
+    i = jnp.argsort(x, axis=axis)
+    vals = jnp.take(s, k - 1, axis=axis)
+    idx = jnp.take(i, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@def_op("mode")
+def mode(x, axis=-1, keepdim=False):
+    sorted_x = jnp.sort(x, axis=axis)
+
+    def mode_1d(v):
+        uniq, counts = jnp.unique(v, return_counts=True, size=v.shape[0])
+        val = uniq[jnp.argmax(counts)]
+        idx = jnp.max(jnp.where(v == val, jnp.arange(v.shape[0]), -1))
+        return val, idx
+    flat = jnp.moveaxis(x, axis, -1)
+    shp = flat.shape
+    flat2 = flat.reshape(-1, shp[-1])
+    vals, idxs = jax.vmap(mode_1d)(flat2)
+    vals = vals.reshape(shp[:-1])
+    idxs = idxs.reshape(shp[:-1])
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return vals, idxs.astype(jnp.int64)
+
+
+@def_op("nonzero")
+def _nonzero_stack(x):
+    return jnp.stack(jnp.nonzero(x), axis=-1).astype(jnp.int64)
+
+
+def nonzero(x, as_tuple=False):
+    if as_tuple:
+        out = _nonzero_stack(x)
+        from .manipulation import unbind
+        return tuple(unbind(out, axis=1))
+    return _nonzero_stack(x)
+
+
+@def_op("searchsorted")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@def_op("bucketize")
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@def_op("index_sample")
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+# ---------------------------------------------------------------------- stat
+@def_op("std")
+def std(x, axis=None, unbiased=True, keepdim=False):
+    ax = axis if axis is None or isinstance(axis, int) else tuple(axis)
+    return jnp.std(x, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@def_op("var")
+def var(x, axis=None, unbiased=True, keepdim=False):
+    ax = axis if axis is None or isinstance(axis, int) else tuple(axis)
+    return jnp.var(x, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@def_op("median")
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim if axis is not None else False)
+
+
+@def_op("nanmedian")
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim if axis is not None else False)
+
+
+@def_op("quantile")
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis,
+                        keepdims=keepdim if axis is not None else False,
+                        method=interpolation)
+
+
+@def_op("nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis,
+                           keepdims=keepdim if axis is not None else False,
+                           method=interpolation)
+
+
+@def_op("histogram")
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=rng, weights=weight,
+                            density=density)
+    return hist if density else hist.astype(jnp.int64)
+
+
+@def_op("histogramdd")
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    return jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                           weights=weights)
+
+
+@def_op("bincount")
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)
