@@ -11,12 +11,10 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
-import jax as _jax
-
-# Paddle parity: int64/float64 tensors exist (reference defaults to int64
-# indices); x64 must be enabled before first backend use.  Perf-critical
-# model code in this repo uses int32/bfloat16 explicitly (TPU-friendly).
-_jax.config.update("jax_enable_x64", True)
+# TPU-native dtype policy: 64-bit types are canonicalized to 32-bit
+# (framework/dtype.py) — int64 is emulated (slow) on TPU and x64 mode breaks
+# Pallas lowering on this backend.  The reference defaults to int64 indices;
+# user code keeps working, tensors just report int32.
 
 from .framework.tensor import Tensor, Parameter, to_tensor
 from .framework import dtype as _dtype_mod

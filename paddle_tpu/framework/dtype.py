@@ -47,6 +47,12 @@ _FLOATING = {bfloat16, float16, float32, float64, float8_e4m3fn, float8_e5m2}
 _INTEGER = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
 _COMPLEX = {complex64, complex128}
 
+import jax as _jax
+_CANON_64 = {}
+if not _jax.config.read("jax_enable_x64"):
+    _CANON_64.update({float64: float32, int64: int32, uint64: uint32,
+                      complex128: complex64})
+
 _default_dtype = float32
 
 
@@ -64,7 +70,23 @@ def get_default_dtype():
 
 
 def convert_dtype(d):
-    """Normalize a user dtype spec (str / np.dtype / python type) to np.dtype."""
+    """Normalize a user dtype spec (str / np.dtype / python type) to np.dtype.
+
+    TPU-native policy: 64-bit dtypes canonicalize to their 32-bit
+    counterparts (int64 is emulated on TPU; x64 also breaks Pallas).  This
+    deviates from the reference's int64 default deliberately.
+    """
+    if d is None:
+        return None
+    d = _canonicalize(_convert_raw(d))
+    return d
+
+
+def _canonicalize(d):
+    return _CANON_64.get(d, d)
+
+
+def _convert_raw(d):
     if d is None:
         return None
     if isinstance(d, str):

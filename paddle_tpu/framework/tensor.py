@@ -248,6 +248,18 @@ class Tensor:
             return format(self.item(), spec)
         return repr(self)
 
+    def __deepcopy__(self, memo):
+        t = type(self).__new__(type(self))
+        t._init_from_array(self._data, stop_gradient=self.stop_gradient,
+                           name=self.name)
+        if isinstance(self, Parameter):
+            t.trainable = self.trainable
+            t.optimize_attr = dict(self.optimize_attr)
+            t.regularizer = self.regularizer
+            t.need_clip = self.need_clip
+        memo[id(self)] = t
+        return t
+
     # numpy interop (one-way: exporting a Tensor detaches it from the tape)
     def __array__(self, dtype=None):
         a = np.asarray(self._data)
@@ -290,7 +302,7 @@ def _coerce_array(data, dtype):
         if d is None and data.dtype == np.float64:
             d = dtypes.get_default_dtype()
         if d is None and data.dtype == np.int64:
-            d = dtypes.int64
+            d = dtypes.convert_dtype("int64")
         arr = jnp.asarray(data, d)
         d = None
     elif isinstance(data, (bool, int, float, complex)):
@@ -298,7 +310,7 @@ def _coerce_array(data, dtype):
             if isinstance(data, bool):
                 d = dtypes.bool_
             elif isinstance(data, int):
-                d = dtypes.int64
+                d = dtypes.convert_dtype("int64")
             elif isinstance(data, float):
                 d = dtypes.get_default_dtype()
             else:

@@ -1,1 +1,48 @@
-"""(being built — see package modules)"""
+"""paddle_tpu.nn — layers, functional, initializers, clip.
+
+Capability parity: python/paddle/nn/ (~150 layers in the reference; the
+high-traffic surface is implemented, organized the same way).
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+
+from .layer.layers import (  # noqa: F401
+    Layer, ParamAttr, Sequential, LayerList, LayerDict, ParameterList,
+    Identity,
+)
+from .layer.common import (  # noqa: F401
+    Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
+    Unflatten, Pad1D, Pad2D, Pad3D, ZeroPad2D, Upsample,
+    UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle, PixelUnshuffle,
+    Bilinear, CosineSimilarity, Unfold,
+)
+from .layer.conv_pool import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv2DTranspose, MaxPool1D, MaxPool2D, AvgPool1D,
+    AvgPool2D, AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+)
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D,
+    InstanceNorm3D, LocalResponseNorm,
+)
+from .layer.activation import (  # noqa: F401
+    CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
+    LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
+    SELU, Sigmoid, Silu, Softmax, Softplus, Softshrink, Softsign, Swish,
+    Tanh, Tanhshrink, ThresholdedReLU,
+)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, HuberLoss, MarginRankingLoss,
+    HingeEmbeddingLoss, CosineEmbeddingLoss, TripletMarginLoss,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layer.rnn import (  # noqa: F401
+    SimpleRNN, LSTM, GRU, LSTMCell, GRUCell, SimpleRNNCell, RNNBase,
+)
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)

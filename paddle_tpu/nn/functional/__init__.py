@@ -1,0 +1,1051 @@
+"""nn functional ops.
+
+Capability parity: python/paddle/nn/functional/ in the reference (activation,
+conv, pooling, norm, loss, attention; flash_attention.py:364).
+
+TPU-native: convs/matmuls go straight to lax (MXU); flash attention has a
+Pallas kernel (paddle_tpu/ops/pallas/flash_attention.py) with an XLA fallback;
+dropout draws from the stateful Generator facade.
+"""
+from __future__ import annotations
+
+import builtins
+import math as pymath
+from typing import Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.dispatch import def_op, call_op
+from ...framework.tensor import Tensor
+from ...framework import dtype as dtypes
+from ...framework import random as _random
+
+# ------------------------------------------------------------- activations
+_ACT = {
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "mish": jax.nn.mish,
+    "softsign": jax.nn.soft_sign,
+    "tanhshrink": lambda x: x - jnp.tanh(x),
+    "hardswish": jax.nn.hard_swish,
+    "hardsigmoid": lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0),
+}
+_g = globals()
+for _name, _fn in _ACT.items():
+    _g[_name] = def_op(_name)(_fn)
+
+
+@def_op("gelu")
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@def_op("leaky_relu")
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope=negative_slope)
+
+
+@def_op("elu")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+@def_op("celu")
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha=alpha)
+
+
+@def_op("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@def_op("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@def_op("hardshrink")
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@def_op("softshrink")
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@def_op("softplus")
+def softplus(x, beta=1.0, threshold=20.0):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+@def_op("prelu")
+def prelu(x, weight, data_format="NCHW"):
+    w = weight
+    if w.size > 1:
+        shape = [1] * x.ndim
+        ch_dim = 1 if data_format.startswith("NC") else x.ndim - 1
+        shape[ch_dim] = w.size
+        w = w.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+@def_op("softmax_")
+def _softmax(x, axis):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtypes.convert_dtype(dtype))
+    return _softmax(x, int(axis))
+
+
+@def_op("log_softmax_")
+def _log_softmax(x, axis):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtypes.convert_dtype(dtype))
+    return _log_softmax(x, int(axis))
+
+
+@def_op("gumbel_softmax")
+def _gumbel_softmax(x, key, temperature, hard):
+    g = jax.random.gumbel(key, x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=-1)
+    if hard:
+        idx = jnp.argmax(y, axis=-1, keepdims=True)
+        y_hard = jnp.zeros_like(y).at[
+            tuple(jnp.meshgrid(*[jnp.arange(s) for s in y.shape[:-1]],
+                               indexing="ij")) + (idx[..., 0],)].set(1.0)
+        y = y_hard + y - lax.stop_gradient(y)
+    return y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    return _gumbel_softmax(x, _random.split_key(), temperature, hard)
+
+
+@def_op("glu")
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@def_op("maxout")
+def maxout(x, groups, axis=1):
+    shape = list(x.shape)
+    shape[axis] = shape[axis] // groups
+    shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+@def_op("normalize")
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True),
+                    1.0 / p)
+    return x / jnp.maximum(nrm, epsilon)
+
+
+@def_op("label_smooth")
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+# ---------------------------------------------------------------- dropout
+@def_op("dropout_")
+def _dropout(x, key, p, training, mode, axis):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    if axis is not None:
+        shape = [1] * x.ndim
+        for a in (axis if isinstance(axis, (list, tuple)) else [axis]):
+            shape[a] = x.shape[a]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+    else:
+        keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if isinstance(p, Tensor):
+        p = float(p.item())
+    return _dropout(x, _random.split_key(), p, training, mode, axis)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x * 1.0
+    alpha = -1.7580993408473766
+
+    def _fn(x, key):
+        keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+        a = ((1 - p) * (1 + p * alpha ** 2)) ** -0.5
+        b = -a * alpha * p
+        return (a * jnp.where(keep, x, alpha) + b).astype(x.dtype)
+    return call_op("alpha_dropout", _fn, (x, _random.split_key()), {})
+
+
+# ------------------------------------------------------------------ linear
+@def_op("linear")
+def linear(x, weight, bias=None):
+    # paddle weight layout: [in, out] (reference: nn/functional/common.py linear)
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@def_op("embedding_")
+def _embedding(weight, x, padding_idx):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return _embedding(weight, x, padding_idx)
+
+
+@def_op("one_hot_f")
+def _onehot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+def one_hot(x, num_classes, name=None):
+    return _onehot(x, int(num_classes))
+
+
+@def_op("bilinear")
+def bilinear(x1, x2, weight, bias=None):
+    # weight: [out, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@def_op("cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+# ------------------------------------------------------------------- convs
+def _conv_dn(ndim, channel_last):
+    if ndim == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if ndim == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _conv_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding),) * 2] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _conv_impl(x, weight, bias, stride, padding, dilation, groups, ndim,
+               channel_last):
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    _conv_dn(ndim, channel_last))
+    out = lax.conv_general_dilated(
+        x, weight,
+        window_strides=_norm_tuple(stride, ndim),
+        padding=_conv_padding(padding, ndim),
+        rhs_dilation=_norm_tuple(dilation, ndim),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None)
+    if bias is not None:
+        shape = [1] * out.ndim
+        shape[out.ndim - 1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@def_op("conv1d")
+def _conv1d(x, weight, bias, stride, padding, dilation, groups, channel_last):
+    return _conv_impl(x, weight, bias, stride, padding, dilation, groups, 1,
+                      channel_last)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv1d(x, weight, bias, stride, padding, dilation, groups,
+                   data_format in ("NLC",))
+
+
+@def_op("conv2d")
+def _conv2d(x, weight, bias, stride, padding, dilation, groups, channel_last):
+    return _conv_impl(x, weight, bias, stride, padding, dilation, groups, 2,
+                      channel_last)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """reference: paddle.nn.functional.conv2d; weight layout [out, in/g, kh, kw]."""
+    return _conv2d(x, weight, bias, stride, padding, dilation, groups,
+                   data_format == "NHWC")
+
+
+@def_op("conv3d")
+def _conv3d(x, weight, bias, stride, padding, dilation, groups, channel_last):
+    return _conv_impl(x, weight, bias, stride, padding, dilation, groups, 3,
+                      channel_last)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv3d(x, weight, bias, stride, padding, dilation, groups,
+                   data_format == "NDHWC")
+
+
+@def_op("conv2d_transpose")
+def _conv2d_transpose(x, weight, bias, stride, padding, output_padding,
+                      dilation, groups, channel_last):
+    # paddle weight layout for transpose: [in, out/g, kh, kw]
+    ndim = 2
+    strides = _norm_tuple(stride, ndim)
+    pads = _conv_padding(padding, ndim)
+    if isinstance(pads, str):
+        pads = [(0, 0)] * ndim if pads == "VALID" else None
+    kh, kw = weight.shape[2], weight.shape[3]
+    dil = _norm_tuple(dilation, ndim)
+    opad = _norm_tuple(output_padding, ndim)
+    # Use lax.conv_transpose with IOHW spec.
+    dn = ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "IOHW", "NCHW")
+    if groups > 1:
+        # grouped transpose: split channels
+        xs = jnp.split(x, groups, axis=-1 if channel_last else 1)
+        ws = jnp.split(weight, groups, axis=0)
+        outs = [lax.conv_transpose(xi, wi, strides=strides,
+                                   padding=pads if pads is not None else "SAME",
+                                   rhs_dilation=dil, dimension_numbers=dn,
+                                   transpose_kernel=True)
+                for xi, wi in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+    else:
+        if pads is None:
+            out = lax.conv_transpose(x, weight, strides=strides, padding="SAME",
+                                     rhs_dilation=dil, dimension_numbers=dn,
+                                     transpose_kernel=True)
+        else:
+            # effective padding for transpose: k-1-p
+            eff = [(dil[i] * ((kh, kw)[i] - 1) - pads[i][0] ,
+                    dil[i] * ((kh, kw)[i] - 1) - pads[i][1] + opad[i])
+                   for i in range(ndim)]
+            out = lax.conv_general_dilated(
+                x, jnp.flip(weight, (2, 3)).swapaxes(0, 1),
+                window_strides=(1, 1), padding=eff,
+                lhs_dilation=strides, rhs_dilation=dil,
+                dimension_numbers=lax.conv_dimension_numbers(
+                    x.shape, weight.shape[1::-1] + weight.shape[2:],
+                    ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")))
+    if bias is not None:
+        shape = [1] * out.ndim
+        shape[out.ndim - 1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCHW", output_size=None, name=None):
+    return _conv2d_transpose(x, weight, bias, stride, padding, output_padding,
+                             dilation, groups, data_format == "NHWC")
+
+
+# ----------------------------------------------------------------- pooling
+def _pool(x, ksize, stride, padding, reducer, init, ndim, channel_last,
+          ceil_mode=False, count_include_pad=True, is_avg=False):
+    ks = _norm_tuple(ksize, ndim)
+    st = _norm_tuple(stride if stride is not None else ksize, ndim)
+    if channel_last:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        spatial = list(range(1, 1 + ndim))
+    else:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        spatial = list(range(2, 2 + ndim))
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _conv_padding(padding, ndim)
+        full = [(0, 0)] * x.ndim
+        for i, d in enumerate(spatial):
+            full[d] = p[i]
+        pad = full
+    if is_avg:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+        if count_include_pad or pad == "VALID":
+            denom = np.prod(ks)
+            return summed / denom
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad)
+        return summed / counts
+    return lax.reduce_window(x, init, reducer, window, strides, pad)
+
+
+@def_op("max_pool2d")
+def _max_pool2d(x, ksize, stride, padding, channel_last, ceil_mode):
+    return _pool(x, ksize, stride, padding, lax.max, -jnp.inf, 2, channel_last,
+                 ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _max_pool2d(x, kernel_size, stride, padding, data_format == "NHWC",
+                       ceil_mode)
+
+
+@def_op("avg_pool2d")
+def _avg_pool2d(x, ksize, stride, padding, channel_last, ceil_mode, cip):
+    return _pool(x, ksize, stride, padding, None, None, 2, channel_last,
+                 ceil_mode, cip, is_avg=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _avg_pool2d(x, kernel_size, stride, padding, data_format == "NHWC",
+                       ceil_mode, not exclusive)
+
+
+@def_op("max_pool1d")
+def _max_pool1d(x, ksize, stride, padding, channel_last, ceil_mode):
+    return _pool(x, ksize, stride, padding, lax.max, -jnp.inf, 1, channel_last,
+                 ceil_mode)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _max_pool1d(x, kernel_size, stride, padding, False, ceil_mode)
+
+
+@def_op("avg_pool1d")
+def _avg_pool1d(x, ksize, stride, padding, channel_last, ceil_mode, cip):
+    return _pool(x, ksize, stride, padding, None, None, 1, channel_last,
+                 ceil_mode, cip, is_avg=True)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _avg_pool1d(x, kernel_size, stride, padding, False, ceil_mode,
+                       not exclusive)
+
+
+@def_op("adaptive_avg_pool2d_")
+def _adaptive_avg_pool2d(x, out_hw, channel_last):
+    if channel_last:
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    # split into oh x ow regions (paddle adaptive pooling semantics)
+    def pool_axis(arr, axis, out_size):
+        in_size = arr.shape[axis]
+        if in_size % out_size == 0:
+            k = in_size // out_size
+            shape = list(arr.shape)
+            shape[axis] = out_size
+            shape.insert(axis + 1, k)
+            return jnp.mean(arr.reshape(shape), axis=axis + 1)
+        # general: average via interval sums
+        starts = (np.arange(out_size) * in_size) // out_size
+        ends = ((np.arange(out_size) + 1) * in_size + out_size - 1) // out_size
+        segs = [jnp.mean(lax.slice_in_dim(arr, int(s), int(e), axis=axis),
+                         axis=axis, keepdims=True) for s, e in zip(starts, ends)]
+        return jnp.concatenate(segs, axis=axis)
+    out = pool_axis(x, 2, oh)
+    out = pool_axis(out, 3, ow)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    hw = _norm_tuple(output_size, 2)
+    return _adaptive_avg_pool2d(x, hw, data_format == "NHWC")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    out = _adaptive_avg_pool2d(x[..., None], (_norm_tuple(output_size, 1)[0], 1),
+                               False)
+    return out[..., 0]
+
+
+@def_op("adaptive_max_pool2d_")
+def _adaptive_max_pool2d(x, out_hw):
+    def pool_axis(arr, axis, out_size):
+        in_size = arr.shape[axis]
+        starts = (np.arange(out_size) * in_size) // out_size
+        ends = ((np.arange(out_size) + 1) * in_size + out_size - 1) // out_size
+        segs = [jnp.max(lax.slice_in_dim(arr, int(s), int(e), axis=axis),
+                        axis=axis, keepdims=True) for s, e in zip(starts, ends)]
+        return jnp.concatenate(segs, axis=axis)
+    out = pool_axis(x, 2, out_hw[0])
+    return pool_axis(out, 3, out_hw[1])
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool2d(x, _norm_tuple(output_size, 2))
+
+
+# ------------------------------------------------------------------- norms
+@def_op("batch_norm_f")
+def _batch_norm(x, mean, variance, weight, bias, epsilon, channel_last):
+    shape = [1] * x.ndim
+    shape[x.ndim - 1 if channel_last else 1] = x.shape[x.ndim - 1 if channel_last else 1]
+    inv = lax.rsqrt(variance.reshape(shape) + epsilon)
+    out = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """reference: nn/functional/norm.py batch_norm.
+
+    In training mode, batch statistics are used and running stats are updated
+    in-place on the provided tensors (eager semantics).
+    """
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ch_dim = x.ndim - 1 if channel_last else 1
+    if training and not use_global_stats:
+        axes = tuple(i for i in range(x.ndim) if i != ch_dim)
+        from ... import tensor as T
+        batch_mean = T.mean(x, axis=list(axes))
+        batch_var = T.var(x, axis=list(axes), unbiased=False)
+        out = _batch_norm(x, batch_mean, batch_var, weight, bias, epsilon,
+                          channel_last)
+        if running_mean is not None:
+            n = np.prod([x.shape[i] for i in axes])
+            unbiased = batch_var.detach() * (n / builtins.max(n - 1, 1))
+            if not isinstance(batch_mean._data, jax.core.Tracer):
+                running_mean._data = (momentum * running_mean._data
+                                      + (1 - momentum) * batch_mean.detach()._data)
+                running_var._data = (momentum * running_var._data
+                                     + (1 - momentum) * unbiased._data)
+        return out
+    return _batch_norm(x, running_mean, running_var, weight, bias, epsilon,
+                       channel_last)
+
+
+@def_op("layer_norm_f")
+def _layer_norm(x, weight, bias, epsilon, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, (int, np.integer)):
+        normalized_shape = [int(normalized_shape)]
+    begin = x.ndim - len(normalized_shape)
+    return _layer_norm(x, weight, bias, epsilon, begin)
+
+
+@def_op("rms_norm_f")
+def _rms_norm(x, weight, epsilon):
+    # Fused rmsnorm: XLA fuses this fine; a Pallas variant exists for the
+    # long-seq path (ops/pallas/rmsnorm.py).
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    out = x.astype(jnp.float32) * lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    return out * weight if weight is not None else out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    return _rms_norm(x, weight, epsilon)
+
+
+@def_op("group_norm_f")
+def _group_norm(x, weight, bias, groups, epsilon, channel_last):
+    if channel_last:
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    g = groups
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=axes, keepdims=True)
+    out = ((xg - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = [1] * x.ndim
+    shape[1] = c
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    return _group_norm(x, weight, bias, num_groups, epsilon,
+                       data_format == "NHWC")
+
+
+@def_op("instance_norm_f")
+def _instance_norm(x, weight, bias, epsilon):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    return _instance_norm(x, weight, bias, eps)
+
+
+@def_op("local_response_norm")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[1]
+    pads = [(0, 0)] * x.ndim
+    pads[1] = (half, size - half - 1)
+    padded = jnp.pad(sq, pads)
+    window = [1] * x.ndim
+    window[1] = size
+    summed = lax.reduce_window(padded, 0.0, lax.add, tuple(window),
+                               (1,) * x.ndim, "VALID")
+    return x / jnp.power(k + alpha * summed, beta)
+
+
+# ------------------------------------------------------------------ losses
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@def_op("cross_entropy_f")
+def _cross_entropy(logits, label, weight, ignore_index, reduction, soft_label,
+                   axis, label_smoothing):
+    if soft_label:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+        if label_smoothing > 0:
+            k = logits.shape[axis]
+            label = (1 - label_smoothing) * label + label_smoothing / k
+        loss = -jnp.sum(label * logp, axis=axis)
+        return _reduce(loss, reduction)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    lbl = label
+    if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+        lbl = jnp.squeeze(lbl, axis=axis)
+    valid = (lbl != ignore_index)
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(safe, axis).astype(jnp.int32), axis=axis)
+    loss = -jnp.squeeze(picked, axis=axis)
+    if label_smoothing > 0:
+        k = logits.shape[axis]
+        smooth = -jnp.mean(logp, axis=axis)
+        loss = (1 - label_smoothing) * loss + label_smoothing * smooth
+    if weight is not None:
+        w = jnp.take(weight, safe)
+        loss = loss * w
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0) \
+            if weight is None else jnp.sum(jnp.where(valid, jnp.take(weight, safe), 0.0))
+        return jnp.sum(loss) / denom
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """reference: python/paddle/nn/functional/loss.py cross_entropy."""
+    if not use_softmax:
+        return nll_loss(call_op("log", lambda x: jnp.log(x), (input,), {}),
+                        label, weight, ignore_index, reduction)
+    return _cross_entropy(input, label, weight, ignore_index, reduction,
+                          soft_label, axis, label_smoothing)
+
+
+@def_op("nll_loss_f")
+def _nll_loss(logp, label, weight, ignore_index, reduction):
+    valid = (label != ignore_index)
+    safe = jnp.where(valid, label, 0)
+    picked = jnp.take_along_axis(logp, safe[:, None].astype(jnp.int32), axis=1)
+    loss = -picked[:, 0]
+    if weight is not None:
+        loss = loss * jnp.take(weight, safe)
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        denom = jnp.sum(valid.astype(loss.dtype)) if weight is None else \
+            jnp.sum(jnp.where(valid, jnp.take(weight, safe), 0.0))
+        return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return _nll_loss(input, label, weight, ignore_index, reduction)
+
+
+@def_op("mse_loss_f")
+def _mse(input, label, reduction):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _mse(input, label, reduction)
+
+
+@def_op("l1_loss_f")
+def _l1(input, label, reduction):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _l1(input, label, reduction)
+
+
+@def_op("smooth_l1_f")
+def _smooth_l1(input, label, reduction, delta):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta,
+                     diff - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _smooth_l1(input, label, reduction, delta)
+
+
+@def_op("huber_loss")
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    diff = jnp.abs(input - label)
+    return _reduce(jnp.where(diff <= delta, 0.5 * diff * diff,
+                             delta * (diff - 0.5 * delta)), reduction)
+
+
+@def_op("bce_f")
+def _bce(input, label, weight, reduction):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps))
+             + (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    return _bce(input, label, weight, reduction)
+
+
+@def_op("bce_logits_f")
+def _bce_logits(logit, label, weight, pos_weight, reduction):
+    max_val = jnp.maximum(-logit, 0.0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + max_val + jnp.log1p(
+            jnp.exp(-jnp.abs(logit)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    return _bce_logits(logit, label, weight, pos_weight, reduction)
+
+
+@def_op("kl_div_f")
+def _kl_div(input, label, reduction, log_target):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        loss = jnp.where(label > 0, label * (jnp.log(jnp.maximum(label, 1e-12))
+                                             - input), 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    return _kl_div(input, label, reduction, log_target)
+
+
+@def_op("margin_ranking_f")
+def _margin_ranking(x1, x2, label, margin, reduction):
+    return _reduce(jnp.maximum(0.0, -label * (x1 - x2) + margin), reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return _margin_ranking(input, other, label, margin, reduction)
+
+
+@def_op("hinge_embedding_f")
+def _hinge_embedding(input, label, margin, reduction):
+    loss = jnp.where(label == 1.0, input,
+                     jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return _hinge_embedding(input, label, margin, reduction)
+
+
+@def_op("cosine_embedding_f")
+def _cosine_embedding(x1, x2, label, margin, reduction):
+    cos = jnp.sum(x1 * x2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    return _cosine_embedding(input1, input2, label, margin, reduction)
+
+
+@def_op("triplet_margin_f")
+def _triplet(anchor, positive, negative, margin, p, eps, swap, reduction):
+    dp = jnp.power(jnp.sum(jnp.power(jnp.abs(anchor - positive) + eps, p), -1),
+                   1.0 / p)
+    dn = jnp.power(jnp.sum(jnp.power(jnp.abs(anchor - negative) + eps, p), -1),
+                   1.0 / p)
+    if swap:
+        dpn = jnp.power(jnp.sum(jnp.power(jnp.abs(positive - negative) + eps, p),
+                                -1), 1.0 / p)
+        dn = jnp.minimum(dn, dpn)
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    return _triplet(input, positive, negative, margin, p, epsilon, swap,
+                    reduction)
+
+
+@def_op("square_error_cost")
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@def_op("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    sm = jax.nn.softmax(logits, axis=axis)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(lbl, axis).astype(jnp.int32), axis=axis)
+        loss = -picked
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+# ----------------------------------------------------------- miscellaneous
+@def_op("interpolate_")
+def _interpolate(x, out_hw, mode, align_corners, channel_last):
+    if channel_last:
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    if mode == "nearest":
+        ridx = (jnp.arange(oh) * h // oh).astype(jnp.int32)
+        cidx = (jnp.arange(ow) * w // ow).astype(jnp.int32)
+        out = x[:, :, ridx][:, :, :, cidx]
+    else:  # bilinear
+        if align_corners and oh > 1 and ow > 1:
+            ys = jnp.linspace(0, h - 1, oh)
+            xs = jnp.linspace(0, w - 1, ow)
+        else:
+            ys = (jnp.arange(oh) + 0.5) * h / oh - 0.5
+            xs = (jnp.arange(ow) + 0.5) * w / ow - 0.5
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(ys - y0, 0, 1)[None, None, :, None]
+        wx = jnp.clip(xs - x0, 0, 1)[None, None, None, :]
+        v00 = x[:, :, y0][:, :, :, x0]
+        v01 = x[:, :, y0][:, :, :, x1]
+        v10 = x[:, :, y1][:, :, :, x0]
+        v11 = x[:, :, y1][:, :, :, x1]
+        out = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+               + v10 * wy * (1 - wx) + v11 * wy * wx).astype(x.dtype)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    channel_last = data_format == "NHWC"
+    h_dim = 1 if channel_last else 2
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor, scale_factor]
+        size = [int(x.shape[h_dim] * sf[0]), int(x.shape[h_dim + 1] * sf[1])]
+    size = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in size]
+    return _interpolate(x, tuple(size), mode, align_corners, channel_last)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+@def_op("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        out = x.reshape(n, c // (r * r), r, r, h, w)
+        out = out.transpose(0, 1, 4, 2, 5, 3)
+        return out.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    out = x.reshape(n, h, w, r, r, c // (r * r))
+    out = out.transpose(0, 1, 3, 2, 4, 5)
+    return out.reshape(n, h * r, w * r, c // (r * r))
+
+
+@def_op("pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // r, r, w // r, r)
+    out = out.transpose(0, 1, 3, 5, 2, 4)
+    return out.reshape(n, c * r * r, h // r, w // r)
+
+
+@def_op("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    ks = _norm_tuple(kernel_sizes, 2)
+    st = _norm_tuple(strides, 2)
+    pd = _norm_tuple(paddings, 2)
+    dl = _norm_tuple(dilations, 2)
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+    oh = (h + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+    ow = (w + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+    cols = []
+    for i in range(ks[0]):
+        for j in range(ks[1]):
+            patch = xp[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                       j * dl[1]: j * dl[1] + ow * st[1]: st[1]]
+            cols.append(patch.reshape(n, c, -1))
+    return jnp.stack(cols, axis=2).reshape(n, c * ks[0] * ks[1], -1)
+
+
+from .attention import (  # noqa: E402,F401
+    scaled_dot_product_attention, flash_attention,
+)
+from ...tensor.manipulation import pad  # noqa: E402,F401
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    def _fn(x):
+        nt, c, h, w = x.shape
+        n = nt // seg_num
+        xr = x.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([xr[:, 1:, :fold], jnp.zeros_like(xr[:, :1, :fold])], 1)
+        right = jnp.concatenate([jnp.zeros_like(xr[:, :1, fold:2 * fold]),
+                                 xr[:, :-1, fold:2 * fold]], 1)
+        rest = xr[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+    return call_op("temporal_shift", _fn, (x,), {})
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    def _fn(lengths):
+        ml = maxlen if maxlen is not None else int(jnp.max(lengths))
+        return (jnp.arange(ml)[None, :] < lengths[:, None]).astype(
+            dtypes.convert_dtype(dtype))
+    return call_op("sequence_mask", _fn, (lengths,), {})

@@ -1,0 +1,55 @@
+"""Attention functional API.
+
+Capability parity: python/paddle/nn/functional/flash_attention.py:364
+(flash_attention, scaled_dot_product_attention) in the reference.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ...framework.dispatch import def_op
+from ...ops.pallas.flash_attention import (
+    flash_attention_bshd, flash_attention_bhsd, mha_reference,
+)
+
+
+@def_op("flash_attention")
+def _flash(q, k, v, causal):
+    return flash_attention_bshd(q, k, v, causal=causal)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """reference API: paddle.nn.functional.flash_attention.flash_attention.
+
+    Layout (batch, seq, num_heads, head_dim).  Dropout inside attention is
+    not fused (XLA/Pallas path); apply dropout on the output if needed.
+    """
+    out = _flash(query, key, value, causal)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+@def_op("sdpa")
+def _sdpa(q, k, v, attn_mask, causal, dropout_p):
+    # (b, s, h, d) -> (b, h, s, d)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    if attn_mask is None:
+        out = flash_attention_bhsd(qt, kt, vt, causal)
+    else:
+        out = mha_reference(qt, kt, vt, causal=causal, bias=attn_mask)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """reference: paddle.nn.functional.scaled_dot_product_attention
+    (flash_attention.py).  Layout (batch, seq, heads, head_dim)."""
+    return _sdpa(query, key, value, attn_mask, is_causal, dropout_p)

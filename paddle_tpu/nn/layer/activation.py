@@ -1,0 +1,105 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .layers import Layer
+from .. import functional as F
+from ..initializer import Constant
+
+
+def _simple(name, fn_name=None, **defaults):
+    fn = getattr(F, fn_name or name.lower())
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            merged = dict(defaults)
+            keys = list(defaults)
+            for i, a in enumerate(args):
+                merged[keys[i]] = a
+            for k, v in kwargs.items():
+                if k in merged:
+                    merged[k] = v
+            self._kwargs = merged
+
+        def forward(self, x):
+            return fn(x, **self._kwargs)
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+CELU = _simple("CELU", "celu", alpha=1.0)
+ELU = _simple("ELU", "elu", alpha=1.0)
+GELU = _simple("GELU", "gelu", approximate=False)
+Hardshrink = _simple("Hardshrink", "hardshrink", threshold=0.5)
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Hardswish = _simple("Hardswish", "hardswish")
+Hardtanh = _simple("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+LeakyReLU = _simple("LeakyReLU", "leaky_relu", negative_slope=0.01)
+LogSigmoid = _simple("LogSigmoid", "sigmoid")  # fixed below
+Maxout = _simple("Maxout", "maxout", groups=2, axis=1)
+Mish = _simple("Mish", "mish")
+ReLU = _simple("ReLU", "relu")
+ReLU6 = _simple("ReLU6", "relu6")
+SELU = _simple("SELU", "selu")
+Sigmoid = _simple("Sigmoid", "sigmoid")
+Silu = _simple("Silu", "silu")
+Softshrink = _simple("Softshrink", "softshrink", threshold=0.5)
+Softsign = _simple("Softsign", "softsign")
+Softplus = _simple("Softplus", "softplus", beta=1.0, threshold=20.0)
+Swish = _simple("Swish", "swish")
+Tanh = _simple("Tanh", "tanh")
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _simple("ThresholdedReLU", "relu")  # fixed below
+GLU = _simple("GLU", "glu", axis=-1)
+
+
+class LogSigmoid(Layer):  # noqa: F811
+    def forward(self, x):
+        from ... import tensor as T
+        return T.log(F.sigmoid(x))
+
+
+class ThresholdedReLU(Layer):  # noqa: F811
+    def __init__(self, threshold=1.0, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        from ...framework.dispatch import call_op
+        import jax.numpy as jnp
+        thr = self.threshold
+        return call_op("thresholded_relu",
+                       lambda a: jnp.where(a > thr, a, 0.0), (x,), {})
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self.data_format)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, self.axis)

@@ -1,0 +1,541 @@
+"""Layer base class + containers.
+
+Capability parity: python/paddle/nn/layer/layers.py (Layer, ~reference
+layer/layers.py Layer class) and containers.py (Sequential/LayerList/
+LayerDict/ParameterList).
+
+TPU-native: parameters are framework Parameters (jax.Array payloads); the
+whole Layer functionalizes cleanly for jit via state_dict <-> pytree helpers
+(used by paddle_tpu.jit.to_static and the distributed wrappers).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, Parameter
+from ...framework import dtype as dtypes
+from ...framework.tape import no_grad
+from ..initializer import Constant, XavierNormal, Normal, _to_initializer
+
+
+class ParamAttr:
+    """reference: python/paddle/base/param_attr.py ParamAttr."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        return ParamAttr(initializer=_to_initializer(attr))
+
+
+class Layer:
+    """Base building block (reference: paddle.nn.Layer)."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self.training = True
+        self._dtype = dtypes.convert_dtype(dtype) if dtype else None
+        self._parameters: Dict[str, Parameter] = collections.OrderedDict()
+        self._sub_layers: Dict[str, "Layer"] = collections.OrderedDict()
+        self._buffers: Dict[str, Tensor] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._name_scope = name_scope or type(self).__name__.lower()
+        self._init_in_dynamic_mode = True
+
+    # ------------------------------------------------------------ attr mgmt
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning params")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params.pop(name)
+                    object.__setattr__(self, name, None)
+                    return
+                if isinstance(value, Tensor):
+                    params[name].set_value(value)
+                    return
+            if layers is not None and name in layers and value is None:
+                layers.pop(name)
+                object.__setattr__(self, name, None)
+                return
+            if buffers is not None and name in buffers:
+                if value is None:
+                    buffers.pop(name)
+                    object.__setattr__(self, name, None)
+                    return
+                if isinstance(value, Tensor):
+                    buffers[name] = value
+                    return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._sub_layers) + list(self._buffers)
+
+    # ----------------------------------------------------------- factories
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None) -> Parameter:
+        """reference: Layer.create_parameter (layers.py)."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtypes.convert_dtype(dtype) if dtype else (
+            self._dtype or dtypes.get_default_dtype())
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierNormal()
+        data = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(data, trainable=attr.trainable, name=attr.name)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        t = Tensor(np.zeros([0], dtype="float32"), dtype=dtype)
+        t.name = name or ""
+        return t
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # ----------------------------------------------------------- iteration
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True,
+                         remove_duplicate=True) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or (remove_duplicate and id(p) in seen):
+                    continue
+                seen.add(id(p))
+                yield (name + ("." if name else "") + pname, p)
+
+    def buffers(self, include_sublayers=True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (name + ("." if name else "") + bname, b)
+
+    def _traverse(self, prefix="", include_sublayers=True):
+        yield (prefix, self)
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = prefix + ("." if prefix else "") + lname
+                yield from layer._traverse(sub_prefix, True)
+
+    def children(self) -> Iterator["Layer"]:
+        for _, layer in self.named_children():
+            yield layer
+
+    def named_children(self):
+        for name, layer in self._sub_layers.items():
+            if layer is not None:
+                yield name, layer
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        out = []
+        for name, layer in self._traverse("", True):
+            if name == "" and not include_self:
+                continue
+            out.append(layer)
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False):
+        for name, layer in self._traverse(prefix, True):
+            if name == prefix and not include_self:
+                continue
+            yield name, layer
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.children():
+            layer.apply(fn)
+        fn(self)
+        return self
+
+    # ----------------------------------------------------------- train/eval
+    def train(self):
+        self.training = True
+        for layer in self.sublayers():
+            layer.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for layer in self.sublayers():
+            layer.training = False
+        return self
+
+    # ----------------------------------------------------------- state dict
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip("."),
+                                             include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, layer in self._traverse(structured_name_prefix.rstrip("."),
+                                          include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                dest[name + ("." if name else "") + bname] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """reference: Layer.set_state_dict / set_dict."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name in state_dict:
+                value = state_dict[name]
+                arr = value._data if isinstance(value, Tensor) else jnp.asarray(
+                    np.asarray(value))
+                if tuple(arr.shape) != tuple(target._data.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: loaded {arr.shape} vs "
+                        f"{tuple(target._data.shape)}")
+                target._data = arr.astype(target._data.dtype)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # ---------------------------------------------------------------- hooks
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # ----------------------------------------------------------------- call
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ dtype/dev
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            d = dtypes.convert_dtype(dtype)
+            with no_grad():
+                for p in self.parameters():
+                    if dtypes.is_floating_point(p.dtype):
+                        p._data = p._data.astype(d)
+                for b in self.buffers():
+                    if dtypes.is_floating_point(b.dtype):
+                        b._data = b._data.astype(d)
+            self._dtype = d
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def clear_gradients(self, set_to_zero=False):
+        for p in self.parameters():
+            p.clear_gradient(set_to_zero)
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            child = repr(layer).split("\n")
+            child = [child[0]] + ["  " + c for c in child[1:]]
+            lines.append(f"  ({name}): " + "\n".join(child))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+
+class _HookHandle:
+    _next_id = 0
+
+    def __init__(self, store):
+        self._store = store
+        self.id = _HookHandle._next_id
+        _HookHandle._next_id += 1
+
+    def remove(self):
+        self._store.pop(self.id, None)
+
+
+class Sequential(Layer):
+    """reference: paddle.nn.Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                len(layers[0]) and isinstance(layers[0][0], tuple):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                if isinstance(layer, tuple):
+                    self.add_sublayer(layer[0], layer[1])
+                else:
+                    self.add_sublayer(str(i), layer)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        keys = list(self._sub_layers)
+        return self._sub_layers[keys[idx]]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+
+class LayerList(Layer):
+    """reference: paddle.nn.LayerList."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, layer in enumerate(sublayers):
+                self.add_sublayer(str(i), layer)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return self._sub_layers[str(self._abs_idx(idx))]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(self._abs_idx(idx))] = layer
+
+    def __delitem__(self, idx):
+        del self._sub_layers[str(self._abs_idx(idx))]
+        layers = list(self._sub_layers.values())
+        self._sub_layers.clear()
+        for i, layer in enumerate(layers):
+            self._sub_layers[str(i)] = layer
+
+    def _abs_idx(self, idx):
+        return idx if idx >= 0 else len(self) + idx
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self)), layer)
+        return self
+
+    def insert(self, index, layer):
+        layers = list(self._sub_layers.values())
+        layers.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self._sub_layers[str(i)] = l
+
+    def extend(self, layers):
+        for layer in layers:
+            self.append(layer)
+        return self
+
+
+class LayerDict(Layer):
+    """reference: paddle.nn.LayerDict."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        if isinstance(sublayers, dict):
+            sublayers = sublayers.items()
+        for key, layer in sublayers:
+            self.add_sublayer(key, layer)
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        layer = self._sub_layers.pop(key)
+        return layer
+
+
+class ParameterList(Layer):
+    """reference: paddle.nn.ParameterList."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __setitem__(self, idx, p):
+        self._parameters[str(idx)] = p
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def append(self, p):
+        self.add_parameter(str(len(self)), p)
+        return self
+
+
+class Identity(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x

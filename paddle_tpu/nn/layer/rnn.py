@@ -1,0 +1,266 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU.
+
+Capability parity: python/paddle/nn/layer/rnn.py in the reference.
+
+TPU-native: the time loop is ``lax.scan`` (compiles to a single fused XLA
+while-loop; no per-step dispatch), matmuls batched over the gate dimension.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .layers import Layer
+from ...framework.dispatch import call_op
+from ...framework.tensor import Tensor
+from ..initializer import Uniform
+from ... import tensor as T
+
+
+def _lstm_cell(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    return jnp.tanh(c2) * o, c2
+
+
+def _gru_cell(x, h, w_ih, w_hh, b_ih, b_hh):
+    gi = x @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    ir, iz, inn = jnp.split(gi, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(inn + r * hn)
+    return (1 - z) * n + z * h
+
+
+def _rnn_cell(x, h, w_ih, w_hh, b_ih, b_hh, activation):
+    out = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    return jnp.tanh(out) if activation == "tanh" else jax.nn.relu(out)
+
+
+class RNNBase(Layer):
+    """Shared multi-layer bidirectional scan driver."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        self._param_names = []
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_size = input_size if layer == 0 else hidden_size * self.bidirect
+                suffix = f"_reverse" if d == 1 else ""
+                names = [f"weight_ih_l{layer}{suffix}",
+                         f"weight_hh_l{layer}{suffix}",
+                         f"bias_ih_l{layer}{suffix}",
+                         f"bias_hh_l{layer}{suffix}"]
+                shapes = [(gate_mult * hidden_size, in_size),
+                          (gate_mult * hidden_size, hidden_size),
+                          (gate_mult * hidden_size,),
+                          (gate_mult * hidden_size,)]
+                attrs = [weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                         bias_hh_attr]
+                for n, s, a in zip(names, shapes, attrs):
+                    p = self.create_parameter(
+                        s, attr=a, default_initializer=Uniform(-std, std))
+                    self.add_parameter(n, p)
+                self._param_names.append(names)
+
+    def _cell_fn(self):
+        mode = self.mode
+        act = self.activation
+        if mode == "LSTM":
+            return lambda x, state, w: _lstm_cell(x, state[0], state[1], *w), 2
+        if mode == "GRU":
+            return lambda x, state, w: _gru_cell(x, state[0], *w), 1
+        return lambda x, state, w: _rnn_cell(x, state[0], *w, act), 1
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        params = []
+        for names in self._param_names:
+            params.extend(self._parameters[n] for n in names)
+        mode = self.mode
+        num_layers, bidirect = self.num_layers, self.bidirect
+        hidden = self.hidden_size
+        time_major = self.time_major
+        is_lstm = mode == "LSTM"
+
+        def _run(x, plist, init_h, init_c):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # (seq, batch, feat)
+            batch = x.shape[1]
+            cell, _ = self._cell_fn()
+            h_finals, c_finals = [], []
+            layer_in = x
+            idx = 0
+            for layer in range(num_layers):
+                outs = []
+                for d in range(bidirect):
+                    w = plist[idx * 4:(idx + 1) * 4]
+                    idx += 1
+                    gi = layer * bidirect + d
+                    h0 = init_h[gi]
+                    c0 = init_c[gi] if is_lstm else None
+                    seq = jnp.flip(layer_in, 0) if d == 1 else layer_in
+
+                    def step(carry, xt):
+                        if is_lstm:
+                            h, c = cell(xt, carry, w)
+                            return (h, c), h
+                        h = cell(xt, carry, w)
+                        return (h,), h
+                    carry0 = (h0, c0) if is_lstm else (h0,)
+                    carry, ys = lax.scan(step, carry0, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    outs.append(ys)
+                    h_finals.append(carry[0])
+                    if is_lstm:
+                        c_finals.append(carry[1])
+                layer_in = jnp.concatenate(outs, axis=-1) if bidirect == 2 \
+                    else outs[0]
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(h_finals)
+            if is_lstm:
+                return out, h_stack, jnp.stack(c_finals)
+            return out, h_stack
+
+        batch = inputs.shape[0] if not time_major else inputs.shape[1]
+        n_states = num_layers * bidirect
+        if initial_states is None:
+            zeros = T.zeros([n_states, batch, hidden], dtype=inputs.dtype)
+            init_h, init_c = zeros, zeros
+        elif is_lstm:
+            init_h, init_c = initial_states
+        else:
+            init_h, init_c = initial_states, None
+        if init_c is None:
+            init_c = T.zeros([n_states, batch, hidden], dtype=inputs.dtype)
+
+        res = call_op(f"rnn_{mode}", _run, (inputs, params, init_h, init_c), {})
+        if is_lstm:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation, **kwargs)
+
+
+class LSTM(RNNBase):
+    """reference: paddle.nn.LSTM."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        kwargs.pop("activation", None)
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        kwargs.pop("activation", None)
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            (4 * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            (4 * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter((4 * hidden_size,),
+                                             attr=bias_ih_attr, is_bias=True)
+        self.bias_hh = self.create_parameter((4 * hidden_size,),
+                                             attr=bias_hh_attr, is_bias=True)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = (T.zeros([inputs.shape[0], self.hidden_size]),) * 2
+        h, c = states
+        out = call_op("lstm_cell", lambda x, h, c, wi, wh, bi, bh:
+                      _lstm_cell(x, h, c, wi, wh, bi, bh),
+                      (inputs, h, c, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh), {})
+        return out[0], out
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            (3 * hidden_size, input_size),
+            default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            (3 * hidden_size, hidden_size),
+            default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter((3 * hidden_size,), is_bias=True)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), is_bias=True)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = T.zeros([inputs.shape[0], self.hidden_size])
+        out = call_op("gru_cell", lambda x, h, wi, wh, bi, bh:
+                      _gru_cell(x, h, wi, wh, bi, bh),
+                      (inputs, states, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh), {})
+        return out, out
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            (hidden_size, input_size), default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            (hidden_size, hidden_size), default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter((hidden_size,), is_bias=True)
+        self.bias_hh = self.create_parameter((hidden_size,), is_bias=True)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = T.zeros([inputs.shape[0], self.hidden_size])
+        act = self.activation
+        out = call_op("rnn_cell", lambda x, h, wi, wh, bi, bh:
+                      _rnn_cell(x, h, wi, wh, bi, bh, act),
+                      (inputs, states, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh), {})
+        return out, out

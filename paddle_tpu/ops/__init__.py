@@ -1,0 +1,1 @@
+"""Custom ops (Pallas kernels + composites)."""
