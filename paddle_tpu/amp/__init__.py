@@ -1,1 +1,249 @@
-"""(being built — see package modules)"""
+"""Automatic mixed precision.
+
+Capability parity: python/paddle/amp/ in the reference — auto_cast levels
+O0/OD/O1/O2 (auto_cast.py:58,140-145,486-487), GradScaler with dynamic loss
+scaling (grad_scaler.py:657), amp.decorate, white/black op lists
+(amp_lists.py).
+
+TPU-native: bfloat16 is the default amp dtype (MXU-native; no loss scaling
+needed — GradScaler degrades to pass-through when use_dynamic_loss_scaling is
+off, matching bf16 practice).  The cast hook plugs into the op-dispatch
+chokepoint (framework/dispatch.py), the analog of the reference's AMP logic in
+generated ad_funcs (eager_gen.py:675).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.dispatch import set_amp_cast_hook
+from ..framework.tensor import Tensor, wrap_array
+from ..framework import dtype as dtypes
+from ..framework.tape import no_grad
+
+# Default op lists (reference: python/paddle/amp/amp_lists.py
+# WHITE_LIST/BLACK_LIST — adapted to this op registry's names).
+WHITE_LIST = {
+    "matmul", "bmm", "mm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "einsum_", "addmm", "flash_attention", "sdpa",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "expm1", "pow", "square",
+    "reciprocal", "rsqrt", "softmax_", "log_softmax_", "cross_entropy_f",
+    "nll_loss_f", "bce_f", "bce_logits_f", "kl_div_f", "layer_norm_f",
+    "batch_norm_f", "group_norm_f", "instance_norm_f", "rms_norm_f",
+    "logsumexp", "cumsum", "cumprod", "norm", "vector_norm", "dist", "cov",
+    "mse_loss_f", "l1_loss_f", "smooth_l1_f", "softmax_with_cross_entropy",
+    "sum", "mean",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white = WHITE_LIST
+        self.black = BLACK_LIST
+
+
+_state = _AmpState()
+
+
+def _cast_tree(obj, dtype):
+    if isinstance(obj, Tensor) and obj.dtype == jnp.float32:
+        return obj.astype(dtype)
+    if isinstance(obj, (list, tuple)):
+        t = [_cast_tree(o, dtype) for o in obj]
+        return tuple(t) if isinstance(obj, tuple) else t
+    return obj
+
+
+def _cast_up(obj):
+    if isinstance(obj, Tensor) and obj.dtype in (jnp.bfloat16, jnp.float16):
+        return obj.astype(jnp.float32)
+    if isinstance(obj, (list, tuple)):
+        t = [_cast_up(o) for o in obj]
+        return tuple(t) if isinstance(obj, tuple) else t
+    return obj
+
+
+def _amp_hook(op_name, args, kwargs):
+    if not _state.enabled:
+        return args, kwargs
+    level = _state.level
+    if level == "O0":
+        return args, kwargs
+    if op_name in _state.black:
+        return (tuple(_cast_up(a) for a in args),
+                {k: _cast_up(v) for k, v in kwargs.items()})
+    if level in ("O1", "OD"):
+        if op_name in _state.white:
+            return (tuple(_cast_tree(a, _state.dtype) for a in args),
+                    {k: _cast_tree(v, _state.dtype) for k, v in kwargs.items()})
+        return args, kwargs
+    if level == "O2":
+        return (tuple(_cast_tree(a, _state.dtype) for a in args),
+                {k: _cast_tree(v, _state.dtype) for k, v in kwargs.items()})
+    return args, kwargs
+
+
+class auto_cast:
+    """reference: paddle.amp.auto_cast (auto_cast.py:1029)."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        if level not in ("O0", "OD", "O1", "O2"):
+            raise ValueError(f"unsupported amp level {level}")
+        self.enable = enable
+        self.level = level
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.custom_white = set(custom_white_list or ())
+        self.custom_black = set(custom_black_list or ())
+
+    def __enter__(self):
+        self._saved = (_state.enabled, _state.dtype, _state.level,
+                       _state.white, _state.black)
+        _state.enabled = self.enable
+        _state.dtype = self.dtype
+        _state.level = self.level
+        _state.white = (WHITE_LIST | self.custom_white) - self.custom_black
+        _state.black = (BLACK_LIST | self.custom_black) - self.custom_white
+        set_amp_cast_hook(_amp_hook if self.enable else None)
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level, _state.white,
+         _state.black) = self._saved
+        set_amp_cast_hook(_amp_hook if _state.enabled else None)
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """reference: paddle.amp.decorate — casts model params for pure-low-
+    precision training; optimizer gets fp32 master weights (multi_precision).
+    """
+    d = dtypes.convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=d)
+    if optimizers is None:
+        return models if single_model else model_list
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    if level == "O2" and master_weight is not False:
+        for opt in opt_list:
+            opt._multi_precision = True
+    return (models if single_model else model_list,
+            optimizers if single_opt else opt_list)
+
+
+class GradScaler:
+    """reference: paddle.amp.GradScaler (grad_scaler.py:657) — dynamic loss
+    scaling.  With bf16 (TPU default) scaling is unnecessary; construct with
+    enable=False for pass-through."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        with no_grad():
+            for p in optimizer._parameter_list:
+                if p.grad is not None:
+                    g = p.grad._data * inv
+                    if bool(jnp.any(~jnp.isfinite(g))):
+                        found = True
+                    p.grad._data = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_count": self._good_steps, "decr_count": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("incr_count", 0)
+        self._bad_steps = sd.get("decr_count", 0)
+
+    set_state_dict = load_state_dict
+
+
+def is_bfloat16_supported():
+    return True
+
+
+def is_float16_supported():
+    return True

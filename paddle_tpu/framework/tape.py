@@ -42,6 +42,7 @@ class no_grad:
     """Context manager / decorator disabling tape recording.
 
     reference: python/paddle/base/dygraph/base.py no_grad_.
+    Supports ``with no_grad():``, ``@no_grad`` and ``@no_grad()``.
     """
 
     def __init__(self, func=None):
@@ -51,7 +52,16 @@ class no_grad:
         if self._func is not None:
             with no_grad():
                 return self._func(*args, **kwargs)
-        return self
+        if len(args) == 1 and callable(args[0]) and not kwargs:
+            import functools
+            func = args[0]
+
+            @functools.wraps(func)
+            def wrapper(*a, **k):
+                with no_grad():
+                    return func(*a, **k)
+            return wrapper
+        raise TypeError("no_grad() used as a decorator expects a callable")
 
     def __enter__(self):
         self._prev = _state.enabled

@@ -1,1 +1,6 @@
-"""(being built — see package modules)"""
+"""Optimizers + LR schedulers (reference: python/paddle/optimizer/)."""
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
+    RMSProp, Lamb, ASGD, Rprop, L1Decay, L2Decay,
+)
+from . import lr  # noqa: F401
