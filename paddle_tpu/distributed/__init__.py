@@ -1,0 +1,47 @@
+"""paddle_tpu.distributed — SPMD-first distributed training.
+
+Capability parity: python/paddle/distributed/ in the reference (152k LoC:
+collective API, fleet hybrid parallel, auto-parallel/SPMD, sharding,
+checkpoint, launch).  See SURVEY §7 for the mapping table; the short version:
+mesh axes replace process groups, GSPMD replaces per-op SPMD rules + reshard
+machinery, compiled collectives over ICI replace ProcessGroupNCCL.
+"""
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, is_initialized,
+)
+from .collective import (  # noqa: F401
+    all_reduce, all_gather, all_gather_object, broadcast, reduce, scatter,
+    reduce_scatter, all_to_all, alltoall, send, recv, isend, irecv, barrier,
+    new_group, get_group, destroy_process_group, get_backend, ReduceOp,
+    Group, broadcast_object_list, scatter_object_list,
+)
+from .parallel import DataParallel  # noqa: F401
+from .auto_parallel.process_mesh import (  # noqa: F401
+    ProcessMesh, get_mesh, set_mesh, auto_mesh,
+)
+from .auto_parallel.placement import (  # noqa: F401
+    Placement, Shard, Replicate, Partial, ReduceType,
+)
+from .auto_parallel.api import (  # noqa: F401
+    shard_tensor, reshard, shard_layer, shard_optimizer, dtensor_from_fn,
+    unshard_dtensor, shard_dataloader, DistAttr,
+)
+from . import fleet  # noqa: F401
+from .fleet.sharding import group_sharded_parallel  # noqa: F401
+from . import checkpoint  # noqa: F401
+
+import jax as _jax
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """reference: paddle.distributed.spawn (spawn.py:463).
+
+    On TPU all local chips belong to one process (SPMD); spawn degenerates to
+    a direct call — kept for script portability.
+    """
+    func(*args)
+
+
+def launch():
+    from .launch.main import main
+    main()

@@ -1,0 +1,5 @@
+"""Auto-parallel (SPMD) package."""
+from .process_mesh import ProcessMesh, get_mesh, set_mesh
+from .placement import Shard, Replicate, Partial
+from .api import shard_tensor, reshard, shard_layer, shard_optimizer
+

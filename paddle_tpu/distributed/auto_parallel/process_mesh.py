@@ -1,0 +1,122 @@
+"""ProcessMesh over jax.sharding.Mesh.
+
+Capability parity: python/paddle/distributed/auto_parallel/process_mesh.py:85
+in the reference (C++ side: dist_tensor.h ProcessMesh).
+
+TPU-native: a ProcessMesh IS a jax Mesh — device ids map onto the physical
+chip topology; XLA lays collectives onto ICI rings per mesh axis.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_global_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    """reference: paddle.distributed.ProcessMesh."""
+
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None, process_ids=None):
+        if mesh is None and shape is not None:
+            mesh = np.arange(int(np.prod(shape))).reshape(shape)
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._shape = list(arr.shape)
+        self._dim_names = list(dim_names)
+        self._process_ids = arr.reshape(-1).tolist()
+        devices = np.asarray(jax.devices(), dtype=object)
+        if arr.size > devices.size:
+            raise ValueError(
+                f"mesh needs {arr.size} devices, only {devices.size} present "
+                f"(use XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                f"for CPU testing)")
+        dev_grid = np.empty(arr.shape, dtype=object)
+        flat_ids = arr.reshape(-1)
+        for i, pid in enumerate(flat_ids):
+            dev_grid.reshape(-1)[i] = devices[pid]
+        self._jax_mesh = Mesh(dev_grid, tuple(self._dim_names))
+
+    # -------------------------------------------------------------- properties
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return list(self._process_ids)
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index=None):
+        """Submesh dropping/fixing one axis (reference: process_mesh.py
+        get_mesh_with_dim)."""
+        axis = self._dim_names.index(dim_name)
+        arr = np.asarray(self._process_ids).reshape(self._shape)
+        arr = np.moveaxis(arr, axis, 0)
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        if index is not None:
+            return ProcessMesh(arr[index], names[1:])
+        return ProcessMesh(arr, names)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._dim_names == other._dim_names
+                and self._process_ids == other._process_ids)
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._dim_names),
+                     tuple(self._process_ids)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+    def __enter__(self):
+        global _global_mesh
+        self._prev = _global_mesh
+        _global_mesh = self
+        return self
+
+    def __exit__(self, *exc):
+        global _global_mesh
+        _global_mesh = self._prev
+        return False
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def auto_mesh(*dim_names: str, shape: Optional[Sequence[int]] = None
+              ) -> ProcessMesh:
+    """Build a mesh over all devices with the given axis names; unspecified
+    shape puts all devices on the first axis."""
+    n = jax.device_count()
+    if shape is None:
+        shape = [n] + [1] * (len(dim_names) - 1)
+    return ProcessMesh(np.arange(n).reshape(shape), list(dim_names))

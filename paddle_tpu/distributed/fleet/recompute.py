@@ -1,0 +1,73 @@
+"""Activation recomputation (gradient checkpointing).
+
+Capability parity: python/paddle/distributed/fleet/recompute/recompute.py in
+the reference (RecomputeFunction PyLayer + recompute_sequential).
+
+TPU-native: ``jax.checkpoint`` (remat) IS the recompute mechanism — XLA
+rematerializes the forward inside the compiled backward, which both saves HBM
+and lets the scheduler overlap recompute with collectives.  The eager tape
+path wraps the remat'd function as a single recorded op.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from ...framework.dispatch import call_op
+from ...framework.tensor import Tensor, wrap_array
+from ...framework.tape import no_grad
+from ... import tensor as T
+
+
+def recompute(function: Callable, *args, **kwargs):
+    """reference: fleet.recompute — checkpoint one block."""
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+
+    from ...nn.layer.layers import Layer
+    param_tensors = []
+    if isinstance(function, Layer):
+        param_tensors = [p for _, p in function.named_parameters()]
+
+    def fn(params, *arrs):
+        saved = [p._data for p in param_tensors]
+        try:
+            for p, a in zip(param_tensors, params):
+                p._data = a
+            wrapped = [wrap_array(a) if not isinstance(a, Tensor) else a
+                       for a in arrs]
+            with no_grad():
+                out = function(*wrapped, **kwargs)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._data if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._data if isinstance(out, Tensor) else out
+        finally:
+            for p, a in zip(param_tensors, saved):
+                p._data = a
+
+    remat_fn = jax.checkpoint(fn)
+    return call_op("recompute", remat_fn, (param_tensors,) + args, {})
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference: recompute_sequential — checkpoint a Sequential in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    seg_size = max(len(layers) // max(segments, 1), 1)
+    out = args
+    for i in range(0, len(layers), seg_size):
+        seg = layers[i:i + seg_size]
+
+        def run_seg(*xs, _seg=seg):
+            y = xs
+            for layer in _seg:
+                y = layer(*y) if isinstance(y, tuple) else layer(y)
+                if not isinstance(y, tuple):
+                    y = (y,)
+            return y if len(y) > 1 else y[0]
+        out = recompute(run_seg, *(out if isinstance(out, tuple) else (out,)))
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out if len(out) > 1 else out[0]

@@ -1,0 +1,87 @@
+"""Group sharded (ZeRO 1/2/3) training.
+
+Capability parity: python/paddle/distributed/fleet/meta_parallel/sharding/
+in the reference — group_sharded_parallel (group_sharded.py), stage2
+optimizer/grad sharding (group_sharded_optimizer_stage2.py:53), stage3
+parameter sharding (group_sharded_stage3.py:85).
+
+TPU-native mapping (SURVEY §7): ZeRO stages are *sharding configs*, not
+runtime machinery:
+  os (stage 1):   optimizer states sharded on the sharding axis; the jitted
+                  optimizer step computes shard-locally, XLA all-gathers the
+                  fresh params (reference's broadcast).
+  os_g (stage 2): + gradients land sharded: XLA turns the grad psum into
+                  reduce-scatter when the consumer (optimizer state) is
+                  sharded — the comm pattern stage2 implements by hand.
+  p_g_os (3):     + parameters sharded dim0; XLA inserts per-op all-gathers
+                  on use (the reference's param broadcast-on-demand).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+
+from ...framework.tape import no_grad
+from ..auto_parallel.placement import Shard, Replicate
+from ..auto_parallel.process_mesh import ProcessMesh, get_mesh
+from ..auto_parallel.api import shard_tensor, shard_optimizer
+from .topology import get_hybrid_communicate_group
+
+
+def _sharding_mesh(axis="sharding"):
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+        return hcg.mesh, "sharding"
+    m = get_mesh()
+    if m is not None and axis in m.dim_names:
+        return m, axis
+    n = jax.device_count()
+    return ProcessMesh(np.arange(n), [axis]), axis
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=None,
+                           segment_size=None, sync_comm=False,
+                           dp_group=None, exclude_layer=None):
+    """reference: paddle.distributed.sharding.group_sharded_parallel.
+
+    level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3).
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"level must be os|os_g|p_g_os, got {level}")
+    mesh, axis = _sharding_mesh()
+    degree = mesh.get_dim_size(axis)
+    axis_idx = mesh.dim_names.index(axis)
+
+    if level == "p_g_os":
+        # stage 3: shard parameters along dim0 where divisible
+        with no_grad():
+            for p in model.parameters():
+                placements = [Replicate()] * mesh.ndim
+                if p.ndim > 0 and p.shape[0] % degree == 0:
+                    placements[axis_idx] = Shard(0)
+                shard_tensor(p, mesh, placements)
+    else:
+        with no_grad():
+            for p in model.parameters():
+                if p.dist_attr is None:
+                    shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
+
+    def state_shard_fn(slot, p):
+        placements = [Replicate()] * mesh.ndim
+        if p.ndim > 0 and p.shape[0] % degree == 0:
+            placements[axis_idx] = Shard(0)
+        return placements, mesh
+
+    optimizer = shard_optimizer(optimizer, state_shard_fn)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """reference: sharding save_group_sharded_model."""
+    from ...framework.io import save
+    save(model.state_dict(), output + ".pdmodel")
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

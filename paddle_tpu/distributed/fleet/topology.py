@@ -1,0 +1,155 @@
+"""Hybrid-parallel topology.
+
+Capability parity: python/paddle/distributed/fleet/base/topology.py:189
+HybridCommunicateGroup (4-D + sep topology: dp/pp/sharding/mp/sep) in the
+reference.
+
+TPU-native: the topology IS a ProcessMesh with axes
+('pp', 'dp', 'sharding', 'sep', 'mp') over the chip grid; a "communicate
+group" is a mesh-axis handle (collectives ride the ICI ring of that axis).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+
+from ..auto_parallel.process_mesh import ProcessMesh, set_mesh
+from ..collective import Group
+
+
+class CommunicateTopology:
+    """reference: fleet/base/topology.py CommunicateTopology."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+
+class HybridCommunicateGroup:
+    """reference: fleet/base/topology.py:189."""
+
+    # paddle axis order: dp, pp, sharding, sep, mp (topology.py order)
+    AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
+                 sep_degree=1):
+        if topology is not None:
+            names = topology.get_hybrid_group_names()
+            mapping = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+                       "sep": "sep", "model": "mp"}
+            degrees = {mapping[n]: topology.get_dim(n) for n in names}
+            dp_degree = degrees.get("dp", 1)
+            pp_degree = degrees.get("pp", 1)
+            sharding_degree = degrees.get("sharding", 1)
+            sep_degree = degrees.get("sep", 1)
+            mp_degree = degrees.get("mp", 1)
+        self._dp_degree = dp_degree
+        self._mp_degree = mp_degree
+        self._pp_degree = pp_degree
+        self._sharding_degree = sharding_degree
+        self._sep_degree = sep_degree
+        total = dp_degree * mp_degree * pp_degree * sharding_degree * sep_degree
+        n = jax.device_count()
+        if total > n:
+            raise ValueError(f"hybrid degrees product {total} > devices {n}")
+        shape = (pp_degree, dp_degree, sharding_degree, sep_degree, mp_degree)
+        self.mesh = ProcessMesh(np.arange(total).reshape(shape),
+                                ["pp", "dp", "sharding", "sep", "mp"])
+        set_mesh(self.mesh)
+        self._groups: Dict[str, Group] = {
+            ax: Group(mesh=self.mesh, axis=ax)
+            for ax in ("pp", "dp", "sharding", "sep", "mp")}
+
+    # ----- degrees (reference API names)
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # ----- ranks: single-controller SPMD → logical rank 0 per axis
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # ----- groups
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, *a, **k) -> Group:
+        return self._groups["mp"]
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+    def topology(self):
+        return self.mesh
+
+    @property
+    def nranks(self):
+        return int(np.prod(self.mesh.shape))
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg

@@ -1,0 +1,52 @@
+"""Launch CLI: ``python -m paddle_tpu.distributed.launch train.py``.
+
+Capability parity: python/paddle/distributed/launch/main.py:23 in the
+reference (CollectiveController process-per-device, HTTP/etcd master).
+
+TPU-native: one process per HOST (chips are SPMD lanes inside the process),
+so on a single host the launcher execs the script directly; multi-host mode
+sets the jax.distributed coordination env (the TCPStore/etcd master analog)
+and is driven by the pod scheduler (one launch per host).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import subprocess
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="TPU-native launcher (reference: paddle.distributed.launch)")
+    parser.add_argument("--nnodes", type=int,
+                        default=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+    parser.add_argument("--node_rank", type=int,
+                        default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    parser.add_argument("--master", default=os.environ.get("PADDLE_MASTER"))
+    parser.add_argument("--nproc_per_node", type=int, default=1,
+                        help="kept for reference-CLI compat; on TPU one "
+                             "process drives all local chips (SPMD)")
+    parser.add_argument("--log_dir", default=None)
+    parser.add_argument("--devices", "--gpus", dest="devices", default=None)
+    parser.add_argument("script", help="training script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    env = os.environ
+    env["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+    env["PADDLE_TRAINER_ID"] = str(args.node_rank)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+    if args.nproc_per_node > 1:
+        print("[paddle_tpu.launch] note: nproc_per_node>1 is a GPU-ism; on "
+              "TPU one process per host drives all chips via SPMD. "
+              "Running a single process.", file=sys.stderr)
+    sys.argv = [args.script] + list(args.script_args)
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

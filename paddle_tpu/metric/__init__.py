@@ -1,0 +1,173 @@
+"""Metrics (reference: python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor, to_tensor, wrap_array
+
+
+class Metric:
+    """reference: paddle.metric.Metric."""
+
+    def __init__(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    """reference: paddle.metric.Accuracy."""
+
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        super().__init__()
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        p = pred.numpy() if isinstance(pred, Tensor) else np.asarray(pred)
+        l = label.numpy() if isinstance(label, Tensor) else np.asarray(label)
+        idx = np.argsort(-p, axis=-1)[..., :self.maxk]
+        if l.ndim == p.ndim and l.shape[-1] == 1:
+            l = l[..., 0]
+        if l.ndim == p.ndim:  # one-hot
+            l = np.argmax(l, axis=-1)
+        correct = (idx == l[..., None]).astype(np.float32)
+        return to_tensor(correct)
+
+    def update(self, correct, *args):
+        c = correct.numpy() if isinstance(correct, Tensor) else np.asarray(correct)
+        num = c.shape[0] if c.ndim > 0 else 1
+        accs = []
+        for k in self.topk:
+            corr = c[..., :k].sum()
+            self.total[self.topk.index(k)] += corr
+            self.count[self.topk.index(k)] += num
+            accs.append(corr / max(num, 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = preds.numpy() if isinstance(preds, Tensor) else np.asarray(preds)
+        l = labels.numpy() if isinstance(labels, Tensor) else np.asarray(labels)
+        pred_pos = (p.reshape(-1) > 0.5)
+        actual = l.reshape(-1).astype(bool)
+        self.tp += int(np.sum(pred_pos & actual))
+        self.fp += int(np.sum(pred_pos & ~actual))
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = preds.numpy() if isinstance(preds, Tensor) else np.asarray(preds)
+        l = labels.numpy() if isinstance(labels, Tensor) else np.asarray(labels)
+        pred_pos = (p.reshape(-1) > 0.5)
+        actual = l.reshape(-1).astype(bool)
+        self.tp += int(np.sum(pred_pos & actual))
+        self.fn += int(np.sum(~pred_pos & actual))
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """reference: paddle.metric.Auc (trapezoid over threshold buckets)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc",
+                 *args, **kwargs):
+        super().__init__()
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = preds.numpy() if isinstance(preds, Tensor) else np.asarray(preds)
+        l = labels.numpy() if isinstance(labels, Tensor) else np.asarray(labels)
+        if p.ndim == 2:
+            p = p[:, 1]
+        idx = np.minimum((p * self.num_thresholds).astype(np.int64),
+                         self.num_thresholds)
+        lbl = l.reshape(-1).astype(bool)
+        np.add.at(self._stat_pos, idx[lbl], 1)
+        np.add.at(self._stat_neg, idx[~lbl], 1)
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, dtype=np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, dtype=np.int64)
+
+    def accumulate(self):
+        tot_pos = self._stat_pos[::-1].cumsum()
+        tot_neg = self._stat_neg[::-1].cumsum()
+        tp, fp = tot_pos, tot_neg
+        P, N = tot_pos[-1], tot_neg[-1]
+        if P == 0 or N == 0:
+            return 0.0
+        tpr = tp / P
+        fpr = fp / N
+        return float(np.trapezoid(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    m = Accuracy(topk=(k,))
+    c = m.compute(input, label)
+    m.update(c)
+    return to_tensor(np.asarray(m.accumulate(), dtype=np.float32))

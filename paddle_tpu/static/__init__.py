@@ -1,0 +1,45 @@
+"""Static-graph API shim.
+
+The reference's static Program stack (python/paddle/static/, PIR interpreters,
+StandaloneExecutor — SURVEY §2 #24/#25/#48) is replaced wholesale by XLA:
+``paddle_tpu.jit.to_static`` traces to one compiled program (SURVEY §7 table).
+This module keeps the static-namespace symbols user code actually touches
+(InputSpec, name guards, io) and raises clear errors for the legacy
+Program-builder API.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..jit import InputSpec, save, load  # noqa: F401
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+@contextlib.contextmanager
+def program_guard(main_program=None, startup_program=None):
+    raise NotImplementedError(
+        "paddle_tpu has no static Program builder; XLA compilation replaces "
+        "it — use paddle_tpu.jit.to_static (see SURVEY §7).")
+    yield
+
+
+class Program:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "static Program is replaced by jit.to_static/XLA on TPU")
+
+
+def default_main_program():
+    raise NotImplementedError("no static Program stack; use jit.to_static")
+
+
+def default_startup_program():
+    raise NotImplementedError("no static Program stack; use jit.to_static")
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
