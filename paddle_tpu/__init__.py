@@ -45,6 +45,7 @@ from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
 from . import vision  # noqa: F401
+from . import models  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from . import distributed  # noqa: F401

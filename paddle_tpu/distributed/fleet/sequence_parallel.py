@@ -1,0 +1,125 @@
+"""Sequence parallelism (Megatron-style SP) + segment parallel (SEP).
+
+Capability parity: python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py in the reference (ScatterOp/GatherOp/AllGatherOp/
+ReduceScatterOp PyLayers :85-146, ColumnSequenceParallelLinear :429,
+RowSequenceParallelLinear, allreduce hooks :192) and
+meta_parallel/segment_parallel.py:26 (SEP).
+
+TPU-native: SP "scatter/gather" are reshards between Shard(seq-dim) and
+Replicate over the 'mp' axis — XLA emits the all-gather/reduce-scatter pair
+the reference codes as PyLayers, and fuses them with the adjacent matmuls.
+SEP = sequence sharded over the 'sep' axis with ring attention
+(ops/ring_attention.py) — exceeding the reference, which shards but has no
+ring kernel.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+
+from ...framework.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ...nn import functional as F
+from ..auto_parallel.placement import Shard, Replicate
+from ..auto_parallel.process_mesh import ProcessMesh, get_mesh
+from ..auto_parallel.api import reshard, shard_tensor
+from .topology import get_hybrid_communicate_group
+from .mp_layers import ColumnParallelLinear, RowParallelLinear, _mp_mesh, \
+    _axis_placements
+
+
+def _sp_placements(mesh, axis, seq_dim):
+    out = [Replicate()] * mesh.ndim
+    out[mesh.dim_names.index(axis)] = Shard(seq_dim)
+    return out
+
+
+def scatter(x: Tensor, axis: str = "mp", seq_dim: int = 0) -> Tensor:
+    """reference: ScatterOp (sequence_parallel_utils.py:85) — split the seq
+    dim across the mp group."""
+    mesh, axis = _mp_mesh(None, axis)
+    return reshard(x, mesh, _sp_placements(mesh, axis, seq_dim))
+
+
+def all_gather(x: Tensor, axis: str = "mp") -> Tensor:
+    """reference: AllGatherOp (:118)."""
+    mesh, axis = _mp_mesh(None, axis)
+    return reshard(x, mesh, _axis_placements(mesh, axis, None))
+
+
+gather = all_gather
+
+
+def reduce_scatter(x: Tensor, axis: str = "mp", seq_dim: int = 0) -> Tensor:
+    """reference: ReduceScatterOp (:146) — partial-sum in, seq-sharded out."""
+    mesh, axis = _mp_mesh(None, axis)
+    return reshard(x, mesh, _sp_placements(mesh, axis, seq_dim))
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """reference: sequence_parallel_utils.py:429 — input seq-sharded, weight
+    col-sharded; the all-gather before the matmul is GSPMD's to insert (and
+    overlap — reference hand-codes overlap in SPInnerOverlapLinear:257)."""
+
+    def forward(self, x):
+        if isinstance(x, Tensor) and x.dist_attr is not None:
+            x = all_gather(x, self._axis)
+        out = F.linear(x, self.weight, self.bias)
+        out.dist_attr = None
+        if self.gather_output:
+            out = reshard(out, self._mesh,
+                          _axis_placements(self._mesh, self._axis, None))
+        return out
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """reference: RowSequenceParallelLinear — output reduce-scattered onto
+    the seq dim instead of all-reduced."""
+
+    def __init__(self, *args, seq_dim=0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._seq_dim = seq_dim
+
+    def forward(self, x):
+        out = super().forward(x)
+        return reduce_scatter(out, self._axis, self._seq_dim)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               use_mp=True):
+    """reference: sequence_parallel_utils.py:192 — grad allreduce for SP
+    params (LayerNorm etc.).  Under GSPMD, grads of replicated params over a
+    sharded seq dim already carry the psum; kept as a no-op for portability."""
+    return model
+
+
+class SegmentParallel(Layer):
+    """reference: meta_parallel/segment_parallel.py:26 — shards the sequence
+    dim over the 'sep' axis; attention must be sep-aware (here: ring
+    attention, which the reference lacks)."""
+
+    def __init__(self, layers, hcg=None, strategy=None, seq_dim=1):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._seq_dim = seq_dim
+
+    def forward(self, *inputs, **kwargs):
+        mesh = self._hcg.mesh if self._hcg else get_mesh()
+        new_inputs = []
+        for x in inputs:
+            if isinstance(x, Tensor) and x.ndim > self._seq_dim:
+                placements = [Replicate()] * mesh.ndim
+                placements[mesh.dim_names.index("sep")] = Shard(self._seq_dim)
+                x = shard_tensor(x, mesh, placements)
+            new_inputs.append(x)
+        return self._layers(*new_inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)

@@ -1,4 +1,197 @@
-"""paddle.Model high-level API (fleshed out in hapi build step)."""
+"""High-level Model API: fit/evaluate/predict.
+
+Capability parity: python/paddle/hapi/model.py in the reference
+(paddle.Model, callbacks in hapi/callbacks.py, summary).
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor, to_tensor
+from ..framework.io import save as _save, load as _load
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+
+
 class Model:
+    """reference: paddle.Model (hapi/model.py)."""
+
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._jit_forward = None
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit_compile=True):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+        if jit_compile:
+            from ..jit import to_static
+            net = self.network
+            self._jit_forward = to_static(lambda *xs: net(*xs))
+        return self
+
+    def _forward(self, *inputs):
+        if self._jit_forward is not None:
+            return self._jit_forward(*inputs)
+        return self.network(*inputs)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) \
+            else [labels]
+        outputs = self._forward(*inputs)
+        losses = self._loss(outputs, *labels) if labels else self._loss(outputs)
+        loss = losses if isinstance(losses, Tensor) else losses[0]
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(loss.item())], metrics) if metrics else [float(loss.item())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) \
+            else [labels]
+        outputs = self._forward(*inputs)
+        result = []
+        if self._loss is not None and labels:
+            losses = self._loss(outputs, *labels)
+            loss = losses if isinstance(losses, Tensor) else losses[0]
+            result.append(float(loss.item()))
+        self._update_metrics(outputs, labels)
+        return result
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return self._forward(*inputs)
+
+    def _update_metrics(self, outputs, labels):
+        vals = []
+        for m in self._metrics:
+            out = outputs if isinstance(outputs, Tensor) else outputs[0]
+            res = m.compute(out, *(labels or []))
+            vals.append(m.update(res))
+        return vals
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=1, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        """reference: hapi/model.py Model.fit."""
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        history = {"loss": []}
+        it = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            t0 = time.time()
+            samples = 0
+            for step, batch in enumerate(loader):
+                if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+                    x, y = batch[0], batch[1]
+                else:
+                    x, y = batch, None
+                result = self.train_batch(x, y)
+                loss_val = result[0][0] if isinstance(result, tuple) else result[0]
+                history["loss"].append(loss_val)
+                bsz = x.shape[0] if isinstance(x, Tensor) else len(x)
+                samples += bsz
+                it += 1
+                if verbose and step % log_freq == 0:
+                    msg = f"Epoch {epoch + 1}/{epochs} step {step} loss {loss_val:.4f}"
+                    for m in self._metrics:
+                        msg += f" {m.name()}: {m.accumulate():.4f}" \
+                            if isinstance(m.name(), str) else ""
+                    print(msg)
+                if num_iters is not None and it >= num_iters:
+                    break
+            dt = time.time() - t0
+            if verbose:
+                print(f"Epoch {epoch + 1}: {samples / max(dt, 1e-9):.1f} "
+                      f"samples/sec")
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch + 1}")
+            if num_iters is not None and it >= num_iters:
+                break
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 num_workers=0, callbacks=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+                x, y = batch[0], batch[1]
+            else:
+                x, y = batch, None
+            res = self.eval_batch(x, y)
+            if res:
+                losses.append(res[0])
+        result = {}
+        if losses:
+            result["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            name = m.name()
+            result[name if isinstance(name, str) else name[0]] = m.accumulate()
+        if verbose:
+            print("Eval:", result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+        outputs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outputs.append(self.predict_batch(x))
+        return outputs
+
+    def save(self, path, training=True):
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        sd = _load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *a, **k):
+        return self.network.parameters(*a, **k)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        trainable = sum(p.size for p in self.network.parameters()
+                        if getattr(p, "trainable", True))
+        info = {"total_params": n_params, "trainable_params": trainable}
+        print(f"Total params: {n_params:,} (trainable {trainable:,})")
+        return info

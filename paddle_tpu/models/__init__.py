@@ -1,0 +1,9 @@
+"""Model zoo: LLaMA (flagship), BERT; vision models in paddle_tpu.vision."""
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, llama_7b, llama_small,
+    shard_llama,
+)
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForSequenceClassification, BertForMaskedLM,
+    bert_base, bert_tiny,
+)

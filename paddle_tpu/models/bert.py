@@ -1,0 +1,157 @@
+"""BERT / ERNIE-class encoder (BASELINE config 2).
+
+Capability parity: the reference fine-tunes BERT/ERNIE-3.0 via PaddleNLP on
+top of paddle.nn.TransformerEncoder; this is the equivalent native stack.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear, Embedding, Dropout
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.activation import Tanh
+from ..nn.layer.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from .. import tensor as T
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+
+
+def bert_base():
+    return BertConfig()
+
+
+def bert_tiny():
+    return BertConfig(vocab_size=1000, hidden_size=128, num_hidden_layers=2,
+                      num_attention_heads=2, intermediate_size=512,
+                      max_position_embeddings=128)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        init = Normal(std=c.initializer_range)
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size,
+                                         weight_attr=init)
+        self.position_embeddings = Embedding(c.max_position_embeddings,
+                                             c.hidden_size, weight_attr=init)
+        self.token_type_embeddings = Embedding(c.type_vocab_size,
+                                               c.hidden_size, weight_attr=init)
+        self.layer_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = T.arange(s, dtype="int32")
+        if token_type_ids is None:
+            token_type_ids = T.zeros_like(input_ids)
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.dense = Linear(c.hidden_size, c.hidden_size,
+                            weight_attr=Normal(std=c.initializer_range))
+        self.activation = Tanh()
+
+    def forward(self, hidden):
+        return self.activation(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    """reference capability: paddlenlp BertModel on paddle.nn primitives."""
+
+    def __init__(self, config: Optional[BertConfig] = None):
+        super().__init__()
+        c = config or BertConfig()
+        self.config = c
+        self.embeddings = BertEmbeddings(c)
+        enc_layer = TransformerEncoderLayer(
+            c.hidden_size, c.num_attention_heads, c.intermediate_size,
+            dropout=c.hidden_dropout_prob, activation=c.hidden_act,
+            attn_dropout=c.attention_probs_dropout_prob,
+            layer_norm_eps=c.layer_norm_eps)
+        self.encoder = TransformerEncoder(enc_layer, c.num_hidden_layers)
+        self.pooler = BertPooler(c)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None):
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        mask = None
+        if attention_mask is not None:
+            # additive mask (b, 1, 1, s)
+            m = (1.0 - attention_mask.astype("float32")) * -1e9
+            mask = m.reshape([m.shape[0], 1, 1, m.shape[1]])
+        encoded = self.encoder(emb, mask)
+        pooled = self.pooler(encoded)
+        return encoded, pooled
+
+
+class BertForSequenceClassification(Layer):
+    """reference capability: GLUE/SST-2 fine-tune entrypoint."""
+
+    def __init__(self, config: Optional[BertConfig] = None):
+        super().__init__()
+        c = config or BertConfig()
+        self.bert = BertModel(c)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+        self.classifier = Linear(c.hidden_size, c.num_labels,
+                                 weight_attr=Normal(std=c.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = F.cross_entropy(logits, labels)
+            return loss, logits
+        return logits
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, config: Optional[BertConfig] = None):
+        super().__init__()
+        c = config or BertConfig()
+        self.bert = BertModel(c)
+        self.transform = Linear(c.hidden_size, c.hidden_size,
+                                weight_attr=Normal(std=c.initializer_range))
+        self.layer_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.decoder = Linear(c.hidden_size, c.vocab_size,
+                              weight_attr=Normal(std=c.initializer_range))
+        self.config = c
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        encoded, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(encoded)))
+        logits = self.decoder(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]), ignore_index=-100)
+            return loss, logits
+        return logits

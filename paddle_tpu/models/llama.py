@@ -1,0 +1,269 @@
+"""LLaMA-family decoder LM — the flagship model (BASELINE config 5).
+
+Capability parity: the reference trains LLaMA-2 via PaddleNLP on Fleet hybrid
+parallel; the architecture blocks it relies on (fused rope, rms_norm, flash
+attention, fused SwiGLU — paddle/phi/kernels/fusion/) appear here as
+XLA-fused ops + the Pallas flash-attention kernel.
+
+TPU-native: bf16 params/compute with fp32 master weights in the optimizer;
+GQA; rotary embeddings precomputed in fp32; causal flash attention (Pallas on
+TPU).  ``shard_llama`` stamps the canonical TP/FSDP placements (SURVEY §7
+mesh axes) so the same model runs 1-chip or hybrid-parallel unchanged.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.dispatch import call_op, def_op
+from ..nn.layer.layers import Layer, LayerList
+from ..nn.layer.common import Linear, Embedding, Dropout
+from ..nn.layer.norm import RMSNorm
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from .. import tensor as T
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+
+def llama_7b():
+    return LlamaConfig()
+
+
+def llama_small(vocab=32000):
+    """~110M-param config for single-chip benchmarking."""
+    return LlamaConfig(vocab_size=vocab, hidden_size=768,
+                       intermediate_size=2048, num_hidden_layers=12,
+                       num_attention_heads=12, num_key_value_heads=12,
+                       max_position_embeddings=2048)
+
+
+def _rope_tables(head_dim, max_pos, theta):
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64)
+                           / head_dim))
+    t = np.arange(max_pos, dtype=np.float64)
+    freqs = np.outer(t, inv)
+    return (jnp.asarray(np.cos(freqs), jnp.float32),
+            jnp.asarray(np.sin(freqs), jnp.float32))
+
+
+@def_op("fused_rope")
+def apply_rope(q, k, cos, sin, position_offset=0):
+    """Rotary embedding on (b, s, h, d) — the reference's fused_rope kernel
+    (paddle/phi/kernels/fusion/gpu/fused_rope_*); XLA fuses this chain."""
+    s = q.shape[1]
+    c = cos[position_offset:position_offset + s][None, :, None, :]
+    si = sin[position_offset:position_offset + s][None, :, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        xf1 = x1.astype(jnp.float32)
+        xf2 = x2.astype(jnp.float32)
+        o1 = xf1 * c - xf2 * si
+        o2 = xf2 * c + xf1 * si
+        return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
+    return rot(q), rot(k)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_attention_heads
+        self.num_kv_heads = c.num_key_value_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        init = Normal(std=0.02)
+        self.q_proj = Linear(c.hidden_size, self.num_heads * self.head_dim,
+                             weight_attr=init, bias_attr=False)
+        self.k_proj = Linear(c.hidden_size, self.num_kv_heads * self.head_dim,
+                             weight_attr=init, bias_attr=False)
+        self.v_proj = Linear(c.hidden_size, self.num_kv_heads * self.head_dim,
+                             weight_attr=init, bias_attr=False)
+        self.o_proj = Linear(self.num_heads * self.head_dim, c.hidden_size,
+                             weight_attr=init, bias_attr=False)
+
+    def forward(self, x, cos, sin, position_offset=0, kv_cache=None):
+        b, s = x.shape[0], x.shape[1]
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q, k = apply_rope(q, k, cos, sin, position_offset)
+        new_cache = None
+        if kv_cache is not None:
+            pk, pv = kv_cache
+            k = T.concat([pk, k], axis=1)
+            v = T.concat([pv, v], axis=1)
+            new_cache = (k, v)
+        out, _ = F.flash_attention(q, k, v, causal=True)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        init = Normal(std=0.02)
+        self.gate_proj = Linear(c.hidden_size, c.intermediate_size,
+                                weight_attr=init, bias_attr=False)
+        self.up_proj = Linear(c.hidden_size, c.intermediate_size,
+                              weight_attr=init, bias_attr=False)
+        self.down_proj = Linear(c.intermediate_size, c.hidden_size,
+                                weight_attr=init, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, cos, sin, position_offset=0, kv_cache=None):
+        attn_in = self.input_layernorm(x)
+        if kv_cache is not None:
+            attn_out, new_cache = self.self_attn(attn_in, cos, sin,
+                                                 position_offset, kv_cache)
+        else:
+            attn_out = self.self_attn(attn_in, cos, sin, position_offset)
+            new_cache = None
+        x = x + attn_out
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        if new_cache is not None:
+            return x, new_cache
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size,
+                                      weight_attr=Normal(std=0.02))
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        cos, sin = _rope_tables(
+            config.hidden_size // config.num_attention_heads,
+            config.max_position_embeddings, config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, position_offset=0, kv_caches=None):
+        x = self.embed_tokens(input_ids)
+        new_caches = [] if kv_caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if kv_caches is not None:
+                x, cache = layer(x, self.rope_cos, self.rope_sin,
+                                 position_offset, kv_caches[i])
+                new_caches.append(cache)
+            else:
+                x = layer(x, self.rope_cos, self.rope_sin, position_offset)
+        x = self.norm(x)
+        if new_caches is not None:
+            return x, new_caches
+        return x
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  weight_attr=Normal(std=0.02),
+                                  bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.model(input_ids)
+        if self.lm_head is not None:
+            logits = self.lm_head(hidden)
+        else:
+            logits = call_op(
+                "tied_lm_head", lambda h, w: jnp.matmul(h, w.T),
+                (hidden, self.model.embed_tokens.weight), {})
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]), ignore_index=-100)
+            return loss, logits
+        return logits
+
+
+# ----------------------------------------------------------- parallel plan
+def shard_llama(model: LlamaForCausalLM, mesh, dp_axis="dp", tp_axis="mp",
+                fsdp_axis: Optional[str] = None):
+    """Canonical TP(+FSDP) placements for the LLaMA stack
+    (reference capability: PaddleNLP LLaMA + Fleet mp/sharding; SURVEY §7
+    mesh-axis mapping; sharding recipe per the public scaling-book pattern).
+
+    Column-parallel: q/k/v/gate/up (out-dim on tp).  Row-parallel:
+    o_proj/down (in-dim on tp).  Embedding/lm_head: vocab on tp.  FSDP axis
+    (optional) shards the other weight dim.
+    """
+    from ..distributed.auto_parallel.placement import Shard, Replicate
+    from ..distributed.auto_parallel.api import shard_tensor
+
+    names = dict(mesh_axis=(mesh.dim_names))
+
+    def place(param, tp_dim, fsdp_dim=None):
+        placements = [Replicate()] * mesh.ndim
+        if tp_axis in mesh.dim_names and tp_dim is not None:
+            if param.shape[tp_dim] % mesh.get_dim_size(tp_axis) == 0:
+                placements[mesh.dim_names.index(tp_axis)] = Shard(tp_dim)
+        if fsdp_axis and fsdp_axis in mesh.dim_names and fsdp_dim is not None:
+            if param.shape[fsdp_dim] % mesh.get_dim_size(fsdp_axis) == 0:
+                placements[mesh.dim_names.index(fsdp_axis)] = Shard(fsdp_dim)
+        shard_tensor(param, mesh, placements)
+
+    for layer in model.model.layers:
+        attn, mlp = layer.self_attn, layer.mlp
+        place(attn.q_proj.weight, 1, 0)
+        place(attn.k_proj.weight, 1, 0)
+        place(attn.v_proj.weight, 1, 0)
+        place(attn.o_proj.weight, 0, 1)
+        place(mlp.gate_proj.weight, 1, 0)
+        place(mlp.up_proj.weight, 1, 0)
+        place(mlp.down_proj.weight, 0, 1)
+        place(layer.input_layernorm.weight, None, 0)
+        place(layer.post_attention_layernorm.weight, None, 0)
+    place(model.model.embed_tokens.weight, 1, 0)
+    if model.lm_head is not None:
+        place(model.lm_head.weight, 1, 0)
+    place(model.model.norm.weight, None, 0)
+    return model

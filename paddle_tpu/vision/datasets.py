@@ -1,0 +1,113 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: datasets load from local files when present
+(standard binary formats) and raise a clear error otherwise; ``FakeData``
+provides deterministic synthetic data for benchmarks/tests (the reference's
+test suites use the same trick via numpy fixtures).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, size=1000, image_shape=(3, 32, 32), num_classes=10,
+                 transform: Optional[Callable] = None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.RandomState(seed)
+        self._images = self._rng.rand(size, *self.image_shape).astype("float32")
+        self._labels = self._rng.randint(0, num_classes, size).astype("int64")
+
+    def __getitem__(self, idx):
+        img = self._images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._labels[idx]
+
+    def __len__(self):
+        return self.size
+
+
+class Cifar10(Dataset):
+    """reference: paddle.vision.datasets.Cifar10 — reads the standard
+    cifar-10-python.tar.gz / extracted batches from ``data_file``."""
+
+    def __init__(self, data_file: Optional[str] = None, mode="train",
+                 transform=None, download=False, backend="cv2"):
+        self.transform = transform
+        self.mode = mode
+        data_file = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/cifar-10-python.tar.gz")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"CIFAR-10 archive not found at {data_file}; this "
+                f"environment has no network egress — provide the standard "
+                f"cifar-10-python.tar.gz locally, or use "
+                f"paddle_tpu.vision.datasets.FakeData for synthetic runs.")
+        names = [f"data_batch_{i}" for i in range(1, 6)] if mode == "train" \
+            else ["test_batch"]
+        images, labels = [], []
+        with tarfile.open(data_file) as tar:
+            for member in tar.getmembers():
+                base = os.path.basename(member.name)
+                if base in names:
+                    batch = pickle.load(tar.extractfile(member),
+                                        encoding="bytes")
+                    images.append(batch[b"data"])
+                    labels.extend(batch[b"labels"])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, dtype="int64")
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype("float32") / 255.0
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class MNIST(Dataset):
+    """reference: paddle.vision.datasets.MNIST — reads idx-format files."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        if image_path is None or not os.path.exists(image_path):
+            raise FileNotFoundError(
+                "MNIST idx files not found; provide image_path/label_path "
+                "locally (no network egress) or use FakeData.")
+        import gzip
+        opener = gzip.open if image_path.endswith(".gz") else open
+        with opener(image_path, "rb") as f:
+            data = f.read()
+        self.images = np.frombuffer(data, dtype=np.uint8,
+                                    offset=16).reshape(-1, 28, 28)
+        with opener(label_path, "rb") as f:
+            data = f.read()
+        self.labels = np.frombuffer(data, dtype=np.uint8,
+                                    offset=8).astype("int64")
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype("float32")[None] / 255.0
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)

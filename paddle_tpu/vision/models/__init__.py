@@ -1,0 +1,4 @@
+from .resnet import (  # noqa: F401
+    ResNet, BasicBlock, BottleneckBlock, resnet18, resnet34, resnet50,
+    resnet101, resnet152, wide_resnet50_2,
+)
