@@ -1,0 +1,95 @@
+"""Headline benchmark: LLaMA decoder pretrain step, tokens/sec on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no absolute numbers (BASELINE.md) — ``vs_baseline``
+compares against an A100-class per-chip figure for a ~110M-param decoder
+(bf16, flash-attn, fused optimizer): ~6.0e4 tokens/sec is a strong reference
+point for this size class; >1.0 means we beat it.
+"""
+import json
+import time
+
+import numpy as np
+
+A100_CLASS_TOKENS_PER_SEC = 6.0e4  # measured-elsewhere reference point
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=768, intermediate_size=2048,
+            num_hidden_layers=12, num_attention_heads=12,
+            max_position_embeddings=2048, dtype="bfloat16")
+        batch, seq, steps = 8, 1024, 20
+    else:  # CPU smoke path so the script always works
+        cfg = LlamaConfig(
+            vocab_size=1024, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=256)
+        batch, seq, steps = 2, 128, 3
+
+    model = LlamaForCausalLM(cfg)
+    params = model.parameters()
+    param_arrays = [p._data for p in params]
+    if on_tpu:
+        param_arrays = [a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a
+                        for a in param_arrays]
+
+    from paddle_tpu.framework.tape import no_grad
+    from paddle_tpu.framework.tensor import wrap_array
+
+    def loss_fn(arrs, ids, labels):
+        saved = [p._data for p in params]
+        try:
+            for p, a in zip(params, arrs):
+                p._data = a
+            with no_grad():
+                logits = model(wrap_array(ids))._data
+        finally:
+            for p, s in zip(params, saved):
+                p._data = s
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return nll.mean()
+
+    @jax.jit
+    def train_step(arrs, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(arrs, ids, labels)
+        new = [p - (1e-3 * g).astype(p.dtype) for p, g in zip(arrs, grads)]
+        return loss, new
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype("int32")
+    x, y = jnp.asarray(ids[:, :-1]), jnp.asarray(ids[:, 1:])
+
+    # warmup/compile
+    loss, param_arrays = train_step(param_arrays, x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, param_arrays = train_step(param_arrays, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    toks_per_sec = batch * seq * steps / dt
+    vs = toks_per_sec / A100_CLASS_TOKENS_PER_SEC if on_tpu else 0.0
+    print(json.dumps({
+        "metric": "llama_110m_pretrain_tokens_per_sec_per_chip",
+        "value": round(toks_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

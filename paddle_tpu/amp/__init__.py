@@ -48,6 +48,7 @@ class _AmpState(threading.local):
         self.level = "O1"
         self.white = WHITE_LIST
         self.black = BLACK_LIST
+        self.in_hook = False  # reentrancy guard: casts dispatch ops too
 
 
 _state = _AmpState()
@@ -72,11 +73,19 @@ def _cast_up(obj):
 
 
 def _amp_hook(op_name, args, kwargs):
-    if not _state.enabled:
+    if not _state.enabled or _state.in_hook:
         return args, kwargs
     level = _state.level
     if level == "O0":
         return args, kwargs
+    _state.in_hook = True
+    try:
+        return _amp_hook_inner(op_name, args, kwargs, level)
+    finally:
+        _state.in_hook = False
+
+
+def _amp_hook_inner(op_name, args, kwargs, level):
     if op_name in _state.black:
         return (tuple(_cast_up(a) for a in args),
                 {k: _cast_up(v) for k, v in kwargs.items()})
@@ -166,6 +175,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def scale(self, loss):
         if not self._enable:
@@ -173,8 +183,9 @@ class GradScaler:
         return loss * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or self._unscaled:
             return
+        self._unscaled = True
         inv = 1.0 / self._scale
         found = False
         with no_grad():
@@ -193,13 +204,14 @@ class GradScaler:
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
+        self._unscaled = False
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:
@@ -215,6 +227,7 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def is_enable(self):
         return self._enable

@@ -307,6 +307,7 @@ class PipelineParallel(Layer):
         if scaler is not None:
             scaler.scale(loss).backward()
             scaler.step(optimizer)
+            scaler.update()
         else:
             loss.backward()
             optimizer.step()

@@ -273,6 +273,16 @@ class Tensor:
     def is_dist(self) -> bool:
         return self.dist_attr is not None
 
+    @property
+    def placements(self):
+        """reference: DistTensor.placements (dist_tensor.h:39)."""
+        return None if self.dist_attr is None else self.dist_attr.placements
+
+    @property
+    def process_mesh(self):
+        """reference: DistTensor.process_mesh."""
+        return None if self.dist_attr is None else self.dist_attr.process_mesh
+
 
 class Parameter(Tensor):
     """Trainable tensor (reference: python/paddle/base/framework.py Parameter /

@@ -1,0 +1,30 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's multi-process localhost strategy (SURVEY §4.4) the
+TPU-native way: instead of spawning one process per rank with env-var
+rendezvous, we give XLA 8 host devices and exercise the same SPMD code paths
+(shard_map/pjit/collectives) in-process.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    yield

@@ -1,0 +1,153 @@
+"""AMP (auto_cast/GradScaler, bf16-first as TPU-native) and jit/to_static
+(trace-based capture, cache, save/load). Reference: python/paddle/amp/,
+python/paddle/jit/."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu import amp, jit
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestAmp:
+    def test_autocast_casts_matmul(self):
+        a = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            y = paddle.matmul(a, a)
+        assert "bfloat16" in str(y.dtype)
+
+    def test_autocast_off_keeps_fp32(self):
+        a = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        with amp.auto_cast(enable=False):
+            y = paddle.matmul(a, a)
+        assert "float32" in str(y.dtype)
+
+    def test_o2_decorate(self):
+        net = nn.Linear(4, 4)
+        opt = optim.Adam(learning_rate=0.01, parameters=net.parameters())
+        net, opt = amp.decorate(net, opt, level="O2", dtype="bfloat16")
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            y = net(x)
+        assert "bfloat16" in str(y.dtype)
+
+    def test_grad_scaler_scales_and_unscales(self):
+        net = nn.Linear(4, 1)
+        opt = optim.SGD(learning_rate=0.01, parameters=net.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=128.0)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+        loss = net(x).mean()
+        scaled = scaler.scale(loss)
+        assert abs(float(_np(scaled)) - 128.0 * float(_np(loss))) < 1e-3
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        assert np.isfinite(_np(net.weight)).all()
+
+    def test_grad_scaler_skips_on_inf(self):
+        w = paddle.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+        opt = optim.SGD(learning_rate=1.0, parameters=[w])
+        scaler = amp.GradScaler(init_loss_scaling=2.0**15)
+        huge = paddle.to_tensor(np.array([1e38, 1e38], "float32"))
+        loss = (w * huge).sum()
+        scaler.scale(loss).backward()  # scaled grad overflows fp32 -> inf
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(_np(w), [1.0, 1.0])  # step skipped
+        assert scaler.state_dict()["scale"] < 2.0**15  # backoff
+
+
+class TestToStatic:
+    def test_matches_eager(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+        eager = _np(net(x))
+        snet = jit.to_static(net)
+        np.testing.assert_allclose(_np(snet(x)), eager, rtol=1e-5)
+
+    def test_function_decorator(self):
+        @jit.to_static
+        def f(a, b):
+            return paddle.matmul(a, b) + 1.0
+
+        a = paddle.to_tensor(np.random.randn(2, 3).astype("float32"))
+        b = paddle.to_tensor(np.random.randn(3, 2).astype("float32"))
+        np.testing.assert_allclose(_np(f(a, b)), _np(a) @ _np(b) + 1, rtol=1e-5)
+
+    def test_grad_through_static(self):
+        net = nn.Linear(4, 1)
+        snet = jit.to_static(net)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        snet(x).sum().backward()
+        assert net.weight.grad is not None
+        np.testing.assert_allclose(_np(net.weight.grad), _np(x).sum(0)[:, None], rtol=1e-5)
+
+    def test_python_control_flow_at_trace(self):
+        @jit.to_static
+        def f(x, flag=True):
+            if flag:  # evaluated at trace time
+                return x * 2
+            return x * 3
+
+        x = paddle.to_tensor([1.0])
+        np.testing.assert_allclose(_np(f(x)), [2.0])
+
+    def test_retrace_on_shape_change(self):
+        calls = []
+
+        @jit.to_static
+        def f(x):
+            calls.append(1)
+            return x + 1
+
+        f(paddle.ones([2]))
+        f(paddle.ones([2]))  # cached: no retrace
+        f(paddle.ones([3]))  # new shape: retrace
+        assert len(calls) == 2
+
+    def test_training_loop_under_jit(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        snet = jit.to_static(net)
+        opt = optim.Adam(learning_rate=0.05, parameters=net.parameters())
+        x = paddle.to_tensor(np.random.randn(16, 4).astype("float32"))
+        y = paddle.to_tensor(np.random.randn(16, 1).astype("float32"))
+        losses = []
+        for _ in range(20):
+            loss = ((snet(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(_np(loss)))
+        assert losses[-1] < losses[0] * 0.5
+
+
+class TestJitSaveLoad:
+    def test_save_load_roundtrip(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        ref = _np(net(x))
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "model")
+            jit.save(net, path, input_spec=[jit.InputSpec([2, 4], "float32")])
+            loaded = jit.load(path)
+            np.testing.assert_allclose(_np(loaded(x)), ref, rtol=1e-5)
+
+
+class TestFrameworkIO:
+    def test_paddle_save_load_state_dict(self):
+        net = nn.Linear(4, 4)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "net.pdparams")
+            paddle.save(net.state_dict(), p)
+            sd = paddle.load(p)
+        net2 = nn.Linear(4, 4)
+        net2.set_state_dict(sd)
+        np.testing.assert_allclose(_np(net.weight), _np(net2.weight))

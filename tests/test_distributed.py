@@ -1,0 +1,291 @@
+"""Distributed: mesh/placement/shard/reshard (auto-parallel), eager
+collectives, TP layers (numeric parity vs dense single-device compute),
+DataParallel, ZeRO sharding, pipeline, ring attention. Runs on the 8-device
+virtual CPU mesh — the TPU-native analog of the reference's multi-process
+localhost tests (SURVEY §4.4)."""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import Shard, Replicate, Partial, ProcessMesh
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestMeshPlacement:
+    def test_mesh_basics(self):
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+        assert mesh.shape == [2, 4]
+        assert mesh.ndim == 2
+        assert mesh.dim_names == ["dp", "mp"]
+        assert len(mesh.process_ids) == 8
+        assert mesh.get_dim_size("mp") == 4
+
+    def test_placement_types(self):
+        assert Shard(0).is_shard()
+        assert not Shard(0).is_replicated()
+        assert Replicate().is_replicated()
+        assert Partial().is_partial()
+        assert Shard(1).get_dim() == 1
+
+
+class TestShardReshard:
+    def test_shard_tensor_placement(self):
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+        a = np.arange(32, dtype="float32").reshape(8, 4)
+        t = dist.shard_tensor(paddle.to_tensor(a), mesh, [Shard(0), Replicate()])
+        np.testing.assert_allclose(_np(t), a)  # value-preserving
+        assert t.placements is not None
+
+    def test_reshard_s_to_r(self):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        a = np.arange(16, dtype="float32").reshape(8, 2)
+        t = dist.shard_tensor(paddle.to_tensor(a), mesh, [Shard(0)])
+        r = dist.reshard(t, mesh, [Replicate()])
+        np.testing.assert_allclose(_np(r), a)
+
+    def test_reshard_r_to_s(self):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        a = np.arange(16, dtype="float32").reshape(8, 2)
+        t = dist.shard_tensor(paddle.to_tensor(a), mesh, [Replicate()])
+        s = dist.reshard(t, mesh, [Shard(0)])
+        np.testing.assert_allclose(_np(s), a)
+
+    def test_reshard_s_to_s(self):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        a = np.arange(64, dtype="float32").reshape(8, 8)
+        t = dist.shard_tensor(paddle.to_tensor(a), mesh, [Shard(0)])
+        s = dist.reshard(t, mesh, [Shard(1)])
+        np.testing.assert_allclose(_np(s), a)
+
+    def test_computation_on_sharded(self):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        a = np.random.randn(8, 16).astype("float32")
+        b = np.random.randn(16, 8).astype("float32")
+        ta = dist.shard_tensor(paddle.to_tensor(a), mesh, [Shard(0)])
+        tb = dist.shard_tensor(paddle.to_tensor(b), mesh, [Replicate()])
+        np.testing.assert_allclose(_np(paddle.matmul(ta, tb)), a @ b, rtol=1e-5)
+
+    def test_shard_layer(self):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        net = nn.Linear(8, 8)
+        dist.shard_layer(net, mesh, shard_fn=None)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        assert net(x).shape == [4, 8]
+
+    def test_unshard(self):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        a = np.random.randn(8, 2).astype("float32")
+        t = dist.shard_tensor(paddle.to_tensor(a), mesh, [Shard(0)])
+        u = dist.unshard_dtensor(t)
+        np.testing.assert_allclose(_np(u), a)
+
+
+class TestEagerCollectives:
+    def test_all_reduce(self):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        g = dist.new_group(mesh=mesh, axis="x")
+        a = np.ones((8, 4), dtype="float32")
+        t = dist.shard_tensor(paddle.to_tensor(a), mesh, [Shard(0)])
+        dist.all_reduce(t, group=g)
+        # each shard row summed over 8 ranks -> all 8s
+        np.testing.assert_allclose(_np(t), np.full((8, 4), 8.0))
+
+    def test_all_gather(self):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        g = dist.new_group(mesh=mesh, axis="x")
+        a = np.arange(8, dtype="float32").reshape(8, 1)
+        t = dist.shard_tensor(paddle.to_tensor(a), mesh, [Shard(0)])
+        out = []
+        dist.all_gather(out, t, group=g)
+        assert len(out) == 8
+
+    def test_broadcast_object(self):
+        lst = [{"a": 1}]
+        dist.broadcast_object_list(lst, src=0)
+        assert lst[0] == {"a": 1}
+
+    def test_get_rank_world_size(self):
+        assert dist.get_rank() == 0
+        assert dist.get_world_size() >= 1
+
+
+class TestTensorParallelLayers:
+    def test_column_parallel_linear_parity(self):
+        from paddle_tpu.distributed.fleet.mp_layers import ColumnParallelLinear
+
+        mesh = ProcessMesh(np.arange(8), dim_names=["mp"])
+        dist.set_mesh(mesh)
+        try:
+            layer = ColumnParallelLinear(16, 32, mesh=mesh)
+            x = paddle.to_tensor(np.random.randn(4, 16).astype("float32"))
+            y = layer(x)
+            ref = _np(x) @ _np(layer.weight)
+            if layer.bias is not None:
+                ref = ref + _np(layer.bias)
+            np.testing.assert_allclose(_np(y), ref, rtol=1e-4)
+        finally:
+            dist.set_mesh(None)
+
+    def test_row_parallel_linear_parity(self):
+        from paddle_tpu.distributed.fleet.mp_layers import RowParallelLinear
+
+        mesh = ProcessMesh(np.arange(8), dim_names=["mp"])
+        dist.set_mesh(mesh)
+        try:
+            layer = RowParallelLinear(32, 16, mesh=mesh)
+            x = paddle.to_tensor(np.random.randn(4, 32).astype("float32"))
+            y = layer(x)
+            ref = _np(x) @ _np(layer.weight)
+            if layer.bias is not None:
+                ref = ref + _np(layer.bias)
+            np.testing.assert_allclose(_np(y), ref, rtol=1e-4)
+        finally:
+            dist.set_mesh(None)
+
+    def test_vocab_parallel_embedding_parity(self):
+        from paddle_tpu.distributed.fleet.mp_layers import VocabParallelEmbedding
+
+        mesh = ProcessMesh(np.arange(8), dim_names=["mp"])
+        dist.set_mesh(mesh)
+        try:
+            emb = VocabParallelEmbedding(64, 16, mesh=mesh)
+            ids = paddle.to_tensor(np.random.randint(0, 64, (4, 6)).astype("int64"))
+            y = emb(ids)
+            ref = _np(emb.weight)[_np(ids)]
+            np.testing.assert_allclose(_np(y), ref, rtol=1e-5)
+        finally:
+            dist.set_mesh(None)
+
+    def test_grads_flow_through_tp(self):
+        from paddle_tpu.distributed.fleet.mp_layers import ColumnParallelLinear
+
+        mesh = ProcessMesh(np.arange(8), dim_names=["mp"])
+        dist.set_mesh(mesh)
+        try:
+            layer = ColumnParallelLinear(8, 16, mesh=mesh)
+            x = paddle.to_tensor(np.random.randn(2, 8).astype("float32"))
+            layer(x).sum().backward()
+            assert layer.weight.grad is not None
+        finally:
+            dist.set_mesh(None)
+
+
+class TestDataParallel:
+    def test_wrap_and_train(self):
+        net = nn.Linear(4, 2)
+        dp = dist.DataParallel(net)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+        y = dp(x)
+        assert y.shape == [8, 2]
+        y.sum().backward()
+        assert net.weight.grad is not None
+
+    def test_matches_single_device(self):
+        net = nn.Linear(4, 2)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+        ref = _np(net(x))
+        dp = dist.DataParallel(net)
+        np.testing.assert_allclose(_np(dp(x)), ref, rtol=1e-5)
+
+
+class TestFleetTopology:
+    def test_hybrid_communicate_group(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_model_parallel_world_size() == 2
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_pipe_parallel_world_size() == 2
+
+
+class TestSharding:
+    def test_group_sharded_wraps(self):
+        net = nn.Linear(8, 8)
+        import paddle_tpu.optimizer as optim
+
+        opt = optim.AdamW(learning_rate=0.01, parameters=net.parameters())
+        model, opt2, _ = dist.group_sharded_parallel(net, opt, level="os_g")
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        model(x).sum().backward()
+        opt2.step()
+        assert np.isfinite(_np(net.weight)).all()
+
+
+class TestRingAttention:
+    def test_parity_vs_dense(self):
+        from paddle_tpu.ops.ring_attention import ring_attention
+
+        mesh = ProcessMesh(np.arange(8), dim_names=["sep"])
+        b, s, h, d = 1, 64, 2, 16
+        q = np.random.randn(b, s, h, d).astype("float32") * 0.3
+        tq = paddle.to_tensor(q)
+        out = ring_attention(tq, tq, tq, mesh, causal=False)
+        # dense reference
+        qt = q.transpose(0, 2, 1, 3)
+        sc = qt @ qt.transpose(0, 1, 3, 2) / np.sqrt(d)
+        e = np.exp(sc - sc.max(-1, keepdims=True))
+        ref = (e / e.sum(-1, keepdims=True)) @ qt
+        o = out[0] if isinstance(out, tuple) else out
+        np.testing.assert_allclose(_np(o), ref.transpose(0, 2, 1, 3), atol=2e-2)
+
+    def test_causal_parity(self):
+        from paddle_tpu.ops.ring_attention import ring_attention
+
+        mesh = ProcessMesh(np.arange(8), dim_names=["sep"])
+        b, s, h, d = 1, 64, 2, 16
+        q = np.random.randn(b, s, h, d).astype("float32") * 0.3
+        tq = paddle.to_tensor(q)
+        out = ring_attention(tq, tq, tq, mesh, causal=True)
+        qt = q.transpose(0, 2, 1, 3)
+        sc = qt @ qt.transpose(0, 1, 3, 2) / np.sqrt(d)
+        mask = np.tril(np.ones((s, s), bool))
+        sc = np.where(mask, sc, -1e30)
+        e = np.exp(sc - sc.max(-1, keepdims=True))
+        ref = (e / e.sum(-1, keepdims=True)) @ qt
+        o = out[0] if isinstance(out, tuple) else out
+        np.testing.assert_allclose(_np(o), ref.transpose(0, 2, 1, 3), atol=2e-2)
+
+
+class TestPipeline:
+    def test_pipeline_stack_matches_sequential(self):
+        from paddle_tpu.distributed.fleet.pipeline_parallel import (
+            LayerDesc,
+            PipelineLayer,
+        )
+
+        descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(4)]
+        pp = PipelineLayer(layers=descs, num_stages=2)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        y = pp(x)
+        assert y.shape == [4, 8]
+
+    def test_recompute(self):
+        from paddle_tpu.distributed.fleet.recompute import recompute
+
+        layer = nn.Linear(8, 8)
+        x = paddle.to_tensor(np.random.randn(2, 8).astype("float32"), stop_gradient=False)
+        y = recompute(layer, x)
+        y.sum().backward()
+        assert layer.weight.grad is not None
+
+
+class TestDistCheckpoint:
+    def test_sharded_save_load(self, tmp_path):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        a = np.random.randn(8, 4).astype("float32")
+        t = dist.shard_tensor(paddle.to_tensor(a), mesh, [Shard(0)])
+        sd = {"w": t}
+        dist.checkpoint.save_state_dict(sd, str(tmp_path))
+        # load into a replicated target (topology change: S(0) -> R)
+        target = {"w": dist.shard_tensor(paddle.to_tensor(np.zeros_like(a)), mesh, [Replicate()])}
+        dist.checkpoint.load_state_dict(target, str(tmp_path))
+        np.testing.assert_allclose(_np(target["w"]), a)

@@ -1,0 +1,234 @@
+"""Model families (LLaMA/BERT/ResNet), DataLoader/datasets, metrics, hapi
+Model.fit — the end-to-end user surface (reference: python/paddle/vision,
+hapi/model.py, python/paddle/io)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu import io
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestLlama:
+    def _cfg(self):
+        from paddle_tpu.models.llama import LlamaConfig
+
+        return LlamaConfig(
+            vocab_size=128,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            max_position_embeddings=64,
+        )
+
+    def test_forward_shape(self):
+        from paddle_tpu.models.llama import LlamaForCausalLM
+
+        m = LlamaForCausalLM(self._cfg())
+        ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype("int32"))
+        logits = m(ids)
+        assert logits.shape == [2, 16, 128]
+
+    def test_train_step_reduces_loss(self):
+        from paddle_tpu.models.llama import LlamaForCausalLM
+
+        m = LlamaForCausalLM(self._cfg())
+        opt = optim.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        ids = paddle.to_tensor(np.random.randint(0, 128, (2, 17)).astype("int32"))
+        x, y = ids[:, :-1], ids[:, 1:]
+        ce = nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(5):
+            logits = m(x)
+            loss = ce(logits.reshape([-1, 128]), y.reshape([-1]).astype("int64"))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(_np(loss)))
+        assert losses[-1] < losses[0]
+
+    def test_gqa_heads(self):
+        # GQA: kv heads < q heads must still produce correct shapes
+        from paddle_tpu.models.llama import LlamaForCausalLM
+
+        m = LlamaForCausalLM(self._cfg())
+        ids = paddle.to_tensor(np.random.randint(0, 128, (1, 8)).astype("int32"))
+        assert m(ids).shape == [1, 8, 128]
+
+
+class TestBert:
+    def test_sequence_classification(self):
+        from paddle_tpu.models.bert import BertConfig, BertForSequenceClassification
+
+        cfg = BertConfig(
+            vocab_size=100, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=64, num_labels=3,
+        )
+        m = BertForSequenceClassification(cfg)
+        ids = paddle.to_tensor(np.random.randint(0, 100, (2, 10)).astype("int64"))
+        out = m(ids)
+        assert out.shape == [2, 3]
+
+    def test_masked_lm(self):
+        from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+
+        cfg = BertConfig(
+            vocab_size=100, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=64,
+        )
+        m = BertForMaskedLM(cfg)
+        ids = paddle.to_tensor(np.random.randint(0, 100, (2, 10)).astype("int64"))
+        assert m(ids).shape == [2, 10, 100]
+
+
+class TestResNet:
+    def test_resnet18_forward_backward(self):
+        from paddle_tpu.vision.models import resnet18
+
+        m = resnet18(num_classes=10)
+        x = paddle.to_tensor(np.random.randn(2, 3, 32, 32).astype("float32"))
+        out = m(x)
+        assert out.shape == [2, 10]
+        out.sum().backward()
+        grads = [p.grad for p in m.parameters() if p.grad is not None]
+        assert len(grads) > 10
+
+    def test_resnet50_bottleneck(self):
+        from paddle_tpu.vision.models import resnet50
+
+        m = resnet50(num_classes=5)
+        x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype("float32"))
+        assert m(x).shape == [1, 5]
+
+
+class TestDataLoader:
+    def test_tensor_dataset_loader(self):
+        xs = np.random.randn(20, 4).astype("float32")
+        ys = np.random.randint(0, 2, (20, 1)).astype("int64")
+        ds = io.TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+        loader = io.DataLoader(ds, batch_size=8, shuffle=False, drop_last=False)
+        batches = list(loader)
+        assert len(batches) == 3
+        xb, yb = batches[0]
+        assert xb.shape == [8, 4]
+
+    def test_custom_dataset(self):
+        class Sq(io.Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.float32(i), np.float32(i * i)
+
+        loader = io.DataLoader(Sq(), batch_size=5, shuffle=False)
+        xb, yb = next(iter(loader))
+        np.testing.assert_allclose(_np(yb), _np(xb) ** 2)
+
+    def test_shuffle_covers_all(self):
+        class Ids(io.Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return np.int64(i)
+
+        loader = io.DataLoader(Ids(), batch_size=4, shuffle=True)
+        seen = sorted(int(v) for b in loader for v in _np(b))
+        assert seen == list(range(16))
+
+    def test_batch_sampler_and_drop_last(self):
+        class Ids(io.Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.int64(i)
+
+        loader = io.DataLoader(Ids(), batch_size=4, drop_last=True)
+        assert len(list(loader)) == 2
+
+    def test_distributed_batch_sampler(self):
+        class Ids(io.Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return np.int64(i)
+
+        bs = io.DistributedBatchSampler(Ids(), batch_size=4, num_replicas=2, rank=0)
+        idxs = [i for batch in bs for i in batch]
+        assert len(idxs) == 8  # half the data on rank 0
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        from paddle_tpu.metric import Accuracy
+
+        acc = Accuracy()
+        pred = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]], "float32"))
+        label = paddle.to_tensor(np.array([[0], [1]], "int64"))
+        corr = acc.compute(pred, label)
+        acc.update(corr)
+        assert acc.accumulate() == 1.0
+
+    def test_precision_recall(self):
+        from paddle_tpu.metric import Precision, Recall
+
+        p, r = Precision(), Recall()
+        pred = paddle.to_tensor(np.array([0.9, 0.2, 0.8, 0.1], "float32"))
+        label = paddle.to_tensor(np.array([1, 0, 1, 1], "int64"))
+        p.update(pred, label)
+        r.update(pred, label)
+        assert p.accumulate() == 1.0
+        assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+
+class TestHapiModel:
+    def test_fit_evaluate_predict(self):
+        xs = np.random.randn(32, 4).astype("float32")
+        ys = (xs.sum(1, keepdims=True) > 0).astype("int64")
+        ds = io.TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+        model = paddle.Model(net)
+        from paddle_tpu.metric import Accuracy
+
+        model.prepare(
+            optimizer=optim.Adam(learning_rate=0.05, parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=Accuracy(),
+        )
+        model.fit(ds, batch_size=8, epochs=2, verbose=0)
+        res = model.evaluate(ds, batch_size=8, verbose=0)
+        assert "loss" in res
+        preds = model.predict(ds, batch_size=8, verbose=0)
+        assert preds is not None
+
+
+class TestVisionTransforms:
+    def test_compose_pipeline(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = (np.random.rand(32, 32, 3) * 255).astype("uint8")
+        tf = T.Compose([T.Resize(24), T.CenterCrop(16), T.ToTensor()])
+        out = tf(img)
+        arr = _np(out) if hasattr(out, "numpy") else np.asarray(out)
+        assert arr.shape == (3, 16, 16)
+        assert arr.max() <= 1.0 + 1e-6
+
+    def test_normalize(self):
+        from paddle_tpu.vision import transforms as T
+
+        x = np.ones((3, 4, 4), dtype="float32")
+        out = T.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])(x)
+        arr = _np(out) if hasattr(out, "numpy") else np.asarray(out)
+        np.testing.assert_allclose(arr, np.ones_like(arr))

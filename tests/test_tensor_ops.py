@@ -1,0 +1,274 @@
+"""Numpy-golden op tests — the TPU analog of the reference OpTest harness
+(test/legacy_test/op_test.py:418): declare inputs, compare against numpy."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.shape == [2, 2]
+        np.testing.assert_allclose(_np(x), [[1, 2], [3, 4]])
+
+    def test_zeros_ones_full(self):
+        assert _np(paddle.zeros([2, 3])).sum() == 0
+        assert _np(paddle.ones([2, 3])).sum() == 6
+        np.testing.assert_allclose(_np(paddle.full([2], 7.5)), [7.5, 7.5])
+
+    def test_arange_linspace(self):
+        np.testing.assert_allclose(_np(paddle.arange(5)), np.arange(5))
+        np.testing.assert_allclose(
+            _np(paddle.linspace(0, 1, 5)), np.linspace(0, 1, 5), rtol=1e-6
+        )
+
+    def test_eye_diag(self):
+        np.testing.assert_allclose(_np(paddle.eye(3)), np.eye(3))
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        assert _np(paddle.diag(x)).shape == (3, 3)
+
+    def test_like_family(self):
+        x = paddle.ones([2, 2])
+        assert _np(paddle.zeros_like(x)).sum() == 0
+        assert _np(paddle.ones_like(x)).sum() == 4
+        assert _np(paddle.full_like(x, 3)).sum() == 12
+
+    def test_rand_shapes(self):
+        assert paddle.rand([4, 5]).shape == [4, 5]
+        assert paddle.randn([4, 5]).shape == [4, 5]
+        r = _np(paddle.randint(0, 10, [100]))
+        assert r.min() >= 0 and r.max() < 10
+
+
+class TestMath:
+    def test_elementwise_binary(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(3, 4).astype("float32") + 2.0
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_allclose(_np(paddle.add(ta, tb)), a + b, rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.subtract(ta, tb)), a - b, rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.multiply(ta, tb)), a * b, rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.divide(ta, tb)), a / b, rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.maximum(ta, tb)), np.maximum(a, b))
+        np.testing.assert_allclose(_np(paddle.pow(tb, 2.0)), b**2, rtol=1e-5)
+
+    def test_operator_overloads(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 4.0])
+        np.testing.assert_allclose(_np(a + b), [4, 6])
+        np.testing.assert_allclose(_np(a - b), [-2, -2])
+        np.testing.assert_allclose(_np(a * b), [3, 8])
+        np.testing.assert_allclose(_np(b / a), [3, 2])
+        np.testing.assert_allclose(_np(2 + a), [3, 4])
+        np.testing.assert_allclose(_np(a**2), [1, 4])
+        np.testing.assert_allclose(_np(-a), [-1, -2])
+
+    def test_unary(self):
+        a = np.random.rand(3, 4).astype("float32") + 0.1
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.exp(t)), np.exp(a), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.log(t)), np.log(a), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.sqrt(t)), np.sqrt(a), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.abs(-t)), a, rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.tanh(t)), np.tanh(a), rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.floor(t)), np.floor(a))
+        np.testing.assert_allclose(_np(paddle.round(t)), np.round(a))
+
+    def test_reductions(self):
+        a = np.random.randn(3, 4, 5).astype("float32")
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.sum(t)), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.sum(t, axis=1)), a.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.mean(t, axis=[0, 2])), a.mean((0, 2)), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.max(t, axis=0)), a.max(0))
+        np.testing.assert_allclose(_np(paddle.min(t)), a.min())
+        np.testing.assert_allclose(_np(paddle.prod(paddle.to_tensor([2.0, 3.0]))), 6.0)
+        keep = paddle.sum(t, axis=1, keepdim=True)
+        assert keep.shape == [3, 1, 5]
+
+    def test_cumsum_cumprod(self):
+        a = np.random.randn(3, 4).astype("float32")
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.cumsum(t, axis=1)), a.cumsum(1), rtol=1e-5)
+
+    def test_clip_trunc(self):
+        a = np.array([-2.0, -0.5, 0.5, 2.0], dtype="float32")
+        np.testing.assert_allclose(_np(paddle.clip(paddle.to_tensor(a), -1, 1)), np.clip(a, -1, 1))
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(4, 5).astype("float32")
+        np.testing.assert_allclose(
+            _np(paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))), a @ b, rtol=1e-5
+        )
+
+    def test_matmul_batched_transpose(self):
+        a = np.random.randn(2, 3, 4).astype("float32")
+        b = np.random.randn(2, 3, 5).astype("float32")
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b), transpose_x=True)
+        np.testing.assert_allclose(_np(out), np.einsum("bij,bik->bjk", a, b), rtol=1e-5)
+
+    def test_norm_dot(self):
+        a = np.random.randn(4).astype("float32")
+        np.testing.assert_allclose(_np(paddle.linalg.norm(paddle.to_tensor(a))),
+                                   np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(paddle.dot(paddle.to_tensor(a), paddle.to_tensor(a))), a @ a, rtol=1e-5
+        )
+
+    def test_svd_solve(self):
+        a = np.random.randn(4, 4).astype("float32") + 4 * np.eye(4, dtype="float32")
+        b = np.random.randn(4, 2).astype("float32")
+        x = _np(paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b)))
+        np.testing.assert_allclose(a @ x, b, atol=1e-3)
+
+    def test_einsum(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(4, 5).astype("float32")
+        np.testing.assert_allclose(
+            _np(paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))),
+            a @ b, rtol=1e-5,
+        )
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(24, dtype="float32").reshape(2, 3, 4)
+        t = paddle.to_tensor(a)
+        assert paddle.reshape(t, [6, 4]).shape == [6, 4]
+        assert paddle.reshape(t, [-1]).shape == [24]
+        np.testing.assert_allclose(
+            _np(paddle.transpose(t, [2, 0, 1])), a.transpose(2, 0, 1)
+        )
+
+    def test_concat_split_stack(self):
+        a = np.random.randn(2, 3).astype("float32")
+        t = paddle.to_tensor(a)
+        c = paddle.concat([t, t], axis=0)
+        assert c.shape == [4, 3]
+        s = paddle.split(c, 2, axis=0)
+        assert len(s) == 2 and s[0].shape == [2, 3]
+        st = paddle.stack([t, t], axis=0)
+        assert st.shape == [2, 2, 3]
+        u = paddle.unstack(st, axis=0)
+        assert len(u) == 2
+
+    def test_squeeze_expand(self):
+        t = paddle.ones([1, 3, 1])
+        assert paddle.squeeze(t).shape == [3]
+        assert paddle.unsqueeze(t, 0).shape == [1, 1, 3, 1]
+        assert paddle.expand(paddle.ones([1, 3]), [4, 3]).shape == [4, 3]
+        assert paddle.tile(paddle.ones([2]), [3]).shape == [6]
+
+    def test_slice_index(self):
+        a = np.arange(24, dtype="float32").reshape(4, 6)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(t[1:3, 2:4]), a[1:3, 2:4])
+        np.testing.assert_allclose(_np(t[0]), a[0])
+        np.testing.assert_allclose(_np(t[:, -1]), a[:, -1])
+        idx = paddle.to_tensor(np.array([0, 2], dtype="int64"))
+        np.testing.assert_allclose(_np(paddle.index_select(t, idx, axis=0)), a[[0, 2]])
+
+    def test_gather_scatter(self):
+        a = np.arange(12, dtype="float32").reshape(4, 3)
+        idx = np.array([0, 2], dtype="int64")
+        out = paddle.gather(paddle.to_tensor(a), paddle.to_tensor(idx), axis=0)
+        np.testing.assert_allclose(_np(out), a[idx])
+
+    def test_flip_roll_flatten(self):
+        a = np.arange(6, dtype="float32").reshape(2, 3)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.flip(t, axis=[1])), a[:, ::-1])
+        np.testing.assert_allclose(_np(paddle.roll(t, 1, axis=1)), np.roll(a, 1, 1))
+        assert paddle.flatten(t).shape == [6]
+
+    def test_where_masked(self):
+        a = np.random.randn(3, 4).astype("float32")
+        t = paddle.to_tensor(a)
+        out = paddle.where(t > 0, t, paddle.zeros_like(t))
+        np.testing.assert_allclose(_np(out), np.where(a > 0, a, 0))
+
+    def test_pad_cast(self):
+        t = paddle.ones([2, 2])
+        p = paddle.nn.functional.pad(t, [1, 1, 1, 1])
+        assert p.shape == [4, 4]
+        c = paddle.cast(t, "int32")
+        assert "int32" in str(c.dtype)
+
+
+class TestLogicSearch:
+    def test_comparisons(self):
+        a = paddle.to_tensor([1.0, 2.0, 3.0])
+        b = paddle.to_tensor([2.0, 2.0, 2.0])
+        np.testing.assert_array_equal(_np(a < b), [True, False, False])
+        np.testing.assert_array_equal(_np(a == b), [False, True, False])
+        np.testing.assert_array_equal(_np(paddle.greater_than(a, b)), [False, False, True])
+
+    def test_all_any_logical(self):
+        t = paddle.to_tensor([True, False, True])
+        assert not bool(_np(paddle.all(t)))
+        assert bool(_np(paddle.any(t)))
+        np.testing.assert_array_equal(_np(paddle.logical_not(t)), [False, True, False])
+
+    def test_argmax_sort_topk(self):
+        a = np.array([3.0, 1.0, 2.0], dtype="float32")
+        t = paddle.to_tensor(a)
+        assert int(_np(paddle.argmax(t))) == 0
+        assert int(_np(paddle.argmin(t))) == 1
+        v, i = paddle.topk(t, 2)
+        np.testing.assert_allclose(_np(v), [3, 2])
+        s = paddle.sort(t)
+        np.testing.assert_allclose(_np(s), [1, 2, 3])
+
+    def test_unique_nonzero(self):
+        t = paddle.to_tensor(np.array([1, 2, 2, 3], dtype="int64"))
+        u = paddle.unique(t)
+        np.testing.assert_array_equal(np.sort(_np(u)), [1, 2, 3])
+        nz = paddle.nonzero(paddle.to_tensor([0.0, 1.0, 2.0]))
+        assert _np(nz).tolist() == [[1], [2]]
+
+    def test_isnan_isinf(self):
+        t = paddle.to_tensor([1.0, float("nan"), float("inf")])
+        np.testing.assert_array_equal(_np(paddle.isnan(t)), [False, True, False])
+        np.testing.assert_array_equal(_np(paddle.isinf(t)), [False, False, True])
+        assert bool(_np(paddle.isfinite(t)).tolist()[0])
+
+
+class TestTensorMethods:
+    def test_method_chaining(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.sum().item() == 10.0
+        assert t.mean().item() == 2.5
+        assert t.reshape([4]).shape == [4]
+        assert t.astype("int32").dtype is not None
+
+    def test_inplace_ops(self):
+        t = paddle.to_tensor([1.0, 2.0])
+        t.add_(paddle.to_tensor([1.0, 1.0]))
+        np.testing.assert_allclose(_np(t), [2, 3])
+        t.scale_(2.0)
+        np.testing.assert_allclose(_np(t), [4, 6])
+
+    def test_item_len_iter(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert len(t) == 2
+        rows = list(t)
+        assert len(rows) == 2
+        assert paddle.to_tensor(3.5).item() == 3.5
+
+    def test_dtype_promotion(self):
+        a = paddle.to_tensor([1], dtype="int32")
+        b = paddle.to_tensor([1.5], dtype="float32")
+        assert "float" in str((a + b).dtype)
+
+    def test_allclose_equal_all(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        assert bool(paddle.allclose(a, a).item())
+        assert bool(paddle.equal_all(a, a).item())
