@@ -50,6 +50,7 @@ from .framework.io import save, load  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from . import distributed  # noqa: F401
 from . import static  # noqa: F401
+from . import incubate  # noqa: F401
 
 
 def disable_static():

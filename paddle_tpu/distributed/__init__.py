@@ -29,6 +29,7 @@ from .auto_parallel.api import (  # noqa: F401
 from . import fleet  # noqa: F401
 from .fleet.sharding import group_sharded_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import utils  # noqa: F401
 
 import jax as _jax
 

@@ -1,0 +1,56 @@
+"""MoE cross-rank dispatch primitives.
+
+Capability parity: python/paddle/distributed/utils/moe_utils.py in the
+reference (global_scatter / global_gather — NCCL alltoall moving
+variable-length token buffers between expert-parallel ranks).
+
+TPU-native: token buffers are static-shaped [experts, capacity, d_model]
+(gate.py), so the cross-rank exchange is a *placement change* of the expert
+axis: global_scatter moves a token-major buffer onto expert-parallel
+placement (Shard(0) over the 'ep' mesh axis) and global_gather moves it
+back.  XLA lowers the reshard to the same ICI all_to_all the reference
+issues by hand; under jit GSPMD inserts it automatically and these calls
+become sharding constraints.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...framework.tensor import Tensor
+from ..auto_parallel.placement import Shard, Replicate
+from ..auto_parallel.api import reshard
+
+
+def _ep_axis(mesh, group):
+    if group is not None and getattr(group, "axis", None):
+        return group.axis
+    for cand in ("ep", "mp", "dp"):
+        if cand in mesh.dim_names:
+            return cand
+    return mesh.dim_names[0]
+
+
+def global_scatter(x: Tensor, local_count=None, global_count=None,
+                   group=None, use_calc_stream=True) -> Tensor:
+    """Move a [experts, capacity, d_model] buffer to expert-parallel
+    placement (reference: moe_utils.global_scatter, alltoall by counts)."""
+    attr = x.dist_attr
+    if attr is None:
+        return x
+    mesh = attr.process_mesh
+    axis = _ep_axis(mesh, group)
+    placements = [Replicate()] * mesh.ndim
+    placements[mesh.dim_names.index(axis)] = Shard(0)
+    return reshard(x, mesh, placements)
+
+
+def global_gather(x: Tensor, local_count=None, global_count=None,
+                  group=None, use_calc_stream=True) -> Tensor:
+    """Inverse of global_scatter: bring expert-parallel buffers back to a
+    token-parallel/replicated view (reference: moe_utils.global_gather)."""
+    attr = x.dist_attr
+    if attr is None:
+        return x
+    mesh = attr.process_mesh
+    placements = [Replicate()] * mesh.ndim
+    return reshard(x, mesh, placements)

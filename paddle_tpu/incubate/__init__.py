@@ -1,0 +1,3 @@
+"""Incubating APIs (reference: python/paddle/incubate/)."""
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401

@@ -1,0 +1,7 @@
+"""MoE / expert parallelism (reference:
+python/paddle/incubate/distributed/models/moe/)."""
+from .gate import BaseGate, NaiveGate, GShardGate, SwitchGate, moe_capacity
+from .moe_layer import MoELayer, ExpertFFN, shard_moe_layer
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate", "MoELayer",
+           "ExpertFFN", "shard_moe_layer", "moe_capacity"]

@@ -1,0 +1,196 @@
+"""MoE gates: naive top-k, GShard top-2, Switch top-1.
+
+Capability parity: python/paddle/incubate/distributed/models/moe/gate/ in the
+reference (base_gate.py BaseGate, naive_gate.py NaiveGate, gshard_gate.py
+GShardGate, switch_gate.py SwitchGate).
+
+TPU-native: the reference routes tokens with variable-length index buffers
+(utils.py count_by_gate + global_scatter alltoall).  XLA needs static shapes,
+so gates here emit dense *combine*/*dispatch* tensors over a fixed per-expert
+capacity (GShard-style):
+
+    dispatch [tokens, experts, capacity]  one-hot routing tensor
+    combine  [tokens, experts, capacity]  dispatch * gate probability
+
+MoE dispatch/combine then becomes two einsums that map straight onto the MXU,
+and expert parallelism is just a sharding of the expert axis (GSPMD inserts
+the all_to_all).  Tokens routed past an expert's capacity are dropped (their
+combine weight is zero), matching GShard/Switch semantics.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .....framework.dispatch import def_op
+from .....framework import random as _random
+from .....nn.layer.layers import Layer
+from .....nn.initializer import XavierNormal
+
+
+def moe_capacity(top_k, num_tokens, num_expert, factor):
+    """Per-expert capacity C = ceil(top_k * T / E * factor), clamped to
+    [1, T].  Single definition shared by the gates and fused_moe."""
+    cap = int(math.ceil(top_k * num_tokens * factor / max(num_expert, 1)))
+    return max(1, min(cap, num_tokens))
+
+
+def _capacity_gating(gates, top_k, capacity, normalize, random_keep=None):
+    """Dense capacity-based top-k routing.
+
+    gates: [T, E] softmax probabilities.  ``random_keep``: optional [T]
+    uniforms — when given, the second-choice expert is kept only where
+    u < 2 * p2 (GShard random routing).  Returns (combine [T,E,C],
+    dispatch [T,E,C] float 0/1, l_aux scalar).
+    """
+    T, E = gates.shape
+    remaining = gates
+    combine = jnp.zeros((T, E, capacity), gates.dtype)
+    fill = jnp.zeros((E,), jnp.int32)        # tokens already placed per expert
+    picked_w = []
+    picked_mask = []
+    first_mask = None
+    for k in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)                    # [T]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)        # [T, E]
+        if first_mask is None:
+            first_mask = onehot
+        # Position of each token inside its expert's capacity buffer:
+        # earlier tokens (and earlier rounds) get earlier slots.
+        pos_grid = jnp.cumsum(onehot, axis=0) - onehot + fill[None, :]
+        pos = jnp.sum(pos_grid * onehot, axis=1)                # [T]
+        within = (pos < capacity).astype(gates.dtype)
+        gate_val = jnp.take_along_axis(gates, idx[:, None], axis=1)[:, 0]
+        if k == 1 and random_keep is not None:
+            within = within * (random_keep < 2.0 * gate_val).astype(
+                gates.dtype)
+        pos_oh = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)
+        sel = onehot.astype(gates.dtype)[:, :, None] * pos_oh[:, None, :]
+        picked_w.append(gate_val * within)
+        picked_mask.append(sel * within[:, None, None])
+        fill = fill + jnp.sum(onehot, axis=0)
+        remaining = remaining * (1 - onehot).astype(gates.dtype)
+    wsum = sum(picked_w)
+    for w, sel in zip(picked_w, picked_mask):
+        weight = w / jnp.maximum(wsum, 1e-9) if normalize else w
+        combine = combine + weight[:, None, None] * sel
+    dispatch = (combine > 0).astype(gates.dtype)
+    # GShard load-balance loss over the primary (top-1) assignment:
+    # E * sum_e(mean_prob_e * fraction_tokens_e).
+    me = jnp.mean(gates, axis=0)                                 # [E]
+    ce = jnp.mean(first_mask.astype(gates.dtype), axis=0)        # [E]
+    l_aux = jnp.sum(me * ce) * E
+    return combine, dispatch, l_aux
+
+
+@def_op("moe_gating")
+def _moe_gating(logits, top_k, capacity, normalize, random_keep=None):
+    gates = jax.nn.softmax(logits, axis=-1)
+    return _capacity_gating(gates, top_k, capacity, normalize, random_keep)
+
+
+class BaseGate(Layer):
+    """reference: gate/base_gate.py BaseGate."""
+
+    def __init__(self, num_expert, world_size):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = world_size * num_expert
+        self.loss = None
+
+    def capacity(self, num_tokens, training=True):
+        factor = self.cap[0] if training else self.cap[1]
+        return moe_capacity(self.top_k, num_tokens, self.tot_expert, factor)
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+    def forward(self, x):
+        raise NotImplementedError("Base gate cannot be called")
+
+
+class NaiveGate(BaseGate):
+    """Plain learned top-k gate, no balance loss
+    (reference: gate/naive_gate.py).  Generous default capacity so token
+    drop is rare."""
+
+    use_balance_loss = False
+
+    def __init__(self, d_model, num_expert, world_size, topk=2):
+        super().__init__(num_expert, world_size)
+        self.d_model = d_model
+        self.top_k = topk
+        self.cap = (2.0, 4.0)
+        self.normalize = True
+        self.gate_weight = self.create_parameter(
+            [d_model, self.tot_expert], attr=XavierNormal())
+
+    def gate_logits(self, x):
+        return x.matmul(self.gate_weight)
+
+    def _random_keep(self, num_tokens):
+        return None
+
+    def forward(self, x):
+        """x: [tokens, d_model] -> (combine, dispatch) [T, E, C]."""
+        logits = self.gate_logits(x)
+        cap = self.capacity(x.shape[0], self.training)
+        combine, dispatch, l_aux = _moe_gating(
+            logits, self.top_k, cap, self.normalize,
+            self._random_keep(x.shape[0]))
+        self.set_loss(l_aux if self.use_balance_loss else None)
+        return combine, dispatch
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with capacity, load-balance loss and random second-choice
+    routing (reference: gate/gshard_gate.py)."""
+
+    use_balance_loss = True
+
+    def __init__(self, d_model, num_expert, world_size, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        assert topk == 2, "GShard only supports top-2 gating"
+        super().__init__(d_model, num_expert, world_size, topk=2)
+        self.cap = capacity
+        self.random_routing = random_routing
+        self.normalize = True
+
+    def _random_keep(self, num_tokens):
+        if not (self.training and self.random_routing):
+            return None
+        from .....tensor.creation import rand
+        return rand([num_tokens], dtype="float32")
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 switch gate with jitter noise + balance loss
+    (reference: gate/switch_gate.py)."""
+
+    use_balance_loss = True
+
+    def __init__(self, d_model, num_expert, world_size, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        assert topk == 1, "Switch gate only supports top-1"
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.cap = capacity
+        self.normalize = False
+
+    def gate_logits(self, x):
+        logits = x.matmul(self.gate_weight)
+        if self.training and self.switch_eps > 0:
+            from .....tensor.creation import rand
+            noise = rand(logits.shape, dtype=logits.dtype)
+            noise = noise * (2 * self.switch_eps) + (1.0 - self.switch_eps)
+            logits = logits * noise
+        return logits

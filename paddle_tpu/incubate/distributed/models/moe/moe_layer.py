@@ -1,0 +1,188 @@
+"""Mixture-of-experts layer with expert parallelism.
+
+Capability parity: python/paddle/incubate/distributed/models/moe/moe_layer.py
+(MoELayer at :263, cross-rank dispatch via global_scatter/global_gather at
+:119,:167) in the reference.
+
+TPU-native: the reference scatters variable-length token buffers across ranks
+with NCCL alltoall.  Here routing is dense and static-shaped (see gate.py):
+
+    dispatch/combine : [tokens, experts, capacity]
+    expert inputs    : einsum('tec,tm->ecm', dispatch, x)
+    expert outputs   : expert FFN on the per-expert [capacity, d_model] slices
+    output           : einsum('tec,ecm->tm', combine, y)
+
+Unlike the reference (per-rank expert ownership, ``num_expert`` local experts
+x ``world_size`` ranks), the single-controller SPMD model sees ALL experts:
+``experts`` is the full expert set and expert parallelism is a *placement* of
+the expert axis over an 'ep' mesh axis.  Use ``ExpertFFN`` (stacked weights)
++ ``shard_moe_layer`` for that; GSPMD then lowers the reshard between the
+token-sharded einsum and the expert-sharded FFN into the same ICI all_to_all
+the reference issues by hand.  A list of arbitrary per-expert Layers also
+works (loop, replicated weights) for eager/single-host use.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from .....framework.dispatch import def_op
+from .....framework.tensor import Tensor
+from .....nn.layer.layers import Layer, LayerList
+from .....nn.initializer import XavierNormal, Constant
+from .....distributed.auto_parallel.placement import Shard, Replicate
+from .....distributed.auto_parallel.process_mesh import ProcessMesh
+from .....distributed.auto_parallel.api import shard_tensor
+from .gate import BaseGate, NaiveGate, GShardGate, SwitchGate
+
+
+@def_op("moe_dispatch")
+def _dispatch(dispatch, x):
+    return jnp.einsum("tec,tm->ecm", dispatch, x)
+
+
+@def_op("moe_combine")
+def _combine(combine, y):
+    return jnp.einsum("tec,ecm->tm", combine, y)
+
+
+@def_op("expert_ffn")
+def _expert_ffn(x, w1, b1, w2, b2, activation):
+    """Stacked-expert FFN on [E, C, M] buffers (batched einsum -> MXU)."""
+    import jax
+    h = jnp.einsum("ecm,emh->ech", x, w1) + b1[:, None, :]
+    if activation == "swiglu":
+        u, g = jnp.split(h, 2, axis=-1)
+        h = u * jax.nn.silu(g)
+    else:
+        h = getattr(jax.nn, activation)(h)
+    return jnp.einsum("ech,ehm->ecm", h, w2) + b2[:, None, :]
+
+
+class ExpertFFN(Layer):
+    """All experts' FFN weights stacked on a leading expert axis — the
+    TPU-native expert container (shardable over 'ep', batched on the MXU)."""
+
+    def __init__(self, num_expert, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.num_expert = num_expert
+        self.activation = activation
+        w1_cols = 2 * d_hidden if activation == "swiglu" else d_hidden
+        self.w1 = self.create_parameter([num_expert, d_model, w1_cols],
+                                        attr=XavierNormal())
+        self.b1 = self.create_parameter([num_expert, w1_cols],
+                                        attr=Constant(0.0), is_bias=True)
+        self.w2 = self.create_parameter([num_expert, d_hidden, d_model],
+                                        attr=XavierNormal())
+        self.b2 = self.create_parameter([num_expert, d_model],
+                                        attr=Constant(0.0), is_bias=True)
+
+    def forward(self, expert_in):
+        return _expert_ffn(expert_in, self.w1, self.b1, self.w2, self.b2,
+                           self.activation)
+
+
+class MoELayer(Layer):
+    """reference: moe_layer.py:263 MoELayer(d_model, experts, gate, ...).
+
+    ``experts``: an ExpertFFN (stacked fast path), or a list of Layers (one
+    per expert — the full global expert set).  ``gate``: a BaseGate instance
+    or config dict {"type": "gshard"|"switch"|"naive", "top_k": k}.
+    """
+
+    def __init__(self, d_model: int,
+                 experts: Union[ExpertFFN, Sequence[Layer]],
+                 gate=None, moe_group=None, mp_group=None,
+                 recompute_interval=0, recompute_ctx=None):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(experts, ExpertFFN):
+            self.experts = experts
+            self.num_expert = experts.num_expert
+        else:
+            self.experts = (experts if isinstance(experts, LayerList)
+                            else LayerList(list(experts)))
+            self.num_expert = len(self.experts)
+        self.moe_group = moe_group
+        self.recompute_interval = recompute_interval
+        if gate is None:
+            gate = {"type": "gshard", "top_k": 2}
+        if isinstance(gate, dict):
+            kind = gate.get("type", "gshard")
+            topk = gate.get("top_k", 2 if kind != "switch" else 1)
+            # The gate sees the full expert set (world_size=1): expert
+            # parallelism is a placement, not a partition of the gate.
+            if kind == "naive":
+                gate = NaiveGate(d_model, self.num_expert, 1, topk=topk)
+            elif kind == "switch":
+                gate = SwitchGate(d_model, self.num_expert, 1)
+            else:
+                gate = GShardGate(d_model, self.num_expert, 1)
+        assert isinstance(gate, BaseGate)
+        assert gate.tot_expert == self.num_expert, (
+            f"gate routes over {gate.tot_expert} experts but layer holds "
+            f"{self.num_expert}")
+        self.gate = gate
+
+    @property
+    def l_aux(self):
+        return self.gate.get_loss(clear=False)
+
+    def _run_experts(self, expert_in, use_recompute=False):
+        if use_recompute:
+            from .....distributed.fleet.recompute import recompute
+        if isinstance(self.experts, ExpertFFN):
+            if use_recompute:
+                return recompute(self.experts, expert_in)
+            return self.experts(expert_in)
+        outs = []
+        for i, expert in enumerate(self.experts):
+            seg = (recompute(expert, expert_in[i]) if use_recompute
+                   else expert(expert_in[i]))
+            if isinstance(seg, (tuple, list)):
+                seg = seg[0]
+            outs.append(seg.unsqueeze(0))
+        from .....tensor.manipulation import concat
+        return concat(outs, axis=0)                      # [E, C, M]
+
+    def forward(self, x: Tensor) -> Tensor:
+        orig_shape = x.shape
+        tokens = x.reshape([-1, self.d_model])
+        combine, dispatch = self.gate(tokens)
+        expert_in = _dispatch(dispatch, tokens)          # [E, C, M]
+        expert_out = self._run_experts(
+            expert_in,
+            use_recompute=self.recompute_interval > 0 and self.training)
+        y = _combine(combine, expert_out)                # [T, M]
+        return y.reshape(orig_shape)
+
+
+def shard_moe_layer(layer: MoELayer, mesh: ProcessMesh, axis: str = "ep"):
+    """Place a MoELayer for expert parallelism: gate replicated, stacked
+    expert weights Shard(0) over ``axis`` — GSPMD inserts the cross-rank
+    all_to_all around the expert FFN (the compiled equivalent of the
+    reference's global_scatter/global_gather).
+
+    Requires the stacked ``ExpertFFN`` expert container; a Python list of
+    arbitrary expert Layers has no shardable expert axis."""
+    if not isinstance(layer.experts, ExpertFFN):
+        raise NotImplementedError(
+            "expert parallelism needs stacked expert weights: build the "
+            "MoELayer with experts=ExpertFFN(...) (a list of per-expert "
+            "Layers runs replicated)")
+    axis_idx = mesh.dim_names.index(axis)
+    repl = [Replicate()] * mesh.ndim
+
+    def _place(p, placements):
+        sharded = shard_tensor(p, mesh, placements)
+        p._data = sharded._data
+        p.dist_attr = sharded.dist_attr
+
+    for p in layer.gate.parameters():
+        _place(p, repl)
+    ep = list(repl)
+    ep[axis_idx] = Shard(0)
+    for p in layer.experts.parameters():
+        _place(p, ep)
+    return layer

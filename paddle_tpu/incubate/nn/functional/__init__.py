@@ -1,0 +1,67 @@
+"""Fused-op functional API (incubate).
+
+Capability parity: python/paddle/incubate/nn/functional/ in the reference
+(fused_moe.py, fused_rotary_position_embedding, fused_rms_norm, ...).  On
+TPU "fused" means one jit region built from einsums that XLA maps onto the
+MXU; the flash-attention fusion lives in paddle_tpu.ops.pallas.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.dispatch import def_op
+from ...distributed.models.moe.gate import _capacity_gating
+
+
+def _act(name):
+    return {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+            "silu": jax.nn.silu, "swiglu": None}[name]
+
+
+@def_op("fused_moe")
+def _fused_moe(x, gate_weight, ffn1_weight, ffn1_bias, ffn2_weight,
+               ffn2_bias, top_k, capacity, activation, normalize):
+    """Single-region MoE: gate -> dense dispatch -> stacked-expert FFN ->
+    combine.  Weight shapes: gate [M, E], ffn1 [E, M, H], ffn2 [E, H, M].
+    Shard ffn weights + the [E, C, M] buffers on an 'ep' mesh axis and GSPMD
+    emits the cross-rank all_to_all (reference does this with
+    global_scatter/global_gather around per-rank experts)."""
+    orig_shape = x.shape
+    tokens = x.reshape(-1, x.shape[-1])
+    logits = tokens @ gate_weight
+    combine, dispatch, l_aux = _capacity_gating(
+        jax.nn.softmax(logits, axis=-1), top_k, capacity, normalize)
+    expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(x.dtype), tokens)
+    h = jnp.einsum("ecm,emh->ech", expert_in, ffn1_weight)
+    if ffn1_bias is not None:
+        h = h + ffn1_bias[:, None, :]
+    if activation == "swiglu":
+        u, g = jnp.split(h, 2, axis=-1)
+        h = u * jax.nn.silu(g)
+    else:
+        h = _act(activation)(h)
+    y = jnp.einsum("ech,ehm->ecm", h, ffn2_weight)
+    if ffn2_bias is not None:
+        y = y + ffn2_bias[:, None, :]
+    out = jnp.einsum("tec,ecm->tm", combine.astype(x.dtype), y)
+    return out.reshape(orig_shape), l_aux
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn2_bias=None, top_k=2, capacity_factor=1.25,
+              activation="gelu", normalize=True, name=None):
+    """reference: incubate/nn/functional/fused_moe.py fused_moe."""
+    from ...distributed.models.moe.gate import moe_capacity
+    num_tokens = 1
+    for s in x.shape[:-1]:
+        num_tokens *= s
+    capacity = moe_capacity(top_k, num_tokens, gate_weight.shape[-1],
+                            capacity_factor)
+    return _fused_moe(x, gate_weight, ffn1_weight, ffn1_bias, ffn2_weight,
+                      ffn2_bias, top_k, capacity, activation, normalize)
+
+
+__all__ = ["fused_moe"]
