@@ -73,34 +73,64 @@ def _pp_mesh(mesh: Optional[ProcessMesh], axis: str):
     return ProcessMesh(np.arange(n), [axis]), axis
 
 
+#: Supported microbatch schedules (reference: pipeline_parallel.py:255,575
+#: 1F1B, :1179 interleaved VPP, :2261 FThenB; passes/pipeline_scheduler_pass/
+#: pipeline_zero_bubble.py ZB).  In a single compiled SPMD program the
+#: schedule selects (a) the layer->stage mapping (contiguous vs interleaved
+#: virtual chunks) and (b) the activation-memory policy:
+#:   FThenB : store every microbatch's activations (GPipe memory, O(M))
+#:   1F1B   : rematerialize per microbatch — peak activations O(stages),
+#:            the 1F1B footprint; XLA owns instruction-level overlap
+#:   VPP    : interleaved virtual chunks (smaller per-stage layer groups)
+#:   ZB     : 1F1B memory; the weight-grad/input-grad split that makes the
+#:            bubble "zero" is instruction scheduling, which XLA performs on
+#:            the fused backward program (no hand schedule needed on TPU)
+SCHEDULES = ("FThenB", "1F1B", "VPP", "ZB")
+
+
 class PipelineStack(Layer):
     """A stack of ``num_layers`` identical blocks, partitioned over the 'pp'
-    mesh axis and executed with the compiled GPipe/1F1B schedule.
+    mesh axis and executed with a compiled microbatch schedule.
 
-    The per-block params are stacked to shape (pp, layers_per_stage, ...)
-    and sharded Shard(0) on 'pp', so each stage holds only its own layers —
-    the memory layout the reference's PipelineLayer partitioner produces.
+    The per-block params are stacked to shape
+    (virtual_chunks, pp, layers_per_chunk, ...) and sharded Shard(1) on
+    'pp', so each stage holds only its own layers — the memory layout the
+    reference's PipelineLayer partitioner produces (interleaved assignment
+    when virtual chunks > 1, as in VPP).
     """
 
     def __init__(self, layer_factory: Callable[[], Layer], num_layers: int,
                  num_stages: Optional[int] = None,
                  num_microbatches: int = 1, mesh: Optional[ProcessMesh] = None,
                  pp_axis: str = "pp", schedule: str = "1F1B",
-                 remat: bool = False):
+                 remat: bool = False, num_virtual_stages: int = 1):
         super().__init__()
         mesh, axis = _pp_mesh(mesh, pp_axis)
         self._mesh, self._axis = mesh, axis
         self.num_stages = num_stages or mesh.get_dim_size(axis)
-        if num_layers % self.num_stages != 0:
-            raise ValueError("num_layers must divide num_stages")
-        self.layers_per_stage = num_layers // self.num_stages
+        if schedule not in SCHEDULES:
+            raise ValueError(f"schedule must be one of {SCHEDULES}")
+        if schedule == "VPP" and num_virtual_stages == 1:
+            num_virtual_stages = 2
+        self.num_virtual_stages = num_virtual_stages
+        chunks = self.num_stages * num_virtual_stages
+        if num_layers % chunks != 0:
+            raise ValueError(
+                f"num_layers={num_layers} must divide num_stages*virtual="
+                f"{chunks}")
+        self.layers_per_stage = num_layers // chunks
         self.num_layers = num_layers
         self.num_microbatches = num_microbatches
         self.schedule = schedule
         self.remat = remat
 
-        # template block defines structure; all blocks' params stacked
-        self._template = layer_factory()
+        # template block defines structure; all blocks' params stacked.
+        # (kept out of the Layer registry: its own params are placeholders
+        # that _block_apply swaps payloads into, never trained directly)
+        # VPP layer->stage mapping: layer index l lives in virtual chunk
+        # v = l // (stages*lps), stage s = (l % (stages*lps)) // lps — the
+        # interleaved assignment of pipeline_parallel.py:1179.
+        object.__setattr__(self, '_template', layer_factory())
         blocks = [self._template] + [layer_factory()
                                      for _ in range(num_layers - 1)]
         names = [n for n, _ in self._template.named_parameters()]
@@ -110,10 +140,10 @@ class PipelineStack(Layer):
             leaves = [dict(b.named_parameters())[name] for b in blocks]
             stacked = jnp.stack(
                 [l._data for l in leaves]).reshape(
-                    (self.num_stages, self.layers_per_stage)
-                    + tuple(leaves[0].shape))
+                    (num_virtual_stages, self.num_stages,
+                     self.layers_per_stage) + tuple(leaves[0].shape))
             placements = [Replicate()] * mesh.ndim
-            placements[axis_idx] = Shard(0)
+            placements[axis_idx] = Shard(1)
             p = self.create_parameter(stacked.shape,
                                       default_initializer=lambda s, d: stacked)
             shard_tensor(p, mesh, placements)
@@ -144,63 +174,77 @@ class PipelineStack(Layer):
                          for n in self._param_names]
 
         def run(params, xs):
-            # params leaves: (1, layers_per_stage, ...) local to this stage
-            # xs: full (M, mb, ...) replicated
+            # params leaves: (virtual, 1, layers_per_stage, ...) local to
+            # this stage; xs: full (M, mb, ...) replicated
             r = lax.axis_index(axis)
-            local_params = [p[0] for p in params]
 
-            def stage_fn(h):
-                def scan_body(carry, layer_params):
-                    out = self._block_apply(layer_params, carry)
-                    return out, None
-                if self.remat:
-                    body = jax.checkpoint(scan_body)
-                else:
-                    body = scan_body
-                out, _ = lax.scan(body, h, local_params)
-                return out
+            def chunk_pipeline(xs, chunk_params):
+                def stage_fn(h):
+                    def scan_body(carry, layer_params):
+                        out = self._block_apply(layer_params, carry)
+                        return out, None
+                    if self.remat:
+                        body = jax.checkpoint(scan_body)
+                    else:
+                        body = scan_body
+                    out, _ = lax.scan(body, h, chunk_params)
+                    return out
 
-            mb_shape = xs.shape[1:]
-            state = jnp.zeros(mb_shape, xs.dtype)
-            outputs = jnp.zeros((M,) + mb_shape, xs.dtype)
-            perm = [(i, i + 1) for i in range(stages - 1)]
+                if self.schedule in ("1F1B", "ZB"):
+                    # per-microbatch remat: backward re-runs each stage's
+                    # forward from the stage-boundary activation — peak
+                    # activation memory O(stages), the 1F1B footprint
+                    stage_fn = jax.checkpoint(stage_fn)
 
-            def step(t, carry):
-                state, outputs = carry
-                # stage 0 ingests microbatch t; others use what arrived
-                inp = jnp.where(r == 0, xs[jnp.minimum(t, M - 1)], state)
-                h = stage_fn(inp)
-                # last stage commits result for microbatch t - (stages-1)
-                done_idx = t - (stages - 1)
-                valid = (r == stages - 1) & (done_idx >= 0) & (done_idx < M)
-                outputs = lax.cond(
-                    valid,
-                    lambda o: o.at[jnp.maximum(done_idx, 0)].set(h),
-                    lambda o: o, outputs)
-                state = lax.ppermute(h, axis, perm)
-                return state, outputs
+                mb_shape = xs.shape[1:]
+                state = jnp.zeros(mb_shape, xs.dtype)
+                outputs = jnp.zeros((M,) + mb_shape, xs.dtype)
+                perm = [(i, i + 1) for i in range(stages - 1)]
 
-            _, outputs = lax.fori_loop(0, M + stages - 1, step,
-                                       (state, outputs))
-            # broadcast result from the last stage to all (out replicated)
-            outputs = lax.psum(
-                jnp.where(r == stages - 1, outputs, jnp.zeros_like(outputs)),
-                axis)
-            return outputs
+                def step(t, carry):
+                    state, outputs = carry
+                    # stage 0 ingests microbatch t; others use what arrived
+                    inp = jnp.where(r == 0, xs[jnp.minimum(t, M - 1)], state)
+                    h = stage_fn(inp)
+                    # last stage commits result for microbatch t-(stages-1)
+                    done_idx = t - (stages - 1)
+                    valid = ((r == stages - 1) & (done_idx >= 0)
+                             & (done_idx < M))
+                    outputs = lax.cond(
+                        valid,
+                        lambda o: o.at[jnp.maximum(done_idx, 0)].set(h),
+                        lambda o: o, outputs)
+                    state = lax.ppermute(h, axis, perm)
+                    return state, outputs
 
-        axis_idx = mesh.dim_names.index(axis)
-        pspec_param = [None] * (2 + 1)
+                _, outputs = lax.fori_loop(0, M + stages - 1, step,
+                                           (state, outputs))
+                # broadcast result from the last stage (out replicated)
+                outputs = lax.psum(
+                    jnp.where(r == stages - 1, outputs,
+                              jnp.zeros_like(outputs)), axis)
+                return outputs
+
+            out = xs
+            # virtual chunks chain: chunk j's last stage feeds chunk j+1's
+            # first stage (interleaved VPP mapping when virtual > 1)
+            for j in range(self.num_virtual_stages):
+                out = chunk_pipeline(out, [p[j][0] for p in params])
+            return out
 
         def spec_for(p):
             s = [None] * p.ndim
-            s[0] = axis
+            s[1] = axis
             return P(*s)
 
         in_specs = (tuple(spec_for(p) for p in param_tensors),
                     P(*([None] * (x.ndim))))
         out_specs = P(*([None] * x.ndim))
-        fn = shard_map(run, mesh=mesh.jax_mesh, in_specs=in_specs,
-                       out_specs=out_specs, check_rep=False)
+        # jit is required: remat (closed_call) can't be eagerly evaluated
+        # inside shard_map, and the schedule should compile to one XLA
+        # program anyway
+        fn = jax.jit(shard_map(run, mesh=mesh.jax_mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_rep=False))
         out = call_op("pipeline_stack", fn, (tuple(param_tensors), x), {})
         return out
 
@@ -214,7 +258,7 @@ class PipelineLayer(Layer):
     def __init__(self, layers, num_stages=None, topology=None,
                  loss_fn=None, seg_method="uniform", recompute_interval=0,
                  num_virtual_pipeline_stages=None, mesh=None, pp_axis="pp",
-                 num_microbatches=1):
+                 num_microbatches=1, schedule="1F1B"):
         super().__init__()
         mesh, axis = _pp_mesh(mesh, pp_axis)
         self._mesh, self._axis = mesh, axis
@@ -243,13 +287,15 @@ class PipelineLayer(Layer):
         self.pre = LayerList([self._build(d) for d in descs[:lo]])
         self.post = LayerList([self._build(d) for d in descs[hi:]])
         body = descs[lo:hi]
-        if body and (hi - lo) % self.num_stages == 0:
+        virtual = num_virtual_pipeline_stages or 1
+        if body and (hi - lo) % (self.num_stages * virtual) == 0:
             d0 = body[0]
             self.body = PipelineStack(
                 lambda: d0.layer_func(*d0.inputs, **d0.kwargs),
                 num_layers=len(body), num_stages=self.num_stages,
                 num_microbatches=num_microbatches, mesh=mesh, pp_axis=axis,
-                remat=recompute_interval > 0)
+                remat=recompute_interval > 0, schedule=schedule,
+                num_virtual_stages=virtual)
             self._body_seq = None
         else:
             # heterogeneous fallback: replicated sequential execution
