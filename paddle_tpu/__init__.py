@@ -51,6 +51,7 @@ from .hapi.model import Model  # noqa: F401
 from . import distributed  # noqa: F401
 from . import static  # noqa: F401
 from . import incubate  # noqa: F401
+from . import distribution  # noqa: F401
 
 
 def disable_static():

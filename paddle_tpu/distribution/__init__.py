@@ -1,0 +1,35 @@
+"""Probability distributions (reference: python/paddle/distribution/).
+
+20+ distributions, bijective transforms, TransformedDistribution and a KL
+registry — computed with jnp/jax.scipy through the op dispatch so log_prob /
+rsample are tape-differentiable and jit-traceable.
+"""
+from .distribution import Distribution
+from .normal import Normal, LogNormal
+from .discrete import (Bernoulli, ContinuousBernoulli, Categorical,
+                       Multinomial, Binomial, Geometric, Poisson)
+from .gamma_family import (ExponentialFamily, Gamma, Chi2, Exponential,
+                           Beta, Dirichlet)
+from .location_scale import Uniform, Cauchy, Gumbel, Laplace, StudentT
+from .multivariate import MultivariateNormal, Independent
+from .transform import (Transform, Type, AbsTransform, AffineTransform,
+                        ChainTransform, ExpTransform, IndependentTransform,
+                        PowerTransform, ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
+                        StickBreakingTransform, TanhTransform,
+                        TransformedDistribution)
+from .kl import kl_divergence, register_kl
+
+__all__ = [
+    "Distribution", "Normal", "LogNormal", "Bernoulli",
+    "ContinuousBernoulli", "Categorical", "Multinomial", "Binomial",
+    "Geometric", "Poisson", "ExponentialFamily", "Gamma", "Chi2",
+    "Exponential", "Beta", "Dirichlet", "Uniform", "Cauchy", "Gumbel",
+    "Laplace", "StudentT", "MultivariateNormal", "Independent",
+    "Transform", "Type", "AbsTransform", "AffineTransform",
+    "ChainTransform", "ExpTransform", "IndependentTransform",
+    "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+    "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+    "TanhTransform", "TransformedDistribution", "kl_divergence",
+    "register_kl",
+]

@@ -1,0 +1,375 @@
+"""Discrete distributions: Bernoulli, Categorical, Multinomial, Binomial,
+Geometric, Poisson, ContinuousBernoulli.
+
+Capability parity: python/paddle/distribution/{bernoulli,categorical,
+multinomial,binomial,geometric,poisson,continuous_bernoulli}.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, _t, _op, _key
+
+_EPS = 1e-8
+
+
+def _gammaln(x):
+    return jsp.gammaln(x)
+
+
+class Bernoulli(Distribution):
+    """reference: distribution/bernoulli.py Bernoulli(probs)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(batch_shape=tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return _op("bern_var", lambda p: p * (1 - p), self.probs)
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxed sample (differentiable), matching the
+        reference's rsample(temperature)."""
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(p):
+            u = jax.random.uniform(key, out_shape, p.dtype, _EPS, 1 - _EPS)
+            logits = jnp.log(p) - jnp.log1p(-p)
+            g = jnp.log(u) - jnp.log1p(-u)
+            return jax.nn.sigmoid((logits + g) / temperature)
+        return _op("bern_rsample", fn, self.probs)
+
+    def sample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(p):
+            return jax.random.bernoulli(key, p, out_shape).astype(p.dtype)
+        out = _op("bern_sample", fn, self.probs)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        def fn(p, v):
+            p = jnp.clip(p, _EPS, 1 - _EPS)
+            return jsp.xlogy(v, p) + jsp.xlog1py(1 - v, -p)
+        return _op("bern_log_prob", fn, self.probs, _t(value))
+
+    def entropy(self):
+        def fn(p):
+            p = jnp.clip(p, _EPS, 1 - _EPS)
+            return -(jsp.xlogy(p, p) + jsp.xlog1py(1 - p, -p))
+        return _op("bern_entropy", fn, self.probs)
+
+    def cdf(self, value):
+        def fn(p, v):
+            return jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - p, 1.0))
+        return _op("bern_cdf", fn, self.probs, _t(value))
+
+
+class ContinuousBernoulli(Distribution):
+    """reference: distribution/continuous_bernoulli.py CB(probs)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(batch_shape=tuple(self.probs.shape))
+
+    def _log_norm(self, p):
+        # log C(p); near p=0.5 use the Taylor-stable limit log(2)
+        safe = jnp.where(jnp.abs(p - 0.5) < (self._lims[1] - 0.5),
+                         0.6, p)
+        ln = jnp.log(
+            (2 * jnp.arctanh(1 - 2 * safe)) / (1 - 2 * safe))
+        return jnp.where(jnp.abs(p - 0.5) < (self._lims[1] - 0.5),
+                         math.log(2.0), ln)
+
+    @property
+    def mean(self):
+        def fn(p):
+            safe = jnp.where(jnp.abs(p - 0.5) < 1e-3, 0.6, p)
+            m = safe / (2 * safe - 1) + 1 / (
+                2 * jnp.arctanh(1 - 2 * safe))
+            return jnp.where(jnp.abs(p - 0.5) < 1e-3, 0.5, m)
+        return _op("cb_mean", fn, self.probs)
+
+    @property
+    def variance(self):
+        def fn(p):
+            safe = jnp.where(jnp.abs(p - 0.5) < 1e-3, 0.6, p)
+            t = jnp.arctanh(1 - 2 * safe)
+            v = safe * (safe - 1) / jnp.square(1 - 2 * safe) + 1 / (
+                4 * jnp.square(t))
+            return jnp.where(jnp.abs(p - 0.5) < 1e-3, 1.0 / 12, v)
+        return _op("cb_var", fn, self.probs)
+
+    def rsample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(p):
+            u = jax.random.uniform(key, out_shape, p.dtype, _EPS, 1 - _EPS)
+            safe = jnp.where(jnp.abs(p - 0.5) < 1e-3, 0.6, p)
+            icdf = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                    / (jnp.log(safe) - jnp.log1p(-safe)))
+            return jnp.where(jnp.abs(p - 0.5) < 1e-3, u, icdf)
+        return _op("cb_rsample", fn, self.probs)
+
+    def log_prob(self, value):
+        def fn(p, v):
+            p = jnp.clip(p, _EPS, 1 - _EPS)
+            return (jsp.xlogy(v, p) + jsp.xlog1py(1 - v, -p)
+                    + self._log_norm(p))
+        return _op("cb_log_prob", fn, self.probs, _t(value))
+
+    def entropy(self):
+        lp = self.log_prob(self.mean)
+        def fn(p, m, _lp):
+            # E[-log p(x)] has closed form via mean
+            p = jnp.clip(p, _EPS, 1 - _EPS)
+            logits = jnp.log(p) - jnp.log1p(-p)
+            return -(self._log_norm(p) + jnp.log1p(-p) + m * logits)
+        return _op("cb_entropy", fn, self.probs, self.mean, lp)
+
+
+class Categorical(Distribution):
+    """reference: distribution/categorical.py Categorical(logits)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(batch_shape=tuple(self.logits.shape[:-1]))
+        self._num_events = self.logits.shape[-1]
+
+    @property
+    def probs_tensor(self):
+        return _op("cat_probs", lambda l: jax.nn.softmax(l, -1), self.logits)
+
+    def sample(self, shape=()):
+        key = _key()
+        shp = tuple(shape)
+
+        def fn(l):
+            return jax.random.categorical(
+                key, jnp.log(jax.nn.softmax(l, -1)), axis=-1,
+                shape=shp + tuple(l.shape[:-1])).astype(jnp.int32)
+        out = _op("cat_sample", fn, self.logits)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        def fn(l, v):
+            logp = jax.nn.log_softmax(l, -1)
+            logp = jnp.broadcast_to(logp, v.shape + logp.shape[-1:])
+            return jnp.take_along_axis(
+                logp, v[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return _op("cat_log_prob", fn, self.logits, _t(value, "int32"))
+
+    def probs(self, value):
+        return _op("cat_prob_of", lambda lp: jnp.exp(lp),
+                   self.log_prob(value))
+
+    def entropy(self):
+        def fn(l):
+            logp = jax.nn.log_softmax(l, -1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return _op("cat_entropy", fn, self.logits)
+
+
+class Multinomial(Distribution):
+    """reference: distribution/multinomial.py Multinomial(total_count,
+    probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(batch_shape=tuple(self.probs.shape[:-1]),
+                         event_shape=(self.probs.shape[-1],))
+
+    @property
+    def mean(self):
+        return _op("multi_mean", lambda p: self.total_count * p, self.probs)
+
+    @property
+    def variance(self):
+        return _op("multi_var",
+                   lambda p: self.total_count * p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        key = _key()
+        shp = tuple(shape)
+        n = self.total_count
+        k = self.event_shape[0]
+
+        def fn(p):
+            norm = p / jnp.sum(p, -1, keepdims=True)
+            logits = jnp.broadcast_to(
+                jnp.log(norm), shp + tuple(p.shape[:-1]) + (n, k))
+            draws = jax.random.categorical(key, logits, axis=-1)
+            counts = jax.nn.one_hot(draws, k).sum(-2)
+            return counts.astype(p.dtype)
+        out = _op("multi_sample", fn, self.probs)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        def fn(p, v):
+            norm = p / jnp.sum(p, -1, keepdims=True)
+            return (_gammaln(jnp.sum(v, -1) + 1)
+                    - jnp.sum(_gammaln(v + 1), -1)
+                    + jnp.sum(jsp.xlogy(v, norm), -1))
+        return _op("multi_log_prob", fn, self.probs, _t(value))
+
+    def entropy(self):
+        # no simple closed form; use the categorical bound n*H(p) + log-coef
+        def fn(p):
+            norm = p / jnp.sum(p, -1, keepdims=True)
+            return -self.total_count * jnp.sum(
+                jsp.xlogy(norm, norm), -1)
+        return _op("multi_entropy", fn, self.probs)
+
+
+class Binomial(Distribution):
+    """reference: distribution/binomial.py Binomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count, "float32")
+        self.probs = _t(probs)
+        shape = jnp.broadcast_shapes(tuple(self.total_count.shape),
+                                     tuple(self.probs.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _op("binom_mean", lambda n, p: n * p,
+                   self.total_count, self.probs)
+
+    @property
+    def variance(self):
+        return _op("binom_var", lambda n, p: n * p * (1 - p),
+                   self.total_count, self.probs)
+
+    def sample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(n, p):
+            return jax.random.binomial(key, n, p, shape=out_shape).astype(
+                p.dtype)
+        out = _op("binom_sample", fn, self.total_count, self.probs)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        def fn(n, p, v):
+            p = jnp.clip(p, _EPS, 1 - _EPS)
+            return (_gammaln(n + 1) - _gammaln(v + 1) - _gammaln(n - v + 1)
+                    + jsp.xlogy(v, p) + jsp.xlog1py(n - v, -p))
+        return _op("binom_log_prob", fn, self.total_count, self.probs,
+                   _t(value))
+
+    def entropy(self):
+        def fn(n, p):
+            # Stirling approximation (exact entropy needs a sum over support)
+            v = n * p * (1 - p)
+            return 0.5 * jnp.log(
+                2 * math.pi * math.e * jnp.maximum(v, _EPS))
+        return _op("binom_entropy", fn, self.total_count, self.probs)
+
+
+class Geometric(Distribution):
+    """reference: distribution/geometric.py Geometric(probs) — number of
+    failures before the first success, support {0, 1, 2, ...}."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(batch_shape=tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return _op("geom_mean", lambda p: (1 - p) / p, self.probs)
+
+    @property
+    def variance(self):
+        return _op("geom_var", lambda p: (1 - p) / jnp.square(p), self.probs)
+
+    @property
+    def stddev(self):
+        return _op("geom_std", lambda v: jnp.sqrt(v), self.variance)
+
+    def sample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(p):
+            u = jax.random.uniform(key, out_shape, p.dtype, _EPS, 1 - _EPS)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+        out = _op("geom_sample", fn, self.probs)
+        out.stop_gradient = True
+        return out
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(p, v):
+            p = jnp.clip(p, _EPS, 1 - _EPS)
+            return jsp.xlog1py(v, -p) + jnp.log(p)
+        return _op("geom_log_prob", fn, self.probs, _t(value))
+
+    def entropy(self):
+        def fn(p):
+            p = jnp.clip(p, _EPS, 1 - _EPS)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)) / p
+        return _op("geom_entropy", fn, self.probs)
+
+    def cdf(self, value):
+        def fn(p, v):
+            return 1 - jnp.power(1 - p, v + 1)
+        return _op("geom_cdf", fn, self.probs, _t(value))
+
+
+class Poisson(Distribution):
+    """reference: distribution/poisson.py Poisson(rate)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(batch_shape=tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(r):
+            return jax.random.poisson(key, r, out_shape).astype(r.dtype)
+        out = _op("poisson_sample", fn, self.rate)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        def fn(r, v):
+            return jsp.xlogy(v, r) - r - _gammaln(v + 1)
+        return _op("poisson_log_prob", fn, self.rate, _t(value))
+
+    def entropy(self):
+        def fn(r):
+            # series approximation (matches reference's truncated approach)
+            return (0.5 * jnp.log(2 * math.pi * math.e * jnp.maximum(r, _EPS))
+                    - 1 / (12 * jnp.maximum(r, _EPS)))
+        return _op("poisson_entropy", fn, self.rate)

@@ -1,0 +1,104 @@
+"""Distribution base class.
+
+Capability parity: python/paddle/distribution/distribution.py in the
+reference (Distribution with batch_shape/event_shape, sample/rsample,
+prob/log_prob, entropy, cdf/icdf).
+
+TPU-native: parameters are Tensors; every method body is a pure jnp function
+executed through the op dispatch (call_op), so log_prob/rsample are
+differentiable on the tape and traceable under jit.  Sampling draws a fresh
+subkey from the stateful Generator facade (framework/random.py).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import call_op
+from ..framework.tensor import Tensor, wrap_array
+from ..framework import random as _random
+
+
+def _t(x, dtype="float32"):
+    """Coerce a scalar/array/Tensor to Tensor."""
+    if isinstance(x, Tensor):
+        return x
+    arr = np.asarray(x, dtype=dtype)
+    return wrap_array(jnp.asarray(arr))
+
+
+def _op(name, fn, *args):
+    """Run a pure jnp function through dispatch (tape + AMP aware)."""
+    return call_op(name, fn, args, {})
+
+
+def _key():
+    return _random.default_generator().split_key()
+
+
+class Distribution:
+    """reference: distribution.py Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return _op("dist_stddev", lambda v: jnp.sqrt(v), self.variance)
+
+    def sample(self, shape=()):
+        """Non-differentiable draw (stop_gradient output)."""
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        out._grad_node = None
+        return out
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _op("dist_prob", lambda lp: jnp.exp(lp), self.log_prob(value))
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return (tuple(sample_shape) + self.batch_shape + self.event_shape)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}"
+                f"(batch_shape={self.batch_shape}, "
+                f"event_shape={self.event_shape})")

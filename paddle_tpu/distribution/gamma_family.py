@@ -1,0 +1,238 @@
+"""Gamma-family distributions: Gamma, Chi2, Beta, Dirichlet, Exponential,
+and the ExponentialFamily base.
+
+Capability parity: python/paddle/distribution/{gamma,chi2,beta,dirichlet,
+exponential,exponential_family}.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, _t, _op, _key
+
+
+def _betaln(a, b):
+    return jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+
+
+class ExponentialFamily(Distribution):
+    """reference: distribution/exponential_family.py — entropy via Bregman
+    divergence of the log-normalizer (autodiff replaces the reference's
+    hand-coded natural-parameter gradients)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nparams = self._natural_parameters
+
+        def fn(*nats):
+            lg = self._log_normalizer(*nats)
+            grads = jax.grad(
+                lambda *n: jnp.sum(self._log_normalizer(*n)),
+                argnums=tuple(range(len(nats))))(*nats)
+            ent = lg - sum(n * g for n, g in zip(nats, grads))
+            return ent + self._mean_carrier_measure
+        return _op("expfam_entropy", fn, *nparams)
+
+
+class Gamma(ExponentialFamily):
+    """reference: distribution/gamma.py Gamma(concentration, rate)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        shape = jnp.broadcast_shapes(tuple(self.concentration.shape),
+                                     tuple(self.rate.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _op("gamma_mean", lambda a, r: a / r,
+                   self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return _op("gamma_var", lambda a, r: a / jnp.square(r),
+                   self.concentration, self.rate)
+
+    def rsample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(a, r):
+            return jax.random.gamma(key, a, out_shape, a.dtype) / r
+        return _op("gamma_rsample", fn, self.concentration, self.rate)
+
+    def log_prob(self, value):
+        def fn(a, r, v):
+            return (jsp.xlogy(a, r) + jsp.xlogy(a - 1, v) - r * v
+                    - jsp.gammaln(a))
+        return _op("gamma_log_prob", fn, self.concentration, self.rate,
+                   _t(value))
+
+    def entropy(self):
+        def fn(a, r):
+            return (a - jnp.log(r) + jsp.gammaln(a)
+                    + (1 - a) * jsp.digamma(a))
+        return _op("gamma_entropy", fn, self.concentration, self.rate)
+
+
+class Chi2(Gamma):
+    """reference: distribution/chi2.py Chi2(df) = Gamma(df/2, 1/2)."""
+
+    def __init__(self, df, name=None):
+        self.df = _t(df)
+        half = _op("chi2_half", lambda d: d / 2, self.df)
+        super().__init__(half, 0.5)
+
+
+class Exponential(ExponentialFamily):
+    """reference: distribution/exponential.py Exponential(rate)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(batch_shape=tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return _op("exp_mean", lambda r: 1 / r, self.rate)
+
+    @property
+    def variance(self):
+        return _op("exp_var", lambda r: 1 / jnp.square(r), self.rate)
+
+    def rsample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(r):
+            u = jax.random.uniform(key, out_shape, r.dtype, 1e-8, 1.0)
+            return -jnp.log(u) / r
+        return _op("exp_rsample", fn, self.rate)
+
+    def log_prob(self, value):
+        def fn(r, v):
+            return jnp.log(r) - r * v
+        return _op("exp_log_prob", fn, self.rate, _t(value))
+
+    def entropy(self):
+        return _op("exp_entropy", lambda r: 1 - jnp.log(r), self.rate)
+
+    def cdf(self, value):
+        return _op("exp_cdf", lambda r, v: 1 - jnp.exp(-r * v),
+                   self.rate, _t(value))
+
+    def icdf(self, value):
+        return _op("exp_icdf", lambda r, v: -jnp.log1p(-v) / r,
+                   self.rate, _t(value))
+
+
+class Beta(ExponentialFamily):
+    """reference: distribution/beta.py Beta(alpha, beta)."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        shape = jnp.broadcast_shapes(tuple(self.alpha.shape),
+                                     tuple(self.beta.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _op("beta_mean", lambda a, b: a / (a + b),
+                   self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        return _op("beta_var",
+                   lambda a, b: a * b / (jnp.square(a + b) * (a + b + 1)),
+                   self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        key = _key()
+        k1, k2 = jax.random.split(key)
+        out_shape = self._extend_shape(shape)
+
+        def fn(a, b):
+            ga = jax.random.gamma(k1, a, out_shape, a.dtype)
+            gb = jax.random.gamma(k2, b, out_shape, b.dtype)
+            return ga / (ga + gb)
+        return _op("beta_rsample", fn, self.alpha, self.beta)
+
+    sample_shape_aware = True
+
+    def log_prob(self, value):
+        def fn(a, b, v):
+            return (jsp.xlogy(a - 1, v) + jsp.xlog1py(b - 1, -v)
+                    - _betaln(a, b))
+        return _op("beta_log_prob", fn, self.alpha, self.beta, _t(value))
+
+    def entropy(self):
+        def fn(a, b):
+            return (_betaln(a, b) - (a - 1) * jsp.digamma(a)
+                    - (b - 1) * jsp.digamma(b)
+                    + (a + b - 2) * jsp.digamma(a + b))
+        return _op("beta_entropy", fn, self.alpha, self.beta)
+
+
+class Dirichlet(ExponentialFamily):
+    """reference: distribution/dirichlet.py Dirichlet(concentration)."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        super().__init__(
+            batch_shape=tuple(self.concentration.shape[:-1]),
+            event_shape=(self.concentration.shape[-1],))
+
+    @property
+    def mean(self):
+        return _op("dir_mean",
+                   lambda c: c / jnp.sum(c, -1, keepdims=True),
+                   self.concentration)
+
+    @property
+    def variance(self):
+        def fn(c):
+            c0 = jnp.sum(c, -1, keepdims=True)
+            m = c / c0
+            return m * (1 - m) / (c0 + 1)
+        return _op("dir_var", fn, self.concentration)
+
+    def rsample(self, shape=()):
+        key = _key()
+        shp = tuple(shape)
+
+        def fn(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(
+                c, shp + tuple(c.shape)), dtype=c.dtype)
+            return g / jnp.sum(g, -1, keepdims=True)
+        return _op("dir_rsample", fn, self.concentration)
+
+    def log_prob(self, value):
+        def fn(c, v):
+            return (jnp.sum(jsp.xlogy(c - 1, v), -1)
+                    + jsp.gammaln(jnp.sum(c, -1))
+                    - jnp.sum(jsp.gammaln(c), -1))
+        return _op("dir_log_prob", fn, self.concentration, _t(value))
+
+    def entropy(self):
+        def fn(c):
+            k = c.shape[-1]
+            c0 = jnp.sum(c, -1)
+            return (jnp.sum(jsp.gammaln(c), -1) - jsp.gammaln(c0)
+                    + (c0 - k) * jsp.digamma(c0)
+                    - jnp.sum((c - 1) * jsp.digamma(c), -1))
+        return _op("dir_entropy", fn, self.concentration)

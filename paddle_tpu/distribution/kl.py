@@ -1,0 +1,198 @@
+"""KL-divergence registry.
+
+Capability parity: python/paddle/distribution/kl.py (kl_divergence +
+register_kl dispatch table, including the exponential-family Bregman
+fallback).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, _op
+from .normal import Normal, LogNormal
+from .discrete import Bernoulli, Categorical, Geometric, Poisson
+from .gamma_family import (Beta, Dirichlet, Gamma, Exponential,
+                           ExponentialFamily, _betaln)
+from .location_scale import Uniform, Laplace, Cauchy
+from .multivariate import MultivariateNormal
+
+_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """reference: kl.py register_kl decorator."""
+    def deco(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """reference: kl.py kl_divergence — most-derived registered match."""
+    matches = [(cp, cq) for (cp, cq) in _REGISTRY
+               if isinstance(p, cp) and isinstance(q, cq)]
+    if not matches:
+        if isinstance(p, ExponentialFamily) and isinstance(
+                q, ExponentialFamily) and type(p) is type(q):
+            return _kl_expfamily_expfamily(p, q)
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+    def score(pair):
+        cp, cq = pair
+        return (len(cp.__mro__), len(cq.__mro__))
+    cp, cq = max(matches, key=score)
+    return _REGISTRY[(cp, cq)](p, q)
+
+
+def _kl_expfamily_expfamily(p, q):
+    """Bregman-divergence KL for same-family exponential distributions
+    (reference: kl.py _kl_expfamily_expfamily)."""
+    p_nat = p._natural_parameters
+    q_nat = q._natural_parameters
+
+    def fn(*nats):
+        n = len(nats) // 2
+        pn, qn = nats[:n], nats[n:]
+        lg_p = p._log_normalizer(*pn)
+        grads = jax.grad(lambda *a: jnp.sum(p._log_normalizer(*a)),
+                         argnums=tuple(range(n)))(*pn)
+        lg_q = q._log_normalizer(*qn)
+        out = lg_q - lg_p
+        for pi, qi, g in zip(pn, qn, grads):
+            out = out - (qi - pi) * g
+        return out
+    return _op("kl_expfam", fn, *(list(p_nat) + list(q_nat)))
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def fn(m1, s1, m2, s2):
+        var_ratio = jnp.square(s1 / s2)
+        t1 = jnp.square((m1 - m2) / s2)
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return _op("kl_normal", fn, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p._base, q._base)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def fn(l1, h1, l2, h2):
+        res = jnp.log((h2 - l2) / (h1 - l1))
+        return jnp.where((l2 <= l1) & (h1 <= h2), res, jnp.inf)
+    return _op("kl_uniform", fn, p.low, p.high, q.low, q.high)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    def fn(p1, p2):
+        eps = 1e-8
+        p1 = jnp.clip(p1, eps, 1 - eps)
+        p2 = jnp.clip(p2, eps, 1 - eps)
+        return (p1 * (jnp.log(p1) - jnp.log(p2))
+                + (1 - p1) * (jnp.log1p(-p1) - jnp.log1p(-p2)))
+    return _op("kl_bern", fn, p.probs, q.probs)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    def fn(l1, l2):
+        lp = jax.nn.log_softmax(l1, -1)
+        lq = jax.nn.log_softmax(l2, -1)
+        return jnp.sum(jnp.exp(lp) * (lp - lq), -1)
+    return _op("kl_cat", fn, p.logits, q.logits)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    def fn(p1, p2):
+        return (-(1 - p1) / p1 * (jnp.log1p(-p1) - jnp.log1p(-p2))
+                + jnp.log(p1) - jnp.log(p2))
+    return _op("kl_geom", fn, p.probs, q.probs)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    def fn(r1, r2):
+        return r1 * (jnp.log(r1) - jnp.log(r2)) - r1 + r2
+    return _op("kl_poisson", fn, p.rate, q.rate)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    def fn(r1, r2):
+        ratio = r2 / r1
+        return ratio - 1 - jnp.log(ratio)
+    return _op("kl_exp", fn, p.rate, q.rate)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    def fn(a1, r1, a2, r2):
+        return ((a1 - a2) * jsp.digamma(a1) - jsp.gammaln(a1)
+                + jsp.gammaln(a2) + a2 * (jnp.log(r1) - jnp.log(r2))
+                + a1 * (r2 / r1 - 1))
+    return _op("kl_gamma", fn, p.concentration, p.rate,
+               q.concentration, q.rate)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def fn(a1, b1, a2, b2):
+        return (_betaln(a2, b2) - _betaln(a1, b1)
+                + (a1 - a2) * jsp.digamma(a1)
+                + (b1 - b2) * jsp.digamma(b1)
+                + (a2 - a1 + b2 - b1) * jsp.digamma(a1 + b1))
+    return _op("kl_beta", fn, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def fn(c1, c2):
+        s1 = jnp.sum(c1, -1)
+        return (jsp.gammaln(s1) - jnp.sum(jsp.gammaln(c1), -1)
+                - jsp.gammaln(jnp.sum(c2, -1))
+                + jnp.sum(jsp.gammaln(c2), -1)
+                + jnp.sum((c1 - c2) * (jsp.digamma(c1)
+                                       - jsp.digamma(s1)[..., None]), -1))
+    return _op("kl_dirichlet", fn, p.concentration, q.concentration)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    def fn(m1, s1, m2, s2):
+        t = jnp.abs(m1 - m2)
+        return (jnp.log(s2 / s1) + s1 / s2 * jnp.exp(-t / s1)
+                + t / s2 - 1)
+    return _op("kl_laplace", fn, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    def fn(m1, s1, m2, s2):
+        return (jnp.log(jnp.square(s1 + s2) + jnp.square(m1 - m2))
+                - jnp.log(4 * s1 * s2))
+    return _op("kl_cauchy", fn, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    def fn(m1, l1, m2, l2):
+        d = m1.shape[-1]
+        half_ld1 = jnp.sum(jnp.log(jnp.diagonal(l1, axis1=-2, axis2=-1)), -1)
+        half_ld2 = jnp.sum(jnp.log(jnp.diagonal(l2, axis1=-2, axis2=-1)), -1)
+        # tr(Σ2⁻¹ Σ1) = ||L2⁻¹ L1||_F², mahalanobis via triangular solve
+        a = jax.scipy.linalg.solve_triangular(l2, l1, lower=True)
+        tr = jnp.sum(jnp.square(a), axis=(-2, -1))
+        diff = m2 - m1
+        z = jax.scipy.linalg.solve_triangular(
+            l2, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(jnp.square(z), -1)
+        return half_ld2 - half_ld1 + 0.5 * (tr + maha - d)
+    return _op("kl_mvn", fn, p.loc, p.scale_tril, q.loc, q.scale_tril)

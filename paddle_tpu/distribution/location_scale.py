@@ -1,0 +1,275 @@
+"""Location-scale distributions: Uniform, Cauchy, Gumbel, Laplace, StudentT.
+
+Capability parity: python/paddle/distribution/{uniform,cauchy,gumbel,laplace,
+student_t}.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, _t, _op, _key
+
+
+class Uniform(Distribution):
+    """reference: distribution/uniform.py Uniform(low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        shape = jnp.broadcast_shapes(tuple(self.low.shape),
+                                     tuple(self.high.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _op("unif_mean", lambda l, h: (l + h) / 2, self.low, self.high)
+
+    @property
+    def variance(self):
+        return _op("unif_var", lambda l, h: jnp.square(h - l) / 12,
+                   self.low, self.high)
+
+    def rsample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(l, h):
+            u = jax.random.uniform(key, out_shape, l.dtype)
+            return l + (h - l) * u
+        return _op("unif_rsample", fn, self.low, self.high)
+
+    def log_prob(self, value):
+        def fn(l, h, v):
+            inside = (v >= l) & (v < h)
+            return jnp.where(inside, -jnp.log(h - l), -jnp.inf)
+        return _op("unif_log_prob", fn, self.low, self.high, _t(value))
+
+    def entropy(self):
+        return _op("unif_entropy", lambda l, h: jnp.log(h - l),
+                   self.low, self.high)
+
+    def cdf(self, value):
+        def fn(l, h, v):
+            return jnp.clip((v - l) / (h - l), 0.0, 1.0)
+        return _op("unif_cdf", fn, self.low, self.high, _t(value))
+
+    def icdf(self, value):
+        return _op("unif_icdf", lambda l, h, v: l + (h - l) * v,
+                   self.low, self.high, _t(value))
+
+
+class Cauchy(Distribution):
+    """reference: distribution/cauchy.py Cauchy(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(tuple(self.loc.shape),
+                                     tuple(self.scale.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def rsample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(m, s):
+            u = jax.random.uniform(key, out_shape, m.dtype, 1e-7, 1 - 1e-7)
+            return m + s * jnp.tan(math.pi * (u - 0.5))
+        return _op("cauchy_rsample", fn, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(m, s, v):
+            return (-math.log(math.pi) - jnp.log(s)
+                    - jnp.log1p(jnp.square((v - m) / s)))
+        return _op("cauchy_log_prob", fn, self.loc, self.scale, _t(value))
+
+    def entropy(self):
+        def fn(m, s):
+            return jnp.broadcast_to(math.log(4 * math.pi) + jnp.log(s),
+                                    jnp.broadcast_shapes(m.shape, s.shape))
+        return _op("cauchy_entropy", fn, self.loc, self.scale)
+
+    def cdf(self, value):
+        def fn(m, s, v):
+            return jnp.arctan((v - m) / s) / math.pi + 0.5
+        return _op("cauchy_cdf", fn, self.loc, self.scale, _t(value))
+
+    def icdf(self, value):
+        def fn(m, s, v):
+            return m + s * jnp.tan(math.pi * (v - 0.5))
+        return _op("cauchy_icdf", fn, self.loc, self.scale, _t(value))
+
+
+class Gumbel(Distribution):
+    """reference: distribution/gumbel.py Gumbel(loc, scale)."""
+
+    _EULER = 0.5772156649015329
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(tuple(self.loc.shape),
+                                     tuple(self.scale.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return _op("gumbel_mean", lambda m, s: m + s * self._EULER,
+                   self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return _op("gumbel_var",
+                   lambda m, s: (math.pi ** 2 / 6) * jnp.square(s)
+                   + jnp.zeros_like(m), self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(m, s):
+            g = jax.random.gumbel(key, out_shape, m.dtype)
+            return m + s * g
+        return _op("gumbel_rsample", fn, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(m, s, v):
+            z = (v - m) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _op("gumbel_log_prob", fn, self.loc, self.scale, _t(value))
+
+    def entropy(self):
+        def fn(m, s):
+            return jnp.broadcast_to(jnp.log(s) + 1 + self._EULER,
+                                    jnp.broadcast_shapes(m.shape, s.shape))
+        return _op("gumbel_entropy", fn, self.loc, self.scale)
+
+    def cdf(self, value):
+        def fn(m, s, v):
+            return jnp.exp(-jnp.exp(-(v - m) / s))
+        return _op("gumbel_cdf", fn, self.loc, self.scale, _t(value))
+
+
+class Laplace(Distribution):
+    """reference: distribution/laplace.py Laplace(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(tuple(self.loc.shape),
+                                     tuple(self.scale.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _op("laplace_var", lambda s: 2 * jnp.square(s), self.scale)
+
+    @property
+    def stddev(self):
+        return _op("laplace_std", lambda s: math.sqrt(2) * s, self.scale)
+
+    def rsample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(m, s):
+            u = jax.random.uniform(key, out_shape, m.dtype,
+                                   -0.5 + 1e-7, 0.5 - 1e-7)
+            return m - s * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+        return _op("laplace_rsample", fn, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(m, s, v):
+            return -jnp.abs(v - m) / s - jnp.log(2 * s)
+        return _op("laplace_log_prob", fn, self.loc, self.scale, _t(value))
+
+    def entropy(self):
+        def fn(m, s):
+            return jnp.broadcast_to(1 + jnp.log(2 * s),
+                                    jnp.broadcast_shapes(m.shape, s.shape))
+        return _op("laplace_entropy", fn, self.loc, self.scale)
+
+    def cdf(self, value):
+        def fn(m, s, v):
+            z = (v - m) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+        return _op("laplace_cdf", fn, self.loc, self.scale, _t(value))
+
+    def icdf(self, value):
+        def fn(m, s, v):
+            t = v - 0.5
+            return m - s * jnp.sign(t) * jnp.log1p(-2 * jnp.abs(t))
+        return _op("laplace_icdf", fn, self.loc, self.scale, _t(value))
+
+
+class StudentT(Distribution):
+    """reference: distribution/student_t.py StudentT(df, loc, scale)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(tuple(self.df.shape),
+                                     tuple(self.loc.shape),
+                                     tuple(self.scale.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        def fn(df, m):
+            return jnp.where(df > 1, m, jnp.nan)
+        return _op("t_mean", fn, self.df, self.loc)
+
+    @property
+    def variance(self):
+        def fn(df, s):
+            return jnp.where(df > 2, jnp.square(s) * df / (df - 2),
+                             jnp.where(df > 1, jnp.inf, jnp.nan))
+        return _op("t_var", fn, self.df, self.scale)
+
+    def rsample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(df, m, s):
+            t = jax.random.t(key, df, out_shape, m.dtype)
+            return m + s * t
+        return _op("t_rsample", fn, self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(df, m, s, v):
+            z = (v - m) / s
+            return (jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(jnp.square(z) / df))
+        return _op("t_log_prob", fn, self.df, self.loc, self.scale,
+                   _t(value))
+
+    def entropy(self):
+        def fn(df, s):
+            return ((df + 1) / 2 * (jsp.digamma((df + 1) / 2)
+                                    - jsp.digamma(df / 2))
+                    + 0.5 * jnp.log(df)
+                    + jsp.gammaln(df / 2) + jsp.gammaln(0.5)
+                    - jsp.gammaln((df + 1) / 2) + jnp.log(s))
+        return _op("t_entropy", fn, self.df, self.scale)

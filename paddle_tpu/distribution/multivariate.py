@@ -1,0 +1,135 @@
+"""MultivariateNormal + Independent.
+
+Capability parity: python/paddle/distribution/{multivariate_normal,
+independent}.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _op, _key
+
+
+class MultivariateNormal(Distribution):
+    """reference: distribution/multivariate_normal.py
+    MultivariateNormal(loc, covariance_matrix=None, precision_matrix=None,
+    scale_tril=None)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _t(loc)
+        if scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+        elif covariance_matrix is not None:
+            cov = _t(covariance_matrix)
+            self.scale_tril = _op("mvn_chol",
+                                  lambda c: jnp.linalg.cholesky(c), cov)
+        elif precision_matrix is not None:
+            prec = _t(precision_matrix)
+
+            def fn(p):
+                # chol(P)⁻ᵀ gives a valid scale factor of Σ = P⁻¹
+                lp = jnp.linalg.cholesky(p)
+                eye = jnp.eye(p.shape[-1], dtype=p.dtype)
+                linv = jax.scipy.linalg.solve_triangular(lp, eye, lower=True)
+                return jnp.linalg.cholesky(
+                    jnp.swapaxes(linv, -1, -2) @ linv)
+            self.scale_tril = _op("mvn_prec_chol", fn, prec)
+        else:
+            raise ValueError("one of covariance_matrix / precision_matrix / "
+                             "scale_tril must be given")
+        d = self.loc.shape[-1]
+        batch = jnp.broadcast_shapes(tuple(self.loc.shape[:-1]),
+                                     tuple(self.scale_tril.shape[:-2]))
+        super().__init__(batch_shape=batch, event_shape=(d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        return _op("mvn_cov",
+                   lambda l: l @ jnp.swapaxes(l, -1, -2), self.scale_tril)
+
+    @property
+    def variance(self):
+        return _op("mvn_var",
+                   lambda l: jnp.sum(jnp.square(l), -1), self.scale_tril)
+
+    def rsample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(m, l):
+            eps = jax.random.normal(key, out_shape, m.dtype)
+            return m + jnp.einsum("...ij,...j->...i", l, eps)
+        return _op("mvn_rsample", fn, self.loc, self.scale_tril)
+
+    def log_prob(self, value):
+        def fn(m, l, v):
+            diff = v - m
+            z = jax.scipy.linalg.solve_triangular(
+                l, diff[..., None], lower=True)[..., 0]
+            half_logdet = jnp.sum(
+                jnp.log(jnp.diagonal(l, axis1=-2, axis2=-1)), -1)
+            d = m.shape[-1]
+            return (-0.5 * jnp.sum(jnp.square(z), -1) - half_logdet
+                    - 0.5 * d * math.log(2 * math.pi))
+        return _op("mvn_log_prob", fn, self.loc, self.scale_tril, _t(value))
+
+    def entropy(self):
+        def fn(m, l):
+            d = m.shape[-1]
+            half_logdet = jnp.sum(
+                jnp.log(jnp.diagonal(l, axis1=-2, axis2=-1)), -1)
+            return 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+        return _op("mvn_entropy", fn, self.loc, self.scale_tril)
+
+
+class Independent(Distribution):
+    """reference: distribution/independent.py — reinterpret batch dims as
+    event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        shape = base.batch_shape + base.event_shape
+        ndim = self.reinterpreted_batch_rank
+        super().__init__(
+            batch_shape=tuple(base.batch_shape[:len(base.batch_shape)
+                                               - ndim]),
+            event_shape=tuple(shape[len(base.batch_shape) - ndim:]))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        n = self.reinterpreted_batch_rank
+
+        def fn(x):
+            return jnp.sum(x, axis=tuple(range(-n, 0)))
+        return _op("independent_log_prob", fn, lp)
+
+    def entropy(self):
+        ent = self.base.entropy()
+        n = self.reinterpreted_batch_rank
+
+        def fn(x):
+            return jnp.sum(x, axis=tuple(range(-n, 0)))
+        return _op("independent_entropy", fn, ent)

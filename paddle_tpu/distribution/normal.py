@@ -1,0 +1,112 @@
+"""Normal + LogNormal.
+
+Capability parity: python/paddle/distribution/normal.py, lognormal.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, _t, _op, _key
+
+
+class Normal(Distribution):
+    """reference: distribution/normal.py Normal(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(tuple(self.loc.shape),
+                                     tuple(self.scale.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _op("normal_var", lambda s: jnp.square(s), self.scale)
+
+    def rsample(self, shape=()):
+        key = _key()
+        out_shape = self._extend_shape(shape)
+
+        def fn(loc, scale):
+            eps = jax.random.normal(key, out_shape, loc.dtype)
+            return loc + scale * eps
+        return _op("normal_rsample", fn, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(loc, scale, v):
+            var = jnp.square(scale)
+            return (-jnp.square(v - loc) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+        return _op("normal_log_prob", fn, self.loc, self.scale, _t(value))
+
+    def entropy(self):
+        def fn(loc, scale):
+            return jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale),
+                jnp.broadcast_shapes(loc.shape, scale.shape))
+        return _op("normal_entropy", fn, self.loc, self.scale)
+
+    def cdf(self, value):
+        def fn(loc, scale, v):
+            return 0.5 * (1 + jsp.erf((v - loc) / (scale * math.sqrt(2))))
+        return _op("normal_cdf", fn, self.loc, self.scale, _t(value))
+
+    def icdf(self, value):
+        def fn(loc, scale, v):
+            return loc + scale * math.sqrt(2) * jsp.erfinv(2 * v - 1)
+        return _op("normal_icdf", fn, self.loc, self.scale, _t(value))
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class LogNormal(Distribution):
+    """reference: distribution/lognormal.py LogNormal(loc, scale):
+    exp(Normal(loc, scale))."""
+
+    def __init__(self, loc, scale, name=None):
+        self._base = Normal(loc, scale)
+        self.loc = self._base.loc
+        self.scale = self._base.scale
+        super().__init__(batch_shape=self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return _op("lognormal_mean",
+                   lambda m, s: jnp.exp(m + jnp.square(s) / 2),
+                   self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return _op(
+            "lognormal_var",
+            lambda m, s: (jnp.exp(jnp.square(s)) - 1)
+            * jnp.exp(2 * m + jnp.square(s)),
+            self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        base = self._base.rsample(shape)
+        return _op("lognormal_rsample", lambda b: jnp.exp(b), base)
+
+    def log_prob(self, value):
+        v = _t(value)
+        base_lp = self._base.log_prob(
+            _op("log", lambda x: jnp.log(x), v))
+        return _op("lognormal_log_prob",
+                   lambda lp, x: lp - jnp.log(x), base_lp, v)
+
+    def entropy(self):
+        ent = self._base.entropy()
+        return _op("lognormal_entropy", lambda e, m: e + m, ent, self.loc)

@@ -1,0 +1,381 @@
+"""Bijective transforms + TransformedDistribution.
+
+Capability parity: python/paddle/distribution/transform.py (Transform,
+AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform)
+and transformed_distribution.py.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _t, _op
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @staticmethod
+    def is_injective(t):
+        return t in (Type.BIJECTION, Type.INJECTION)
+
+
+class Transform:
+    """reference: transform.py Transform."""
+
+    _type = Type.INJECTION
+
+    @property
+    def type(self):
+        return self._type
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    def forward(self, x):
+        return _op(f"{type(self).__name__}_fwd", self._forward, _t(x))
+
+    def inverse(self, y):
+        return _op(f"{type(self).__name__}_inv", self._inverse, _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return _op(f"{type(self).__name__}_fldj",
+                   self._forward_log_det_jacobian, _t(x))
+
+    def inverse_log_det_jacobian(self, y):
+        def fn(y_):
+            return -self._forward_log_det_jacobian(self._inverse(y_))
+        return _op(f"{type(self).__name__}_ildj", fn, _t(y))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # subclass hooks over raw jnp arrays
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # one branch of the preimage
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return _op("affine_fwd", lambda l, s, x_: l + s * x_,
+                   self.loc, self.scale, _t(x))
+
+    def inverse(self, y):
+        return _op("affine_inv", lambda l, s, y_: (y_ - l) / s,
+                   self.loc, self.scale, _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return _op("affine_fldj",
+                   lambda s, x_: jnp.broadcast_to(
+                       jnp.log(jnp.abs(s)),
+                       jnp.broadcast_shapes(s.shape, x_.shape)),
+                   self.scale, _t(x))
+
+    def inverse_log_det_jacobian(self, y):
+        return _op("affine_ildj",
+                   lambda s, y_: jnp.broadcast_to(
+                       -jnp.log(jnp.abs(s)),
+                       jnp.broadcast_shapes(s.shape, y_.shape)),
+                   self.scale, _t(y))
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return _op("power_fwd", lambda p, x_: jnp.power(x_, p),
+                   self.power, _t(x))
+
+    def inverse(self, y):
+        return _op("power_inv", lambda p, y_: jnp.power(y_, 1 / p),
+                   self.power, _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return _op("power_fldj",
+                   lambda p, x_: jnp.log(jnp.abs(p * jnp.power(x_, p - 1))),
+                   self.power, _t(x))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if int(np.prod(self.in_event_shape)) != int(
+                np.prod(self.out_event_shape)):
+            raise ValueError("in/out event sizes must match")
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.reshape(x, batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return jnp.reshape(y, batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.in_event_shape
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^k -> (k+1)-simplex."""
+    _type = Type.INJECTION
+
+    def _forward(self, x):
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        zpad = jnp.concatenate([z, jnp.ones(z.shape[:-1] + (1,), x.dtype)],
+                               -1)
+        one_m = jnp.concatenate(
+            [jnp.ones(z.shape[:-1] + (1,), x.dtype),
+             jnp.cumprod(1 - z, -1)], -1)
+        return zpad * one_m
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        offset = y.shape[-1] - jnp.arange(1, y.shape[-1])
+        rest = 1 - jnp.cumsum(y_crop, -1) + y_crop
+        z = y_crop / rest
+        return (jnp.log(z) - jnp.log1p(-z)
+                + jnp.log(offset.astype(y.dtype)))
+
+    def _forward_log_det_jacobian(self, x):
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        t = x - jnp.log(offset.astype(x.dtype))
+        z = jax.nn.sigmoid(t)
+        remainder = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumprod(1 - z, -1)[..., :-1]], -1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(remainder), -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ ... ∘ t_1."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._type = (Type.BIJECTION if all(
+            t.type == Type.BIJECTION for t in self.transforms)
+            else Type.INJECTION)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else _op(
+                "chain_add", lambda a, b: a + b, total, ld)
+            x = t.forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Sum the log-det over trailing batch dims (event reinterpretation)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._type = base.type
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(x)
+        n = self.reinterpreted_batch_rank
+        return _op("indep_fldj",
+                   lambda a: jnp.sum(a, axis=tuple(range(-n, 0))), ld)
+
+
+class StackTransform(Transform):
+    """Apply different transforms along slices of one axis."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _slices(self, x):
+        from ..tensor.manipulation import unstack
+        return unstack(x, axis=self.axis)
+
+    def forward(self, x):
+        from ..tensor.manipulation import stack
+        parts = self._slices(_t(x))
+        return stack([t.forward(p) for t, p in zip(self.transforms, parts)],
+                     axis=self.axis)
+
+    def inverse(self, y):
+        from ..tensor.manipulation import stack
+        parts = self._slices(_t(y))
+        return stack([t.inverse(p) for t, p in zip(self.transforms, parts)],
+                     axis=self.axis)
+
+    def forward_log_det_jacobian(self, x):
+        from ..tensor.manipulation import stack
+        parts = self._slices(_t(x))
+        return stack([t.forward_log_det_jacobian(p)
+                      for t, p in zip(self.transforms, parts)],
+                     axis=self.axis)
+
+
+class TransformedDistribution(Distribution):
+    """reference: transformed_distribution.py."""
+
+    def __init__(self, base, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        shape = chain.forward_shape(base.batch_shape + base.event_shape)
+        nb = len(base.batch_shape)
+        super().__init__(batch_shape=tuple(shape[:nb]),
+                         event_shape=tuple(shape[nb:]))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        x.stop_gradient = True
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = _t(value)
+        ld_total = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ld = t.forward_log_det_jacobian(x)
+            ld_total = ld if ld_total is None else _op(
+                "td_add", lambda a, b: a + b, ld_total, ld)
+            y = x
+        base_lp = self.base.log_prob(y)
+        return _op("td_log_prob", lambda lp, ld: lp - ld, base_lp, ld_total)

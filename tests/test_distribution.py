@@ -1,0 +1,268 @@
+"""Distribution tests (reference capability: python/paddle/distribution/,
+SURVEY §2 #71).  Golden values from scipy.stats."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestNormal:
+    def test_log_prob_matches_scipy(self):
+        n = D.Normal(loc=1.0, scale=2.0)
+        v = np.array([-1.0, 0.0, 2.5], dtype="float32")
+        np.testing.assert_allclose(
+            _np(n.log_prob(paddle.to_tensor(v))),
+            st.norm(1.0, 2.0).logpdf(v), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(n.cdf(paddle.to_tensor(v))),
+            st.norm(1.0, 2.0).cdf(v), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(n.entropy()), st.norm(1.0, 2.0).entropy(), rtol=1e-6)
+
+    def test_icdf_inverts_cdf(self):
+        n = D.Normal(0.0, 1.0)
+        v = paddle.to_tensor(np.array([0.1, 0.5, 0.9], dtype="float32"))
+        np.testing.assert_allclose(_np(n.cdf(n.icdf(v))), _np(v), atol=1e-5)
+
+    def test_rsample_grad(self):
+        loc = paddle.to_tensor(np.array(0.5, dtype="float32"))
+        loc.stop_gradient = False
+        n = D.Normal(loc, 1.0)
+        s = n.rsample((64,))
+        s.mean().backward()
+        assert loc.grad is not None
+
+    def test_sample_stats(self):
+        n = D.Normal(2.0, 3.0)
+        s = _np(n.sample((20000,)))
+        assert abs(s.mean() - 2.0) < 0.1
+        assert abs(s.std() - 3.0) < 0.1
+
+
+class TestUnivariate:
+    @pytest.mark.parametrize("dist,ref,vals", [
+        (lambda: D.Beta(2.0, 3.0), st.beta(2, 3), [0.2, 0.5, 0.8]),
+        (lambda: D.Gamma(2.0, 3.0), st.gamma(2, scale=1 / 3), [0.5, 1.0]),
+        (lambda: D.Exponential(1.5), st.expon(scale=1 / 1.5), [0.3, 2.0]),
+        (lambda: D.Laplace(0.0, 2.0), st.laplace(0, 2), [-1.0, 0.5]),
+        (lambda: D.Gumbel(1.0, 2.0), st.gumbel_r(1, 2), [0.0, 3.0]),
+        (lambda: D.Cauchy(0.0, 1.0), st.cauchy(0, 1), [-2.0, 0.3]),
+        (lambda: D.StudentT(5.0, 0.0, 1.0), st.t(5), [-1.0, 0.7]),
+        (lambda: D.Uniform(-1.0, 3.0), st.uniform(-1, 4), [0.0, 2.0]),
+        (lambda: D.LogNormal(0.0, 1.0), st.lognorm(1.0), [0.5, 2.0]),
+        (lambda: D.Chi2(4.0), st.chi2(4), [1.0, 3.0]),
+    ])
+    def test_log_prob_matches_scipy(self, dist, ref, vals):
+        d = dist()
+        v = np.asarray(vals, dtype="float32")
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(v))), ref.logpdf(v),
+            rtol=2e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("dist,ref", [
+        (lambda: D.Beta(2.0, 3.0), st.beta(2, 3)),
+        (lambda: D.Gamma(2.0, 3.0), st.gamma(2, scale=1 / 3)),
+        (lambda: D.Exponential(1.5), st.expon(scale=1 / 1.5)),
+        (lambda: D.Laplace(0.0, 2.0), st.laplace(0, 2)),
+        (lambda: D.Uniform(-1.0, 3.0), st.uniform(-1, 4)),
+    ])
+    def test_entropy_and_moments(self, dist, ref):
+        d = dist()
+        np.testing.assert_allclose(float(d.entropy()), ref.entropy(),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(float(d.mean), ref.mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(d.variance), ref.var(), rtol=1e-5)
+
+    def test_rsample_shapes(self):
+        d = D.Beta(np.full((3,), 2.0, "float32"),
+                   np.full((3,), 3.0, "float32"))
+        assert d.rsample((5,)).shape == [5, 3]
+        assert D.Gamma(2.0, 2.0).rsample((4,)).shape == [4]
+
+
+class TestDiscrete:
+    def test_bernoulli(self):
+        b = D.Bernoulli(0.3)
+        v = np.array([0.0, 1.0], dtype="float32")
+        np.testing.assert_allclose(
+            _np(b.log_prob(paddle.to_tensor(v))),
+            st.bernoulli(0.3).logpmf(v.astype(int)), rtol=1e-5)
+        np.testing.assert_allclose(float(b.entropy()),
+                                   st.bernoulli(0.3).entropy(), rtol=1e-5)
+        s = _np(b.sample((5000,)))
+        assert abs(s.mean() - 0.3) < 0.05
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], dtype="float32"))
+        c = D.Categorical(logits)
+        lp = _np(c.log_prob(paddle.to_tensor(
+            np.array([0, 1, 2], dtype="int64"))))
+        np.testing.assert_allclose(lp, np.log([0.2, 0.3, 0.5]), rtol=1e-5)
+        s = _np(c.sample((8000,)))
+        freq = np.bincount(s, minlength=3) / len(s)
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+        np.testing.assert_allclose(
+            float(c.entropy()),
+            -(np.array([.2, .3, .5]) * np.log([.2, .3, .5])).sum(),
+            rtol=1e-5)
+
+    def test_poisson_binomial_geometric(self):
+        p = D.Poisson(4.0)
+        v = np.array([2.0, 5.0], dtype="float32")
+        np.testing.assert_allclose(
+            _np(p.log_prob(paddle.to_tensor(v))),
+            st.poisson(4).logpmf(v.astype(int)), rtol=1e-5)
+        b = D.Binomial(10, 0.4)
+        np.testing.assert_allclose(
+            _np(b.log_prob(paddle.to_tensor(v))),
+            st.binom(10, 0.4).logpmf(v.astype(int)), rtol=1e-4)
+        g = D.Geometric(0.3)
+        np.testing.assert_allclose(
+            _np(g.log_prob(paddle.to_tensor(v))),
+            st.geom(0.3, loc=-1).logpmf(v.astype(int)), rtol=1e-5)
+
+    def test_multinomial(self):
+        m = D.Multinomial(10, np.array([0.2, 0.3, 0.5], dtype="float32"))
+        v = np.array([2.0, 3.0, 5.0], dtype="float32")
+        np.testing.assert_allclose(
+            float(m.log_prob(paddle.to_tensor(v))),
+            st.multinomial(10, [0.2, 0.3, 0.5]).logpmf(v.astype(int)),
+            rtol=1e-5)
+        s = _np(m.sample((64,)))
+        assert s.shape == (64, 3)
+        np.testing.assert_allclose(s.sum(-1), 10.0)
+
+
+class TestMultivariate:
+    def test_mvn_log_prob(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], dtype="float32")
+        loc = np.array([1.0, -1.0], dtype="float32")
+        mvn = D.MultivariateNormal(loc, covariance_matrix=cov)
+        v = np.array([0.5, 0.0], dtype="float32")
+        np.testing.assert_allclose(
+            float(mvn.log_prob(paddle.to_tensor(v))),
+            st.multivariate_normal(loc, cov).logpdf(v), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(mvn.entropy()),
+            st.multivariate_normal(loc, cov).entropy(), rtol=1e-5)
+        assert mvn.rsample((7,)).shape == [7, 2]
+
+    def test_dirichlet(self):
+        c = np.array([1.0, 2.0, 3.0], dtype="float32")
+        d = D.Dirichlet(c)
+        v = np.array([0.2, 0.3, 0.5], dtype="float32")
+        np.testing.assert_allclose(
+            float(d.log_prob(paddle.to_tensor(v))),
+            st.dirichlet(c).logpdf(v), rtol=1e-5)
+        s = _np(d.rsample((16,)))
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+
+    def test_independent(self):
+        base = D.Normal(np.zeros((3, 4), "float32"),
+                        np.ones((3, 4), "float32"))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,)
+        assert ind.event_shape == (4,)
+        v = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+        np.testing.assert_allclose(
+            _np(ind.log_prob(v)), _np(base.log_prob(v)).sum(-1), rtol=1e-5)
+
+
+class TestTransforms:
+    @pytest.mark.parametrize("t,x", [
+        (D.ExpTransform(), [0.5, -1.0]),
+        (D.SigmoidTransform(), [0.5, -1.0]),
+        (D.TanhTransform(), [0.5, -1.0]),
+        (D.AffineTransform(1.0, 2.0), [0.5, -1.0]),
+        (D.PowerTransform(2.0), [0.5, 1.5]),
+    ])
+    def test_inverse_roundtrip(self, t, x):
+        v = paddle.to_tensor(np.asarray(x, dtype="float32"))
+        np.testing.assert_allclose(_np(t.inverse(t.forward(v))), _np(v),
+                                   atol=1e-5)
+
+    def test_log_det_jacobian_numeric(self):
+        # d/dx sigmoid = sigmoid(x)(1-sigmoid(x))
+        t = D.SigmoidTransform()
+        x = np.array([0.3], dtype="float32")
+        ld = _np(t.forward_log_det_jacobian(paddle.to_tensor(x)))[0]
+        sig = 1 / (1 + np.exp(-x[0]))
+        np.testing.assert_allclose(ld, np.log(sig * (1 - sig)), rtol=1e-5)
+
+    def test_stickbreaking(self):
+        t = D.StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.1, -0.2, 0.4], dtype="float32"))
+        y = t.forward(x)
+        assert y.shape == [4]
+        np.testing.assert_allclose(_np(y).sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(_np(t.inverse(y)), _np(x), atol=1e-4)
+
+    def test_transformed_distribution(self):
+        # exp(Normal) must equal LogNormal
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                       [D.ExpTransform()])
+        ln = D.LogNormal(0.0, 1.0)
+        v = paddle.to_tensor(np.array([0.5, 1.5], dtype="float32"))
+        np.testing.assert_allclose(_np(td.log_prob(v)), _np(ln.log_prob(v)),
+                                   rtol=1e-5)
+        assert td.sample((3,)).shape == [3]
+
+    def test_chain_and_independent_transform(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        x = paddle.to_tensor(np.array([0.1, 0.2], dtype="float32"))
+        np.testing.assert_allclose(_np(chain.inverse(chain.forward(x))),
+                                   _np(x), atol=1e-5)
+        it = D.IndependentTransform(D.ExpTransform(), 1)
+        ld = it.forward_log_det_jacobian(x)
+        np.testing.assert_allclose(float(ld), _np(x).sum(), rtol=1e-5)
+
+
+class TestKL:
+    def test_kl_normal_closed_form(self):
+        p = D.Normal(0.0, 1.0)
+        q = D.Normal(1.0, 2.0)
+        kl = float(D.kl_divergence(p, q))
+        expect = (np.log(2.0) + (1 + 1) / (2 * 4) - 0.5)
+        np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+    @pytest.mark.parametrize("maker", [
+        lambda: D.Normal(0.3, 1.2),
+        lambda: D.Bernoulli(0.4),
+        lambda: D.Categorical(np.log(np.array([.2, .8], dtype="float32"))),
+        lambda: D.Beta(2.0, 3.0),
+        lambda: D.Gamma(2.0, 2.0),
+        lambda: D.Exponential(1.1),
+        lambda: D.Laplace(0.0, 1.0),
+        lambda: D.Dirichlet(np.array([1.0, 2.0], dtype="float32")),
+        lambda: D.Poisson(3.0),
+        lambda: D.Geometric(0.4),
+    ])
+    def test_kl_self_is_zero(self, maker):
+        p, q = maker(), maker()
+        np.testing.assert_allclose(float(D.kl_divergence(p, q)), 0.0,
+                                   atol=1e-5)
+
+    def test_kl_mvn(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], dtype="float32")
+        p = D.MultivariateNormal(np.zeros(2, "float32"),
+                                 covariance_matrix=cov)
+        q = D.MultivariateNormal(np.ones(2, "float32"),
+                                 covariance_matrix=np.eye(2, dtype="float32"))
+        kl = float(D.kl_divergence(p, q))
+        # closed form: 0.5*(tr(Σq⁻¹Σp) + maha - d + ln det Σq/det Σp)
+        expect = 0.5 * (np.trace(np.linalg.inv(np.eye(2)) @ cov)
+                        + 2.0 - 2
+                        + np.log(np.linalg.det(np.eye(2))
+                                 / np.linalg.det(cov)))
+        np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+    def test_kl_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(0.0, 1.0), D.Gumbel(0.0, 1.0))
