@@ -52,6 +52,10 @@ from . import distributed  # noqa: F401
 from . import static  # noqa: F401
 from . import incubate  # noqa: F401
 from . import distribution  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import geometric  # noqa: F401
+from .tensor import linalg  # noqa: F401 (paddle.linalg alias)
 
 
 def disable_static():

@@ -1,0 +1,96 @@
+"""Discrete Fourier transforms.
+
+Capability parity: python/paddle/fft.py in the reference (fft/ifft/rfft/
+irfft/hfft/ihfft + 2d/nd variants + fftfreq/fftshift helpers).  All routes
+through jnp.fft (XLA FFT lowering; TPU executes via the XLA FFT HLO).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.dispatch import def_op
+from .framework.tensor import wrap_array
+
+
+def _norm(norm):
+    return norm if norm in ("ortho", "forward") else "backward"
+
+
+def _mk1(name, jfn, default_axis=-1):
+    @def_op(name)
+    def op(x, n=None, axis=default_axis, norm="backward"):
+        return jfn(x, n=n, axis=axis, norm=_norm(norm))
+    op.__name__ = name
+    op.__doc__ = f"reference: paddle.fft.{name}"
+    return op
+
+
+def _mk2(name, jfn):
+    @def_op(name)
+    def op(x, s=None, axes=(-2, -1), norm="backward"):
+        return jfn(x, s=s, axes=tuple(axes), norm=_norm(norm))
+    op.__name__ = name
+    op.__doc__ = f"reference: paddle.fft.{name}"
+    return op
+
+
+def _mkn(name, jfn):
+    @def_op(name)
+    def op(x, s=None, axes=None, norm="backward"):
+        return jfn(x, s=s, axes=axes, norm=_norm(norm))
+    op.__name__ = name
+    op.__doc__ = f"reference: paddle.fft.{name}"
+    return op
+
+
+fft = _mk1("fft", jnp.fft.fft)
+ifft = _mk1("ifft", jnp.fft.ifft)
+rfft = _mk1("rfft", jnp.fft.rfft)
+irfft = _mk1("irfft", jnp.fft.irfft)
+hfft = _mk1("hfft", jnp.fft.hfft)
+ihfft = _mk1("ihfft", jnp.fft.ihfft)
+fft2 = _mk2("fft2", jnp.fft.fft2)
+ifft2 = _mk2("ifft2", jnp.fft.ifft2)
+rfft2 = _mk2("rfft2", jnp.fft.rfft2)
+irfft2 = _mk2("irfft2", jnp.fft.irfft2)
+fftn = _mkn("fftn", jnp.fft.fftn)
+ifftn = _mkn("ifftn", jnp.fft.ifftn)
+rfftn = _mkn("rfftn", jnp.fft.rfftn)
+irfftn = _mkn("irfftn", jnp.fft.irfftn)
+
+
+@def_op("hfft2")
+def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.hfft(jnp.fft.ifft(x, axis=axes[0], norm=_norm(norm)),
+                        n=None if s is None else s[-1], axis=axes[1],
+                        norm=_norm(norm))
+
+
+@def_op("ihfft2")
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.ihfft(jnp.fft.fft(x, axis=axes[0], norm=_norm(norm)),
+                         n=None if s is None else s[-1], axis=axes[1],
+                         norm=_norm(norm))
+
+
+@def_op("fftshift")
+def fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@def_op("ifftshift")
+def ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+def fftfreq(n, d=1.0, dtype="float32", name=None):
+    return wrap_array(jnp.fft.fftfreq(n, d).astype(dtype))
+
+
+def rfftfreq(n, d=1.0, dtype="float32", name=None):
+    return wrap_array(jnp.fft.rfftfreq(n, d).astype(dtype))
+
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "hfft2", "ihfft2", "fftn", "ifftn", "rfftn",
+           "irfftn", "fftshift", "ifftshift", "fftfreq", "rfftfreq"]

@@ -1,0 +1,162 @@
+"""Graph learning ops: message passing, segment reductions, reindex,
+neighbor sampling.
+
+Capability parity: python/paddle/geometric/ in the reference
+(message_passing/send_recv.py send_u_recv/send_ue_recv/send_uv,
+math.py segment_sum/mean/max/min, reindex.py reindex_graph,
+sampling/neighbors.py sample_neighbors).
+
+TPU-native: segment reductions map to jax.ops.segment_* (one-hot/scatter
+fused by XLA); gather/scatter message passing is static-shaped.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import def_op
+from ..framework.tensor import Tensor, wrap_array
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min", "reindex_graph",
+           "sample_neighbors"]
+
+
+def _num_segments(count, data_len):
+    return int(count) if count is not None else None
+
+
+@def_op("segment_sum")
+def segment_sum(data, segment_ids):
+    n = None
+    return jax.ops.segment_sum(data, segment_ids.astype(jnp.int32),
+                               num_segments=n)
+
+
+@def_op("segment_mean")
+def segment_mean(data, segment_ids):
+    ids = segment_ids.astype(jnp.int32)
+    s = jax.ops.segment_sum(data, ids)
+    cnt = jax.ops.segment_sum(jnp.ones(ids.shape, data.dtype), ids)
+    shape = cnt.shape + (1,) * (s.ndim - cnt.ndim)
+    return s / jnp.maximum(cnt.reshape(shape), 1)
+
+
+@def_op("segment_max")
+def segment_max(data, segment_ids):
+    return jax.ops.segment_max(data, segment_ids.astype(jnp.int32))
+
+
+@def_op("segment_min")
+def segment_min(data, segment_ids):
+    return jax.ops.segment_min(data, segment_ids.astype(jnp.int32))
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "add": jax.ops.segment_sum,
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _reduce(msg, dst, pool_type, out_size):
+    ids = dst.astype(jnp.int32)
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(msg, ids, num_segments=out_size)
+        cnt = jax.ops.segment_sum(jnp.ones(ids.shape, msg.dtype), ids,
+                                  num_segments=out_size)
+        shape = cnt.shape + (1,) * (s.ndim - cnt.ndim)
+        return s / jnp.maximum(cnt.reshape(shape), 1)
+    return _REDUCERS[pool_type](msg, ids, num_segments=out_size)
+
+
+@def_op("send_u_recv")
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None):
+    """reference: geometric/message_passing/send_recv.py send_u_recv —
+    gather x[src], reduce onto dst."""
+    out_size = int(out_size) if out_size is not None else x.shape[0]
+    msg = x[src_index.astype(jnp.int32)]
+    return _reduce(msg, dst_index, reduce_op, out_size)
+
+
+@def_op("send_ue_recv")
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None):
+    """reference: send_ue_recv — combine node features with edge features
+    then reduce."""
+    out_size = int(out_size) if out_size is not None else x.shape[0]
+    u = x[src_index.astype(jnp.int32)]
+    if message_op in ("add", "sum"):
+        msg = u + y
+    elif message_op == "sub":
+        msg = u - y
+    elif message_op == "mul":
+        msg = u * y
+    elif message_op == "div":
+        msg = u / y
+    else:
+        raise ValueError(f"unknown message_op {message_op}")
+    return _reduce(msg, dst_index, reduce_op, out_size)
+
+
+@def_op("send_uv")
+def send_uv(x, y, src_index, dst_index, message_op="add"):
+    """reference: send_uv — per-edge message from both endpoints."""
+    u = x[src_index.astype(jnp.int32)]
+    v = y[dst_index.astype(jnp.int32)]
+    if message_op in ("add", "sum"):
+        return u + v
+    if message_op == "sub":
+        return u - v
+    if message_op == "mul":
+        return u * v
+    if message_op == "div":
+        return u / v
+    raise ValueError(f"unknown message_op {message_op}")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None):
+    """reference: geometric/reindex.py reindex_graph — compact global node
+    ids to local ids (host-side, like the reference's CPU kernel)."""
+    xs = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    nb = np.asarray(neighbors.numpy()
+                    if isinstance(neighbors, Tensor) else neighbors)
+    cnt = np.asarray(count.numpy() if isinstance(count, Tensor) else count)
+    uniq, inverse = np.unique(np.concatenate([xs, nb]), return_inverse=True)
+    # order nodes: seeds first, then new neighbor nodes in appearance order
+    mapping = {}
+    for v in xs.tolist():
+        mapping.setdefault(v, len(mapping))
+    for v in nb.tolist():
+        mapping.setdefault(v, len(mapping))
+    reindex_nb = np.array([mapping[v] for v in nb.tolist()], dtype=np.int64)
+    out_nodes = np.array(sorted(mapping, key=mapping.get), dtype=np.int64)
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return (wrap_array(jnp.asarray(reindex_nb)),
+            wrap_array(jnp.asarray(dst)),
+            wrap_array(jnp.asarray(out_nodes)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None):
+    """reference: geometric/sampling/neighbors.py sample_neighbors — uniform
+    neighbor sampling on a CSC graph (host-side)."""
+    rows = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    ptr = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes.numpy()
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    rng = np.random.default_rng(0)
+    out_nb, out_cnt = [], []
+    for nd in nodes.tolist():
+        lo, hi = int(ptr[nd]), int(ptr[nd + 1])
+        nbrs = rows[lo:hi]
+        if sample_size >= 0 and len(nbrs) > sample_size:
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
+        out_nb.append(nbrs)
+        out_cnt.append(len(nbrs))
+    neighbors = np.concatenate(out_nb) if out_nb else np.zeros(0, np.int64)
+    counts = np.asarray(out_cnt, dtype=np.int64)
+    return (wrap_array(jnp.asarray(neighbors.astype(np.int64))),
+            wrap_array(jnp.asarray(counts)))

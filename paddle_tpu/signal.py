@@ -1,0 +1,111 @@
+"""Signal processing: frame / overlap_add / stft / istft.
+
+Capability parity: python/paddle/signal.py in the reference.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .framework.dispatch import def_op
+from .framework.tensor import Tensor
+
+
+@def_op("frame")
+def frame(x, frame_length, hop_length, axis=-1):
+    """reference: paddle.signal.frame — slice overlapping frames."""
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    n = x.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num_frames)[:, None])
+    out = x[..., idx]                       # (..., num_frames, frame_length)
+    out = jnp.swapaxes(out, -1, -2)         # (..., frame_length, num_frames)
+    if axis not in (-1, x.ndim - 1):
+        out = jnp.moveaxis(out, -1, axis)
+    return out
+
+
+@def_op("overlap_add")
+def overlap_add(x, hop_length, axis=-1):
+    """reference: paddle.signal.overlap_add."""
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    frame_length, num_frames = x.shape[-2], x.shape[-1]
+    n = frame_length + hop_length * (num_frames - 1)
+    idx = (jnp.arange(frame_length)[:, None]
+           + hop_length * jnp.arange(num_frames)[None, :])
+    out = jnp.zeros(x.shape[:-2] + (n,), x.dtype)
+    out = out.at[..., idx].add(x)
+    return out
+
+
+@def_op("stft")
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True):
+    """reference: paddle.signal.stft.  x: (..., seq_len) ->
+    (..., n_fft//2+1 or n_fft, num_frames) complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), x.dtype)
+    else:
+        win = window
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    n = x.shape[-1]
+    num_frames = 1 + (n - n_fft) // hop_length
+    idx = (jnp.arange(n_fft)[None, :]
+           + hop_length * jnp.arange(num_frames)[:, None])
+    frames = x[..., idx] * win                  # (..., num_frames, n_fft)
+    spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+            else jnp.fft.fft(frames, axis=-1))
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return jnp.swapaxes(spec, -1, -2)           # (..., freq, num_frames)
+
+
+@def_op("istft")
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False):
+    """reference: paddle.signal.istft (least-squares window normalization)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,))
+    else:
+        win = window
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    spec = jnp.swapaxes(x, -1, -2)              # (..., num_frames, freq)
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+              else jnp.fft.ifft(spec, axis=-1).real)
+    frames = frames * win
+    num_frames = frames.shape[-2]
+    n = n_fft + hop_length * (num_frames - 1)
+    idx = (jnp.arange(n_fft)[:, None]
+           + hop_length * jnp.arange(num_frames)[None, :])
+    sig = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+    sig = sig.at[..., idx].add(jnp.swapaxes(frames, -1, -2))
+    wsum = jnp.zeros((n,), frames.dtype)
+    wsum = wsum.at[idx.reshape(-1)].add(
+        jnp.tile(jnp.square(win)[:, None], (1, num_frames)).reshape(-1))
+    sig = sig / jnp.maximum(wsum, 1e-10)
+    if center:
+        sig = sig[..., n_fft // 2: n - n_fft // 2]
+    if length is not None:
+        sig = sig[..., :length]
+    return sig
+
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]

@@ -190,3 +190,103 @@ def pca_lowrank(x, q=None, center=True, niter=2):
     u, s, vt = jnp.linalg.svd(x, full_matrices=False)
     q = q or min(x.shape[-2:])
     return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]
+
+
+@def_op("lu")
+def lu(x, pivot=True, get_infos=False):
+    """reference: paddle.linalg.lu — packed LU + pivots (1-based like the
+    reference's LAPACK convention)."""
+    packed, pivots = jax.scipy.linalg.lu_factor(x)
+    out = (packed, pivots.astype(jnp.int32) + 1)
+    if get_infos:
+        info = jnp.zeros(x.shape[:-2], jnp.int32)
+        return out + (info,)
+    return out
+
+
+@def_op("lu_unpack")
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
+    """reference: paddle.linalg.lu_unpack(LU, pivots) -> P, L, U."""
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    l = jnp.tril(x, -1)[..., :, :k] + jnp.eye(m, k, dtype=x.dtype)
+    u = jnp.triu(x)[..., :k, :]
+    piv = (y - 1).astype(jnp.int32)
+
+    def perm_from_pivots(pv):
+        perm = jnp.arange(m, dtype=jnp.int32)
+        def body(i, pm):
+            j = pv[i]
+            a, b = pm[i], pm[j]
+            pm = pm.at[i].set(b).at[j].set(a)
+            return pm
+        from jax import lax as _lax
+        return _lax.fori_loop(0, pv.shape[-1], body, perm)
+
+    if piv.ndim == 1:
+        perm = perm_from_pivots(piv)
+        p = jnp.eye(m, dtype=x.dtype)[perm].T
+    else:
+        flat = piv.reshape((-1, piv.shape[-1]))
+        perms = jax.vmap(perm_from_pivots)(flat)
+        p = jax.vmap(lambda pr: jnp.eye(m, dtype=x.dtype)[pr].T)(perms)
+        p = p.reshape(x.shape[:-2] + (m, m))
+    return p, l, u
+
+
+@def_op("matrix_exp")
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+@def_op("ormqr")
+def ormqr(x, tau, y, left=True, transpose=False):
+    """reference: paddle.linalg.ormqr — multiply by Q from a householder QR."""
+    q = _householder_q(x, tau)
+    qm = jnp.swapaxes(q, -1, -2) if transpose else q
+    return jnp.matmul(qm, y) if left else jnp.matmul(y, qm)
+
+
+def _householder_q(x, tau):
+    m, k = x.shape[-2], tau.shape[-1]
+    q = jnp.eye(m, dtype=x.dtype)
+    for i in range(k):
+        v = jnp.concatenate([jnp.zeros((i,), x.dtype),
+                             jnp.ones((1,), x.dtype), x[i + 1:, i]])
+        q = q @ (jnp.eye(m, dtype=x.dtype)
+                 - tau[i] * jnp.outer(v, v.conj()))
+    return q
+
+
+@def_op("svd_lowrank")
+def svd_lowrank(x, q=6, niter=2, M=None):
+    """reference: paddle.linalg.svd_lowrank — randomized range finder."""
+    if M is not None:
+        x = x - M
+    m, n = x.shape[-2], x.shape[-1]
+    q = min(q, m, n)
+    key = jax.random.key(0)
+    omega = jax.random.normal(key, x.shape[:-2] + (n, q), x.dtype)
+    y = jnp.matmul(x, omega)
+    for _ in range(niter):
+        y = jnp.matmul(x, jnp.matmul(jnp.swapaxes(x, -1, -2), y))
+    qmat, _ = jnp.linalg.qr(y)
+    b = jnp.matmul(jnp.swapaxes(qmat, -1, -2), x)
+    u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    return jnp.matmul(qmat, u), s, jnp.swapaxes(vh, -1, -2)
+
+
+@def_op("cdist")
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    """reference: paddle.cdist — pairwise p-norm distance."""
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.maximum(jnp.sum(jnp.square(diff), -1), 0.0))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), -1)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), -1), 1.0 / p)
+
+
+@def_op("matrix_transpose")
+def matrix_transpose(x):
+    return jnp.swapaxes(x, -1, -2)

@@ -1,0 +1,170 @@
+"""Domain math tests: fft, signal, extended linalg, geometric
+(reference capability: python/paddle/{fft,signal}.py, paddle.linalg,
+python/paddle/geometric/ — SURVEY §2 #84)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import linalg as L
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = np.random.randn(32).astype("float32")
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(_np(paddle.fft.fft(t)), np.fft.fft(x),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            _np(paddle.fft.ifft(paddle.fft.fft(t))).real, x, atol=1e-5)
+
+    def test_rfft_irfft(self):
+        x = np.random.randn(4, 32).astype("float32")
+        t = paddle.to_tensor(x)
+        r = paddle.fft.rfft(t)
+        assert r.shape == [4, 17]
+        np.testing.assert_allclose(_np(paddle.fft.irfft(r, n=32)), x,
+                                   atol=1e-5)
+
+    def test_2d_nd(self):
+        x = np.random.randn(4, 8, 8).astype("float32")
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(_np(paddle.fft.fft2(t)),
+                                   np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(_np(paddle.fft.fftn(t)),
+                                   np.fft.fftn(x), rtol=1e-4, atol=1e-4)
+
+    def test_shift_freq(self):
+        x = np.random.randn(8).astype("float32")
+        np.testing.assert_allclose(
+            _np(paddle.fft.fftshift(paddle.to_tensor(x))), np.fft.fftshift(x))
+        np.testing.assert_allclose(_np(paddle.fft.fftfreq(8, 0.5)),
+                                   np.fft.fftfreq(8, 0.5).astype("float32"))
+
+    def test_norm_modes(self):
+        x = np.random.randn(16).astype("float32")
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(_np(paddle.fft.fft(t, norm="ortho")),
+                                   np.fft.fft(x, norm="ortho"), rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestSignal:
+    def test_frame_overlap_add_inverse(self):
+        x = np.arange(16, dtype="float32")
+        fr = paddle.signal.frame(paddle.to_tensor(x), frame_length=4,
+                                 hop_length=4)
+        assert fr.shape == [4, 4]
+        rec = paddle.signal.overlap_add(fr, hop_length=4)
+        np.testing.assert_allclose(_np(rec), x)
+
+    def test_stft_istft_roundtrip(self):
+        x = np.random.randn(2, 128).astype("float32")
+        t = paddle.to_tensor(x)
+        win = paddle.to_tensor(np.hanning(32).astype("float32"))
+        spec = paddle.signal.stft(t, n_fft=32, hop_length=8, window=win)
+        assert spec.shape[1] == 17
+        rec = paddle.signal.istft(spec, n_fft=32, hop_length=8, window=win,
+                                  length=128)
+        np.testing.assert_allclose(_np(rec), x, atol=1e-4)
+
+    def test_stft_matches_scipy(self):
+        from scipy.signal import stft as sp_stft
+        x = np.random.randn(256).astype("float32")
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64,
+                                  hop_length=32, center=False)
+        # scipy uses a window + scaling; compare rectangular unscaled
+        ref = np.stack([np.fft.rfft(x[i * 32:i * 32 + 64])
+                        for i in range((256 - 64) // 32 + 1)], -1)
+        np.testing.assert_allclose(_np(spec), ref, rtol=1e-3, atol=1e-3)
+
+
+class TestLinalgExt:
+    def test_lu_roundtrip(self):
+        a = np.random.randn(5, 5).astype("float32")
+        lu_, piv = L.lu(paddle.to_tensor(a))
+        P, l, u = L.lu_unpack(lu_, piv)
+        np.testing.assert_allclose(_np(P) @ _np(l) @ _np(u), a, atol=1e-5)
+
+    def test_matrix_exp(self):
+        from scipy.linalg import expm
+        a = np.random.randn(4, 4).astype("float32") * 0.1
+        np.testing.assert_allclose(_np(L.matrix_exp(paddle.to_tensor(a))),
+                                   expm(a), rtol=1e-4, atol=1e-5)
+
+    def test_svd_lowrank(self):
+        a = np.random.randn(8, 6).astype("float32")
+        u, s, v = L.svd_lowrank(paddle.to_tensor(a), q=6)
+        np.testing.assert_allclose(_np(u) @ np.diag(_np(s)) @ _np(v).T, a,
+                                   atol=1e-4)
+
+    def test_cdist(self):
+        from scipy.spatial.distance import cdist as sp_cdist
+        x = np.random.randn(5, 3).astype("float32")
+        y = np.random.randn(7, 3).astype("float32")
+        np.testing.assert_allclose(
+            _np(L.cdist(paddle.to_tensor(x), paddle.to_tensor(y))),
+            sp_cdist(x, y), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            _np(L.cdist(paddle.to_tensor(x), paddle.to_tensor(y), p=1.0)),
+            sp_cdist(x, y, metric="cityblock"), rtol=1e-4, atol=1e-5)
+
+    def test_ormqr(self):
+        a = np.random.randn(4, 3).astype("float32")
+        import scipy.linalg as sl
+        (qr_, tau), _ = sl.qr(a, mode="raw")
+        y = np.random.randn(4, 2).astype("float32")
+        out = L.ormqr(paddle.to_tensor(qr_.astype("float32")),
+                      paddle.to_tensor(tau.astype("float32")),
+                      paddle.to_tensor(y))
+        q_full = sl.qr(a)[0]
+        np.testing.assert_allclose(_np(out), q_full @ y, atol=1e-4)
+
+
+class TestGeometric:
+    def test_send_u_recv(self):
+        x = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                      dtype="float32"))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], dtype="int64"))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], dtype="int64"))
+        out = paddle.geometric.send_u_recv(x, src, dst, "sum")
+        np.testing.assert_allclose(
+            _np(out), [[1., 2.], [6., 8.], [3., 4.]])
+        out_max = paddle.geometric.send_u_recv(x, src, dst, "max")
+        np.testing.assert_allclose(
+            _np(out_max), [[1., 2.], [5., 6.], [3., 4.]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = paddle.to_tensor(np.ones((3, 2), "float32"))
+        e = paddle.to_tensor(np.full((3, 2), 2.0, "float32"))
+        src = paddle.to_tensor(np.array([0, 1, 2], dtype="int64"))
+        dst = paddle.to_tensor(np.array([0, 0, 1], dtype="int64"))
+        out = paddle.geometric.send_ue_recv(x, e, src, dst, "mul", "sum")
+        np.testing.assert_allclose(_np(out)[0], [4., 4.])
+        uv = paddle.geometric.send_uv(x, e, src, dst, "add")
+        np.testing.assert_allclose(_np(uv), np.full((3, 2), 3.0))
+
+    def test_segment_ops(self):
+        data = paddle.to_tensor(np.array([1., 2., 3., 4.], "float32"))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1], dtype="int64"))
+        np.testing.assert_allclose(
+            _np(paddle.geometric.segment_sum(data, ids)), [3., 7.])
+        np.testing.assert_allclose(
+            _np(paddle.geometric.segment_mean(data, ids)), [1.5, 3.5])
+        np.testing.assert_allclose(
+            _np(paddle.geometric.segment_max(data, ids)), [2., 4.])
+        np.testing.assert_allclose(
+            _np(paddle.geometric.segment_min(data, ids)), [1., 3.])
+
+    def test_sample_neighbors(self):
+        # CSC: node0 -> [1,2], node1 -> [0], node2 -> [0,1]
+        row = paddle.to_tensor(np.array([1, 2, 0, 0, 1], dtype="int64"))
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 5], dtype="int64"))
+        nodes = paddle.to_tensor(np.array([0, 2], dtype="int64"))
+        nb, cnt = paddle.geometric.sample_neighbors(row, colptr, nodes,
+                                                    sample_size=-1)
+        np.testing.assert_allclose(_np(cnt), [2, 2])
+        np.testing.assert_allclose(_np(nb), [1, 2, 0, 1])
