@@ -1,0 +1,446 @@
+"""Sparse tensor API: COO/CSR tensors + functional ops + sparse nn.
+
+Capability parity: python/paddle/sparse/ in the reference (creation:
+sparse_coo_tensor/sparse_csr_tensor; unary/binary ops; matmul/masked_matmul;
+nn layers) over phi sparse kernels (paddle/phi/kernels/sparse/, SURVEY §2
+#11/#69).
+
+TPU-native: values/indices are dense jax arrays (static nnz — XLA needs
+static shapes, so nnz is fixed at construction like the reference's
+dense-backed COO buffers).  Elementwise ops act on the values tensor through
+the normal op dispatch, so they are tape-differentiable; matmul scatters
+per-row products with segment-sum (fused by XLA).  The heavy 3-D sparse
+convs run via gather/scatter on the active-site list.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import def_op, call_op
+from ..framework.tensor import Tensor, wrap_array
+from ..framework import dtype as dtypes
+
+
+def _to_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    return wrap_array(jnp.asarray(np.asarray(x)))
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference: phi::SparseCooTensor,
+    paddle/phi/core/sparse_coo_tensor.h)."""
+
+    def __init__(self, indices: Tensor, values: Tensor, shape,
+                 coalesced=False):
+        self._indices = _to_tensor(indices)
+        self._values = _to_tensor(values)
+        self._shape = list(int(s) for s in shape)
+        self._coalesced = coalesced
+
+    # paddle Tensor-protocol surface
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def indices(self):
+        return self._indices
+
+    def values(self):
+        return self._values
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def to_dense(self) -> Tensor:
+        shape = tuple(self._shape)
+        sparse_ndim = self._indices.shape[0]
+
+        def fn(vals, idx):
+            out = jnp.zeros(shape, vals.dtype)
+            locs = tuple(idx[i].astype(jnp.int32)
+                         for i in range(sparse_ndim))
+            return out.at[locs].add(vals)
+        return call_op("coo_to_dense", fn, (self._values, self._indices), {})
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Merge duplicate coordinates (sorted order), static nnz."""
+        sparse_ndim = self._indices.shape[0]
+        shape = tuple(self._shape)
+
+        def fn(vals, idx):
+            strides = np.cumprod((shape[1:sparse_ndim] + (1,))[::-1])[::-1]
+            import builtins
+            flat = builtins.sum(idx[i].astype(jnp.int64) * int(strides[i])
+                                for i in range(sparse_ndim))
+            order = jnp.argsort(flat)
+            flat_s = flat[order]
+            vals_s = vals[order]
+            uniq = jnp.concatenate(
+                [jnp.ones((1,), bool), flat_s[1:] != flat_s[:-1]])
+            seg = jnp.cumsum(uniq) - 1
+            merged = jax.ops.segment_sum(vals_s, seg,
+                                         num_segments=vals.shape[0])
+            keep_flat = jnp.where(uniq, flat_s, 0)
+            first_pos = jnp.where(uniq, jnp.arange(flat_s.shape[0]), 0)
+            slot = jnp.zeros((vals.shape[0],), jnp.int64)
+            slot = slot.at[seg].max(keep_flat)
+            new_idx = jnp.stack(
+                [(slot // int(strides[i])) % shape[i]
+                 for i in range(sparse_ndim)]).astype(idx.dtype)
+            return merged, new_idx
+        vals, idx = call_op("coo_coalesce", fn,
+                            (self._values, self._indices), {})
+        return SparseCooTensor(idx, vals, self._shape, coalesced=True)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        assert len(self._shape) == 2, "CSR conversion needs a 2-D tensor"
+        n_rows = self._shape[0]
+
+        def fn(vals, idx):
+            rows = idx[0].astype(jnp.int32)
+            cols = idx[1].astype(jnp.int32)
+            order = jnp.argsort(rows)
+            counts = jax.ops.segment_sum(
+                jnp.ones_like(rows), rows, num_segments=n_rows)
+            crows = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)])
+            return crows, cols[order], vals[order]
+        crows, cols, vals = call_op(
+            "coo_to_csr", fn, (self._values, self._indices), {})
+        return SparseCsrTensor(crows, cols, vals, self._shape)
+
+    def transpose(self, perm):
+        new_shape = [self._shape[p] for p in perm]
+
+        def fn(idx):
+            return jnp.stack([idx[p] for p in perm])
+        idx = call_op("coo_transpose", fn, (self._indices,), {})
+        return SparseCooTensor(idx, self._values, new_shape)
+
+    def astype(self, dtype):
+        return SparseCooTensor(self._indices, self._values.astype(dtype),
+                               self._shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix (reference: phi::SparseCsrTensor,
+    paddle/phi/core/sparse_csr_tensor.h)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = _to_tensor(crows)
+        self._cols = _to_tensor(cols)
+        self._values = _to_tensor(values)
+        self._shape = list(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def crows(self):
+        return self._crows
+
+    def cols(self):
+        return self._cols
+
+    def values(self):
+        return self._values
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def _row_ids(self):
+        n_rows = self._shape[0]
+        nnz = self._values.shape[0]
+
+        def fn(crows):
+            return (jnp.searchsorted(
+                crows.astype(jnp.int32), jnp.arange(nnz), side="right")
+                - 1).astype(jnp.int32)
+        return call_op("csr_rows", fn, (self._crows,), {})
+
+    def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
+        rows = self._row_ids()
+
+        def fn(r, c):
+            return jnp.stack([r.astype(jnp.int64), c.astype(jnp.int64)])
+        idx = call_op("csr_to_coo", fn, (rows, self._cols), {})
+        return SparseCooTensor(idx, self._values, self._shape)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+# ------------------------------------------------------------- creation
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """reference: paddle.sparse.sparse_coo_tensor."""
+    idx = _to_tensor(indices)
+    vals = _to_tensor(values)
+    if shape is None:
+        mx = np.asarray(idx.numpy()).max(axis=1) + 1
+        shape = [int(m) for m in mx] + list(vals.shape[1:])
+    out = SparseCooTensor(idx, vals, shape)
+    out._values.stop_gradient = stop_gradient
+    return out
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """reference: paddle.sparse.sparse_csr_tensor."""
+    out = SparseCsrTensor(crows, cols, values, shape)
+    out._values.stop_gradient = stop_gradient
+    return out
+
+
+def to_sparse_coo(x: Tensor, sparse_dim=None) -> SparseCooTensor:
+    """Dense -> COO (host-side nnz discovery, like the reference's
+    DenseToCoo kernel)."""
+    arr = np.asarray(x.numpy())
+    sparse_dim = sparse_dim or arr.ndim
+    nz = np.nonzero(np.any(arr.reshape(arr.shape[:sparse_dim] + (-1,)) != 0,
+                           axis=-1) if sparse_dim < arr.ndim else arr != 0)
+    idx = np.stack(nz).astype(np.int64)
+    vals = arr[nz]
+    return SparseCooTensor(wrap_array(jnp.asarray(idx)),
+                           wrap_array(jnp.asarray(vals)), list(arr.shape))
+
+
+# ------------------------------------------------------------- unary ops
+def _unary(name, jfn):
+    def op(x, name_arg=None):
+        if isinstance(x, (SparseCooTensor,)):
+            vals = call_op(f"sp_{name}", jfn, (x.values(),), {})
+            return SparseCooTensor(x.indices(), vals, x.shape)
+        if isinstance(x, SparseCsrTensor):
+            vals = call_op(f"sp_{name}", jfn, (x.values(),), {})
+            return SparseCsrTensor(x.crows(), x.cols(), vals, x.shape)
+        return call_op(f"sp_{name}", jfn, (x,), {})
+    op.__name__ = name
+    op.__doc__ = f"reference: paddle.sparse.{name} (zero-preserving)"
+    return op
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+relu6 = _unary("relu6", lambda v: jnp.clip(v, 0, 6))
+sigmoid = _unary("sigmoid", lambda v: jax.nn.sigmoid(v))
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+sin = _unary("sin", jnp.sin)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+expm1 = _unary("expm1", jnp.expm1)
+neg = _unary("neg", jnp.negative)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def scale(x, scale_val, bias=0.0, bias_after_scale=True, name=None):
+    return _unary("scale", lambda v: v * scale_val + bias)(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    vals = x.values().astype(value_dtype) if value_dtype else x.values()
+    if isinstance(x, SparseCooTensor):
+        idx = (x.indices().astype(index_dtype) if index_dtype
+               else x.indices())
+        return SparseCooTensor(idx, vals, x.shape)
+    return SparseCsrTensor(x.crows(), x.cols(), vals, x.shape)
+
+
+# ------------------------------------------------------------- binary ops
+def _ensure_coo(x):
+    return x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else x
+
+
+def add(x, y, name=None):
+    """reference: paddle.sparse.add — union of sparsity patterns."""
+    x, y = _ensure_coo(x), _ensure_coo(y)
+    from ..tensor.manipulation import concat
+    idx = concat([x.indices(), y.indices()], axis=1)
+    vals = concat([x.values(), y.values()], axis=0)
+    return SparseCooTensor(idx, vals, x.shape).coalesce()
+
+
+def subtract(x, y, name=None):
+    return add(x, neg(y))
+
+
+def multiply(x, y, name=None):
+    """Elementwise multiply; sparse*dense keeps x's pattern."""
+    x = _ensure_coo(x)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        y = _ensure_coo(y)
+        return multiply(x, y.to_dense())
+    sparse_ndim = x.indices().shape[0]
+
+    def fn(vals, idx, d):
+        locs = tuple(idx[i].astype(jnp.int32) for i in range(sparse_ndim))
+        return vals * d[locs]
+    vals = call_op("sp_multiply", fn, (x.values(), x.indices(), y), {})
+    return SparseCooTensor(x.indices(), vals, x.shape)
+
+
+def divide(x, y, name=None):
+    x = _ensure_coo(x)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        y = _ensure_coo(y).to_dense()
+    sparse_ndim = x.indices().shape[0]
+
+    def fn(vals, idx, d):
+        locs = tuple(idx[i].astype(jnp.int32) for i in range(sparse_ndim))
+        return vals / d[locs]
+    vals = call_op("sp_divide", fn, (x.values(), x.indices(), y), {})
+    return SparseCooTensor(x.indices(), vals, x.shape)
+
+
+# ------------------------------------------------------------- matmul etc
+def matmul(x, y, name=None):
+    """reference: paddle.sparse.matmul — sparse @ dense -> dense."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if isinstance(x, SparseCooTensor):
+        n_rows = x.shape[0]
+
+        def fn(vals, idx, d):
+            rows = idx[0].astype(jnp.int32)
+            cols = idx[1].astype(jnp.int32)
+            prod = vals[:, None] * d[cols]
+            return jax.ops.segment_sum(prod, rows, num_segments=n_rows)
+        return call_op("sp_matmul", fn, (x.values(), x.indices(), y), {})
+    # dense @ sparse
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        yt = _ensure_coo(y).transpose([1, 0])
+        from ..tensor.math import transpose as dense_t
+        out_t = matmul(yt, call_op(
+            "sp_xt", lambda a: jnp.swapaxes(a, -1, -2), (x,), {}))
+        return call_op("sp_outt", lambda a: jnp.swapaxes(a, -1, -2),
+                       (out_t,), {})
+    raise TypeError("matmul needs at least one sparse operand")
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask, name=None):
+    """reference: paddle.sparse.masked_matmul — dense@dense sampled at
+    mask's sparsity (SDDMM)."""
+    mask = _ensure_coo(mask)
+
+    def fn(a, b, idx):
+        rows = idx[0].astype(jnp.int32)
+        cols = idx[1].astype(jnp.int32)
+        return jnp.sum(a[rows] * jnp.swapaxes(b, -1, -2)[cols], axis=-1)
+    vals = call_op("sp_sddmm", fn, (x, y, mask.indices()), {})
+    return SparseCooTensor(mask.indices(), vals, mask.shape)
+
+
+def mv(x, vec, name=None):
+    """reference: paddle.sparse.mv."""
+    x = _ensure_coo(x)
+    n_rows = x.shape[0]
+
+    def fn(vals, idx, v):
+        rows = idx[0].astype(jnp.int32)
+        cols = idx[1].astype(jnp.int32)
+        return jax.ops.segment_sum(vals * v[cols], rows,
+                                   num_segments=n_rows)
+    return call_op("sp_mv", fn, (x.values(), x.indices(), vec), {})
+
+
+def softmax(x, axis=-1, name=None):
+    """reference: paddle.sparse.nn.functional.softmax — per-row softmax over
+    stored values (2-D, axis=-1)."""
+    coo = _ensure_coo(x)
+    n_rows = coo.shape[0]
+
+    def fn(vals, idx):
+        rows = idx[0].astype(jnp.int32)
+        row_max = jax.ops.segment_max(vals, rows, num_segments=n_rows)
+        e = jnp.exp(vals - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        return e / denom[rows]
+    vals = call_op("sp_softmax", fn, (coo.values(), coo.indices()), {})
+    out = SparseCooTensor(coo.indices(), vals, coo.shape)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """reference: paddle.sparse.sum."""
+    coo = _ensure_coo(x)
+    if axis is None:
+        from ..tensor.math import sum as dense_sum
+        return dense_sum(coo.values())
+    return call_op("sp_sum_axis",
+                   lambda d: jnp.sum(d, axis=axis, keepdims=keepdim),
+                   (coo.to_dense(),), {})
+
+
+def transpose(x, perm, name=None):
+    return _ensure_coo(x).transpose(perm)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+from . import nn  # noqa: E402,F401
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "to_sparse_coo", "relu", "relu6", "sigmoid",
+    "tanh", "sqrt", "square", "log1p", "abs", "sin", "asin", "atan",
+    "sinh", "asinh", "atanh", "expm1", "neg", "pow", "scale", "cast",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "mv", "softmax", "sum", "transpose", "is_same_shape", "nn",
+    "deg2rad", "rad2deg",
+]

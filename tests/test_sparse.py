@@ -1,0 +1,178 @@
+"""Sparse API tests (reference capability: python/paddle/sparse/,
+SURVEY §2 #69/#11)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse as sp
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+def _rand_coo(shape=(4, 5), nnz=6, seed=0):
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(shape[0] * shape[1], size=nnz, replace=False)
+    idx = np.stack([flat // shape[1], flat % shape[1]]).astype("int64")
+    vals = rng.standard_normal(nnz).astype("float32")
+    dense = np.zeros(shape, "float32")
+    dense[idx[0], idx[1]] = vals
+    return idx, vals, dense
+
+
+class TestCreation:
+    def test_coo_roundtrip(self):
+        idx, vals, dense = _rand_coo()
+        t = sp.sparse_coo_tensor(idx, vals, list(dense.shape))
+        assert t.is_sparse() and t.is_sparse_coo()
+        assert t.nnz() == 6
+        np.testing.assert_allclose(_np(t.to_dense()), dense)
+
+    def test_dense_to_coo(self):
+        _, _, dense = _rand_coo()
+        t = sp.to_sparse_coo(paddle.to_tensor(dense))
+        np.testing.assert_allclose(_np(t.to_dense()), dense)
+
+    def test_csr_roundtrip(self):
+        dense = np.array([[1., 0., 2.], [0., 0., 3.], [4., 0., 0.]],
+                         "float32")
+        t = sp.sparse_csr_tensor([0, 2, 3, 4], [0, 2, 2, 0],
+                                 [1., 2., 3., 4.], [3, 3])
+        assert t.is_sparse_csr()
+        np.testing.assert_allclose(_np(t.to_dense()), dense)
+        coo = t.to_sparse_coo()
+        np.testing.assert_allclose(_np(coo.to_dense()), dense)
+
+    def test_coo_to_csr(self):
+        idx, vals, dense = _rand_coo()
+        coo = sp.sparse_coo_tensor(idx, vals, list(dense.shape))
+        csr = coo.to_sparse_csr()
+        np.testing.assert_allclose(_np(csr.to_dense()), dense)
+
+    def test_coalesce_merges_duplicates(self):
+        idx = np.array([[0, 0, 1], [1, 1, 2]], "int64")
+        vals = np.array([1.0, 2.0, 3.0], "float32")
+        t = sp.sparse_coo_tensor(idx, vals, [2, 3]).coalesce()
+        dense = _np(t.to_dense())
+        assert dense[0, 1] == 3.0 and dense[1, 2] == 3.0
+
+
+class TestOps:
+    def test_unary(self):
+        idx, vals, dense = _rand_coo()
+        t = sp.sparse_coo_tensor(idx, vals, list(dense.shape))
+        np.testing.assert_allclose(_np(sp.relu(t).to_dense()),
+                                   np.maximum(dense, 0))
+        np.testing.assert_allclose(_np(sp.square(t).to_dense()),
+                                   np.square(dense), rtol=1e-6)
+        np.testing.assert_allclose(_np(sp.neg(t).to_dense()), -dense)
+        np.testing.assert_allclose(_np(sp.scale(t, 2.0).to_dense()),
+                                   2 * dense, rtol=1e-6)
+
+    def test_add_multiply(self):
+        idx1, vals1, d1 = _rand_coo(seed=1)
+        idx2, vals2, d2 = _rand_coo(seed=2)
+        a = sp.sparse_coo_tensor(idx1, vals1, list(d1.shape))
+        b = sp.sparse_coo_tensor(idx2, vals2, list(d2.shape))
+        np.testing.assert_allclose(_np(sp.add(a, b).to_dense()), d1 + d2,
+                                   rtol=1e-6)
+        dense_mul = paddle.to_tensor(np.full(d1.shape, 2.0, "float32"))
+        np.testing.assert_allclose(
+            _np(sp.multiply(a, dense_mul).to_dense()), d1 * 2, rtol=1e-6)
+
+    def test_matmul_mv(self):
+        idx, vals, dense = _rand_coo()
+        t = sp.sparse_coo_tensor(idx, vals, list(dense.shape))
+        y = np.random.randn(5, 3).astype("float32")
+        np.testing.assert_allclose(
+            _np(sp.matmul(t, paddle.to_tensor(y))), dense @ y, rtol=1e-5,
+            atol=1e-6)
+        v = np.random.randn(5).astype("float32")
+        np.testing.assert_allclose(_np(sp.mv(t, paddle.to_tensor(v))),
+                                   dense @ v, rtol=1e-5, atol=1e-6)
+
+    def test_masked_matmul_sddmm(self):
+        idx, vals, dense = _rand_coo()
+        mask = sp.sparse_coo_tensor(idx, np.ones_like(vals),
+                                    list(dense.shape))
+        a = np.random.randn(4, 7).astype("float32")
+        b = np.random.randn(7, 5).astype("float32")
+        out = sp.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                               mask)
+        full = a @ b
+        expect = np.zeros_like(dense)
+        expect[idx[0], idx[1]] = full[idx[0], idx[1]]
+        np.testing.assert_allclose(_np(out.to_dense()), expect, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_softmax(self):
+        idx, vals, dense = _rand_coo()
+        t = sp.sparse_coo_tensor(idx, vals, list(dense.shape))
+        out = _np(sp.softmax(t).to_dense())
+        for r in range(4):
+            nz = dense[r] != 0
+            if nz.any():
+                e = np.exp(vals[(idx[0] == r)]
+                           - vals[(idx[0] == r)].max())
+                np.testing.assert_allclose(
+                    np.sort(out[r][nz]), np.sort(e / e.sum()), rtol=1e-5)
+
+    def test_values_grad_flows(self):
+        idx, vals, dense = _rand_coo()
+        t = sp.sparse_coo_tensor(idx, vals, list(dense.shape),
+                                 stop_gradient=False)
+        y = np.random.randn(5, 3).astype("float32")
+        out = sp.matmul(t, paddle.to_tensor(y))
+        out.sum().backward()
+        assert t.values().grad is not None
+        assert t.values().grad.shape == [6]
+
+
+class TestSparseNN:
+    def test_relu_layer(self):
+        idx, vals, dense = _rand_coo()
+        t = sp.sparse_coo_tensor(idx, vals, list(dense.shape))
+        out = sp.nn.ReLU()(t)
+        np.testing.assert_allclose(_np(out.to_dense()),
+                                   np.maximum(dense, 0))
+
+    def test_subm_conv3d_preserves_sites(self):
+        # one batch, 4x4x4 grid, 2 channels, 5 active sites
+        rng = np.random.default_rng(0)
+        sites = rng.choice(64, 5, replace=False)
+        idx = np.stack([np.zeros(5, np.int64), sites // 16,
+                        (sites // 4) % 4, sites % 4])
+        vals = rng.standard_normal((5, 2)).astype("float32")
+        x = sp.sparse_coo_tensor(idx, vals, [1, 4, 4, 4, 2])
+        conv = sp.nn.SubmConv3D(2, 3, kernel_size=3, padding=1)
+        out = conv(x)
+        assert out.shape == [1, 4, 4, 4, 3]
+        assert out.nnz() == 5
+
+    def test_conv3d(self):
+        rng = np.random.default_rng(0)
+        idx = np.array([[0, 0], [1, 2], [1, 2], [1, 2]], dtype="int64")
+        vals = rng.standard_normal((2, 2)).astype("float32")
+        x = sp.sparse_coo_tensor(idx, vals, [1, 4, 4, 4, 2])
+        conv = sp.nn.Conv3D(2, 3, kernel_size=2, stride=1, padding=0)
+        out = conv(x)
+        assert out.shape[-1] == 3
+
+    def test_batchnorm(self):
+        idx, _, _ = _rand_coo()
+        vals = np.random.randn(6, 3).astype("float32")
+        x = sp.sparse_coo_tensor(np.stack([idx[0], idx[1]]), vals, [4, 5, 3])
+        bn = sp.nn.BatchNorm(3)
+        out = bn(x)
+        v = _np(out.values())
+        np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-5)
+
+    def test_sparse_attention(self):
+        q = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        k = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        v = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        idx, vals, dense = _rand_coo(shape=(4, 4), nnz=8)
+        mask = sp.sparse_coo_tensor(idx, np.ones_like(vals), [4, 4])
+        out = sp.nn.functional.attention(q, k, v, mask)
+        assert out.shape == [4, 8]
