@@ -56,6 +56,7 @@ from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import geometric  # noqa: F401
 from . import sparse  # noqa: F401
+from . import profiler  # noqa: F401
 from .tensor import linalg  # noqa: F401 (paddle.linalg alias)
 
 

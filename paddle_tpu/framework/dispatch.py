@@ -62,8 +62,31 @@ def set_amp_cast_hook(hook: Optional[Callable]) -> None:
     _amp_cast_hook = hook
 
 
+# Host-event recorder hook, installed while a Profiler is in a RECORD state:
+# records one span per eager op (reference: RecordEvent spans auto-inserted by
+# eager_gen.py:322).  None when profiling is off, so the hot path pays one
+# attribute read.
+_prof_recorder = None
+
+
+def set_profiler_recorder(rec) -> None:
+    global _prof_recorder
+    _prof_recorder = rec
+
+
 def call_op(name: str, fn: Callable, args: tuple, kwargs: dict):
     """Execute ``fn`` (a pure jax-array function) with tape recording."""
+    rec = _prof_recorder
+    if rec is not None:
+        start = rec.now_ns()
+        try:
+            return _call_op_impl(name, fn, args, kwargs)
+        finally:
+            rec.push("op::" + name, start, rec.now_ns())
+    return _call_op_impl(name, fn, args, kwargs)
+
+
+def _call_op_impl(name: str, fn: Callable, args: tuple, kwargs: dict):
     if _amp_cast_hook is not None:
         args, kwargs = _amp_cast_hook(name, args, kwargs)
 

@@ -1,0 +1,51 @@
+"""Native (C++) runtime components.
+
+The reference keeps its runtime services (event recorder, stores, readers) in
+C++ (reference: paddle/phi/api/profiler/host_event_recorder.h:231,
+paddle/phi/core/distributed/store/tcp_store.cc); here each service is a small
+C++ shared library with a C ABI, loaded via ctypes.  Libraries are compiled
+on first use with g++ and cached by source hash, so the package needs no build
+step to install; every consumer must degrade gracefully to a pure-Python
+fallback when no toolchain is present.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_SRC_DIR, "_build")
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def load_native(name: str, extra_flags: tuple = ()) -> ctypes.CDLL:
+    """Compile ``<name>.cc`` into a shared library (cached) and dlopen it."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_SRC_DIR, name + ".cc")
+        with open(src, "rb") as f:
+            blob = f.read()
+        tag = hashlib.sha256(blob + repr(extra_flags).encode()).hexdigest()[:16]
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        out = os.path.join(_BUILD_DIR, f"lib{name}-{tag}.so")
+        if not os.path.exists(out):
+            cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared",
+                   "-pthread", src, "-o", out + ".tmp", *extra_flags]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+            except (subprocess.CalledProcessError, OSError) as e:
+                msg = getattr(e, "stderr", str(e))
+                raise NativeBuildError(f"building {name}: {msg}") from e
+            os.replace(out + ".tmp", out)
+        lib = ctypes.CDLL(out)
+        _cache[name] = lib
+        return lib

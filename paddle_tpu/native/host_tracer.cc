@@ -1,0 +1,127 @@
+// Host event recorder: thread-local append-only buffers; the hot path takes
+// only the owning thread's (uncontended) mutex, never a global lock.
+//
+// Capability parity with the reference's HostEventRecorder
+// (reference: paddle/phi/api/profiler/host_event_recorder.h:205,231 —
+// thread-local EventContainer chunks gathered on demand).  TPU-native: device
+// timelines come from XLA/jax.profiler; this recorder owns only host spans,
+// which the Python layer merges into one chrome trace.
+//
+// Collection is two-phase and atomic w.r.t. concurrent pushes:
+//   pt_drain()  — moves every thread's events into a global staging area
+//                 (per-buffer lock) and returns the staged count;
+//   pt_read(..) — copies staged events out and clears the staging area.
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace {
+
+struct Event {
+  uint32_t name_id;
+  uint64_t tid;
+  uint64_t start_ns;
+  uint64_t end_ns;
+};
+
+struct ThreadBuffer {
+  uint64_t tid = 0;
+  std::mutex mu;  // owner thread vs. draining thread
+  std::vector<Event> events;
+};
+
+std::mutex g_mu;  // guards buffer/name registries + staging
+std::vector<ThreadBuffer*> g_buffers;
+std::unordered_map<std::string, uint32_t> g_name_ids;
+std::vector<std::string> g_names;
+std::vector<Event> g_staging;
+std::atomic<int> g_enabled{0};
+
+thread_local ThreadBuffer* t_buf = nullptr;
+
+ThreadBuffer* LocalBuffer() {
+  if (t_buf == nullptr) {
+    auto* b = new ThreadBuffer();
+    b->tid = static_cast<uint64_t>(
+        std::hash<std::thread::id>()(std::this_thread::get_id()));
+    b->events.reserve(1024);
+    std::lock_guard<std::mutex> l(g_mu);
+    g_buffers.push_back(b);
+    t_buf = b;
+  }
+  return t_buf;
+}
+
+uint64_t NowNs() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_tracer_enable(int on) { g_enabled.store(on ? 1 : 0); }
+
+int pt_tracer_enabled() { return g_enabled.load(std::memory_order_relaxed); }
+
+uint64_t pt_now_ns() { return NowNs(); }
+
+uint32_t pt_register_name(const char* name) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_name_ids.find(name);
+  if (it != g_name_ids.end()) return it->second;
+  uint32_t id = static_cast<uint32_t>(g_names.size());
+  g_names.emplace_back(name);
+  g_name_ids.emplace(name, id);
+  return id;
+}
+
+void pt_push_event(uint32_t name_id, uint64_t start_ns, uint64_t end_ns) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  ThreadBuffer* b = LocalBuffer();
+  std::lock_guard<std::mutex> l(b->mu);
+  b->events.push_back(Event{name_id, b->tid, start_ns, end_ns});
+}
+
+uint64_t pt_drain() {
+  std::lock_guard<std::mutex> g(g_mu);
+  for (auto* b : g_buffers) {
+    std::lock_guard<std::mutex> l(b->mu);
+    if (b->events.empty()) continue;
+    g_staging.insert(g_staging.end(), b->events.begin(), b->events.end());
+    b->events.clear();
+  }
+  return g_staging.size();
+}
+
+uint64_t pt_read(uint32_t* name_ids, uint64_t* tids, uint64_t* starts,
+                 uint64_t* ends, uint64_t cap) {
+  std::lock_guard<std::mutex> g(g_mu);
+  uint64_t n = g_staging.size() < cap ? g_staging.size() : cap;
+  for (uint64_t i = 0; i < n; ++i) {
+    const Event& e = g_staging[i];
+    name_ids[i] = e.name_id;
+    tids[i] = e.tid;
+    starts[i] = e.start_ns;
+    ends[i] = e.end_ns;
+  }
+  g_staging.erase(g_staging.begin(), g_staging.begin() + n);
+  return n;
+}
+
+const char* pt_name(uint32_t id) {
+  std::lock_guard<std::mutex> l(g_mu);
+  if (id >= g_names.size()) return "";
+  return g_names[id].c_str();
+}
+
+}  // extern "C"

@@ -1,0 +1,83 @@
+"""Aggregate statistics over collected host events.
+
+Capability parity with the reference's profiler statistics
+(reference: python/paddle/profiler/profiler_statistic.py — EventNode tree,
+per-name totals, formatted summary table).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List
+
+from .record import HostEvent
+
+
+class SortedKeys(enum.Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    Calls = 4
+
+
+class EventStat:
+    __slots__ = ("name", "calls", "total_ns", "max_ns", "min_ns")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.calls = 0
+        self.total_ns = 0
+        self.max_ns = 0
+        self.min_ns = None
+
+    def add(self, dur_ns: int) -> None:
+        self.calls += 1
+        self.total_ns += dur_ns
+        self.max_ns = max(self.max_ns, dur_ns)
+        self.min_ns = dur_ns if self.min_ns is None else min(self.min_ns, dur_ns)
+
+    @property
+    def avg_ns(self) -> float:
+        return self.total_ns / max(self.calls, 1)
+
+
+def aggregate(events: List[HostEvent]) -> Dict[str, EventStat]:
+    stats: Dict[str, EventStat] = {}
+    for e in events:
+        s = stats.get(e.name)
+        if s is None:
+            s = stats[e.name] = EventStat(e.name)
+        s.add(e.end_ns - e.start_ns)
+    return stats
+
+
+_UNIT = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
+
+
+def summary_table(events: List[HostEvent],
+                  sorted_by: SortedKeys = SortedKeys.CPUTotal,
+                  time_unit: str = "ms") -> str:
+    stats = aggregate(events)
+    key = {
+        SortedKeys.CPUTotal: lambda s: s.total_ns,
+        SortedKeys.CPUAvg: lambda s: s.avg_ns,
+        SortedKeys.CPUMax: lambda s: s.max_ns,
+        SortedKeys.CPUMin: lambda s: s.min_ns or 0,
+        SortedKeys.Calls: lambda s: s.calls,
+    }[sorted_by]
+    rows = sorted(stats.values(), key=key, reverse=True)
+    div = _UNIT.get(time_unit, 1e6)
+    total = sum(s.total_ns for s in rows) or 1
+
+    name_w = max([len(s.name) for s in rows] + [20])
+    hdr = (f"{'Name':<{name_w}}  {'Calls':>8}  {'Total(' + time_unit + ')':>12}  "
+           f"{'Avg(' + time_unit + ')':>12}  {'Max(' + time_unit + ')':>12}  "
+           f"{'Min(' + time_unit + ')':>12}  {'Ratio(%)':>8}")
+    lines = ["-" * len(hdr), hdr, "-" * len(hdr)]
+    for s in rows:
+        lines.append(
+            f"{s.name:<{name_w}}  {s.calls:>8}  {s.total_ns / div:>12.3f}  "
+            f"{s.avg_ns / div:>12.3f}  {s.max_ns / div:>12.3f}  "
+            f"{(s.min_ns or 0) / div:>12.3f}  {100.0 * s.total_ns / total:>8.2f}")
+    lines.append("-" * len(hdr))
+    return "\n".join(lines)

@@ -1,0 +1,102 @@
+"""Benchmark timer: reader cost / batch cost / ips running summaries.
+
+Capability parity with the reference's benchmark timer
+(reference: python/paddle/profiler/timer.py — Hook-based step timing driving
+``Profiler(timer_only=True)`` step_info strings).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+class _Stat:
+    __slots__ = ("total", "count", "maxv", "minv", "_window", "_wsum", "_wcount")
+
+    def __init__(self, window: int = 100):
+        self.total = 0.0
+        self.count = 0
+        self.maxv = 0.0
+        self.minv = None
+        self._window = window
+        self._wsum = 0.0
+        self._wcount = 0
+
+    def add(self, v: float) -> None:
+        self.total += v
+        self.count += 1
+        self.maxv = max(self.maxv, v)
+        self.minv = v if self.minv is None else min(self.minv, v)
+        self._wsum += v
+        self._wcount += 1
+        if self._wcount > self._window:
+            self._wsum = v
+            self._wcount = 1
+
+    @property
+    def avg(self) -> float:
+        return self.total / max(self.count, 1)
+
+    @property
+    def window_avg(self) -> float:
+        return self._wsum / max(self._wcount, 1)
+
+
+class Benchmark:
+    """Per-step timing: call ``before_reader``/``after_reader`` around data
+    fetch and ``step(num_samples)`` at each iteration end."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.reader_cost = _Stat()
+        self.batch_cost = _Stat()
+        self.ips = _Stat()
+        self._reader_start: Optional[float] = None
+        self._batch_start: Optional[float] = None
+        self.steps = 0
+
+    def begin(self) -> None:
+        self._batch_start = time.perf_counter()
+
+    def before_reader(self) -> None:
+        self._reader_start = time.perf_counter()
+
+    def after_reader(self) -> None:
+        if self._reader_start is not None:
+            self.reader_cost.add(time.perf_counter() - self._reader_start)
+            self._reader_start = None
+
+    def step(self, num_samples: Optional[int] = None) -> None:
+        now = time.perf_counter()
+        if self._batch_start is not None:
+            cost = now - self._batch_start
+            self.batch_cost.add(cost)
+            if num_samples and cost > 0:
+                self.ips.add(num_samples / cost)
+        self._batch_start = now
+        self.steps += 1
+
+    def step_info(self, unit: str = "samples") -> str:
+        return (f"reader_cost: {self.reader_cost.window_avg:.5f} s, "
+                f"batch_cost: {self.batch_cost.window_avg:.5f} s, "
+                f"ips: {self.ips.window_avg:.3f} {unit}/s")
+
+    def report(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for key, stat in (("reader_cost", self.reader_cost),
+                          ("batch_cost", self.batch_cost), ("ips", self.ips)):
+            out[key] = {"avg": stat.avg, "max": stat.maxv,
+                        "min": stat.minv or 0.0}
+        return out
+
+
+_benchmark: Optional[Benchmark] = None
+
+
+def benchmark() -> Benchmark:
+    global _benchmark
+    if _benchmark is None:
+        _benchmark = Benchmark()
+    return _benchmark
