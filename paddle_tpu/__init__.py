@@ -57,6 +57,7 @@ from . import signal  # noqa: F401
 from . import geometric  # noqa: F401
 from . import sparse  # noqa: F401
 from . import profiler  # noqa: F401
+from . import quantization  # noqa: F401
 from .tensor import linalg  # noqa: F401 (paddle.linalg alias)
 
 

@@ -46,3 +46,4 @@ from .layer.rnn import (  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
 )
+from . import quant  # noqa: F401  (quantization layers, SURVEY #70)

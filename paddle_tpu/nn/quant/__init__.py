@@ -1,0 +1,17 @@
+"""paddle_tpu.nn.quant — quantization layers + weight-quantized ops
+(reference: python/paddle/nn/quant/)."""
+from .format import (  # noqa: F401
+    Stub, QuantizedLinear, QuantizedConv2D, quantize_weight_per_channel,
+)
+from .qat_layers import (  # noqa: F401
+    QuantedLinear, QuantedConv2D, DEFAULT_QAT_LAYER_MAPPINGS,
+)
+from .quantized_linear import (  # noqa: F401
+    weight_quantize, weight_dequantize, weight_only_linear, llm_int8_linear,
+)
+
+__all__ = [
+    "Stub", "QuantizedLinear", "QuantizedConv2D", "QuantedLinear",
+    "QuantedConv2D", "weight_quantize", "weight_dequantize",
+    "weight_only_linear", "llm_int8_linear",
+]

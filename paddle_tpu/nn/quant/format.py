@@ -1,0 +1,116 @@
+"""Converted (inference-form) quantized layers + Stub.
+
+Capability parity with the reference's conversion format layers
+(reference: python/paddle/nn/quant/format.py — ConvertibleQuantedLayer /
+LinearQuanterDequanter; stub.py — Stub observing an activation site).
+
+The converted Linear stores an int8 weight + per-channel scales and runs the
+weight-only path (dequant fused into matmul by XLA).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.tensor import Tensor, to_tensor
+from ..layer.layers import Layer
+from .. import functional as F
+from .quantized_linear import weight_only_linear
+
+
+class Stub(Layer):
+    """Marks an activation quantization site in user models; QAT replaces it
+    with the configured quanter, otherwise identity (reference: stub.py)."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+        self._quanter = None
+
+    def forward(self, x):
+        if self._quanter is not None:
+            return self._quanter(x)
+        return x
+
+
+def _scale_for(weight_ndim, scale: Tensor, quant_axis):
+    """Reshape a stored scale so it broadcasts against the weight along
+    ``quant_axis`` (None = per-tensor scalar)."""
+    if quant_axis is None or scale.ndim == 0:
+        return scale
+    shape = [1] * weight_ndim
+    shape[quant_axis] = -1
+    return scale.reshape(shape)
+
+
+class QuantizedLinear(Layer):
+    """Inference-form Linear: int8 weight + float scales along quant_axis."""
+
+    def __init__(self, weight_int8: Tensor, scale: Tensor, bias,
+                 act_scale=None, act_bits=8, quant_axis=1):
+        super().__init__()
+        self.register_buffer("weight", weight_int8)
+        self.register_buffer("weight_scale", scale)
+        self.bias = bias
+        self.act_scale = act_scale   # exported metadata (input threshold)
+        self.act_bits = act_bits
+        self.quant_axis = quant_axis
+
+    def forward(self, x):
+        if self.quant_axis == 1 or self.quant_axis is None:
+            return weight_only_linear(x, self.weight, self.weight_scale,
+                                      self.bias)
+        w = self.weight.astype(x.dtype) * _scale_for(
+            2, self.weight_scale, self.quant_axis).astype(x.dtype)
+        return F.linear(x, w, self.bias)
+
+
+class QuantizedConv2D(Layer):
+    """Inference-form Conv2D: int8 weight + scales along quant_axis; the
+    dequant multiply is fused by XLA into the conv's weight load."""
+
+    def __init__(self, weight_int8, scale, bias, conv_attrs, act_scale=None,
+                 act_bits=8, quant_axis=0):
+        super().__init__()
+        self.register_buffer("weight", weight_int8)
+        self.register_buffer("weight_scale", scale)
+        self.bias = bias
+        self.act_scale = act_scale
+        self.act_bits = act_bits
+        self.quant_axis = quant_axis
+        self._attrs = conv_attrs
+
+    def forward(self, x):
+        w = self.weight.astype(x.dtype) * _scale_for(
+            4, self.weight_scale, self.quant_axis).astype(x.dtype)
+        a = self._attrs
+        return F.conv2d(x, w, self.bias, a["stride"], a["padding"],
+                        a["dilation"], a["groups"], a["data_format"])
+
+
+def quantize_weight_per_channel(w: Tensor, quant_axis, bits: int = 8,
+                                threshold=None):
+    """Host-side weight quantization for conversion: returns
+    (int8 Tensor, float32 scale Tensor along quant_axis — scalar when
+    quant_axis is None).  ``threshold`` (calibrated absmax, scalar or
+    per-channel) overrides the recomputed absmax so calibration choices
+    (e.g. KL/Hist clipping) survive conversion."""
+    arr = np.asarray(w.numpy(), dtype=np.float32)
+    bnt = float((1 << (bits - 1)) - 1)
+    if threshold is not None:
+        absmax = np.asarray(
+            threshold.numpy() if hasattr(threshold, "numpy") else threshold,
+            dtype=np.float32)
+    elif quant_axis is None:
+        absmax = np.abs(arr).max()
+    else:
+        axes = tuple(i for i in range(arr.ndim) if i != quant_axis)
+        absmax = np.abs(arr).max(axis=axes)
+    scale = np.maximum(absmax, 1e-9) / bnt
+    if quant_axis is None or np.ndim(scale) == 0:
+        s = scale
+    else:
+        shape = [1] * arr.ndim
+        shape[quant_axis] = -1
+        s = scale.reshape(shape)
+    q = np.clip(np.round(arr / s), -bnt, bnt).astype(np.int8)
+    return to_tensor(q), to_tensor(np.asarray(scale, dtype=np.float32))

@@ -1,0 +1,66 @@
+"""QAT wrapper layers: fake-quantized Linear / Conv2D.
+
+Capability parity with the reference's quanted layers
+(reference: python/paddle/nn/quant/qat/linear.py, conv.py — QuantedLinear /
+QuantedConv2D hold the source layer's parameters and apply activation/weight
+fake quanters in forward).
+"""
+from __future__ import annotations
+
+from ..layer.layers import Layer
+from .. import functional as F
+
+
+class QuantedLinear(Layer):
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._source = layer
+        self.weight_quanter = None
+        self.activation_quanter = None
+        if q_config.weight is not None:
+            self.weight_quanter = q_config.weight._instance(layer)
+        if q_config.activation is not None:
+            self.activation_quanter = q_config.activation._instance(layer)
+
+    def forward(self, x):
+        w = self.weight
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._source = layer
+        self.weight_quanter = None
+        self.activation_quanter = None
+        if q_config.weight is not None:
+            self.weight_quanter = q_config.weight._instance(layer)
+        if q_config.activation is not None:
+            self.activation_quanter = q_config.activation._instance(layer)
+
+    def forward(self, x):
+        w = self.weight
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        src = self._source
+        return F.conv2d(x, w, self.bias, src.stride, src.padding,
+                        src.dilation, src.groups, src.data_format)
+
+
+def _default_mappings():
+    from ..layer.common import Linear
+    from ..layer.conv_pool import Conv2D
+    return {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+DEFAULT_QAT_LAYER_MAPPINGS = _default_mappings()

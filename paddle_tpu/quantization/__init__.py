@@ -1,0 +1,30 @@
+"""paddle_tpu.quantization — QAT/PTQ framework (SURVEY #70).
+
+Mirrors the reference's quantization surface
+(reference: python/paddle/quantization/__init__.py): QuantConfig picks
+quanters/observers per layer/name/type; QAT swaps layers for fake-quant
+wrappers (straight-through estimator); PTQ inserts calibration observers;
+convert() bakes scales into int8 inference layers (weight-only path fused
+into matmul by XLA).
+"""
+from .base import (  # noqa: F401
+    BaseObserver, BaseQuanter, QuanterFactory, ObserverFactory, quanter,
+    quant_dequant, fake_quant_ste,
+)
+from .config import QuantConfig, SingleLayerConfig  # noqa: F401
+from .observers import (  # noqa: F401
+    AbsmaxObserver, PerChannelAbsmaxObserver, HistObserver, KLObserver,
+    ObserveWrapper,
+)
+from .quanters import (  # noqa: F401
+    FakeQuanterWithAbsMaxObserver, FakeQuanterChannelWiseAbsMax,
+)
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+
+__all__ = [
+    "QuantConfig", "BaseQuanter", "BaseObserver", "quanter", "QAT", "PTQ",
+    "AbsmaxObserver", "PerChannelAbsmaxObserver", "HistObserver",
+    "KLObserver", "FakeQuanterWithAbsMaxObserver",
+    "FakeQuanterChannelWiseAbsMax",
+]

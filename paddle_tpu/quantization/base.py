@@ -1,0 +1,103 @@
+"""Quantization base classes + factories.
+
+Capability parity with the reference's quantization core
+(reference: python/paddle/quantization/base_observer.py, base_quanter.py,
+factory.py — BaseObserver/BaseQuanter layer protocol; factories bind ctor
+kwargs and instantiate per wrapped layer).
+
+TPU-native notes: fake-quant uses the straight-through estimator written as
+``x + stop_gradient(qdq(x) - x)`` — identity gradient with zero custom-VJP
+machinery, and XLA folds the expression into the surrounding computation.
+"""
+from __future__ import annotations
+
+import abc
+
+from .. import tensor as T
+from ..nn.layer.layers import Layer
+
+
+def _broadcast_scale(scale, x, quant_axis):
+    """Reshape a per-channel scale vector so it broadcasts against ``x``
+    along ``quant_axis`` (None = per-tensor scalar)."""
+    if quant_axis is None or not hasattr(scale, "ndim") or scale.ndim == 0:
+        return scale
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    return scale.reshape(shape)
+
+
+def quant_dequant(x, scale, bit_length=8, quant_axis=None):
+    """Simulated symmetric quantization: round(x/s) clipped to the int range,
+    then rescaled.  ``scale`` is the absmax threshold (maps to qmax)."""
+    bnt = float((1 << (bit_length - 1)) - 1)
+    s = _broadcast_scale(scale, x, quant_axis) / bnt
+    s = T.clip(s, min=1e-9)
+    q = T.clip(T.round(x / s), -bnt, bnt)
+    return q * s
+
+
+def fake_quant_ste(x, scale, bit_length=8, quant_axis=None):
+    """Quant-dequant forward with straight-through (identity) gradient."""
+    qdq = quant_dequant(x, scale, bit_length, quant_axis)
+    return x + (qdq - x).detach()
+
+
+class BaseQuanter(Layer, metaclass=abc.ABCMeta):
+    """Trainable-path fake quantizer (reference: base_quanter.py)."""
+
+    @abc.abstractmethod
+    def scales(self):
+        ...
+
+    def zero_points(self):
+        return None
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return 8
+
+
+class BaseObserver(BaseQuanter, metaclass=abc.ABCMeta):
+    """Calibration observer (reference: base_observer.py): watches tensors
+    during PTQ calibration, then ``cal_thresholds`` fixes the scales."""
+
+    @abc.abstractmethod
+    def cal_thresholds(self):
+        ...
+
+
+class ClassFactory:
+    """Binds ctor kwargs; ``_instance(layer)`` builds the bound layer object
+    (reference: factory.py QuanterFactory/ObserverFactory)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def _get_class(self):
+        raise NotImplementedError
+
+    def _instance(self, layer) -> BaseQuanter:
+        return self._get_class()(layer, **self._kwargs)
+
+
+class QuanterFactory(ClassFactory):
+    pass
+
+
+class ObserverFactory(ClassFactory):
+    pass
+
+
+def quanter(class_name):
+    """Decorator registering a quanter layer and synthesizing its factory
+    (reference: factory.py ``quanter``)."""
+    def deco(cls):
+        factory_cls = type(class_name, (QuanterFactory,),
+                           {"_get_class": lambda self: cls})
+        import sys
+        setattr(sys.modules[cls.__module__], class_name, factory_cls)
+        return cls
+    return deco

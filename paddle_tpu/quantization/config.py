@@ -1,0 +1,115 @@
+"""QuantConfig: which layers get which quanters/observers.
+
+Capability parity with the reference's QuantConfig
+(reference: python/paddle/quantization/config.py:67 — per-instance
+``add_layer_config``, per-name ``add_name_config``, per-type
+``add_type_config``, qat layer mapping, customized leaves; resolution order
+instance > name > type > global).
+"""
+from __future__ import annotations
+
+import copy as copy_module
+from typing import Dict, List, Optional, Type
+
+from ..nn.layer.layers import Layer
+
+
+class SingleLayerConfig:
+    def __init__(self, activation=None, weight=None):
+        self._activation = activation
+        self._weight = weight
+
+    @property
+    def activation(self):
+        return self._activation
+
+    @property
+    def weight(self):
+        return self._weight
+
+    def __str__(self):
+        return f"activation: {self._activation}\nweight: {self._weight}"
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        if activation is None and weight is None:
+            self._global_config = None
+        else:
+            self._global_config = SingleLayerConfig(activation, weight)
+        self._layer2config: Dict[int, SingleLayerConfig] = {}
+        self._name2config: Dict[str, SingleLayerConfig] = {}
+        self._type2config: Dict[Type[Layer], SingleLayerConfig] = {}
+        self._qat_layer_mapping: Dict[Type[Layer], Type[Layer]] = {}
+        self._customized_leaves: List[Type[Layer]] = []
+
+    # -- registration ------------------------------------------------------
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer2config[id(l)] = SingleLayerConfig(activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = (layer_name if isinstance(layer_name, (list, tuple))
+                 else [layer_name])
+        for n in names:
+            self._name2config[n] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type2config[t] = SingleLayerConfig(activation, weight)
+
+    def add_qat_layer_mapping(self, source: Type[Layer], target: Type[Layer]):
+        self._qat_layer_mapping[source] = target
+
+    def add_customized_leaf(self, layer_type: Type[Layer]):
+        self._customized_leaves.append(layer_type)
+
+    @property
+    def customized_leaves(self):
+        return list(self._customized_leaves)
+
+    @property
+    def global_config(self) -> Optional[SingleLayerConfig]:
+        return self._global_config
+
+    @property
+    def qat_layer_mappings(self):
+        from ..nn.quant.qat_layers import DEFAULT_QAT_LAYER_MAPPINGS
+        merged = dict(DEFAULT_QAT_LAYER_MAPPINGS)
+        merged.update(self._qat_layer_mapping)
+        return merged
+
+    def _remapped(self, memo: dict) -> "QuantConfig":
+        """Per-instance configs are keyed by id(); after quantize() deepcopies
+        the model, translate them through the deepcopy memo (original id ->
+        copied object) so add_layer_config survives inplace=False."""
+        if not self._layer2config:
+            return self
+        clone = copy_module.copy(self)
+        clone._layer2config = dict(self._layer2config)
+        for old_id, cfg in self._layer2config.items():
+            copied = memo.get(old_id)
+            if copied is not None:
+                clone._layer2config[id(copied)] = cfg
+        return clone
+
+    # -- resolution --------------------------------------------------------
+    def _get_config_by_layer(self, layer: Layer,
+                             full_name: str = "") -> Optional[SingleLayerConfig]:
+        cfg = self._layer2config.get(id(layer))
+        if cfg is not None:
+            return cfg
+        if full_name and full_name in self._name2config:
+            return self._name2config[full_name]
+        for t, cfg in self._type2config.items():
+            if isinstance(layer, t):
+                return cfg
+        return self._global_config
+
+    def _is_quantifiable(self, layer: Layer, full_name: str = "") -> bool:
+        cfg = self._get_config_by_layer(layer, full_name)
+        return cfg is not None and (cfg.activation is not None
+                                    or cfg.weight is not None)

@@ -1,0 +1,253 @@
+"""PTQ observers: watch activations/weights during calibration.
+
+Capability parity with the reference's observers + PTQ quantizers
+(reference: python/paddle/quantization/observers/abs_max.py,
+imperative/ptq_quantizer.py — Absmax / PerChannelAbsmax / Hist / KL).
+Histogram/KL search runs on host numpy (calibration is offline, not in the
+compiled step).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import tensor as T
+from .base import BaseObserver, ObserverFactory, fake_quant_ste
+
+
+class AbsmaxObserverLayer(BaseObserver):
+    """Running per-tensor absmax (reference: AbsmaxObserverLayer)."""
+
+    def __init__(self, layer, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._max = 0.0
+        self._scale = None
+
+    def forward(self, x):
+        self._max = max(self._max, float(T.max(T.abs(x.detach())).numpy()))
+        return x
+
+    def cal_thresholds(self):
+        self._scale = self._max
+
+    def scales(self):
+        if self._scale is None:
+            self.cal_thresholds()
+        return self._scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return None
+
+
+class AbsmaxObserver(ObserverFactory):
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits=quant_bits)
+
+    def _get_class(self):
+        return AbsmaxObserverLayer
+
+
+class PerChannelAbsmaxObserverLayer(BaseObserver):
+    """Per-output-channel absmax for weights (reference:
+    PerChannelAbsmaxQuantizer)."""
+
+    def __init__(self, layer, quant_bits=8, quant_axis=0):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._quant_axis = quant_axis
+        self._absmax = None
+        self._scale = None
+
+    def forward(self, x):
+        axes = [i for i in range(x.ndim) if i != self._quant_axis]
+        cur = np.asarray(T.max(T.abs(x.detach()), axis=axes).numpy())
+        self._absmax = cur if self._absmax is None else np.maximum(
+            self._absmax, cur)
+        return x
+
+    def cal_thresholds(self):
+        from ..framework.tensor import to_tensor
+        self._scale = to_tensor(
+            np.asarray(self._absmax, dtype="float32"))
+
+    def scales(self):
+        if self._scale is None:
+            self.cal_thresholds()
+        return self._scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return self._quant_axis
+
+
+class PerChannelAbsmaxObserver(ObserverFactory):
+    def __init__(self, quant_bits=8, quant_axis=0):
+        super().__init__(quant_bits=quant_bits, quant_axis=quant_axis)
+
+    def _get_class(self):
+        return PerChannelAbsmaxObserverLayer
+
+
+class HistObserverLayer(BaseObserver):
+    """Histogram-percentile threshold (reference: HistQuantizer —
+    upsample/percentile-style histogram calibration)."""
+
+    def __init__(self, layer, quant_bits=8, bins=2048, percent=0.99999):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._bins = bins
+        self._percent = percent
+        self._hist = None
+        self._hist_max = None
+        self._scale = None
+
+    def _update_hist(self, abs_vals):
+        cur_max = float(abs_vals.max()) if abs_vals.size else 0.0
+        if cur_max == 0.0:
+            return
+        if self._hist is None:
+            self._hist_max = cur_max
+            self._hist, _ = np.histogram(abs_vals, bins=self._bins,
+                                         range=(0.0, self._hist_max))
+            self._hist = self._hist.astype(np.float64)
+            return
+        if cur_max > self._hist_max:
+            # stretch: rebin old histogram into the wider range
+            new_max = cur_max
+            old_edges = np.linspace(0, self._hist_max, self._bins + 1)
+            centers = (old_edges[:-1] + old_edges[1:]) / 2
+            new_hist, _ = np.histogram(centers, bins=self._bins,
+                                       range=(0.0, new_max),
+                                       weights=self._hist)
+            self._hist = new_hist
+            self._hist_max = new_max
+        cur, _ = np.histogram(abs_vals, bins=self._bins,
+                              range=(0.0, self._hist_max))
+        self._hist += cur
+
+    def forward(self, x):
+        self._update_hist(np.abs(np.asarray(x.detach().numpy())).ravel())
+        return x
+
+    def cal_thresholds(self):
+        if self._hist is None:
+            self._scale = 0.0
+            return
+        cdf = np.cumsum(self._hist) / max(self._hist.sum(), 1.0)
+        idx = int(np.searchsorted(cdf, self._percent))
+        self._scale = (idx + 0.5) * self._hist_max / self._bins
+
+    def scales(self):
+        if self._scale is None:
+            self.cal_thresholds()
+        return self._scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return None
+
+
+class HistObserver(ObserverFactory):
+    def __init__(self, quant_bits=8, bins=2048, percent=0.99999):
+        super().__init__(quant_bits=quant_bits, bins=bins, percent=percent)
+
+    def _get_class(self):
+        return HistObserverLayer
+
+
+class KLObserverLayer(HistObserverLayer):
+    """KL-divergence threshold search over the calibration histogram
+    (reference: KLQuantizer — TensorRT-style cal_kl_threshold)."""
+
+    def __init__(self, layer, quant_bits=8, bins=2048):
+        super().__init__(layer, quant_bits=quant_bits, bins=bins)
+
+    def cal_thresholds(self):
+        if self._hist is None:
+            self._scale = 0.0
+            return
+        self._scale = _kl_threshold(self._hist, self._hist_max,
+                                    self._quant_bits)
+
+    def quant_axis(self):
+        return None
+
+
+class KLObserver(ObserverFactory):
+    def __init__(self, quant_bits=8, bins=2048):
+        super().__init__(quant_bits=quant_bits, bins=bins)
+
+    def _get_class(self):
+        return KLObserverLayer
+
+
+def _kl_threshold(hist, hist_max, quant_bits):
+    """Pick the clip threshold minimizing KL(P || quantized P)."""
+    bins = len(hist)
+    levels = 1 << (quant_bits - 1)
+    best_i, best_kl = bins, float("inf")
+    total = hist.sum()
+    if total == 0:
+        return 0.0
+    for i in range(levels, bins + 1, max((bins - levels) // 64, 1)):
+        p = hist[:i].astype(np.float64).copy()
+        p[-1] += hist[i:].sum()   # clip tail mass into last bin
+        q = np.zeros(i)
+        # quantize the i bins down to `levels` buckets, then expand back
+        chunk = i / levels
+        for j in range(levels):
+            lo, hi = int(j * chunk), int((j + 1) * chunk) or 1
+            hi = max(hi, lo + 1)
+            seg = p[lo:hi]
+            nz = (seg > 0).sum()
+            if nz:
+                q[lo:hi] = np.where(seg > 0, seg.sum() / nz, 0)
+        p /= p.sum()
+        qs = q.sum()
+        if qs == 0:
+            continue
+        q /= qs
+        mask = p > 0
+        kl = float(np.sum(p[mask] * np.log(p[mask] / np.maximum(q[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return (best_i + 0.5) * hist_max / bins
+
+
+class ObserveWrapper(BaseObserver):
+    """Wraps a leaf layer for PTQ: observes the input activation and the
+    weight, delegates forward (reference: quantization/wrapper.py +
+    ptq.py observer insertion)."""
+
+    def __init__(self, observed, act_observer=None, weight_observer=None):
+        super().__init__()
+        self._observed = observed
+        self._act_observer = act_observer
+        self._weight_observer = weight_observer
+        self._weight_seen = False
+
+    def forward(self, *args, **kwargs):
+        if self._act_observer is not None and args:
+            self._act_observer(args[0])
+        # the weight is constant during calibration — observe it once
+        if (self._weight_observer is not None and not self._weight_seen
+                and hasattr(self._observed, "weight")):
+            self._weight_observer(self._observed.weight)
+            self._weight_seen = True
+        return self._observed(*args, **kwargs)
+
+    def cal_thresholds(self):
+        for ob in (self._act_observer, self._weight_observer):
+            if ob is not None:
+                ob.cal_thresholds()
+
+    def scales(self):
+        return (self._act_observer.scales()
+                if self._act_observer else None)

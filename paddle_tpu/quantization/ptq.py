@@ -1,0 +1,41 @@
+"""Post-training quantization (reference:
+python/paddle/quantization/ptq.py:29 — PTQ.quantize inserts observers;
+user runs calibration batches; convert() bakes thresholds)."""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear
+from ..nn.layer.conv_pool import Conv2D
+from .config import QuantConfig
+from .observers import ObserveWrapper
+from .quantize import Quantization, _walk_and_replace
+
+
+class PTQ(Quantization):
+    def __init__(self, config: QuantConfig):
+        super().__init__(config)
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        config = self._config
+        if not inplace:
+            memo: dict = {}
+            model = copy.deepcopy(model, memo)
+            config = config._remapped(memo)
+
+        def _observe(full, layer):
+            if not isinstance(layer, (Linear, Conv2D)):
+                return None
+            cfg = config._get_config_by_layer(layer, full)
+            if cfg is None or (cfg.activation is None and cfg.weight is None):
+                return None
+            act_ob = (cfg.activation._instance(layer)
+                      if cfg.activation is not None else None)
+            w_ob = (cfg.weight._instance(layer)
+                    if cfg.weight is not None else None)
+            return ObserveWrapper(layer, act_ob, w_ob)
+
+        _walk_and_replace(model, _observe)
+        model.eval()
+        return model

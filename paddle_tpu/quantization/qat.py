@@ -1,0 +1,41 @@
+"""Quantization-aware training (reference:
+python/paddle/quantization/qat.py:27 — QAT.quantize swaps configured layers
+for their quanted counterparts per the config's qat layer mapping)."""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .quantize import Quantization, _walk_and_replace
+
+
+class QAT(Quantization):
+    def __init__(self, config: QuantConfig):
+        super().__init__(config)
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        config = self._config
+        if not inplace:
+            memo: dict = {}
+            model = copy.deepcopy(model, memo)
+            config = config._remapped(memo)
+        mapping = config.qat_layer_mappings
+
+        def _swap(full, layer):
+            from ..nn.quant.format import Stub
+            cfg = config._get_config_by_layer(layer, full)
+            if cfg is None or (cfg.activation is None and cfg.weight is None):
+                return None
+            if isinstance(layer, Stub):
+                # activation-site marker: arm it with the configured quanter
+                if cfg.activation is not None:
+                    layer._quanter = cfg.activation._instance(layer)
+                return None
+            target = mapping.get(type(layer))
+            if target is None:
+                return None
+            return target(layer, cfg)
+
+        _walk_and_replace(model, _swap)
+        return model

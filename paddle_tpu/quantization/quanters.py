@@ -1,0 +1,91 @@
+"""QAT fake quanters (trainable-path quantization simulation).
+
+Capability parity with the reference's quanters
+(reference: python/paddle/quantization/quanters/abs_max.py —
+FakeQuanterWithAbsMaxObserver: moving-average absmax scale updated during
+training, straight-through gradient; FakeQuanterChannelWiseAbsMax).
+
+TPU-native: the STE is expressed as ``x + stop_gradient(qdq(x) - x)`` so no
+custom VJP is needed and XLA fuses the whole expression; the EMA scale state
+is a host-side float updated eagerly (QAT runs in eager mode; the converted
+inference model is pure and jittable).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import tensor as T
+from .base import BaseQuanter, QuanterFactory, fake_quant_ste
+
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    """Moving-average absmax fake quanter (reference: abs_max.py:96 —
+    state/accum EMA: scale = accum/state)."""
+
+    def __init__(self, layer=None, moving_rate=0.9, bit_length=8, dtype=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bit_length = bit_length
+        self._scale = 1.0
+        self._state = 0.0
+        self._accum = 0.0
+
+    def forward(self, x):
+        if self.training:
+            cur = float(T.max(T.abs(x.detach())).numpy())
+            r = self._moving_rate
+            self._state = r * self._state + 1.0
+            self._accum = r * self._accum + cur
+            self._scale = self._accum / self._state
+        return fake_quant_ste(x, max(self._scale, 1e-9), self._bit_length)
+
+    def scales(self):
+        return self._scale
+
+    def bit_length(self):
+        return self._bit_length
+
+    def quant_axis(self):
+        return None
+
+
+class FakeQuanterWithAbsMaxObserver(QuanterFactory):
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype=None):
+        super().__init__(moving_rate=moving_rate, bit_length=bit_length)
+
+    def _get_class(self):
+        return FakeQuanterWithAbsMaxObserverLayer
+
+
+class FakeQuanterChannelWiseAbsMaxLayer(BaseQuanter):
+    """Per-channel absmax fake quanter for weights (reference:
+    nn/quant/quant_layers.py FakeQuantChannelWiseAbsMax)."""
+
+    def __init__(self, layer=None, quant_axis=0, bit_length=8, dtype=None):
+        super().__init__()
+        self._quant_axis = quant_axis
+        self._bit_length = bit_length
+        self._scale = None
+
+    def forward(self, x):
+        axes = [i for i in range(x.ndim) if i != self._quant_axis]
+        scale = T.max(T.abs(x), axis=axes).detach()
+        self._scale = scale
+        return fake_quant_ste(x, scale, self._bit_length, self._quant_axis)
+
+    def scales(self):
+        return self._scale
+
+    def bit_length(self):
+        return self._bit_length
+
+    def quant_axis(self):
+        return self._quant_axis
+
+
+class FakeQuanterChannelWiseAbsMax(QuanterFactory):
+    def __init__(self, quant_axis=0, bit_length=8, dtype=None):
+        super().__init__(quant_axis=quant_axis, bit_length=bit_length)
+
+    def _get_class(self):
+        return FakeQuanterChannelWiseAbsMaxLayer

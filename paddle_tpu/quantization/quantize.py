@@ -1,0 +1,108 @@
+"""Quantization driver base: model walking, QAT/PTQ transforms, convert.
+
+Capability parity with the reference's Quantization base
+(reference: python/paddle/quantization/quantize.py:28 — quantize() swaps
+configured layers for quanted wrappers; convert() bakes observed scales into
+inference-form layers).
+"""
+from __future__ import annotations
+
+import copy
+import abc
+
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear
+from ..nn.layer.conv_pool import Conv2D
+from ..nn.quant.qat_layers import QuantedLinear, QuantedConv2D
+from ..nn.quant.format import (
+    QuantizedLinear, QuantizedConv2D, quantize_weight_per_channel,
+)
+from .config import QuantConfig
+from .observers import ObserveWrapper
+
+
+def _walk_and_replace(model: Layer, fn, prefix=""):
+    """Depth-first sublayer replacement: ``fn(full_name, layer)`` returns a
+    replacement layer or None to recurse."""
+    for name, child in list(model._sub_layers.items()):
+        full = prefix + ("." if prefix else "") + name
+        repl = fn(full, child)
+        if repl is not None:
+            model._sub_layers[name] = repl
+        else:
+            _walk_and_replace(child, fn, full)
+
+
+class Quantization(metaclass=abc.ABCMeta):
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    @abc.abstractmethod
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        ...
+
+    def convert(self, model: Layer, inplace: bool = False,
+                remain_weight: bool = False) -> Layer:
+        """Replace quanted/observed layers with inference-form quantized
+        layers carrying int8 weights + scales.  Honors each weight
+        quanter/observer's quant_axis(), bit_length(), and calibrated
+        scales() so the deployed model matches the QAT/PTQ simulation."""
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def _bake(source, w_quanter, act_quanter, default_axis):
+            """One conversion for all four wrapper x layer-kind cases."""
+            w_bits, w_axis, w_threshold = 8, default_axis, None
+            if w_quanter is not None:
+                w_bits = w_quanter.bit_length()
+                w_axis = w_quanter.quant_axis()
+                scales = w_quanter.scales()
+                # a calibrated threshold (scalar or per-channel absmax)
+                # overrides recomputed absmax; dynamic quanters whose scales
+                # track the current weight give the same result either way
+                if scales is not None:
+                    w_threshold = scales
+            wq, ws = quantize_weight_per_channel(
+                source.weight, w_axis, w_bits, threshold=w_threshold)
+            act_scale, act_bits = None, 8
+            if act_quanter is not None:
+                act_scale = act_quanter.scales()
+                act_bits = act_quanter.bit_length()
+            if isinstance(source, Linear):
+                return QuantizedLinear(wq, ws, source.bias, act_scale,
+                                       act_bits, quant_axis=w_axis)
+            attrs = {"stride": source.stride, "padding": source.padding,
+                     "dilation": source.dilation, "groups": source.groups,
+                     "data_format": source.data_format}
+            return QuantizedConv2D(wq, ws, source.bias, attrs, act_scale,
+                                   act_bits, quant_axis=w_axis)
+
+        def _convert(full, layer):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                src = layer._source
+                default_axis = 1 if isinstance(src, Linear) else 0
+                return _bake(src, layer.weight_quanter,
+                             layer.activation_quanter, default_axis)
+            if isinstance(layer, ObserveWrapper):
+                layer.cal_thresholds()
+                inner = layer._observed
+                if isinstance(inner, (Linear, Conv2D)):
+                    default_axis = 1 if isinstance(inner, Linear) else 0
+                    return _bake(inner, layer._weight_observer,
+                                 layer._act_observer, default_axis)
+                return inner   # unwrap anything else
+            return None
+
+        _convert_root = _convert("", model)
+        if _convert_root is not None:
+            return _convert_root
+        _walk_and_replace(model, _convert)
+        return model
+
+    def _details(self):
+        return {"config": str(self._config)}
+
+    def __str__(self):
+        return str(self._details())
+
+    __repr__ = __str__

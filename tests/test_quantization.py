@@ -1,0 +1,248 @@
+"""Quantization tests: fake-quant numerics, QAT swap+train, PTQ calibrate+
+convert, weight-only int8 ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu.nn.quant import (
+    QuantedLinear, QuantizedLinear, weight_quantize, weight_only_linear,
+    llm_int8_linear, Stub,
+)
+from paddle_tpu.quantization import (
+    QAT, PTQ, QuantConfig, AbsmaxObserver, PerChannelAbsmaxObserver,
+    HistObserver, KLObserver, FakeQuanterWithAbsMaxObserver,
+    FakeQuanterChannelWiseAbsMax, quant_dequant, fake_quant_ste,
+)
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+class TestFakeQuant:
+    def test_quant_dequant_int8_error_bound(self):
+        x = paddle.to_tensor(np.random.randn(64).astype("float32"))
+        scale = float(np.abs(x.numpy()).max())
+        qdq = quant_dequant(x, scale, 8)
+        # max abs error of symmetric int8 <= scale/127/2 + eps
+        err = np.abs(qdq.numpy() - x.numpy()).max()
+        assert err <= scale / 127 / 2 + 1e-6
+
+    def test_ste_gradient_is_identity(self):
+        x = paddle.to_tensor(np.random.randn(16).astype("float32"),
+                             stop_gradient=False)
+        y = fake_quant_ste(x, 3.0, 8)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(16), rtol=1e-6)
+
+    def test_values_land_on_grid(self):
+        x = paddle.to_tensor(np.random.randn(100).astype("float32"))
+        scale = float(np.abs(x.numpy()).max())
+        q = quant_dequant(x, scale, 8).numpy()
+        grid = q / (scale / 127)
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-4)
+
+
+class TestQAT:
+    def test_quantize_swaps_layers(self):
+        model = _mlp()
+        cfg = QuantConfig(
+            activation=FakeQuanterWithAbsMaxObserver(moving_rate=0.9),
+            weight=FakeQuanterChannelWiseAbsMax(quant_axis=1))
+        qat = QAT(cfg)
+        qmodel = qat.quantize(model, inplace=False)
+        kinds = [type(l).__name__ for l in qmodel.sublayers()]
+        assert kinds.count("QuantedLinear") == 2
+        # original untouched
+        assert all(not isinstance(l, QuantedLinear)
+                   for l in model.sublayers())
+
+    def test_qat_trains_and_scale_tracks(self):
+        model = _mlp()
+        cfg = QuantConfig(
+            activation=FakeQuanterWithAbsMaxObserver(),
+            weight=FakeQuanterChannelWiseAbsMax(quant_axis=1))
+        qmodel = QAT(cfg).quantize(model, inplace=True)
+        opt = optim.SGD(parameters=qmodel.parameters(), learning_rate=0.1)
+        x = paddle.to_tensor(np.random.randn(16, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 4, (16,)))
+        loss_fn = nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(10):
+            loss = loss_fn(qmodel(x), y)
+            loss.backward()
+            opt.step(); opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+        quanter = qmodel[0].activation_quanter
+        assert quanter.scales() > 0
+
+    def test_name_and_type_config(self):
+        model = _mlp()
+        cfg = QuantConfig()
+        cfg.add_type_config(nn.Linear,
+                            weight=FakeQuanterChannelWiseAbsMax(quant_axis=1))
+        qmodel = QAT(cfg).quantize(model)
+        assert any(isinstance(l, QuantedLinear) for l in qmodel.sublayers())
+
+    def test_convert_produces_int8(self):
+        model = _mlp()
+        cfg = QuantConfig(
+            activation=FakeQuanterWithAbsMaxObserver(),
+            weight=FakeQuanterChannelWiseAbsMax(quant_axis=1))
+        qat = QAT(cfg)
+        qmodel = qat.quantize(model)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        qmodel(x)  # populate act scales
+        converted = qat.convert(qmodel, inplace=False)
+        qlayers = [l for l in converted.sublayers()
+                   if isinstance(l, QuantizedLinear)]
+        assert len(qlayers) == 2
+        assert str(qlayers[0].weight.dtype).endswith("int8")
+        # converted output close to fake-quant output
+        ref = qmodel(x).numpy()
+        out = converted(x).numpy()
+        np.testing.assert_allclose(out, ref, atol=0.2, rtol=0.2)
+
+
+class TestConfigResolution:
+    def test_layer_config_survives_deepcopy(self):
+        model = _mlp()
+        cfg = QuantConfig()
+        cfg.add_layer_config(
+            model[0], weight=FakeQuanterChannelWiseAbsMax(quant_axis=1))
+        qmodel = QAT(cfg).quantize(model, inplace=False)
+        kinds = [type(l).__name__ for l in qmodel.sublayers()]
+        assert kinds.count("QuantedLinear") == 1
+
+    def test_convert_honors_quant_axis_zero(self):
+        model = nn.Sequential(nn.Linear(8, 4))
+        cfg = QuantConfig(
+            weight=FakeQuanterChannelWiseAbsMax(quant_axis=0))
+        qat = QAT(cfg)
+        qm = qat.quantize(model)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        ref = qm(x).numpy()
+        conv = qat.convert(qm)
+        ql = [l for l in conv.sublayers() if isinstance(l, QuantizedLinear)][0]
+        assert ql.quant_axis == 0
+        assert tuple(ql.weight_scale.shape) == (8,)   # per-IN-channel
+        np.testing.assert_allclose(conv(x).numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_act_bits_propagated(self):
+        model = nn.Sequential(nn.Linear(8, 4))
+        cfg = QuantConfig(
+            activation=AbsmaxObserver(quant_bits=4),
+            weight=PerChannelAbsmaxObserver(quant_bits=8, quant_axis=1))
+        ptq = PTQ(cfg)
+        qm = ptq.quantize(model)
+        qm(paddle.to_tensor(np.random.randn(4, 8).astype("float32")))
+        conv = ptq.convert(qm)
+        ql = [l for l in conv.sublayers() if isinstance(l, QuantizedLinear)][0]
+        assert ql.act_bits == 4
+
+    def test_stub_armed_by_qat(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+                self.stub = Stub()
+
+            def forward(self, x):
+                return self.stub(self.fc(x))
+
+        model = M()
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver())
+        qm = QAT(cfg).quantize(model)
+        assert qm.stub._quanter is not None
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        out = qm(x)
+        assert qm.stub._quanter.scales() > 0
+
+
+class TestPTQ:
+    def test_calibrate_and_convert(self):
+        model = _mlp()
+        cfg = QuantConfig(activation=AbsmaxObserver(),
+                          weight=PerChannelAbsmaxObserver(quant_axis=1))
+        ptq = PTQ(cfg)
+        qmodel = ptq.quantize(model, inplace=False)
+        xs = [paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+              for _ in range(4)]
+        for x in xs:
+            qmodel(x)
+        converted = ptq.convert(qmodel)
+        qlayers = [l for l in converted.sublayers()
+                   if isinstance(l, QuantizedLinear)]
+        assert len(qlayers) == 2
+        assert qlayers[0].act_scale is not None and qlayers[0].act_scale > 0
+        # int8 model stays close to fp32 model on calibration data
+        ref = model(xs[0]).numpy()
+        out = converted(xs[0]).numpy()
+        assert np.abs(out - ref).max() < 0.15 * max(np.abs(ref).max(), 1)
+
+    def test_kl_observer(self):
+        model = nn.Sequential(nn.Linear(8, 8))
+        cfg = QuantConfig(activation=KLObserver(bins=512),
+                          weight=PerChannelAbsmaxObserver(quant_axis=1))
+        ptq = PTQ(cfg)
+        qmodel = ptq.quantize(model)
+        for _ in range(3):
+            qmodel(paddle.to_tensor(
+                np.random.randn(16, 8).astype("float32")))
+        converted = ptq.convert(qmodel)
+        ql = [l for l in converted.sublayers()
+              if isinstance(l, QuantizedLinear)][0]
+        assert ql.act_scale > 0
+
+    def test_hist_observer(self):
+        model = nn.Sequential(nn.Linear(8, 8))
+        cfg = QuantConfig(activation=HistObserver(bins=256, percent=0.999),
+                          weight=PerChannelAbsmaxObserver(quant_axis=1))
+        ptq = PTQ(cfg)
+        qmodel = ptq.quantize(model)
+        for _ in range(3):
+            qmodel(paddle.to_tensor(
+                np.random.randn(16, 8).astype("float32")))
+        converted = ptq.convert(qmodel)
+        ql = [l for l in converted.sublayers()
+              if isinstance(l, QuantizedLinear)][0]
+        assert ql.act_scale > 0
+
+
+class TestWeightOnlyOps:
+    def test_weight_quantize_roundtrip(self):
+        w = paddle.to_tensor(np.random.randn(32, 16).astype("float32"))
+        qw, scale = weight_quantize(w, algo="weight_only_int8")
+        assert str(qw.dtype).endswith("int8")
+        assert tuple(scale.shape) == (16,)
+        deq = qw.numpy().astype(np.float32) * scale.numpy()
+        assert np.abs(deq - w.numpy()).max() <= scale.numpy().max() / 2 + 1e-6
+
+    def test_weight_only_linear_matches_fp(self):
+        x = paddle.to_tensor(np.random.randn(4, 32).astype("float32"))
+        w = paddle.to_tensor(np.random.randn(32, 16).astype("float32"))
+        b = paddle.to_tensor(np.random.randn(16).astype("float32"))
+        qw, scale = weight_quantize(w)
+        y = weight_only_linear(x, qw, scale, b)
+        ref = x.numpy() @ w.numpy() + b.numpy()
+        assert np.abs(y.numpy() - ref).max() < 0.25
+
+    def test_llm_int8_linear(self):
+        rng = np.random.RandomState(0)
+        xv = rng.randn(4, 32).astype("float32")
+        xv[:, 3] *= 20.0   # outlier feature dim
+        x = paddle.to_tensor(xv)
+        w = paddle.to_tensor(rng.randn(32, 16).astype("float32"))
+        qw, scale = weight_quantize(w, algo="llm.int8")
+        y = llm_int8_linear(x, qw, scale, threshold=6.0)
+        ref = xv @ w.numpy()
+        rel = np.abs(y.numpy() - ref).max() / np.abs(ref).max()
+        assert rel < 0.05
+
+    def test_stub_identity(self):
+        s = Stub()
+        x = paddle.to_tensor(np.ones((2, 2), dtype="float32"))
+        np.testing.assert_allclose(s(x).numpy(), x.numpy())
