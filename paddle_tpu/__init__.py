@@ -58,6 +58,8 @@ from . import geometric  # noqa: F401
 from . import sparse  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
+from . import inference  # noqa: F401
+from . import onnx  # noqa: F401
 from .tensor import linalg  # noqa: F401 (paddle.linalg alias)
 
 

