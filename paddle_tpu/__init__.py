@@ -60,6 +60,11 @@ from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
 from . import onnx  # noqa: F401
+from . import device  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import utils  # noqa: F401
+from .hapi import hub  # noqa: F401
 from .tensor import linalg  # noqa: F401 (paddle.linalg alias)
 
 

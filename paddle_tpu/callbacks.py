@@ -1,0 +1,9 @@
+"""paddle.callbacks parity (reference: python/paddle/callbacks.py —
+re-exports the hapi callback set)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping,
+    ReduceLROnPlateau, VisualDL,
+)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "ReduceLROnPlateau", "VisualDL"]

@@ -1,0 +1,117 @@
+"""paddle.device parity (reference: python/paddle/device/__init__.py —
+set_device:281, streams/events, paddle.device.cuda memory API).
+
+TPU-native: XLA owns per-device scheduling, so Stream/Event are ordering
+no-ops that preserve the API (work under one JAX device is already ordered;
+``synchronize`` blocks on outstanding async dispatch).  Memory stats come
+from PJRT ``device.memory_stats()``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..framework.device import (  # noqa: F401
+    Place, CPUPlace, TPUPlace, CUDAPlace, set_device, get_device,
+    device_count, is_compiled_with_cuda, is_compiled_with_xpu,
+)
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "CUDAPlace", "set_device", "get_device",
+    "device_count", "synchronize", "Stream", "Event", "current_stream",
+    "set_stream", "stream_guard", "get_all_device_type",
+    "get_available_device", "get_all_custom_device_type",
+    "get_available_custom_device", "is_compiled_with_cuda",
+    "is_compiled_with_xpu", "cuda",
+]
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_all_custom_device_type():
+    return [p for p in get_all_device_type() if p not in ("cpu", "gpu", "tpu")]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if d.split(":")[0] not in ("cpu", "gpu", "tpu")]
+
+
+def synchronize(device=None) -> None:
+    """Block until all queued device work completes (reference:
+    paddle.device.synchronize).  JAX dispatch is async; this drains it."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        (jax.device_put(0.0) + 0).block_until_ready()
+
+
+class Stream:
+    """Ordering handle (reference: paddle.device.Stream).  Under XLA one
+    device has one well-ordered execution; record/wait are no-ops kept so
+    multi-stream CUDA code ports cleanly."""
+
+    def __init__(self, device=None, priority: int = 2):
+        self.device = device
+        self.priority = priority
+
+    def wait_event(self, event: "Event") -> None: ...
+    def wait_stream(self, stream: "Stream") -> None: ...
+    def record_event(self, event: Optional["Event"] = None) -> "Event":
+        return event or Event()
+    def query(self) -> bool:
+        return True
+    def synchronize(self) -> None:
+        synchronize(self.device)
+
+
+class Event:
+    """reference: paddle.device.Event."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self.device = device
+
+    def record(self, stream: Optional[Stream] = None) -> None: ...
+    def query(self) -> bool:
+        return True
+    def synchronize(self) -> None:
+        synchronize(self.device)
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _current_stream
+
+
+def set_stream(stream: Stream) -> Stream:
+    global _current_stream
+    prev, _current_stream = _current_stream, stream
+    return prev
+
+
+class stream_guard:
+    """Context manager (reference: paddle.device.stream_guard)."""
+
+    def __init__(self, stream: Stream):
+        self._stream = stream
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+from . import cuda  # noqa: E402,F401  (imported last: cuda.py re-uses Stream/Event)

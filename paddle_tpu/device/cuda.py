@@ -1,0 +1,84 @@
+"""paddle.device.cuda parity, mapped to the accelerator JAX exposes
+(reference: python/paddle/device/cuda/__init__.py — device_count, memory
+stats, Stream/Event, empty_cache).  On this stack "cuda" calls address the
+TPU (or whatever accelerator backs jax.devices()); memory figures come from
+PJRT ``memory_stats``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def _accel_devices():
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    return devs or jax.devices()
+
+
+def _dev(device=None):
+    devs = _accel_devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[min(device, len(devs) - 1)]
+    return device
+
+
+def device_count() -> int:
+    return len(_accel_devices())
+
+
+def _stat(device, key) -> int:
+    try:
+        stats = _dev(device).memory_stats() or {}
+        return int(stats.get(key, 0))
+    except Exception:
+        return 0
+
+
+def memory_allocated(device=None) -> int:
+    return _stat(device, "bytes_in_use")
+
+
+def max_memory_allocated(device=None) -> int:
+    return _stat(device, "peak_bytes_in_use")
+
+
+def memory_reserved(device=None) -> int:
+    return _stat(device, "bytes_reserved") or _stat(device, "bytes_in_use")
+
+
+def max_memory_reserved(device=None) -> int:
+    return _stat(device, "peak_bytes_in_use")
+
+
+def reset_max_memory_allocated(device=None) -> None: ...
+def reset_max_memory_reserved(device=None) -> None: ...
+def empty_cache() -> None: ...
+
+
+def synchronize(device=None) -> None:
+    from . import synchronize as _sync
+    _sync(device)
+
+
+def get_device_name(device=None) -> str:
+    return getattr(_dev(device), "device_kind", "unknown")
+
+
+def get_device_properties(device=None):
+    d = _dev(device)
+    return {"name": getattr(d, "device_kind", "unknown"),
+            "platform": d.platform, "id": d.id}
+
+
+def get_device_capability(device=None):
+    return (0, 0)   # CUDA compute capability has no TPU analog
+
+
+def current_device() -> int:
+    return 0
+
+
+from . import Stream, Event, current_stream, stream_guard  # noqa: E402,F401

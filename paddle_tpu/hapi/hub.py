@@ -1,0 +1,71 @@
+"""Model hub (reference: python/paddle/hapi/hub.py — list/help/load entry
+points resolved through a repo's ``hubconf.py``).
+
+Sources: ``local`` fully supported (a directory with hubconf.py); remote
+github/gitee sources need network egress — the archive fetch goes through
+utils.download and raises a clear error when offline.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_builtin_list = list
+MODULE_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str, force_reload: bool = False) -> str:
+    if source == "local":
+        return repo_dir
+    if source in ("github", "gitee"):
+        from ..utils.download import get_path_from_url
+        base = ("https://github.com" if source == "github"
+                else "https://gitee.com")
+        if ":" in repo_dir:
+            repo, branch = repo_dir.split(":", 1)
+        else:
+            repo, branch = repo_dir, "main"
+        url = f"{base}/{repo}/archive/{branch}.zip"
+        cache = os.path.expanduser("~/.cache/paddle_tpu/hub")
+        return get_path_from_url(url, cache, decompress=True,
+                                 check_exist=not force_reload)
+    raise ValueError(f"unknown hub source: {source}")
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+    """Entry points exported by the repo's hubconf."""
+    mod = _load_hubconf(_resolve(repo_dir, source, force_reload))
+    return [f for f in dir(mod)
+            if callable(getattr(mod, f)) and not f.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False):
+    mod = _load_hubconf(_resolve(repo_dir, source, force_reload))
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    mod = _load_hubconf(_resolve(repo_dir, source, force_reload))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"hub entry '{model}' not found in {repo_dir}")
+    return fn(**kwargs)

@@ -95,69 +95,86 @@ class Model:
             verbose=1, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None):
         """reference: hapi/model.py Model.fit."""
+        from .callbacks import config_callbacks
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, log_freq=log_freq,
+            verbose=verbose, save_freq=save_freq, save_dir=save_dir,
+            metrics=[m.name() for m in self._metrics])
         history = {"loss": []}
         it = 0
+        self.stop_training = False
+        cbks.on_train_begin()
         for epoch in range(epochs):
             for m in self._metrics:
                 m.reset()
-            t0 = time.time()
-            samples = 0
+            cbks.on_epoch_begin(epoch)
+            logs = None        # only this epoch's last-batch logs
             for step, batch in enumerate(loader):
                 if isinstance(batch, (list, tuple)) and len(batch) >= 2:
                     x, y = batch[0], batch[1]
                 else:
                     x, y = batch, None
+                cbks.on_train_batch_begin(step)
                 result = self.train_batch(x, y)
                 loss_val = result[0][0] if isinstance(result, tuple) else result[0]
                 history["loss"].append(loss_val)
                 bsz = x.shape[0] if isinstance(x, Tensor) else len(x)
-                samples += bsz
                 it += 1
-                if verbose and step % log_freq == 0:
-                    msg = f"Epoch {epoch + 1}/{epochs} step {step} loss {loss_val:.4f}"
-                    for m in self._metrics:
-                        msg += f" {m.name()}: {m.accumulate():.4f}" \
-                            if isinstance(m.name(), str) else ""
-                    print(msg)
+                logs = {"loss": loss_val, "batch_size": bsz}
+                for m in self._metrics:
+                    name = m.name()
+                    if isinstance(name, str):
+                        logs[name] = m.accumulate()
+                cbks.on_train_batch_end(step, logs)
                 if num_iters is not None and it >= num_iters:
                     break
-            dt = time.time() - t0
-            if verbose:
-                print(f"Epoch {epoch + 1}: {samples / max(dt, 1e-9):.1f} "
-                      f"samples/sec")
+            cbks.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size,
-                              verbose=verbose)
-            if save_dir is not None and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/epoch_{epoch + 1}")
-            if num_iters is not None and it >= num_iters:
+                eval_res = self.evaluate(eval_data, batch_size=batch_size,
+                                         verbose=0, callbacks=cbks)
+                for k, v in eval_res.items():
+                    history.setdefault("eval_" + k, []).append(v)
+            if self.stop_training or \
+                    (num_iters is not None and it >= num_iters):
                 break
+        cbks.on_train_end()
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
                  num_workers=0, callbacks=None):
+        from .callbacks import CallbackList, config_callbacks
         loader = eval_data if isinstance(eval_data, DataLoader) else \
             DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        if isinstance(callbacks, CallbackList):
+            cbks = callbacks
+        else:
+            # verbose=0: evaluate prints its own summary below
+            cbks = config_callbacks(callbacks, model=self, verbose=0,
+                                    log_freq=log_freq, mode="eval")
         for m in self._metrics:
             m.reset()
+        cbks.on_eval_begin()
         losses = []
-        for batch in loader:
+        for step, batch in enumerate(loader):
             if isinstance(batch, (list, tuple)) and len(batch) >= 2:
                 x, y = batch[0], batch[1]
             else:
                 x, y = batch, None
+            cbks.on_eval_batch_begin(step)
             res = self.eval_batch(x, y)
             if res:
                 losses.append(res[0])
+            cbks.on_eval_batch_end(step, {"loss": res[0] if res else None})
         result = {}
         if losses:
             result["loss"] = [float(np.mean(losses))]
         for m in self._metrics:
             name = m.name()
             result[name if isinstance(name, str) else name[0]] = m.accumulate()
+        cbks.on_eval_end(result)
         if verbose:
             print("Eval:", result)
         return result
@@ -173,6 +190,10 @@ class Model:
         return outputs
 
     def save(self, path, training=True):
+        import os
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
         _save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             _save(self._optimizer.state_dict(), path + ".pdopt")

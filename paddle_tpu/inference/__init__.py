@@ -44,9 +44,9 @@ class Config:
 
     def __init__(self, prog_file: Optional[str] = None,
                  params_file: Optional[str] = None):
-        if prog_file is not None and prog_file.endswith(".stablehlo"):
-            prog_file = prog_file[:-len(".stablehlo")]
-        self._prefix = prog_file
+        self._prefix = None
+        if prog_file is not None:
+            self.set_model(prog_file, params_file)
         self._device = None          # None = default jax backend
         self._precision = PrecisionType.Float32
         self._memory_optim = True
@@ -56,6 +56,8 @@ class Config:
 
     # -- model location ----------------------------------------------------
     def set_model(self, prefix: str, params_file: Optional[str] = None):
+        if prefix.endswith(".stablehlo"):
+            prefix = prefix[:-len(".stablehlo")]
         self._prefix = prefix
 
     def model_dir(self):
@@ -211,18 +213,20 @@ class Predictor:
         import jax.numpy as jnp
         from ..framework.tensor import Tensor
 
-        with self._lock:
-            if inputs is None:
+        if inputs is None:
+            with self._lock:   # snapshot handles under the lock only
                 arrays = [jnp.asarray(self._inputs[n]._array)
                           for n in self._input_names]
-            else:
-                arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
-                          for x in inputs]
-            outs = self._exported.call(self._params, *arrays)
-            np_outs = [np.asarray(o) for o in outs]
+        else:
+            arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                      for x in inputs]
+        # the compiled call is re-entrant — run it outside the lock
+        outs = self._exported.call(self._params, *arrays)
+        np_outs = [np.asarray(o) for o in outs]
+        with self._lock:
             for n, o in zip(self._output_names, np_outs):
                 self._outputs[n]._array = o
-            return np_outs
+        return np_outs
 
     def clone(self) -> "Predictor":
         """Share the deserialized program and parameter arrays (immutable

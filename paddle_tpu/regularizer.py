@@ -1,0 +1,6 @@
+"""paddle.regularizer parity (reference: python/paddle/regularizer.py —
+L1Decay/L2Decay applied via ParamAttr.regularizer or the optimizer's
+weight_decay)."""
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

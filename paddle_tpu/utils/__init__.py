@@ -1,0 +1,9 @@
+"""paddle.utils parity: download cache, misc helpers (reference:
+python/paddle/utils/)."""
+from . import download  # noqa: F401
+from .download import get_weights_path_from_url  # noqa: F401
+
+try:  # guard: requires a host toolchain
+    from . import cpp_extension  # noqa: F401
+except Exception:  # pragma: no cover
+    pass

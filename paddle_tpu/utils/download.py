@@ -1,0 +1,77 @@
+"""Weight download + cache (reference: python/paddle/utils/download.py —
+get_weights_path_from_url with ~/.cache weights dir, md5 check, tar/zip
+decompress)."""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tarfile
+import zipfile
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "WEIGHTS_HOME"]
+
+
+def _md5check(path: str, md5sum: str) -> bool:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def _decompress(path: str) -> str:
+    root = os.path.dirname(path)
+    if tarfile.is_tarfile(path):
+        with tarfile.open(path) as tf:
+            names = tf.getnames()
+            tf.extractall(root, filter="data")
+        return os.path.join(root, names[0].split("/")[0])
+    if zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as zf:
+            names = zf.namelist()
+            zf.extractall(root)
+        return os.path.join(root, names[0].split("/")[0])
+    return path
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum: str = None,
+                      check_exist: bool = True, decompress: bool = True) -> str:
+    """Resolve ``url`` to a local path, downloading into ``root_dir`` if
+    needed.  Local paths (and file://) are used in place."""
+    if url.startswith("file://"):
+        url = url[len("file://"):]
+    if os.path.exists(url):          # already-local weights
+        return url
+
+    os.makedirs(root_dir, exist_ok=True)
+    fname = url.split("/")[-1].split("?")[0] or "download"
+    fullpath = os.path.join(root_dir, fname)
+    if check_exist and os.path.exists(fullpath) and (
+            md5sum is None or _md5check(fullpath, md5sum)):
+        pass
+    else:
+        import urllib.request
+        try:
+            tmp = fullpath + ".part"
+            with urllib.request.urlopen(url, timeout=30) as r, \
+                    open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            os.replace(tmp, fullpath)
+        except Exception as e:
+            raise RuntimeError(
+                f"download of {url} failed ({e}); this environment may have "
+                "no network egress — place the file at "
+                f"{fullpath} manually or pass a local path") from e
+        if md5sum is not None and not _md5check(fullpath, md5sum):
+            raise RuntimeError(f"md5 mismatch for {fullpath}")
+    if decompress and (tarfile.is_tarfile(fullpath)
+                       or zipfile.is_zipfile(fullpath)):
+        return _decompress(fullpath)
+    return fullpath
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None) -> str:
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

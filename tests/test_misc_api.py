@@ -1,0 +1,168 @@
+"""Misc API batch tests: device package, callbacks-in-fit, regularizer alias,
+hub local source, download local path, RNG tracker."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+
+
+class TestDevicePackage:
+    def test_device_types_and_count(self):
+        assert "cpu" in paddle.device.get_all_device_type()
+        assert paddle.device.cuda.device_count() >= 1
+
+    def test_streams_events_noop_semantics(self):
+        s = paddle.device.Stream()
+        e = s.record_event()
+        assert s.query() and e.query()
+        e.synchronize()
+        s.synchronize()
+        with paddle.device.stream_guard(paddle.device.Stream()) as g:
+            assert paddle.device.current_stream() is g
+
+    def test_synchronize_and_memory_stats(self):
+        x = paddle.ones([64, 64])
+        y = paddle.matmul(x, x)
+        paddle.device.synchronize()
+        assert isinstance(paddle.device.cuda.memory_allocated(), int)
+        assert isinstance(paddle.device.cuda.max_memory_allocated(), int)
+        paddle.device.cuda.empty_cache()
+
+    def test_device_properties(self):
+        props = paddle.device.cuda.get_device_properties()
+        assert "platform" in props
+
+
+class TestCallbacks:
+    def _model(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m = paddle.Model(net)
+        m.prepare(optimizer=optim.Adam(parameters=net.parameters(),
+                                       learning_rate=1e-2),
+                  loss=nn.CrossEntropyLoss())
+        return m
+
+    def _data(self, n=32):
+        x = np.random.randn(n, 4).astype("float32")
+        y = (x.sum(1) > 0).astype("int64")
+        return [(x[i], y[i]) for i in range(n)]
+
+    def test_custom_callback_hooks_fire(self):
+        events = []
+
+        class Rec(paddle.callbacks.Callback):
+            def on_train_begin(self, logs=None):
+                events.append("train_begin")
+
+            def on_epoch_begin(self, epoch, logs=None):
+                events.append(f"epoch_{epoch}")
+
+            def on_train_batch_end(self, step, logs=None):
+                events.append("batch")
+                assert "loss" in logs
+
+            def on_train_end(self, logs=None):
+                events.append("train_end")
+
+        m = self._model()
+        m.fit(self._data(), batch_size=8, epochs=2, verbose=0,
+              callbacks=[Rec()])
+        assert events[0] == "train_begin" and events[-1] == "train_end"
+        assert "epoch_0" in events and "epoch_1" in events
+        assert events.count("batch") == 8
+
+    def test_early_stopping(self):
+        m = self._model()
+
+        class NoisyEval(paddle.callbacks.Callback):
+            pass
+
+        es = paddle.callbacks.EarlyStopping(monitor="loss", patience=0,
+                                            verbose=0)
+        # patience=0: second non-improving eval stops training
+        hist = m.fit(self._data(), eval_data=self._data(8), batch_size=8,
+                     epochs=20, eval_freq=1, verbose=0, callbacks=[es])
+        assert m.stop_training or len(hist["loss"]) == 20 * 4
+
+    def test_model_checkpoint(self, tmp_path):
+        m = self._model()
+        m.fit(self._data(8), batch_size=8, epochs=1, verbose=0,
+              save_dir=str(tmp_path), save_freq=1)
+        assert os.path.exists(str(tmp_path / "epoch_1.pdparams"))
+        assert os.path.exists(str(tmp_path / "final.pdparams"))
+
+    def test_reduce_lr_on_plateau(self):
+        m = self._model()
+        cb = paddle.callbacks.ReduceLROnPlateau(
+            monitor="loss", factor=0.5, patience=1, verbose=0)
+        cb.set_model(m)
+        cb.on_eval_end({"loss": [1.0]})
+        cb.on_eval_end({"loss": [1.0]})   # wait=1 >= patience -> reduce
+        assert abs(m._optimizer.get_lr() - 0.005) < 1e-9
+
+
+    def test_early_stopping_saves_best_model(self, tmp_path):
+        m = self._model()
+        es = paddle.callbacks.EarlyStopping(monitor="loss", patience=3,
+                                            verbose=0, save_best_model=True)
+        m.fit(self._data(16), eval_data=self._data(8), batch_size=8,
+              epochs=2, verbose=0, save_dir=str(tmp_path), callbacks=[es])
+        assert os.path.exists(str(tmp_path / "best_model.pdparams"))
+
+    def test_evaluate_prints_once(self, capsys):
+        m = self._model()
+        m.evaluate(self._data(8), batch_size=8, verbose=1)
+        out = capsys.readouterr().out
+        assert out.count("Eval:") == 1
+
+    def test_config_set_model_strips_suffix(self, tmp_path):
+        from paddle_tpu.inference import Config
+        cfg = Config()
+        cfg.set_model("model.stablehlo")
+        assert cfg.prog_file() == "model.stablehlo"
+
+
+class TestRegularizerAlias:
+    def test_alias(self):
+        assert paddle.regularizer.L2Decay(0.01).coeff == pytest.approx(0.01)
+
+
+class TestHubAndDownload:
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def toy_model(scale=1):\n"
+            "    'a toy entry'\n"
+            "    return {'scale': scale}\n")
+        entries = paddle.hub.list(str(tmp_path), source="local")
+        assert "toy_model" in entries
+        assert "toy entry" in paddle.hub.help(str(tmp_path), "toy_model",
+                                              source="local")
+        out = paddle.hub.load(str(tmp_path), "toy_model", source="local",
+                              scale=3)
+        assert out == {"scale": 3}
+
+    def test_download_local_passthrough(self, tmp_path):
+        p = tmp_path / "w.bin"
+        p.write_bytes(b"abc")
+        from paddle_tpu.utils.download import get_path_from_url
+        assert get_path_from_url(str(p), str(tmp_path)) == str(p)
+        assert get_path_from_url("file://" + str(p), str(tmp_path)) == str(p)
+
+
+class TestRNGTracker:
+    def test_tracker_distinct_streams(self):
+        from paddle_tpu.framework.random import RNGStatesTracker
+        tr = RNGStatesTracker.global_tracker()
+        try:
+            tr.add("test-stream", 1234)
+        except Exception:
+            pass
+        with tr.rng_state("test-stream"):
+            a = paddle.rand([4]).numpy()
+        with tr.rng_state("test-stream"):
+            b = paddle.rand([4]).numpy()
+        assert not np.allclose(a, b)   # stream state advances
