@@ -21,7 +21,10 @@ def _dev(device=None):
     if device is None:
         return devs[0]
     if isinstance(device, int):
-        return devs[min(device, len(devs) - 1)]
+        if not 0 <= device < len(devs):
+            raise ValueError(
+                f"invalid device id {device}; {len(devs)} device(s) visible")
+        return devs[device]
     return device
 
 
@@ -30,8 +33,9 @@ def device_count() -> int:
 
 
 def _stat(device, key) -> int:
+    d = _dev(device)   # raises on invalid index
     try:
-        stats = _dev(device).memory_stats() or {}
+        stats = d.memory_stats() or {}
         return int(stats.get(key, 0))
     except Exception:
         return 0

@@ -241,8 +241,10 @@ class ReduceLROnPlateau(Callback):
         if isinstance(value, (list, tuple, np.ndarray)):
             value = float(np.asarray(value).ravel()[0])
         if self.cooldown_counter > 0:
+            # hold the reduced LR: no improvement tracking during cooldown
             self.cooldown_counter -= 1
             self.wait = 0
+            return
         improved = (self.best is None
                     or (self.mode == "min" and value < self.best - self.min_delta)
                     or (self.mode == "max" and value > self.best + self.min_delta))
@@ -257,7 +259,14 @@ class ReduceLROnPlateau(Callback):
                 old = float(opt.get_lr())
                 new = max(old * self.factor, self.min_lr)
                 if new < old:
-                    opt.set_lr(new)
+                    sched = getattr(opt, "_learning_rate", None)
+                    if hasattr(sched, "base_lr"):
+                        # scheduler-driven LR: scale its base so future
+                        # schedule values shrink proportionally
+                        sched.base_lr *= self.factor
+                        sched.last_lr *= self.factor
+                    else:
+                        opt.set_lr(new)
                     if self.verbose:
                         print(f"ReduceLROnPlateau: lr {old:.2e} -> {new:.2e}")
             self.cooldown_counter = self.cooldown
@@ -304,6 +313,10 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     cbs = list(callbacks or [])
     if not any(isinstance(c, ProgBarLogger) for c in cbs) and verbose:
         cbs.insert(0, ProgBarLogger(log_freq, verbose=verbose))
+    if not any(isinstance(c, LRScheduler) for c in cbs):
+        # reference config_callbacks injects an LRScheduler callback so
+        # optimizer schedulers advance per step during fit
+        cbs.append(LRScheduler(by_step=True))
     if not any(isinstance(c, ModelCheckpoint) for c in cbs):
         cbs.append(ModelCheckpoint(save_freq, save_dir))
     lst = CallbackList(cbs)

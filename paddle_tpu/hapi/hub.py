@@ -43,7 +43,10 @@ def _resolve(repo_dir: str, source: str, force_reload: bool = False) -> str:
         else:
             repo, branch = repo_dir, "main"
         url = f"{base}/{repo}/archive/{branch}.zip"
-        cache = os.path.expanduser("~/.cache/paddle_tpu/hub")
+        # per-repo cache dir: archives are named {branch}.zip, so a shared
+        # dir would collide across repos on the same branch
+        cache = os.path.join(os.path.expanduser("~/.cache/paddle_tpu/hub"),
+                             repo.replace("/", "_"))
         return get_path_from_url(url, cache, decompress=True,
                                  check_exist=not force_reload)
     raise ValueError(f"unknown hub source: {source}")

@@ -1,0 +1,26 @@
+"""paddle_tpu.utils.cpp_extension — build & load custom C++ ops (SURVEY #73).
+
+Capability parity with the reference's extension builder
+(reference: python/paddle/utils/cpp_extension/cpp_extension.py —
+CppExtension/CUDAExtension/BuildExtension/load; custom op C ABI
+paddle/phi/capi/).
+
+TPU-native mapping: device kernels are written in Pallas (Python), so the
+C++ extension path covers *host* ops — data munging, tokenization, custom
+CPU math — executed inside compiled programs via ``jax.pure_callback``.
+No pybind11: extensions export a C ABI (see OP DESCRIPTOR below) loaded with
+ctypes, and gradients plug in through ``jax.custom_vjp``.
+
+OP DESCRIPTOR CONVENTION
+  const char* pt_ops();   // ";"-separated entries  name:ninputs[:grad]
+  // per op (float32 buffers, output shaped like input 0):
+  void <name>(const float** ins, const int64_t* sizes, int n_in, float* out);
+  // optional grad (d wrt input 0):
+  void <name>_grad(const float** ins, const int64_t* sizes, int n_in,
+                   const float* grad_out, float* grad_in);
+"""
+from .cpp_extension import (  # noqa: F401
+    CppExtension, CUDAExtension, BuildExtension, load, setup,
+)
+
+__all__ = ["CppExtension", "CUDAExtension", "BuildExtension", "load", "setup"]

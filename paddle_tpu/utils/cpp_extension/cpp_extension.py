@@ -1,0 +1,194 @@
+"""Custom C++ op builder/loader (see package docstring for the C ABI)."""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import types
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+DEFAULT_BUILD_ROOT = os.environ.get(
+    "PADDLE_TPU_EXTENSION_DIR",
+    os.path.expanduser("~/.cache/paddle_tpu/extensions"))
+
+
+class CppExtension:
+    """Declarative extension spec (reference: CppExtension(sources=...))."""
+
+    def __init__(self, sources: Sequence[str], name: Optional[str] = None,
+                 extra_compile_args: Optional[List[str]] = None,
+                 extra_link_args: Optional[List[str]] = None, **kwargs):
+        self.sources = list(sources)
+        self.name = name
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.extra_link_args = list(extra_link_args or [])
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension has no TPU analog — write device kernels in Pallas "
+        "(paddle_tpu/ops/pallas) and host ops as CppExtension")
+
+
+class BuildExtension:
+    """setuptools cmdclass shim (reference: BuildExtension.with_options).
+    The JIT ``load`` path is the supported flow; this class exists so
+    reference setup.py files import cleanly."""
+
+    @classmethod
+    def with_options(cls, **options):
+        return cls
+
+
+def setup(name: str, ext_modules=None, **kwargs):
+    """Build the extensions eagerly into the cache dir (the reference's
+    setup() installs an importable module; here the artifact is the shared
+    library which ``load`` picks up)."""
+    exts = ext_modules or []
+    if isinstance(exts, CppExtension):
+        exts = [exts]
+    return [load(e.name or name, e.sources,
+                 extra_cflags=e.extra_compile_args,
+                 extra_ldflags=e.extra_link_args) for e in exts]
+
+
+def _build(name: str, sources: Sequence[str], extra_cflags, extra_ldflags,
+           build_directory: Optional[str], verbose: bool) -> str:
+    root = build_directory or os.path.join(DEFAULT_BUILD_ROOT, name)
+    os.makedirs(root, exist_ok=True)
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(repr((extra_cflags, extra_ldflags)).encode())
+    out = os.path.join(root, f"{name}-{h.hexdigest()[:16]}.so")
+    if not os.path.exists(out):
+        cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+               *map(str, sources), *(extra_cflags or []),
+               "-o", out + ".tmp", *(extra_ldflags or [])]
+        if verbose:
+            print("building:", " ".join(cmd))
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(f"extension build failed:\n{e.stderr}") from e
+        os.replace(out + ".tmp", out)
+    return out
+
+
+_KERNEL_SIG = [ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+               ctypes.c_int, ctypes.c_void_p]
+_GRAD_SIG = _KERNEL_SIG[:3] + [ctypes.c_void_p, ctypes.c_void_p]
+
+
+def _make_host_call(kernel):
+    """numpy-in/numpy-out host function around the C kernel."""
+    def host(*arrays):
+        arrays = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+        n = len(arrays)
+        ptrs = (ctypes.c_void_p * n)(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays])
+        sizes = (ctypes.c_int64 * n)(*[a.size for a in arrays])
+        out = np.empty_like(arrays[0])
+        kernel(ptrs, sizes, n, out.ctypes.data_as(ctypes.c_void_p))
+        return out
+    return host
+
+
+def _make_grad_call(kernel):
+    def host(*arrays_and_gout):
+        arrays = [np.ascontiguousarray(a, dtype=np.float32)
+                  for a in arrays_and_gout[:-1]]
+        gout = np.ascontiguousarray(arrays_and_gout[-1], dtype=np.float32)
+        n = len(arrays)
+        ptrs = (ctypes.c_void_p * n)(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays])
+        sizes = (ctypes.c_int64 * n)(*[a.size for a in arrays])
+        gin = np.empty_like(arrays[0])
+        kernel(ptrs, sizes, n, gout.ctypes.data_as(ctypes.c_void_p),
+               gin.ctypes.data_as(ctypes.c_void_p))
+        return gin
+    return host
+
+
+def _build_op_fn(name: str, n_in: int, host_fwd, host_grad):
+    """A differentiable jax-level function around the host kernels, then a
+    user-facing Tensor op registered through the standard dispatch."""
+    import jax
+    import jax.numpy as jnp
+    from ...framework.dispatch import def_op
+
+    def _callback(*arrays):
+        spec = jax.ShapeDtypeStruct(arrays[0].shape, jnp.float32)
+        return jax.pure_callback(host_fwd, spec, *arrays, vmap_method="sequential")
+
+    if host_grad is not None:
+        @jax.custom_vjp
+        def core(*arrays):
+            return _callback(*arrays)
+
+        def fwd(*arrays):
+            return _callback(*arrays), arrays
+
+        def bwd(res, g):
+            spec = jax.ShapeDtypeStruct(res[0].shape, jnp.float32)
+            gin = jax.pure_callback(host_grad, spec, *res, g,
+                                    vmap_method="sequential")
+            # d wrt input 0 only; other inputs get zero cotangents
+            return (gin,) + tuple(jnp.zeros_like(a) for a in res[1:])
+
+        core.defvjp(fwd, bwd)
+    else:
+        def core(*arrays):
+            return _callback(*arrays)
+
+    def wrapper(*arrays):
+        if len(arrays) != n_in:
+            raise TypeError(f"{name} expects {n_in} inputs, got {len(arrays)}")
+        return core(*[jnp.asarray(a, jnp.float32) for a in arrays])
+
+    wrapper.__name__ = name
+    return def_op(name, custom_extension=True)(wrapper)
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cflags: Optional[List[str]] = None,
+         extra_ldflags: Optional[List[str]] = None,
+         extra_include_paths: Optional[List[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> types.SimpleNamespace:
+    """JIT-build and load a custom-op extension (reference:
+    cpp_extension.load).  Returns a module-like namespace with one callable
+    per op declared by ``pt_ops()``."""
+    cflags = list(extra_cflags or [])
+    for p in extra_include_paths or []:
+        cflags.append(f"-I{p}")
+    so_path = _build(name, sources, cflags, extra_ldflags, build_directory,
+                     verbose)
+    lib = ctypes.CDLL(so_path)
+    try:
+        lib.pt_ops.restype = ctypes.c_char_p
+        desc = lib.pt_ops().decode()
+    except AttributeError as e:
+        raise RuntimeError(
+            f"extension {name} must export  const char* pt_ops()  "
+            "(see cpp_extension package docstring)") from e
+
+    mod = types.SimpleNamespace(__so_path__=so_path)
+    for entry in filter(None, desc.split(";")):
+        parts = entry.split(":")
+        op_name, n_in = parts[0].strip(), int(parts[1])
+        has_grad = len(parts) > 2 and parts[2].strip() == "grad"
+        kernel = getattr(lib, op_name)
+        kernel.argtypes = _KERNEL_SIG
+        host_fwd = _make_host_call(kernel)
+        host_grad = None
+        if has_grad:
+            gk = getattr(lib, op_name + "_grad")
+            gk.argtypes = _GRAD_SIG
+            host_grad = _make_grad_call(gk)
+        setattr(mod, op_name, _build_op_fn(op_name, n_in, host_fwd, host_grad))
+    return mod

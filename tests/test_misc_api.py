@@ -105,6 +105,45 @@ class TestCallbacks:
         assert abs(m._optimizer.get_lr() - 0.005) < 1e-9
 
 
+    def test_optimizer_scheduler_advances_in_fit(self):
+        net = nn.Sequential(nn.Linear(4, 2))
+        sched = optim.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+        m = paddle.Model(net)
+        m.prepare(optimizer=optim.SGD(parameters=net.parameters(),
+                                      learning_rate=sched),
+                  loss=nn.CrossEntropyLoss())
+        m.fit(self._data(16), batch_size=8, epochs=1, verbose=0)
+        # 2 steps with step_size=1 -> lr decayed at least once
+        assert m._optimizer.get_lr() < 0.1
+
+    def test_reduce_lr_with_scheduler_does_not_crash(self):
+        net = nn.Sequential(nn.Linear(4, 2))
+        sched = optim.lr.StepDecay(learning_rate=0.1, step_size=100)
+        m = paddle.Model(net)
+        m.prepare(optimizer=optim.SGD(parameters=net.parameters(),
+                                      learning_rate=sched),
+                  loss=nn.CrossEntropyLoss())
+        cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                                patience=1, verbose=0)
+        cb.set_model(m)
+        cb.on_eval_end({"loss": [1.0]})
+        cb.on_eval_end({"loss": [1.0]})
+        assert m._optimizer.get_lr() == pytest.approx(0.05)
+
+    def test_reduce_lr_cooldown_holds(self):
+        m = self._model()
+        cb = paddle.callbacks.ReduceLROnPlateau(
+            monitor="loss", factor=0.5, patience=1, cooldown=3, verbose=0)
+        cb.set_model(m)
+        lr0 = m._optimizer.get_lr()
+        for _ in range(4):   # 1 reduction, then cooldown holds
+            cb.on_eval_end({"loss": [1.0]})
+        assert m._optimizer.get_lr() == pytest.approx(lr0 * 0.5)
+
+    def test_cuda_invalid_device_raises(self):
+        with pytest.raises(ValueError):
+            paddle.device.cuda.memory_allocated(99)
+
     def test_early_stopping_saves_best_model(self, tmp_path):
         m = self._model()
         es = paddle.callbacks.EarlyStopping(monitor="loss", patience=3,
