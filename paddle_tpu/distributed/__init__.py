@@ -46,3 +46,6 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
 def launch():
     from .launch.main import main
     main()
+
+
+from .store import TCPStore, create_or_get_global_tcp_store  # noqa: E402,F401

@@ -261,10 +261,16 @@ class ReduceLROnPlateau(Callback):
                 if new < old:
                     sched = getattr(opt, "_learning_rate", None)
                     if hasattr(sched, "base_lr"):
-                        # scheduler-driven LR: scale its base so future
-                        # schedule values shrink proportionally
-                        sched.base_lr *= self.factor
-                        sched.last_lr *= self.factor
+                        # scheduler-driven LR: shrink the whole schedule by
+                        # the applied (min_lr-clamped) ratio — every lr-level
+                        # attribute scales so max_lr/OneCycle-style schedules
+                        # honor the reduction too
+                        ratio = new / old
+                        for attr in ("base_lr", "last_lr", "max_lr",
+                                     "initial_lr", "end_lr", "eta_min"):
+                            if hasattr(sched, attr):
+                                setattr(sched, attr,
+                                        getattr(sched, attr) * ratio)
                     else:
                         opt.set_lr(new)
                     if self.verbose:

@@ -7,6 +7,10 @@ C++ shared library with a C ABI, loaded via ctypes.  Libraries are compiled
 on first use with g++ and cached by source hash, so the package needs no build
 step to install; every consumer must degrade gracefully to a pure-Python
 fallback when no toolchain is present.
+
+``build_shared`` is the single compile/cache pipeline — also used by
+utils.cpp_extension for user extensions — guarded by an in-process lock plus
+an flock so concurrent processes never corrupt the cache.
 """
 from __future__ import annotations
 
@@ -15,6 +19,7 @@ import hashlib
 import os
 import subprocess
 import threading
+from typing import Optional, Sequence
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_SRC_DIR, "_build")
@@ -26,26 +31,70 @@ class NativeBuildError(RuntimeError):
     pass
 
 
+def _hash_sources(sources: Sequence[str], extra_flags: Sequence[str]) -> str:
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    # headers are not tracked through #include; approximate by hashing any
+    # header files sitting in -I directories so header edits trigger rebuilds
+    for flag in extra_flags or ():
+        if flag.startswith("-I"):
+            inc = flag[2:]
+            if os.path.isdir(inc):
+                for fn in sorted(os.listdir(inc)):
+                    if fn.endswith((".h", ".hpp", ".hh", ".cuh")):
+                        with open(os.path.join(inc, fn), "rb") as f:
+                            h.update(f.read())
+    h.update(repr(tuple(extra_flags or ())).encode())
+    return h.hexdigest()[:16]
+
+
+def build_shared(name: str, sources: Sequence[str],
+                 extra_flags: Sequence[str] = (),
+                 build_dir: Optional[str] = None,
+                 verbose: bool = False) -> str:
+    """Compile ``sources`` into a cached shared library; returns its path.
+    Safe under concurrent calls from multiple processes (flock) and threads
+    (module lock taken by callers holding _lock or via load_native)."""
+    root = build_dir or _BUILD_DIR
+    os.makedirs(root, exist_ok=True)
+    tag = _hash_sources(sources, extra_flags)
+    out = os.path.join(root, f"lib{name}-{tag}.so")
+    if os.path.exists(out):
+        return out
+    lock_path = out + ".lock"
+    import fcntl
+    with open(lock_path, "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(out):   # built by the lock holder before us
+                return out
+            tmp = f"{out}.tmp.{os.getpid()}"
+            cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared",
+                   "-pthread", *map(str, sources), *list(extra_flags or ()),
+                   "-o", tmp]
+            if verbose:
+                print("building:", " ".join(cmd))
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               text=True)
+            except (subprocess.CalledProcessError, OSError) as e:
+                msg = getattr(e, "stderr", str(e))
+                raise NativeBuildError(f"building {name}: {msg}") from e
+            os.replace(tmp, out)
+            return out
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+
+
 def load_native(name: str, extra_flags: tuple = ()) -> ctypes.CDLL:
-    """Compile ``<name>.cc`` into a shared library (cached) and dlopen it."""
+    """Compile ``<name>.cc`` (cached) and dlopen it."""
     with _lock:
         if name in _cache:
             return _cache[name]
         src = os.path.join(_SRC_DIR, name + ".cc")
-        with open(src, "rb") as f:
-            blob = f.read()
-        tag = hashlib.sha256(blob + repr(extra_flags).encode()).hexdigest()[:16]
-        os.makedirs(_BUILD_DIR, exist_ok=True)
-        out = os.path.join(_BUILD_DIR, f"lib{name}-{tag}.so")
-        if not os.path.exists(out):
-            cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared",
-                   "-pthread", src, "-o", out + ".tmp", *extra_flags]
-            try:
-                subprocess.run(cmd, check=True, capture_output=True, text=True)
-            except (subprocess.CalledProcessError, OSError) as e:
-                msg = getattr(e, "stderr", str(e))
-                raise NativeBuildError(f"building {name}: {msg}") from e
-            os.replace(out + ".tmp", out)
+        out = build_shared(name, [src], extra_flags)
         lib = ctypes.CDLL(out)
         _cache[name] = lib
         return lib

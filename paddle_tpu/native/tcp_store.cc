@@ -1,0 +1,286 @@
+// TCP key-value store for host-side rendezvous/coordination.
+//
+// Capability parity with the reference's TCPStore
+// (reference: paddle/phi/core/distributed/store/tcp_store.cc — master server
+// with set/get/add/wait, worker clients over TCP).  TPU-native role: inside a
+// slice, rendezvous is jax.distributed's coordination service; this store
+// covers the *framework-level* coordination the reference exposes to users
+// (elastic membership, launch barriers, cross-host handshakes) without
+// pulling in etcd/brpc.
+//
+// Wire protocol (little-endian):
+//   request : u8 cmd | u32 klen | key bytes | payload
+//     cmd 0 SET  : payload = u32 vlen | value bytes        -> resp u8 0
+//     cmd 1 GET  : payload = i64 timeout_ms                -> resp u32 vlen
+//                  (0xFFFFFFFF on timeout) | value bytes
+//     cmd 2 ADD  : payload = i64 delta                     -> resp i64 new
+//     cmd 3 WAIT : payload = i64 timeout_ms                -> resp u8 0|1
+//     cmd 4 CHECK: no payload                              -> resp u8 0|1
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Server {
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> data;
+};
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void HandleConn(Server* s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t cmd;
+    uint32_t klen;
+    if (!ReadFull(fd, &cmd, 1) || !ReadFull(fd, &klen, 4)) break;
+    if (klen > (1u << 20)) break;
+    std::string key(klen, '\0');
+    if (!ReadFull(fd, key.data(), klen)) break;
+
+    if (cmd == 0) {  // SET
+      uint32_t vlen;
+      if (!ReadFull(fd, &vlen, 4) || vlen > (1u << 28)) break;
+      std::string val(vlen, '\0');
+      if (!ReadFull(fd, val.data(), vlen)) break;
+      {
+        std::lock_guard<std::mutex> l(s->mu);
+        s->data[key] = std::move(val);
+      }
+      s->cv.notify_all();
+      uint8_t ok = 0;
+      if (!WriteFull(fd, &ok, 1)) break;
+    } else if (cmd == 1 || cmd == 3) {  // GET / WAIT (blocking)
+      int64_t timeout_ms;
+      if (!ReadFull(fd, &timeout_ms, 8)) break;
+      std::string val;
+      bool found = false;
+      {
+        std::unique_lock<std::mutex> l(s->mu);
+        auto pred = [&] {
+          return s->stop.load() || s->data.count(key) != 0;
+        };
+        if (timeout_ms < 0) {
+          s->cv.wait(l, pred);
+        } else {
+          s->cv.wait_for(l, std::chrono::milliseconds(timeout_ms), pred);
+        }
+        auto it = s->data.find(key);
+        if (it != s->data.end()) {
+          found = true;
+          val = it->second;
+        }
+      }
+      if (cmd == 1) {
+        uint32_t vlen = found ? static_cast<uint32_t>(val.size())
+                              : 0xFFFFFFFFu;
+        if (!WriteFull(fd, &vlen, 4)) break;
+        if (found && !WriteFull(fd, val.data(), val.size())) break;
+      } else {
+        uint8_t rc = found ? 0 : 1;
+        if (!WriteFull(fd, &rc, 1)) break;
+      }
+    } else if (cmd == 2) {  // ADD
+      int64_t delta;
+      if (!ReadFull(fd, &delta, 8)) break;
+      int64_t result;
+      {
+        std::lock_guard<std::mutex> l(s->mu);
+        int64_t cur = 0;
+        auto it = s->data.find(key);
+        if (it != s->data.end() && it->second.size() == 8) {
+          std::memcpy(&cur, it->second.data(), 8);
+        }
+        result = cur + delta;
+        std::string v(8, '\0');
+        std::memcpy(v.data(), &result, 8);
+        s->data[key] = std::move(v);
+      }
+      s->cv.notify_all();
+      if (!WriteFull(fd, &result, 8)) break;
+    } else if (cmd == 4) {  // CHECK
+      uint8_t exists;
+      {
+        std::lock_guard<std::mutex> l(s->mu);
+        exists = s->data.count(key) ? 1 : 0;
+      }
+      if (!WriteFull(fd, &exists, 1)) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+void AcceptLoop(Server* s) {
+  for (;;) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (s->stop.load()) return;
+      continue;
+    }
+    s->conn_threads.emplace_back(HandleConn, s, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Starts a server on `port` (0 = ephemeral).  Returns handle, writes the
+// bound port into *out_port; nullptr on failure.
+void* pt_store_server_start(int port, int* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  if (out_port) *out_port = ntohs(addr.sin_port);
+  auto* s = new Server();
+  s->listen_fd = fd;
+  s->accept_thread = std::thread(AcceptLoop, s);
+  return s;
+}
+
+void pt_store_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  s->stop.store(true);
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->conn_threads) {
+    if (t.joinable()) t.join();
+  }
+  delete s;
+}
+
+// Client: one blocking connection.
+int pt_store_connect(const char* host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  std::snprintf(portstr, sizeof(portstr), "%d", port);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    if (getaddrinfo(host, portstr, &hints, &res) == 0) {
+      int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 &&
+          ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        freeaddrinfo(res);
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return fd;
+      }
+      if (fd >= 0) ::close(fd);
+      freeaddrinfo(res);
+      res = nullptr;
+    }
+    if (std::chrono::steady_clock::now() >= deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void pt_store_close(int fd) { ::close(fd); }
+
+static bool SendKey(int fd, uint8_t cmd, const char* key) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  return WriteFull(fd, &cmd, 1) && WriteFull(fd, &klen, 4) &&
+         WriteFull(fd, key, klen);
+}
+
+int pt_store_set(int fd, const char* key, const void* val, uint32_t vlen) {
+  if (!SendKey(fd, 0, key) || !WriteFull(fd, &vlen, 4) ||
+      !WriteFull(fd, val, vlen))
+    return -1;
+  uint8_t ok;
+  return ReadFull(fd, &ok, 1) ? 0 : -1;
+}
+
+// Returns value length, -1 on timeout/error.  Caller provides buf/cap; if
+// the value is larger than cap the first cap bytes are stored (check the
+// returned length).
+int64_t pt_store_get(int fd, const char* key, int64_t timeout_ms, void* buf,
+                     uint32_t cap) {
+  if (!SendKey(fd, 1, key) || !WriteFull(fd, &timeout_ms, 8)) return -1;
+  uint32_t vlen;
+  if (!ReadFull(fd, &vlen, 4)) return -1;
+  if (vlen == 0xFFFFFFFFu) return -1;
+  std::string val(vlen, '\0');
+  if (!ReadFull(fd, val.data(), vlen)) return -1;
+  std::memcpy(buf, val.data(), vlen < cap ? vlen : cap);
+  return static_cast<int64_t>(vlen);
+}
+
+int64_t pt_store_add(int fd, const char* key, int64_t delta) {
+  if (!SendKey(fd, 2, key) || !WriteFull(fd, &delta, 8)) return INT64_MIN;
+  int64_t result;
+  return ReadFull(fd, &result, 8) ? result : INT64_MIN;
+}
+
+int pt_store_wait(int fd, const char* key, int64_t timeout_ms) {
+  if (!SendKey(fd, 3, key) || !WriteFull(fd, &timeout_ms, 8)) return -1;
+  uint8_t rc;
+  return ReadFull(fd, &rc, 1) ? rc : -1;
+}
+
+int pt_store_check(int fd, const char* key) {
+  if (!SendKey(fd, 4, key)) return -1;
+  uint8_t rc;
+  return ReadFull(fd, &rc, 1) ? rc : -1;
+}
+
+}  // extern "C"

@@ -2,9 +2,7 @@
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
 import types
 from typing import List, Optional, Sequence
 
@@ -20,11 +18,16 @@ class CppExtension:
 
     def __init__(self, sources: Sequence[str], name: Optional[str] = None,
                  extra_compile_args: Optional[List[str]] = None,
-                 extra_link_args: Optional[List[str]] = None, **kwargs):
+                 extra_link_args: Optional[List[str]] = None,
+                 include_dirs: Optional[List[str]] = None, **kwargs):
         self.sources = list(sources)
         self.name = name
         self.extra_compile_args = list(extra_compile_args or [])
+        self.extra_compile_args += [f"-I{d}" for d in include_dirs or []]
         self.extra_link_args = list(extra_link_args or [])
+        if kwargs:
+            import warnings
+            warnings.warn(f"CppExtension: ignored build kwargs {sorted(kwargs)}")
 
 
 def CUDAExtension(*args, **kwargs):
@@ -57,26 +60,10 @@ def setup(name: str, ext_modules=None, **kwargs):
 
 def _build(name: str, sources: Sequence[str], extra_cflags, extra_ldflags,
            build_directory: Optional[str], verbose: bool) -> str:
+    from ...native import build_shared
     root = build_directory or os.path.join(DEFAULT_BUILD_ROOT, name)
-    os.makedirs(root, exist_ok=True)
-    h = hashlib.sha256()
-    for s in sources:
-        with open(s, "rb") as f:
-            h.update(f.read())
-    h.update(repr((extra_cflags, extra_ldflags)).encode())
-    out = os.path.join(root, f"{name}-{h.hexdigest()[:16]}.so")
-    if not os.path.exists(out):
-        cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
-               *map(str, sources), *(extra_cflags or []),
-               "-o", out + ".tmp", *(extra_ldflags or [])]
-        if verbose:
-            print("building:", " ".join(cmd))
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
-        except subprocess.CalledProcessError as e:
-            raise RuntimeError(f"extension build failed:\n{e.stderr}") from e
-        os.replace(out + ".tmp", out)
-    return out
+    flags = list(extra_cflags or []) + list(extra_ldflags or [])
+    return build_shared(name, sources, flags, build_dir=root, verbose=verbose)
 
 
 _KERNEL_SIG = [ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),

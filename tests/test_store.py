@@ -1,0 +1,141 @@
+"""TCPStore tests: native server/client, multi-process rendezvous, barrier —
+mirrors the reference's single-host multi-process collective test strategy
+(SURVEY §4.4)."""
+import multiprocessing as mp
+import pickle
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import (
+    TCPStore, _PyClient, _PyStoreServer, barrier,
+)
+
+
+@pytest.fixture()
+def master():
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10)
+    yield s
+    s.close()
+
+
+class TestNativeStore:
+    def test_native_backend_active(self, master):
+        from paddle_tpu.distributed.store import _NativeClient
+        assert isinstance(master._client, _NativeClient)
+
+    def test_set_get(self, master):
+        master.set("k1", b"hello")
+        assert master.get("k1") == b"hello"
+        master.set("k1", "text-value")
+        assert master.get("k1") == b"text-value"
+
+    def test_get_blocks_until_set(self, master):
+        worker = TCPStore("127.0.0.1", master.port, is_master=False,
+                          timeout=10)
+
+        def setter():
+            time.sleep(0.2)
+            worker.set("late_key", b"v")
+
+        t = threading.Thread(target=setter)
+        t.start()
+        t0 = time.time()
+        assert master.get("late_key", timeout=5) == b"v"
+        assert time.time() - t0 >= 0.15
+        t.join()
+
+    def test_get_timeout(self, master):
+        with pytest.raises(TimeoutError):
+            master.get("never_set", timeout=0.2)
+
+    def test_add_counter(self, master):
+        assert master.add("cnt", 1) == 1
+        assert master.add("cnt", 2) == 3
+        assert master.add("cnt", -1) == 2
+
+    def test_wait_and_check(self, master):
+        assert not master.check("w1")
+        master.set("w1", b"x")
+        master.wait("w1", timeout=1)
+        assert master.check("w1")
+
+    def test_large_value(self, master):
+        blob = bytes(range(256)) * 4096   # 1 MiB
+        master.set("big", blob)
+        assert master.get("big") == blob
+
+    def test_multiple_clients(self, master):
+        clients = [TCPStore("127.0.0.1", master.port, is_master=False,
+                            timeout=10) for _ in range(4)]
+        for i, c in enumerate(clients):
+            c.set(f"client_{i}", str(i))
+        for i, c in enumerate(clients):
+            assert master.get(f"client_{i}") == str(i).encode()
+
+
+def _rank_proc(rank, world, port, results):
+    store = TCPStore("127.0.0.1", port, is_master=False, world_size=world,
+                     timeout=20)
+    store.set(f"rank/{rank}", pickle.dumps({"rank": rank}))
+    barrier(store, "join", world)
+    # after barrier every rank sees every other rank's entry immediately
+    got = sorted(pickle.loads(store.get(f"rank/{r}"))["rank"]
+                 for r in range(world))
+    results.put((rank, got))
+
+
+class TestMultiProcess:
+    def test_rendezvous_and_barrier(self):
+        world = 3
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=world,
+                          timeout=20)
+        ctx = mp.get_context("spawn")
+        results = ctx.Queue()
+        procs = [ctx.Process(target=_rank_proc,
+                             args=(r, world, master.port, results))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        seen = {}
+        for _ in range(world):
+            rank, got = results.get(timeout=60)
+            seen[rank] = got
+        for p in procs:
+            p.join(timeout=30)
+        assert set(seen) == {0, 1, 2}
+        for got in seen.values():
+            assert got == [0, 1, 2]
+
+
+class TestPyFallback:
+    def test_python_server_and_client_protocol(self):
+        srv = _PyStoreServer(0)
+        try:
+            c = _PyClient("127.0.0.1", srv.port, timeout=10)
+            assert c.set(b"k", b"v")
+            assert c.get(b"k", 1000) == b"v"
+            assert c.add(b"n", 5) == 5
+            assert c.add(b"n", 5) == 10
+            assert c.wait(b"k", 1000)
+            assert c.check(b"k")
+            assert not c.check(b"missing")
+            assert c.get(b"missing", 100) is None
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_native_client_python_server_interop(self):
+        # wire protocol is shared: native client against python server
+        from paddle_tpu.distributed.store import _NativeClient, _load_lib
+        srv = _PyStoreServer(0)
+        try:
+            lib = _load_lib()
+            c = _NativeClient(lib, "127.0.0.1", srv.port, timeout=10)
+            assert c.set(b"ik", b"iv")
+            assert c.get(b"ik", 1000) == b"iv"
+            assert c.add(b"ic", 7) == 7
+            c.close()
+        finally:
+            srv.stop()
