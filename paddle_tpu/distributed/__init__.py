@@ -49,3 +49,8 @@ def launch():
 
 
 from .store import TCPStore, create_or_get_global_tcp_store  # noqa: E402,F401
+from .watchdog import (  # noqa: E402,F401
+    enable_comm_watchdog, disable_comm_watchdog, comm_guard, CommTaskManager,
+)
+from . import fault_tolerance  # noqa: E402,F401
+from .fleet import elastic  # noqa: E402,F401

@@ -1,0 +1,137 @@
+"""Preemption-aware fault tolerance: signal handling + checkpoint-resume.
+
+Capability parity with the reference's failure-recovery flow
+(reference: elastic relaunch on special exit codes
+fleet/elastic/manager.py:33-34 + checkpoint/resume via paddle.save/load;
+SURVEY §5 "Failure detection / elastic recovery" — the TPU equivalent is a
+preemption notice + checkpoint-resume loop, since TPU pods deliver
+maintenance/preemption as SIGTERM).
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+import signal
+import sys
+import threading
+from typing import Callable, List, Optional
+
+from .fleet.elastic.manager import ELASTIC_EXIT_CODE
+
+__all__ = [
+    "PreemptionHandler", "save_checkpoint", "latest_checkpoint",
+    "load_checkpoint", "run_with_resume",
+]
+
+
+class PreemptionHandler:
+    """Installs SIGTERM/SIGUSR1 handlers that set a flag checked between
+    steps — the cooperative-preemption pattern for TPU pods."""
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._event = threading.Event()
+        self._callbacks: List[Callable[[], None]] = []
+        self._prev = {}
+        self._signals = signals
+
+    def install(self) -> "PreemptionHandler":
+        for sig in self._signals:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+
+    def _on_signal(self, signum, frame):
+        self._event.set()
+        for cb in self._callbacks:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def on_preemption(self, cb: Callable[[], None]) -> None:
+        self._callbacks.append(cb)
+
+    def preempted(self) -> bool:
+        return self._event.is_set()
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+
+_CKPT_RE = re.compile(r"step_(\d+)$")
+
+
+def save_checkpoint(state_dict: dict, ckpt_dir: str, step: int,
+                    keep_last_n: int = 3) -> str:
+    """Atomic checkpoint write: save to tmp, rename, prune old
+    (reference: paddle.save + dist checkpoint's async/atomic discipline)."""
+    from ..framework.io import save as _save
+    os.makedirs(ckpt_dir, exist_ok=True)
+    final = os.path.join(ckpt_dir, f"step_{step}")
+    tmp = final + ".tmp"
+    _save(state_dict, tmp)
+    os.replace(tmp, final)
+    # prune
+    ckpts = sorted(_list_checkpoints(ckpt_dir))
+    for s in ckpts[:-keep_last_n]:
+        try:
+            os.remove(os.path.join(ckpt_dir, f"step_{s}"))
+        except OSError:
+            pass
+    return final
+
+
+def _list_checkpoints(ckpt_dir: str) -> List[int]:
+    out = []
+    for p in glob.glob(os.path.join(ckpt_dir, "step_*")):
+        m = _CKPT_RE.search(os.path.basename(p))
+        if m and not p.endswith(".tmp"):
+            out.append(int(m.group(1)))
+    return out
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    steps = _list_checkpoints(ckpt_dir)
+    if not steps:
+        return None
+    return os.path.join(ckpt_dir, f"step_{max(steps)}")
+
+
+def load_checkpoint(ckpt_dir: str):
+    """(state_dict, step) of the newest checkpoint, or (None, 0)."""
+    from ..framework.io import load as _load
+    path = latest_checkpoint(ckpt_dir)
+    if path is None:
+        return None, 0
+    step = int(_CKPT_RE.search(os.path.basename(path)).group(1))
+    return _load(path), step
+
+
+def run_with_resume(train_loop: Callable, ckpt_dir: str,
+                    exit_on_preemption: bool = True):
+    """Drive a resumable training loop.
+
+    ``train_loop(state_dict, start_step, should_stop)`` — ``state_dict`` is
+    the restored checkpoint (or None), ``should_stop()`` turns True on
+    preemption; the loop is expected to save via ``save_checkpoint`` and
+    return normally.  On preemption this exits with ELASTIC_EXIT_CODE so a
+    supervising ``launch_elastic`` relaunches (and resumes) it.
+    """
+    handler = PreemptionHandler().install()
+    try:
+        state, start_step = load_checkpoint(ckpt_dir)
+        result = train_loop(state, start_step, handler.preempted)
+        if handler.preempted() and exit_on_preemption:
+            sys.exit(ELASTIC_EXIT_CODE)
+        return result
+    finally:
+        handler.uninstall()

@@ -1,0 +1,22 @@
+"""Elastic training: membership, fault-tolerant relaunch, scale in/out.
+
+Capability parity with the reference's elastic subsystem
+(reference: python/paddle/distributed/fleet/elastic/manager.py:125
+ElasticManager — etcd registration with TTL :145, membership watch, relaunch
+decision, ELASTIC_EXIT_CODE=101/102 :33-34, fault tolerance level env
+PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL :177).
+
+TPU-native: membership lives in the native TCPStore (no etcd dependency) —
+each node heartbeats a timestamped key; the manager computes the alive set
+and signals RESTART/EXIT.  On TPU pods, preemption notices arrive as SIGTERM;
+see fault_tolerance.py for the checkpoint-resume loop.
+"""
+from .manager import (  # noqa: F401
+    ElasticManager, ElasticStatus, LauncherInterface,
+    ELASTIC_EXIT_CODE, ELASTIC_AUTO_PARALLEL_EXIT_CODE, launch_elastic,
+)
+
+__all__ = [
+    "ElasticManager", "ElasticStatus", "LauncherInterface",
+    "ELASTIC_EXIT_CODE", "ELASTIC_AUTO_PARALLEL_EXIT_CODE", "launch_elastic",
+]

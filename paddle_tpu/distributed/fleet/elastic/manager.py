@@ -1,0 +1,174 @@
+"""ElasticManager over the native TCPStore (see package docstring)."""
+from __future__ import annotations
+
+import enum
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+ELASTIC_EXIT_CODE = 101
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+
+class ElasticStatus(enum.Enum):
+    COMPLETED = 0
+    ERROR = 1
+    HOLD = 2
+    RESTART = 3
+    EXIT = 4
+
+
+class ElasticManager:
+    """Membership + relaunch decisions (reference: manager.py:125).
+
+    Each node calls ``register`` (starts a heartbeat thread refreshing
+    ``elastic/node/<host>`` with a timestamp).  ``alive_nodes`` is the set
+    whose heartbeat is younger than the TTL; ``watch`` returns HOLD while
+    the world matches ``np``, RESTART when membership changed but remains
+    viable (>= min_np), EXIT when it dropped below min_np.
+    """
+
+    def __init__(self, store, np: int, host: Optional[str] = None,
+                 min_np: Optional[int] = None, ttl: float = 10.0,
+                 heartbeat_interval: Optional[float] = None):
+        self._store = store
+        self.np = np
+        self.min_np = min_np if min_np is not None else np
+        self.ttl = ttl
+        self.host = host or f"{os.uname().nodename}-{os.getpid()}"
+        self._interval = heartbeat_interval or max(ttl / 3.0, 0.05)
+        self._beat_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.enabled = True
+
+    # -- membership --------------------------------------------------------
+    def register(self) -> None:
+        self._store.set(f"elastic/node/{self.host}", str(time.time()))
+        roster = self._store.get("elastic/roster", timeout=0.1) \
+            if self._store.check("elastic/roster") else b""
+        names = set(filter(None, roster.decode().split(",")))
+        names.add(self.host)
+        self._store.set("elastic/roster", ",".join(sorted(names)))
+        if self._beat_thread is None:
+            self._beat_thread = threading.Thread(target=self._heartbeat,
+                                                 daemon=True)
+            self._beat_thread.start()
+
+    def deregister(self) -> None:
+        self._stop.set()
+        if self._beat_thread is not None:
+            self._beat_thread.join(timeout=2 * self._interval)
+            self._beat_thread = None
+        # tombstone: report an expired heartbeat
+        self._store.set(f"elastic/node/{self.host}", "0")
+
+    def _heartbeat(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._store.set(f"elastic/node/{self.host}",
+                                str(time.time()))
+            except Exception:
+                return
+
+    def alive_nodes(self) -> List[str]:
+        if not self._store.check("elastic/roster"):
+            return []
+        roster = self._store.get("elastic/roster").decode()
+        now = time.time()
+        alive = []
+        for name in filter(None, roster.split(",")):
+            key = f"elastic/node/{name}"
+            if not self._store.check(key):
+                continue
+            try:
+                ts = float(self._store.get(key).decode())
+            except ValueError:
+                continue
+            if now - ts <= self.ttl:
+                alive.append(name)
+        return alive
+
+    # -- decisions ---------------------------------------------------------
+    def watch(self) -> ElasticStatus:
+        n = len(self.alive_nodes())
+        if n == self.np:
+            return ElasticStatus.HOLD
+        if n >= self.min_np:
+            return ElasticStatus.RESTART
+        return ElasticStatus.EXIT
+
+    def wait_for_np(self, np: Optional[int] = None,
+                    timeout: float = 300.0) -> bool:
+        """Block until ``np`` nodes are alive (rendezvous for a restart)."""
+        want = np or self.np
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.alive_nodes()) >= want:
+                return True
+            time.sleep(self._interval)
+        return False
+
+    def exit(self, completed: bool = True) -> None:
+        self.deregister()
+        if completed:
+            self._store.set(f"elastic/done/{self.host}", b"1")
+
+
+class LauncherInterface:
+    """Child-process supervisor (reference: elastic/manager.py
+    LauncherInterface — launch/ watch/ stop the trainer process)."""
+
+    def __init__(self, cmd: List[str], env: Optional[dict] = None,
+                 log_path: Optional[str] = None):
+        self.cmd = cmd
+        self.env = {**os.environ, **(env or {})}
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+
+    def launch(self) -> None:
+        out = (open(self.log_path, "ab")
+               if self.log_path else None)
+        self.proc = subprocess.Popen(self.cmd, env=self.env, stdout=out,
+                                     stderr=subprocess.STDOUT if out else None)
+
+    def watch(self) -> Optional[int]:
+        """Non-blocking: exit code or None while running."""
+        if self.proc is None:
+            return None
+        return self.proc.poll()
+
+    def stop(self, grace: float = 10.0) -> None:
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+
+def launch_elastic(cmd: List[str], max_restarts: int = 3,
+                   env: Optional[dict] = None,
+                   poll_interval: float = 0.2) -> int:
+    """Run ``cmd``; relaunch on ELASTIC exit codes up to ``max_restarts``
+    (reference: launch controllers re-exec loop on exit code 101/102).
+    Returns the final exit code."""
+    restarts = 0
+    while True:
+        launcher = LauncherInterface(cmd, env)
+        launcher.launch()
+        while True:
+            code = launcher.watch()
+            if code is not None:
+                break
+            time.sleep(poll_interval)
+        if code in (ELASTIC_EXIT_CODE, ELASTIC_AUTO_PARALLEL_EXIT_CODE) \
+                and restarts < max_restarts:
+            restarts += 1
+            env = {**(env or {}), "PADDLE_RESTART_COUNT": str(restarts)}
+            continue
+        return code

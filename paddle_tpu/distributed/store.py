@@ -348,13 +348,15 @@ class TCPStore:
 
 def barrier(store: TCPStore, key: str, world_size: int,
             timeout: Optional[float] = None) -> None:
-    """Store-based barrier: each rank increments, waits for the release key
-    set by the last arriver (reference: tcp_store-based barrier in
-    launch/elastic flows)."""
+    """Store-based reusable barrier: each rank increments a counter; the
+    last arriver of each generation releases a per-generation key, so the
+    same ``key`` can synchronize every epoch (reference: tcp_store-based
+    barrier in launch/elastic flows)."""
     arrived = store.add("barrier/" + key, 1)
-    if arrived == world_size:
-        store.set("barrier_done/" + key, b"1")
-    store.wait("barrier_done/" + key, timeout)
+    gen = (arrived - 1) // world_size
+    if arrived % world_size == 0:
+        store.set(f"barrier_done/{key}/{gen}", b"1")
+    store.wait(f"barrier_done/{key}/{gen}", timeout)
 
 
 _global_store: Optional[TCPStore] = None

@@ -1,0 +1,189 @@
+"""Collective watchdog: async hang/timeout detection on eager collectives.
+
+Capability parity with the reference's comm task watchdog
+(reference: paddle/phi/core/distributed/comm_task_manager.cc:142-169 —
+background thread scanning in-flight CommTasks, logging/aborting hung
+collectives; paddle/phi/core/distributed/nccl_comm_task.cc:234 IsTimeout).
+
+TPU-native: intra-slice collectives are compiled into the XLA program (they
+cannot "hang" separately from the step), so the watchdog guards the
+*host-side* coordination ops — eager collectives over multihost_utils, store
+rendezvous, barriers — where a lost peer blocks forever in the reference's
+failure mode too.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+import traceback
+import warnings
+from typing import Callable, Dict, Optional
+
+from ..framework.flags import define_flag, get_flag
+
+define_flag("comm_timeout_seconds", 1800.0,
+            "watchdog timeout for host-side collectives/rendezvous")
+define_flag("comm_watchdog_abort", False,
+            "abort the process when a collective exceeds the timeout "
+            "(reference: FLAGS async error handling abort semantics)")
+
+__all__ = ["CommTask", "CommTaskManager", "comm_guard",
+           "enable_comm_watchdog", "disable_comm_watchdog"]
+
+
+class CommTask:
+    __slots__ = ("name", "started_at", "timeout", "done", "thread_name")
+
+    def __init__(self, name: str, timeout: float):
+        self.name = name
+        self.timeout = timeout
+        self.started_at = time.monotonic()
+        self.done = False
+        self.thread_name = threading.current_thread().name
+
+    def is_timeout(self, now: Optional[float] = None) -> bool:
+        return (not self.done
+                and (now or time.monotonic()) - self.started_at > self.timeout)
+
+
+class CommTaskManager:
+    """Background scanner over in-flight host collectives."""
+
+    _instance: Optional["CommTaskManager"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, scan_interval: float = 1.0):
+        self._tasks: Dict[int, CommTask] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._scan_interval = scan_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._timeout_handler: Optional[Callable[[CommTask], None]] = None
+        self._flagged: set = set()
+
+    @classmethod
+    def instance(cls) -> "CommTaskManager":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = CommTaskManager()
+            return cls._instance
+
+    def set_timeout_handler(self, fn: Callable[[CommTask], None]) -> None:
+        self._timeout_handler = fn
+
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._scan_loop,
+                                            name="comm-watchdog", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self._scan_interval)
+            self._thread = None
+
+    def begin(self, name: str, timeout: Optional[float] = None) -> int:
+        t = CommTask(name, timeout or get_flag("comm_timeout_seconds"))
+        with self._lock:
+            self._seq += 1
+            tid = self._seq
+            self._tasks[tid] = t
+        return tid
+
+    def end(self, tid: int) -> None:
+        with self._lock:
+            t = self._tasks.pop(tid, None)
+        if t is not None:
+            t.done = True
+
+    def in_flight(self):
+        with self._lock:
+            return list(self._tasks.values())
+
+    def _scan_loop(self) -> None:
+        while not self._stop.wait(self._scan_interval):
+            now = time.monotonic()
+            with self._lock:
+                hung = [(tid, t) for tid, t in self._tasks.items()
+                        if t.is_timeout(now) and tid not in self._flagged]
+                for tid, _ in hung:
+                    self._flagged.add(tid)
+            for tid, t in hung:
+                self._on_timeout(t)
+
+    def _on_timeout(self, task: CommTask) -> None:
+        msg = (f"[comm-watchdog] collective '{task.name}' on thread "
+               f"{task.thread_name} exceeded {task.timeout:.0f}s "
+               f"(started {time.monotonic() - task.started_at:.0f}s ago); "
+               "a peer may be lost or desynchronized")
+        if self._timeout_handler is not None:
+            self._timeout_handler(task)
+            return
+        warnings.warn(msg)
+        for line in traceback.format_stack():
+            pass   # stack of the watchdog thread is not the hung one
+        if get_flag("comm_watchdog_abort"):
+            print(msg + " — aborting (FLAGS_comm_watchdog_abort)",
+                  flush=True)
+            os._exit(1)
+
+
+class comm_guard:
+    """``with comm_guard("all_reduce"): ...`` registers the span with the
+    watchdog; also usable as a decorator."""
+
+    def __init__(self, name: str, timeout: Optional[float] = None):
+        self.name = name
+        self.timeout = timeout
+        self._tid = None
+
+    def __enter__(self):
+        mgr = CommTaskManager.instance()
+        mgr.start()
+        self._tid = mgr.begin(self.name, self.timeout)
+        return self
+
+    def __exit__(self, *exc):
+        CommTaskManager.instance().end(self._tid)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with comm_guard(self.name, self.timeout):
+                return fn(*args, **kwargs)
+        return wrapped
+
+
+_WRAPPED_COLLECTIVES = ("all_reduce", "all_gather", "all_gather_object",
+                        "reduce", "broadcast", "scatter", "all_to_all",
+                        "send", "recv", "barrier", "reduce_scatter")
+_originals: Dict[str, Callable] = {}
+
+
+def enable_comm_watchdog(timeout: Optional[float] = None) -> None:
+    """Wrap the eager collective API with watchdog guards (reference: the
+    watchdog is always-on for every NCCL task; here it is opt-in since
+    intra-slice collectives are compiled and cannot hang host-side)."""
+    from . import collective as coll
+    mgr = CommTaskManager.instance()
+    mgr.start()
+    for name in _WRAPPED_COLLECTIVES:
+        fn = getattr(coll, name, None)
+        if fn is None or name in _originals:
+            continue
+        _originals[name] = fn
+        setattr(coll, name, comm_guard(name, timeout)(fn))
+
+
+def disable_comm_watchdog() -> None:
+    from . import collective as coll
+    for name, fn in _originals.items():
+        setattr(coll, name, fn)
+    _originals.clear()
+    CommTaskManager.instance().stop()

@@ -259,18 +259,11 @@ class ReduceLROnPlateau(Callback):
                 old = float(opt.get_lr())
                 new = max(old * self.factor, self.min_lr)
                 if new < old:
-                    sched = getattr(opt, "_learning_rate", None)
-                    if hasattr(sched, "base_lr"):
-                        # scheduler-driven LR: shrink the whole schedule by
-                        # the applied (min_lr-clamped) ratio — every lr-level
-                        # attribute scales so max_lr/OneCycle-style schedules
-                        # honor the reduction too
-                        ratio = new / old
-                        for attr in ("base_lr", "last_lr", "max_lr",
-                                     "initial_lr", "end_lr", "eta_min"):
-                            if hasattr(sched, attr):
-                                setattr(sched, attr,
-                                        getattr(sched, attr) * ratio)
+                    if hasattr(opt, "_lr_factor"):
+                        # works for every schedule shape: the optimizer
+                        # multiplies its (scheduled or fixed) lr by this
+                        # factor, so the min_lr-clamped reduction sticks
+                        opt._lr_factor *= new / old
                     else:
                         opt.set_lr(new)
                     if self.verbose:

@@ -42,10 +42,11 @@ def _hash_sources(sources: Sequence[str], extra_flags: Sequence[str]) -> str:
         if flag.startswith("-I"):
             inc = flag[2:]
             if os.path.isdir(inc):
-                for fn in sorted(os.listdir(inc)):
-                    if fn.endswith((".h", ".hpp", ".hh", ".cuh")):
-                        with open(os.path.join(inc, fn), "rb") as f:
-                            h.update(f.read())
+                for root, _dirs, files in sorted(os.walk(inc)):
+                    for fn in sorted(files):
+                        if fn.endswith((".h", ".hpp", ".hh", ".cuh")):
+                            with open(os.path.join(root, fn), "rb") as f:
+                                h.update(f.read())
     h.update(repr(tuple(extra_flags or ())).encode())
     return h.hexdigest()[:16]
 

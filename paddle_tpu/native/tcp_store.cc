@@ -41,6 +41,8 @@ struct Server {
   std::atomic<bool> stop{false};
   std::thread accept_thread;
   std::vector<std::thread> conn_threads;
+  std::mutex conn_mu;
+  std::vector<int> conn_fds;   // open connections, shut down on stop
   std::mutex mu;
   std::condition_variable cv;
   std::map<std::string, std::string> data;
@@ -158,7 +160,13 @@ void AcceptLoop(Server* s) {
     int fd = ::accept(s->listen_fd, nullptr, nullptr);
     if (fd < 0) {
       if (s->stop.load()) return;
+      // persistent errors (EMFILE, ...) must not busy-spin
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
       continue;
+    }
+    {
+      std::lock_guard<std::mutex> l(s->conn_mu);
+      s->conn_fds.push_back(fd);
     }
     s->conn_threads.emplace_back(HandleConn, s, fd);
   }
@@ -200,6 +208,11 @@ void pt_store_server_stop(void* handle) {
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // unblock HandleConn threads sitting in recv() on live clients
+    std::lock_guard<std::mutex> l(s->conn_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
   for (auto& t : s->conn_threads) {
     if (t.joinable()) t.join();
   }

@@ -64,12 +64,15 @@ class Optimizer:
         self._global_step = 0
         self._jit_update = None
         self._name = name or type(self).__name__
+        # multiplicative factor on top of the schedule (ReduceLROnPlateau
+        # scales this so the reduction works for every scheduler shape)
+        self._lr_factor = 1.0
 
     # ------------------------------------------------------------------- lr
     def get_lr(self) -> float:
         if isinstance(self._learning_rate, LRScheduler):
-            return float(self._learning_rate())
-        return float(self._learning_rate)
+            return float(self._learning_rate()) * self._lr_factor
+        return float(self._learning_rate) * self._lr_factor
 
     def set_lr(self, value: float):
         if isinstance(self._learning_rate, LRScheduler):

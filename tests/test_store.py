@@ -75,6 +75,28 @@ class TestNativeStore:
             assert master.get(f"client_{i}") == str(i).encode()
 
 
+class TestBarrierReuse:
+    def test_same_key_multiple_generations(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True, timeout=10)
+        try:
+            # world_size=1: each call is its own generation and must
+            # complete rather than sail through on a stale release key
+            for _ in range(3):
+                barrier(master, "epoch", 1, timeout=2)
+            assert master.add("barrier/epoch", 0) == 3
+        finally:
+            master.close()
+
+    def test_server_stops_with_live_clients(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True, timeout=10)
+        extra = TCPStore("127.0.0.1", master.port, is_master=False,
+                         timeout=10)
+        t0 = time.time()
+        master.close()          # must not hang on extra's open connection
+        assert time.time() - t0 < 5
+        extra.close()
+
+
 def _rank_proc(rank, world, port, results):
     store = TCPStore("127.0.0.1", port, is_master=False, world_size=world,
                      timeout=20)
