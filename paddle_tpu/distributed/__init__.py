@@ -54,3 +54,4 @@ from .watchdog import (  # noqa: E402,F401
 )
 from . import fault_tolerance  # noqa: E402,F401
 from .fleet import elastic  # noqa: E402,F401
+from . import auto_tuner  # noqa: E402,F401

@@ -1,0 +1,22 @@
+"""paddle_tpu.distributed.auto_tuner — parallelism config search (SURVEY #64).
+
+Capability parity with the reference's auto-tuner
+(reference: python/paddle/distributed/auto_tuner/ — tuner.py AutoTuner,
+search.py GridSearch, prune.py @register_prune rules over dp/mp/pp/sharding/
+micro-bs/recompute, recorder.py history, cost_model.py).
+
+TPU-native: the search space ranges over mesh-axis degrees
+(dp/fsdp/mp/pp/sep) instead of GPU process counts; pruning knows TPU
+constraints (degrees must tile the chip count, TP axis should divide heads,
+memory model uses bf16+fp32-master footprints against per-chip HBM); the
+analytical cost model prices compute at MXU peak x MFU and communication
+over ICI per mesh axis.
+"""
+from .tuner import AutoTuner  # noqa: F401
+from .search import GridSearch  # noqa: F401
+from .recorder import HistoryRecorder  # noqa: F401
+from .cost_model import CostModel, HardwareSpec, ModelSpec  # noqa: F401
+from .prune import register_prune, PRUNE_RULES  # noqa: F401
+
+__all__ = ["AutoTuner", "GridSearch", "HistoryRecorder", "CostModel",
+           "HardwareSpec", "ModelSpec", "register_prune", "PRUNE_RULES"]

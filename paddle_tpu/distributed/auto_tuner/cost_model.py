@@ -1,0 +1,150 @@
+"""Analytical step-time + memory cost model for parallelism planning.
+
+Capability parity with the reference's tuner cost models
+(reference: python/paddle/distributed/auto_tuner/cost_model.py,
+memory_cost_model.py; static auto-parallel cost model
+python/paddle/distributed/auto_parallel/static/cost_model.py).
+
+TPU-first pricing (the scaling-book recipe): a transformer step costs
+  compute  = 6 * params * tokens / (peak_flops * mfu)            [fwd+bwd]
+  TP comm  = per-layer allreduce volume over the ICI mp axis
+  DP comm  = grad reduce-scatter+all-gather volume over dp axis
+  PP       = bubble fraction (pp-1)/(microbatches + pp - 1)
+Memory: params/grads/optimizer states sharded per ZeRO stage + activations
+per microbatch (with recompute discount).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+
+@dataclass
+class HardwareSpec:
+    """Per-chip capability (defaults ~ a v5p-class chip)."""
+    peak_flops: float = 459e12        # bf16 FLOP/s
+    hbm_bytes: float = 95e9
+    ici_bandwidth: float = 9e10       # bytes/s per link direction, on-mesh
+    dcn_bandwidth: float = 6.25e9     # bytes/s cross-slice
+    mfu: float = 0.55                 # achievable model FLOPs utilization
+
+
+@dataclass
+class ModelSpec:
+    """Transformer shape (decoder-style)."""
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    vocab_size: int
+    seq_len: int
+    intermediate_size: int = 0
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def n_params(self) -> float:
+        h, L = self.hidden_size, self.num_layers
+        per_layer = 4 * h * h + 2 * h * self.intermediate_size \
+            + (self.intermediate_size * h if True else 0)
+        embed = self.vocab_size * h
+        return L * per_layer + embed
+
+    def flops_per_token(self) -> float:
+        # 6 * params for fwd+bwd matmuls + attention quadratic term
+        attn = 12 * self.num_layers * self.hidden_size * self.seq_len
+        return 6.0 * self.n_params + attn
+
+
+@dataclass
+class ParallelConfig:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sharding_stage: int = 1
+    micro_batch_size: int = 1
+    global_batch_size: int = 1
+    vpp_degree: int = 1
+    use_recompute: bool = False
+
+    def as_dict(self) -> Dict:
+        return dict(self.__dict__)
+
+
+class CostModel:
+    def __init__(self, model: ModelSpec, hardware: HardwareSpec = None):
+        self.model = model
+        self.hw = hardware or HardwareSpec()
+
+    # -- memory ------------------------------------------------------------
+    def memory_bytes(self, cfg: ParallelConfig) -> float:
+        m, hw = self.model, self.hw
+        shard_params = cfg.mp_degree * cfg.pp_degree * (
+            cfg.sharding_degree if cfg.sharding_stage >= 3 else 1)
+        shard_grads = cfg.mp_degree * cfg.pp_degree * (
+            cfg.sharding_degree if cfg.sharding_stage >= 2 else 1)
+        shard_opt = cfg.mp_degree * cfg.pp_degree * cfg.sharding_degree
+        p = m.n_params
+        params_b = 2.0 * p / shard_params          # bf16 weights
+        grads_b = 2.0 * p / shard_grads            # bf16 grads
+        opt_b = 12.0 * p / shard_opt               # fp32 master + 2 moments
+        # activations per microbatch per layer (~34*s*b*h for a bf16 block)
+        layers_here = m.num_layers / cfg.pp_degree
+        act_per_layer = 34.0 * m.seq_len * cfg.micro_batch_size * \
+            m.hidden_size / cfg.mp_degree
+        if cfg.use_recompute:
+            act_per_layer *= 0.15                  # keep boundaries only
+        # 1F1B keeps <= pp in-flight microbatches on the first stage
+        in_flight = min(cfg.pp_degree, max(
+            self.num_microbatches(cfg), 1))
+        act_b = act_per_layer * layers_here * in_flight
+        return params_b + grads_b + opt_b + act_b
+
+    def fits_memory(self, cfg: ParallelConfig, reserve: float = 0.9) -> bool:
+        return self.memory_bytes(cfg) <= self.hw.hbm_bytes * reserve
+
+    # -- time --------------------------------------------------------------
+    def num_microbatches(self, cfg: ParallelConfig) -> int:
+        denom = cfg.micro_batch_size * cfg.dp_degree * max(
+            cfg.sharding_degree if cfg.sharding_stage >= 2 else 1, 1)
+        return max(cfg.global_batch_size // max(denom, 1), 1)
+
+    def step_time(self, cfg: ParallelConfig) -> float:
+        m, hw = self.model, self.hw
+        tokens = cfg.global_batch_size * m.seq_len
+        world = cfg.dp_degree * cfg.mp_degree * cfg.pp_degree * \
+            max(cfg.sharding_degree, 1)
+        compute = m.flops_per_token() * tokens / (
+            hw.peak_flops * hw.mfu * world)
+
+        # TP: 4 allreduces per layer of bs*seq*hidden bf16, ring cost
+        comm = 0.0
+        if cfg.mp_degree > 1:
+            per_layer = 4 * 2.0 * cfg.micro_batch_size * m.seq_len * \
+                m.hidden_size
+            ring = 2.0 * (cfg.mp_degree - 1) / cfg.mp_degree
+            comm += m.num_layers / cfg.pp_degree * per_layer * ring * \
+                self.num_microbatches(cfg) / hw.ici_bandwidth
+        # DP/sharding: grad reduce-scatter + (maybe) param all-gather
+        dp_world = cfg.dp_degree * (cfg.sharding_degree
+                                    if cfg.sharding_stage >= 2 else 1)
+        if dp_world > 1:
+            grad_bytes = 2.0 * m.n_params / (cfg.mp_degree * cfg.pp_degree)
+            ring = 2.0 * (dp_world - 1) / dp_world
+            comm += grad_bytes * ring / hw.ici_bandwidth
+
+        busy = compute + comm
+        # PP bubble stretches the step
+        if cfg.pp_degree > 1:
+            mb = self.num_microbatches(cfg) * max(cfg.vpp_degree, 1)
+            bubble = (cfg.pp_degree - 1) / (mb + cfg.pp_degree - 1)
+            busy = busy / max(1.0 - bubble, 1e-3)
+        if cfg.use_recompute:
+            busy *= 4.0 / 3.0                      # extra forward pass
+        return busy
+
+    def tokens_per_sec(self, cfg: ParallelConfig) -> float:
+        return cfg.global_batch_size * self.model.seq_len / \
+            self.step_time(cfg)

@@ -1,0 +1,140 @@
+"""Prune rules: reject invalid/hopeless configs before costing/running.
+
+Capability parity with the reference's prune registry
+(reference: python/paddle/distributed/auto_tuner/prune.py —
+@register_prune rules prune_by_mp/pp/mbs/sharding/recompute/num_gpus,
+history-based pruning of configs dominated by an OOM/slower sibling).
+"""
+from __future__ import annotations
+
+from typing import Callable, List
+
+PRUNE_RULES: List[Callable] = []
+PRUNE_HISTORY_RULES: List[Callable] = []
+
+
+def register_prune(fn: Callable) -> Callable:
+    """fn(tuner_cfg, cur_cfg, history) -> True to PRUNE."""
+    PRUNE_RULES.append(fn)
+    return fn
+
+
+def register_prune_history(fn: Callable) -> Callable:
+    PRUNE_HISTORY_RULES.append(fn)
+    return fn
+
+
+def _get(cfg, key, default=None):
+    if isinstance(cfg, dict):
+        return cfg.get(key, default)
+    return getattr(cfg, key, default)
+
+
+@register_prune
+def prune_by_num_chips(tuner_cfg, cur, history):
+    """Degrees must exactly tile the chip count (reference: prune_by_num_gpus)."""
+    n = _get(tuner_cfg, "num_chips", 1)
+    world = _get(cur, "dp_degree", 1) * _get(cur, "mp_degree", 1) * \
+        _get(cur, "pp_degree", 1) * max(_get(cur, "sharding_degree", 1), 1)
+    return world != n
+
+
+@register_prune
+def prune_by_mp(tuner_cfg, cur, history):
+    """TP degree must divide heads and hidden (reference: prune_by_mp)."""
+    mp = _get(cur, "mp_degree", 1)
+    if mp <= 1:
+        return False
+    heads = _get(tuner_cfg, "num_heads", None)
+    hidden = _get(tuner_cfg, "hidden_size", None)
+    vocab = _get(tuner_cfg, "vocab_size", None)
+    if heads is not None and heads % mp != 0:
+        return True
+    if hidden is not None and hidden % mp != 0:
+        return True
+    if vocab is not None and vocab % mp != 0:
+        return True
+    return False
+
+
+@register_prune
+def prune_by_pp(tuner_cfg, cur, history):
+    """PP degree must divide the layer count; microbatches must cover the
+    pipeline (reference: prune_by_pp)."""
+    pp = _get(cur, "pp_degree", 1)
+    if pp <= 1:
+        return False
+    layers = _get(tuner_cfg, "num_layers", None)
+    if layers is not None and layers % pp != 0:
+        return True
+    return False
+
+
+@register_prune
+def prune_by_mbs(tuner_cfg, cur, history):
+    """micro-bs must divide the per-DP-rank batch (reference: prune_by_mbs)."""
+    gbs = _get(cur, "global_batch_size", None) or _get(
+        tuner_cfg, "global_batch_size", None)
+    if gbs is None:
+        return False
+    dp = _get(cur, "dp_degree", 1) * max(
+        _get(cur, "sharding_degree", 1)
+        if _get(cur, "sharding_stage", 1) >= 2 else 1, 1)
+    mbs = _get(cur, "micro_batch_size", 1)
+    if gbs % dp != 0:
+        return True
+    local = gbs // dp
+    return local % mbs != 0
+
+
+@register_prune
+def prune_by_vpp(tuner_cfg, cur, history):
+    """VPP chunks must divide per-stage layers (reference: prune_by_vpp)."""
+    vpp = _get(cur, "vpp_degree", 1)
+    if vpp <= 1:
+        return False
+    pp = _get(cur, "pp_degree", 1)
+    layers = _get(tuner_cfg, "num_layers", None)
+    if pp <= 1:
+        return True       # vpp without pp is meaningless
+    if layers is not None and (layers % pp != 0
+                               or (layers // pp) % vpp != 0):
+        return True
+    return False
+
+
+@register_prune
+def prune_by_memory(tuner_cfg, cur, history):
+    """Analytical OOM pruning (reference: memory_cost_model.py)."""
+    cm = _get(tuner_cfg, "cost_model", None)
+    if cm is None:
+        return False
+    from .cost_model import ParallelConfig
+    cfg = ParallelConfig(**{k: _get(cur, k, d) for k, d in
+                            ParallelConfig().__dict__.items()})
+    return not cm.fits_memory(cfg)
+
+
+@register_prune_history
+def prune_by_history_oom(tuner_cfg, cur, history):
+    """Skip configs dominated by an OOM sibling: same config but smaller
+    micro-bs already OOMed (reference: prune_by_mbs_history)."""
+    for h in history or []:
+        if _get(h, "oom", False):
+            same = all(_get(h, k) == _get(cur, k)
+                       for k in ("dp_degree", "mp_degree", "pp_degree",
+                                 "sharding_degree", "sharding_stage"))
+            if same and _get(h, "micro_batch_size", 1) <= \
+                    _get(cur, "micro_batch_size", 1):
+                return True
+    return False
+
+
+def should_prune(tuner_cfg, cur, history=None) -> bool:
+    for rule in PRUNE_RULES:
+        if rule(tuner_cfg, cur, history):
+            return True
+    for rule in PRUNE_HISTORY_RULES:
+        if rule(tuner_cfg, cur, history):
+            return True
+    return False

@@ -1,0 +1,69 @@
+"""Trial history: record, rank, persist
+(reference: python/paddle/distributed/auto_tuner/recorder.py
+History_recorder — store metric per config, sort, save csv)."""
+from __future__ import annotations
+
+import csv
+import json
+from typing import Dict, List, Optional
+
+
+class HistoryRecorder:
+    def __init__(self, metric_name: str = "tokens_per_sec",
+                 higher_is_better: bool = True):
+        self.metric_name = metric_name
+        self.higher_is_better = higher_is_better
+        self.history: List[Dict] = []
+
+    def add(self, cfg: Dict, metric: Optional[float] = None,
+            oom: bool = False, error: Optional[str] = None) -> None:
+        row = dict(cfg)
+        row[self.metric_name] = metric
+        row["oom"] = oom
+        if error:
+            row["error"] = error
+        self.history.append(row)
+
+    def sorted(self) -> List[Dict]:
+        ok = [h for h in self.history
+              if h.get(self.metric_name) is not None and not h.get("oom")]
+        return sorted(ok, key=lambda h: h[self.metric_name],
+                      reverse=self.higher_is_better)
+
+    def best(self) -> Optional[Dict]:
+        s = self.sorted()
+        return s[0] if s else None
+
+    def store_history(self, path: str = "./history.csv") -> None:
+        if not self.history:
+            return
+        keys = sorted({k for h in self.history for k in h})
+        if path.endswith(".json"):
+            with open(path, "w") as f:
+                json.dump(self.history, f, indent=1)
+            return
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            w.writerows(self.history)
+
+    def load_history(self, path: str = "./history.csv") -> None:
+        if path.endswith(".json"):
+            with open(path) as f:
+                self.history = json.load(f)
+            return
+        with open(path, newline="") as f:
+            self.history = []
+            for row in csv.DictReader(f):
+                parsed = {}
+                for k, v in row.items():
+                    if v == "":
+                        parsed[k] = None
+                    elif v in ("True", "False"):
+                        parsed[k] = v == "True"
+                    else:
+                        try:
+                            parsed[k] = json.loads(v)
+                        except (json.JSONDecodeError, TypeError):
+                            parsed[k] = v
+                self.history.append(parsed)

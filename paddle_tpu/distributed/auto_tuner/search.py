@@ -1,0 +1,56 @@
+"""Search algorithms over the parallelism space
+(reference: python/paddle/distributed/auto_tuner/search.py GridSearch)."""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Iterator, List
+
+
+def _factor_degrees(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def build_space(tuner_cfg: Dict) -> Dict[str, List]:
+    """Resolve 'auto' entries into candidate lists.  Degrees default to the
+    divisors of num_chips; micro-bs to powers of two up to the local batch."""
+    n = tuner_cfg.get("num_chips", 1)
+    gbs = tuner_cfg.get("global_batch_size", 1)
+    divisors = _factor_degrees(n)
+
+    def resolve(key, default):
+        v = tuner_cfg.get(key, default)
+        if v == "auto":
+            return default
+        if isinstance(v, (list, tuple)):
+            return list(v)
+        return [v]
+
+    mbs_cands = [m for m in (1, 2, 4, 8, 16, 32, 64) if m <= gbs]
+    return {
+        "dp_degree": resolve("dp_degree", divisors),
+        "mp_degree": resolve("mp_degree", divisors),
+        "pp_degree": resolve("pp_degree", divisors),
+        "sharding_degree": resolve("sharding_degree", [1]),
+        "sharding_stage": resolve("sharding_stage", [1]),
+        "vpp_degree": resolve("vpp_degree", [1]),
+        "micro_batch_size": resolve("micro_batch_size", mbs_cands or [1]),
+        "use_recompute": resolve("use_recompute", [False, True]),
+    }
+
+
+class GridSearch:
+    """Cartesian-product candidate stream (reference: search.py GridSearch);
+    pruning happens in the tuner, so this only enumerates."""
+
+    def __init__(self, tuner_cfg: Dict):
+        self.tuner_cfg = tuner_cfg
+        self.space = build_space(tuner_cfg)
+        keys = list(self.space)
+        self._iter = (dict(zip(keys, vals)) for vals in
+                      itertools.product(*[self.space[k] for k in keys]))
+
+    def __iter__(self) -> Iterator[Dict]:
+        return self._iter
+
+    def search_once(self) -> Dict:
+        return next(self._iter)

@@ -1,0 +1,90 @@
+"""AutoTuner driver (reference:
+python/paddle/distributed/auto_tuner/tuner.py AutoTuner — search_once over
+pruned grid, record results, pick best).
+
+Two evaluation modes:
+  - analytical (default): rank every valid config with the CostModel —
+    instant, no hardware needed;
+  - measured: pass ``run_fn(cfg) -> metric`` (e.g. run N real steps and
+    report tokens/sec); raise MemoryError inside to mark OOM (feeds the
+    history pruner).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from .cost_model import CostModel, HardwareSpec, ModelSpec, ParallelConfig
+from .prune import should_prune
+from .recorder import HistoryRecorder
+from .search import GridSearch
+
+
+class AutoTuner:
+    def __init__(self, tuner_cfg: Dict):
+        """tuner_cfg keys: num_chips, global_batch_size, model spec fields
+        (hidden_size/num_layers/num_heads/vocab_size/seq_len), optional
+        hardware (HardwareSpec), optional explicit degree lists or 'auto',
+        max_search_time/max_trials."""
+        self.tuner_cfg = dict(tuner_cfg)
+        model = tuner_cfg.get("model_spec")
+        if model is None and "hidden_size" in tuner_cfg:
+            model = ModelSpec(
+                hidden_size=tuner_cfg["hidden_size"],
+                num_layers=tuner_cfg["num_layers"],
+                num_heads=tuner_cfg["num_heads"],
+                vocab_size=tuner_cfg["vocab_size"],
+                seq_len=tuner_cfg.get("seq_len", 2048))
+        self.model_spec = model
+        hw = tuner_cfg.get("hardware") or HardwareSpec()
+        self.cost_model = (CostModel(model, hw) if model is not None
+                           else None)
+        self.tuner_cfg["cost_model"] = self.cost_model
+        self.recorder = HistoryRecorder()
+        self._search = GridSearch(self.tuner_cfg)
+
+    # -- candidate stream --------------------------------------------------
+    def search_once(self) -> Optional[Dict]:
+        """Next un-pruned candidate, or None when exhausted
+        (reference: tuner.py search_once)."""
+        for cand in self._search:
+            cand.setdefault("global_batch_size",
+                            self.tuner_cfg.get("global_batch_size", 1))
+            if should_prune(self.tuner_cfg, cand, self.recorder.history):
+                continue
+            return cand
+        return None
+
+    # -- full tuning loop --------------------------------------------------
+    def tune(self, run_fn: Optional[Callable[[Dict], float]] = None,
+             max_trials: Optional[int] = None) -> Optional[Dict]:
+        if run_fn is not None and "use_memory_prune" not in self.tuner_cfg:
+            # measured mode: let real runs decide OOM — the analytical
+            # memory model must not pre-filter what the user will measure
+            self.tuner_cfg["cost_model"] = None
+        trials = 0
+        while True:
+            cand = self.search_once()
+            if cand is None:
+                break
+            trials += 1
+            if run_fn is not None:
+                try:
+                    metric = run_fn(dict(cand))
+                    self.recorder.add(cand, metric)
+                except MemoryError:
+                    self.recorder.add(cand, None, oom=True)
+                except Exception as e:   # noqa: BLE001 — record and continue
+                    self.recorder.add(cand, None, error=str(e))
+            elif self.cost_model is not None:
+                cfg = ParallelConfig(**cand)
+                if not self.cost_model.fits_memory(cfg):
+                    self.recorder.add(cand, None, oom=True)
+                else:
+                    self.recorder.add(
+                        cand, self.cost_model.tokens_per_sec(cfg))
+            else:
+                raise ValueError("no run_fn and no model spec for the "
+                                 "analytical cost model")
+            if max_trials is not None and trials >= max_trials:
+                break
+        return self.recorder.best()

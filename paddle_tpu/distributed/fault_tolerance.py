@@ -80,9 +80,10 @@ def save_checkpoint(state_dict: dict, ckpt_dir: str, step: int,
     tmp = final + ".tmp"
     _save(state_dict, tmp)
     os.replace(tmp, final)
-    # prune
+    # prune (always keep at least the checkpoint just written)
+    keep = max(keep_last_n, 1)
     ckpts = sorted(_list_checkpoints(ckpt_dir))
-    for s in ckpts[:-keep_last_n]:
+    for s in ckpts[:-keep]:
         try:
             os.remove(os.path.join(ckpt_dir, f"step_{s}"))
         except OSError:

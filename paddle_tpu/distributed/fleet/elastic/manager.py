@@ -47,12 +47,12 @@ class ElasticManager:
     # -- membership --------------------------------------------------------
     def register(self) -> None:
         self._store.set(f"elastic/node/{self.host}", str(time.time()))
-        roster = self._store.get("elastic/roster", timeout=0.1) \
-            if self._store.check("elastic/roster") else b""
-        names = set(filter(None, roster.decode().split(",")))
-        names.add(self.host)
-        self._store.set("elastic/roster", ",".join(sorted(names)))
+        # roster entries are ADD-allocated slots: the counter increment is
+        # atomic server-side, so concurrent registrations never lose names
+        slot = self._store.add("elastic/roster_count", 1)
+        self._store.set(f"elastic/roster/{slot}", self.host)
         if self._beat_thread is None:
+            self._stop.clear()
             self._beat_thread = threading.Thread(target=self._heartbeat,
                                                  daemon=True)
             self._beat_thread.start()
@@ -74,12 +74,20 @@ class ElasticManager:
                 return
 
     def alive_nodes(self) -> List[str]:
-        if not self._store.check("elastic/roster"):
+        if not self._store.check("elastic/roster_count"):
             return []
-        roster = self._store.get("elastic/roster").decode()
+        n_slots = self._store.add("elastic/roster_count", 0)
         now = time.time()
         alive = []
-        for name in filter(None, roster.split(",")):
+        seen = set()
+        for slot in range(1, n_slots + 1):
+            skey = f"elastic/roster/{slot}"
+            if not self._store.check(skey):
+                continue
+            name = self._store.get(skey).decode()
+            if name in seen:     # re-registration allocates a new slot
+                continue
+            seen.add(name)
             key = f"elastic/node/{name}"
             if not self._store.check(key):
                 continue

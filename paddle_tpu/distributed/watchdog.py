@@ -17,7 +17,6 @@ import functools
 import os
 import threading
 import time
-import traceback
 import warnings
 from typing import Callable, Dict, Optional
 
@@ -98,6 +97,7 @@ class CommTaskManager:
     def end(self, tid: int) -> None:
         with self._lock:
             t = self._tasks.pop(tid, None)
+            self._flagged.discard(tid)
         if t is not None:
             t.done = True
 
@@ -125,8 +125,6 @@ class CommTaskManager:
             self._timeout_handler(task)
             return
         warnings.warn(msg)
-        for line in traceback.format_stack():
-            pass   # stack of the watchdog thread is not the hung one
         if get_flag("comm_watchdog_abort"):
             print(msg + " — aborting (FLAGS_comm_watchdog_abort)",
                   flush=True)
@@ -169,8 +167,14 @@ _originals: Dict[str, Callable] = {}
 def enable_comm_watchdog(timeout: Optional[float] = None) -> None:
     """Wrap the eager collective API with watchdog guards (reference: the
     watchdog is always-on for every NCCL task; here it is opt-in since
-    intra-slice collectives are compiled and cannot hang host-side)."""
+    intra-slice collectives are compiled and cannot hang host-side).
+
+    Both the collective module and the ``paddle_tpu.distributed`` package
+    re-exports are patched, so call sites bound either way are guarded.
+    """
+    import sys
     from . import collective as coll
+    pkg = sys.modules[__package__]
     mgr = CommTaskManager.instance()
     mgr.start()
     for name in _WRAPPED_COLLECTIVES:
@@ -178,12 +182,20 @@ def enable_comm_watchdog(timeout: Optional[float] = None) -> None:
         if fn is None or name in _originals:
             continue
         _originals[name] = fn
-        setattr(coll, name, comm_guard(name, timeout)(fn))
+        wrapped = comm_guard(name, timeout)(fn)
+        setattr(coll, name, wrapped)
+        if getattr(pkg, name, None) is fn:
+            setattr(pkg, name, wrapped)
 
 
 def disable_comm_watchdog() -> None:
+    import sys
     from . import collective as coll
+    pkg = sys.modules[__package__]
     for name, fn in _originals.items():
+        wrapped = getattr(coll, name, None)
         setattr(coll, name, fn)
+        if getattr(pkg, name, None) is wrapped:
+            setattr(pkg, name, fn)
     _originals.clear()
     CommTaskManager.instance().stop()

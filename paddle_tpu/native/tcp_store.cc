@@ -152,6 +152,17 @@ void HandleConn(Server* s, int fd) {
       break;
     }
   }
+  {
+    // drop from the live set before closing so server stop never
+    // shutdown()s a recycled fd number
+    std::lock_guard<std::mutex> l(s->conn_mu);
+    for (auto it = s->conn_fds.begin(); it != s->conn_fds.end(); ++it) {
+      if (*it == fd) {
+        s->conn_fds.erase(it);
+        break;
+      }
+    }
+  }
   ::close(fd);
 }
 

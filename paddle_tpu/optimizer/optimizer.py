@@ -196,6 +196,8 @@ class Optimizer:
             if pid in name_of:
                 sd[f"{name_of[pid]}.master_weight"] = wrap_array(arr)
         sd["global_step"] = self._global_step
+        if self._lr_factor != 1.0:
+            sd["lr_factor"] = self._lr_factor
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         return sd
@@ -204,11 +206,12 @@ class Optimizer:
         name_of = {(p.name or f"param_{i}"): p
                    for i, p in enumerate(self._parameter_list)}
         self._global_step = int(state_dict.get("global_step", 0))
+        self._lr_factor = float(state_dict.get("lr_factor", 1.0))
         if "LR_Scheduler" in state_dict and \
                 isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
         for key, value in state_dict.items():
-            if key in ("global_step", "LR_Scheduler"):
+            if key in ("global_step", "LR_Scheduler", "lr_factor"):
                 continue
             pname, slot = key.rsplit(".", 1)
             p = name_of.get(pname)

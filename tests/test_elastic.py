@@ -61,6 +61,29 @@ class TestElasticManager:
         assert m.alive_nodes() == []
         m.deregister()
 
+    def test_concurrent_registration_atomic(self, store):
+        import threading
+        managers = [ElasticManager(store, np=8, host=f"c{i}", ttl=30)
+                    for i in range(8)]
+        ts = [threading.Thread(target=m.register) for m in managers]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(managers[0].alive_nodes()) == 8
+        for m in managers:
+            m.deregister()
+
+    def test_reregister_after_deregister(self, store):
+        m = ElasticManager(store, np=1, host="re", ttl=0.5,
+                           heartbeat_interval=0.05)
+        m.register()
+        m.deregister()
+        m.register()            # heartbeat thread must restart
+        time.sleep(0.7)         # past ttl: only heartbeats keep it alive
+        assert m.alive_nodes() == ["re"]
+        m.deregister()
+
     def test_wait_for_np(self, store):
         a = ElasticManager(store, np=2, host="wa", ttl=5,
                            heartbeat_interval=0.05)
@@ -121,12 +144,17 @@ class TestWatchdog:
         assert "fast_barrier" not in hung
 
     def test_enable_disable_wrapping(self):
+        import paddle_tpu.distributed as dist
         import paddle_tpu.distributed.collective as coll
         orig = coll.all_reduce
+        pkg_orig = dist.all_reduce
         enable_comm_watchdog(timeout=60)
         assert coll.all_reduce is not orig
+        # the package re-export must be guarded too
+        assert dist.all_reduce is coll.all_reduce
         disable_comm_watchdog()
         assert coll.all_reduce is orig
+        assert dist.all_reduce is pkg_orig
 
 
 class TestFaultTolerance:
