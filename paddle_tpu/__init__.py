@@ -61,6 +61,8 @@ from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
 from . import onnx  # noqa: F401
 from . import device  # noqa: F401
+from . import audio  # noqa: F401
+from . import text  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import utils  # noqa: F401

@@ -1,0 +1,38 @@
+"""paddle_tpu.audio — audio features + functional (SURVEY #68 audio).
+
+reference: python/paddle/audio/ — features/layers.py, functional/,
+backends (soundfile IO, gated on the optional dependency), datasets
+(download-based; use local files in this environment).
+"""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from .features import (  # noqa: F401
+    Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC,
+)
+
+
+def load(path: str, sr=None, mono: bool = True, dtype: str = "float32"):
+    """Audio file load (reference: audio/backends — soundfile backend)."""
+    try:
+        import soundfile
+    except ImportError:
+        import wave
+
+        import numpy as np
+        with wave.open(path, "rb") as w:
+            frames = w.readframes(w.getnframes())
+            data = np.frombuffer(frames, dtype=np.int16).astype(dtype)
+            data /= 32768.0
+            if w.getnchannels() > 1:
+                data = data.reshape(-1, w.getnchannels())
+                if mono:
+                    data = data.mean(axis=1)
+            return data, w.getframerate()
+    data, rate = soundfile.read(path, dtype=dtype)
+    if mono and data.ndim > 1:
+        data = data.mean(axis=1)
+    return data, rate
+
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC", "load"]

@@ -1,0 +1,101 @@
+"""Audio feature layers: Spectrogram / MelSpectrogram / LogMelSpectrogram /
+MFCC (reference: python/paddle/audio/features/layers.py:47,132,239,346).
+
+Each layer precomputes its window / filterbank / DCT basis as constants so
+the forward is a pure matmul+fft pipeline XLA fuses into the step.
+"""
+from __future__ import annotations
+
+from .. import signal as _signal
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .. import tensor as T
+from .functional import (
+    compute_fbank_matrix, create_dct, get_window, power_to_db,
+)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length=None, win_length=None,
+                 window: str = "hann", power: float = 2.0, center=True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x: Tensor) -> Tensor:
+        """(..., time) -> (..., freq, frames) magnitude**power."""
+        spec = _signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            window=self.fft_window, center=self.center,
+                            pad_mode=self.pad_mode)
+        mag = T.abs(spec)
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return mag
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann", power: float = 2.0,
+                 center=True, pad_mode="reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max=None, htk: bool = False,
+                 norm="slaney", dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.n_mels = n_mels
+        self.fbank_matrix = compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk,
+            "slaney" if norm == "slaney" else None, dtype)
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = self._spectrogram(x)            # (..., freq, frames)
+        return T.matmul(self.fbank_matrix, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann", power: float = 2.0,
+                 center=True, pad_mode="reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10, top_db=None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x: Tensor) -> Tensor:
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length=None, win_length=None, window="hann",
+                 power: float = 2.0, center=True, pad_mode="reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db=None, dtype: str = "float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x: Tensor) -> Tensor:
+        """(..., time) -> (..., n_mfcc, frames)."""
+        logmel = self._log_melspectrogram(x)   # (..., n_mels, frames)
+        return T.matmul(self.dct_matrix.transpose([1, 0]), logmel)

@@ -1,0 +1,159 @@
+"""Audio functional ops: mel scale, filterbanks, dB, DCT, windows.
+
+Capability parity with the reference's audio functional API
+(reference: python/paddle/audio/functional/functional.py — hz_to_mel:29,
+mel_to_hz:83, mel_frequencies:126, fft_frequencies:166,
+compute_fbank_matrix:189, power_to_db:262, create_dct:306;
+functional/window.py get_window).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..framework.dispatch import def_op
+from ..framework.tensor import Tensor, wrap_array
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "power_to_db", "create_dct", "get_window",
+]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Hz -> mel (slaney by default, htk optional)."""
+    f = _unwrap(freq)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + jnp.asarray(f) / 700.0)
+        return wrap_array(out) if isinstance(freq, Tensor) else float(out)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (jnp.asarray(f, jnp.float32) - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    mels = jnp.where(jnp.asarray(f) >= min_log_hz,
+                     min_log_mel + jnp.log(jnp.maximum(
+                         jnp.asarray(f, jnp.float32), 1e-10) / min_log_hz)
+                     / logstep,
+                     mels)
+    return wrap_array(mels) if isinstance(freq, Tensor) else float(mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = _unwrap(mel)
+    if htk:
+        out = 700.0 * (10.0 ** (jnp.asarray(m) / 2595.0) - 1.0)
+        return wrap_array(out) if isinstance(mel, Tensor) else float(out)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * jnp.asarray(m, jnp.float32)
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    freqs = jnp.where(jnp.asarray(m) >= min_log_mel,
+                      min_log_hz * jnp.exp(logstep * (
+                          jnp.asarray(m, jnp.float32) - min_log_mel)),
+                      freqs)
+    return wrap_array(freqs) if isinstance(mel, Tensor) else float(freqs)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32") -> Tensor:
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(low, high, n_mels, dtype=dtype)
+    return mel_to_hz(wrap_array(mels), htk)
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32") -> Tensor:
+    return wrap_array(jnp.linspace(0, sr / 2.0, 1 + n_fft // 2,
+                                   dtype=dtype))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm: str = "slaney",
+                         dtype: str = "float32") -> Tensor:
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft, dtype)._data
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk, dtype)._data
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return wrap_array(weights.astype(dtype))
+
+
+@def_op("power_to_db")
+def power_to_db(x, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=80.0):
+    """10*log10(S/ref) with top_db flooring (reference: power_to_db:262)."""
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * jnp.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: str = "ortho",
+               dtype: str = "float32") -> Tensor:
+    """[n_mels, n_mfcc] DCT-II basis (reference: create_dct:306)."""
+    n = jnp.arange(n_mels, dtype=dtype)
+    k = jnp.arange(n_mfcc, dtype=dtype)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * math.sqrt(2.0 / n_mels)
+        dct = dct.at[:, 0].set(dct[:, 0] * (1.0 / math.sqrt(2)))
+    else:
+        dct = dct * 2.0
+    return wrap_array(dct.astype(dtype))
+
+
+def get_window(window, win_length: int, fftbins: bool = True,
+               dtype: str = "float32") -> Tensor:
+    """Window function by name (reference: functional/window.py get_window).
+    Supports hann/hamming/blackman/bartlett/bohman/kaiser/gaussian/
+    triang/rect; tuple form ('kaiser', beta) / ('gaussian', std)."""
+    arg = None
+    if isinstance(window, (tuple, list)):
+        window, arg = window[0], window[1]
+    n = win_length + 1 if fftbins else win_length   # periodic vs symmetric
+    t = jnp.arange(n, dtype=dtype)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * t / (n - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * t / (n - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * t / (n - 1))
+             + 0.08 * jnp.cos(4 * math.pi * t / (n - 1)))
+    elif window in ("bartlett", "triang"):
+        w = 1.0 - jnp.abs(2.0 * t / (n - 1) - 1.0)
+    elif window == "bohman":
+        x = jnp.abs(2.0 * t / (n - 1) - 1.0)
+        w = (1 - x) * jnp.cos(math.pi * x) + jnp.sin(math.pi * x) / math.pi
+    elif window == "kaiser":
+        beta = 12.0 if arg is None else float(arg)
+        x = 2.0 * t / (n - 1) - 1.0
+        w = jnp.i0(beta * jnp.sqrt(jnp.maximum(1 - x * x, 0))) / jnp.i0(beta)
+    elif window == "gaussian":
+        std = 7.0 if arg is None else float(arg)
+        x = t - (n - 1) / 2.0
+        w = jnp.exp(-0.5 * (x / std) ** 2)
+    elif window in ("rect", "boxcar", "ones"):
+        w = jnp.ones((n,), dtype)
+    else:
+        raise ValueError(f"unsupported window: {window}")
+    if fftbins:
+        w = w[:-1]                                  # drop the duplicate end
+    return wrap_array(w.astype(dtype))

@@ -31,13 +31,15 @@ class HardwareSpec:
 
 @dataclass
 class ModelSpec:
-    """Transformer shape (decoder-style)."""
+    """Transformer shape (decoder-style).  ``gated_mlp`` = SwiGLU-style
+    3-matrix FFN (LLaMA family); off = standard 2-matrix FFN."""
     hidden_size: int
     num_layers: int
     num_heads: int
     vocab_size: int
     seq_len: int
     intermediate_size: int = 0
+    gated_mlp: bool = False
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -46,8 +48,8 @@ class ModelSpec:
     @property
     def n_params(self) -> float:
         h, L = self.hidden_size, self.num_layers
-        per_layer = 4 * h * h + 2 * h * self.intermediate_size \
-            + (self.intermediate_size * h if True else 0)
+        mlp_mats = 3 if self.gated_mlp else 2
+        per_layer = 4 * h * h + mlp_mats * h * self.intermediate_size
         embed = self.vocab_size * h
         return L * per_layer + embed
 

@@ -53,4 +53,6 @@ class GridSearch:
         return self._iter
 
     def search_once(self) -> Dict:
-        return next(self._iter)
+        """Next candidate or None when exhausted (same contract as
+        AutoTuner.search_once)."""
+        return next(self._iter, None)

@@ -57,9 +57,11 @@ class AutoTuner:
     # -- full tuning loop --------------------------------------------------
     def tune(self, run_fn: Optional[Callable[[Dict], float]] = None,
              max_trials: Optional[int] = None) -> Optional[Dict]:
-        if run_fn is not None and "use_memory_prune" not in self.tuner_cfg:
-            # measured mode: let real runs decide OOM — the analytical
-            # memory model must not pre-filter what the user will measure
+        if not self.tuner_cfg.get("use_memory_prune", False):
+            # default: don't pre-filter on the analytical memory model —
+            # measured mode must measure what the user asked, and in
+            # analytical mode this lets OOM verdicts be *recorded* in the
+            # history instead of silently pruned
             self.tuner_cfg["cost_model"] = None
         trials = 0
         while True:

@@ -48,9 +48,13 @@ class ElasticManager:
     def register(self) -> None:
         self._store.set(f"elastic/node/{self.host}", str(time.time()))
         # roster entries are ADD-allocated slots: the counter increment is
-        # atomic server-side, so concurrent registrations never lose names
-        slot = self._store.add("elastic/roster_count", 1)
-        self._store.set(f"elastic/roster/{slot}", self.host)
+        # atomic server-side, so concurrent registrations never lose names.
+        # A host re-registering reuses its slot, keeping the scan bounded by
+        # distinct hosts rather than total registrations.
+        if not self._store.check(f"elastic/slot_of/{self.host}"):
+            slot = self._store.add("elastic/roster_count", 1)
+            self._store.set(f"elastic/roster/{slot}", self.host)
+            self._store.set(f"elastic/slot_of/{self.host}", str(slot))
         if self._beat_thread is None:
             self._stop.clear()
             self._beat_thread = threading.Thread(target=self._heartbeat,

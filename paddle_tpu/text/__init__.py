@@ -1,0 +1,8 @@
+"""paddle_tpu.text — text utilities (SURVEY #68 text).
+
+reference: python/paddle/text/ — viterbi_decode.py (ViterbiDecoder + the
+functional form), datasets (download-based; pass local files here).
+"""
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = ["ViterbiDecoder", "viterbi_decode"]

@@ -1,0 +1,88 @@
+"""Viterbi decoding for linear-chain CRF tag sequences.
+
+Capability parity with the reference
+(reference: python/paddle/text/viterbi_decode.py:31 viterbi_decode +
+ViterbiDecoder layer; C++ kernel paddle/phi/kernels/impl/viterbi_decode).
+
+TPU-native: the forward max-product recursion and the backtrace are both
+``lax.scan`` loops over the time axis (static shapes, no host sync), so the
+decoder compiles into one XLA program and batches on the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.dispatch import def_op
+from ..nn.layer.layers import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+@def_op("viterbi_decode")
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True):
+    """potentials [B,T,N], transition_params [N,N], lengths [B] ->
+    (scores [B], paths [B,T]); positions past a sequence's length hold 0.
+
+    With ``include_bos_eos_tag`` the last two tag indices are the implicit
+    BOS (N-2) and EOS (N-1) tags (reference semantics).
+    """
+    pots = jnp.asarray(potentials)
+    trans = jnp.asarray(transition_params)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    B, T, N = pots.shape
+
+    alpha = pots[:, 0]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[N - 2][None, :]
+
+    def fwd(carry, t):
+        a = carry
+        scores = a[:, :, None] + trans[None, :, :]      # [B, from, to]
+        best = scores.max(axis=1) + pots[:, t]
+        idx = scores.argmax(axis=1).astype(jnp.int32)   # [B, to]
+        active = (t < lengths)[:, None]
+        a = jnp.where(active, best, a)
+        idx = jnp.where(active, idx,
+                        jnp.arange(N, dtype=jnp.int32)[None, :])
+        return a, idx
+
+    if T > 1:
+        alpha, history = lax.scan(fwd, alpha, jnp.arange(1, T))
+    else:
+        history = jnp.zeros((0, B, N), jnp.int32)
+
+    final = alpha
+    if include_bos_eos_tag:
+        final = final + trans[:, N - 1][None, :]
+    scores = final.max(axis=-1)
+    last_tag = final.argmax(axis=-1).astype(jnp.int32)
+
+    def bwd(carry, idx_t):
+        tag = carry
+        prev = jnp.take_along_axis(idx_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first_tag, rest = lax.scan(bwd, last_tag, history, reverse=True)
+    paths = jnp.concatenate([first_tag[None, :], rest], axis=0)  # [T, B]
+    paths = jnp.transpose(paths, (1, 0))                          # [B, T]
+    # zero out positions past each sequence's length
+    mask = jnp.arange(T)[None, :] < lengths[:, None]
+    paths = jnp.where(mask, paths, 0)
+    return scores, paths
+
+
+class ViterbiDecoder(Layer):
+    """reference: paddle.text.ViterbiDecoder — holds the transition matrix."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,152 @@
+"""Audio feature + text viterbi tests (numpy-golden, SURVEY §4.1 style)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import (
+    MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram,
+)
+from paddle_tpu.audio.functional import (
+    compute_fbank_matrix, create_dct, fft_frequencies, get_window,
+    hz_to_mel, mel_to_hz, power_to_db,
+)
+from paddle_tpu.text import ViterbiDecoder, viterbi_decode
+
+
+class TestAudioFunctional:
+    def test_mel_roundtrip(self):
+        for htk in (False, True):
+            for hz in (60.0, 440.0, 4000.0):
+                assert mel_to_hz(hz_to_mel(hz, htk), htk) == pytest.approx(
+                    hz, rel=1e-4)
+
+    def test_hz_to_mel_htk_value(self):
+        # 1000 Hz ~= 1000 mel (HTK formula within 0.1%)
+        assert hz_to_mel(1000.0, htk=True) == pytest.approx(999.99, rel=1e-3)
+
+    def test_fft_frequencies(self):
+        f = fft_frequencies(16000, 512).numpy()
+        assert f.shape == (257,)
+        assert f[0] == 0 and f[-1] == pytest.approx(8000.0)
+
+    def test_fbank_shape_and_coverage(self):
+        fb = compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        # every filter has some support
+        assert (fb.sum(axis=1) > 0).all()
+
+    def test_windows(self):
+        for name in ("hann", "hamming", "blackman", "bartlett", "bohman",
+                     ("kaiser", 9.0), ("gaussian", 7.0), "rect"):
+            w = get_window(name, 64).numpy()
+            assert w.shape == (64,)
+            assert np.isfinite(w).all() and w.max() <= 1.0 + 1e-6
+        # periodic hann: w[0] == 0, symmetric midpoint == 1
+        w = get_window("hann", 64).numpy()
+        assert w[0] == pytest.approx(0.0, abs=1e-7)
+        assert w[32] == pytest.approx(1.0, abs=1e-6)
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.array([1.0, 0.1, 0.01], "float32"))
+        db = power_to_db(x, top_db=None).numpy()
+        np.testing.assert_allclose(db, [0.0, -10.0, -20.0], atol=1e-4)
+        db2 = power_to_db(x, top_db=15.0).numpy()
+        assert db2.min() == pytest.approx(-15.0)
+
+    def test_create_dct_ortho(self):
+        d = create_dct(13, 40).numpy()
+        assert d.shape == (40, 13)
+        # orthonormal columns
+        gram = d.T @ d
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-4)
+
+
+class TestAudioFeatures:
+    def _sig(self, n=4000, sr=16000):
+        t = np.arange(n) / sr
+        return (np.sin(2 * np.pi * 440 * t)
+                + 0.5 * np.sin(2 * np.pi * 880 * t)).astype("float32")
+
+    def test_spectrogram_peak_at_tone(self):
+        sr, n_fft = 16000, 512
+        spec = Spectrogram(n_fft=n_fft)(
+            paddle.to_tensor(self._sig())).numpy()
+        assert spec.shape[0] == n_fft // 2 + 1
+        freq_bin = spec.mean(axis=-1).argmax()
+        assert abs(freq_bin * sr / n_fft - 440) < sr / n_fft * 2
+
+    def test_mel_and_logmel_shapes(self):
+        x = paddle.to_tensor(self._sig())
+        mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=64)(x)
+        assert mel.shape[0] == 64
+        logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=64)(x)
+        assert logmel.shape[0] == 64
+        assert float(logmel.numpy().max()) <= float(
+            power_to_db(mel).numpy().max()) + 1e-4
+
+    def test_mfcc_shape_and_batch(self):
+        x = paddle.to_tensor(np.stack([self._sig(), self._sig()]))
+        out = MFCC(sr=16000, n_mfcc=20, n_fft=512)(x)
+        assert out.shape[0] == 2 and out.shape[1] == 20
+
+
+def _brute_force_viterbi(pots, trans, length, bos_eos):
+    """Enumerate all tag sequences (golden reference)."""
+    T, N = pots.shape
+    n_real = N
+    best_score, best_path = -np.inf, None
+    for path in itertools.product(range(n_real), repeat=length):
+        s = pots[0, path[0]] + (trans[N - 2, path[0]] if bos_eos else 0)
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + pots[t, path[t]]
+        if bos_eos:
+            s += trans[path[length - 1], N - 1]
+        if s > best_score:
+            best_score, best_path = s, path
+    return best_score, list(best_path)
+
+
+class TestViterbi:
+    @pytest.mark.parametrize("bos_eos", [True, False])
+    def test_matches_brute_force(self, bos_eos):
+        rng = np.random.RandomState(0)
+        B, T, N = 3, 5, 4
+        pots = rng.randn(B, T, N).astype("float32")
+        trans = rng.randn(N, N).astype("float32")
+        lengths = np.array([5, 5, 5], "int32")
+        scores, paths = viterbi_decode(
+            paddle.to_tensor(pots), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=bos_eos)
+        for b in range(B):
+            ref_s, ref_p = _brute_force_viterbi(pots[b], trans, T, bos_eos)
+            assert scores.numpy()[b] == pytest.approx(ref_s, rel=1e-4)
+            assert list(paths.numpy()[b]) == ref_p
+
+    def test_variable_lengths(self):
+        rng = np.random.RandomState(1)
+        B, T, N = 2, 6, 3
+        pots = rng.randn(B, T, N).astype("float32")
+        trans = rng.randn(N, N).astype("float32")
+        lengths = np.array([6, 3], "int32")
+        scores, paths = viterbi_decode(
+            paddle.to_tensor(pots), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=False)
+        ref_s, ref_p = _brute_force_viterbi(pots[1], trans, 3, False)
+        assert scores.numpy()[1] == pytest.approx(ref_s, rel=1e-4)
+        assert list(paths.numpy()[1][:3]) == ref_p
+        assert (paths.numpy()[1][3:] == 0).all()
+
+    def test_decoder_layer_and_jit(self):
+        rng = np.random.RandomState(2)
+        pots = paddle.to_tensor(rng.randn(2, 4, 5).astype("float32"))
+        trans = paddle.to_tensor(rng.randn(5, 5).astype("float32"))
+        lengths = paddle.to_tensor(np.array([4, 4], "int32"))
+        dec = ViterbiDecoder(trans)
+        s1, p1 = dec(pots, lengths)
+        jit_dec = paddle.jit.to_static(lambda p, l: dec(p, l))
+        s2, p2 = jit_dec(pots, lengths)
+        np.testing.assert_allclose(s1.numpy(), s2.numpy(), rtol=1e-5)
+        np.testing.assert_array_equal(p1.numpy(), p2.numpy())
