@@ -12,7 +12,9 @@ from .features import (  # noqa: F401
 
 
 def load(path: str, sr=None, mono: bool = True, dtype: str = "float32"):
-    """Audio file load (reference: audio/backends — soundfile backend)."""
+    """Audio file load (reference: audio/backends — soundfile backend).
+    No resampling is performed: the file's native rate is returned (pass it
+    to the feature layers); requesting a different ``sr`` raises."""
     try:
         import soundfile
     except ImportError:
@@ -20,17 +22,36 @@ def load(path: str, sr=None, mono: bool = True, dtype: str = "float32"):
 
         import numpy as np
         with wave.open(path, "rb") as w:
-            frames = w.readframes(w.getnframes())
-            data = np.frombuffer(frames, dtype=np.int16).astype(dtype)
-            data /= 32768.0
+            width = w.getsampwidth()
+            if width == 1:
+                raw = np.frombuffer(w.readframes(w.getnframes()), np.uint8)
+                data = (raw.astype(dtype) - 128.0) / 128.0
+            elif width == 2:
+                raw = np.frombuffer(w.readframes(w.getnframes()), np.int16)
+                data = raw.astype(dtype) / 32768.0
+            elif width == 4:
+                raw = np.frombuffer(w.readframes(w.getnframes()), np.int32)
+                data = raw.astype(dtype) / 2147483648.0
+            else:
+                raise ValueError(
+                    f"unsupported {8 * width}-bit wav; install soundfile")
             if w.getnchannels() > 1:
                 data = data.reshape(-1, w.getnchannels())
                 if mono:
                     data = data.mean(axis=1)
-            return data, w.getframerate()
+            rate = w.getframerate()
+            if sr is not None and sr != rate:
+                raise ValueError(
+                    f"file rate {rate} != requested sr {sr}; resampling is "
+                    "not implemented — use the native rate")
+            return data, rate
     data, rate = soundfile.read(path, dtype=dtype)
     if mono and data.ndim > 1:
         data = data.mean(axis=1)
+    if sr is not None and sr != rate:
+        raise ValueError(
+            f"file rate {rate} != requested sr {sr}; resampling is not "
+            "implemented — use the native rate")
     return data, rate
 
 

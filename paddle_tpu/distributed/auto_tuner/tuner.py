@@ -33,7 +33,10 @@ class AutoTuner:
                 num_layers=tuner_cfg["num_layers"],
                 num_heads=tuner_cfg["num_heads"],
                 vocab_size=tuner_cfg["vocab_size"],
-                seq_len=tuner_cfg.get("seq_len", 2048))
+                seq_len=tuner_cfg.get("seq_len", 2048),
+                intermediate_size=tuner_cfg.get("intermediate_size", 0),
+                # LLaMA-class gated (SwiGLU) FFN is the common case tuned
+                gated_mlp=tuner_cfg.get("gated_mlp", True))
         self.model_spec = model
         hw = tuner_cfg.get("hardware") or HardwareSpec()
         self.cost_model = (CostModel(model, hw) if model is not None

@@ -1,0 +1,99 @@
+"""Kernel autotune: runtime implementation selection + persistent cache.
+
+Capability parity with the reference's kernel autotune
+(reference: paddle/phi/kernels/autotune/ — cache.cc keyed per op+shape,
+auto_tune_base.h timing candidate kernels, switch_autotune.cc).
+
+TPU-native: candidates are whole implementations (Pallas kernel vs XLA
+fusion) rather than cudnn algorithms.  On an *eager* call with concrete
+arrays the candidates are timed once per shape key and the winner is cached
+(in-memory + JSON on disk).  Under tracing (jit) timing is impossible, so a
+cached winner is used when present, else the caller's analytical heuristic.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+_CACHE_PATH = os.environ.get(
+    "PADDLE_TPU_AUTOTUNE_CACHE",
+    os.path.expanduser("~/.cache/paddle_tpu/autotune.json"))
+
+_lock = threading.Lock()
+_cache: Optional[Dict[str, str]] = None
+_enabled = os.environ.get("FLAGS_use_autotune", "1") not in ("0", "false")
+
+
+def _load() -> Dict[str, str]:
+    global _cache
+    if _cache is None:
+        try:
+            with open(_CACHE_PATH) as f:
+                _cache = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            _cache = {}
+    return _cache
+
+
+def _persist() -> None:
+    try:
+        os.makedirs(os.path.dirname(_CACHE_PATH), exist_ok=True)
+        tmp = _CACHE_PATH + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(_cache, f, indent=1, sort_keys=True)
+        os.replace(tmp, _CACHE_PATH)
+    except OSError:
+        pass
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = on
+
+
+def lookup(key: str) -> Optional[str]:
+    with _lock:
+        return _load().get(key)
+
+
+def record(key: str, winner: str) -> None:
+    with _lock:
+        _load()[key] = winner
+        _persist()
+
+
+def _time_one(fn: Callable, repeats: int = 3) -> float:
+    import jax
+    out = fn()                       # compile + warm
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def autotune(key: str, candidates: Dict[str, Callable],
+             default: str) -> str:
+    """Winner for ``key``: cached if known; measured now if enabled and all
+    candidates are runnable; else ``default``."""
+    if not _enabled:
+        return default
+    hit = lookup(key)
+    if hit in candidates:
+        return hit
+    timings = {}
+    for name, fn in candidates.items():
+        try:
+            timings[name] = _time_one(fn)
+        except Exception:
+            continue             # candidate not runnable for this shape
+    if not timings:
+        return default
+    winner = min(timings, key=timings.get)
+    record(key, winner)
+    return winner

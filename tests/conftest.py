@@ -6,6 +6,14 @@ rendezvous, we give XLA 8 host devices and exercise the same SPMD code paths
 (shard_map/pjit/collectives) in-process.
 """
 import os
+import tempfile
+
+# keep the kernel-autotune cache out of the user's home and isolated per
+# test session (a shared path would make dispatch order/history-dependent)
+os.environ.setdefault(
+    "PADDLE_TPU_AUTOTUNE_CACHE",
+    os.path.join(tempfile.gettempdir(),
+                 f"paddle_tpu_test_autotune_{os.getpid()}.json"))
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
