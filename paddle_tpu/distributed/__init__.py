@@ -55,3 +55,5 @@ from .watchdog import (  # noqa: E402,F401
 from . import fault_tolerance  # noqa: E402,F401
 from .fleet import elastic  # noqa: E402,F401
 from . import auto_tuner  # noqa: E402,F401
+from . import rpc  # noqa: E402,F401
+from . import ps  # noqa: E402,F401

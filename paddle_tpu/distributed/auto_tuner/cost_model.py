@@ -43,7 +43,11 @@ class ModelSpec:
 
     def __post_init__(self):
         if not self.intermediate_size:
-            self.intermediate_size = 4 * self.hidden_size
+            # architecture-matched defaults: gated (SwiGLU) FFNs use ~8h/3
+            # so total FFN params stay ~8h^2, like the 4h two-matrix FFN
+            self.intermediate_size = (
+                int(8 * self.hidden_size / 3) if self.gated_mlp
+                else 4 * self.hidden_size)
 
     @property
     def n_params(self) -> float:

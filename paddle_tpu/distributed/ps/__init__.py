@@ -1,0 +1,21 @@
+"""Parameter-server mode: sharded sparse tables + pull/push workers.
+
+Capability parity with the reference's PS stack
+(reference: paddle/fluid/distributed/ps/ — service/brpc_ps_server.cc,
+table/memory_sparse_table.cc; Python mode python/paddle/distributed/ps/
+the_one_ps.py; fleet facade init_server/run_server/init_worker/stop_worker).
+
+TPU-native scope (SURVEY §7: PS is out of the dense-training path — sparse
+embeddings shard over mesh axes instead), this module covers the
+*capability*: billion-row embedding tables that cannot live in HBM are
+sharded across host-memory server processes; TPU workers pull rows for the
+batch, run the dense compute on-chip, and push gradients back.  Transport is
+the RPC layer (paddle_tpu/distributed/rpc.py); rows shard by ``id % n``.
+"""
+from .table import MemorySparseTable  # noqa: F401
+from .server import PSServer, run_server  # noqa: F401
+from .client import PSClient  # noqa: F401
+from .embedding import DistributedEmbedding  # noqa: F401
+
+__all__ = ["MemorySparseTable", "PSServer", "run_server", "PSClient",
+           "DistributedEmbedding"]

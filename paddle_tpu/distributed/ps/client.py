@@ -1,0 +1,86 @@
+"""PS client: shards ids across servers, aggregates pull/push over RPC
+(reference: ps/service client half + python/paddle/distributed/ps/
+the_one_ps.py worker side)."""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from .. import rpc as _rpc
+from . import server as _server
+
+
+class PSClient:
+    """Rows shard by ``id % num_servers``; pulls/pushes fan out as one
+    async RPC per involved server."""
+
+    def __init__(self, server_names: Sequence[str]):
+        self.server_names = list(server_names)
+        self.n = len(self.server_names)
+
+    # -- table mgmt --------------------------------------------------------
+    def create_table(self, name: str, dim: int, **kwargs) -> None:
+        futs = [_rpc.rpc_async(s, _server._h_create_table,
+                               (name, dim, kwargs))
+                for s in self.server_names]
+        for f in futs:
+            f.result()
+
+    def table_size(self, name: str) -> int:
+        return sum(_rpc.rpc_sync(s, _server._h_size, (name,))
+                   for s in self.server_names)
+
+    def save(self, name: str, path_prefix: str) -> None:
+        futs = [_rpc.rpc_async(s, _server._h_save,
+                               (name, f"{path_prefix}.shard{i}"))
+                for i, s in enumerate(self.server_names)]
+        for f in futs:
+            f.result()
+
+    def load(self, name: str, path_prefix: str) -> None:
+        futs = [_rpc.rpc_async(s, _server._h_load,
+                               (name, f"{path_prefix}.shard{i}"))
+                for i, s in enumerate(self.server_names)]
+        for f in futs:
+            f.result()
+
+    # -- data path ---------------------------------------------------------
+    def _shard(self, ids: np.ndarray):
+        ids = np.asarray(ids, np.int64).ravel()
+        owner = ids % self.n
+        parts = []
+        for s in range(self.n):
+            mask = owner == s
+            parts.append((s, np.nonzero(mask)[0], ids[mask]))
+        return ids, parts
+
+    def pull_sparse(self, name: str, ids) -> np.ndarray:
+        flat, parts = self._shard(ids)
+        dim = None
+        out = None
+        futs = [(pos, _rpc.rpc_async(self.server_names[s], _server._h_pull,
+                                     (name, sub_ids)))
+                for s, pos, sub_ids in parts if len(sub_ids)]
+        for pos, fut in futs:
+            rows = fut.result()
+            if out is None:
+                dim = rows.shape[1]
+                out = np.empty((len(flat), dim), np.float32)
+            out[pos] = rows
+        if out is None:
+            raise ValueError("pull_sparse with no ids")
+        return out.reshape(tuple(np.asarray(ids).shape) + (dim,))
+
+    def push_sparse(self, name: str, ids, grads, learning_rate=None) -> None:
+        flat, parts = self._shard(ids)
+        grads = np.asarray(grads, np.float32).reshape(len(flat), -1)
+        futs = [_rpc.rpc_async(self.server_names[s], _server._h_push,
+                               (name, sub_ids, grads[pos], learning_rate))
+                for s, pos, sub_ids in parts if len(sub_ids)]
+        for f in futs:
+            f.result()
+
+    def stop_servers(self) -> None:
+        for s in self.server_names:
+            _rpc.rpc_sync(s, _server._h_stop, ())

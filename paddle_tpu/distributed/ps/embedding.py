@@ -1,0 +1,46 @@
+"""DistributedEmbedding: PS-backed embedding lookup with gradient push.
+
+Capability parity with the reference's distributed lookup table
+(reference: python/paddle/incubate/distributed/fleet — sparse embedding via
+distributed lookup_table ops pulling from the PS; gradients pushed back to
+the sparse table instead of flowing into a dense parameter).
+
+Forward pulls the batch's rows from the sharded table to the host and puts
+them on device as a *leaf* tensor with a gradient hook: when ``backward()``
+reaches it, the hook pushes the row gradients straight to the servers (the
+table optimizer applies them) — the embedding never materializes as a dense
+parameter, which is the entire point of PS mode.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.tensor import Tensor, to_tensor
+from ...nn.layer.layers import Layer
+from .client import PSClient
+
+
+class DistributedEmbedding(Layer):
+    def __init__(self, client: PSClient, table_name: str, embedding_dim: int,
+                 learning_rate: float = None, **table_kwargs):
+        super().__init__()
+        self.client = client
+        self.table_name = table_name
+        self.embedding_dim = embedding_dim
+        self.learning_rate = learning_rate
+        client.create_table(table_name, embedding_dim, **table_kwargs)
+
+    def forward(self, ids) -> Tensor:
+        ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids,
+                            np.int64)
+        rows = to_tensor(self.client.pull_sparse(self.table_name, ids_np))
+        rows.stop_gradient = False
+
+        def _push(grad: Tensor):
+            self.client.push_sparse(
+                self.table_name, ids_np,
+                np.asarray(grad.numpy(), np.float32), self.learning_rate)
+            return grad
+
+        rows.register_hook(_push)
+        return rows

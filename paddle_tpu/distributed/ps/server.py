@@ -1,0 +1,77 @@
+"""PS server process: hosts table shards, serves pull/push over RPC
+(reference: paddle/fluid/distributed/ps/service/brpc_ps_server.cc;
+the_one_ps.py server half)."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .table import MemorySparseTable
+
+# process-global registry the RPC handler functions act on (RPC ships the
+# function by pickle; it must resolve state on the *server* side)
+_SERVER: Optional["PSServer"] = None
+
+
+class PSServer:
+    def __init__(self, server_index: int = 0):
+        self.server_index = server_index
+        self._tables: Dict[str, MemorySparseTable] = {}
+        self._stop = threading.Event()
+
+    def create_table(self, name: str, dim: int, **kwargs) -> None:
+        if name not in self._tables:
+            self._tables[name] = MemorySparseTable(
+                dim, seed=self.server_index * 7919 + 1, **kwargs)
+
+    def table(self, name: str) -> MemorySparseTable:
+        return self._tables[name]
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def wait(self) -> None:
+        self._stop.wait()
+
+
+def run_server(server_index: int = 0) -> PSServer:
+    """Install the process-global server (reference: fleet.run_server)."""
+    global _SERVER
+    _SERVER = PSServer(server_index)
+    return _SERVER
+
+
+# -- RPC-shipped handlers (executed on the server process) -----------------
+def _h_create_table(name, dim, kwargs):
+    _SERVER.create_table(name, dim, **kwargs)
+    return True
+
+
+def _h_pull(name, ids):
+    return _SERVER.table(name).pull(np.asarray(ids))
+
+
+def _h_push(name, ids, grads, lr):
+    _SERVER.table(name).push(np.asarray(ids), np.asarray(grads), lr)
+    return True
+
+
+def _h_size(name):
+    return _SERVER.table(name).size()
+
+
+def _h_save(name, path):
+    _SERVER.table(name).save(path)
+    return True
+
+
+def _h_load(name, path):
+    _SERVER.table(name).load(path)
+    return True
+
+
+def _h_stop():
+    _SERVER.stop()
+    return True

@@ -1,0 +1,88 @@
+"""In-memory sparse table (reference:
+paddle/fluid/distributed/ps/table/memory_sparse_table.cc — id -> embedding
+row with lazy init, optimizer state per row, save/load)."""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class MemorySparseTable:
+    """id -> fp32 row, created on first pull.  Push applies the configured
+    rule: 'sgd' (row -= lr * grad), 'adagrad' (per-row accumulator), or
+    'sum' (raw accumulate, for async aggregation)."""
+
+    def __init__(self, dim: int, initializer: str = "uniform",
+                 init_scale: float = 0.01, optimizer: str = "sgd",
+                 learning_rate: float = 0.05, seed: int = 0):
+        self.dim = dim
+        self.initializer = initializer
+        self.init_scale = init_scale
+        self.optimizer = optimizer
+        self.learning_rate = learning_rate
+        self._rows: Dict[int, np.ndarray] = {}
+        self._accum: Dict[int, np.ndarray] = {}
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+
+    def _init_row(self) -> np.ndarray:
+        if self.initializer == "zeros":
+            return np.zeros(self.dim, np.float32)
+        return self._rng.uniform(-self.init_scale, self.init_scale,
+                                 self.dim).astype(np.float32)
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self._lock:
+            for i, key in enumerate(np.asarray(ids, np.int64)):
+                row = self._rows.get(int(key))
+                if row is None:
+                    row = self._rows[int(key)] = self._init_row()
+                out[i] = row
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray,
+             learning_rate: Optional[float] = None) -> None:
+        lr = self.learning_rate if learning_rate is None else learning_rate
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            for i, key in enumerate(np.asarray(ids, np.int64)):
+                k = int(key)
+                row = self._rows.get(k)
+                if row is None:
+                    row = self._rows[k] = self._init_row()
+                g = grads[i]
+                if self.optimizer == "sum":
+                    row += g
+                elif self.optimizer == "adagrad":
+                    acc = self._accum.get(k)
+                    if acc is None:
+                        acc = self._accum[k] = np.zeros(self.dim, np.float32)
+                    acc += g * g
+                    row -= lr * g / (np.sqrt(acc) + 1e-10)
+                else:                                  # sgd
+                    row -= lr * g
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    # -- persistence (reference: table save/load) --------------------------
+    def save(self, path: str) -> None:
+        with self._lock:
+            payload = {"dim": self.dim, "rows": dict(self._rows),
+                       "accum": dict(self._accum)}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(payload, f, protocol=4)
+
+    def load(self, path: str) -> None:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        with self._lock:
+            self._rows = payload["rows"]
+            self._accum = payload.get("accum", {})

@@ -1,0 +1,241 @@
+"""RPC: named-worker remote function calls.
+
+Capability parity with the reference's RPC subsystem
+(reference: paddle/fluid/distributed/rpc/rpc_agent.cc brpc RpcAgent; Python
+API python/paddle/distributed/rpc/rpc.py — init_rpc, rpc_sync, rpc_async,
+shutdown, get_worker_info, get_all_worker_infos).
+
+TPU-native: training-plane communication is XLA collectives; RPC is the
+*control plane* (PS pull/push, orchestration, metrics).  Transport is a
+length-prefixed pickle protocol over TCP sockets — one server thread pool
+per worker, discovery + shutdown barrier through the native TCPStore.
+Pickled callables run only across a trusted training cluster, as in the
+reference.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import pickle
+import socket
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from .store import TCPStore, barrier as _store_barrier
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _RpcServer:
+    """Per-worker request server: each connection is served on its own
+    thread; requests are (fn, args, kwargs) pickles, replies are
+    ('ok', result) or ('exc', exception)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 max_workers: int = 8):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers)
+        self._stop = False
+        self._accept_thread = threading.Thread(target=self._accept,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            self._pool.submit(self._serve, conn)
+
+    @staticmethod
+    def _read(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _serve(self, conn):
+        try:
+            while True:
+                (ln,) = struct.unpack("<Q", self._read(conn, 8))
+                fn, args, kwargs = pickle.loads(self._read(conn, ln))
+                try:
+                    reply = ("ok", fn(*args, **kwargs))
+                except Exception as e:   # noqa: BLE001 — shipped to caller
+                    reply = ("exc", e)
+                blob = pickle.dumps(reply, protocol=4)
+                conn.sendall(struct.pack("<Q", len(blob)) + blob)
+        except (ConnectionError, OSError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+
+
+class _RpcAgent:
+    def __init__(self, name: str, rank: int, world_size: int,
+                 store: TCPStore):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self.server = _RpcServer()
+        ip = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
+        self.info = WorkerInfo(name, rank, ip, self.server.port)
+        store.set(f"rpc/worker/{rank}",
+                  pickle.dumps(self.info, protocol=4))
+        # everyone present before any call resolves names
+        _store_barrier(store, "rpc_init", world_size)
+        self._workers: Dict[str, WorkerInfo] = {}
+        for r in range(world_size):
+            info = pickle.loads(store.get(f"rpc/worker/{r}"))
+            self._workers[info.name] = info
+        self._conns: Dict[str, socket.socket] = {}
+        self._call_locks: Dict[str, threading.Lock] = {}
+        self._conn_lock = threading.Lock()
+        self._pool = concurrent.futures.ThreadPoolExecutor(16)
+
+    # -- client side -------------------------------------------------------
+    def _connection(self, to: str) -> socket.socket:
+        with self._conn_lock:
+            conn = self._conns.get(to)
+            if conn is None:
+                info = self._workers[to]
+                conn = socket.create_connection((info.ip, info.port),
+                                                timeout=60)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns[to] = conn
+            return conn
+
+    def call(self, to: str, fn, args, kwargs, timeout: float):
+        if to not in self._workers:
+            raise ValueError(f"unknown RPC worker '{to}'")
+        blob = pickle.dumps((fn, args, kwargs or {}), protocol=4)
+        conn = self._connection(to)
+        # one in-flight request per connection: serialize on it
+        with self._conn_lock:
+            lock = self._call_locks.setdefault(to, threading.Lock())
+        with lock:
+            conn.settimeout(timeout if timeout and timeout > 0 else None)
+            conn.sendall(struct.pack("<Q", len(blob)) + blob)
+            (ln,) = struct.unpack("<Q", _RpcServer._read(conn, 8))
+            status, payload = pickle.loads(_RpcServer._read(conn, ln))
+        if status == "exc":
+            raise payload
+        return payload
+
+    def call_async(self, to: str, fn, args, kwargs, timeout: float):
+        return self._pool.submit(self.call, to, fn, args, kwargs, timeout)
+
+    def shutdown(self):
+        import time
+        _store_barrier(self.store, "rpc_shutdown", self.world_size)
+        # drain phase: the store host (rank 0) must outlive every peer's
+        # last store round-trip, or their final replies race its exit
+        if self.rank == 0:
+            deadline = time.monotonic() + 60
+            while (self.store.add("rpc/shutdown_acks", 0)
+                   < self.world_size - 1 and time.monotonic() < deadline):
+                time.sleep(0.01)
+        else:
+            try:
+                self.store.add("rpc/shutdown_acks", 1)
+            except Exception:
+                pass
+        for c in self._conns.values():
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.server.stop()
+        self._pool.shutdown(wait=False)
+        self.store.close()
+
+
+_agent: Optional[_RpcAgent] = None
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """reference: paddle.distributed.rpc.init_rpc — rank 0 hosts the store
+    at ``master_endpoint`` (env PADDLE_MASTER_ENDPOINT fallback)."""
+    global _agent
+    if _agent is not None:
+        raise RuntimeError("RPC already initialized")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0")) \
+        if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) \
+        if world_size is None else world_size
+    endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:8813")
+    host, port = endpoint.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    _agent = _RpcAgent(name, rank, world_size, store)
+
+
+def _require_agent() -> _RpcAgent:
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 180.0):
+    return _require_agent().call(to, fn, tuple(args), kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = 180.0):
+    """Returns a concurrent.futures.Future (``.result()``/``.done()`` —
+    the reference's FutureWrapper exposes ``wait()``; both are provided)."""
+    fut = _require_agent().call_async(to, fn, tuple(args), kwargs, timeout)
+    if not hasattr(fut, "wait"):
+        fut.wait = fut.result   # reference API alias
+    return fut
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _require_agent()._workers[name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    ag = _require_agent()
+    return sorted(ag._workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return _require_agent().info
+
+
+def shutdown() -> None:
+    global _agent
+    if _agent is None:
+        return
+    _agent.shutdown()
+    _agent = None

@@ -24,7 +24,25 @@ _CACHE_PATH = os.environ.get(
 
 _lock = threading.Lock()
 _cache: Optional[Dict[str, str]] = None
-_enabled = os.environ.get("FLAGS_use_autotune", "1") not in ("0", "false")
+_enabled = True
+_device_tag: Optional[str] = None
+
+
+def _get_device_tag() -> str:
+    """Winners are only valid for the device they were measured on."""
+    global _device_tag
+    if _device_tag is None:
+        try:
+            import jax
+            d = jax.devices()[0]
+            _device_tag = f"{d.platform}/{getattr(d, 'device_kind', '?')}"
+        except Exception:
+            _device_tag = "unknown"
+    return _device_tag
+
+
+def _full_key(key: str) -> str:
+    return f"{_get_device_tag()}::{key}"
 
 
 def _load() -> Dict[str, str]:
@@ -51,17 +69,28 @@ def _persist() -> None:
 
 def set_enabled(on: bool) -> None:
     global _enabled
-    _enabled = on
+    _enabled = bool(on)
+
+
+# switch through the framework flag registry (reference:
+# paddle/phi/kernels/autotune/switch_autotune.cc + FLAGS_use_autotune);
+# env FLAGS_use_autotune is ingested by define_flag, set_flags updates live
+from ..framework.flags import define_flag, get_flag  # noqa: E402
+
+define_flag("use_autotune", True,
+            "measure and cache kernel-implementation choices",
+            on_change=set_enabled)
+_enabled = bool(get_flag("use_autotune"))
 
 
 def lookup(key: str) -> Optional[str]:
     with _lock:
-        return _load().get(key)
+        return _load().get(_full_key(key))
 
 
 def record(key: str, winner: str) -> None:
     with _lock:
-        _load()[key] = winner
+        _load()[_full_key(key)] = winner
         _persist()
 
 

@@ -9,11 +9,10 @@ import os
 import tempfile
 
 # keep the kernel-autotune cache out of the user's home and isolated per
-# test session (a shared path would make dispatch order/history-dependent)
-os.environ.setdefault(
-    "PADDLE_TPU_AUTOTUNE_CACHE",
-    os.path.join(tempfile.gettempdir(),
-                 f"paddle_tpu_test_autotune_{os.getpid()}.json"))
+# test session — unconditional, so an exported PADDLE_TPU_AUTOTUNE_CACHE
+# can neither leak test winners out nor make test dispatch history-dependent
+os.environ["PADDLE_TPU_AUTOTUNE_CACHE"] = os.path.join(
+    tempfile.gettempdir(), f"paddle_tpu_test_autotune_{os.getpid()}.json")
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
