@@ -1,0 +1,13 @@
+"""paddle.cost_model parity (reference: python/paddle/cost_model/
+cost_model.py — per-op cost profiling feeding planners).
+
+TPU-native: static per-op profiling is replaced by (a) the analytical
+parallelism cost model (distributed/auto_tuner/cost_model.py) and (b) live
+measurement via tools/op_benchmark.py; this facade exposes both under the
+reference's entry point.
+"""
+from .distributed.auto_tuner.cost_model import (  # noqa: F401
+    CostModel, HardwareSpec, ModelSpec, ParallelConfig,
+)
+
+__all__ = ["CostModel", "HardwareSpec", "ModelSpec", "ParallelConfig"]

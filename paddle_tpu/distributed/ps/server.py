@@ -19,10 +19,18 @@ class PSServer:
     def __init__(self, server_index: int = 0):
         self.server_index = server_index
         self._tables: Dict[str, MemorySparseTable] = {}
+        self._create_lock = threading.Lock()
         self._stop = threading.Event()
 
     def create_table(self, name: str, dim: int, **kwargs) -> None:
-        if name not in self._tables:
+        with self._create_lock:
+            existing = self._tables.get(name)
+            if existing is not None:
+                if existing.dim != dim:
+                    raise ValueError(
+                        f"table '{name}' exists with dim {existing.dim}, "
+                        f"requested {dim}")
+                return
             self._tables[name] = MemorySparseTable(
                 dim, seed=self.server_index * 7919 + 1, **kwargs)
 

@@ -38,18 +38,17 @@ class WorkerInfo:
 
 
 class _RpcServer:
-    """Per-worker request server: each connection is served on its own
-    thread; requests are (fn, args, kwargs) pickles, replies are
-    ('ok', result) or ('exc', exception)."""
+    """Per-worker request server: one dedicated daemon thread per live
+    connection (connections persist for the cluster's lifetime, so a fixed
+    pool would starve the N+1'th peer); requests are (fn, args, kwargs)
+    pickles, replies are ('ok', result) or ('exc', exception)."""
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0,
-                 max_workers: int = 8):
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(128)
         self.port = self._sock.getsockname()[1]
-        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers)
         self._stop = False
         self._accept_thread = threading.Thread(target=self._accept,
                                                daemon=True)
@@ -61,7 +60,8 @@ class _RpcServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            self._pool.submit(self._serve, conn)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
 
     @staticmethod
     def _read(conn, n):
@@ -82,7 +82,13 @@ class _RpcServer:
                     reply = ("ok", fn(*args, **kwargs))
                 except Exception as e:   # noqa: BLE001 — shipped to caller
                     reply = ("exc", e)
-                blob = pickle.dumps(reply, protocol=4)
+                try:
+                    blob = pickle.dumps(reply, protocol=4)
+                except Exception as e:   # unpicklable result/exception
+                    blob = pickle.dumps(
+                        ("exc", RuntimeError(
+                            f"remote reply not picklable: {reply[1]!r} "
+                            f"({e})")), protocol=4)
                 conn.sendall(struct.pack("<Q", len(blob)) + blob)
         except (ConnectionError, OSError, EOFError):
             pass
@@ -95,7 +101,6 @@ class _RpcServer:
             self._sock.close()
         except OSError:
             pass
-        self._pool.shutdown(wait=False)
 
 
 class _RpcAgent:
@@ -122,30 +127,39 @@ class _RpcAgent:
         self._pool = concurrent.futures.ThreadPoolExecutor(16)
 
     # -- client side -------------------------------------------------------
-    def _connection(self, to: str) -> socket.socket:
+    def call(self, to: str, fn, args, kwargs, timeout: float):
+        if to not in self._workers:
+            raise ValueError(f"unknown RPC worker '{to}'")
+        blob = pickle.dumps((fn, args, kwargs or {}), protocol=4)
+        # one in-flight request per destination; the dial also happens under
+        # the per-destination lock so a slow peer never stalls other routes
         with self._conn_lock:
-            conn = self._conns.get(to)
+            lock = self._call_locks.setdefault(to, threading.Lock())
+        with lock:
+            with self._conn_lock:
+                conn = self._conns.get(to)
             if conn is None:
                 info = self._workers[to]
                 conn = socket.create_connection((info.ip, info.port),
                                                 timeout=60)
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conns[to] = conn
-            return conn
-
-    def call(self, to: str, fn, args, kwargs, timeout: float):
-        if to not in self._workers:
-            raise ValueError(f"unknown RPC worker '{to}'")
-        blob = pickle.dumps((fn, args, kwargs or {}), protocol=4)
-        conn = self._connection(to)
-        # one in-flight request per connection: serialize on it
-        with self._conn_lock:
-            lock = self._call_locks.setdefault(to, threading.Lock())
-        with lock:
-            conn.settimeout(timeout if timeout and timeout > 0 else None)
-            conn.sendall(struct.pack("<Q", len(blob)) + blob)
-            (ln,) = struct.unpack("<Q", _RpcServer._read(conn, 8))
-            status, payload = pickle.loads(_RpcServer._read(conn, ln))
+                with self._conn_lock:
+                    self._conns[to] = conn
+            try:
+                conn.settimeout(timeout if timeout and timeout > 0 else None)
+                conn.sendall(struct.pack("<Q", len(blob)) + blob)
+                (ln,) = struct.unpack("<Q", _RpcServer._read(conn, 8))
+                status, payload = pickle.loads(_RpcServer._read(conn, ln))
+            except Exception:
+                # the stream may hold a half frame / orphaned reply — drop
+                # the connection so the next call re-dials cleanly
+                with self._conn_lock:
+                    self._conns.pop(to, None)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                raise
         if status == "exc":
             raise payload
         return payload
