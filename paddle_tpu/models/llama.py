@@ -20,7 +20,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..framework.tensor import Tensor
+from ..framework.tensor import Tensor, wrap_array
 from ..framework.dispatch import call_op, def_op
 from ..nn.layer.layers import Layer, LayerList
 from ..nn.layer.common import Linear, Embedding, Dropout
@@ -211,18 +211,92 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None):
         hidden = self.model(input_ids)
-        if self.lm_head is not None:
-            logits = self.lm_head(hidden)
-        else:
-            logits = call_op(
-                "tied_lm_head", lambda h, w: jnp.matmul(h, w.T),
-                (hidden, self.model.embed_tokens.weight), {})
+        logits = self._logits_of(hidden)
         if labels is not None:
             loss = F.cross_entropy(
                 logits.reshape([-1, self.config.vocab_size]),
                 labels.reshape([-1]), ignore_index=-100)
             return loss, logits
         return logits
+
+    def _logits_of(self, hidden):
+        if self.lm_head is not None:
+            return self.lm_head(hidden)
+        return call_op("tied_lm_head", lambda h, w: jnp.matmul(h, w.T),
+                       (hidden, self.model.embed_tokens.weight), {})
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 1.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, do_sample: bool = False,
+                 eos_token_id: Optional[int] = None, seed: int = 0):
+        """Autoregressive decoding with a KV cache (reference capability:
+        PaddleNLP generate / paddle.incubate block_multihead_attention
+        serving path).  Greedy by default; temperature/top-k/top-p
+        sampling with ``do_sample=True``.  Runs eagerly — each step
+        reuses the cached K/V so cost is O(new_tokens * seq)."""
+        import numpy as np
+        from ..framework.tape import no_grad
+
+        with no_grad():
+            ids = input_ids
+            # prefill: run the prompt once, building the cache
+            head_dim = (self.config.hidden_size
+                        // self.config.num_attention_heads)
+            empty = wrap_array(jnp.zeros(
+                (int(ids.shape[0]), 0, self.config.num_key_value_heads,
+                 head_dim), self.model.embed_tokens.weight._data.dtype))
+            caches = [(empty, empty)
+                      for _ in range(self.config.num_hidden_layers)]
+            hidden, caches = self.model(ids, 0, caches)
+            logits = self._logits_of(hidden[:, -1:])
+            out_tokens = [ids]
+            rng = np.random.default_rng(seed)
+            finished = np.zeros(int(ids.shape[0]), bool)
+            pos = int(ids.shape[1])
+            for _ in range(max_new_tokens):
+                step_logits = np.asarray(
+                    logits._data[:, -1].astype(jnp.float32))
+                if do_sample:
+                    if temperature and temperature != 1.0:
+                        step_logits = step_logits / max(temperature, 1e-6)
+                    if top_k is not None:
+                        kth = np.partition(
+                            step_logits, -top_k, axis=-1)[:, -top_k][:, None]
+                        step_logits = np.where(step_logits < kth,
+                                               -np.inf, step_logits)
+                    if top_p is not None:
+                        sort_idx = np.argsort(-step_logits, axis=-1)
+                        sorted_l = np.take_along_axis(step_logits, sort_idx,
+                                                      axis=-1)
+                        probs = np.exp(sorted_l - sorted_l.max(-1,
+                                                               keepdims=True))
+                        probs /= probs.sum(-1, keepdims=True)
+                        cum = probs.cumsum(-1)
+                        cut = cum - probs > top_p
+                        sorted_l[cut] = -np.inf
+                        restored = np.full_like(step_logits, -np.inf)
+                        np.put_along_axis(restored, sort_idx, sorted_l,
+                                          axis=-1)
+                        step_logits = restored
+                    p = np.exp(step_logits
+                               - step_logits.max(-1, keepdims=True))
+                    p /= p.sum(-1, keepdims=True)
+                    nxt = np.array([rng.choice(p.shape[-1], p=p[b])
+                                    for b in range(p.shape[0])])
+                else:
+                    nxt = step_logits.argmax(-1)
+                if eos_token_id is not None:
+                    nxt = np.where(finished, eos_token_id, nxt)
+                    finished |= nxt == eos_token_id
+                nxt_t = wrap_array(jnp.asarray(nxt[:, None], jnp.int32))
+                out_tokens.append(nxt_t)
+                if eos_token_id is not None and finished.all():
+                    break
+                hidden, caches = self.model(nxt_t, pos, caches)
+                logits = self._logits_of(hidden)
+                pos += 1
+        from .. import tensor as T
+        return T.concat(out_tokens, axis=1)
 
 
 # ----------------------------------------------------------- parallel plan

@@ -2,3 +2,12 @@ from .resnet import (  # noqa: F401
     ResNet, BasicBlock, BottleneckBlock, resnet18, resnet34, resnet50,
     resnet101, resnet152, wide_resnet50_2,
 )
+from .small_nets import (  # noqa: F401
+    LeNet, AlexNet, VGG, SqueezeNet, alexnet, vgg11, vgg13, vgg16, vgg19,
+    squeezenet1_0, squeezenet1_1,
+)
+from .mobilenets import (  # noqa: F401
+    MobileNetV1, MobileNetV2, MobileNetV3Small, MobileNetV3Large,
+    ShuffleNetV2, DenseNet, mobilenet_v1, mobilenet_v2, mobilenet_v3_small,
+    mobilenet_v3_large, shufflenet_v2_x1_0, densenet121,
+)

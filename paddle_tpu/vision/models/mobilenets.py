@@ -1,0 +1,384 @@
+"""MobileNet V1/V2/V3 + ShuffleNetV2 + DenseNet families.
+
+Capability parity: python/paddle/vision/models/{mobilenetv1,mobilenetv2,
+mobilenetv3,shufflenetv2,densenet}.py in the reference (same factory names,
+width multipliers, head structure).
+"""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer, LayerList, Sequential
+from ...nn.layer.conv_pool import (
+    AdaptiveAvgPool2D, AvgPool2D, Conv2D, MaxPool2D,
+)
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.activation import Hardsigmoid, Hardswish, ReLU, ReLU6
+from ...nn.layer.common import Dropout, Flatten, Linear
+from ... import tensor as T
+
+__all__ = ["MobileNetV1", "MobileNetV2", "MobileNetV3Small",
+           "MobileNetV3Large", "ShuffleNetV2", "DenseNet",
+           "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small",
+           "mobilenet_v3_large", "shufflenet_v2_x1_0", "densenet121"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _conv_bn(in_ch, out_ch, k, stride=1, groups=1, act=ReLU):
+    pad = (k - 1) // 2
+    layers = [Conv2D(in_ch, out_ch, k, stride=stride, padding=pad,
+                     groups=groups, bias_attr=False), BatchNorm2D(out_ch)]
+    if act is not None:
+        layers.append(act())
+    return Sequential(*layers)
+
+
+class MobileNetV1(Layer):
+    """reference: mobilenetv1.py — depthwise-separable stacks."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return int(ch * scale)
+
+        cfg = [  # (out, stride) per depthwise-separable block
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1)]
+        layers = [_conv_bn(3, c(32), 3, stride=2)]
+        in_ch = c(32)
+        for out, s in cfg:
+            layers.append(_conv_bn(in_ch, in_ch, 3, stride=s,
+                                   groups=in_ch))          # depthwise
+            layers.append(_conv_bn(in_ch, c(out), 1))      # pointwise
+            in_ch = c(out)
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(T.flatten(x, start_axis=1))
+        return x
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, in_ch, out_ch, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_ch * expand_ratio))
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_conv_bn(in_ch, hidden, 1, act=ReLU6))
+        layers += [
+            _conv_bn(hidden, hidden, 3, stride=stride, groups=hidden,
+                     act=ReLU6),
+            _conv_bn(hidden, out_ch, 1, act=None),
+        ]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    """reference: mobilenetv2.py — inverted residuals."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_ch = _make_divisible(32 * scale)
+        last = _make_divisible(1280 * max(1.0, scale))
+        layers = [_conv_bn(3, in_ch, 3, stride=2, act=ReLU6)]
+        for t, c, n, s in cfg:
+            out_ch = _make_divisible(c * scale)
+            for i in range(n):
+                layers.append(_InvertedResidual(
+                    in_ch, out_ch, s if i == 0 else 1, t))
+                in_ch = out_ch
+        layers.append(_conv_bn(in_ch, last, 1, act=ReLU6))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(T.flatten(x, start_axis=1))
+        return x
+
+
+class _SqueezeExcite(Layer):
+    def __init__(self, ch, reduce=4):
+        super().__init__()
+        mid = _make_divisible(ch // reduce)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(ch, mid, 1)
+        self.fc2 = Conv2D(mid, ch, 1)
+        self.relu = ReLU()
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MBV3Block(Layer):
+    def __init__(self, in_ch, mid, out_ch, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if mid != in_ch:
+            layers.append(_conv_bn(in_ch, mid, 1, act=act))
+        layers.append(_conv_bn(mid, mid, k, stride=stride, groups=mid,
+                               act=act))
+        if se:
+            layers.append(_SqueezeExcite(mid))
+        layers.append(_conv_bn(mid, out_ch, 1, act=None))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_MBV3_SMALL = [  # k, mid, out, se, act, stride
+    (3, 16, 16, True, ReLU, 2), (3, 72, 24, False, ReLU, 2),
+    (3, 88, 24, False, ReLU, 1), (5, 96, 40, True, Hardswish, 2),
+    (5, 240, 40, True, Hardswish, 1), (5, 240, 40, True, Hardswish, 1),
+    (5, 120, 48, True, Hardswish, 1), (5, 144, 48, True, Hardswish, 1),
+    (5, 288, 96, True, Hardswish, 2), (5, 576, 96, True, Hardswish, 1),
+    (5, 576, 96, True, Hardswish, 1)]
+_MBV3_LARGE = [
+    (3, 16, 16, False, ReLU, 1), (3, 64, 24, False, ReLU, 2),
+    (3, 72, 24, False, ReLU, 1), (5, 72, 40, True, ReLU, 2),
+    (5, 120, 40, True, ReLU, 1), (5, 120, 40, True, ReLU, 1),
+    (3, 240, 80, False, Hardswish, 2), (3, 200, 80, False, Hardswish, 1),
+    (3, 184, 80, False, Hardswish, 1), (3, 184, 80, False, Hardswish, 1),
+    (3, 480, 112, True, Hardswish, 1), (3, 672, 112, True, Hardswish, 1),
+    (5, 672, 160, True, Hardswish, 2), (5, 960, 160, True, Hardswish, 1),
+    (5, 960, 160, True, Hardswish, 1)]
+
+
+class _MobileNetV3(Layer):
+    """reference: mobilenetv3.py."""
+
+    def __init__(self, cfg, last_mid, last_ch, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_ch = _make_divisible(16 * scale)
+        layers = [_conv_bn(3, in_ch, 3, stride=2, act=Hardswish)]
+        for k, mid, out, se, act, s in cfg:
+            layers.append(_MBV3Block(
+                in_ch, _make_divisible(mid * scale),
+                _make_divisible(out * scale), k, s, se, act))
+            in_ch = _make_divisible(out * scale)
+        layers.append(_conv_bn(in_ch, _make_divisible(last_mid * scale), 1,
+                               act=Hardswish))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(_make_divisible(last_mid * scale), last_ch),
+                Hardswish(), Dropout(0.2), Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(T.flatten(x, start_axis=1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_SMALL, 576, 1024, scale, num_classes,
+                         with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_LARGE, 960, 1280, scale, num_classes,
+                         with_pool)
+
+
+def _channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = T.reshape(x, [b, groups, c // groups, h, w])
+    x = T.transpose(x, [0, 2, 1, 3, 4])
+    return T.reshape(x, [b, c, h, w])
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.stride = stride
+        branch = out_ch // 2
+        if stride == 1:
+            self.branch2 = Sequential(
+                _conv_bn(in_ch // 2, branch, 1),
+                _conv_bn(branch, branch, 3, stride=1, groups=branch,
+                         act=None),
+                _conv_bn(branch, branch, 1))
+        else:
+            self.branch1 = Sequential(
+                _conv_bn(in_ch, in_ch, 3, stride=stride, groups=in_ch,
+                         act=None),
+                _conv_bn(in_ch, branch, 1))
+            self.branch2 = Sequential(
+                _conv_bn(in_ch, branch, 1),
+                _conv_bn(branch, branch, 3, stride=stride, groups=branch,
+                         act=None),
+                _conv_bn(branch, branch, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = T.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = T.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    """reference: shufflenetv2.py."""
+
+    _WIDTH = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+              1.5: [176, 352, 704, 1024], 2.0: [244, 488, 976, 2048]}
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        widths = self._WIDTH[scale]
+        self.conv1 = _conv_bn(3, 24, 3, stride=2)
+        self.maxpool = MaxPool2D(3, 2, padding=1)
+        in_ch = 24
+        stages = []
+        for i, repeats in enumerate([4, 8, 4]):
+            out_ch = widths[i]
+            units = [_ShuffleUnit(in_ch, out_ch, 2)]
+            for _ in range(repeats - 1):
+                units.append(_ShuffleUnit(out_ch, out_ch, 1))
+            stages.append(Sequential(*units))
+            in_ch = out_ch
+        self.stages = Sequential(*stages)
+        self.conv_last = _conv_bn(in_ch, widths[3], 1)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(widths[3], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(T.flatten(x, start_axis=1))
+        return x
+
+
+class _DenseLayer(Layer):
+    def __init__(self, in_ch, growth, bn_size):
+        super().__init__()
+        self.block = Sequential(
+            BatchNorm2D(in_ch), ReLU(),
+            Conv2D(in_ch, bn_size * growth, 1, bias_attr=False),
+            BatchNorm2D(bn_size * growth), ReLU(),
+            Conv2D(bn_size * growth, growth, 3, padding=1, bias_attr=False))
+
+    def forward(self, x):
+        return T.concat([x, self.block(x)], axis=1)
+
+
+class DenseNet(Layer):
+    """reference: densenet.py (121/169/201/264 via block_config)."""
+
+    _CONFIGS = {121: (6, 12, 24, 16), 169: (6, 12, 32, 32),
+                201: (6, 12, 48, 32), 264: (6, 12, 64, 48)}
+
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        block_config = self._CONFIGS[layers]
+        ch = 2 * growth_rate
+        feats = [Conv2D(3, ch, 7, stride=2, padding=3, bias_attr=False),
+                 BatchNorm2D(ch), ReLU(), MaxPool2D(3, 2, padding=1)]
+        for bi, n in enumerate(block_config):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth_rate, bn_size))
+                ch += growth_rate
+            if bi != len(block_config) - 1:   # transition
+                feats += [BatchNorm2D(ch), ReLU(),
+                          Conv2D(ch, ch // 2, 1, bias_attr=False),
+                          AvgPool2D(2, 2)]
+                ch //= 2
+        feats += [BatchNorm2D(ch), ReLU()]
+        self.features = Sequential(*feats)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(T.flatten(x, start_axis=1))
+        return x
+
+
+# ---------------------------------------------------------------- factories
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(layers=121, **kwargs)

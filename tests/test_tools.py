@@ -11,11 +11,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 class TestOpBenchmark:
     def test_run_and_compare_gate(self, tmp_path):
-        sys.path.insert(0, os.path.join(REPO, "tools"))
+        tools_dir = os.path.join(REPO, "tools")
+        sys.path.insert(0, tools_dir)
         try:
             import op_benchmark
         finally:
-            sys.path.pop(0)
+            sys.path.remove(tools_dir)
         base = str(tmp_path / "base.json")
         payload = op_benchmark.run(base, repeats=2)
         assert set(payload["ops"]) >= {"matmul_1024", "flash_attention_256",
@@ -33,6 +34,12 @@ class TestOpBenchmark:
         assert op_benchmark.compare(base, reg, threshold=0.05) == 1
         # improvement passes
         assert op_benchmark.compare(reg, base, threshold=0.05) == 0
+        # a baseline op missing from the change run fails the gate
+        del data["ops"]["matmul_1024"]
+        part = str(tmp_path / "part.json")
+        with open(part, "w") as f:
+            json.dump(data, f)
+        assert op_benchmark.compare(base, part, threshold=0.05) == 1
 
 
 class TestCostModelFacade:

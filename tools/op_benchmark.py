@@ -83,9 +83,14 @@ def compare(baseline_path: str, change_path: str,
     with open(change_path) as f:
         change = json.load(f)["ops"]
     failed = []
+    missing = []
     for name, base_t in base.items():
         new_t = change.get(name)
         if new_t is None:
+            # a baseline op vanished from the change run — that's a gate
+            # failure, not a free pass
+            print(f"{name:>24}: MISSING from change run")
+            missing.append(name)
             continue
         ratio = (new_t - base_t) / base_t
         flag = "REGRESSION" if ratio > threshold else "ok"
@@ -93,9 +98,13 @@ def compare(baseline_path: str, change_path: str,
               f"({ratio:+.1%}) {flag}")
         if ratio > threshold:
             failed.append(name)
-    if failed:
-        print(f"FAILED: {len(failed)} op(s) regressed > {threshold:.0%}: "
-              f"{failed}")
+    if failed or missing:
+        if failed:
+            print(f"FAILED: {len(failed)} op(s) regressed > {threshold:.0%}: "
+                  f"{failed}")
+        if missing:
+            print(f"FAILED: {len(missing)} op(s) missing from change run: "
+                  f"{missing}")
         return 1
     print("PASSED: no op regressed beyond threshold")
     return 0
