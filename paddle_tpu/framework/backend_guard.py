@@ -1,0 +1,92 @@
+"""Backend-failure isolation for helper processes.
+
+The reference treats accelerator/backend failure as a first-class detected
+condition (reference: paddle/phi/core/distributed/comm_task_manager.cc:142-169
+timeout scans, python/paddle/distributed/fleet/elastic/manager.py:125 relaunch
+on fault).  The TPU-native analog of the most common fault on a single-host
+deployment is a wedged PJRT plugin: ``jax.devices()`` blocks forever retrying
+device init.  Any framework-spawned helper process that does not need the
+accelerator (store server, RPC/PS workers, DataLoader workers, elastic
+relaunch supervisors, dryrun children) must pin the CPU backend *before* its
+first backend touch, or the whole fleet hangs with the chip.
+
+Note (measured on this deployment): setting ``JAX_PLATFORMS=cpu`` in the
+environment does NOT prevent the TPU plugin's init here — only
+``jax.config.update("jax_platforms", "cpu")`` before the first backend touch
+does.  Hence a config-level guard rather than env plumbing.
+"""
+from __future__ import annotations
+
+
+def backend_initialized() -> bool:
+    """True iff a PJRT backend has already been created in this process.
+
+    Never triggers backend initialization itself.
+    """
+    try:
+        from jax._src import xla_bridge
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        # unknown jax layout — report "not initialized" so helpers still
+        # attempt the CPU pin (pin_cpu tolerates a late/no-op pin; skipping
+        # it would hang helpers on a wedged plugin, the exact failure this
+        # module exists to prevent)
+        return False
+
+
+def pin_cpu(num_devices: int | None = None) -> bool:
+    """Force this process onto the virtual CPU backend if (and only if) no
+    backend exists yet.  Returns True when the pin took effect.
+
+    ``num_devices`` provisions that many virtual CPU devices (overrides any
+    ``--xla_force_host_platform_device_count`` in XLA_FLAGS).
+    """
+    if backend_initialized():
+        return False
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        if num_devices:
+            jax.config.update("jax_num_cpu_devices", int(num_devices))
+    except Exception:
+        return False   # raced with a concurrent init — pin had no effect
+    return True
+
+
+def helper_process_init(num_devices: int | None = None) -> None:
+    """Call first thing in every framework-spawned helper process."""
+    pin_cpu(num_devices)
+
+
+def probe_accelerator(timeout: float = 60.0):
+    """Probe which backend default jax init reaches — from a throwaway
+    subprocess so a wedged plugin cannot hang the caller.
+
+    Returns (ok, n_devices, platform): ``ok`` means *some* backend
+    initialized within the timeout; ``platform`` says which one, and the
+    caller decides whether e.g. a CPU fallback is acceptable.  A helper that
+    wants the accelerator but must survive its failure calls this before
+    deciding where to run (watchdog discipline, comm_task_manager.cc:142).
+    """
+    import subprocess
+    import sys
+
+    code = (
+        "import jax, json, sys;"
+        "d = jax.devices();"
+        "print(json.dumps({'n': len(d), 'p': d[0].platform}))"
+    )
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, timeout=timeout, text=True)
+    except subprocess.TimeoutExpired:
+        return False, 0, "unreachable"
+    if res.returncode != 0:
+        return False, 0, "error"
+    import json
+    try:
+        info = json.loads(res.stdout.strip().splitlines()[-1])
+    except Exception:
+        return False, 0, "error"
+    return True, int(info["n"]), str(info["p"])

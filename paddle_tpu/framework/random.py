@@ -25,13 +25,18 @@ class Generator:
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
         self._seed = seed
-        self._key = jax.random.key(seed)
-        self._offset = 0
+        self._key = None   # lazy: creating a key initializes the backend,
+        self._offset = 0   # and Generators are built at import time
+
+    def _key_or_init(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
 
     def manual_seed(self, seed: int) -> "Generator":
         with self._lock:
             self._seed = seed
-            self._key = jax.random.key(seed)
+            self._key = None
             self._offset = 0
         return self
 
@@ -42,7 +47,7 @@ class Generator:
         """Return a fresh subkey; advances internal state."""
         with self._lock:
             self._offset += 1
-            return jax.random.fold_in(self._key, self._offset)
+            return jax.random.fold_in(self._key_or_init(), self._offset)
 
     def get_state(self):
         with self._lock:
@@ -51,7 +56,7 @@ class Generator:
     def set_state(self, state) -> None:
         with self._lock:
             self._seed = int(state["seed"])
-            self._key = jax.random.key(self._seed)
+            self._key = None
             self._offset = int(state["offset"])
 
 

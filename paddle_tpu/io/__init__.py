@@ -332,6 +332,9 @@ class DataLoader:
         self.prefetch_factor = max(prefetch_factor, 1)
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._payload = None
+        self._pool = None
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -373,60 +376,180 @@ class DataLoader:
         if self.num_workers > 0:
             yield from self._produce_mp()
             return
+        yield from self._produce_sp()
+
+    def _produce_sp(self):
         for indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
-    def _produce_mp(self):
-        import multiprocessing as mp
-        ctx = mp.get_context("fork")
-        index_q = ctx.Queue()
-        out_q = ctx.Queue(maxsize=self.num_workers * self.prefetch_factor)
+    def _pickle_payload(self):
+        """Pre-pickle the worker payload once (spawn children unpickle it
+        after pinning CPU).  _PICKLE_FAILED when not spawn-picklable."""
+        import pickle
+        import warnings
 
-        def worker_loop(wid):
-            if self.worker_init_fn is not None:
-                self.worker_init_fn(wid)
-            while True:
-                item = index_q.get()
-                if item is None:
-                    break
-                seq, indices = item
-                try:
-                    batch = self.collate_fn(
-                        [self.dataset[i] for i in indices])
-                    # Tensors don't pickle across processes cheaply; send numpy
-                    batch = _to_numpy_batch(batch)
-                    out_q.put((seq, batch, None))
-                except Exception as e:  # noqa: BLE001
-                    out_q.put((seq, None, e))
-
-        workers = [ctx.Process(target=worker_loop, args=(w,), daemon=True)
-                   for w in range(self.num_workers)]
-        for w in workers:
-            w.start()
-        batches = list(self.batch_sampler)
-        for seq, indices in enumerate(batches):
-            index_q.put((seq, indices))
-        for _ in workers:
-            index_q.put(None)
-        pending = {}
-        next_seq = 0
-        received = 0
+        if self._payload is not None:
+            return self._payload
         try:
-            while received < len(batches):
-                seq, batch, err = out_q.get()
-                received += 1
-                if err is not None:
-                    raise err
-                pending[seq] = batch
-                while next_seq in pending:
-                    yield _from_numpy_batch(pending.pop(next_seq))
-                    next_seq += 1
+            self._payload = pickle.dumps(
+                (self.dataset, self.collate_fn, self.worker_init_fn))
+        except Exception as e:  # noqa: BLE001 — lambdas/closures/local classes
+            warnings.warn(
+                f"num_workers={self.num_workers} needs a picklable dataset/"
+                f"collate_fn/worker_init_fn under the spawn start method "
+                f"({e!r}); falling back to in-process loading", stacklevel=3)
+            self._payload = _PICKLE_FAILED
+        return self._payload
+
+    def _produce_mp(self):
+        # spawn, not fork: forking a multithreaded (jax) parent deadlocks.
+        # The worker payload is pre-pickled in the parent and only unpickled
+        # in the child AFTER it pins the CPU backend, so materializing any
+        # Tensors in the dataset cannot touch (and hang on) a sick TPU plugin.
+        if self._pickle_payload() is _PICKLE_FAILED:
+            yield from self._produce_sp()
+            return
+        # a pool serves one epoch at a time; a second concurrent iterator
+        # (or a pool whose workers died) gets a fresh private pool
+        pool = self._pool
+        private = pool is None or pool.busy or not pool.alive()
+        if private:
+            pool = _WorkerPool(self._payload, self.num_workers,
+                               self.prefetch_factor)
+            if self._pool is None and self.persistent_workers:
+                self._pool, private = pool, False
+        try:
+            yield from pool.run_epoch(list(self.batch_sampler), self.timeout)
         finally:
-            for w in workers:
-                w.terminate()
+            if private or not self.persistent_workers:
+                pool.shutdown()
+                if pool is self._pool:
+                    self._pool = None
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown()
 
     def __iter__(self):
         return _PrefetchIter(self._produce(), self.prefetch_factor)
+
+
+class _WorkerPool:
+    """Spawn-based DataLoader worker pool (reference: io/dataloader/worker.py
+    + reader.py _DataLoaderIterMultiProcess).
+
+    Reusable across epochs when persistent_workers=True — workers are
+    stateless per index-batch, so an epoch is just a numbered stream of
+    (seq, indices) items with exactly-once accounting in the parent.
+    """
+
+    def __init__(self, payload, num_workers, prefetch_factor):
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        self.index_q = ctx.Queue()
+        self.out_q = ctx.Queue(maxsize=num_workers * prefetch_factor)
+        self.busy = False
+        self._gen = 0   # epoch generation: stale items from an abandoned
+        self.workers = [  # epoch are dropped by tag, not mistaken for data
+            ctx.Process(target=_mp_worker_boot,
+                        args=(payload, w, self.index_q, self.out_q),
+                        daemon=True)
+            for w in range(num_workers)
+        ]
+        for w in self.workers:
+            w.start()
+
+    def alive(self):
+        return all(w.is_alive() for w in self.workers)
+
+    def run_epoch(self, batches, timeout=0):
+        self.busy = True
+        try:
+            yield from self._run_epoch(batches, timeout)
+        finally:
+            self.busy = False
+
+    def _run_epoch(self, batches, timeout):
+        self._gen += 1
+        gen = self._gen
+        for seq, indices in enumerate(batches):
+            self.index_q.put((gen, seq, indices))
+        pending = {}
+        next_seq = 0
+        received = 0
+        waited = 0.0
+        while received < len(batches):
+            try:
+                g, seq, batch, err = self.out_q.get(timeout=_POLL_S)
+            except queue.Empty:
+                # liveness check: a worker that died (unpicklable payload
+                # class in the child, worker_init_fn crash, OOM-kill) must
+                # surface as an error, not a parent hang
+                dead = [w.name for w in self.workers if not w.is_alive()]
+                if dead:
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} exited unexpectedly "
+                        f"(check child stderr; spawned workers must be able "
+                        f"to import the dataset/collate_fn module)")
+                waited += _POLL_S
+                if timeout and waited >= timeout:
+                    self.shutdown()
+                    raise TimeoutError(
+                        f"DataLoader batch not produced within {timeout}s")
+                continue
+            waited = 0.0
+            if g != gen:
+                continue   # leftover from an abandoned earlier epoch
+            received += 1
+            if err is not None:
+                self.shutdown()
+                raise err
+            pending[seq] = batch
+            while next_seq in pending:
+                yield _from_numpy_batch(pending.pop(next_seq))
+                next_seq += 1
+
+    def shutdown(self):
+        for w in self.workers:
+            if w.is_alive():
+                w.terminate()
+        for w in self.workers:
+            w.join(timeout=5)
+
+
+_POLL_S = 2.0
+_PICKLE_FAILED = object()   # distinct from the "not yet computed" None
+
+
+def _mp_worker_boot(payload, wid, index_q, out_q):
+    """Spawned DataLoader worker entry (reference: io/dataloader/worker.py).
+
+    Must be a module-level function (spawn pickles the target).  Pins the CPU
+    backend before unpickling the payload — workers never need the
+    accelerator, and a wedged TPU plugin must not hang the fleet
+    (framework/backend_guard.py docstring).
+    """
+    from paddle_tpu.framework.backend_guard import helper_process_init
+    helper_process_init()
+    import pickle
+
+    dataset, collate_fn, worker_init_fn = pickle.loads(payload)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    while True:
+        item = index_q.get()
+        if item is None:
+            break
+        gen, seq, indices = item
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            # Tensors don't pickle across processes cheaply; send numpy
+            out_q.put((gen, seq, _to_numpy_batch(batch), None))
+        except Exception as e:  # noqa: BLE001
+            out_q.put((gen, seq, None, e))
 
 
 def _to_numpy_batch(obj):

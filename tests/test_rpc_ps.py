@@ -31,6 +31,8 @@ def _boom():
 
 def _rpc_worker(rank, world, port, q):
     try:
+        from paddle_tpu.framework.backend_guard import helper_process_init
+        helper_process_init()
         from paddle_tpu.distributed import rpc
         rpc.init_rpc(f"worker{rank}", rank, world,
                      master_endpoint=f"127.0.0.1:{port}")
@@ -77,6 +79,8 @@ class TestRpc:
 # ----------------------------------------------------------------- ps procs
 def _ps_server_proc(rank, world, port, q):
     try:
+        from paddle_tpu.framework.backend_guard import helper_process_init
+        helper_process_init()
         from paddle_tpu.distributed import rpc
         from paddle_tpu.distributed.ps import run_server
         run_server(server_index=rank)
@@ -93,8 +97,8 @@ def _ps_server_proc(rank, world, port, q):
 
 def _ps_trainer_proc(rank, world, port, q, ckpt_dir):
     try:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        from paddle_tpu.framework.backend_guard import helper_process_init
+        helper_process_init()
         import paddle_tpu as paddle
         from paddle_tpu.distributed import rpc
         from paddle_tpu.distributed.ps import PSClient, DistributedEmbedding
