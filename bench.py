@@ -1,35 +1,76 @@
-"""Headline benchmark: LLaMA decoder pretrain step, tokens/sec on one chip.
+"""Benchmark suite over the framework path (BASELINE.md configs 1/2/4/5).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line.  Headline metric stays LLaMA pretrain tokens/sec/chip;
+the other configs ride in the ``suite`` list of the same object:
 
-The reference publishes no absolute numbers (BASELINE.md) — ``vs_baseline``
-compares against an A100-class per-chip figure for a ~110M-param decoder
-(bf16, flash-attn, fused optimizer): ~6.0e4 tokens/sec is a strong reference
-point for this size class; >1.0 means we beat it.
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+     "device": "tpu"|"cpu", "suite": [{...}, ...]}
+
+Every config runs through the framework's own training path —
+``jit.TrainStep`` (whole-step compilation: forward + loss + backward +
+fused optimizer update in one donated-buffer XLA program) with
+``paddle_tpu.optimizer`` and bf16/AMP — not hand-rolled jax.
+
+``vs_baseline`` policy (BASELINE.md: the reference publishes no absolute
+numbers; baselines must be measured, not transcribed): the headline compares
+against OUR round-1 measured figure on this same chip (94,072.4 tok/s,
+BENCH_r01.json) — >1.0 means this round improved on it.  Note r01 was
+measured with a hand-rolled SGD-step bypassing the framework; this suite
+pays for real AdamW + master weights, so parity at ~1.0 already reflects a
+faster core.  Configs measured for the first time carry ``vs_baseline`` 0.0
+(no prior measurement to compare against).
+
+Backend-failure robustness: the accelerator is probed from a throwaway
+subprocess (a wedged TPU plugin hangs ``jax.devices()`` forever on this
+deployment); on failure the suite pins CPU and still emits parseable JSON.
 """
-import functools
 import json
 import time
 
 import numpy as np
 
-A100_CLASS_TOKENS_PER_SEC = 6.0e4  # measured-elsewhere reference point
+R01_LLAMA_TOKENS_PER_SEC = 94072.4   # measured on this chip, BENCH_r01.json
 
 
-def main():
+def _measure(step_fn, sync, units_per_step, steps, warmup=2):
+    """Median-free simple wall measure: warmup (compile) then timed steps."""
+    for _ in range(warmup):
+        sync(step_fn())
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(steps):
+        last = step_fn()
+    sync(last)
+    dt = time.perf_counter() - t0
+    return units_per_step * steps / dt
+
+
+def _sync(loss):
     import jax
+    jax.block_until_ready(loss._data)
+    v = float(np.asarray(loss._data))
+    assert np.isfinite(v), f"non-finite loss {v}"
+    return v
+
+
+def bench_llama(on_tpu):
+    """Config 5 analog (single-chip): LLaMA decoder pretrain step."""
     import jax.numpy as jnp
 
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
 
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
     if on_tpu:
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=768, intermediate_size=2048,
             num_hidden_layers=12, num_attention_heads=12,
             max_position_embeddings=2048, dtype="bfloat16")
         batch, seq, steps = 8, 1024, 20
-    else:  # CPU smoke path so the script always works
+    else:
         cfg = LlamaConfig(
             vocab_size=1024, hidden_size=128, intermediate_size=256,
             num_hidden_layers=2, num_attention_heads=4,
@@ -37,62 +78,215 @@ def main():
         batch, seq, steps = 2, 128, 3
 
     model = LlamaForCausalLM(cfg)
-    params = model.parameters()
-    param_arrays = [p._data for p in params]
-    if on_tpu:
-        param_arrays = [a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a
-                        for a in param_arrays]
+    if on_tpu:   # bf16 params + f32 master weights in the fused optimizer
+        for p in model.parameters():
+            if p._data.dtype == jnp.float32:
+                p._data = p._data.astype(jnp.bfloat16)
 
-    from paddle_tpu.framework.tape import no_grad
-    from paddle_tpu.framework.tensor import wrap_array
+    opt = optim.AdamW(learning_rate=1e-3, parameters=model.parameters(),
+                      multi_precision=on_tpu)
 
-    def loss_fn(arrs, ids, labels):
-        saved = [p._data for p in params]
-        try:
-            for p, a in zip(params, arrs):
-                p._data = a
-            with no_grad():
-                logits = model(wrap_array(ids))._data
-        finally:
-            for p, s in zip(params, saved):
-                p._data = s
-        # lse-form CE: logsumexp - target logit. Avoids log_softmax's full
-        # [b,s,V] f32 output on the forward (measured win on v5e).
-        logits = logits.astype(jnp.float32)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(
-            logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
-        return (lse - tgt).mean()
+    def loss_fn(logits, labels):
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]).astype("float32"),
+            labels.reshape([-1]))
 
-    # donate params: the updated weights reuse the old buffers in-place
-    @functools.partial(jax.jit, donate_argnums=0)
-    def train_step(arrs, ids, labels):
-        loss, grads = jax.value_and_grad(loss_fn)(arrs, ids, labels)
-        new = [p - (1e-3 * g).astype(p.dtype) for p, g in zip(arrs, grads)]
-        return loss, new
-
+    step = TrainStep(model, loss_fn, opt)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype("int32")
-    x, y = jnp.asarray(ids[:, :-1]), jnp.asarray(ids[:, 1:])
+    x = paddle.to_tensor(ids[:, :-1])
+    y = paddle.to_tensor(ids[:, 1:])
 
-    # warmup/compile
-    loss, param_arrays = train_step(param_arrays, x, y)
-    jax.block_until_ready(loss)
+    tok_s = _measure(lambda: step(x, y), _sync, batch * seq, steps)
+    return {
+        "metric": "llama_110m_pretrain_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1), "unit": "tokens/sec",
+        "vs_baseline": round(tok_s / R01_LLAMA_TOKENS_PER_SEC, 3)
+        if on_tpu else 0.0,
+        "path": "jit.TrainStep + optimizer.AdamW(multi_precision) + bf16",
+    }
 
+
+def bench_resnet_cifar(on_tpu):
+    """BASELINE config 1: ResNet-50 on CIFAR-10-shaped data, images/sec."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision.models import resnet50, resnet18
+
+    if on_tpu:
+        model, batch, steps = resnet50(num_classes=10), 256, 20
+    else:
+        model, batch, steps = resnet18(num_classes=10), 8, 2
+    size = 32   # CIFAR resolution on both paths
+
+    opt = optim.Momentum(learning_rate=0.1, momentum=0.9,
+                         parameters=model.parameters(), weight_decay=5e-4)
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(logits, labels):
+        return ce(logits, labels)
+
+    step = TrainStep(model, loss_fn, opt,
+                     amp_level="O1" if on_tpu else "O0")
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal(
+        (batch, 3, size, size)).astype("float32"))
+    y = paddle.to_tensor(rng.integers(0, 10, (batch,)).astype("int64"))
+
+    img_s = _measure(lambda: step(x, y), _sync, batch, steps)
+    return {
+        "metric": "resnet50_cifar10_images_per_sec" if on_tpu
+        else "resnet18_cifar10_images_per_sec",
+        "value": round(img_s, 1), "unit": "images/sec", "vs_baseline": 0.0,
+        "path": "jit.TrainStep + optimizer.Momentum + amp O1",
+    }
+
+
+def bench_bert_sst2(on_tpu):
+    """BASELINE config 2: BERT-base SST-2-shaped fine-tune, tokens/sec."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as optim
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.bert import BertConfig, BertForSequenceClassification
+
+    if on_tpu:
+        cfg = BertConfig()                       # bert-base
+        batch, seq, steps = 32, 128, 20
+    else:
+        cfg = BertConfig(hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=2, intermediate_size=128,
+                         vocab_size=512)
+        batch, seq, steps = 4, 32, 2
+
+    model = BertForSequenceClassification(cfg)
+    opt = optim.AdamW(learning_rate=2e-5, parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits, labels)
+
+    step = TrainStep(model, loss_fn, opt,
+                     amp_level="O1" if on_tpu else "O0")
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    y = paddle.to_tensor(rng.integers(0, 2, (batch,)).astype("int64"))
+
+    tok_s = _measure(lambda: step(x, y), _sync, batch * seq, steps)
+    return {
+        "metric": "bert_base_sst2_finetune_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1), "unit": "tokens/sec", "vs_baseline": 0.0,
+        "path": "jit.TrainStep + optimizer.AdamW + amp O1",
+    }
+
+
+def bench_dp_scaling():
+    """BASELINE config 4 (shape only): DP ResNet weak-scaling efficiency on
+    an 8-device virtual CPU mesh, measured in a CPU-pinned subprocess so it
+    neither touches the real chip nor pollutes this process's backend."""
+    import subprocess
+    import sys
+
+    code = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import json, time
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.vision.models import resnet18
+import paddle_tpu.distributed as dist
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+def run(ndev, per_dev_batch=4, steps=3):
+    mesh = dist.ProcessMesh(np.arange(ndev), dim_names=["dp"])
+    model = resnet18(num_classes=10)
+    opt = optim.Momentum(learning_rate=0.1, momentum=0.9,
+                         parameters=model.parameters())
+    ce = nn.CrossEntropyLoss()
+    step = TrainStep(model, lambda lg, lb: ce(lg, lb), opt)
+    rng = np.random.default_rng(0)
+    b = per_dev_batch * ndev
+    xs = rng.standard_normal((b, 3, 32, 32)).astype("float32")
+    ys = rng.integers(0, 10, (b,)).astype("int64")
+    sh = NamedSharding(mesh.jax_mesh, P("dp"))
+    x = paddle.to_tensor(jax.device_put(xs, sh))
+    y = paddle.to_tensor(jax.device_put(ys, sh))
+    for _ in range(2):
+        loss = step(x, y); jax.block_until_ready(loss._data)
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss, param_arrays = train_step(param_arrays, x, y)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+        loss = step(x, y)
+    jax.block_until_ready(loss._data)
+    return b * steps / (time.perf_counter() - t0)
 
-    toks_per_sec = batch * seq * steps / dt
-    vs = toks_per_sec / A100_CLASS_TOKENS_PER_SEC if on_tpu else 0.0
-    print(json.dumps({
-        "metric": "llama_110m_pretrain_tokens_per_sec_per_chip",
-        "value": round(toks_per_sec, 1),
-        "unit": "tokens/sec",
-        "vs_baseline": round(vs, 3),
-    }))
+r1 = run(1)
+r8 = run(8)
+print(json.dumps({"img_s_1": r1, "img_s_8": r8, "eff": r8 / (8 * r1)}))
+"""
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=900)
+        info = json.loads(res.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        return {"metric": "dp_resnet18_weak_scaling_efficiency_8dev",
+                "value": 0.0, "unit": "ratio", "vs_baseline": 0.0,
+                "error": repr(e)}
+    return {
+        "metric": "dp_resnet18_weak_scaling_efficiency_8dev",
+        "value": round(info["eff"], 3), "unit": "ratio", "vs_baseline": 0.0,
+        "images_per_sec_1dev": round(info["img_s_1"], 1),
+        "images_per_sec_8dev": round(info["img_s_8"], 1),
+        "path": "GSPMD dp mesh, virtual CPU devices (one real chip on host)",
+        "note": "8 virtual devices share one host's cores, so weak-scaling "
+                "efficiency ~1/8 is the expected ceiling here; this config "
+                "validates DP sharding mechanics until a multi-chip slice "
+                "is available",
+    }
+
+
+def main():
+    from paddle_tpu.framework.backend_guard import (
+        backend_initialized, pin_cpu, probe_accelerator,
+    )
+
+    if backend_initialized():
+        import jax
+        on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    else:
+        ok, _n, platform = probe_accelerator(timeout=120)
+        on_tpu = ok and platform == "tpu"
+        if not on_tpu:
+            pin_cpu()   # wedged/missing accelerator: stay alive on CPU
+
+    suite = []
+    errors = []
+    for fn in (bench_resnet_cifar, bench_bert_sst2):
+        try:
+            suite.append(fn(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"{fn.__name__}: {e!r}")
+    try:
+        suite.append(bench_dp_scaling())
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"bench_dp_scaling: {e!r}")
+
+    try:
+        head = bench_llama(on_tpu)   # headline last: largest, warm caches
+    except Exception as e:  # noqa: BLE001 — the JSON contract survives
+        errors.append(f"bench_llama: {e!r}")
+        head = {"metric": "llama_110m_pretrain_tokens_per_sec_per_chip",
+                "value": 0.0, "unit": "tokens/sec", "vs_baseline": 0.0}
+    head["device"] = "tpu" if on_tpu else "cpu"
+    head["suite"] = suite
+    if errors:
+        head["errors"] = errors
+    print(json.dumps(head))
 
 
 if __name__ == "__main__":

@@ -350,3 +350,6 @@ def load(path, **configs):
     with open(path + ".meta", "rb") as f:
         meta = pickle.load(f)
     return TranslatedLayer(exported, params, meta["param_names"])
+
+
+from .train_step import TrainStep  # noqa: E402  (whole-step compilation)

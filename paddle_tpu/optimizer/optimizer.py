@@ -117,12 +117,20 @@ class Optimizer:
             return grad + self.regularization.coeff * jnp.sign(param)
         return grad
 
-    def _build_jit(self):
+    def _functional_update_fn(self, params=None):
+        """Pure update: (lr, step, arrays, grads, states, masters) →
+        (new_arrays, new_states, new_masters).
+
+        Shared by the eager ``step()`` jit and by whole-step compilation
+        (jit.TrainStep — the fused-kernel analog of the reference's
+        fused adam/momentum ops).  ``params`` (static Parameter list) lets
+        subclasses specialize per-param behavior, e.g. AdamW's decay mask.
+        """
         slots = self._state_slots
 
-        def update_all(lr, step, params, grads, states, masters):
+        def update_all(lr, step, params_, grads, states, masters):
             new_params, new_states, new_masters = [], [], []
-            for i, (p, g) in enumerate(zip(params, grads)):
+            for i, (p, g) in enumerate(zip(params_, grads)):
                 st = {s: states[s][i] for s in slots}
                 master = masters[i]
                 work = master if master is not None else p
@@ -139,7 +147,11 @@ class Optimizer:
             out_states = {s: [ns[s] for ns in new_states] for s in slots}
             return new_params, out_states, new_masters
 
-        self._jit_update = jax.jit(update_all, donate_argnums=(2, 4, 5))
+        return update_all
+
+    def _build_jit(self):
+        self._jit_update = jax.jit(self._functional_update_fn(),
+                                   donate_argnums=(2, 4, 5))
 
     @no_grad()
     def step(self):
@@ -319,10 +331,20 @@ class AdamW(Adam):
         self._param_index = {id(p): i for i, p in enumerate(params)}
         super().step()
 
-    def _build_jit(self):
+    def _functional_update_fn(self, params=None):
+        if params is None:
+            raise ValueError(
+                "AdamW whole-step compilation needs the static param list "
+                "to resolve apply_decay_param_fun")
+        mask = tuple(self._apply_decay_param_fun is None
+                     or self._apply_decay_param_fun(p.name) for p in params)
+        masked = self._masked_update_all()
+        return lambda lr, step, arrs, grads, states, masters: \
+            masked(lr, step, arrs, grads, states, masters, mask)
+
+    def _masked_update_all(self):
         base_rule = super()._update_rule
         coeff = self._coeff
-        decay_mask = None
 
         def update_all(lr, step, params, grads, states, masters, mask):
             new_params, new_states, new_masters = [], [], []
@@ -345,7 +367,10 @@ class AdamW(Adam):
                           for s in self._state_slots}
             return new_params, out_states, new_masters
 
-        jitted = jax.jit(update_all, donate_argnums=(2, 4, 5),
+        return update_all
+
+    def _build_jit(self):
+        jitted = jax.jit(self._masked_update_all(), donate_argnums=(2, 4, 5),
                          static_argnums=(6,))
         self._jit_update = lambda lr, step, params, grads, states, masters: \
             jitted(lr, step, params, grads, states, masters,
