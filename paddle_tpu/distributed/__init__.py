@@ -15,6 +15,7 @@ from .collective import (  # noqa: F401
     new_group, get_group, destroy_process_group, get_backend, ReduceOp,
     Group, broadcast_object_list, scatter_object_list,
 )
+from .p2p import P2POp, batch_isend_irecv  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from .auto_parallel.process_mesh import (  # noqa: F401
     ProcessMesh, get_mesh, set_mesh, auto_mesh,

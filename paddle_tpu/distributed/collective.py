@@ -21,7 +21,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..framework.tensor import Tensor, wrap_array
@@ -114,7 +114,7 @@ def _shard_map_collective(tensor: Tensor, group: Group, body, out_spec_fn=None,
         mesh, tensor.ndim)
     out_spec = out_spec_fn(in_spec) if out_spec_fn else in_spec
     fn = shard_map(body, mesh=mesh.jax_mesh, in_specs=in_spec,
-                   out_specs=out_spec, check_rep=False)
+                   out_specs=out_spec, check_vma=False)
     return call_op(name, fn, (tensor,), {})
 
 
@@ -185,14 +185,53 @@ def all_gather(tensor_list: Optional[List[Tensor]], tensor: Tensor,
     return gathered
 
 
+def _host_world():
+    """Cross-process world size from the launcher env contract — does NOT
+    touch the jax backend (spawned helpers may have a wedged plugin)."""
+    import os
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def _host_rank():
+    import os
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+_obj_gen = {"bcast": 0, "scatter": 0, "gather": 0, "a2a": 0}
+
+
+def _obj_key(kind):
+    """Deterministic per-call key: every rank calls the object collective the
+    same number of times (the same SPMD assumption the reference makes)."""
+    _obj_gen[kind] += 1
+    return f"objcoll/{kind}/{_obj_gen[kind]}"
+
+
+def _release_when_all_read(key, readers):
+    """Empty a consumed store payload once every reader has seen it, so
+    long-running jobs don't grow rank 0's store without bound."""
+    from . import p2p
+    st = p2p._state
+    with st.io_lock:
+        if st.get_store().add(key + "/read", 1) >= readers:
+            st.get_store().set(key, b"")
+
+
 def all_gather_object(object_list, obj, group=None):
-    if get_world_size() <= 1:
+    """reference: communication/all_gather.py all_gather_object — host
+    objects gathered rank-major over the TCPStore substrate."""
+    import pickle
+    world = _host_world()
+    if world <= 1:
         object_list.append(obj)
         return
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(np.asarray([0]))
-    object_list.append(obj)  # host-object gather across processes
-    return
+    from . import p2p
+    key = _obj_key("gather")
+    rank = _host_rank()
+    p2p.store_set(f"{key}/{rank}", pickle.dumps(obj))
+    for r in range(world):
+        object_list.append(pickle.loads(p2p.store_get(f"{key}/{r}")))
+        _release_when_all_read(f"{key}/{r}", world)
 
 
 def reduce_scatter(output: Tensor, input: Tensor, op=ReduceOp.SUM,
@@ -276,9 +315,33 @@ def all_to_all(out_tensor_list, in_tensor_list,
         new_dim = 1 if (isinstance(cur, Shard) and cur.dim == 0) else 0
         placements[axis_idx] = Shard(new_dim)
         return reshard(x, mesh, placements)
-    from ..tensor.manipulation import concat, split as t_split
-    full = concat(in_tensor_list, axis=0)
-    parts = t_split(full, len(in_tensor_list), axis=0)
+    world = _host_world()
+    if world > 1:
+        # real rank-to-rank exchange over the p2p substrate: rank i sends
+        # in_tensor_list[j] to rank j and receives slot i from every rank
+        from . import p2p
+        rank = _host_rank()
+        if len(in_tensor_list) != world:
+            raise ValueError(
+                f"all_to_all needs one input tensor per rank "
+                f"({len(in_tensor_list)} != world {world})")
+        tag = _obj_key("a2a")
+        for j in range(world):
+            if j != rank:
+                p2p.send(in_tensor_list[j], dst=j, tag=tag)
+        parts = []
+        for i in range(world):
+            if i == rank:
+                parts.append(in_tensor_list[rank])
+            else:
+                t = in_tensor_list[i].clone() if hasattr(
+                    in_tensor_list[i], "clone") else in_tensor_list[i]
+                parts.append(p2p.recv(t, src=i, tag=tag))
+        if out_tensor_list is not None:
+            out_tensor_list.extend(parts)
+        return parts
+    # world 1: identity exchange (each rank keeps its own slot)
+    parts = list(in_tensor_list)
     if out_tensor_list is not None:
         out_tensor_list.extend(parts)
     return parts
@@ -289,18 +352,27 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "eager point-to-point send/recv is a pipeline-parallel primitive; on "
-        "TPU use the compiled pipeline schedule (distributed/fleet/"
-        "pipeline_parallel.py) whose ppermute IS the p2p exchange")
+    """Eager p2p send (reference: communication/send.py).  Intra-process
+    chips exchange via compiled ppermute (fleet/pipeline_parallel.py); eager
+    send targets another *process* over the store substrate (p2p.py)."""
+    from . import p2p
+    return p2p.send(tensor, dst=dst, group=group, sync_op=sync_op)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    send(tensor, src, group, sync_op)
+    """Eager p2p receive, in-place (reference: communication/recv.py)."""
+    from . import p2p
+    return p2p.recv(tensor, src=src, group=group, sync_op=sync_op)
 
 
-isend = send
-irecv = recv
+def isend(tensor, dst=0, group=None):
+    from . import p2p
+    return p2p.isend(tensor, dst=dst, group=group)
+
+
+def irecv(tensor, src=0, group=None):
+    from . import p2p
+    return p2p.irecv(tensor, src=src, group=group)
 
 
 def barrier(group=None):
@@ -322,9 +394,40 @@ def get_backend(group=None) -> str:
 
 # ------------------------------------------------- host-object collectives
 def broadcast_object_list(object_list, src=0, group=None):
+    """reference: communication/broadcast.py broadcast_object_list — replaces
+    ``object_list`` contents in-place with ``src``'s list on every rank."""
+    import pickle
+    world = _host_world()
+    if world <= 1:
+        return object_list
+    from . import p2p
+    key = _obj_key("bcast")
+    if _host_rank() == src:
+        p2p.store_set(key, pickle.dumps(list(object_list)))
+        return object_list
+    object_list[:] = pickle.loads(p2p.store_get(key))
+    _release_when_all_read(key, world - 1)   # src doesn't read
     return object_list
 
 
 def scatter_object_list(out_list, in_list, src=0, group=None):
-    out_list.extend(in_list[get_rank():get_rank() + 1] or in_list[:1])
+    """reference: communication/scatter.py scatter_object_list — rank r gets
+    in_list[r] from ``src``."""
+    import pickle
+    world = _host_world()
+    if world <= 1:
+        out_list.extend(in_list[:1] if in_list else [])
+        return out_list
+    from . import p2p
+    key = _obj_key("scatter")
+    rank = _host_rank()
+    if rank == src:
+        if len(in_list) != world:
+            raise ValueError(
+                f"scatter_object_list needs one object per rank "
+                f"({len(in_list)} != world {world})")
+        for r in range(world):
+            p2p.store_set(f"{key}/{r}", pickle.dumps(in_list[r]))
+    out_list.append(pickle.loads(p2p.store_get(f"{key}/{rank}")))
+    _release_when_all_read(f"{key}/{rank}", 1)   # each slot has one reader
     return out_list

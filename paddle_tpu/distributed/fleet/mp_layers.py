@@ -20,7 +20,7 @@ from typing import Optional
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ...framework.tensor import Tensor
@@ -162,7 +162,7 @@ class VocabParallelEmbedding(Layer):
         fn = shard_map(lookup, mesh=mesh.jax_mesh,
                        in_specs=(in_spec, P(axis, None)),
                        out_specs=P(*([None] * (x.ndim + 1))),
-                       check_rep=False)
+                       check_vma=False)
         out = call_op("vocab_parallel_embedding", fn, (x, self.weight), {})
         return out
 
@@ -204,5 +204,5 @@ class ParallelCrossEntropy(Layer):
         in_specs = (P(*([None] * (input.ndim - 1) + [axis])),
                     P(*([None] * label.ndim)))
         fn = shard_map(ce, mesh=mesh.jax_mesh, in_specs=in_specs,
-                       out_specs=P(*([None] * input.ndim)), check_rep=False)
+                       out_specs=P(*([None] * input.ndim)), check_vma=False)
         return call_op("parallel_cross_entropy", fn, (input, label), {})

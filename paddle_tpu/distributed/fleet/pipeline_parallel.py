@@ -24,7 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ...framework.tensor import Tensor, wrap_array
@@ -244,7 +244,7 @@ class PipelineStack(Layer):
         # inside shard_map, and the schedule should compile to one XLA
         # program anyway
         fn = jax.jit(shard_map(run, mesh=mesh.jax_mesh, in_specs=in_specs,
-                               out_specs=out_specs, check_rep=False))
+                               out_specs=out_specs, check_vma=False))
         out = call_op("pipeline_stack", fn, (tuple(param_tensors), x), {})
         return out
 

@@ -1,0 +1,237 @@
+"""Eager point-to-point communication + batched p2p.
+
+Capability parity: python/paddle/distributed/communication/send.py / recv.py /
+batch_isend_irecv.py (P2POp, batch_isend_irecv) and the PP usage in
+fleet/meta_parallel/pp_utils/p2p_communication.py:52,573,651.
+
+TPU-native split (SURVEY §5): *inside* a process, chips are SPMD lanes —
+compiled ``ppermute`` IS the p2p exchange (fleet/pipeline_parallel.py uses
+it).  *Eager* send/recv is therefore a host-level, cross-process primitive
+here: payloads ride the TCPStore rendezvous substrate (the role the
+reference's gloo/NCCL p2p plays for control-plane and PP boundary tensors),
+with per-(src,dst,tag) sequence numbers for ordering and exactly-once
+delivery.  Helper processes never touch the accelerator backend —
+numpy in, numpy out (framework/backend_guard.py discipline).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from collections import defaultdict
+from typing import List, Optional
+
+import numpy as np
+
+from .store import TCPStore, create_or_get_global_tcp_store
+
+_RECV_POLL_S = 0.02
+
+
+def _env_rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def _env_world() -> int:
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+class _P2PState:
+    """Per-process sequence counters; lazily bound to the global store."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # the store client is ONE socket; concurrent isend/irecv threads
+        # must serialize wire operations.  Blocking waits poll with short
+        # lock-held check/get calls so a parked recv can't starve a send
+        # (which would deadlock a symmetric exchange).
+        self.io_lock = threading.Lock()
+        self.send_seq = defaultdict(int)   # (dst, tag) -> next seq
+        self.recv_seq = defaultdict(int)   # (src, tag) -> next seq
+        self.store: Optional[TCPStore] = None
+
+    def get_store(self) -> TCPStore:
+        if self.store is None:
+            with self.lock:
+                if self.store is None:
+                    self.store = create_or_get_global_tcp_store()
+        return self.store
+
+
+_state = _P2PState()
+
+
+def _reset_state():   # tests / re-init
+    global _state
+    _state = _P2PState()
+
+
+def store_set(key: str, value: bytes) -> None:
+    """Thread-safe store write sharing the p2p wire lock (for host-object
+    collectives that may overlap in-flight isend/irecv tasks)."""
+    st = _state
+    store = st.get_store()
+    with st.io_lock:
+        store.set(key, value)
+
+
+def store_get(key: str, timeout: Optional[float] = None) -> bytes:
+    """Thread-safe blocking store read: polls with short lock-held probes so
+    concurrent p2p traffic keeps flowing."""
+    st = _state
+    store = st.get_store()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        with st.io_lock:
+            if store.check(key):
+                return store.get(key, timeout=5)
+        if deadline is not None and time.monotonic() >= deadline:
+            raise TimeoutError(f"store_get({key!r}) timed out")
+        time.sleep(_RECV_POLL_S)
+
+
+def _as_numpy(tensor) -> np.ndarray:
+    if hasattr(tensor, "numpy"):
+        return np.asarray(tensor.numpy())
+    return np.asarray(tensor)
+
+
+def _key(src: int, dst: int, seq: int, tag: str) -> str:
+    return f"p2p/{tag}/{src}->{dst}/{seq}"
+
+
+def _reserve(counter, key) -> int:
+    """Claim the next sequence number NOW (synchronously): async ops must
+    reserve ordering at issue time, not at thread-schedule time, or two
+    isends to one peer could swap payloads."""
+    with _state.lock:
+        v = counter[key]
+        counter[key] += 1
+        return v
+
+
+def send(tensor, dst: int = 0, group=None, sync_op: bool = True,
+         tag: str = "", _seq: Optional[int] = None):
+    """reference: paddle.distributed.send — post the tensor to ``dst``.
+
+    Store-brokered: completes locally once the payload is accepted by the
+    store (buffered-send semantics, like NCCL's eager protocol for small
+    messages)."""
+    st = _state
+    store = st.get_store()
+    seq = _reserve(st.send_seq, (dst, tag)) if _seq is None else _seq
+    arr = np.ascontiguousarray(_as_numpy(tensor))
+    payload = pickle.dumps((arr.dtype.str, arr.shape, arr.tobytes()),
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    with st.io_lock:
+        store.set(_key(_env_rank(), dst, seq, tag), payload)
+    return None
+
+
+def recv(tensor, src: int = 0, group=None, sync_op: bool = True,
+         tag: str = "", timeout: Optional[float] = None,
+         _seq: Optional[int] = None):
+    """reference: paddle.distributed.recv — blocking receive from ``src``
+    into ``tensor`` (in-place, paddle semantics).  Returns the tensor."""
+    st = _state
+    store = st.get_store()
+    seq = _reserve(st.recv_seq, (src, tag)) if _seq is None else _seq
+    key = _key(src, _env_rank(), seq, tag)
+    deadline = None if timeout is None else time.monotonic() + timeout
+    payload = None
+    while True:
+        with st.io_lock:
+            if store.check(key):
+                payload = store.get(key, timeout=5)
+                store.set(key, b"")   # consumed: shrink the store entry
+                break
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+        time.sleep(_RECV_POLL_S)
+    if payload in (None, b""):
+        raise TimeoutError(f"recv from rank {src} (tag={tag!r}, seq={seq}) "
+                           f"timed out")
+    dtype_str, shape, buf = pickle.loads(payload)
+    arr = np.frombuffer(buf, dtype=np.dtype(dtype_str)).reshape(shape)
+    if hasattr(tensor, "_data"):
+        import jax.numpy as jnp
+        tensor._data = jnp.asarray(arr)
+        return tensor
+    np.copyto(np.asarray(tensor), arr)
+    return tensor
+
+
+class _P2PTask:
+    """Async handle for isend/irecv (reference: the returned task of
+    communication ops with sync_op=False)."""
+
+    def __init__(self, fn):
+        self._exc = None
+        self._result = None
+
+        def run():
+            try:
+                self._result = fn()
+            except BaseException as e:  # noqa: BLE001
+                self._exc = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def wait(self, timeout: Optional[float] = None):
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("p2p task did not complete in time")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def is_completed(self) -> bool:
+        return not self._thread.is_alive()
+
+
+def isend(tensor, dst: int = 0, group=None, tag: str = "") -> _P2PTask:
+    seq = _reserve(_state.send_seq, (dst, tag))
+    return _P2PTask(lambda: send(tensor, dst, group, tag=tag, _seq=seq))
+
+
+def irecv(tensor, src: int = 0, group=None, tag: str = "",
+          timeout: Optional[float] = None) -> _P2PTask:
+    seq = _reserve(_state.recv_seq, (src, tag))
+    return _P2PTask(lambda: recv(tensor, src, group, tag=tag,
+                                 timeout=timeout, _seq=seq))
+
+
+class P2POp:
+    """reference: communication/batch_isend_irecv.py P2POp — a deferred
+    send/recv descriptor for batch_isend_irecv."""
+
+    def __init__(self, op, tensor, peer: int, group=None, tag: str = ""):
+        if op not in (isend, irecv, send, recv):
+            raise ValueError(
+                "op must be paddle_tpu.distributed.isend or irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+        self.tag = tag
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[_P2PTask]:
+    """reference: paddle.distributed.batch_isend_irecv — launch all ops,
+    return tasks in INPUT order (tasks[i] ↔ p2p_op_list[i], the reference
+    contract).  Sends are launched before receives so a symmetric exchange
+    cannot deadlock."""
+    if not p2p_op_list:
+        return []
+    tasks: List[Optional[_P2PTask]] = [None] * len(p2p_op_list)
+    order = sorted(range(len(p2p_op_list)),
+                   key=lambda i: p2p_op_list[i].op in (irecv, recv))
+    for i in order:
+        op = p2p_op_list[i]
+        if op.op in (isend, send):
+            tasks[i] = isend(op.tensor, op.peer, op.group, tag=op.tag)
+        else:
+            tasks[i] = irecv(op.tensor, op.peer, op.group, tag=op.tag)
+    return tasks

@@ -360,11 +360,19 @@ def barrier(store: TCPStore, key: str, world_size: int,
 
 
 _global_store: Optional[TCPStore] = None
+_global_store_lock = threading.Lock()
 
 
 def create_or_get_global_tcp_store() -> TCPStore:
     """reference: pybind communication.cc:140 — rank 0 hosts, others
-    connect, addresses from PADDLE_MASTER/PADDLE_TRAINER_ENDPOINTS env."""
+    connect, addresses from PADDLE_MASTER/PADDLE_TRAINER_ENDPOINTS env.
+    Thread-safe: concurrent isend/irecv tasks must not double-bind."""
+    global _global_store
+    with _global_store_lock:
+        return _create_or_get_global_tcp_store_locked()
+
+
+def _create_or_get_global_tcp_store_locked() -> TCPStore:
     global _global_store
     if _global_store is not None:
         return _global_store

@@ -127,8 +127,10 @@ class TrainStep:
             (loss, outs), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(arrays)
             if grad_clip is not None:
-                pairs = [(wrap_array(a), wrap_array(g))
-                         for a, g in zip(arrays, grads)]
+                # real Parameter objects, not bare wraps: the clip consults
+                # per-param flags (need_clip) that live on the Parameter
+                pairs = [(p, wrap_array(g))
+                         for p, g in zip(train_params, grads)]
                 with no_grad():
                     clipped = grad_clip(pairs)
                 grads = [g._data for _, g in clipped]

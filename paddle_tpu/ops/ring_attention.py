@@ -20,7 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..framework.dispatch import call_op
@@ -108,5 +108,5 @@ def ring_attention(query: Tensor, key: Tensor, value: Tensor, mesh,
 
     fn = shard_map(body, mesh=jmesh,
                    in_specs=(spec(4), spec(4), spec(4)),
-                   out_specs=spec(4), check_rep=False)
+                   out_specs=spec(4), check_vma=False)
     return call_op("ring_attention", fn, (query, key, value), {})

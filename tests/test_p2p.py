@@ -1,0 +1,196 @@
+"""Eager p2p + object collectives: multi-process localhost clusters over the
+TCPStore substrate (reference: communication/batch_isend_irecv.py,
+test/collective p2p tests)."""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _env(rank, world, port):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(world)
+    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+
+
+def _p2p_proc(rank, world, port, q):
+    try:
+        from paddle_tpu.framework.backend_guard import helper_process_init
+        helper_process_init()
+        _env(rank, world, port)
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import P2POp, batch_isend_irecv
+        from paddle_tpu.distributed import p2p
+
+        # --- blocking ring exchange: rank r sends r*ones to (r+1) % world
+        nxt, prv = (rank + 1) % world, (rank - 1) % world
+        out = paddle.to_tensor(np.full((4,), rank, np.float32))
+        got = paddle.to_tensor(np.zeros((4,), np.float32))
+        if rank % 2 == 0:
+            dist.send(out, dst=nxt)
+            dist.recv(got, src=prv)
+        else:
+            dist.recv(got, src=prv)
+            dist.send(out, dst=nxt)
+        np.testing.assert_allclose(got.numpy(), np.full((4,), prv))
+
+        # --- isend/irecv round trip with explicit wait
+        t_in = paddle.to_tensor(np.arange(6, dtype=np.float32) + 100 * rank)
+        t_out = paddle.to_tensor(np.zeros(6, np.float32))
+        tasks = [p2p.isend(t_in, dst=nxt, tag="async"),
+                 p2p.irecv(t_out, src=prv, tag="async", timeout=60)]
+        for t in tasks:
+            t.wait(timeout=60)
+        np.testing.assert_allclose(
+            t_out.numpy(), np.arange(6, dtype=np.float32) + 100 * prv)
+
+        # --- batch_isend_irecv symmetric exchange
+        b_in = paddle.to_tensor(np.full((2, 2), rank, np.float32))
+        b_out = paddle.to_tensor(np.zeros((2, 2), np.float32))
+        ops = [P2POp(p2p.isend, b_in, nxt, tag="batch"),
+               P2POp(p2p.irecv, b_out, prv, tag="batch")]
+        for t in batch_isend_irecv(ops):
+            t.wait(timeout=60)
+        np.testing.assert_allclose(b_out.numpy(), np.full((2, 2), prv))
+
+        # --- object collectives
+        objs = []
+        dist.all_gather_object(objs, {"rank": rank})
+        assert [o["rank"] for o in objs] == list(range(world))
+
+        blist = [f"payload-{rank}", rank] if rank == 0 else [None, None]
+        dist.broadcast_object_list(blist, src=0)
+        assert blist == ["payload-0", 0]
+
+        scattered = []
+        dist.scatter_object_list(
+            scattered, [f"for-{r}" for r in range(world)], src=0)
+        assert scattered == [f"for-{rank}"]
+
+        # --- list-form all_to_all: rank i's slot j lands on rank j slot i
+        ins = [paddle.to_tensor(np.array([rank * 10 + j], np.float32))
+               for j in range(world)]
+        outs = []
+        dist.all_to_all(outs, ins)
+        np.testing.assert_allclose(
+            np.concatenate([o.numpy() for o in outs]),
+            np.array([r * 10 + rank for r in range(world)], np.float32))
+
+        q.put((rank, "ok"))
+    except Exception as e:   # noqa: BLE001
+        import traceback
+        q.put((rank, f"FAIL: {e}\n{traceback.format_exc()}"))
+
+
+class TestP2PMultiProcess:
+    def test_ring_exchange_three_ranks(self):
+        world = 3
+        port = _free_port()
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_p2p_proc, args=(r, world, port, q))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        results = {}
+        for _ in range(world):
+            rank, status = q.get(timeout=180)
+            results[rank] = status
+        for p in procs:
+            p.join(timeout=30)
+        assert all(v == "ok" for v in results.values()), results
+
+
+class TestP2PSingleProcess:
+    def test_send_recv_self_roundtrip(self):
+        # world=1: send-to-self then recv-from-self through the store
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import p2p
+        from paddle_tpu.distributed.store import TCPStore
+        p2p._reset_state()
+        st = TCPStore("127.0.0.1", 0, is_master=True, timeout=10)
+        p2p._state.store = st
+        try:
+            x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+            y = paddle.to_tensor(np.zeros(4, np.float32))
+            p2p.send(x, dst=0)
+            p2p.recv(y, src=0, timeout=5)
+            np.testing.assert_allclose(y.numpy(), x.numpy())
+        finally:
+            st.close()
+            p2p._reset_state()
+
+    def test_isend_sequence_reserved_at_issue_time(self):
+        # two isends to the same peer must deliver in issue order even if
+        # their worker threads are scheduled out of order
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import p2p
+        from paddle_tpu.distributed.store import TCPStore
+        p2p._reset_state()
+        st = TCPStore("127.0.0.1", 0, is_master=True, timeout=10)
+        p2p._state.store = st
+        try:
+            a = paddle.to_tensor(np.array([1.0], np.float32))
+            b = paddle.to_tensor(np.array([2.0], np.float32))
+            t1 = p2p.isend(a, dst=0)
+            t2 = p2p.isend(b, dst=0)
+            t1.wait(30); t2.wait(30)
+            r1 = paddle.to_tensor(np.zeros(1, np.float32))
+            r2 = paddle.to_tensor(np.zeros(1, np.float32))
+            p2p.recv(r1, src=0, timeout=10)
+            p2p.recv(r2, src=0, timeout=10)
+            assert float(r1.numpy()[0]) == 1.0
+            assert float(r2.numpy()[0]) == 2.0
+        finally:
+            st.close()
+            p2p._reset_state()
+
+    def test_batch_isend_irecv_preserves_input_order(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import P2POp, batch_isend_irecv
+        from paddle_tpu.distributed import p2p
+        from paddle_tpu.distributed.store import TCPStore
+        p2p._reset_state()
+        st = TCPStore("127.0.0.1", 0, is_master=True, timeout=10)
+        p2p._state.store = st
+        try:
+            t_in = paddle.to_tensor(np.array([5.0], np.float32))
+            t_out = paddle.to_tensor(np.zeros(1, np.float32))
+            # recv listed FIRST: tasks[0] must still be the recv task
+            ops = [P2POp(p2p.irecv, t_out, 0), P2POp(p2p.isend, t_in, 0)]
+            tasks = batch_isend_irecv(ops)
+            tasks[0].wait(30)   # reference contract: tasks[i] <-> ops[i]
+            np.testing.assert_allclose(t_out.numpy(), [5.0])
+            tasks[1].wait(30)
+        finally:
+            st.close()
+            p2p._reset_state()
+
+    def test_p2pop_validates_op(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import P2POp
+        with pytest.raises(ValueError):
+            P2POp(print, paddle.to_tensor(np.zeros(1)), 0)
+
+    def test_object_collectives_world1(self):
+        import paddle_tpu.distributed as dist
+        objs = []
+        dist.all_gather_object(objs, 7)
+        assert objs == [7]
+        lst = ["a"]
+        dist.broadcast_object_list(lst, src=0)
+        assert lst == ["a"]
+        out = []
+        dist.scatter_object_list(out, ["x", "y"], src=0)
+        assert out == ["x"]

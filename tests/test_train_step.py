@@ -58,6 +58,27 @@ class TestTrainStepMatchesEager:
         for pe, ps in zip(m_eager.parameters(), m_step.parameters()):
             np.testing.assert_allclose(_np(ps), _np(pe), rtol=2e-4, atol=2e-5)
 
+    def test_grad_clip_need_clip_excluded(self):
+        # per-param need_clip=False must be honored inside the compiled step
+        x, y = _data()
+        m_eager, m_step = _mlp(9), _mlp(9)
+        for m in (m_eager, m_step):
+            m[0].weight.need_clip = False
+        opt_e = optim.SGD(learning_rate=0.5, parameters=m_eager.parameters(),
+                          grad_clip=nn.ClipGradByGlobalNorm(0.05))
+        opt_s = optim.SGD(learning_rate=0.5, parameters=m_step.parameters(),
+                          grad_clip=nn.ClipGradByGlobalNorm(0.05))
+        step = TrainStep(m_step, _mse, opt_s)
+        for _ in range(3):
+            loss = _mse(m_eager(x), y)
+            loss.backward()
+            opt_e.step()
+            opt_e.clear_grad()
+            step(x, y)
+        step.sync()
+        for pe, ps in zip(m_eager.parameters(), m_step.parameters()):
+            np.testing.assert_allclose(_np(ps), _np(pe), rtol=2e-4, atol=2e-5)
+
     def test_grad_clip_matches_eager(self):
         x, y = _data()
         m_eager, m_step = _mlp(3), _mlp(3)
