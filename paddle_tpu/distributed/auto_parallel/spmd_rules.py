@@ -1,0 +1,353 @@
+"""Per-op SPMD sharding-propagation rules (SURVEY §2 row 15).
+
+Capability parity: paddle/phi/infermeta/spmd_rules/*.cc — matmul.cc,
+flash_attention.cc, fused_rope.cc, layer_norm.cc, embedding.cc,
+elementwise.cc, reduction.cc, concat_and_split.cc, transpose.cc, reshape.cc.
+
+TPU-native role: GSPMD already *propagates* shardings inside a compiled
+program, so these rules exist for the cases where the output sharding is a
+CHOICE among several legal propagations — there they pin the placement the
+hybrid-parallel recipes expect (e.g. a row-parallel matmul's output stays
+sharded on the batch axis rather than gathered).  Dispatch applies a rule's
+verdict to op outputs whose inputs carry ``dist_attr``:
+``jax.lax.with_sharding_constraint`` under tracing, ``jax.device_put``
+eagerly, and stamps the output ``dist_attr`` so eager chains keep placements
+flowing (reference: the InferSPMD slot every phi op schema carries).
+
+Rules receive ``ShardedArg`` stand-ins (shape + placements + mesh) for tensor
+arguments and the op's literal non-tensor arguments; they return the output
+placement list (or a tuple of lists for multi-output ops).  Rules are
+advisory: any rule error falls back to GSPMD's default propagation.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .placement import Partial, Placement, Replicate, Shard
+
+
+class ShardedArg:
+    """Stand-in for a tensor argument handed to an SPMD rule."""
+
+    __slots__ = ("shape", "placements", "mesh")
+
+    def __init__(self, shape, placements, mesh):
+        self.shape = tuple(shape)
+        self.placements = list(placements)
+        self.mesh = mesh
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def dims_map(self):
+        """tensor dim -> list of mesh-axis indices sharding it."""
+        m = {}
+        for ax, pl in enumerate(self.placements):
+            if isinstance(pl, Shard):
+                m.setdefault(pl.dim, []).append(ax)
+        return m
+
+
+def _n_axes(arg: ShardedArg) -> int:
+    return len(arg.placements)
+
+
+def _from_dims_map(dmap, n_axes) -> List[Placement]:
+    """Inverse of dims_map with first-wins conflict resolution: one mesh
+    axis can shard at most one tensor dim."""
+    placements: List[Placement] = [Replicate() for _ in range(n_axes)]
+    for dim in sorted(dmap):
+        for ax in dmap[dim]:
+            if isinstance(placements[ax], Replicate):
+                placements[ax] = Shard(dim)
+    return placements
+
+
+def _first_sharded(*args) -> Optional[ShardedArg]:
+    for a in args:
+        if isinstance(a, ShardedArg) and any(
+                not isinstance(p, Replicate) for p in a.placements):
+            return a
+    for a in args:
+        if isinstance(a, ShardedArg):
+            return a
+    return None
+
+
+# --------------------------------------------------------------- elementwise
+def elementwise_rule(*args, **kwargs):
+    """Broadcast-aligned MERGE of every input's shardings (reference:
+    spmd_rules/elementwise.cc): input dim d maps to output dim
+    d + (out_ndim - ndim); first-wins on conflicts.  Merging (not picking a
+    lead input) matters: pinning Replicate where some input was sharded
+    would force a gather GSPMD would never insert."""
+    tensors = [a for a in args if isinstance(a, ShardedArg)]
+    if not tensors:
+        return None
+    out_ndim = max(t.ndim for t in tensors)
+    dmap = {}
+    # higher-rank inputs first: their dims align with the output directly
+    for t in sorted(tensors, key=lambda t: -t.ndim):
+        shift = out_ndim - t.ndim
+        for d, axes in t.dims_map().items():
+            dmap.setdefault(d + shift, axes)
+    return _from_dims_map(dmap, _n_axes(tensors[0]))
+
+
+# ------------------------------------------------------------------- matmul
+def matmul_rule(x: ShardedArg, y: ShardedArg, transpose_x=False,
+                transpose_y=False):
+    """reference: spmd_rules/matmul.cc — m/batch dims follow x, n and y's
+    batch dims follow y; a mesh axis contracted on k is dropped (GSPMD
+    inserts the reduce).  Follows numpy matmul rank semantics (1-D operands
+    contract away their only dim)."""
+    n_axes = _n_axes(x)
+    nx, ny = x.ndim, y.ndim
+    if nx == 0 or ny == 0:
+        return None
+    xm = (nx - 1 if transpose_x else nx - 2) if nx >= 2 else None
+    xk = (nx - 2 if transpose_x else nx - 1) if nx >= 2 else 0
+    yk = (ny - 1 if transpose_y else ny - 2) if ny >= 2 else 0
+    yn = (ny - 2 if transpose_y else ny - 1) if ny >= 2 else None
+    if nx == 1 and ny == 1:
+        out_ndim = 0
+    elif nx == 1:
+        out_ndim = ny - 1
+    elif ny == 1:
+        out_ndim = nx - 1
+    else:
+        out_ndim = max(nx, ny)
+
+    dmap = {}
+    if nx >= 2:
+        for d, axes in x.dims_map().items():
+            if d == xk:
+                continue   # contracted: resolved by the compiler's reduce
+            if d == xm:
+                od = out_ndim - (2 if yn is not None else 1)
+            elif yn is None:
+                od = d          # vector rhs: out = x dims minus k, in place
+            else:               # batch dim: right-aligned with the output
+                od = d + (out_ndim - nx)
+            if 0 <= od < out_ndim:
+                dmap.setdefault(od, axes)
+    ymap = y.dims_map()
+    if yn is not None:
+        yaxes = ymap.get(yn)
+        if yaxes and out_ndim >= 1:
+            dmap.setdefault(out_ndim - 1, yaxes)
+    if ny >= 2:
+        for d, axes in ymap.items():
+            if d in (yk, yn):
+                continue
+            od = d + (out_ndim - ny)
+            if 0 <= od < out_ndim:
+                dmap.setdefault(od, axes)
+    return _from_dims_map(dmap, n_axes)
+
+
+def linear_rule(x: ShardedArg, weight: ShardedArg, bias=None):
+    """x[..., k] @ w[k, n]: out follows x on batch dims, w on the n dim
+    (column-parallel keeps Shard on n; row-parallel k-shard is contracted)."""
+    n_axes = _n_axes(x)
+    dmap = {d: axes for d, axes in x.dims_map().items() if d != x.ndim - 1}
+    waxes = weight.dims_map().get(1)
+    if waxes:
+        dmap.setdefault(x.ndim - 1, waxes)
+    return _from_dims_map(dmap, n_axes)
+
+
+# ---------------------------------------------------------------- embedding
+def embedding_rule(weight: ShardedArg, x: ShardedArg, padding_idx=None):
+    """reference: spmd_rules/embedding.cc — out = ids dims + hidden dim;
+    hidden follows the weight's column sharding; a vocab(row)-sharded weight
+    contributes partial rows (compiler resolves)."""
+    n_axes = _n_axes(weight)
+    dmap = dict(x.dims_map())
+    col_axes = weight.dims_map().get(1)
+    if col_axes:
+        dmap[x.ndim] = col_axes
+    return _from_dims_map(dmap, n_axes)
+
+
+# ---------------------------------------------------------------- attention
+def flash_attention_rule(q: ShardedArg, k: ShardedArg, v: ShardedArg,
+                         causal=False, **kwargs):
+    """reference: spmd_rules/flash_attention.cc — output follows q
+    ([batch, heads, seq, head_dim]); head_dim sharding comes from v."""
+    n_axes = _n_axes(q)
+    dmap = {d: axes for d, axes in q.dims_map().items() if d != q.ndim - 1}
+    vaxes = v.dims_map().get(v.ndim - 1)
+    if vaxes:
+        dmap[q.ndim - 1] = vaxes
+    return _from_dims_map(dmap, n_axes)
+
+
+def fused_rope_rule(q: ShardedArg, k: ShardedArg, cos=None, sin=None,
+                    position_offset=0):
+    """reference: spmd_rules/fused_rope.cc — rotation is per-position,
+    per-head elementwise: q and k keep their own placements."""
+    return (list(q.placements), list(k.placements))
+
+
+# --------------------------------------------------------------------- norm
+def layer_norm_rule(x: ShardedArg, weight=None, bias=None, epsilon=1e-5,
+                    begin_axis=-1):
+    """reference: spmd_rules/layer_norm.cc — normalized trailing dims must
+    be unsharded in the output; leading dims follow x."""
+    n_axes = _n_axes(x)
+    if begin_axis < 0:
+        begin_axis += x.ndim
+    dmap = {d: axes for d, axes in x.dims_map().items() if d < begin_axis}
+    return _from_dims_map(dmap, n_axes)
+
+
+def rms_norm_rule(x: ShardedArg, weight=None, epsilon=1e-6):
+    return layer_norm_rule(x, weight, None, epsilon, begin_axis=x.ndim - 1)
+
+
+def softmax_rule(x: ShardedArg, axis=-1):
+    """Softmax axis must not stay sharded in the output."""
+    n_axes = _n_axes(x)
+    if axis < 0:
+        axis += x.ndim
+    dmap = {d: a for d, a in x.dims_map().items() if d != axis}
+    return _from_dims_map(dmap, n_axes)
+
+
+# ------------------------------------------------------------- manipulation
+def transpose_rule(x: ShardedArg, perm):
+    n_axes = _n_axes(x)
+    perm = [p % x.ndim for p in perm]
+    inv = {old: new for new, old in enumerate(perm)}
+    dmap = {inv[d]: axes for d, axes in x.dims_map().items() if d in inv}
+    return _from_dims_map(dmap, n_axes)
+
+
+def reshape_rule(x: ShardedArg, shape):
+    """Conservative (reference reshape.cc handles more): keep a dim's shard
+    only while the leading shape prefix is unchanged; later dims replicate."""
+    n_axes = _n_axes(x)
+    shape = list(shape)
+    # resolve a single -1 using the element count
+    if -1 in shape:
+        total = 1
+        for s in x.shape:
+            total *= s
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = total // max(known, 1)
+    keep = 0
+    while (keep < min(x.ndim, len(shape))
+           and shape[keep] == x.shape[keep]):
+        keep += 1
+    dmap = {d: axes for d, axes in x.dims_map().items() if d < keep}
+    return _from_dims_map(dmap, n_axes)
+
+
+def concat_rule(xs, axis=0):
+    """reference: spmd_rules/concat_and_split.cc — the concat axis cannot
+    stay sharded; other dims follow the first sharded input."""
+    lead = _first_sharded(*xs)
+    if lead is None:
+        return None
+    n_axes = _n_axes(lead)
+    if axis < 0:
+        axis += lead.ndim
+    dmap = {d: a for d, a in lead.dims_map().items() if d != axis}
+    return _from_dims_map(dmap, n_axes)
+
+
+def split_rule(x: ShardedArg, sections, axis=0):
+    """Every output keeps x's placements except the split axis."""
+    n_axes = _n_axes(x)
+    if axis < 0:
+        axis += x.ndim
+    dmap = {d: a for d, a in x.dims_map().items() if d != axis}
+    pl = _from_dims_map(dmap, n_axes)
+    n_out = sections if isinstance(sections, int) else len(sections)
+    return tuple(list(pl) for _ in range(n_out))
+
+
+# ---------------------------------------------------------------- reduction
+def _reduction_rule(x: ShardedArg, axis, keepdim):
+    """reference: spmd_rules/reduction.cc — reduced dims disappear (or
+    replicate with keepdim); surviving dims keep their shards."""
+    n_axes = _n_axes(x)
+    if axis is None:
+        red = set(range(x.ndim))
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        red = {a % x.ndim for a in axes}
+    dmap = {}
+    for d, ax in x.dims_map().items():
+        if d in red:
+            continue
+        if keepdim:
+            dmap[d] = ax
+        else:
+            dmap[d - sum(1 for r in red if r < d)] = ax
+    return _from_dims_map(dmap, n_axes)
+
+
+def reduction_rule(x: ShardedArg, axis=None, keepdim=False):
+    """Signature mirror of mean/max/min/amax/amin/logsumexp/nansum/nanmean —
+    positional keepdim must land correctly (matches tensor/math.py)."""
+    return _reduction_rule(x, axis, bool(keepdim))
+
+
+def sum_rule(x: ShardedArg, axis=None, dtype=None, keepdim=False):
+    """Signature mirror of sum(x, axis, dtype, keepdim)."""
+    return _reduction_rule(x, axis, bool(keepdim))
+
+
+def register_all():
+    """Install the rules into the op registry (idempotent)."""
+    from ...framework.dispatch import OP_REGISTRY, register_spmd_rule
+
+    rules = {
+        "matmul": matmul_rule,
+        "linear": linear_rule,
+        "embedding_": embedding_rule,
+        "flash_attention": flash_attention_rule,
+        "fused_rope": fused_rope_rule,
+        "layer_norm_f": layer_norm_rule,
+        "rms_norm_f": rms_norm_rule,
+        "softmax_": softmax_rule,
+        "log_softmax_": softmax_rule,
+        "transpose": transpose_rule,
+        "reshape": reshape_rule,
+        "concat_": concat_rule,
+        "split_": split_rule,
+        "sum": sum_rule,
+        "mean": reduction_rule,
+        "max": reduction_rule,
+        "min": reduction_rule,
+        "amax": reduction_rule,
+        "amin": reduction_rule,
+        "logsumexp": reduction_rule,
+        "nansum": reduction_rule,
+        "nanmean": reduction_rule,
+    }
+    # elementwise family: same broadcast-aligned rule
+    for name in ("add", "subtract", "multiply", "divide", "pow", "maximum",
+                 "minimum", "gelu", "relu", "silu", "tanh", "sigmoid",
+                 "dropout_", "cast", "scale", "clip", "where_"):
+        if name in OP_REGISTRY:
+            rules.setdefault(name, elementwise_rule)
+    n = 0
+    missing = []
+    for name, rule in rules.items():
+        if name in OP_REGISTRY:
+            register_spmd_rule(name, rule)
+            n += 1
+        else:
+            missing.append(name)
+    if missing:
+        import warnings
+        warnings.warn(
+            f"SPMD rules for unknown ops skipped (op renamed?): {missing}")
+    return n

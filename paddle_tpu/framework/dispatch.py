@@ -45,8 +45,13 @@ OP_REGISTRY: Dict[str, OpDef] = {}
 
 
 def register_spmd_rule(name: str, rule: Callable) -> None:
-    if name in OP_REGISTRY:
-        OP_REGISTRY[name].spmd_rule = rule
+    """Attach a sharding-propagation rule to a registered op.  Raises on an
+    unknown op name — a typo'd registration silently dropping a rule would
+    degrade hybrid-parallel placement with no error."""
+    if name not in OP_REGISTRY:
+        raise ValueError(f"register_spmd_rule: no op named {name!r} "
+                         f"(is the defining module imported yet?)")
+    OP_REGISTRY[name].spmd_rule = rule
 
 
 def _is_tensor(x):
@@ -113,6 +118,7 @@ def _call_op_impl(name: str, fn: Callable, args: tuple, kwargs: dict):
     if not record:
         out = _call_with(arrays)
         result, _, _ = _wrap_outputs(out)
+        _apply_spmd_rule(name, leaves, tensor_idx, treedef, result)
         _check_nan_inf(name, result)
         return result
 
@@ -141,8 +147,78 @@ def _call_op_impl(name: str, fn: Callable, args: tuple, kwargs: dict):
 
     # Stamp tape metadata on floating outputs.
     _stamp_outputs(result, node)
+    _apply_spmd_rule(name, leaves, tensor_idx, treedef, result)
     _check_nan_inf(name, result)
     return result
+
+
+def _apply_spmd_rule(name, leaves, tensor_idx, treedef, result):
+    """Apply the op's SPMD rule when any input is a dist tensor (SURVEY row
+    15; reference: the InferSPMD slot run by the dist API layer).
+
+    Pins the output sharding the rule chose — ``with_sharding_constraint``
+    under tracing, ``device_put`` eagerly — and stamps ``dist_attr`` so
+    placements keep flowing through eager op chains.  Rules are advisory:
+    any failure leaves GSPMD's default propagation in place.
+    """
+    opdef = OP_REGISTRY.get(name)
+    if opdef is None or opdef.spmd_rule is None:
+        return
+    dist_in = [leaves[i] for i in tensor_idx
+               if leaves[i].dist_attr is not None]
+    if not dist_in:
+        return
+    try:
+        from ..distributed.auto_parallel.api import (
+            DistAttr, placements_to_spec,
+        )
+        from ..distributed.auto_parallel.placement import Replicate
+        from ..distributed.auto_parallel.spmd_rules import ShardedArg
+        from jax.sharding import NamedSharding
+
+        mesh = dist_in[0].dist_attr.process_mesh
+        n_axes = mesh.ndim
+
+        def as_meta(leaf):
+            if not _is_tensor(leaf):
+                return leaf
+            attr = leaf.dist_attr
+            placements = (list(attr.placements) if attr is not None
+                          else [Replicate() for _ in range(n_axes)])
+            return ShardedArg(leaf._data.shape, placements, mesh)
+
+        meta_leaves = [as_meta(l) for l in leaves]
+        args2, kwargs2 = jtu.tree_unflatten(treedef, meta_leaves)
+        out_pl = opdef.spmd_rule(*args2, **kwargs2)
+        if out_pl is None:
+            return
+        flat_res, _ = jtu.tree_flatten(result, is_leaf=_is_tensor)
+        out_tensors = [t for t in flat_res if _is_tensor(t)]
+        if out_pl and isinstance(out_pl[0], (list, tuple)) and not isinstance(
+                out_pl[0], str):
+            per_out = list(out_pl)
+        else:
+            per_out = [out_pl] * len(out_tensors)
+        # stage everything before mutating ANY output: a failure halfway
+        # must not leave a mixed constrained/unconstrained state
+        staged = []
+        for t, placements in zip(out_tensors, per_out):
+            spec = placements_to_spec(placements, mesh, t.ndim)
+            sharding = NamedSharding(mesh.jax_mesh, spec)
+            if isinstance(t._data, jax.core.Tracer):
+                new_data = jax.lax.with_sharding_constraint(t._data, sharding)
+            else:
+                new_data = jax.device_put(t._data, sharding)
+            staged.append((t, new_data, DistAttr(mesh, list(placements))))
+        for t, new_data, attr in staged:
+            t._data = new_data
+            t.dist_attr = attr
+    except Exception:   # advisory: never let a rule break dispatch
+        if get_flag("spmd_rule_debug", 0):
+            import traceback
+            print(f"WARNING: spmd rule for op '{name}' failed:")
+            traceback.print_exc()
+        return
 
 
 def _wrap_outputs(out):
