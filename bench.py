@@ -182,6 +182,43 @@ def bench_bert_sst2(on_tpu):
     }
 
 
+def bench_ocr_crnn(on_tpu):
+    """BASELINE config 3 (recognition half of the OCR pipeline): CRNN + CTC
+    images/sec through the framework path."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import CRNN, crnn_tiny
+
+    if on_tpu:
+        n_cls, B, H, W, steps = 96, 64, 32, 320, 20
+        model = CRNN(n_cls, img_height=H)
+    else:
+        n_cls, B, H, W, steps = 8, 4, 16, 32, 2
+        model = crnn_tiny(n_cls, img_height=H)
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        rng.standard_normal((B, 1, H, W)).astype("float32"))
+    y = paddle.to_tensor(
+        rng.integers(1, n_cls, (B, max(W // 8, 2))).astype("int64"))
+    ilen = paddle.to_tensor(np.full(B, W // 4, np.int64))
+    llen = paddle.to_tensor(np.full(B, max(W // 8, 2), np.int64))
+    opt = optim.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return F.ctc_loss(logits, labels, ilen, llen)
+
+    step = TrainStep(model, loss_fn, opt)
+    img_s = _measure(lambda: step(x, y), _sync, B, steps)
+    return {
+        "metric": "crnn_ctc_ocr_rec_images_per_sec",
+        "value": round(img_s, 1), "unit": "images/sec", "vs_baseline": 0.0,
+        "path": "jit.TrainStep + optimizer.Adam + lax.scan CTC",
+    }
+
+
 def bench_dp_scaling():
     """BASELINE config 4 (shape only): DP ResNet weak-scaling efficiency on
     an 8-device virtual CPU mesh, measured in a CPU-pinned subprocess so it
@@ -266,7 +303,7 @@ def main():
 
     suite = []
     errors = []
-    for fn in (bench_resnet_cifar, bench_bert_sst2):
+    for fn in (bench_resnet_cifar, bench_bert_sst2, bench_ocr_crnn):
         try:
             suite.append(fn(on_tpu))
         except Exception as e:  # noqa: BLE001

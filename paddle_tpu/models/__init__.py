@@ -7,3 +7,4 @@ from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForSequenceClassification, BertForMaskedLM,
     bert_base, bert_tiny,
 )
+from .crnn import CRNN, crnn_tiny  # noqa: F401

@@ -1,0 +1,53 @@
+"""CRNN: the OCR recognition model shape (BASELINE config 3, PP-OCR rec).
+
+Capability parity: the reference ecosystem's CRNN/PP-OCRv4 recognition head
+(conv backbone → collapse height → bidirectional LSTM encoder → per-timestep
+classifier → CTC).  TPU-native: the conv stack and the per-timestep linear
+are MXU matmuls; the BiLSTM is the lax.scan RNN from nn/layer/rnn.py; CTC is
+the scan-based loss in nn/functional/ctc.py — the whole train step compiles
+into one XLA program under jit.TrainStep.
+"""
+from __future__ import annotations
+
+from ..nn import (
+    BatchNorm2D, Conv2D, Layer, Linear, LSTM, MaxPool2D, ReLU, Sequential,
+)
+
+
+class CRNN(Layer):
+    """Input [N, C, H, W] (H divisible by 4 after two 2x pools collapses to
+    the sequence axis W//4); output logits [T=W//4, N, num_classes]
+    (time-major, ready for ctc_loss)."""
+
+    def __init__(self, num_classes, in_channels=1, img_height=32,
+                 hidden_size=96, channels=(32, 64, 128)):
+        super().__init__()
+        if img_height % 4 != 0:
+            raise ValueError("img_height must be divisible by 4 "
+                             "(two 2x poolings collapse it)")
+        c1, c2, c3 = channels
+        self.backbone = Sequential(
+            Conv2D(in_channels, c1, 3, padding=1), BatchNorm2D(c1), ReLU(),
+            MaxPool2D(2, 2),
+            Conv2D(c1, c2, 3, padding=1), BatchNorm2D(c2), ReLU(),
+            MaxPool2D(2, 2),
+            Conv2D(c2, c3, 3, padding=1), BatchNorm2D(c3), ReLU(),
+        )
+        self.rnn = LSTM(c3 * (img_height // 4), hidden_size, num_layers=2,
+                        direction="bidirect", time_major=False)
+        self.head = Linear(2 * hidden_size, num_classes)
+        self.num_classes = num_classes
+
+    def forward(self, x):
+        feat = self.backbone(x)                       # [N, C3, H/4, W/4]
+        n, c, h, w = feat.shape
+        seq = feat.transpose([0, 3, 1, 2]).reshape([n, w, c * h])
+        enc, _ = self.rnn(seq)                        # [N, T, 2*hidden]
+        logits = self.head(enc)                       # [N, T, classes]
+        return logits.transpose([1, 0, 2])            # [T, N, classes]
+
+
+def crnn_tiny(num_classes, in_channels=1, img_height=16):
+    """Small config for tests/benchmarks."""
+    return CRNN(num_classes, in_channels, img_height, hidden_size=48,
+                channels=(16, 32, 64))

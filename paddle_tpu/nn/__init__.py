@@ -35,6 +35,7 @@ from .layer.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     KLDivLoss, SmoothL1Loss, HuberLoss, MarginRankingLoss,
     HingeEmbeddingLoss, CosineEmbeddingLoss, TripletMarginLoss,
+    CTCLoss,
 )
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,

@@ -1049,3 +1049,6 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
         return (jnp.arange(ml)[None, :] < lengths[:, None]).astype(
             dtypes.convert_dtype(dtype))
     return call_op("sequence_mask", _fn, (lengths,), {})
+
+
+from .ctc import ctc_loss, ctc_decode  # noqa: E402,F401

@@ -1,0 +1,135 @@
+"""CTC loss + greedy decode.
+
+Capability parity: python/paddle/nn/functional/loss.py ctc_loss:1907
+(warpctc-backed in the reference: paddle/phi/kernels/impl/warpctc_kernel_impl.h)
+and the legacy ctc_greedy_decoder.
+
+TPU-native design: the forward-backward alpha recursion is a ``lax.scan``
+over time in log space — one compiled loop with static shapes (labels padded
+to max length, per-sample lengths masked), fully differentiable by jax
+autodiff (no hand-written backward, unlike warpctc).  The extended label
+sequence (blank-interleaved, 2L+1) is built with gathers so the whole loss
+jits and batches."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import def_op
+from ...framework.tensor import Tensor, wrap_array
+
+_NEG_INF = -1e30   # finite sentinel: with finite operands jnp.logaddexp is
+                   # NaN-free in both forward and backward (true -inf would
+                   # produce inf-inf in its own grad; and tiny epsilons are
+                   # subnormals XLA:CPU flushes to 0 -> log(0) NaNs)
+
+
+def _log_add(a, b):
+    return jnp.logaddexp(a, b)
+
+
+@def_op("ctc_loss_")
+def _ctc_loss(logits, labels, input_lengths, label_lengths, blank,
+              norm_by_times):
+    """logits [T, B, C]; labels [B, L] padded; per-sample NLL [B]."""
+    T, B, C = logits.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    lab = labels.astype(jnp.int32)
+    # extended sequence: blank, l1, blank, l2, ..., blank  (length 2L+1)
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    # transitions: s-2 allowed when ext[s] != blank and ext[s] != ext[s-2]
+    can_skip = jnp.zeros((B, S), bool)
+    can_skip = can_skip.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    ilen = input_lengths.astype(jnp.int32)
+    llen = label_lengths.astype(jnp.int32)
+    s_len = 2 * llen + 1                       # valid extended length
+
+    # alpha_0
+    init = jnp.full((B, S), _NEG_INF)
+    p0 = log_probs[0]                          # [B, C]
+    init = init.at[:, 0].set(p0[:, blank])
+    init = init.at[:, 1].set(jnp.where(
+        llen > 0, jnp.take_along_axis(p0, lab[:, 0:1], 1)[:, 0], _NEG_INF))
+
+    def step(alpha, t):
+        p = log_probs[t]                       # [B, C]
+        emit = jnp.take_along_axis(p, ext, axis=1)      # [B, S]
+        a_prev = alpha
+        a_shift1 = jnp.concatenate(
+            [jnp.full((B, 1), _NEG_INF), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate(
+            [jnp.full((B, 2), _NEG_INF), alpha[:, :-2]], axis=1)
+        a = _log_add(a_prev, a_shift1)
+        a = jnp.where(can_skip, _log_add(a, a_shift2), a)
+        new_alpha = a + emit
+        # frozen past the sample's input length (loss read at t = ilen-1)
+        new_alpha = jnp.where((t < ilen)[:, None], new_alpha, alpha)
+        return new_alpha, None
+
+    alpha, _ = jax.lax.scan(step, init, jnp.arange(1, T))
+
+    idx_last = jnp.clip(s_len - 1, 0, S - 1)
+    idx_prev = jnp.clip(s_len - 2, 0, S - 1)
+    a_last = jnp.take_along_axis(alpha, idx_last[:, None], 1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, idx_prev[:, None], 1)[:, 0]
+    a_prev = jnp.where(s_len >= 2, a_prev, _NEG_INF)
+    nll = -_log_add(a_last, a_prev)
+    if norm_by_times:
+        nll = nll / jnp.maximum(ilen.astype(jnp.float32), 1.0)
+    return nll
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """reference: paddle.nn.functional.ctc_loss (loss.py:1907) — takes raw
+    LOGITS [max_logit_length, batch, num_classes+1] (softmax is integrated,
+    matching warpctc), int labels [batch, max_label_length]."""
+    nll = _ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                    int(blank), bool(norm_by_times))
+    if reduction == "mean":
+        ll = label_lengths
+        denom = ll.astype("float32") if isinstance(ll, Tensor) else \
+            wrap_array(jnp.asarray(np.asarray(ll), jnp.float32))
+        return (nll / denom.clip(1.0)).mean()
+    if reduction == "sum":
+        return nll.sum()
+    return nll
+
+
+def ctc_decode(log_probs, input_lengths=None, blank=0):
+    """Greedy (best-path) CTC decode: argmax per frame, collapse repeats,
+    drop blanks (reference capability: fluid ctc_greedy_decoder).  Returns
+    (decoded [B, Lmax] padded with -1, lengths [B])."""
+    lp = log_probs._data if isinstance(log_probs, Tensor) else \
+        jnp.asarray(log_probs)
+    if lp.ndim != 3:
+        raise ValueError("ctc_decode expects [T, B, C] log-probs/logits")
+    T, B, C = lp.shape
+    path = np.asarray(jnp.argmax(lp, axis=-1))        # [T, B]
+    ilen = np.full(B, T) if input_lengths is None else \
+        np.asarray(input_lengths._data if isinstance(input_lengths, Tensor)
+                   else input_lengths)
+    outs = []
+    for b in range(B):
+        seq = []
+        prev = -1
+        for t in range(int(ilen[b])):
+            c = int(path[t, b])
+            if c != blank and c != prev:
+                seq.append(c)
+            prev = c
+        outs.append(seq)
+    lmax = max((len(s) for s in outs), default=0)
+    dec = np.full((B, max(lmax, 1)), -1, np.int64)
+    for b, s in enumerate(outs):
+        dec[b, :len(s)] = s
+    return (wrap_array(jnp.asarray(dec)),
+            wrap_array(jnp.asarray(np.asarray([len(s) for s in outs],
+                                              np.int64))))

@@ -1,0 +1,311 @@
+"""vision.ops detection suite + CTC loss + CRNN (reference:
+python/paddle/vision/ops.py, nn/functional/loss.py ctc_loss:1907).
+Numpy-golden where a closed form exists; brute-force for CTC."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops
+
+
+def _t(a, dt="float32"):
+    return paddle.to_tensor(np.asarray(a, dt))
+
+
+class TestNms:
+    def test_greedy_suppression_golden(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                          [20, 20, 30, 30], [21, 21, 29, 29]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7, 0.95], np.float32)
+        kept = ops.nms(_t(boxes), 0.5, _t(scores)).numpy()
+        np.testing.assert_array_equal(kept, [3, 0])
+
+    def test_no_scores_input_order(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        kept = ops.nms(_t(boxes), 0.5).numpy()
+        np.testing.assert_array_equal(kept, [0])
+
+    def test_categories_isolate(self):
+        # identical boxes in different categories both survive
+        boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1], np.int64)
+        kept = ops.nms(_t(boxes), 0.5, _t(scores), _t(cats, "int64"), [0, 1])
+        assert len(kept.numpy()) == 2
+
+    def test_top_k(self):
+        boxes = np.array([[0, 0, 1, 1], [5, 5, 6, 6], [9, 9, 10, 10]],
+                         np.float32)
+        scores = np.array([0.1, 0.9, 0.5], np.float32)
+        kept = ops.nms(_t(boxes), 0.5, _t(scores), top_k=2).numpy()
+        np.testing.assert_array_equal(kept, [1, 2])
+
+    def test_matrix_nms_shapes(self):
+        bb = np.random.default_rng(0).uniform(0, 30, (1, 6, 4)).astype("float32")
+        bb[..., 2:] += bb[..., :2]
+        sc = np.random.default_rng(1).uniform(0.3, 1, (1, 3, 6)).astype("float32")
+        out, idx, num = ops.matrix_nms(_t(bb), _t(sc), 0.2,
+                                       return_index=True)
+        assert out.shape[1] == 6           # [label, score, x1,y1,x2,y2]
+        assert int(num.numpy()[0]) == out.shape[0]
+
+
+class TestRoiOps:
+    def test_roi_align_constant_map(self):
+        x = _t(np.full((1, 2, 8, 8), 3.0))
+        rois = _t([[0.0, 0.0, 4.0, 4.0]])
+        out = ops.roi_align(x, rois, _t([1], "int32"), 2)
+        assert out.shape == [1, 2, 2, 2]
+        np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-6)
+
+    def test_roi_align_gradient_flows(self):
+        xa = np.random.default_rng(0).standard_normal((1, 1, 8, 8))
+        x = _t(xa)
+        x.stop_gradient = False
+        rois = _t([[1.0, 1.0, 6.0, 6.0]])
+        out = ops.roi_align(x, rois, _t([1], "int32"), 3)
+        out.sum().backward()
+        g = x.grad.numpy()
+        assert np.abs(g).sum() > 0
+
+    def test_roi_pool_max_semantics(self):
+        xa = np.zeros((1, 1, 8, 8), np.float32)
+        xa[0, 0, 1, 1] = 7.0
+        out = ops.roi_pool(_t(xa), _t([[0.0, 0.0, 3.0, 3.0]]),
+                           _t([1], "int32"), 1)
+        assert float(out.numpy()) == 7.0
+
+    def test_psroi_pool_position_sensitive(self):
+        # C_in = oc(2) * oh(2) * ow(2) = 8; block k feeds bin k only
+        xa = np.zeros((1, 8, 4, 4), np.float32)
+        for blk in range(4):
+            xa[0, blk * 2:(blk + 1) * 2] = blk + 1
+        out = ops.psroi_pool(_t(xa), _t([[0.0, 0.0, 4.0, 4.0]]),
+                             _t([1], "int32"), 2)
+        assert out.shape == [1, 2, 2, 2]
+        got = out.numpy()[0, 0]            # [oh, ow]
+        np.testing.assert_allclose(got, [[1.0, 2.0], [3.0, 4.0]])
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        import jax, jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        xa = rng.standard_normal((2, 3, 8, 8)).astype("float32")
+        wa = rng.standard_normal((4, 3, 3, 3)).astype("float32")
+        off = np.zeros((2, 18, 8, 8), np.float32)
+        y = ops.deform_conv2d(_t(xa), _t(off), _t(wa), padding=1)
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(xa), jnp.asarray(wa), (1, 1), [(1, 1), (1, 1)])
+        np.testing.assert_allclose(y.numpy(), np.asarray(ref), atol=1e-4)
+
+    def test_integer_offset_shifts_sampling(self):
+        # 1x1 kernel, offset (dy=0, dx=1): output[i,j] = x[i, j+1]
+        xa = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        wa = np.ones((1, 1, 1, 1), np.float32)
+        off = np.zeros((1, 2, 4, 4), np.float32)
+        off[0, 1] = 1.0                     # dx
+        y = ops.deform_conv2d(_t(xa), _t(off), _t(wa)).numpy()[0, 0]
+        want = np.zeros((4, 4), np.float32)
+        want[:, :3] = xa[0, 0][:, 1:]
+        np.testing.assert_allclose(y, want)
+
+    def test_mask_scales(self):
+        xa = np.ones((1, 1, 4, 4), np.float32)
+        wa = np.ones((1, 1, 1, 1), np.float32)
+        off = np.zeros((1, 2, 4, 4), np.float32)
+        mk = np.full((1, 1, 4, 4), 0.5, np.float32)
+        y = ops.deform_conv2d(_t(xa), _t(off), _t(wa), mask=_t(mk))
+        np.testing.assert_allclose(y.numpy(), 0.5)
+
+    def test_layer_trains(self):
+        layer = ops.DeformConv2D(2, 4, 3, padding=1)
+        x = _t(np.random.default_rng(0).standard_normal((1, 2, 6, 6)))
+        off = _t(np.zeros((1, 18, 6, 6)))
+        out = layer(x, off)
+        assert out.shape == [1, 4, 6, 6]
+        out.sum().backward()
+        assert layer.weight.grad is not None
+
+
+class TestYoloPriorCoder:
+    def test_yolo_box_shapes_and_range(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3 * 9, 4, 4)).astype("float32")
+        b, s = ops.yolo_box(_t(x), _t([[32, 32], [32, 32]], "int32"),
+                            [10, 13, 16, 30, 33, 23], 4, 0.005, 8)
+        assert b.shape == [2, 48, 4] and s.shape == [2, 48, 4]
+
+    def test_prior_box_count(self):
+        pb, pv = ops.prior_box(_t(np.zeros((1, 3, 4, 4))),
+                               _t(np.zeros((1, 3, 32, 32))),
+                               min_sizes=[8.0], aspect_ratios=[2.0],
+                               flip=True, clip=True)
+        assert pb.shape == [4, 4, 3, 4]    # 1 + 2 flipped ratios
+        assert float(pb.numpy().min()) >= 0.0
+        assert float(pb.numpy().max()) <= 1.0
+
+    def test_box_coder_roundtrip(self):
+        priors = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+        targets = np.array([[1, 1, 9, 9], [6, 4, 14, 16]], np.float32)
+        var = [1.0, 1.0, 1.0, 1.0]
+        enc = ops.box_coder(_t(priors), var, _t(targets),
+                            "encode_center_size", False).numpy()
+        diag = np.array([enc[i, i] for i in range(2)], np.float32)
+        dec = ops.box_coder(_t(priors), var, _t(diag[None]),
+                            "decode_center_size", False, axis=0).numpy()
+        np.testing.assert_allclose(dec[0], targets, atol=1e-4)
+
+
+class TestProposals:
+    def test_distribute_fpn_levels_and_restore(self):
+        rois = np.array([[0, 0, 10, 10], [0, 0, 100, 100],
+                         [0, 0, 300, 300]], np.float32)
+        multi, restore, nums = ops.distribute_fpn_proposals(
+            _t(rois), 2, 5, 4, 224)
+        assert len(multi) == 4
+        total = sum(int(n.numpy()[0]) for n in nums)
+        assert total == 3
+        # restore index maps concatenated-levels order back to input order
+        cat = np.concatenate([m.numpy() for m in multi if m.shape[0]])
+        np.testing.assert_allclose(cat[restore.numpy()[:, 0]], rois)
+
+    def test_distribute_fpn_per_image_counts(self):
+        rois = np.array([[0, 0, 10, 10], [0, 0, 300, 300],
+                         [0, 0, 100, 100]], np.float32)
+        multi, restore, nums = ops.distribute_fpn_proposals(
+            _t(rois), 2, 5, 4, 224, rois_num=_t([2, 1], "int32"))
+        for n in nums:
+            assert n.shape == [2]            # per-image counts
+        total = np.stack([n.numpy() for n in nums]).sum(0)
+        np.testing.assert_array_equal(total, [2, 1])
+
+    def test_generate_proposals(self):
+        rng = np.random.default_rng(0)
+        scores = rng.uniform(0, 1, (1, 3, 4, 4)).astype("float32")
+        deltas = rng.standard_normal((1, 12, 4, 4)).astype("float32") * 0.1
+        anchors = rng.uniform(0, 20, (48, 4)).astype("float32")
+        anchors[:, 2:] = anchors[:, :2] + 8
+        var = np.full((48, 4), 1.0, np.float32)
+        rois, probs, num = ops.generate_proposals(
+            _t(scores), _t(deltas), _t([[32.0, 32.0]]), _t(anchors),
+            _t(var), nms_thresh=0.7, min_size=1.0, return_rois_num=True)
+        assert rois.shape[1] == 4
+        assert int(num.numpy()[0]) == rois.shape[0]
+        assert probs.shape[0] == rois.shape[0]
+
+
+class TestCtc:
+    def _brute(self, lg, label, blank=0):
+        T, C = lg.shape
+        p = np.exp(lg - lg.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        tot = 0.0
+        for path in itertools.product(range(C), repeat=T):
+            seq, prev = [], -1
+            for c in path:
+                if c != blank and c != prev:
+                    seq.append(c)
+                prev = c
+            if seq == list(label):
+                pr = 1.0
+                for t, c in enumerate(path):
+                    pr *= p[t, c]
+                tot += pr
+        return -np.log(tot)
+
+    def test_matches_brute_force(self):
+        rng = np.random.default_rng(0)
+        lg = rng.standard_normal((5, 3, 4)).astype("float32")
+        labels = np.array([[1, 2], [3, 3], [2, 0]], np.int64)
+        llen = np.array([2, 2, 1], np.int64)
+        ilen = np.array([5, 4, 5], np.int64)
+        nll = F.ctc_loss(_t(lg), _t(labels, "int64"), _t(ilen, "int64"),
+                         _t(llen, "int64"), reduction="none").numpy()
+        for b in range(3):
+            want = self._brute(lg[:ilen[b], b], labels[b, :llen[b]])
+            np.testing.assert_allclose(nll[b], want, rtol=1e-4)
+
+    def test_gradient_finite_and_fd_checked(self):
+        rng = np.random.default_rng(1)
+        lg = rng.standard_normal((8, 4, 5)).astype("float32")
+        labels = rng.integers(1, 5, (4, 3))
+        args = (_t(labels, "int64"), _t(np.full(4, 8), "int64"),
+                _t(np.full(4, 3), "int64"))
+        t = _t(lg)
+        t.stop_gradient = False
+        loss = F.ctc_loss(t, *args)
+        loss.backward()
+        g = t.grad.numpy()
+        assert np.isfinite(g).all()
+        eps, i = 1e-3, (3, 2, 1)
+        lp, lm = lg.copy(), lg.copy()
+        lp[i] += eps
+        lm[i] -= eps
+        fd = (float(F.ctc_loss(_t(lp), *args).numpy()) -
+              float(F.ctc_loss(_t(lm), *args).numpy())) / (2 * eps)
+        np.testing.assert_allclose(g[i], fd, atol=1e-3)
+
+    def test_reductions(self):
+        rng = np.random.default_rng(2)
+        lg = rng.standard_normal((4, 2, 3)).astype("float32")
+        labels = np.array([[1, 2], [2, 1]], np.int64)
+        args = (_t(labels, "int64"), _t(np.full(2, 4), "int64"),
+                _t(np.full(2, 2), "int64"))
+        none = F.ctc_loss(_t(lg), *args, reduction="none").numpy()
+        s = float(F.ctc_loss(_t(lg), *args, reduction="sum").numpy())
+        m = float(F.ctc_loss(_t(lg), *args, reduction="mean").numpy())
+        np.testing.assert_allclose(s, none.sum(), rtol=1e-5)
+        np.testing.assert_allclose(m, (none / 2).mean(), rtol=1e-5)
+
+    def test_greedy_decode_collapses(self):
+        # path argmax: [1, 1, 0, 2] -> collapse -> [1, 2]
+        lg = np.full((4, 1, 3), -5.0, np.float32)
+        for t, c in enumerate([1, 1, 0, 2]):
+            lg[t, 0, c] = 5.0
+        dec, lens = F.ctc_decode(_t(lg))
+        assert list(dec.numpy()[0][:2]) == [1, 2]
+        assert int(lens.numpy()[0]) == 2
+
+    def test_layer(self):
+        rng = np.random.default_rng(3)
+        lg = rng.standard_normal((4, 2, 3)).astype("float32")
+        labels = np.array([[1, 2], [2, 1]], np.int64)
+        loss = nn.CTCLoss()(_t(lg), _t(labels, "int64"),
+                            _t(np.full(2, 4), "int64"),
+                            _t(np.full(2, 2), "int64"))
+        assert np.isfinite(float(loss.numpy()))
+
+
+class TestCrnn:
+    def test_crnn_shapes_and_ctc_training(self):
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.models import crnn_tiny
+
+        paddle.seed(0)
+        rng = np.random.default_rng(0)
+        n_cls, B, H, W = 5, 4, 16, 32
+        model = crnn_tiny(n_cls, img_height=H)
+        xs = np.zeros((B, 1, H, W), np.float32)
+        ys = np.zeros((B, 3), np.int64)
+        for b in range(B):
+            chars = rng.integers(1, n_cls, 3)
+            ys[b] = chars
+            for i, c in enumerate(chars):
+                xs[b, 0, :, i * 10:i * 10 + 8] = c / n_cls
+        logits = model(_t(xs))
+        assert logits.shape == [W // 4, B, n_cls]
+        ilen = _t(np.full(B, W // 4), "int64")
+        llen = _t(np.full(B, 3), "int64")
+        opt = optim.Adam(learning_rate=3e-3,
+                         parameters=model.parameters())
+        step = TrainStep(model, lambda lg, lb: F.ctc_loss(
+            lg, lb, ilen, llen), opt)
+        x, y = _t(xs), _t(ys, "int64")
+        losses = [float(step(x, y).numpy()) for _ in range(40)]
+        assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
