@@ -74,6 +74,7 @@ class StaticFunction:
         self._orig_fn = function
         self._layer = getattr(function, "__self__", None)
         self._input_spec = input_spec
+        self._graph_broken = False
         self._jitted = None
         self._n_params = 0
         self._param_tensors: List[Tensor] = []
@@ -156,6 +157,42 @@ class StaticFunction:
         self._out_tree_store = out_tree_store
 
     def __call__(self, *args, **kwargs):
+        # graph-break fallback (reference: SOT's graceful fallback,
+        # jit/sot/opcode_translator/executor/opcode_executor.py:1865): when
+        # the function's Python control flow needs concrete values, run it
+        # eagerly instead of failing.  The decision is cached PER INSTANCE
+        # (two instances of one Layer class may differ in whether their
+        # config trips the break — a shared code-object cache would strip
+        # compilation from the clean instance too).
+        if not _TO_STATIC_ENABLED or self._graph_broken or \
+                getattr(self._orig_fn, "_not_to_static", False):
+            return self._orig_fn(*args, **kwargs)
+        try:
+            return self._call_compiled(*args, **kwargs)
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.NonConcreteBooleanIndexError) as e:
+            self._graph_broken = True
+            import warnings
+            code = getattr(self._orig_fn, "__code__", None)
+            warn_key = code if code is not None else id(self)
+            if warn_key not in _GRAPH_BREAK_WARNED:
+                _GRAPH_BREAK_WARNED.add(warn_key)
+                name = getattr(self._orig_fn, "__qualname__", "<fn>")
+                warnings.warn(
+                    f"to_static: {name} needs concrete tensor values for "
+                    f"Python control flow and cannot be captured in one "
+                    f"graph ({type(e).__name__}); falling back to eager "
+                    f"execution for this function from now on.  Note the "
+                    f"body partially ran once during the failed capture — "
+                    f"Python side effects before the break happened twice "
+                    f"on this call.  Use lax-style ops (paddle.where, "
+                    f"masking) to keep it compiled.",
+                    stacklevel=2)
+            return self._orig_fn(*args, **kwargs)
+
+    def _call_compiled(self, *args, **kwargs):
         if self._jitted is None:
             self._build()
         leaves, treedef = jtu.tree_flatten((args, kwargs), is_leaf=_is_tensor)
@@ -224,6 +261,7 @@ def enable_to_static(flag: bool = True):
 
 
 _TO_STATIC_ENABLED = True
+_GRAPH_BREAK_WARNED = set()   # warn-once keys (code object or instance id)
 
 
 def ignore_module(modules):

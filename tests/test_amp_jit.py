@@ -73,6 +73,86 @@ class TestToStatic:
         snet = jit.to_static(net)
         np.testing.assert_allclose(_np(snet(x)), eager, rtol=1e-5)
 
+    def test_graph_break_falls_back_to_eager(self):
+        import warnings
+
+        @jit.to_static
+        def branchy(x):
+            # data-dependent Python control flow: untraceable
+            if float(x.sum().numpy() if hasattr(x.sum(), "numpy")
+                     else x.sum()) > 0:
+                return x * 2
+            return x - 1
+
+        xp = paddle.to_tensor(np.ones(3, "float32"))
+        xn = paddle.to_tensor(-np.ones(3, "float32"))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            np.testing.assert_allclose(_np(branchy(xp)), 2 * np.ones(3))
+        assert any("falling back to eager" in str(x.message) for x in w)
+        # decision cached: second call takes the branch correctly, silently
+        with warnings.catch_warnings(record=True) as w2:
+            warnings.simplefilter("always")
+            np.testing.assert_allclose(_np(branchy(xn)), -2 * np.ones(3))
+        assert not any("falling back" in str(x.message) for x in w2)
+        assert branchy._graph_broken
+
+    def test_graph_break_boolean_mask_indexing(self):
+        @jit.to_static
+        def masky(x):
+            return x[x > 0]          # canonical graph-break pattern
+
+        x = paddle.to_tensor(np.array([-1.0, 2.0, -3.0, 4.0], "float32"))
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = masky(x)
+        np.testing.assert_allclose(_np(out), [2.0, 4.0])
+
+    def test_graph_break_fallback_supports_backward(self):
+        w = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+
+        @jit.to_static
+        def f(x):
+            if float((x * w).sum().item()) > -1e9:   # always true, concrete
+                return (x * w).sum()
+            return x.sum()
+
+        x = paddle.to_tensor(np.arange(3, dtype="float32"))
+        loss = f(x)          # falls back to eager -> tape records
+        loss.backward()
+        np.testing.assert_allclose(_np(w.grad), np.arange(3, dtype="float32"))
+
+    def test_clean_function_still_compiles(self):
+        calls = []
+
+        @jit.to_static
+        def clean(a):
+            calls.append(1)          # python body runs only while tracing
+            return a * 3 + 1
+
+        x = paddle.to_tensor(np.ones(4, "float32"))
+        for _ in range(3):
+            np.testing.assert_allclose(_np(clean(x)), 4 * np.ones(4))
+        assert len(calls) == 1       # traced once, then cached XLA program
+
+    def test_enable_to_static_flag(self):
+        calls = []
+
+        @jit.to_static
+        def g(a):
+            calls.append(1)
+            return a + 1
+
+        x = paddle.to_tensor(np.zeros(2, "float32"))
+        jit.enable_to_static(False)
+        try:
+            g(x)
+            g(x)
+            assert len(calls) == 2   # eager: body runs every call
+        finally:
+            jit.enable_to_static(True)
+
     def test_function_decorator(self):
         @jit.to_static
         def f(a, b):
