@@ -60,16 +60,37 @@ class SharedLayerDesc(LayerDesc):
         self.shared_weight_attr = shared_weight_attr
 
 
-def _pp_mesh(mesh: Optional[ProcessMesh], axis: str):
+def _pp_mesh(mesh: Optional[ProcessMesh], axis: str,
+             num_stages: Optional[int] = None):
+    """Resolve the pipeline mesh; an auto-discovered mesh whose pp-axis size
+    disagrees with an explicit ``num_stages`` is replaced by a fresh
+    num_stages-device mesh (sharding a size-S stage dim over more devices
+    than S is unsatisfiable)."""
+    def ok(m, ax):
+        return num_stages is None or m.get_dim_size(ax) == num_stages
+
     if mesh is not None:
         return mesh, axis
     hcg = get_hybrid_communicate_group()
-    if hcg is not None:
-        return hcg.mesh, "pp"
+    if hcg is not None and "pp" in hcg.mesh.dim_names:
+        if not ok(hcg.mesh, "pp") and hcg.mesh.get_dim_size("pp") > 1:
+            raise ValueError(
+                f"num_stages={num_stages} conflicts with the configured "
+                f"hybrid topology (pp degree "
+                f"{hcg.mesh.get_dim_size('pp')}); drop num_stages or fix "
+                f"the fleet strategy")
+        if ok(hcg.mesh, "pp"):
+            return hcg.mesh, "pp"
     m = get_mesh()
     if m is not None and axis in m.dim_names:
-        return m, axis
-    n = jax.device_count()
+        if ok(m, axis):
+            return m, axis
+        import warnings
+        warnings.warn(
+            f"global mesh axis {axis!r} has size {m.get_dim_size(axis)} != "
+            f"num_stages={num_stages}; building a private "
+            f"{num_stages}-device pipeline mesh instead")
+    n = num_stages or jax.device_count()
     return ProcessMesh(np.arange(n), [axis]), axis
 
 
@@ -105,9 +126,15 @@ class PipelineStack(Layer):
                  pp_axis: str = "pp", schedule: str = "1F1B",
                  remat: bool = False, num_virtual_stages: int = 1):
         super().__init__()
-        mesh, axis = _pp_mesh(mesh, pp_axis)
+        mesh, axis = _pp_mesh(mesh, pp_axis, num_stages)
         self._mesh, self._axis = mesh, axis
         self.num_stages = num_stages or mesh.get_dim_size(axis)
+        if mesh.get_dim_size(axis) != self.num_stages:
+            raise ValueError(
+                f"mesh axis {axis!r} has {mesh.get_dim_size(axis)} devices "
+                f"but num_stages={self.num_stages}; a size-S stage ring "
+                f"cannot run on a different-size axis")
+        self._compiled_cache = {}
         if schedule not in SCHEDULES:
             raise ValueError(f"schedule must be one of {SCHEDULES}")
         if schedule == "VPP" and num_virtual_stages == 1:
@@ -165,72 +192,132 @@ class PipelineStack(Layer):
             for n, a in zip(names, saved):
                 params_of[n]._data = a
 
+    def schedule_stats(self):
+        """Per-stage busy/idle accounting of the EXECUTED schedule (same
+        formula the compiled loop evaluates — not an estimate).
+
+        ``relative_step_time`` is in units of one full-depth stage pass
+        (ticks x per-tick cost 1/v): the number the interleaved schedule
+        shrinks.  reference: the bubble analysis in
+        fleet/meta_parallel/pipeline_parallel.py:1179 (interleaved 1F1B)."""
+        S, M, v = self.num_stages, self.num_microbatches, \
+            self.num_virtual_stages
+        if v > 1 and M % S != 0:
+            raise ValueError(
+                f"interleaved schedule needs num_microbatches ({M}) "
+                f"divisible by num_stages ({S}) — these stats would "
+                f"describe a schedule forward() refuses to run")
+        n_groups = -(-M // S)
+        GV = n_groups * v
+        T = GV * S + S
+        busy = np.zeros(S, np.int64)
+        for t in range(T):
+            for s in range(S):
+                u = t - s
+                G, i = u // S, u % S
+                if u >= 0 and G < GV and (G // v) * S + i < M:
+                    busy[s] += 1
+        return {
+            "schedule": self.schedule,
+            "ticks": T,
+            "per_stage_busy_ticks": busy.tolist(),
+            "per_stage_utilization": (busy / T).round(4).tolist(),
+            "bubble_fraction": round(1.0 - float(busy.sum()) / (T * S), 4),
+            "relative_step_time": round(T / v, 2),
+        }
+
     def forward(self, x):
-        """x: (microbatches, mb_size, ...) or (batch, ...) auto-split."""
+        """x: (microbatches, mb_size, ...) or (batch, ...) auto-split.
+
+        One compiled circular-pipeline loop for every schedule (the
+        interleaved assignment of pipeline_parallel.py:1179): microbatches
+        are processed in chunk groups — unit (microbatch m, chunk j) is
+        handled by physical stage s at tick t = (group(m)*v + j)*S + (m%S)
+        + s, wrapping S-1 → 0 via the circular ppermute to enter the next
+        chunk.  With v virtual chunks the per-tick cost is 1/v of a full
+        stage, so the fill/drain bubble shrinks from (S-1) to (S-1)/v full-
+        stage units — the real VPP win, visible in wall-clock, not a remat
+        relabel."""
         M = self.num_microbatches
-        stages = self.num_stages
+        S = self.num_stages
+        v = self.num_virtual_stages
         mesh, axis = self._mesh, self._axis
+        if v > 1 and M % S != 0:
+            raise ValueError(
+                f"interleaved schedule needs num_microbatches ({M}) "
+                f"divisible by num_stages ({S}) — reference constraint "
+                f"(pipeline_parallel.py interleaved 1F1B)")
+        n_groups = -(-M // S)          # ceil; tail units masked when v == 1
+        GV = n_groups * v
+        T = GV * S + S                 # + S: final wrapped outputs arrive
         param_tensors = [self._parameters[n.replace(".", "__")]
                          for n in self._param_names]
+        # ONE jitted program per ndim (shape changes retrace inside the same
+        # jit cache; a fresh closure per call would recompile every step)
+        cached = self._compiled_cache.get(x.ndim)
+        if cached is not None:
+            return call_op("pipeline_stack", cached,
+                           (tuple(param_tensors), x), {})
 
         def run(params, xs):
             # params leaves: (virtual, 1, layers_per_stage, ...) local to
             # this stage; xs: full (M, mb, ...) replicated
             r = lax.axis_index(axis)
 
-            def chunk_pipeline(xs, chunk_params):
-                def stage_fn(h):
-                    def scan_body(carry, layer_params):
-                        out = self._block_apply(layer_params, carry)
-                        return out, None
-                    if self.remat:
-                        body = jax.checkpoint(scan_body)
-                    else:
-                        body = scan_body
-                    out, _ = lax.scan(body, h, chunk_params)
-                    return out
+            def stage_block(h, chunk_params):
+                def scan_body(carry, layer_params):
+                    out = self._block_apply(layer_params, carry)
+                    return out, None
+                body = jax.checkpoint(scan_body) if self.remat else scan_body
+                out, _ = lax.scan(body, h, chunk_params)
+                return out
 
-                if self.schedule in ("1F1B", "ZB"):
-                    # per-microbatch remat: backward re-runs each stage's
-                    # forward from the stage-boundary activation — peak
-                    # activation memory O(stages), the 1F1B footprint
-                    stage_fn = jax.checkpoint(stage_fn)
+            if self.schedule in ("1F1B", "ZB", "VPP"):
+                # per-unit remat: backward re-runs each stage pass from the
+                # stage-boundary activation — peak activations O(stages),
+                # the 1F1B footprint.  FThenB stores everything (GPipe).
+                stage_block = jax.checkpoint(stage_block)
 
-                mb_shape = xs.shape[1:]
-                state = jnp.zeros(mb_shape, xs.dtype)
-                outputs = jnp.zeros((M,) + mb_shape, xs.dtype)
-                perm = [(i, i + 1) for i in range(stages - 1)]
+            mb_shape = xs.shape[1:]
+            state = jnp.zeros(mb_shape, xs.dtype)
+            outputs = jnp.zeros((M,) + mb_shape, xs.dtype)
+            perm = [(i, (i + 1) % S) for i in range(S)]   # circular
 
-                def step(t, carry):
-                    state, outputs = carry
-                    # stage 0 ingests microbatch t; others use what arrived
-                    inp = jnp.where(r == 0, xs[jnp.minimum(t, M - 1)], state)
-                    h = stage_fn(inp)
-                    # last stage commits result for microbatch t-(stages-1)
-                    done_idx = t - (stages - 1)
-                    valid = ((r == stages - 1) & (done_idx >= 0)
-                             & (done_idx < M))
-                    outputs = lax.cond(
-                        valid,
-                        lambda o: o.at[jnp.maximum(done_idx, 0)].set(h),
-                        lambda o: o, outputs)
-                    state = lax.ppermute(h, axis, perm)
-                    return state, outputs
+            def step(carry, t):
+                state, outputs = carry
+                u = t - r
+                G = u // S
+                i = u % S
+                j = jnp.clip(G, 0, GV - 1) % v
+                m = (jnp.clip(G, 0, GV - 1) // v) * S + i
+                # collect BEFORE compute: the arriving state at stage 0 is
+                # what stage S-1 wrapped at t-1; it completed chunk v-1 iff
+                # (t//S) % v == 0 with its group in range
+                Ga = t // S - 1
+                m_done = (jnp.clip(Ga, 0, GV - 1) // v) * S + t % S
+                collect = ((r == 0) & (Ga >= 0) & (Ga < GV)
+                           & (Ga % v == (v - 1)) & (m_done < M))
+                outputs = lax.cond(
+                    collect,
+                    lambda o: o.at[jnp.minimum(m_done, M - 1)].set(state),
+                    lambda o: o, outputs)
+                # stage 0 injects a fresh microbatch when its unit opens
+                # chunk 0; wrapped units (j > 0) continue from the arrival
+                inject = (r == 0) & (j == 0)
+                inp = jnp.where(inject, xs[jnp.clip(m, 0, M - 1)], state)
+                chunk_params = [lax.dynamic_index_in_dim(p[:, 0], j, 0,
+                                                         keepdims=False)
+                                for p in params]
+                h = stage_block(inp, chunk_params)
+                state = lax.ppermute(h, axis, perm)
+                return (state, outputs), None
 
-                _, outputs = lax.fori_loop(0, M + stages - 1, step,
-                                           (state, outputs))
-                # broadcast result from the last stage (out replicated)
-                outputs = lax.psum(
-                    jnp.where(r == stages - 1, outputs,
-                              jnp.zeros_like(outputs)), axis)
-                return outputs
-
-            out = xs
-            # virtual chunks chain: chunk j's last stage feeds chunk j+1's
-            # first stage (interleaved VPP mapping when virtual > 1)
-            for j in range(self.num_virtual_stages):
-                out = chunk_pipeline(out, [p[j][0] for p in params])
-            return out
+            (_, outputs), _ = lax.scan(step, (state, outputs),
+                                       jnp.arange(T))
+            # broadcast result from stage 0 (where completed units arrive)
+            outputs = lax.psum(
+                jnp.where(r == 0, outputs, jnp.zeros_like(outputs)), axis)
+            return outputs
 
         def spec_for(p):
             s = [None] * p.ndim
@@ -245,6 +332,7 @@ class PipelineStack(Layer):
         # program anyway
         fn = jax.jit(shard_map(run, mesh=mesh.jax_mesh, in_specs=in_specs,
                                out_specs=out_specs, check_vma=False))
+        self._compiled_cache[x.ndim] = fn
         out = call_op("pipeline_stack", fn, (tuple(param_tensors), x), {})
         return out
 
@@ -260,7 +348,7 @@ class PipelineLayer(Layer):
                  num_virtual_pipeline_stages=None, mesh=None, pp_axis="pp",
                  num_microbatches=1, schedule="1F1B"):
         super().__init__()
-        mesh, axis = _pp_mesh(mesh, pp_axis)
+        mesh, axis = _pp_mesh(mesh, pp_axis, num_stages)
         self._mesh, self._axis = mesh, axis
         self.num_stages = num_stages or mesh.get_dim_size(axis)
         self._loss_fn = loss_fn

@@ -287,21 +287,76 @@ class TestPipeline:
             out.append(hm)
         return np.stack([np.asarray(o) for o in out])
 
-    @pytest.mark.parametrize("schedule,virtual",
-                             [("FThenB", 1), ("1F1B", 1), ("ZB", 1),
-                              ("VPP", 2)])
-    def test_schedules_match_sequential(self, schedule, virtual):
+    @pytest.mark.parametrize("schedule,virtual,mbs",
+                             [("FThenB", 1, 3), ("1F1B", 1, 3), ("ZB", 1, 3),
+                              ("VPP", 2, 4), ("VPP", 3, 6), ("1F1B", 2, 4)])
+    def test_schedules_match_sequential(self, schedule, virtual, mbs):
+        # interleaved (virtual > 1) requires M % S == 0, the reference's
+        # constraint; v=1 schedules accept any M (tail masked)
+        from paddle_tpu.distributed.fleet.pipeline_parallel import (
+            PipelineStack)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["pp", "dp"])
+        stack = PipelineStack(lambda: nn.Linear(8, 8),
+                              num_layers=2 * virtual * 2,
+                              num_stages=2, num_microbatches=mbs, mesh=mesh,
+                              schedule=schedule,
+                              num_virtual_stages=virtual)
+        x = np.random.randn(mbs, 2, 8).astype("float32")  # (M, mb, feat)
+        y = stack(paddle.to_tensor(x))
+        ref = self._stack_reference(stack, x)
+        np.testing.assert_allclose(_np(y), ref, atol=1e-4)
+
+    def test_interleaved_requires_divisible_microbatches(self):
         from paddle_tpu.distributed.fleet.pipeline_parallel import (
             PipelineStack)
         mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["pp", "dp"])
         stack = PipelineStack(lambda: nn.Linear(8, 8), num_layers=4,
                               num_stages=2, num_microbatches=3, mesh=mesh,
-                              schedule=schedule,
-                              num_virtual_stages=virtual)
-        x = np.random.randn(3, 2, 8).astype("float32")  # (M, mb, feat)
-        y = stack(paddle.to_tensor(x))
-        ref = self._stack_reference(stack, x)
-        np.testing.assert_allclose(_np(y), ref, atol=1e-4)
+                              schedule="VPP", num_virtual_stages=2)
+        with pytest.raises(ValueError):
+            stack(paddle.to_tensor(np.zeros((3, 2, 8), "float32")))
+
+    def test_pipeline_program_cached_across_steps(self):
+        from paddle_tpu.distributed.fleet.pipeline_parallel import (
+            PipelineStack)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["pp", "dp"])
+        stack = PipelineStack(lambda: nn.Linear(8, 8), num_layers=2,
+                              num_stages=2, num_microbatches=2, mesh=mesh)
+        x = paddle.to_tensor(np.random.randn(2, 2, 8).astype("float32"))
+        with paddle.no_grad():    # inference path hits the executable cache
+            stack(x)
+            stack(x)
+            stack(x)
+        assert len(stack._compiled_cache) == 1
+        # one trace for the repeated shape — no per-step recompilation
+        # (training re-linearizes under the eager tape: wrap the step in
+        # jit.TrainStep for one-compile training)
+        assert stack._compiled_cache[3]._cache_size() == 1
+
+    def test_mismatched_explicit_mesh_rejected(self):
+        from paddle_tpu.distributed.fleet.pipeline_parallel import (
+            PipelineStack)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["pp", "dp"])
+        with pytest.raises(ValueError):
+            PipelineStack(lambda: nn.Linear(8, 8), num_layers=4,
+                          num_stages=4, mesh=mesh)   # pp axis is size 2
+
+    def test_schedule_stats_vpp_shrinks_bubble(self):
+        from paddle_tpu.distributed.fleet.pipeline_parallel import (
+            PipelineStack)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["pp", "dp"])
+        plain = PipelineStack(lambda: nn.Linear(8, 8), num_layers=4,
+                              num_stages=2, num_microbatches=4, mesh=mesh,
+                              schedule="1F1B")
+        vpp = PipelineStack(lambda: nn.Linear(8, 8), num_layers=4,
+                            num_stages=2, num_microbatches=4, mesh=mesh,
+                            schedule="VPP", num_virtual_stages=2)
+        sp, sv = plain.schedule_stats(), vpp.schedule_stats()
+        # interleaving cuts fill/drain: fewer full-stage units of wall time
+        assert sv["relative_step_time"] < sp["relative_step_time"], (sp, sv)
+        assert sv["bubble_fraction"] < sp["bubble_fraction"] + 1e-9
+        # every stage does exactly M*v useful ticks
+        assert all(b == 4 * 2 for b in sv["per_stage_busy_ticks"])
 
     def test_schedule_backward(self):
         from paddle_tpu.distributed.fleet.pipeline_parallel import (

@@ -1,0 +1,117 @@
+"""Pipeline-schedule benchmark: FThenB vs 1F1B vs VPP step time + compiled
+peak memory on the 8-virtual-device CPU mesh (VERDICT r2 task 7; reference
+analog: the schedule comparisons in fleet/meta_parallel/pipeline_parallel.py
+and passes/pipeline_scheduler_pass/).
+
+Prints one JSON line per schedule:
+  {"schedule", "virtual", "fwd_ms", "train_ms", "temp_mib", "ticks",
+   "bubble_fraction", "relative_step_time"}
+
+What to expect and why:
+- 1F1B vs FThenB: same tick count (memory policies differ) — temp_mib drops,
+  step time about the same or slightly higher (remat recompute).
+- VPP vs 1F1B: fewer full-stage units of wall time (bubble/v) — fwd/train
+  time drops while temp stays in the 1F1B regime.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed.fleet.pipeline_parallel import (  # noqa: E402
+    PipelineStack,
+)
+
+
+S = 8           # stages = devices
+LAYERS = 16     # transformer-ish depth; divisible by S*v for v in {1, 2}
+M = 16          # microbatches (divisible by S for interleaving)
+MB, D = 4, 512  # microbatch size x width — big enough to dominate overhead
+
+
+def block():
+    return nn.Sequential(nn.Linear(D, 4 * D), nn.GELU(), nn.Linear(4 * D, D))
+
+
+def measure(schedule, virtual):
+    mesh = dist.ProcessMesh(np.arange(8), dim_names=["pp"])
+    stack = PipelineStack(block, num_layers=LAYERS, num_stages=S,
+                          num_microbatches=M, mesh=mesh, schedule=schedule,
+                          num_virtual_stages=virtual)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((M, MB, D))
+        .astype("float32"))
+
+    def timed(fn, reps=3):
+        fn()                       # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        jax.block_until_ready(out._data if hasattr(out, "_data") else out)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    def fwd():
+        with paddle.no_grad():     # inference path: cached executable
+            return stack(x)
+
+    fwd_ms = timed(fwd)
+
+    # training through the framework's whole-step compilation (TrainStep) —
+    # forward + backward + update in ONE cached XLA program
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStep
+
+    opt = optim.SGD(learning_rate=1e-3, parameters=stack.parameters())
+    step = TrainStep(stack, lambda y, _label: (y * y).mean(), opt)
+    zero = paddle.to_tensor(np.zeros(1, np.float32))
+    train_ms = timed(lambda: step(x, zero), reps=2)
+
+    # compiled peak temp memory of the differentiated whole-step program
+    import jax.numpy as jnp
+    params = [stack._parameters[n.replace(".", "__")]._data
+              for n in stack._param_names]
+
+    def loss_of(params_arrays, xs):
+        saved = [stack._parameters[n.replace(".", "__")]._data
+                 for n in stack._param_names]
+        try:
+            for n, a in zip(stack._param_names, params_arrays):
+                stack._parameters[n.replace(".", "__")]._data = a
+            from paddle_tpu.framework.tape import no_grad
+            with no_grad():
+                y = stack(paddle.to_tensor(xs))
+            return (y._data.astype(jnp.float32) ** 2).mean()
+        finally:
+            for n, a in zip(stack._param_names, saved):
+                stack._parameters[n.replace(".", "__")]._data = a
+
+    lowered = jax.jit(jax.grad(loss_of)).lower(params, x._data)
+    mem = lowered.compile().memory_analysis()
+    temp_mib = getattr(mem, "temp_size_in_bytes", 0) / 2**20
+
+    stats = stack.schedule_stats()
+    print(json.dumps({
+        "schedule": schedule, "virtual": virtual,
+        "fwd_ms": round(fwd_ms, 1), "train_ms": round(train_ms, 1),
+        "temp_mib": round(temp_mib, 1),
+        "ticks": stats["ticks"],
+        "bubble_fraction": stats["bubble_fraction"],
+        "relative_step_time": stats["relative_step_time"],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    for schedule, virtual in (("FThenB", 1), ("1F1B", 1), ("VPP", 2)):
+        measure(schedule, virtual)
