@@ -76,6 +76,12 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
         return placements, mesh
 
     optimizer = shard_optimizer(optimizer, state_shard_fn)
+    # stamp the stage so whole-step compilation (jit.TrainStep) can apply
+    # the stage's GRADIENT placement: os_g/p_g_os land grads sharded
+    # (reduce-scatter pattern, group_sharded_optimizer_stage2.py:53) while
+    # os keeps full grads — an observable compiled-memory difference
+    optimizer._sharding_level = level
+    optimizer._sharding_mesh = (mesh, axis)
     return model, optimizer, scaler
 
 

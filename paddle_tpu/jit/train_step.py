@@ -36,6 +36,18 @@ def _to_array(x):
     return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
+def _keep(arr):
+    """An array's NamedSharding, or None (single-device / no placement)."""
+    from jax.sharding import NamedSharding
+    sh = getattr(arr, "sharding", None)
+    return sh if isinstance(sh, NamedSharding) else None
+
+
+def _pin(x, sh):
+    return x if x is None or sh is None else \
+        jax.lax.with_sharding_constraint(x, sh)
+
+
 class TrainStep:
     """Compile model+loss+optimizer into a single donated-buffer XLA step.
 
@@ -126,6 +138,16 @@ class TrainStep:
 
             (loss, outs), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(arrays)
+            # ZeRO stage-2/3 gradient placement: when a param's optimizer
+            # state is sharded, land its gradient with the SAME sharding
+            # (XLA lowers the grad psum to reduce-scatter — the pattern the
+            # reference's stage-2 implements by hand,
+            # group_sharded_optimizer_stage2.py:53).  Derived from the
+            # state shardings so any shard_optimizer user gets it; a
+            # group_sharded level of 'os' (stage-1) opts out — full grads
+            # are that stage's definition.
+            if getattr(opt, "_sharding_level", None) != "os":
+                grads = [_pin(g, s) for g, s in zip(grads, grad_shardings)]
             if grad_clip is not None:
                 # real Parameter objects, not bare wraps: the clip consults
                 # per-param flags (need_clip) that live on the Parameter
@@ -136,16 +158,39 @@ class TrainStep:
                 grads = [g._data for _, g in clipped]
             new_arrays, new_states, new_masters = update_fn(
                 lr, stepno, arrays, grads, states, masters)
+            # pin outputs to their INITIAL placements: donated-buffer steps
+            # otherwise drift to whatever GSPMD chose (e.g. ZeRO-1 params
+            # silently becoming sharded after one step, erasing the
+            # stage-1/2 vs stage-3 distinction and surprising eager readers)
+            new_arrays = [_pin(a, s)
+                          for a, s in zip(new_arrays, param_shardings)]
+            new_states = {k: [_pin(a, s) for a, s in
+                              zip(new_states[k], state_shardings[k])]
+                          for k in new_states}
+            new_masters = [_pin(a, s)
+                           for a, s in zip(new_masters, master_shardings)]
             return loss, outs, new_arrays, new_states, new_masters
+
+        param_shardings = [_keep(a) for a in self._arrays]
+        state_shardings = {k: [_keep(a) for a in v]
+                           for k, v in self._states.items()}
+        master_shardings = [_keep(m) for m in self._masters]
+        # grad placement follows the param's sharded state (or master) —
+        # the gradient's consumer
+        grad_shardings = []
+        for i in range(len(self._arrays)):
+            sh = next((state_shardings[k][i] for k in self._states
+                       if state_shardings[k][i] is not None), None)
+            grad_shardings.append(sh or master_shardings[i])
 
         self._compiled = jax.jit(pure_step, donate_argnums=(0, 1, 2),
                                  static_argnums=(8,))
 
     # ------------------------------------------------------------------- call
-    def __call__(self, inputs, labels=()):
-        """One fused train step.  ``inputs``/``labels`` are a Tensor/array or
-        (possibly nested) tuple/list of them; returns the scalar loss Tensor
-        (device value — no host sync unless you read it)."""
+    def _prepare_args(self, inputs, labels):
+        """Flatten user inputs/labels the way the compiled step expects —
+        shared by __call__ and memory_analysis so their signatures cannot
+        diverge."""
         if self._compiled is None:
             self._build()
         if not isinstance(inputs, (list, tuple)):
@@ -159,6 +204,14 @@ class TrainStep:
         in_leaves = [_to_array(x) for x in in_leaves]
         label_leaves = [_to_array(x) for x in label_leaves]
         frozen = [p._data for p in self._frozen_params]
+        return in_leaves, label_leaves, (in_tree, label_tree), frozen
+
+    def __call__(self, inputs, labels=()):
+        """One fused train step.  ``inputs``/``labels`` are a Tensor/array or
+        (possibly nested) tuple/list of them; returns the scalar loss Tensor
+        (device value — no host sync unless you read it)."""
+        in_leaves, label_leaves, treedefs, frozen = self._prepare_args(
+            inputs, labels)
 
         opt = self.optimizer
         opt._global_step += 1
@@ -168,10 +221,49 @@ class TrainStep:
         loss, outs, self._arrays, self._states, self._masters = \
             self._compiled(self._arrays, self._states, self._masters,
                            frozen, lr, stepno, in_leaves, label_leaves,
-                           (in_tree, label_tree))
+                           treedefs)
         self._last_outputs = [wrap_array(o) for o in outs]
         self._last_loss = wrap_array(loss)
         return self._last_loss
+
+    # -------------------------------------------------------------- analysis
+    def memory_analysis(self, inputs, labels=(), return_hlo=False):
+        """Per-device compiled memory profile of the whole train step
+        (argument/output/temp/alias bytes) — the observability the
+        reference's sharding stages expose through max_memory_allocated.
+        ZeRO stage differences are visible here: stage-3 shrinks the donated
+        parameter arguments, stage-2 shrinks gradient temps.
+
+        Memoized per input-shape signature: repeat calls (periodic
+        monitoring) don't pay a whole-step recompile."""
+        in_leaves, label_leaves, treedefs, frozen = self._prepare_args(
+            inputs, labels)
+        key = (tuple((a.shape, str(a.dtype))
+                     for a in in_leaves + label_leaves),
+               treedefs, bool(return_hlo))
+        cached = getattr(self, "_mem_cache", {}).get(key)
+        if cached is not None:
+            return dict(cached)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        stepno = jnp.asarray(self.optimizer._global_step + 1, jnp.int32)
+        lowered = self._compiled.lower(
+            self._arrays, self._states, self._masters, frozen, lr, stepno,
+            in_leaves, label_leaves, treedefs)
+        mem = lowered.compile().memory_analysis()
+        out = {
+            "argument_bytes": getattr(mem, "argument_size_in_bytes", 0),
+            "output_bytes": getattr(mem, "output_size_in_bytes", 0),
+            "temp_bytes": getattr(mem, "temp_size_in_bytes", 0),
+            "alias_bytes": getattr(mem, "alias_size_in_bytes", 0),
+            "generated_code_bytes": getattr(
+                mem, "generated_code_size_in_bytes", 0),
+        }
+        if return_hlo:
+            out["hlo"] = lowered.as_text()
+        if not hasattr(self, "_mem_cache"):
+            self._mem_cache = {}
+        self._mem_cache[key] = dict(out)
+        return out
 
     # ------------------------------------------------------------------- sync
     def sync(self):
