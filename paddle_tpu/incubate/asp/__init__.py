@@ -1,0 +1,160 @@
+"""Automatic SParsity (n:m structured pruning).
+
+Capability parity: python/paddle/incubate/asp/asp.py + supported_layer_list
+— calculate_density, decorate (sparsity-preserving optimizer wrapper),
+prune_model (mask_1d / mask_2d_greedy n:m masks), excluded-layer registry,
+check_sparsity.
+
+TPU note: n:m masks are kept as multiplicative weight masks (the reference's
+ASP masks feed Ampere sparse tensor cores; on TPU the win is model-size /
+regularization — the masks and training flow are identical)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_EXCLUDED: Dict[int, List[str]] = {}
+_MASKS: Dict[int, "np.ndarray"] = {}   # id(param) -> mask
+
+
+def calculate_density(x) -> float:
+    """reference: asp.py calculate_density — nonzero fraction."""
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _compute_mask_1d(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Per row, per consecutive group of m: keep the n largest |values|."""
+    rows, cols = mat.shape
+    pad = (-cols) % m
+    padded = np.pad(np.abs(mat), ((0, 0), (0, pad)))
+    groups = padded.reshape(rows, -1, m)
+    order = np.argsort(-groups, axis=-1)
+    mask = np.zeros_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[..., :n], True, axis=-1)
+    return mask.reshape(rows, -1)[:, :cols]
+
+
+def _compute_mask_2d_greedy(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Greedy m x m block mask: keep n entries per row AND per column of
+    each block (reference mask_2d_greedy)."""
+    rows, cols = mat.shape
+    pr, pc = (-rows) % m, (-cols) % m
+    padded = np.pad(np.abs(mat), ((0, pr), (0, pc)))
+    out = np.zeros_like(padded, dtype=bool)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = padded[bi:bi + m, bj:bj + m]
+            mask = np.zeros((m, m), bool)
+            row_cnt = np.zeros(m, int)
+            col_cnt = np.zeros(m, int)
+            for idx in np.argsort(-block, axis=None):
+                r, c = divmod(int(idx), m)
+                if row_cnt[r] < n and col_cnt[c] < n:
+                    mask[r, c] = True
+                    row_cnt[r] += 1
+                    col_cnt[c] += 1
+            out[bi:bi + m, bj:bj + m] = mask
+    return out[:rows, :cols]
+
+
+_MASK_ALGOS = {
+    "mask_1d": _compute_mask_1d,
+    "mask_2d_greedy": _compute_mask_2d_greedy,
+    "mask_2d_best": _compute_mask_2d_greedy,   # greedy stands in for best
+}
+
+
+def set_excluded_layers(param_names, main_program=None, model=None):
+    """reference: asp.set_excluded_layers."""
+    _EXCLUDED.setdefault(id(main_program or model), []).extend(param_names)
+    _EXCLUDED.setdefault(0, []).extend(param_names)
+
+
+def reset_excluded_layers(main_program=None, model=None):
+    _EXCLUDED.pop(id(main_program or model), None)
+    _EXCLUDED.pop(0, None)
+
+
+def _prunable(name: str, p) -> bool:
+    if p is None or not getattr(p, "trainable", True):
+        return False
+    excluded = _EXCLUDED.get(0, [])
+    if any(e in name for e in excluded):
+        return False
+    if p.ndim == 2:
+        return p.shape[0] >= 4 and p.shape[1] >= 4
+    if p.ndim == 4:
+        return True
+    return False
+
+
+def _as_2d(arr: np.ndarray):
+    if arr.ndim == 2:
+        return arr, None
+    shape = arr.shape
+    return arr.reshape(shape[0], -1), shape
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """reference: asp.prune_model — compute and apply n:m masks on
+    supported weights (Linear 2-D, Conv 4-D flattened); masks are retained
+    so ``decorate``-d optimizers re-apply them every step."""
+    import jax.numpy as jnp
+    if mask_algo not in _MASK_ALGOS:
+        raise ValueError(f"mask_algo must be one of {list(_MASK_ALGOS)}")
+    algo = _MASK_ALGOS[mask_algo]
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        arr = np.asarray(p.numpy())
+        mat, orig_shape = _as_2d(arr)
+        mask2d = algo(mat, n, m)
+        mask = mask2d if orig_shape is None else mask2d.reshape(orig_shape)
+        p._data = jnp.asarray(arr * mask)
+        if with_mask:
+            _MASKS[id(p)] = mask
+            masks[name] = mask
+    return masks
+
+
+def check_sparsity(model, n=2, m=4) -> bool:
+    """True iff every pruned weight satisfies the n:m pattern."""
+    for name, p in model.named_parameters():
+        mask = _MASKS.get(id(p))
+        if mask is None:
+            continue
+        arr = np.asarray(p.numpy())
+        mat, _ = _as_2d(arr != 0)
+        cols = mat.shape[1] - mat.shape[1] % m
+        groups = mat[:, :cols].reshape(mat.shape[0], -1, m)
+        if (groups.sum(-1) > n).any():
+            return False
+    return True
+
+
+class OptimizerWithSparsityGuarantee:
+    """reference: asp.py decorate — after every step, re-apply the masks so
+    updates cannot resurrect pruned weights."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        import jax.numpy as jnp
+        self._optimizer.step()
+        for p in self._optimizer._parameter_list:
+            mask = _MASKS.get(id(p))
+            if mask is not None:
+                p._data = p._data * jnp.asarray(
+                    mask, p._data.dtype)
+
+
+def decorate(optimizer):
+    """reference: asp.decorate."""
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -14,7 +14,8 @@ from .layer.common import (  # noqa: F401
     Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
     Unflatten, Pad1D, Pad2D, Pad3D, ZeroPad2D, Upsample,
     UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle, PixelUnshuffle,
-    Bilinear, CosineSimilarity, Unfold,
+    Bilinear, CosineSimilarity, Unfold, Fold, MaxUnPool2D, ChannelShuffle,
+    SpectralNorm,
 )
 from .layer.conv_pool import (  # noqa: F401
     Conv1D, Conv2D, Conv3D, Conv2DTranspose, MaxPool1D, MaxPool2D, AvgPool1D,

@@ -1052,3 +1052,15 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
 
 
 from .ctc import ctc_loss, ctc_decode  # noqa: E402,F401
+from .extra import (  # noqa: E402,F401
+    nearest_interp, bilinear_interp, bicubic_interp, linear_interp,
+    trilinear_interp, affine_grid, grid_sample, fold,
+    max_pool2d_with_index, max_unpool2d, lp_pool2d, channel_shuffle,
+    tanh_shrink, thresholded_relu, swiglu, rrelu,
+    sigmoid_cross_entropy_with_logits, hinge_loss, log_loss, identity_loss,
+    hsigmoid_loss, margin_cross_entropy, class_center_sample,
+    fused_softmax_mask, fused_softmax_mask_upper_triangle,
+    pad3d, fractional_max_pool2d, affine_channel, shuffle_channel,
+    bce_loss, kldiv_loss, logsigmoid, max_unpool3d, l2_normalize, ctc_align,
+)
+from . import extra  # noqa: E402,F401

@@ -1,0 +1,492 @@
+"""Long-tail nn functionals (reference: ops.yaml + nn/functional rows with
+no prior mapping — interpolation family, grid sampling, fold/unpool, extra
+activations and losses).  MXU-friendly formulations: interpolation via
+jax.image, grid_sample as a vectorized bilinear gather, fold as the im2col
+transpose."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import def_op
+from ...framework.random import split_key
+from ...framework.tensor import Tensor
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+# ------------------------------------------------------------ interpolation
+def _resize(x, size, method, antialias=False):
+    out_shape = x.shape[:2] + tuple(size)
+    return jax.image.resize(x, out_shape, method=method,
+                            antialias=antialias)
+
+
+def _linear_1d_align(x, out_size, axis):
+    """Separable linear interpolation with align_corners=True semantics
+    (corner samples map exactly; jax.image.resize only does half-pixel)."""
+    n = x.shape[axis]
+    if out_size == 1 or n == 1:
+        idx0 = jnp.zeros(out_size, jnp.int32)
+        return jnp.take(x, idx0, axis=axis)
+    coords = jnp.arange(out_size) * ((n - 1) / (out_size - 1))
+    lo = jnp.floor(coords).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, n - 1)
+    w = (coords - lo).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[axis] = out_size
+    w = w.reshape(shape)
+    return (jnp.take(x, lo, axis=axis) * (1 - w)
+            + jnp.take(x, hi, axis=axis) * w)
+
+
+def _linear_resize(x, sizes, align_corners):
+    if not align_corners:
+        return _resize(x, sizes, "linear" if len(sizes) == 1 else (
+            "bilinear" if len(sizes) == 2 else "trilinear"))
+    for i, s in enumerate(sizes):
+        x = _linear_1d_align(x, s, x.ndim - len(sizes) + i)
+    return x
+
+
+@def_op("nearest_interp")
+def nearest_interp(x, out_h, out_w):
+    return _resize(x, (out_h, out_w), "nearest")
+
+
+@def_op("bilinear_interp")
+def bilinear_interp(x, out_h, out_w, align_corners=False):
+    return _linear_resize(x, (out_h, out_w), align_corners)
+
+
+@def_op("bicubic_interp")
+def bicubic_interp(x, out_h, out_w, align_corners=False):
+    if align_corners:
+        raise NotImplementedError(
+            "bicubic align_corners=True is not supported (jax.image.resize "
+            "is half-pixel); use align_corners=False or bilinear")
+    return _resize(x, (out_h, out_w), "bicubic")
+
+
+@def_op("linear_interp")
+def linear_interp(x, out_w, align_corners=False):
+    return _linear_resize(x, (out_w,), align_corners)
+
+
+@def_op("trilinear_interp")
+def trilinear_interp(x, out_d, out_h, out_w, align_corners=False):
+    return _linear_resize(x, (out_d, out_h, out_w), align_corners)
+
+
+# -------------------------------------------------------------- grid sample
+@def_op("affine_grid")
+def affine_grid(theta, out_shape, align_corners=True):
+    """reference: F.affine_grid — theta [N, 2, 3], out [N, H, W, 2]."""
+    n, h, w = out_shape[0], out_shape[-2], out_shape[-1]
+    if align_corners:
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+    else:
+        ys = (jnp.arange(h) * 2 + 1) / h - 1
+        xs = (jnp.arange(w) * 2 + 1) / w - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)   # [H, W, 3]
+    return jnp.einsum("hwk,nak->nhwa", base, theta)
+
+
+@def_op("grid_sample")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """reference: F.grid_sample — x [N, C, H, W], grid [N, Ho, Wo, 2] in
+    [-1, 1] (x then y)."""
+    N, C, H, W = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (W - 1) / 2
+        fy = (gy + 1) * (H - 1) / 2
+    else:
+        fx = ((gx + 1) * W - 1) / 2
+        fy = ((gy + 1) * H - 1) / 2
+
+    def sample_one(feat, fx, fy):
+        def at(yi, xi):
+            if padding_mode == "border":
+                yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+                return feat[:, yc, xc]
+            oob = (yi < 0) | (yi > H - 1) | (xi < 0) | (xi > W - 1)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            return jnp.where(oob, 0.0, feat[:, yc, xc])
+        if mode == "nearest":
+            return at(jnp.round(fy), jnp.round(fx))
+        y0, x0 = jnp.floor(fy), jnp.floor(fx)
+        ly, lx = fy - y0, fx - x0
+        return (at(y0, x0) * (1 - ly) * (1 - lx)
+                + at(y0, x0 + 1) * (1 - ly) * lx
+                + at(y0 + 1, x0) * ly * (1 - lx)
+                + at(y0 + 1, x0 + 1) * ly * lx)
+
+    return jax.vmap(sample_one)(x, fx, fy)
+
+
+# ------------------------------------------------------------- fold/unpool
+@def_op("fold")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """reference: F.fold (col2im) — x [N, C*kh*kw, L] -> [N, C, H, W];
+    overlaps sum (the transpose of unfold)."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    N = x.shape[0]
+    C = x.shape[1] // (kh * kw)
+    lh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    lw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(N, C, kh, kw, lh, lw)
+    out = jnp.zeros((N, C, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            ys = i * dh
+            xs = j * dw
+            out = out.at[:, :, ys:ys + lh * sh:sh,
+                         xs:xs + lw * sw:sw].add(cols[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+@def_op("max_pool2d_with_index")
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
+    """Returns (pooled, flat argmax index into each image plane)."""
+    kh, kw = _pair(kernel_size)
+    sh, sw = _pair(stride if stride is not None else kernel_size)
+    ph, pw = _pair(padding)
+    N, C, H, W = x.shape
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    # index map of the padded plane back to the original flat index
+    iy = jnp.arange(H + 2 * ph) - ph
+    ix = jnp.arange(W + 2 * pw) - pw
+    flat_idx = (jnp.clip(iy[:, None], 0, H - 1) * W
+                + jnp.clip(ix[None, :], 0, W - 1))
+    oh = (H + 2 * ph - kh) // sh + 1
+    ow = (W + 2 * pw - kw) // sw + 1
+    vals, idxs = [], []
+    for i in range(kh):
+        for j in range(kw):
+            patch = xp[:, :, i:i + oh * sh:sh, j:j + ow * sw:sw]
+            pidx = flat_idx[i:i + oh * sh:sh, j:j + ow * sw:sw]
+            vals.append(patch)
+            idxs.append(jnp.broadcast_to(pidx, patch.shape))
+    vals = jnp.stack(vals)
+    idxs = jnp.stack(idxs)
+    best = jnp.argmax(vals, axis=0)
+    pooled = jnp.take_along_axis(vals, best[None], axis=0)[0]
+    index = jnp.take_along_axis(idxs, best[None], axis=0)[0]
+    return pooled, index.astype(jnp.int64)
+
+
+@def_op("max_unpool2d")
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """reference: F.max_unpool2d — scatter pooled values back to their
+    argmax positions."""
+    kh, kw = _pair(kernel_size)
+    sh, sw = _pair(stride if stride is not None else kernel_size)
+    N, C, H, W = x.shape
+    if output_size is None:
+        oh = (H - 1) * sh + kh - 2 * _pair(padding)[0]
+        ow = (W - 1) * sw + kw - 2 * _pair(padding)[1]
+    else:
+        oh, ow = output_size[-2], output_size[-1]
+    flat = jnp.zeros((N, C, oh * ow), x.dtype)
+    # .set, not .add: overlapping windows sharing an argmax carry identical
+    # values; the reference kernel overwrites rather than accumulating
+    flat = flat.at[
+        jnp.arange(N)[:, None, None],
+        jnp.arange(C)[None, :, None],
+        indices.reshape(N, C, -1)].set(x.reshape(N, C, -1))
+    return flat.reshape(N, C, oh, ow)
+
+
+@def_op("lp_pool2d")
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False):
+    kh, kw = _pair(kernel_size)
+    sh, sw = _pair(stride if stride is not None else kernel_size)
+    ph, pw = _pair(padding)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    H, W = xp.shape[-2:]
+    oh = (H - kh) // sh + 1
+    ow = (W - kw) // sw + 1
+    acc = 0.0
+    for i in range(kh):
+        for j in range(kw):
+            acc = acc + jnp.abs(
+                xp[:, :, i:i + oh * sh:sh, j:j + ow * sw:sw]) ** norm_type
+    return acc ** (1.0 / norm_type)
+
+
+@def_op("channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        return x.reshape(n, groups, c // groups, h, w).transpose(
+            0, 2, 1, 3, 4).reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    return x.reshape(n, h, w, groups, c // groups).transpose(
+        0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+
+# -------------------------------------------------------------- activations
+@def_op("tanh_shrink")
+def tanh_shrink(x):
+    return x - jnp.tanh(x)
+
+
+@def_op("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, jnp.asarray(value, x.dtype))
+
+
+@def_op("swiglu")
+def swiglu(x, y=None):
+    """reference: fused swiglu — silu(x) * y (y defaults to the second half
+    of the last axis)."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+@def_op("rrelu_")
+def _rrelu(x, lower, upper, training, key):
+    if training:
+        a = jax.random.uniform(key, x.shape, jnp.float32, lower, upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, (a * x).astype(x.dtype))
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True, name=None):
+    return _rrelu(x, float(lower), float(upper), bool(training), split_key())
+
+
+# ------------------------------------------------------------------- losses
+@def_op("sigmoid_cross_entropy_with_logits")
+def sigmoid_cross_entropy_with_logits(logits, labels, ignore_index=-100,
+                                      normalize=False):
+    loss = jnp.maximum(logits, 0) - logits * labels + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    mask = (labels != ignore_index).astype(loss.dtype)
+    loss = loss * mask
+    if normalize:
+        loss = loss / jnp.maximum(mask.sum(), 1.0)
+    return loss
+
+
+@def_op("hinge_loss")
+def hinge_loss(logits, labels):
+    return jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)
+
+
+@def_op("log_loss")
+def log_loss(input, label, epsilon=1e-4):
+    return -label * jnp.log(input + epsilon) - \
+        (1 - label) * jnp.log(1 - input + epsilon)
+
+
+@def_op("identity_loss")
+def identity_loss(x, reduction="none"):
+    if reduction in ("mean", 1):
+        return jnp.mean(x)
+    if reduction in ("sum", 2):
+        return jnp.sum(x)
+    return x
+
+
+@def_op("hsigmoid_loss")
+def hsigmoid_loss(x, label, weight, bias, path_table, path_code):
+    """Hierarchical sigmoid along precomputed paths (reference:
+    hsigmoid_loss with custom tree).  path_table [B, D]: node ids (-1 pad);
+    path_code [B, D]: binary codes."""
+    sel_w = weight[path_table]                     # [B, D, F]
+    logits = jnp.einsum("bdf,bf->bd", sel_w, x)
+    if bias is not None:
+        logits = logits + bias[path_table][..., 0] if bias.ndim == 2 \
+            else logits + bias[path_table]
+    valid = (path_table >= 0).astype(logits.dtype)
+    code = path_code.astype(logits.dtype)
+    loss = jnp.maximum(logits, 0) - logits * code + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return (loss * valid).sum(axis=-1, keepdims=True)
+
+
+@def_op("margin_cross_entropy")
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0):
+    """reference: margin_cross_entropy (ArcFace-style margins).
+    cos(m1*theta + m2) - m3 applied to the target logit."""
+    theta = jnp.arccos(jnp.clip(logits, -1 + 1e-7, 1 - 1e-7))
+    target_theta = jnp.take_along_axis(theta, label[:, None], axis=-1)
+    adj = jnp.cos(margin1 * target_theta + margin2) - margin3
+    onehot = jax.nn.one_hot(label, logits.shape[-1], dtype=logits.dtype)
+    out = jnp.where(onehot > 0, adj, logits) * scale
+    logp = jax.nn.log_softmax(out, axis=-1)
+    loss = -jnp.take_along_axis(logp, label[:, None], axis=-1)
+    return loss, jax.nn.softmax(out, axis=-1)
+
+
+@def_op("class_center_sample_")
+def _class_center_sample(label, num_classes, num_samples, key):
+    pos = jnp.zeros(num_classes, bool).at[label].set(True)
+    noise = jax.random.uniform(key, (num_classes,))
+    # positives first (noise - 1 < 0 <= noise), then random negatives
+    order = jnp.argsort(jnp.where(pos, noise - 1.0, noise))
+    sampled = jnp.sort(order[:num_samples])
+    # remap labels into the sampled index space
+    remap = jnp.zeros(num_classes, jnp.int64).at[sampled].set(
+        jnp.arange(num_samples, dtype=jnp.int64))
+    return remap[label], sampled.astype(jnp.int64)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """reference: class_center_sample — sample class centers for partial-fc
+    style training; returns (remapped_label, sampled_class_centers)."""
+    return _class_center_sample(label, int(num_classes), int(num_samples),
+                                split_key())
+
+
+# ---------------------------------------------------------- fused softmax
+@def_op("fused_softmax_mask")
+def fused_softmax_mask(x, mask):
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+@def_op("fused_softmax_mask_upper_triangle")
+def fused_softmax_mask_upper_triangle(x):
+    T = x.shape[-1]
+    causal = jnp.tril(jnp.ones((x.shape[-2], T), bool))
+    return jax.nn.softmax(jnp.where(causal, x, -1e9), axis=-1)
+
+
+@def_op("pad3d")
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    pl, pr, pt, pb, pf, pk = paddings   # w-l/r, h-top/bottom, d-front/back
+    if data_format == "NCDHW":
+        pads = ((0, 0), (0, 0), (pf, pk), (pt, pb), (pl, pr))
+    elif data_format == "NDHWC":
+        pads = ((0, 0), (pf, pk), (pt, pb), (pl, pr), (0, 0))
+    else:
+        raise ValueError(f"pad3d: unknown data_format {data_format!r}")
+    if mode == "constant":
+        return jnp.pad(x, pads, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return jnp.pad(x, pads, mode=jmode)
+
+
+@def_op("fractional_max_pool2d")
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None):
+    """Pseudo-random fractional pooling (Graham 2014): bin edges from the
+    deterministic u when given (test mode) else evenly fractional.
+    Segment-max per axis — O(H*W) memory, not O(oh*ow*H*W)."""
+    oh, ow = _pair(output_size)
+    N, C, H, W = x.shape
+    u = 0.5 if random_u is None else float(random_u)
+
+    def seg_ids(inp, out):
+        alpha = inp / out
+        starts = jnp.minimum(
+            jnp.floor(alpha * (jnp.arange(out) + u)).astype(jnp.int32),
+            inp - 1)
+        # row i belongs to the last bin whose start <= i
+        return jnp.searchsorted(starts, jnp.arange(inp), side="right") - 1
+
+    rid = jnp.clip(seg_ids(H, oh), 0, oh - 1)
+    cid = jnp.clip(seg_ids(W, ow), 0, ow - 1)
+    # reduce H: [N, C, H, W] -> [N, C, oh, W] via segment max
+    hx = jnp.moveaxis(x, 2, 0)                     # [H, N, C, W]
+    hred = jax.ops.segment_max(hx, rid, num_segments=oh)
+    hred = jnp.moveaxis(hred, 0, 2)                # [N, C, oh, W]
+    wx = jnp.moveaxis(hred, 3, 0)                  # [W, N, C, oh]
+    wred = jax.ops.segment_max(wx, cid, num_segments=ow)
+    return jnp.moveaxis(wred, 0, 3)                # [N, C, oh, ow]
+
+
+@def_op("affine_channel")
+def affine_channel(x, scale, bias, data_format="NCHW"):
+    if data_format == "NCHW":
+        return x * scale[None, :, None, None] + bias[None, :, None, None]
+    return x * scale + bias
+
+
+@def_op("shuffle_channel")
+def shuffle_channel(x, group=1):
+    return channel_shuffle.raw_fn(x, group, "NCHW")
+
+
+@def_op("bce_loss")
+def bce_loss(input, label):
+    eps = 1e-12
+    return -(label * jnp.log(input + eps)
+             + (1 - label) * jnp.log(1 - input + eps))
+
+
+@def_op("kldiv_loss")
+def kldiv_loss(x, target, reduction="mean", log_target=False):
+    t = jnp.exp(target) if log_target else target
+    loss = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-12)) - x), 0.0)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@def_op("logsigmoid")
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@def_op("max_unpool3d")
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else kernel_size
+    st = ks if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else stride)
+    N, C, D, H, W = x.shape
+    if output_size is None:
+        od = (D - 1) * st[0] + ks[0]
+        oh = (H - 1) * st[1] + ks[1]
+        ow = (W - 1) * st[2] + ks[2]
+    else:
+        od, oh, ow = output_size[-3:]
+    flat = jnp.zeros((N, C, od * oh * ow), x.dtype)
+    flat = flat.at[
+        jnp.arange(N)[:, None, None],
+        jnp.arange(C)[None, :, None],
+        indices.reshape(N, C, -1)].set(x.reshape(N, C, -1))
+    return flat.reshape(N, C, od, oh, ow)
+
+
+@def_op("l2_normalize")
+def l2_normalize(x, axis=-1, epsilon=1e-12):
+    return x / jnp.sqrt(jnp.maximum(
+        jnp.sum(x * x, axis=axis, keepdims=True), epsilon))
+
+
+@def_op("ctc_align")
+def ctc_align(input, blank=0, merge_repeated=True):
+    """Greedy path collapse mask (padded with -1), jittable form."""
+    prev = jnp.concatenate([jnp.full((input.shape[0], 1), -1, input.dtype),
+                            input[:, :-1]], axis=1)
+    keep = (input != blank) & ((input != prev) | (not merge_repeated))
+    return jnp.where(keep, input, -1)

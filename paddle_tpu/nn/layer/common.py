@@ -241,3 +241,95 @@ class Unfold(Layer):
 
     def forward(self, x):
         return F.unfold(x, *self.args)
+
+
+class Fold(Layer):
+    """reference: paddle.nn.Fold (col2im, the transpose of Unfold)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.args = (output_sizes, kernel_sizes, strides, paddings,
+                     dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self.args)
+
+
+class MaxUnPool2D(Layer):
+    """reference: paddle.nn.MaxUnPool2D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size)
+
+
+class ChannelShuffle(Layer):
+    """reference: paddle.nn.ChannelShuffle."""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class SpectralNorm(Layer):
+    """reference: paddle.nn.SpectralNorm (spectral_norm op) — normalizes a
+    weight by its largest singular value, estimated by power iteration with
+    persistent u/v buffers."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = int(weight_shape[dim])
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= int(s)
+        import numpy as _np
+        from ...framework.tensor import to_tensor as _tt
+        rng = _np.random.default_rng(0)
+        u = rng.standard_normal(h).astype(dtype)
+        v = rng.standard_normal(w).astype(dtype)
+        self.register_buffer("weight_u", _tt(u / _np.linalg.norm(u)))
+        self.register_buffer("weight_v", _tt(v / _np.linalg.norm(v)))
+
+    def forward(self, weight):
+        import jax.numpy as _jnp
+        from ...framework.dispatch import call_op
+
+        dim, iters, eps = self.dim, self.power_iters, self.eps
+
+        def _fn(w, u, v):
+            mat = _jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            for _ in range(iters):
+                v = mat.T @ u
+                v = v / (_jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (_jnp.linalg.norm(u) + eps)
+            sigma = u @ mat @ v
+            return w / sigma, u, v
+
+        out, u, v = call_op("spectral_norm", _fn,
+                            (weight, self.weight_u, self.weight_v), {})
+        # persistent power-iteration state (paddle semantics) — but never
+        # leak tracers into the buffers when compiled (to_static/TrainStep)
+        import jax as _jax
+        if not isinstance(u._data, _jax.core.Tracer):
+            self.weight_u._data = u._data
+            self.weight_v._data = v._data
+        return out

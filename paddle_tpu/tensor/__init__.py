@@ -20,6 +20,15 @@ from .manipulation import *  # noqa: F401,F403
 from .creation import *    # noqa: F401,F403
 from .logic import *       # noqa: F401,F403
 from .search import *      # noqa: F401,F403
+from .extra_ops import (  # noqa: F401
+    gammaln, polygamma, gammaincc, gammainc, logcumsumexp, ldexp, frexp,
+    p_norm, frobenius_norm, squared_l2_norm, l1_norm, clip_by_norm, renorm,
+    inverse, vander, fill_, fill_diagonal, fill_diagonal_tensor, reverse,
+    as_complex, as_real, view_dtype, index_fill, select_scatter,
+    diagonal_scatter, reduce_as, mean_all, unique_consecutive, binomial,
+    standard_gamma, exponential_, gaussian, truncated_gaussian_random,
+    top_p_sampling, gather_tree, edit_distance, accuracy,
+)
 from . import linalg       # noqa: F401
 from . import math as _math
 from . import manipulation as _manip

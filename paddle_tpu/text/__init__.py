@@ -4,5 +4,6 @@ reference: python/paddle/text/ — viterbi_decode.py (ViterbiDecoder + the
 functional form), datasets (download-based; pass local files here).
 """
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+from . import datasets  # noqa: F401
 
-__all__ = ["ViterbiDecoder", "viterbi_decode"]
+__all__ = ["ViterbiDecoder", "viterbi_decode", "datasets"]

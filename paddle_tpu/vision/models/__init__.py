@@ -11,3 +11,6 @@ from .mobilenets import (  # noqa: F401
     ShuffleNetV2, DenseNet, mobilenet_v1, mobilenet_v2, mobilenet_v3_small,
     mobilenet_v3_large, shufflenet_v2_x1_0, densenet121,
 )
+from .inception import (  # noqa: F401
+    GoogLeNet, InceptionV3, googlenet, inception_v3,
+)

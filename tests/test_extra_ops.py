@@ -1,0 +1,425 @@
+"""Long-tail op batch (reference: ops.yaml rows) — numpy/scipy goldens and
+fd-grad checks per family."""
+import numpy as np
+import pytest
+import scipy.special as sp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(a, dt=None):
+    return paddle.to_tensor(np.asarray(a, dt) if dt else np.asarray(a))
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+rng = np.random.default_rng(0)
+
+
+class TestSpecialFunctions:
+    def test_gamma_family(self):
+        x = rng.uniform(0.5, 3, (4, 5)).astype("float32")
+        np.testing.assert_allclose(_np(paddle.gammaln(_t(x))),
+                                   sp.gammaln(x), rtol=2e-4)
+        np.testing.assert_allclose(_np(paddle.polygamma(_t(x), 1)),
+                                   sp.polygamma(1, x), rtol=1e-4)
+        np.testing.assert_allclose(_np(paddle.gammaincc(_t(x), _t(x))),
+                                   sp.gammaincc(x, x), rtol=1e-4)
+        np.testing.assert_allclose(_np(paddle.gammainc(_t(x), _t(x))),
+                                   sp.gammainc(x, x), rtol=1e-4)
+
+    def test_logcumsumexp(self):
+        x = rng.standard_normal((3, 6)).astype("float32")
+        np.testing.assert_allclose(_np(paddle.logcumsumexp(_t(x), 1)),
+                                   np.logaddexp.accumulate(x, 1), rtol=1e-5)
+
+    def test_ldexp_frexp_roundtrip(self):
+        x = rng.standard_normal((8,)).astype("float32") * 100
+        m, e = paddle.frexp(_t(x))
+        np.testing.assert_allclose(_np(m) * 2.0 ** _np(e).astype("float32"),
+                                   x, rtol=1e-6)
+        assert (np.abs(_np(m)[x != 0]) >= 0.5).all()
+        assert (np.abs(_np(m)) < 1).all()
+        np.testing.assert_allclose(
+            _np(paddle.ldexp(_t(np.float32(3.0)), _t(np.int32(4)))), 48.0)
+
+    def test_sinc_signbit_isinf(self):
+        x = np.array([-1.5, -0.0, 0.5, np.inf, -np.inf], np.float32)
+        np.testing.assert_allclose(_np(paddle.tensor.extra_ops.sinc(_t(x))),
+                                   np.sinc(x), rtol=1e-6)
+        np.testing.assert_array_equal(
+            _np(paddle.tensor.extra_ops.signbit(_t(x))), np.signbit(x))
+        np.testing.assert_array_equal(
+            _np(paddle.tensor.extra_ops.isposinf(_t(x))), np.isposinf(x))
+
+
+class TestNorms:
+    def test_p_norm_and_friends(self):
+        x = rng.standard_normal((4, 6)).astype("float32")
+        np.testing.assert_allclose(_np(paddle.p_norm(_t(x), 3.0, 1)),
+                                   (np.abs(x) ** 3).sum(1) ** (1 / 3),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.frobenius_norm(_t(x))),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.squared_l2_norm(_t(x))),
+                                   (x ** 2).sum(), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.l1_norm(_t(x))),
+                                   np.abs(x).sum(), rtol=1e-5)
+
+    def test_clip_by_norm_and_renorm(self):
+        x = rng.standard_normal((4, 6)).astype("float32") * 10
+        out = _np(paddle.clip_by_norm(_t(x), 1.0))
+        np.testing.assert_allclose(np.linalg.norm(out), 1.0, rtol=1e-5)
+        r = _np(paddle.renorm(_t(x), 2.0, 0, 1.0))
+        norms = np.linalg.norm(r.reshape(4, -1), axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+
+    def test_inverse_vander(self):
+        a = rng.standard_normal((5, 5)).astype("float32") + 5 * np.eye(
+            5, dtype="float32")
+        np.testing.assert_allclose(_np(paddle.inverse(_t(a))),
+                                   np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+        v = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(_np(paddle.vander(_t(v), 3)),
+                                   np.vander(v, 3), rtol=1e-6)
+
+
+class TestManipulation:
+    def test_fill_family(self):
+        x = _t(np.zeros((3, 3), "float32"))
+        paddle.fill_(x, 7)
+        np.testing.assert_allclose(_np(x), 7.0)
+        d = _np(paddle.fill_diagonal(_t(np.zeros((3, 3), "float32")), 5.0))
+        np.testing.assert_allclose(np.diag(d), 5.0)
+        assert d[0, 1] == 0
+        y = np.array([1.0, 2.0, 3.0], np.float32)
+        dt = _np(paddle.fill_diagonal_tensor(
+            _t(np.zeros((3, 3), "float32")), _t(y)))
+        np.testing.assert_allclose(np.diag(dt), y)
+
+    def test_scatter_style(self):
+        x = np.zeros((3, 4), np.float32)
+        out = _np(paddle.select_scatter(_t(x), _t(np.ones(4, "float32")),
+                                        0, 1))
+        np.testing.assert_allclose(out[1], 1.0)
+        np.testing.assert_allclose(out[0], 0.0)
+        ifl = _np(paddle.index_fill(_t(x), _t(np.array([0, 2])), 0, 9.0))
+        np.testing.assert_allclose(ifl[0], 9.0)
+        np.testing.assert_allclose(ifl[1], 0.0)
+
+    def test_complex_views(self):
+        x = rng.standard_normal((4, 2)).astype("float32")
+        c = paddle.as_complex(_t(x))
+        back = _np(paddle.as_real(c))
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_reverse_reduce_as_mean_all(self):
+        x = rng.standard_normal((2, 3)).astype("float32")
+        np.testing.assert_allclose(_np(paddle.reverse(_t(x), 1)),
+                                   x[:, ::-1])
+        r = _np(paddle.reduce_as(_t(x), _t(np.zeros((1, 3), "float32"))))
+        np.testing.assert_allclose(r, x.sum(0, keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.mean_all(_t(x))), x.mean(),
+                                   rtol=1e-6)
+
+    def test_unique_consecutive(self):
+        v, inv, cnt = paddle.unique_consecutive(
+            _t(np.array([1, 1, 2, 2, 2, 3, 1])), True, True)
+        assert list(_np(v)) == [1, 2, 3, 1]
+        assert list(_np(cnt)) == [2, 3, 1, 1]
+        assert list(_np(inv)) == [0, 0, 1, 1, 1, 2, 3]
+
+
+class TestSampling:
+    def test_distribution_shapes_and_stats(self):
+        paddle.seed(0)
+        g = paddle.gaussian([2000], mean=1.0, std=2.0)
+        assert abs(float(_np(g).mean()) - 1.0) < 0.2
+        tg = paddle.truncated_gaussian_random([2000])
+        assert (np.abs(_np(tg)) <= 2.0 + 1e-6).all()
+        b = paddle.binomial(_t(np.full(2000, 10.0, "float32")),
+                            _t(np.full(2000, 0.5, "float32")))
+        assert abs(float(_np(b).mean()) - 5.0) < 0.5
+        sg = paddle.standard_gamma(_t(np.full(2000, 2.0, "float32")))
+        assert abs(float(_np(sg).mean()) - 2.0) < 0.3
+        x = _t(np.zeros(1000, "float32"))
+        paddle.exponential_(x, lam=2.0)
+        assert abs(float(_np(x).mean()) - 0.5) < 0.1
+
+    def test_top_p_sampling(self):
+        paddle.seed(0)
+        logits = np.full((4, 10), -10.0, np.float32)
+        logits[:, 3] = 10.0          # all nucleus mass on token 3
+        scores, ids = paddle.top_p_sampling(_t(logits), 0.9)
+        assert (_np(ids) == 3).all()
+
+
+class TestSequence:
+    def test_gather_tree(self):
+        # ids/parents [T=2, B=1, beam=2]
+        ids = np.array([[[1, 2]], [[3, 4]]], np.int64)
+        parents = np.array([[[0, 0]], [[1, 0]]], np.int64)
+        out = _np(paddle.gather_tree(_t(ids), _t(parents)))
+        # beam 0 at t=1 came from parent 1 -> path (2, 3)
+        assert list(out[:, 0, 0]) == [2, 3]
+        assert list(out[:, 0, 1]) == [1, 4]
+
+    def test_edit_distance(self):
+        d, n = paddle.edit_distance(_t(np.array([[1, 2, 3]])),
+                                    _t(np.array([[1, 3, 3, 4]])),
+                                    normalized=False)
+        assert float(_np(d)) == 2.0
+        assert int(_np(n)) == 1
+
+    def test_accuracy(self):
+        pred = np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)
+        lab = np.array([[1], [1]], np.int64)
+        np.testing.assert_allclose(
+            float(_np(paddle.accuracy(_t(pred), _t(lab)))), 0.5)
+
+
+class TestNnExtra:
+    def test_interp_family(self):
+        x = rng.standard_normal((2, 3, 8, 8)).astype("float32")
+        assert F.bilinear_interp(_t(x), 16, 16).shape == [2, 3, 16, 16]
+        assert F.nearest_interp(_t(x), 4, 4).shape == [2, 3, 4, 4]
+        assert F.bicubic_interp(_t(x), 16, 16).shape == [2, 3, 16, 16]
+        x1 = rng.standard_normal((2, 3, 8)).astype("float32")
+        assert F.linear_interp(_t(x1), 16).shape == [2, 3, 16]
+        x3 = rng.standard_normal((1, 2, 4, 4, 4)).astype("float32")
+        assert F.trilinear_interp(_t(x3), 8, 8, 8).shape == [1, 2, 8, 8, 8]
+
+    def test_grid_sample_identity(self):
+        x = rng.standard_normal((2, 3, 8, 8)).astype("float32")
+        theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], "float32"),
+                        (2, 1, 1))
+        grid = F.affine_grid(_t(theta), [2, 3, 8, 8])
+        np.testing.assert_allclose(_np(F.grid_sample(_t(x), grid)), x,
+                                   atol=1e-5)
+
+    def test_grid_sample_gradient(self):
+        x = _t(rng.standard_normal((1, 1, 4, 4)).astype("float32"))
+        x.stop_gradient = False
+        theta = _t(np.array([[[0.5, 0, 0], [0, 0.5, 0]]], "float32"))
+        out = F.grid_sample(x, F.affine_grid(theta, [1, 1, 4, 4]))
+        out.sum().backward()
+        assert np.abs(_np(x.grad)).sum() > 0
+
+    def test_fold_inverts_unfold(self):
+        x = rng.standard_normal((2, 3, 8, 8)).astype("float32")
+        cols = F.unfold(_t(x), 2, strides=2)
+        np.testing.assert_allclose(
+            _np(F.fold(cols, (8, 8), 2, strides=2)), x, atol=1e-5)
+
+    def test_pool_index_unpool_roundtrip(self):
+        x = rng.standard_normal((2, 3, 8, 8)).astype("float32")
+        p, idx = F.max_pool2d_with_index(_t(x), 2, 2)
+        up = _np(F.max_unpool2d(p, idx, 2, 2))
+        # unpooled has the max at its original position, zeros elsewhere
+        assert up.shape == (2, 3, 8, 8)
+        np.testing.assert_allclose(up.max(), _np(p).max(), rtol=1e-6)
+        assert (np.count_nonzero(up) <= 2 * 3 * 16)
+
+    def test_lp_pool_matches_avg_for_p1_abs(self):
+        x = np.abs(rng.standard_normal((1, 1, 4, 4))).astype("float32")
+        out = _np(F.lp_pool2d(_t(x), 1.0, 2, 2))
+        want = x.reshape(1, 1, 2, 2, 2, 2).transpose(
+            0, 1, 2, 4, 3, 5).reshape(1, 1, 2, 2, 4).sum(-1)
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_channel_shuffle_permutes(self):
+        x = np.arange(6, dtype="float32").reshape(1, 6, 1, 1)
+        out = _np(F.channel_shuffle(_t(x), 2)).reshape(-1)
+        np.testing.assert_allclose(out, [0, 3, 1, 4, 2, 5])
+
+    def test_activations(self):
+        x = rng.standard_normal((4, 6)).astype("float32")
+        np.testing.assert_allclose(_np(F.tanh_shrink(_t(x))),
+                                   x - np.tanh(x), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(
+            _np(F.thresholded_relu(_t(x), 0.5)), np.where(x > 0.5, x, 0.0))
+        sw = _np(F.swiglu(_t(x)))
+        a, b = x[:, :3], x[:, 3:]
+        np.testing.assert_allclose(sw, (a / (1 + np.exp(-a))) * b,
+                                   rtol=1e-5)
+        out = _np(F.rrelu(_t(x), training=False))
+        alpha = (1 / 8 + 1 / 3) / 2
+        np.testing.assert_allclose(out, np.where(x >= 0, x, alpha * x),
+                                   rtol=1e-5)
+
+    def test_losses(self):
+        logits = rng.standard_normal((4, 3)).astype("float32")
+        labels = (rng.uniform(size=(4, 3)) > 0.5).astype("float32")
+        got = _np(F.sigmoid_cross_entropy_with_logits(_t(logits),
+                                                      _t(labels)))
+        p = 1 / (1 + np.exp(-logits))
+        want = -(labels * np.log(p) + (1 - labels) * np.log(1 - p))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        hl = _np(F.hinge_loss(_t(logits), _t(labels)))
+        np.testing.assert_allclose(
+            hl, np.maximum(0, 1 - (2 * labels - 1) * logits), rtol=1e-5)
+        probs = np.clip(p, 0.01, 0.99)
+        ll = _np(F.log_loss(_t(probs), _t(labels)))
+        assert (ll > 0).all()
+
+    def test_margin_cross_entropy(self):
+        # margins zero + scale 1 reduces to plain softmax CE on cosines
+        cos = rng.uniform(-0.9, 0.9, (4, 5)).astype("float32")
+        label = rng.integers(0, 5, 4)
+        loss, sm = F.margin_cross_entropy(
+            _t(cos), _t(label), margin1=1.0, margin2=0.0, margin3=0.0,
+            scale=1.0)
+        e = np.exp(cos - cos.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(4), label])
+        np.testing.assert_allclose(_np(loss)[:, 0], want, rtol=1e-4)
+
+    def test_class_center_sample(self):
+        paddle.seed(0)
+        label = _t(np.array([3, 7, 3, 1], np.int64))
+        remapped, centers = F.class_center_sample(label, 10, 6)
+        c = _np(centers)
+        assert len(c) == 6
+        for orig in (1, 3, 7):
+            assert orig in c           # positives always sampled
+        rm = _np(remapped)
+        np.testing.assert_array_equal(c[rm], [3, 7, 3, 1])
+
+    def test_fused_softmax_masks(self):
+        x = rng.standard_normal((2, 2, 4, 4)).astype("float32")
+        up = _np(F.fused_softmax_mask_upper_triangle(_t(x)))
+        assert np.allclose(np.triu(up[0, 0], 1), 0, atol=1e-6)
+        np.testing.assert_allclose(up.sum(-1), 1.0, rtol=1e-5)
+
+    def test_layers(self):
+        x = rng.standard_normal((1, 4, 8, 8)).astype("float32")
+        cols = nn.Unfold(2, strides=2)(_t(x))
+        back = nn.Fold((8, 8), 2, strides=2)(cols)
+        np.testing.assert_allclose(_np(back), x, atol=1e-5)
+        assert nn.ChannelShuffle(2)(_t(x)).shape == [1, 4, 8, 8]
+        p, idx = F.max_pool2d_with_index(_t(x), 2, 2)
+        assert nn.MaxUnPool2D(2, 2)(p, idx).shape == [1, 4, 8, 8]
+
+    def test_spectral_norm_matches_svd(self):
+        w = rng.standard_normal((4, 8)).astype("float32")
+        sn = nn.SpectralNorm((4, 8), power_iters=50)
+        wn = _np(sn(_t(w)))
+        smax = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(wn, w / smax, rtol=1e-3)
+
+    def test_pad3d_and_kldiv_and_bce(self):
+        x = rng.standard_normal((1, 1, 2, 2, 2)).astype("float32")
+        out = F.pad3d(_t(x), [1, 1, 0, 0, 0, 0])
+        assert out.shape == [1, 1, 2, 2, 4]
+        p = np.clip(rng.uniform(size=(3, 2)), 0.05, 0.95).astype("float32")
+        lab = (rng.uniform(size=(3, 2)) > 0.5).astype("float32")
+        np.testing.assert_allclose(
+            _np(F.extra.bce_loss(_t(p), _t(lab))),
+            -(lab * np.log(p) + (1 - lab) * np.log(1 - p)), rtol=1e-4)
+        lx = np.log(p)
+        kd = float(_np(F.extra.kldiv_loss(_t(lx), _t(p), "sum")))
+        assert abs(kd) < 1e-4          # KL(p||p) = 0
+
+
+class TestAsp:
+    def test_prune_model_2_4_pattern(self):
+        from paddle_tpu.incubate import asp
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        masks = asp.prune_model(model, n=2, m=4)
+        assert masks
+        assert asp.check_sparsity(model)
+        d = asp.calculate_density(model[0].weight)
+        assert abs(d - 0.5) < 1e-6          # exactly 2:4
+        # per-group check on the raw weights
+        w = _np(model[0].weight)
+        groups = w.reshape(w.shape[0], -1, 4)
+        assert ((groups != 0).sum(-1) <= 2).all()
+
+    def test_decorated_optimizer_preserves_sparsity(self):
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.incubate import asp
+        paddle.seed(1)
+        model = nn.Linear(8, 8)
+        asp.prune_model(model, n=2, m=4)
+        opt = asp.decorate(optim.SGD(learning_rate=0.1,
+                                     parameters=model.parameters()))
+        x = _t(rng.standard_normal((4, 8)).astype("float32"))
+        for _ in range(3):
+            loss = (model(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert asp.check_sparsity(model)
+        assert abs(asp.calculate_density(model.weight) - 0.5) < 1e-6
+
+    def test_excluded_layers(self):
+        from paddle_tpu.incubate import asp
+        paddle.seed(2)
+        model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        asp.set_excluded_layers(["0.weight"], model=model)
+        try:
+            asp.prune_model(model)
+            assert abs(asp.calculate_density(model[0].weight) - 1.0) < 1e-6
+            assert abs(asp.calculate_density(model[1].weight) - 0.5) < 1e-6
+        finally:
+            asp.reset_excluded_layers(model=model)
+
+    def test_mask_2d_greedy(self):
+        from paddle_tpu.incubate.asp import _compute_mask_2d_greedy
+        m = _compute_mask_2d_greedy(
+            rng.standard_normal((8, 8)).astype("float32"), 2, 4)
+        blocks = m.reshape(2, 4, 2, 4).transpose(0, 2, 1, 3)
+        assert (blocks.sum(-1) <= 2).all()       # rows
+        assert (blocks.sum(-2) <= 2).all()       # cols
+
+
+class TestReviewRegressions:
+    def test_fill_diagonal_tensor_offset_rectangular(self):
+        x = np.zeros((2, 5), np.float32)
+        y = np.array([7.0, 8.0], np.float32)
+        out = _np(paddle.fill_diagonal_tensor(_t(x), _t(y), offset=2))
+        assert out[0, 2] == 7.0 and out[1, 3] == 8.0
+        assert out.sum() == 15.0
+
+    def test_max_unpool_overlapping_windows_no_accumulation(self):
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 1, 1] = 5.0
+        p, idx = F.max_pool2d_with_index(_t(x), 2, 1)   # stride < kernel
+        up = _np(F.max_unpool2d(p, idx, 2, 1, output_size=(4, 4)))
+        assert up[0, 0, 1, 1] == 5.0                    # not 4 * 5.0
+
+    def test_top_p_per_row(self):
+        paddle.seed(0)
+        logits = np.zeros((2, 4), np.float32)
+        logits[0, 0] = 10.0      # row 0: all mass on token 0
+        # row 1: uniform; p=1.0 keeps everything
+        ps = _t(np.array([0.5, 1.0], np.float32))
+        _, ids = paddle.top_p_sampling(_t(logits), ps)
+        assert int(_np(ids)[0]) == 0
+
+    def test_ldexp_negative_exponent_int_input(self):
+        out = paddle.ldexp(_t(np.array([4], "int32")),
+                           _t(np.array([-1], "int32")))
+        np.testing.assert_allclose(_np(out), [2.0])
+
+    def test_bilinear_align_corners_preserves_corners(self):
+        x = np.arange(4, dtype="float32").reshape(1, 1, 2, 2)
+        out = _np(F.bilinear_interp(_t(x), 4, 4, align_corners=True))
+        assert out[0, 0, 0, 0] == 0.0 and out[0, 0, -1, -1] == 3.0
+        assert out[0, 0, 0, -1] == 1.0 and out[0, 0, -1, 0] == 2.0
+
+    def test_pad3d_ndhwc(self):
+        x = np.zeros((1, 2, 2, 2, 3), np.float32)
+        out = F.pad3d(_t(x), [1, 1, 0, 0, 0, 0], data_format="NDHWC")
+        assert out.shape == [1, 2, 2, 4, 3]
+
+    def test_fractional_max_pool(self):
+        x = rng.standard_normal((1, 2, 9, 9)).astype("float32")
+        out = F.fractional_max_pool2d(_t(x), 3)
+        assert out.shape == [1, 2, 3, 3]
+        np.testing.assert_allclose(_np(out).max(), x.max(), rtol=1e-6)

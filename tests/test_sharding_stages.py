@@ -15,6 +15,20 @@ from paddle_tpu.jit import TrainStep
 D = 256
 
 
+@pytest.fixture(autouse=True)
+def _clean_topology():
+    """group_sharded honors ambient fleet topology by design; these tests
+    assert the DEFAULT 8-device sharding mesh, so isolate them from hcg /
+    global-mesh state other test files legitimately leave behind."""
+    from paddle_tpu.distributed.auto_parallel import process_mesh as pm
+    from paddle_tpu.distributed.fleet import topology as topo
+    saved = (pm._global_mesh, topo._hcg)
+    pm._global_mesh = None
+    topo._hcg = None
+    yield
+    pm._global_mesh, topo._hcg = saved
+
+
 def _build(level):
     paddle.seed(0)
     model = nn.Sequential(nn.Linear(D, 4 * D), nn.GELU(), nn.Linear(4 * D, D))

@@ -1,0 +1,141 @@
+"""text.datasets parsers exercised on locally built mini-archives in the
+canonical formats (reference: python/paddle/text/datasets/; no egress in
+this environment, so download paths stay untested by design)."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text.datasets import (
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
+)
+
+
+def _add_bytes(tar, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tar.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture()
+def imdb_file(tmp_path):
+    path = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(path, "w:gz") as tar:
+        docs = {
+            "aclImdb/train/pos/0.txt": b"a great great movie",
+            "aclImdb/train/pos/1.txt": b"great fun",
+            "aclImdb/train/neg/0.txt": b"a bad movie",
+            "aclImdb/test/pos/0.txt": b"great movie",
+            "aclImdb/test/neg/0.txt": b"bad bad fun",
+        }
+        for name, text in docs.items():
+            _add_bytes(tar, name, text)
+    return str(path)
+
+
+class TestImdb:
+    def test_parse_and_labels(self, imdb_file):
+        ds = Imdb(data_file=imdb_file, mode="train", cutoff=0)
+        assert len(ds) == 3
+        labels = sorted(int(ds[i][1][0]) for i in range(3))
+        assert labels == [0, 1, 1]
+        # word dict is frequency-sorted with <unk> last
+        assert b"<unk>" in ds.word_idx
+        assert ds.word_idx[b"great"] == 0      # most frequent word
+        doc, _ = ds[0]
+        assert doc.dtype == np.int64
+
+    def test_test_mode(self, imdb_file):
+        ds = Imdb(data_file=imdb_file, mode="test", cutoff=0)
+        assert len(ds) == 2
+
+
+@pytest.fixture()
+def ptb_file(tmp_path):
+    path = tmp_path / "simple-examples.tgz"
+    train = b"the cat sat\nthe dog sat\n"
+    valid = b"the cat ran\n"
+    with tarfile.open(path, "w:gz") as tar:
+        _add_bytes(tar, "simple-examples/data/ptb.train.txt", train)
+        _add_bytes(tar, "simple-examples/data/ptb.valid.txt", valid)
+    return str(path)
+
+
+class TestImikolov:
+    def test_ngram(self, ptb_file):
+        ds = Imikolov(data_file=ptb_file, data_type="NGRAM", window_size=2,
+                      mode="train", min_word_freq=1)
+        assert len(ds) > 0
+        for gram in ds:
+            assert len(gram) == 2
+        assert "the" in ds.word_idx
+
+    def test_seq(self, ptb_file):
+        ds = Imikolov(data_file=ptb_file, data_type="SEQ", mode="test",
+                      min_word_freq=1)
+        src, tgt = ds[0]
+        assert len(src) == len(tgt)
+
+    def test_requires_data_file_when_no_download(self):
+        with pytest.raises(ValueError):
+            Imikolov(data_file=None, download=False)
+
+
+class TestUCIHousing:
+    def test_normalization_and_split(self, tmp_path):
+        rng = np.random.default_rng(0)
+        data = rng.uniform(1, 10, (20, 14)).astype("float32")
+        path = tmp_path / "housing.data"
+        with open(path, "w") as f:
+            for row in data:
+                f.write(" ".join(f"{v:.4f}" for v in row) + "\n")
+        tr = UCIHousing(data_file=str(path), mode="train")
+        te = UCIHousing(data_file=str(path), mode="test")
+        assert len(tr) == 16 and len(te) == 4
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # features are avg-centered: global mean ~0 per feature
+        allx = np.stack([tr[i][0] for i in range(16)]
+                        + [te[i][0] for i in range(4)])
+        assert np.abs(allx.mean(0)).max() < 0.5
+
+
+class TestConll05st:
+    def test_srl_samples(self, tmp_path):
+        words = "The\ncat\nsat\n\nDogs\nrun\n\n"
+        props = "-\t(A0*)\n-\t*\nsat\t(V*)\n\n-\t(V*)\nrun\t*\n\n"
+        gz_w = gzip.compress(words.encode())
+        gz_p = gzip.compress(props.encode())
+        path = tmp_path / "conll05st-tests.tar.gz"
+        with tarfile.open(path, "w:gz") as tar:
+            _add_bytes(tar, "conll05st-release/test.wsj/words/"
+                       "test.wsj.words.gz", gz_w)
+            _add_bytes(tar, "conll05st-release/test.wsj/props/"
+                       "test.wsj.props.gz", gz_p)
+        ds = Conll05st(data_file=str(path))
+        assert len(ds) == 2              # one predicate per sentence
+        ids, tags = ds[0]
+        assert len(ids) == 3 and len(tags) == 3
+        assert "cat" in ds.word_dict
+
+
+class TestMovielens:
+    def test_ratings_join(self, tmp_path):
+        path = tmp_path / "ml-1m.zip"
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("ml-1m/users.dat",
+                        "1::M::25::4::12345\n2::F::35::7::54321\n")
+            zf.writestr("ml-1m/movies.dat",
+                        "10::Movie A (1990)::Comedy|Drama\n"
+                        "20::Movie B (1991)::Action\n")
+            zf.writestr("ml-1m/ratings.dat",
+                        "1::10::5::100\n1::20::3::101\n2::10::4::102\n")
+        tr = Movielens(data_file=str(path), mode="train", test_ratio=0.0)
+        assert len(tr) == 3
+        uid, gender, age, job, mid, multihot, rating = tr[0]
+        assert multihot.sum() >= 1
+        assert rating in (3.0, 4.0, 5.0)
