@@ -5,8 +5,11 @@ TPU-native: the portable interchange format on the XLA stack is StableHLO
 (versioned, stable serialization), not ONNX — ``export`` emits the same
 shape-polymorphic StableHLO artifact as ``paddle_tpu.jit.save`` and can be
 loaded by any StableHLO consumer (or ``paddle_tpu.jit.load`` /
-``paddle_tpu.inference``).  If the optional ``onnx`` package is installed,
-pass ``format='onnx'`` to attempt conversion; otherwise it raises.
+``paddle_tpu.inference``).  Direct ONNX emission is NOT implemented:
+``format='onnx'`` always raises NotImplementedError pointing at the
+StableHLO path (converting between the two graph dialects is out of scope;
+ONNX consumers should ingest StableHLO via onnx-mlir or serve the StableHLO
+artifact directly).
 """
 from __future__ import annotations
 
@@ -22,6 +25,8 @@ def export(layer, path, input_spec=None, opset_version=9,
         return path + ".stablehlo"
     if format == "onnx":
         raise NotImplementedError(
-            "direct ONNX emission requires the 'onnx' package, which is not "
-            "bundled; export StableHLO (default) for portable serving")
+            "direct ONNX emission is not implemented; export StableHLO "
+            "(the default) — it is the portable interchange format on the "
+            "XLA stack and any StableHLO consumer (incl. onnx-mlir "
+            "pipelines) can ingest it")
     raise ValueError(f"unknown export format: {format}")
