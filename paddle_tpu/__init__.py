@@ -16,6 +16,14 @@ __version__ = "0.1.0"
 # Pallas lowering on this backend.  The reference defaults to int64 indices;
 # user code keeps working, tensors just report int32.
 
+import os as _os
+
+if _os.environ.get("PADDLE_TPU_HELPER_CPU"):
+    # launcher-marked helper rank: pin the CPU backend before anything can
+    # touch (and hang on) a sick accelerator plugin (framework/backend_guard)
+    from .framework.backend_guard import pin_cpu as _pin_cpu
+    _pin_cpu()
+
 from .framework.tensor import Tensor, Parameter, to_tensor
 from .framework import dtype as _dtype_mod
 from .framework.dtype import (

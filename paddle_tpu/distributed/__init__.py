@@ -27,6 +27,9 @@ from .auto_parallel.api import (  # noqa: F401
     shard_tensor, reshard, shard_layer, shard_optimizer, dtensor_from_fn,
     unshard_dtensor, shard_dataloader, DistAttr,
 )
+from .auto_parallel.dist_model import (  # noqa: F401
+    DistModel, Strategy, to_static,
+)
 from .auto_parallel import spmd_rules as _spmd_rules  # noqa: F401
 _spmd_rules.register_all()
 from . import fleet  # noqa: F401

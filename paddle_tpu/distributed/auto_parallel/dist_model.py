@@ -1,0 +1,217 @@
+"""Static auto-parallel engine: ``dist.to_static`` → ``DistModel``.
+
+Capability parity: python/paddle/distributed/auto_parallel/api.py:2167
+(DistModel) + :2776 (to_static) and the static engine it fronts
+(auto_parallel/static/engine.py:99 — plan once, then run a partitioned
+program per batch).
+
+TPU-native design: "plan + partition + execute" is exactly what GSPMD does
+when a jitted program takes dist tensors — the params already carry their
+placements (``shard_tensor``/``shard_layer``), so the "static graph" is a
+whole-step compiled program: ``jit.TrainStep`` for train mode (forward +
+loss + backward + sharded optimizer update in ONE XLA executable) and a
+cached jitted forward(+loss) for eval/predict.  The reference's
+planner/partitioner/reshard passes collapse into XLA's sharding propagation
+over those placements.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+
+from ...framework.tape import no_grad
+from ...framework.tensor import Tensor, wrap_array
+
+__all__ = ["DistModel", "to_static", "Strategy"]
+
+
+class Strategy:
+    """reference: dist.Strategy — pass/parallelism configuration knobs.
+    Consumed knobs: ``sharding`` (ZeRO stage + degree for the optimizer),
+    ``amp`` (o1/o2 autocast in the compiled step).  ``pipeline`` and
+    ``gradient_merge`` are accepted for config compatibility but NOT
+    consumed here (PP is configured on the layers themselves; gradient
+    accumulation is a warned no-op until TrainStep grows it)."""
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.sharding = _Section(config.get("sharding", {}),
+                                 enable=False, degree=8, stage=1)
+        self.amp = _Section(config.get("amp", {}),
+                            enable=False, level="o1", dtype="bfloat16")
+        self.pipeline = _Section(config.get("pipeline", {}),
+                                 enable=False, schedule_mode="1F1B",
+                                 accumulate_steps=1)
+        self.gradient_merge = _Section(config.get("gradient_merge", {}),
+                                       enable=False, k_steps=1)
+
+
+class _Section:
+    def __init__(self, overrides, **defaults):
+        self.__dict__.update(defaults)
+        self.__dict__.update(overrides)
+
+    def __repr__(self):
+        return repr(self.__dict__)
+
+
+class DistModel:
+    """reference: DistModel (api.py:2167) — mode-gated callable over the
+    compiled distributed program.
+
+    ``train()``/``eval()``/``predict()`` select the mode; ``__call__`` runs
+    one step: train → scalar loss (params updated), eval → loss (no
+    update), predict → outputs."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, input_spec=None):
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._train_step = None
+        self._eval_fn = None
+        self._mode = None
+        if optimizer is not None and loss is not None:
+            self._mode = "train"
+        elif loss is not None:
+            self._mode = "eval"
+        else:
+            self._mode = "predict"
+
+        if self._strategy.amp.enable:
+            self._amp_level = self._strategy.amp.level.upper()
+            self._amp_dtype = self._strategy.amp.dtype
+        else:
+            self._amp_level, self._amp_dtype = "O0", "bfloat16"
+
+        if self._strategy.gradient_merge.enable:
+            import warnings
+            warnings.warn(
+                "Strategy.gradient_merge is not consumed by the TPU engine "
+                "yet — steps apply every batch (no accumulation)")
+        if self._strategy.sharding.enable and optimizer is not None:
+            from ..fleet.sharding import group_sharded_parallel
+            level = {1: "os", 2: "os_g", 3: "p_g_os"}[
+                int(self._strategy.sharding.stage)]
+            _, optimizer, _ = group_sharded_parallel(layer, optimizer, level)
+            self._optimizer = optimizer
+
+    # ------------------------------------------------------------ mode gates
+    def train(self):
+        """reference: DistModel.train — requires loss AND optimizer."""
+        if self._loss is None or self._optimizer is None:
+            raise ValueError(
+                "DistModel.train() needs both loss and optimizer "
+                "(reference: engine mode check)")
+        self.network.train()
+        self._mode = "train"
+        return self
+
+    def eval(self):
+        if self._loss is None:
+            raise ValueError("DistModel.eval() needs a loss")
+        self.sync()   # trained functional state -> Layer params
+        self.network.eval()
+        self._mode = "eval"
+        return self
+
+    def predict(self):
+        self.sync()
+        self.network.eval()
+        self._mode = "predict"
+        return self
+
+    @property
+    def mode(self):
+        return self._mode
+
+    # -------------------------------------------------------------- execute
+    def _loss_fn(self, outputs, *labels):
+        loss = self._loss(outputs, *labels) if callable(self._loss) else \
+            outputs
+        return loss if isinstance(loss, Tensor) else loss[0]
+
+    def _get_train_step(self):
+        if self._train_step is None:
+            from ...jit.train_step import TrainStep
+            self._train_step = TrainStep(
+                self.network, self._loss_fn, self._optimizer,
+                amp_level=self._amp_level, amp_dtype=self._amp_dtype)
+        return self._train_step
+
+    def _get_eval_fn(self):
+        if self._eval_fn is None:
+            from ...jit import to_static
+            net = self.network
+            self._eval_fn = to_static(lambda *xs: net(*xs))
+        return self._eval_fn
+
+    def __call__(self, *args):
+        """One step in the current mode.  By convention the LAST argument is
+        the label for train/eval (reference: DistModel feeds (data, label))."""
+        if self._mode == "train":
+            step = self._get_train_step()
+            inputs, labels = list(args[:-1]), [args[-1]]
+            loss = step(inputs, labels)
+            return loss
+        if self._mode == "eval":
+            fwd = self._get_eval_fn()
+            with no_grad():
+                out = fwd(*args[:-1])
+                return self._loss_fn(out, args[-1])
+        fwd = self._get_eval_fn()
+        with no_grad():
+            return fwd(*args)
+
+    # ---------------------------------------------------------------- state
+    def sync(self):
+        """Flush the compiled train step's functional state back into the
+        Layer/optimizer objects (automatic in state_dict)."""
+        if self._train_step is not None:
+            self._train_step.sync()
+
+    def state_dict(self, mode="all"):
+        """reference: DistModel.state_dict — dist (sharded) params; 'opt'
+        restricts to optimizer state, 'params' to parameters."""
+        self.sync()
+        out = {}
+        if mode in ("all", "params"):
+            out.update(self.network.state_dict())
+        if mode in ("all", "opt") and self._optimizer is not None:
+            out.update({f"opt.{k}": v
+                        for k, v in self._optimizer.state_dict().items()})
+        return out
+
+    def set_state_dict(self, state_dict):
+        params = {k: v for k, v in state_dict.items()
+                  if not k.startswith("opt.")}
+        opt = {k[4:]: v for k, v in state_dict.items()
+               if k.startswith("opt.")}
+        if params:
+            self.network.set_state_dict(params)
+        if opt and self._optimizer is not None:
+            self._optimizer.set_state_dict(opt)
+        # compiled state is rebuilt from the objects on next call
+        self._train_step = None
+        self._eval_fn = None
+
+    def dist_main_program(self, mode=None):
+        """reference: DistModel.dist_main_program — the partitioned program
+        text; here the StableHLO of the compiled step (one SPMD program)."""
+        if self._train_step is not None and \
+                self._train_step._compiled is not None:
+            return "<compiled whole-step XLA program; use " \
+                   "TrainStep.memory_analysis(return_hlo=True) for HLO>"
+        return "<not compiled yet — run one step first>"
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              input_spec=None):
+    """reference: dist.to_static (api.py:2776) — build the static
+    distributed engine from a layer whose params carry placements."""
+    return DistModel(layer, loader, loss, optimizer, strategy, input_spec)

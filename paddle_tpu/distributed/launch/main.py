@@ -27,9 +27,14 @@ def main(argv=None):
                         default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
     parser.add_argument("--master", default=os.environ.get("PADDLE_MASTER"))
     parser.add_argument("--nproc_per_node", type=int, default=1,
-                        help="kept for reference-CLI compat; on TPU one "
-                             "process drives all local chips (SPMD)")
+                        help="host-level worker processes to supervise "
+                             "(PS/RPC actors, data workers); on TPU the "
+                             "training process itself drives all local "
+                             "chips via SPMD")
     parser.add_argument("--log_dir", default=None)
+    parser.add_argument("--job_id", default="default")
+    parser.add_argument("--elastic_level", type=int, default=0)
+    parser.add_argument("--max_restarts", type=int, default=3)
     parser.add_argument("--devices", "--gpus", dest="devices", default=None)
     parser.add_argument("script", help="training script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
@@ -41,9 +46,15 @@ def main(argv=None):
     if args.master:
         env["PADDLE_MASTER"] = args.master
     if args.nproc_per_node > 1:
-        print("[paddle_tpu.launch] note: nproc_per_node>1 is a GPU-ism; on "
-              "TPU one process per host drives all chips via SPMD. "
-              "Running a single process.", file=sys.stderr)
+        # supervised multi-process mode (reference: controllers/collective)
+        from .controller import LocalController
+        code = LocalController(
+            args.script, args.script_args, nproc=args.nproc_per_node,
+            master=args.master, log_dir=args.log_dir, job_id=args.job_id,
+            elastic_level=args.elastic_level,
+            max_restarts=args.max_restarts,
+            nnodes=args.nnodes, node_rank=args.node_rank).run()
+        sys.exit(code)
     sys.argv = [args.script] + list(args.script_args)
     runpy.run_path(args.script, run_name="__main__")
 

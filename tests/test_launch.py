@@ -1,0 +1,86 @@
+"""Launch controller: multi-process supervision, env contract, per-rank
+logs, failure teardown, elastic restart (reference:
+launch/controllers/collective.py, job/container.py, elastic manager)."""
+import os
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.launch.controller import LocalController
+
+
+def _script(tmp_path, body):
+    p = tmp_path / "worker.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+class TestLocalController:
+    def test_env_contract_and_logs(self, tmp_path):
+        script = _script(tmp_path, """
+            import os
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            world = os.environ["PADDLE_TRAINERS_NUM"]
+            assert os.environ["PADDLE_MASTER"]
+            eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+            assert len(eps) == int(world)
+            print(f"rank {rank} of {world} ok", flush=True)
+        """)
+        log_dir = str(tmp_path / "logs")
+        code = LocalController(script, nproc=3, log_dir=log_dir,
+                               watch_rank0=False).run()
+        assert code == 0
+        for r in range(3):
+            text = open(os.path.join(log_dir, f"workerlog.{r}")).read()
+            assert f"rank {r} of 3 ok" in text
+
+    def test_failure_tears_down_peers(self, tmp_path):
+        script = _script(tmp_path, """
+            import os, sys, time
+            if os.environ["PADDLE_TRAINER_ID"] == "1":
+                sys.exit(7)
+            time.sleep(60)   # peers must not run to completion
+        """)
+        import time
+        t0 = time.time()
+        code = LocalController(script, nproc=3, watch_rank0=False).run()
+        assert code == 7
+        assert time.time() - t0 < 40       # no 60s straggler wait
+
+    def test_elastic_restart_then_success(self, tmp_path):
+        marker = tmp_path / "attempt"
+        script = _script(tmp_path, f"""
+            import os, sys
+            marker = {str(marker)!r} + os.environ["PADDLE_TRAINER_ID"]
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                if os.environ["PADDLE_TRAINER_ID"] == "0":
+                    sys.exit(101)     # fail the first attempt
+        """)
+        code = LocalController(script, nproc=2, elastic_level=1,
+                               max_restarts=2, watch_rank0=False).run()
+        assert code == 0               # second attempt succeeds
+
+    def test_helper_ranks_marked_cpu_only(self, tmp_path):
+        script = _script(tmp_path, """
+            import os, sys
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            has = os.environ.get("PADDLE_TPU_HELPER_CPU")
+            if rank == "0":
+                assert has is None
+            else:
+                assert has == "1"
+        """)
+        assert LocalController(script, nproc=2, watch_rank0=False).run() == 0
+
+    def test_launch_main_multiproc(self, tmp_path):
+        from paddle_tpu.distributed.launch.main import main
+        script = _script(tmp_path, """
+            import os
+            print("hello from", os.environ["PADDLE_TRAINER_ID"])
+        """)
+        with pytest.raises(SystemExit) as e:
+            main(["--nproc_per_node", "2", "--log_dir",
+                  str(tmp_path / "l"), script])
+        assert e.value.code == 0
