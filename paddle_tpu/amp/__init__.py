@@ -260,3 +260,6 @@ def is_bfloat16_supported():
 
 def is_float16_supported():
     return True
+
+
+from . import debugging  # noqa: E402  (numerical sanitizers, SURVEY §5)

@@ -1,0 +1,418 @@
+"""AMP numerical-debugging toolkit (SURVEY §5 "numerical sanitizers").
+
+Capability parity with the reference's ``python/paddle/amp/debugging.py``
+(TensorCheckerConfig, check_numerics, enable/disable_tensor_checker,
+operator-stats collection, compare_accuracy) re-designed for the TPU stack:
+instead of a C++ nan_inf_utils kernel pass (reference:
+paddle/fluid/framework/details/nan_inf_utils_detail.cc), the checker is an
+eager post-op hook on the single dispatch chokepoint, and the statistics are
+computed as fused XLA reductions on-device — one ``jnp.isnan``/``isinf``
+reduction pair per checked tensor, no host round-trip until a finding is
+reported.
+
+Under ``jit`` tracing the hooks see tracers and skip concrete checks (the
+sanitizer is an eager-mode tool, matching the reference's dygraph checker).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+
+from ..framework import dispatch as _dispatch
+from ..framework import dtype as _dtypes
+from ..framework.tensor import Tensor, wrap_array
+
+__all__ = [
+    "DebugMode", "TensorCheckerConfig", "check_numerics",
+    "enable_tensor_checker", "disable_tensor_checker",
+    "set_checked_op_list", "set_skipped_op_list", "check_layer_numerics",
+    "enable_operator_stats_collection", "disable_operator_stats_collection",
+    "collect_operator_stats", "compare_accuracy",
+]
+
+
+class DebugMode(Enum):
+    """What the tensor checker does on a finding (reference debugging.py:56)."""
+    CHECK_NAN_INF_AND_ABORT = 0   # raise on nan/inf
+    CHECK_NAN_INF = 1             # log nan/inf, keep running
+    CHECK_ALL_FOR_OVERFLOW = 2    # also log fp16/bf16-range overflow
+    CHECK_ALL = 3                 # log stats for every checked op
+    CHECK_ALL_AND_ABORT = 4
+    DUMP_ALL = 5
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _tensor_stats(data):
+    """One fused pass over ``data``: (num_nan, num_inf, num_zero, max, min,
+    mean). All six reductions fuse into a single XLA computation."""
+    f = data.astype(jnp.float32)
+    return (jnp.sum(jnp.isnan(f)), jnp.sum(jnp.isinf(f)),
+            jnp.sum(f == 0.0), jnp.max(f), jnp.min(f), jnp.mean(f))
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Compute nan/inf/zero statistics of ``tensor`` (reference
+    debugging.py:361; phi op ``check_numerics``).
+
+    Returns ``(stats, values)`` — ``stats`` is an int32 Tensor
+    ``[num_nan, num_inf, num_zero]``, ``values`` a float32 Tensor
+    ``[max, min, mean]``.  In an ABORT mode, raises ``FloatingPointError``
+    when any nan/inf is present.
+    """
+    data = tensor._data if _is_tensor(tensor) else jnp.asarray(tensor)
+    n_nan, n_inf, n_zero, mx, mn, mean = _tensor_stats(data)
+    stats = wrap_array(jnp.stack([n_nan, n_inf, n_zero]).astype(jnp.int32))
+    values = wrap_array(jnp.stack([mx, mn, mean]))
+    if debug_mode in (DebugMode.CHECK_NAN_INF_AND_ABORT,
+                      DebugMode.CHECK_ALL_AND_ABORT):
+        if not isinstance(data, jax.core.Tracer):
+            bad = int(stats._data[0]) + int(stats._data[1])
+            if bad:
+                raise FloatingPointError(
+                    f"[check_numerics] op={op_type!r} var={var_name!r}: "
+                    f"{int(stats._data[0])} nan, {int(stats._data[1])} inf "
+                    f"(max={float(mx)}, min={float(mn)}, mean={float(mean)})")
+    return stats, values
+
+
+class TensorCheckerConfig:
+    """Configuration for the global tensor checker (reference
+    debugging.py:173).
+
+    Args:
+        enable: master switch.
+        debug_mode: a :class:`DebugMode`.
+        output_dir: when set, findings are appended as JSON lines to
+            ``<output_dir>/worker_<pid>.log`` (consumed by
+            :func:`compare_accuracy`).
+        checked_op_list / skipped_op_list: restrict / exempt op names.
+        debug_step: optional ``(start, end)`` step interval to check.
+        stack_height_limit: kept for API parity (host Python stacks are
+            cheap here; unused).
+    """
+
+    def __init__(self, enable: bool,
+                 debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir: Optional[str] = None,
+                 checked_op_list: Optional[Sequence[str]] = None,
+                 skipped_op_list: Optional[Sequence[str]] = None,
+                 debug_step: Optional[tuple] = None,
+                 stack_height_limit: int = 1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+        self.initial_seed = 123
+        self._step = 0
+        if debug_step is not None:
+            start, end = debug_step
+            if start >= end:
+                raise ValueError(
+                    f"debug_step must be (start, end) with start < end, "
+                    f"got {debug_step}")
+
+    def update_and_check_step_id(self) -> bool:
+        """Advance the step counter; True when this step is in-range."""
+        self._step += 1
+        if self.debug_step is None:
+            return True
+        start, end = self.debug_step
+        return start <= self._step <= end
+
+    def _step_in_range(self) -> bool:
+        if self.debug_step is None:
+            return True
+        start, end = self.debug_step
+        return start <= self._step <= end
+
+
+class _CheckerState:
+    config: Optional[TensorCheckerConfig] = None
+    hook: Optional[Callable] = None
+    log_fh = None
+    findings: int = 0
+
+
+_checker = _CheckerState()
+_checker_lock = threading.Lock()
+
+
+def set_checked_op_list(checked_op_list: Optional[Sequence[str]]) -> None:
+    """Narrow the active checker to these op names (reference :153)."""
+    if _checker.config is not None:
+        _checker.config.checked_op_list = set(checked_op_list or [])
+
+
+def set_skipped_op_list(skipped_op_list: Optional[Sequence[str]]) -> None:
+    """Exempt these op names from the active checker (reference :163)."""
+    if _checker.config is not None:
+        _checker.config.skipped_op_list = set(skipped_op_list or [])
+
+
+def _emit_finding(cfg, record):
+    _checker.findings += 1
+    line = json.dumps(record)
+    if _checker.log_fh is not None:
+        _checker.log_fh.write(line + "\n")
+        _checker.log_fh.flush()
+    else:
+        print("[tensor_checker]", line)
+
+
+def _checker_hook(op_name, result):
+    cfg = _checker.config
+    if cfg is None or not cfg.enable or not cfg._step_in_range():
+        return
+    if op_name in cfg.skipped_op_list:
+        return
+    if cfg.checked_op_list and op_name not in cfg.checked_op_list:
+        return
+    flat, _ = jtu.tree_flatten(result, is_leaf=_is_tensor)
+    for i, t in enumerate(flat):
+        if not _is_tensor(t) or not _dtypes.is_floating_point(t.dtype):
+            continue
+        if isinstance(t._data, jax.core.Tracer):
+            continue   # eager-mode sanitizer: skip under tracing
+        n_nan, n_inf, n_zero, mx, mn, mean = _tensor_stats(t._data)
+        bad = int(n_nan) + int(n_inf)
+        dump_all = cfg.debug_mode in (DebugMode.CHECK_ALL,
+                                      DebugMode.CHECK_ALL_AND_ABORT,
+                                      DebugMode.DUMP_ALL)
+        overflow = False
+        if cfg.debug_mode == DebugMode.CHECK_ALL_FOR_OVERFLOW:
+            lim = 65504.0 if t.dtype == _dtypes.float16 else 3.38e38
+            overflow = bool(jnp.max(jnp.abs(
+                t._data.astype(jnp.float32))) > lim)
+        if not (bad or dump_all or overflow):
+            continue
+        record = {
+            "ts": time.time(), "op": op_name, "out_index": i,
+            "dtype": str(t.dtype), "shape": list(t.shape),
+            "num_nan": int(n_nan), "num_inf": int(n_inf),
+            "num_zero": int(n_zero), "max": float(mx), "min": float(mn),
+            "mean": float(mean), "step": cfg._step,
+        }
+        _emit_finding(cfg, record)
+        if bad and cfg.debug_mode in (DebugMode.CHECK_NAN_INF_AND_ABORT,
+                                      DebugMode.CHECK_ALL_AND_ABORT):
+            raise FloatingPointError(
+                f"[tensor_checker] nan/inf in output {i} of op "
+                f"{op_name!r}: {record}")
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig) -> None:
+    """Install the global nan/inf checker on the op-dispatch chokepoint
+    (reference debugging.py:654)."""
+    with _checker_lock:
+        disable_tensor_checker()
+        _checker.config = checker_config
+        _checker.findings = 0
+        if checker_config.output_dir:
+            os.makedirs(checker_config.output_dir, exist_ok=True)
+            path = os.path.join(checker_config.output_dir,
+                                f"worker_{os.getpid()}.log")
+            _checker.log_fh = open(path, "a")
+        if checker_config.enable:
+            _checker.hook = _checker_hook
+            _dispatch.add_post_op_hook(_checker_hook)
+
+
+def disable_tensor_checker() -> None:
+    """Remove the global checker (reference debugging.py:695)."""
+    if _checker.hook is not None:
+        _dispatch.remove_post_op_hook(_checker.hook)
+        _checker.hook = None
+    if _checker.log_fh is not None:
+        _checker.log_fh.close()
+        _checker.log_fh = None
+    _checker.config = None
+
+
+def check_layer_numerics(func):
+    """Decorator for a Layer's ``forward``: checks its tensor inputs and
+    outputs for nan/inf (reference debugging.py:78)."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        name = type(self).__name__
+        for i, a in enumerate(args):
+            if _is_tensor(a) and _dtypes.is_floating_point(a.dtype) \
+                    and not isinstance(a._data, jax.core.Tracer):
+                check_numerics(a, op_type=f"{name}.forward",
+                               var_name=f"input[{i}]")
+        out = func(self, *args, **kwargs)
+        flat, _ = jtu.tree_flatten(out, is_leaf=_is_tensor)
+        for i, t in enumerate(flat):
+            if _is_tensor(t) and _dtypes.is_floating_point(t.dtype) \
+                    and not isinstance(t._data, jax.core.Tracer):
+                check_numerics(t, op_type=f"{name}.forward",
+                               var_name=f"output[{i}]")
+        return out
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# Low-precision operator statistics (reference debugging.py:481-592)
+# ---------------------------------------------------------------------------
+
+class _OpStatsState:
+    active: bool = False
+    hook: Optional[Callable] = None
+    # op name -> [fp16 calls, bf16 calls, fp32 calls, other calls]
+    counts: dict = {}
+
+
+_op_stats = _OpStatsState()
+
+
+def _op_stats_hook(op_name, result):
+    flat, _ = jtu.tree_flatten(result, is_leaf=_is_tensor)
+    for t in flat:
+        if not _is_tensor(t):
+            continue
+        if isinstance(t._data, jax.core.Tracer):
+            return   # eager-mode counter: trace-time ops are not executions
+        row = _op_stats.counts.setdefault(op_name, [0, 0, 0, 0])
+        if t.dtype == _dtypes.float16:
+            row[0] += 1
+        elif t.dtype == _dtypes.bfloat16:
+            row[1] += 1
+        elif t.dtype == _dtypes.float32:
+            row[2] += 1
+        else:
+            row[3] += 1
+        break   # one count per op call, classified by its first output
+
+
+def _print_operator_stats(op_count_dict) -> None:
+    """Pretty table: op, fp16/bf16/fp32/other call counts (reference
+    debugging.py:437)."""
+    print("<{:-^120}>".format(" op list "))
+    fmt = "{:-^40}|{:-^17}|{:-^17}|{:-^17}|{:-^17}"
+    print(fmt.format(" Op Name ", " FP16 Calls ", " BF16 Calls ",
+                     " FP32 Calls ", " Other Calls "))
+    for op, row in sorted(op_count_dict.items()):
+        if isinstance(row, str):
+            row = [int(x) for x in row.split(",")]
+        print("  {:<40}|  {:<17}|  {:<17}|  {:<15}|  {:<15}".format(
+            op, row[0], row[1], row[2], row[3]))
+    print("<{:-^120}>".format(""))
+
+
+def enable_operator_stats_collection() -> None:
+    """Begin counting eager op calls by output dtype (reference
+    debugging.py:481)."""
+    if _op_stats.active:
+        return
+    _op_stats.counts = {}
+    _op_stats.active = True
+    _op_stats.hook = _op_stats_hook
+    _dispatch.add_post_op_hook(_op_stats_hook)
+
+
+def disable_operator_stats_collection() -> None:
+    """Stop collection and print the table (reference debugging.py:519)."""
+    if not _op_stats.active:
+        return
+    _dispatch.remove_post_op_hook(_op_stats.hook)
+    _op_stats.active = False
+    _op_stats.hook = None
+    _print_operator_stats(_op_stats.counts)
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Context manager form (reference debugging.py:560)::
+
+        with paddle.amp.debugging.collect_operator_stats():
+            out = model(x)
+    """
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def operator_stats_dict() -> dict:
+    """Snapshot of the current counts — ``{op: [fp16, bf16, fp32, other]}``.
+    TPU-native extension (the reference only prints)."""
+    return {k: list(v) for k, v in _op_stats.counts.items()}
+
+
+# ---------------------------------------------------------------------------
+# Cross-run accuracy comparison (reference debugging.py:595)
+# ---------------------------------------------------------------------------
+
+def _load_run_logs(log_dir):
+    records = {}
+    if not os.path.isdir(log_dir):
+        raise FileNotFoundError(f"compare_accuracy: no such dir {log_dir!r}")
+    for fname in sorted(os.listdir(log_dir)):
+        if not fname.endswith(".log"):
+            continue
+        with open(os.path.join(log_dir, fname)) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                key = (r.get("op"), r.get("out_index", 0))
+                records.setdefault(key, []).append(r)
+    return records
+
+
+def compare_accuracy(dump_path: str, another_dump_path: str,
+                     output_filename: str,
+                     loss_scale: float = 1.0,
+                     dump_all_tensors: bool = False):
+    """Compare two tensor-checker run logs — e.g. an fp32 run vs an amp run —
+    and write a merged report listing ops whose numerical behavior diverges
+    (reference debugging.py:595; the reference writes xlsx, this writes CSV +
+    returns the row dicts).
+    """
+    run1 = _load_run_logs(dump_path)
+    run2 = _load_run_logs(another_dump_path)
+    rows = []
+    for key in sorted(set(run1) | set(run2), key=str):
+        r1 = run1.get(key, [])
+        r2 = run2.get(key, [])
+        bad1 = sum(r["num_nan"] + r["num_inf"] for r in r1)
+        bad2 = sum(r["num_nan"] + r["num_inf"] for r in r2)
+        if not dump_all_tensors and not (bad1 or bad2):
+            continue
+        rows.append({
+            "op": key[0], "out_index": key[1],
+            "run1_events": len(r1), "run1_nan_inf": bad1,
+            "run1_max": max((r["max"] for r in r1), default=None),
+            "run2_events": len(r2), "run2_nan_inf": bad2,
+            "run2_max": max((r["max"] for r in r2), default=None),
+            "mismatch": (bad1 > 0) != (bad2 > 0),
+        })
+    with open(output_filename, "w") as fh:
+        cols = ["op", "out_index", "run1_events", "run1_nan_inf", "run1_max",
+                "run2_events", "run2_nan_inf", "run2_max", "mismatch"]
+        fh.write(",".join(cols) + "\n")
+        for row in rows:
+            fh.write(",".join(str(row[c]) for c in cols) + "\n")
+    return rows

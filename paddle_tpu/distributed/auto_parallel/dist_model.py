@@ -16,15 +16,10 @@ over those placements.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
-
-import numpy as np
-import jax
-import jax.numpy as jnp
-import jax.tree_util as jtu
+from typing import Optional
 
 from ...framework.tape import no_grad
-from ...framework.tensor import Tensor, wrap_array
+from ...framework.tensor import Tensor
 
 __all__ = ["DistModel", "to_static", "Strategy"]
 
@@ -96,9 +91,16 @@ class DistModel:
                 "yet — steps apply every batch (no accumulation)")
         if self._strategy.sharding.enable and optimizer is not None:
             from ..fleet.sharding import group_sharded_parallel
-            level = {1: "os", 2: "os_g", 3: "p_g_os"}[
-                int(self._strategy.sharding.stage)]
-            _, optimizer, _ = group_sharded_parallel(layer, optimizer, level)
+            stage = self._strategy.sharding.stage
+            try:
+                level = {1: "os", 2: "os_g", 3: "p_g_os"}[int(stage)]
+            except (KeyError, ValueError, TypeError):
+                raise ValueError(
+                    f"Strategy.sharding.stage must be 1, 2 or 3 "
+                    f"(got {stage!r})") from None
+            _, optimizer, _ = group_sharded_parallel(
+                layer, optimizer, level,
+                degree=int(self._strategy.sharding.degree))
             self._optimizer = optimizer
 
     # ------------------------------------------------------------ mode gates

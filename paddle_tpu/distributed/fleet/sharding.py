@@ -30,7 +30,7 @@ from ..auto_parallel.api import shard_tensor, shard_optimizer
 from .topology import get_hybrid_communicate_group
 
 
-def _sharding_mesh(axis="sharding"):
+def _sharding_mesh(axis="sharding", degree=None):
     hcg = get_hybrid_communicate_group()
     if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
         return hcg.mesh, "sharding"
@@ -38,20 +38,28 @@ def _sharding_mesh(axis="sharding"):
     if m is not None and axis in m.dim_names:
         return m, axis
     n = jax.device_count()
+    if degree is not None and 1 < degree < n and n % degree == 0:
+        # ZeRO over groups of `degree`, pure DP across groups (reference:
+        # sharding_degree subdividing the world)
+        return ProcessMesh(np.arange(n).reshape(n // degree, degree),
+                           ["dp", axis]), axis
     return ProcessMesh(np.arange(n), [axis]), axis
 
 
 def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
                            offload=False, sync_buffers=False, buffer_max_size=None,
                            segment_size=None, sync_comm=False,
-                           dp_group=None, exclude_layer=None):
+                           dp_group=None, exclude_layer=None, degree=None):
     """reference: paddle.distributed.sharding.group_sharded_parallel.
 
     level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3).
+    degree: shard over groups of this many devices (replicated across
+    groups); honored when it divides the device count and no mesh with a
+    sharding axis is already installed, else the full world is used.
     """
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"level must be os|os_g|p_g_os, got {level}")
-    mesh, axis = _sharding_mesh()
+    mesh, axis = _sharding_mesh(degree=degree)
     degree = mesh.get_dim_size(axis)
     axis_idx = mesh.dim_names.index(axis)
 

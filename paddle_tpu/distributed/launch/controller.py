@@ -42,9 +42,13 @@ class ProcContext:
             out = self._log_f
         else:
             out = None
-        self.proc = subprocess.Popen(
-            self.cmd, env=self.env, stdout=out,
-            stderr=subprocess.STDOUT if out else None)
+        try:
+            self.proc = subprocess.Popen(
+                self.cmd, env=self.env, stdout=out,
+                stderr=subprocess.STDOUT if out else None)
+        except BaseException:
+            self.close()   # Popen failed (bad script, EMFILE): don't leak fd
+            raise
         return self
 
     def alive(self) -> bool:
@@ -139,10 +143,39 @@ class LocalController:
         self.watch_rank0 = watch_rank0 and log_dir is not None
         self.helper_cpu_only = helper_cpu_only
         self.procs: List[ProcContext] = []
+        self._store = None   # node-rendezvous store (multi-host only)
+
+    def _exchange_endpoints(self, local_eps: List[str]) -> List[str]:
+        """Cross-host endpoint exchange over the master TCPStore (reference:
+        launch/controllers/master.py:73,186 — the master KV each node
+        registers with).  The node-0 launcher hosts the store; every
+        launcher publishes its local endpoint list, then reads all nodes'
+        lists in node order to assemble the global contract."""
+        from ..store import TCPStore
+        host, port = self.master.rsplit(":", 1)
+        if self._store is None:
+            self._store = TCPStore(host, int(port),
+                                   is_master=(self.node_rank == 0),
+                                   world_size=self.nnodes)
+        prefix = f"launch/{self.job_id}"
+        self._store.set(f"{prefix}/node/{self.node_rank}",
+                        ",".join(local_eps))
+        out: List[str] = []
+        for node in range(self.nnodes):
+            self._store.wait(f"{prefix}/node/{node}", timeout=120.0)
+            val = self._store.get(f"{prefix}/node/{node}")
+            if isinstance(val, bytes):
+                val = val.decode()
+            out.extend(val.split(","))
+        return out
 
     def _build(self) -> List[ProcContext]:
-        endpoints = ",".join(
-            f"127.0.0.1:{_free_port()}" for _ in range(self.nproc))
+        host = "127.0.0.1" if self.nnodes == 1 else _host_ip()
+        local_eps = [f"{host}:{_free_port()}" for _ in range(self.nproc)]
+        if self.nnodes > 1:
+            endpoints = ",".join(self._exchange_endpoints(local_eps))
+        else:
+            endpoints = ",".join(local_eps)
         world = self.nnodes * self.nproc
         procs = []
         for rank in range(self.nproc):
@@ -159,6 +192,11 @@ class LocalController:
                 "PADDLE_TRAINER_ENDPOINTS": endpoints,
                 "PADDLE_JOB_ID": self.job_id,
             })
+            if self.nnodes > 1:
+                # the node-0 LAUNCHER hosts the master store (reference:
+                # controllers/master.py KV service) — trainer rank 0 must
+                # connect as a client, not re-bind the port
+                env["PADDLE_MASTER_BOUND"] = "1"
             if self.helper_cpu_only and rank > 0:
                 # worker ranks beyond 0 are host-level helpers: never let a
                 # wedged accelerator plugin hang them
@@ -203,6 +241,14 @@ class LocalController:
         return started
 
     def run(self) -> int:
+        try:
+            return self._run()
+        finally:
+            if self._store is not None:
+                self._store.close()
+                self._store = None
+
+    def _run(self) -> int:
         restarts = 0
         while True:
             self.procs = self._start_all()
@@ -227,6 +273,11 @@ class LocalController:
                 return 0
             if interrupted:
                 return code        # user asked to stop — never auto-restart
+            if self.nnodes > 1:
+                # cross-host restart needs job-level coordination (every
+                # node must re-rendezvous together) — leave it to the
+                # cluster scheduler, like the reference's master controller
+                return code
             if self.elastic_level >= 1 and restarts < self.max_restarts:
                 restarts += 1
                 print(f"[launch] elastic restart {restarts}/"
@@ -242,3 +293,21 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _host_ip() -> str:
+    """This host's address as peers can reach it (multi-node endpoints)."""
+    import socket
+    try:
+        # connecting a UDP socket picks the outbound interface, no traffic
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"

@@ -383,6 +383,10 @@ def _create_or_get_global_tcp_store_locked() -> TCPStore:
     host, port = endpoint.rsplit(":", 1)
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    _global_store = TCPStore(host, int(port), is_master=(rank == 0),
+    # PADDLE_MASTER_BOUND: the launcher already hosts the store at this
+    # address (multi-node mode) — every rank connects as a client
+    bound = os.environ.get("PADDLE_MASTER_BOUND", "") not in ("", "0")
+    _global_store = TCPStore(host, int(port),
+                             is_master=(rank == 0 and not bound),
                              world_size=world)
     return _global_store

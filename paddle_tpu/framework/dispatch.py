@@ -67,6 +67,32 @@ def set_amp_cast_hook(hook: Optional[Callable]) -> None:
     _amp_cast_hook = hook
 
 
+# Post-op observer hooks (numerical sanitizers, operator-stats collectors —
+# SURVEY §5 "race/numerical sanitizers"; reference: the check_nan_inf plumbing
+# of paddle/fluid/framework/details/nan_inf_utils_detail.cc and the low-
+# precision op counters behind paddle/amp/debugging.py).  Each hook is called
+# as ``hook(op_name, result)`` after every eager op; the empty-list fast path
+# costs one truthiness check.
+_post_op_hooks: list = []
+
+
+def add_post_op_hook(hook: Callable) -> Callable:
+    _post_op_hooks.append(hook)
+    return hook
+
+
+def remove_post_op_hook(hook: Callable) -> None:
+    try:
+        _post_op_hooks.remove(hook)
+    except ValueError:
+        pass
+
+
+def _run_post_op_hooks(name, result):
+    for h in list(_post_op_hooks):
+        h(name, result)
+
+
 # Host-event recorder hook, installed while a Profiler is in a RECORD state:
 # records one span per eager op (reference: RecordEvent spans auto-inserted by
 # eager_gen.py:322).  None when profiling is off, so the hot path pays one
@@ -120,6 +146,8 @@ def _call_op_impl(name: str, fn: Callable, args: tuple, kwargs: dict):
         result, _, _ = _wrap_outputs(out)
         _apply_spmd_rule(name, leaves, tensor_idx, treedef, result)
         _check_nan_inf(name, result)
+        if _post_op_hooks:
+            _run_post_op_hooks(name, result)
         return result
 
     # Differentiate w.r.t. the requires-grad floating inputs only; others are
@@ -149,6 +177,8 @@ def _call_op_impl(name: str, fn: Callable, args: tuple, kwargs: dict):
     _stamp_outputs(result, node)
     _apply_spmd_rule(name, leaves, tensor_idx, treedef, result)
     _check_nan_inf(name, result)
+    if _post_op_hooks:
+        _run_post_op_hooks(name, result)
     return result
 
 

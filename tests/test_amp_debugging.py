@@ -1,0 +1,138 @@
+"""Tests for paddle_tpu.amp.debugging (reference python/paddle/amp/debugging.py
+surface: TensorCheckerConfig, check_numerics, operator stats, compare_accuracy)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.amp import debugging as dbg
+
+
+class TestCheckNumerics:
+    def test_abort_on_nan_inf(self):
+        t = paddle.to_tensor(np.array([1.0, np.nan, np.inf], np.float32))
+        with pytest.raises(FloatingPointError):
+            dbg.check_numerics(t, "op", "t")
+
+    def test_stats_values(self):
+        t = paddle.to_tensor(np.array([[1.0, np.nan], [0.0, -np.inf]],
+                                      np.float32))
+        stats, values = dbg.check_numerics(
+            t, "op", "t", dbg.DebugMode.CHECK_NAN_INF)
+        assert stats.numpy().tolist() == [1, 1, 1]
+
+    def test_clean_tensor(self):
+        t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        stats, values = dbg.check_numerics(t, "op", "t")
+        assert stats.numpy().tolist() == [0, 0, 0]
+        np.testing.assert_allclose(values.numpy(), [2.0, 1.0, 1.5])
+
+
+class TestTensorChecker:
+    def test_abort_mode_raises_at_op(self):
+        cfg = dbg.TensorCheckerConfig(
+            enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT)
+        dbg.enable_tensor_checker(cfg)
+        try:
+            x = paddle.to_tensor(np.array([-1.0], np.float32))
+            with pytest.raises(FloatingPointError):
+                paddle.sqrt(x)   # nan
+        finally:
+            dbg.disable_tensor_checker()
+
+    def test_log_mode_writes_findings(self, tmp_path):
+        out = str(tmp_path / "run1")
+        cfg = dbg.TensorCheckerConfig(
+            enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF,
+            output_dir=out)
+        dbg.enable_tensor_checker(cfg)
+        try:
+            x = paddle.to_tensor(np.array([0.0], np.float32))
+            paddle.log(x)    # -inf: logged, not raised
+        finally:
+            dbg.disable_tensor_checker()
+        logs = [f for f in os.listdir(out) if f.endswith(".log")]
+        assert logs
+        rec = json.loads(open(os.path.join(out, logs[0])).read()
+                         .strip().splitlines()[0])
+        assert rec["op"] == "log" and rec["num_inf"] == 1
+
+    def test_skipped_op_list(self):
+        cfg = dbg.TensorCheckerConfig(
+            enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT,
+            skipped_op_list=["sqrt"])
+        dbg.enable_tensor_checker(cfg)
+        try:
+            x = paddle.to_tensor(np.array([-1.0], np.float32))
+            paddle.sqrt(x)   # exempted: no raise
+        finally:
+            dbg.disable_tensor_checker()
+
+    def test_checked_op_list_narrows(self):
+        cfg = dbg.TensorCheckerConfig(
+            enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT,
+            checked_op_list=["log"])
+        dbg.enable_tensor_checker(cfg)
+        try:
+            x = paddle.to_tensor(np.array([-1.0], np.float32))
+            paddle.sqrt(x)   # not in checked list: passes
+            with pytest.raises(FloatingPointError):
+                paddle.log(x)
+        finally:
+            dbg.disable_tensor_checker()
+
+    def test_debug_step_gating(self):
+        cfg = dbg.TensorCheckerConfig(
+            enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT,
+            debug_step=(1, 2))
+        assert cfg.update_and_check_step_id()      # step 1: in range
+        assert cfg.update_and_check_step_id()      # step 2
+        assert not cfg.update_and_check_step_id()  # step 3: out
+
+    def test_check_layer_numerics_decorator(self):
+        class Bad(paddle.nn.Layer):
+            @dbg.check_layer_numerics
+            def forward(self, x):
+                return paddle.log(x)
+
+        layer = Bad()
+        x = paddle.to_tensor(np.array([-1.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            layer(x)
+
+
+class TestOperatorStats:
+    def test_collect_counts_by_dtype(self):
+        with dbg.collect_operator_stats():
+            a = paddle.to_tensor(np.ones((2, 2), np.float32))
+            a @ a
+            b = a.astype("bfloat16")
+            b @ b
+        sd = dbg.operator_stats_dict()
+        assert sd["matmul"][1] == 1    # one bf16 call
+        assert sd["matmul"][2] == 1    # one fp32 call
+
+    def test_disable_is_idempotent(self):
+        dbg.disable_operator_stats_collection()
+        dbg.disable_operator_stats_collection()
+
+
+class TestCompareAccuracy:
+    def test_divergent_runs(self, tmp_path):
+        d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+        for d, val in ((d1, 0.0), (d2, 1.0)):
+            cfg = dbg.TensorCheckerConfig(
+                enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF,
+                output_dir=d)
+            dbg.enable_tensor_checker(cfg)
+            try:
+                paddle.log(paddle.to_tensor(np.array([val], np.float32)))
+            finally:
+                dbg.disable_tensor_checker()
+        out = str(tmp_path / "cmp.csv")
+        rows = dbg.compare_accuracy(d1, d2, out)
+        assert len(rows) == 1
+        assert rows[0]["op"] == "log" and rows[0]["mismatch"]
+        assert os.path.exists(out)

@@ -84,3 +84,45 @@ class TestLocalController:
             main(["--nproc_per_node", "2", "--log_dir",
                   str(tmp_path / "l"), script])
         assert e.value.code == 0
+
+    def test_multinode_endpoint_exchange(self, tmp_path):
+        """Two launchers (nnodes=2) on one machine: the node-0 launcher
+        hosts the master store, both exchange endpoint lists, and every
+        child sees the full world-sized global contract in node order."""
+        import socket
+        import threading
+
+        script = _script(tmp_path, """
+            import os
+            eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+            world = int(os.environ["PADDLE_TRAINERS_NUM"])
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            assert len(eps) == world == 4, (eps, world)
+            assert len(set(eps)) == 4          # all distinct
+            assert os.environ["PADDLE_MASTER_BOUND"] == "1"
+            print(f"rank {rank} sees {len(eps)} endpoints", flush=True)
+        """)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        master = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        codes = {}
+
+        def launch(node_rank):
+            codes[node_rank] = LocalController(
+                script, nproc=2, nnodes=2, node_rank=node_rank,
+                master=master, watch_rank0=False).run()
+
+        t1 = threading.Thread(target=launch, args=(1,))
+        t1.start()
+        launch(0)
+        t1.join(timeout=60)
+        assert codes == {0: 0, 1: 0}
+
+    def test_popen_failure_closes_log_fd(self, tmp_path):
+        from paddle_tpu.distributed.launch.controller import ProcContext
+        pc = ProcContext(0, ["/nonexistent-binary-xyz"], dict(os.environ),
+                         str(tmp_path / "log.0"))
+        with pytest.raises(OSError):
+            pc.start()
+        assert pc._log_f is None       # fd released on Popen failure
