@@ -122,10 +122,10 @@ class TensorCheckerConfig:
         self._step = 0
         if debug_step is not None:
             start, end = debug_step
-            if start >= end:
+            if start > end:
                 raise ValueError(
-                    f"debug_step must be (start, end) with start < end, "
-                    f"got {debug_step}")
+                    f"debug_step must be (start, end) with start <= end "
+                    f"(both inclusive), got {debug_step}")
 
     def update_and_check_step_id(self) -> bool:
         """Advance the step counter; True when this step is in-range."""
@@ -223,6 +223,9 @@ def enable_tensor_checker(checker_config: TensorCheckerConfig) -> None:
         disable_tensor_checker()
         _checker.config = checker_config
         _checker.findings = 0
+        # reference semantics: enable is called per training iteration and
+        # advances the step counter used by debug_step gating
+        checker_config.update_and_check_step_id()
         if checker_config.output_dir:
             os.makedirs(checker_config.output_dir, exist_ok=True)
             path = os.path.join(checker_config.output_dir,

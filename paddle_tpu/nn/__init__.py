@@ -8,18 +8,21 @@ from . import initializer  # noqa: F401
 
 from .layer.layers import (  # noqa: F401
     Layer, ParamAttr, Sequential, LayerList, LayerDict, ParameterList,
-    Identity,
+    ParameterDict, Identity,
 )
 from .layer.common import (  # noqa: F401
     Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
     Unflatten, Pad1D, Pad2D, Pad3D, ZeroPad2D, Upsample,
     UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle, PixelUnshuffle,
     Bilinear, CosineSimilarity, Unfold, Fold, MaxUnPool2D, ChannelShuffle,
-    SpectralNorm,
+    SpectralNorm, ZeroPad1D, ZeroPad3D, PairwiseDistance, FeatureAlphaDropout,
 )
 from .layer.conv_pool import (  # noqa: F401
     Conv1D, Conv2D, Conv3D, Conv2DTranspose, MaxPool1D, MaxPool2D, AvgPool1D,
     AvgPool2D, AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+    Conv1DTranspose, Conv3DTranspose, MaxPool3D, AvgPool3D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool3D, LPPool1D, LPPool2D,
+    FractionalMaxPool2D, FractionalMaxPool3D, MaxUnPool1D, MaxUnPool3D,
 )
 from .layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
@@ -30,13 +33,15 @@ from .layer.activation import (  # noqa: F401
     CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
     LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
     SELU, Sigmoid, Silu, Softmax, Softplus, Softshrink, Softsign, Swish,
-    Tanh, Tanhshrink, ThresholdedReLU,
+    Tanh, Tanhshrink, ThresholdedReLU, RReLU, Softmax2D,
 )
 from .layer.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     KLDivLoss, SmoothL1Loss, HuberLoss, MarginRankingLoss,
     HingeEmbeddingLoss, CosineEmbeddingLoss, TripletMarginLoss,
-    CTCLoss,
+    CTCLoss, GaussianNLLLoss, PoissonNLLLoss, SoftMarginLoss,
+    MultiLabelSoftMarginLoss, MultiMarginLoss, TripletMarginWithDistanceLoss,
+    HSigmoidLoss, RNNTLoss, AdaptiveLogSoftmaxWithLoss,
 )
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
@@ -44,8 +49,10 @@ from .layer.transformer import (  # noqa: F401
 )
 from .layer.rnn import (  # noqa: F401
     SimpleRNN, LSTM, GRU, LSTMCell, GRUCell, SimpleRNNCell, RNNBase,
+    RNN, BiRNN, RNNCellBase,
 )
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
 )
 from . import quant  # noqa: F401  (quantization layers, SURVEY #70)
+from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401

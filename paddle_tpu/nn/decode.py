@@ -1,0 +1,187 @@
+"""Seq2seq decoding: Decoder / BeamSearchDecoder / dynamic_decode.
+
+Capability parity: python/paddle/nn/decode.py (Decoder:50,
+BeamSearchDecoder:161, dynamic_decode:1238).
+
+TPU-native note: the decode loop is a host-side Python loop (steps are
+data-dependent on `finished`), but every step's beam expansion, top-k and
+state gather run as one fused XLA computation on device; the final
+backtrace is the compiled ``gather_tree`` op.  This matches the
+reference's dygraph path (decode.py: while loop over decoder.step).
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, wrap_array
+from . import functional as F
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _tree_map(fn, tree):
+    if isinstance(tree, (tuple, list)):
+        return type(tree)(_tree_map(fn, t) for t in tree)
+    return fn(tree)
+
+
+class Decoder:
+    """reference: nn/decode.py:50 — the step-decoder interface:
+    ``initialize() -> (inputs, states, finished)``,
+    ``step(time, inputs, states) -> (outputs, states, inputs, finished)``,
+    ``finalize(outputs, states, lengths)``."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """reference: nn/decode.py:161 — beam search over an RNN cell.
+
+    cell: a cell Layer ``(inputs, states) -> (outputs, new_states)``.
+    embedding_fn: token ids -> embeddings for the next step's inputs.
+    output_fn: projects cell output to vocab logits (e.g. a Linear).
+    """
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers ----------------------------------------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] by repeating each batch row."""
+        a = _arr(x)
+        tiled = jnp.repeat(a[:, None], beam_size, axis=1)
+        return wrap_array(tiled.reshape((-1,) + a.shape[1:]))
+
+    def _merge(self, a):        # [B, beam, ...] -> [B*beam, ...]
+        return a.reshape((-1,) + a.shape[2:])
+
+    def _split(self, a):        # [B*beam, ...] -> [B, beam, ...]
+        return a.reshape((-1, self.beam_size) + a.shape[1:])
+
+    # -- Decoder interface ------------------------------------------------
+    def initialize(self, initial_cell_states):
+        states = _tree_map(
+            lambda t: _arr(self.tile_beam_merge_with_batch(
+                t, self.beam_size)), initial_cell_states)
+        bxk = jax.tree_util.tree_leaves(states)[0].shape[0]
+        batch = bxk // self.beam_size
+        start = jnp.full((bxk,), self.start_token, jnp.int32)
+        inputs = self.embedding_fn(wrap_array(start)) \
+            if self.embedding_fn is not None else wrap_array(start)
+        # beam 0 live, the rest dead (standard first-step symmetry break)
+        log_probs = jnp.tile(
+            jnp.array([0.0] + [-1e9] * (self.beam_size - 1), jnp.float32),
+            (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int32)
+        state = self.StateWrapper(states, log_probs, finished, lengths)
+        return inputs, state, wrap_array(finished)
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_out, cell_states = self.cell(
+            inputs, _tree_map(wrap_array, states.cell_states), **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = _arr(cell_out)                       # [B*beam, V]
+        V = logits.shape[-1]
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        lp = self._split(lp)                          # [B, beam, V]
+        prev = states.log_probs[..., None]            # [B, beam, 1]
+        # finished beams only propagate through end_token with prob 1
+        fin = states.finished[..., None]
+        onehot_end = (jnp.arange(V) == self.end_token)
+        masked = jnp.where(onehot_end, 0.0, -1e9)
+        total = jnp.where(fin, prev + masked, prev + lp)   # [B, beam, V]
+        flat = total.reshape(total.shape[0], -1)           # [B, beam*V]
+        top_val, top_idx = jax.lax.top_k(flat, self.beam_size)
+        parent = (top_idx // V).astype(jnp.int32)          # [B, beam]
+        token = (top_idx % V).astype(jnp.int32)
+
+        batch = flat.shape[0]
+        brow = jnp.arange(batch)[:, None]
+
+        def gather_state(s):
+            split = self._split(s)                          # [B, beam, ...]
+            return self._merge(split[brow, parent])
+
+        next_cell = _tree_map(lambda t: gather_state(_arr(t)), cell_states)
+        was_fin = states.finished[brow, parent]
+        now_fin = was_fin | (token == self.end_token)
+        lengths = states.lengths[brow, parent] + (~was_fin).astype(jnp.int32)
+
+        next_state = self.StateWrapper(next_cell, top_val, now_fin, lengths)
+        outputs = self.OutputWrapper(wrap_array(top_val),
+                                     wrap_array(token),
+                                     wrap_array(parent))
+        flat_token = token.reshape(-1)
+        next_inputs = self.embedding_fn(wrap_array(flat_token)) \
+            if self.embedding_fn is not None else wrap_array(flat_token)
+        return outputs, next_state, next_inputs, wrap_array(now_fin)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrace parents to full sequences: [B, T, beam] ids."""
+        ids = jnp.stack([_arr(o.predicted_ids) for o in outputs])  # [T,B,K]
+        parents = jnp.stack([_arr(o.parent_ids) for o in outputs])
+        full = F.gather_tree(wrap_array(ids), wrap_array(parents))
+        return full, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """reference: nn/decode.py:1238 — run ``decoder`` until every lane
+    finishes or ``max_step_num`` steps elapse."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    step = 0
+    limit = int(max_step_num) if max_step_num is not None else 256
+    while step < limit:
+        out, states, inputs, finished = decoder.step(step, inputs, states,
+                                                     **kwargs)
+        outputs.append(out)
+        step += 1
+        if bool(jnp.all(_arr(finished))):
+            break
+    lengths = getattr(states, "lengths", None)
+    final, final_states = decoder.finalize(outputs, states, lengths)
+    if isinstance(final, Tensor) and not output_time_major:
+        final = wrap_array(jnp.moveaxis(_arr(final), 0, 1))  # [B, T, beam]
+    if return_length:
+        return final, final_states, wrap_array(lengths) \
+            if lengths is not None else None
+    return final, final_states

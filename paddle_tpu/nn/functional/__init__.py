@@ -401,8 +401,15 @@ def _conv2d_transpose(x, weight, bias, stride, padding, output_padding,
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      data_format="NCHW", output_size=None, name=None):
+    channel_last = data_format == "NHWC"
+    if output_size is not None:
+        from .pool_conv import opad_from_output_size
+        in_sp = tuple(x.shape[1:3]) if channel_last else tuple(x.shape[2:4])
+        output_padding = opad_from_output_size(
+            output_size, in_sp, stride, padding, dilation,
+            tuple(weight.shape[2:]), 2)
     return _conv2d_transpose(x, weight, bias, stride, padding, output_padding,
-                             dilation, groups, data_format == "NHWC")
+                             dilation, groups, channel_last)
 
 
 # ----------------------------------------------------------------- pooling
@@ -445,6 +452,17 @@ def _max_pool2d(x, ksize, stride, padding, channel_last, ceil_mode):
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        from .extra import max_pool2d_with_index
+        from ...tensor.manipulation import transpose
+        if data_format == "NHWC":
+            pooled, idx = max_pool2d_with_index(
+                transpose(x, [0, 3, 1, 2]), kernel_size, stride, padding,
+                ceil_mode)
+            return transpose(pooled, [0, 2, 3, 1]), \
+                transpose(idx, [0, 2, 3, 1])
+        return max_pool2d_with_index(x, kernel_size, stride, padding,
+                                     ceil_mode)
     return _max_pool2d(x, kernel_size, stride, padding, data_format == "NHWC",
                        ceil_mode)
 
@@ -470,6 +488,14 @@ def _max_pool1d(x, ksize, stride, padding, channel_last, ceil_mode):
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
+    if return_mask:
+        # singleton-W plane: the flat plane argmax IS the L index
+        from .extra import max_pool2d_with_index
+        pooled, idx = max_pool2d_with_index(
+            x[..., None], (kernel_size, 1),
+            (stride if stride is not None else kernel_size, 1), (padding, 0),
+            ceil_mode)
+        return pooled[..., 0], idx[..., 0]
     return _max_pool1d(x, kernel_size, stride, padding, False, ceil_mode)
 
 
@@ -1051,6 +1077,81 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
     return call_op("sequence_mask", _fn, (lengths,), {})
 
 
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """reference: F.feature_alpha_dropout — alpha dropout zeroing whole
+    channel maps (axis 1), preserving self-normalizing statistics."""
+    if not training or p == 0:
+        return x * 1.0
+    alpha = -1.7580993408473766
+
+    def _fn(x, key):
+        shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        a = ((1 - p) * (1 + p * alpha ** 2)) ** -0.5
+        b = -a * alpha * p
+        return (a * jnp.where(keep, x, alpha) + b).astype(x.dtype)
+    return call_op("feature_alpha_dropout", _fn,
+                   (x, _random.split_key()), {})
+
+
+@def_op("zeropad2d")
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    l, r, t, b = padding
+    if data_format == "NCHW":
+        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+    return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+
+
+@def_op("gather_tree")
+def gather_tree(ids, parents):
+    """reference: F.gather_tree (functional/extension.py) — backtrace beam
+    -search parents to full sequences.  [max_time, batch, beam] layout;
+    a reverse ``lax.scan`` carries the live beam index per (batch, beam)."""
+    T, B, K = ids.shape
+    binds = jnp.arange(B)[:, None]
+
+    def body(beam, xs):
+        ids_t, parents_tp1 = xs
+        beam_prev = parents_tp1[binds, beam]     # who produced this beam
+        return beam_prev, ids_t[binds, beam]
+
+    init = jnp.broadcast_to(jnp.arange(K), (B, K))
+    # step t uses parents at t+1 to pick the beam, then reads ids at t
+    last = ids[T - 1][binds, init]
+    if T == 1:
+        return last[None]
+    beam0 = parents[T - 1][binds, init]
+    _, rest = lax.scan(body, beam0,
+                       (jnp.flip(ids[:-1], 0), jnp.flip(parents[:-1], 0)))
+    return jnp.concatenate([jnp.flip(rest, 0), last[None]], axis=0)
+
+
+# --------------------------------------------------------------- in-place
+def _inplace(fn):
+    """Paddle-style ``op_(x)``: run the out-of-place op, then move its
+    value AND tape linkage onto x (the in-place result participates in
+    autograd exactly like the out-of-place one)."""
+    import functools
+
+    @functools.wraps(fn)
+    def inner(x, *args, **kwargs):
+        y = fn(x, *args, **kwargs)
+        x._data = y._data
+        x.stop_gradient = y.stop_gradient
+        x._grad_node = getattr(y, "_grad_node", None)
+        x._node_out_idx = getattr(y, "_node_out_idx", 0)
+        return x
+    return inner
+
+
+relu_ = _inplace(_g["relu"])
+tanh_ = _inplace(_g["tanh"])
+elu_ = _inplace(elu)
+hardtanh_ = _inplace(hardtanh)
+leaky_relu_ = _inplace(leaky_relu)
+softmax_ = _inplace(softmax)
+
+
 from .ctc import ctc_loss, ctc_decode  # noqa: E402,F401
 from .extra import (  # noqa: E402,F401
     nearest_interp, bilinear_interp, bicubic_interp, linear_interp,
@@ -1064,3 +1165,23 @@ from .extra import (  # noqa: E402,F401
     bce_loss, kldiv_loss, logsigmoid, max_unpool3d, l2_normalize, ctc_align,
 )
 from . import extra  # noqa: E402,F401
+
+log_sigmoid = logsigmoid
+thresholded_relu_ = _inplace(thresholded_relu)
+
+from .pool_conv import (  # noqa: E402,F401
+    max_pool3d, max_pool3d_with_index, avg_pool3d, adaptive_avg_pool3d,
+    adaptive_max_pool1d, adaptive_max_pool3d, lp_pool1d,
+    fractional_max_pool3d, max_unpool1d, conv1d_transpose, conv3d_transpose,
+)
+from .attention import (  # noqa: E402,F401
+    flash_attn_qkvpacked, flash_attn_varlen_qkvpacked, flashmask_attention,
+    sparse_attention,
+)
+from .loss_extra import (  # noqa: E402,F401
+    gaussian_nll_loss, poisson_nll_loss, soft_margin_loss,
+    multi_label_soft_margin_loss, multi_margin_loss,
+    triplet_margin_with_distance_loss, pairwise_distance, dice_loss,
+    npair_loss, sigmoid_focal_loss, rnnt_loss,
+    adaptive_log_softmax_with_loss,
+)

@@ -92,3 +92,143 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """reference: paddle.nn.functional.scaled_dot_product_attention
     (flash_attention.py).  Layout (batch, seq, heads, head_dim)."""
     return _sdpa(query, key, value, attn_mask, is_causal, dropout_p)
+
+
+@def_op("flash_attn_qkvpacked")
+def _flash_qkvpacked(qkv, causal):
+    # [B, S, 3, H, D] -> three [B, S, H, D]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    return _flash_impl(q, k, v, causal)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """reference: F.flash_attn_qkvpacked (flash_attention.py) — packed
+    [batch, seq, 3, heads, head_dim] input."""
+    out = _flash_qkvpacked(qkv, causal)
+    return out, None
+
+
+def _varlen_segment_mask(cu_seqlens, total, dtype):
+    """Segment ids from cumulative sequence lengths: position i belongs to
+    the sequence whose [cu[j], cu[j+1]) interval contains it."""
+    pos = jnp.arange(total)
+    seg = jnp.searchsorted(cu_seqlens[1:-1], pos, side="right") \
+        if cu_seqlens.shape[0] > 2 else jnp.zeros((total,), jnp.int32)
+    return seg
+
+
+@def_op("flash_attn_varlen_qkvpacked")
+def _flash_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, causal, scale):
+    # qkv: [total, 3, H, D] — ragged batch packed along axis 0.  On TPU the
+    # ragged batch runs as ONE attention with a block-diagonal segment mask
+    # (the reference's varlen kernel iterates cu_seqlens on the GPU side).
+    total = qkv.shape[0]
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    seg_q = _varlen_segment_mask(cu_seqlens_q, total, q.dtype)
+    seg_k = _varlen_segment_mask(cu_seqlens_k, k.shape[0], k.dtype)
+    mask = (seg_q[:, None] == seg_k[None, :])
+    if causal:
+        mask = mask & (jnp.arange(total)[:, None] >= jnp.arange(
+            k.shape[0])[None, :])
+    bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    # [total, H, D] -> heads-leading matmul
+    qt = jnp.swapaxes(q, 0, 1) * s                  # [H, total, D]
+    kt = jnp.swapaxes(k, 0, 1)
+    vt = jnp.swapaxes(v, 0, 1)
+    scores = qt @ jnp.swapaxes(kt, -1, -2) + bias[None]
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = probs @ vt                                # [H, total, D]
+    return jnp.swapaxes(out, 0, 1)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q=None, max_seqlen_k=None,
+                                scale=None, dropout=0.0, causal=False,
+                                return_softmax=False, varlen_padded=False,
+                                training=True, name=None):
+    """reference: F.flash_attn_varlen_qkvpacked — ragged sequences packed
+    as [total_tokens, 3, heads, head_dim] with cu_seqlens boundaries."""
+    out = _flash_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, causal,
+                                  scale)
+    return out, None
+
+
+@def_op("flashmask_attention")
+def _flashmask_attention(q, k, v, startend_row_indices, causal):
+    # startend_row_indices: [B, H or 1, Sk, 1|2|4] — FlashMask (the
+    # reference's flashmask_attention): column j of the score matrix is
+    # masked for rows r in [start_j, end_j).  1 col: causal LT mask with
+    # rows >= start masked; 2 cols: [start, end); 4 cols: LT + UT bands.
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    idx = startend_row_indices
+    rows = jnp.arange(Sq)[:, None]                  # [Sq, 1]
+
+    def band(lo, hi):
+        # mask rows lo <= r < hi, per column: [B, h, Sq, Sk]
+        return (rows[None, None] >= lo[:, :, None, :]) & \
+               (rows[None, None] < hi[:, :, None, :])
+
+    ncol = idx.shape[-1]
+    if ncol == 1:
+        masked = band(idx[..., 0], jnp.full_like(idx[..., 0], Sq))
+    elif ncol == 2:
+        masked = band(idx[..., 0], idx[..., 1])
+    else:                                           # 4: LT start/end + UT
+        masked = band(idx[..., 0], idx[..., 1]) | \
+                 band(idx[..., 2], idx[..., 3])
+    if causal:
+        masked = masked | (rows[None, None] < jnp.arange(Sk)[None, None,
+                                                            None, :])
+    bias = jnp.where(masked, -1e30, 0.0).astype(jnp.float32)
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    out = mha_reference(qt, kt, vt, causal=False, bias=bias)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def flashmask_attention(query, key, value, startend_row_indices,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """reference: F.flashmask_attention — sparse attention masks encoded
+    as per-column row intervals (FlashMask, PaddlePaddle 3.0)."""
+    out = _flashmask_attention(query, key, value, startend_row_indices,
+                               causal)
+    if return_softmax_lse or return_seed_offset:
+        return (out, None) + ((None,) if return_seed_offset else ())
+    return out
+
+
+@def_op("sparse_attention")
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """reference: F.sparse_attention — per-row CSR sparsity pattern over
+    the score matrix.  [B, H, S, D] layout (reference layout).  On TPU the
+    pattern is applied as a dense additive bias — XLA fuses it into the
+    softmax; true block-sparse compute belongs to the Pallas kernel when
+    the pattern is block-structured."""
+    B, H, S, D = query.shape
+    # dense mask[b, h, r, c] = 1 iff c in columns[offset[r]:offset[r+1]]
+    nnz = sparse_csr_columns.shape[-1]
+    pos = jnp.arange(nnz)
+
+    def one_mask(offset, columns):
+        row_of_nnz = jnp.searchsorted(offset[1:], pos, side="right")
+        return jnp.zeros((S, S), bool).at[row_of_nnz, columns].set(True)
+
+    mask = jax.vmap(one_mask)(
+        sparse_csr_offset.reshape(B * H, -1),
+        sparse_csr_columns.reshape(B * H, -1)).reshape(B, H, S, S)
+    bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
+    if attn_mask is not None:
+        bias = bias + jnp.where(attn_mask.astype(bool), 0.0, -1e30)
+    if key_padding_mask is not None:
+        bias = bias + jnp.where(key_padding_mask.astype(bool), 0.0,
+                                -1e30)[:, None, None, :]
+    return mha_reference(query, key, value, causal=False, bias=bias)

@@ -159,23 +159,40 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
     return out[:, :, ph:ph + oh, pw:pw + ow]
 
 
+def _pool_out_size(n, k, s, p, ceil_mode):
+    """Output extent, torch/paddle semantics: with ceil_mode the last
+    window may run past the right edge but must START within
+    input + left padding."""
+    if ceil_mode:
+        o = (n + 2 * p - k + s - 1) // s + 1
+        if (o - 1) * s >= n + p:
+            o -= 1
+        return o
+    return (n + 2 * p - k) // s + 1
+
+
 @def_op("max_pool2d_with_index")
-def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          ceil_mode=False):
     """Returns (pooled, flat argmax index into each image plane)."""
     kh, kw = _pair(kernel_size)
     sh, sw = _pair(stride if stride is not None else kernel_size)
     ph, pw = _pair(padding)
     N, C, H, W = x.shape
+    oh = _pool_out_size(H, kh, sh, ph, ceil_mode)
+    ow = _pool_out_size(W, kw, sw, pw, ceil_mode)
+    # ceil_mode: extra right-padding so the strided slicing below covers
+    # every window (padded values are -inf and can never win the argmax)
+    eh = max(0, (oh - 1) * sh + kh - (H + 2 * ph))
+    ew = max(0, (ow - 1) * sw + kw - (W + 2 * pw))
     neg = jnp.asarray(-jnp.inf, x.dtype)
-    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew)),
                  constant_values=neg)
     # index map of the padded plane back to the original flat index
-    iy = jnp.arange(H + 2 * ph) - ph
-    ix = jnp.arange(W + 2 * pw) - pw
+    iy = jnp.arange(H + 2 * ph + eh) - ph
+    ix = jnp.arange(W + 2 * pw + ew) - pw
     flat_idx = (jnp.clip(iy[:, None], 0, H - 1) * W
                 + jnp.clip(ix[None, :], 0, W - 1))
-    oh = (H + 2 * ph - kh) // sh + 1
-    ow = (W + 2 * pw - kw) // sw + 1
     vals, idxs = [], []
     for i in range(kh):
         for j in range(kw):
@@ -188,7 +205,7 @@ def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
     best = jnp.argmax(vals, axis=0)
     pooled = jnp.take_along_axis(vals, best[None], axis=0)[0]
     index = jnp.take_along_axis(idxs, best[None], axis=0)[0]
-    return pooled, index.astype(jnp.int64)
+    return pooled, index.astype(jnp.int32)
 
 
 @def_op("max_unpool2d")
@@ -390,9 +407,21 @@ def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
     return jnp.pad(x, pads, mode=jmode)
 
 
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference: F.fractional_max_pool2d — pseudo-random fractional
+    pooling; with return_mask also the flat argmax per output cell."""
+    out = _fractional_max_pool2d(x, output_size, kernel_size, random_u)
+    if return_mask:
+        from .pool_conv import _fractional_argmax_nd
+        u = 0.5 if random_u is None else float(random_u)
+        return out, _fractional_argmax_nd(x, _pair(output_size), u)
+    return out
+
+
 @def_op("fractional_max_pool2d")
-def fractional_max_pool2d(x, output_size, kernel_size=None,
-                          random_u=None):
+def _fractional_max_pool2d(x, output_size, kernel_size=None,
+                           random_u=None):
     """Pseudo-random fractional pooling (Graham 2014): bin edges from the
     deterministic u when given (test mode) else evenly fractional.
     Segment-max per axis — O(H*W) memory, not O(oh*ow*H*W)."""

@@ -1,0 +1,275 @@
+"""Loss long tail: probabilistic NLLs, margin family, metric-learning,
+RNN-T, adaptive log-softmax.
+
+Capability parity: python/paddle/nn/functional/loss.py in the reference
+(gaussian_nll_loss, poisson_nll_loss, soft_margin_loss,
+multi_label_soft_margin_loss, multi_margin_loss,
+triplet_margin_with_distance_loss, dice_loss, npair_loss,
+sigmoid_focal_loss, rnnt_loss, adaptive_log_softmax_with_loss,
+pairwise_distance from distance.py).
+
+TPU-native notes: rnnt_loss is a ``lax.scan`` over the T axis carrying one
+U-row of the forward lattice (the reference wraps the warprnnt CUDA
+kernel); everything differentiates through jax autodiff — no hand-written
+backward kernels.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.dispatch import def_op
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@def_op("gaussian_nll_loss")
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """reference: F.gaussian_nll_loss — NLL of label under
+    N(input, variance), variance clamped below at epsilon."""
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * math.log(2 * math.pi)
+    return _reduce(loss, reduction)
+
+
+@def_op("poisson_nll_loss")
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """reference: F.poisson_nll_loss — NLL of label under
+    Poisson(exp(input)) (log_input) or Poisson(input)."""
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        # Stirling approximation for label! where label > 1
+        stirling = (label * jnp.log(label) - label
+                    + 0.5 * jnp.log(2 * math.pi * label))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+@def_op("soft_margin_loss")
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """reference: F.soft_margin_loss — log(1 + exp(-label * input))."""
+    loss = jnp.log1p(jnp.exp(-label.astype(input.dtype) * input))
+    return _reduce(loss, reduction)
+
+
+@def_op("multi_label_soft_margin_loss")
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    y = label.astype(input.dtype)
+    logsig = jax.nn.log_sigmoid
+    loss = -(y * logsig(input) + (1 - y) * logsig(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce(loss, reduction)
+
+
+@def_op("multi_margin_loss")
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """reference: F.multi_margin_loss — mean_j max(0, margin - x_y + x_j)^p
+    over j != y."""
+    n, c = input.shape
+    xy = jnp.take_along_axis(input, label[:, None], axis=1)
+    viol = jnp.maximum(0.0, margin - xy + input) ** p
+    if weight is not None:
+        viol = viol * weight[label][:, None]
+    # zero out the true-class column
+    onehot = jax.nn.one_hot(label, c, dtype=input.dtype)
+    loss = jnp.sum(viol * (1 - onehot), axis=1) / c
+    return _reduce(loss, reduction)
+
+
+@def_op("triplet_margin_with_distance_loss")
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function if distance_function is not None else \
+        (lambda a, b: jnp.linalg.norm(a - b, axis=-1))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    loss = jnp.maximum(0.0, dp - dn + margin)
+    return _reduce(loss, reduction)
+
+
+@def_op("pairwise_distance")
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """reference: F.pairwise_distance (distance.py) — ||x - y + eps||_p
+    along the last axis."""
+    d = x - y + epsilon
+    out = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    if keepdim:
+        out = out[..., None]
+    return out
+
+
+@def_op("dice_loss")
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference: F.dice_loss — input [N, ..., C] class probabilities,
+    label [N, ..., 1] int labels."""
+    c = input.shape[-1]
+    onehot = jax.nn.one_hot(label[..., 0], c, dtype=input.dtype)
+    flat_in = input.reshape(input.shape[0], -1)
+    flat_lab = onehot.reshape(onehot.shape[0], -1)
+    inter = jnp.sum(flat_in * flat_lab, axis=1)
+    union = jnp.sum(flat_in, axis=1) + jnp.sum(flat_lab, axis=1)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+@def_op("npair_loss")
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """reference: F.npair_loss — similarity CE + L2 on embeddings."""
+    reg = (jnp.mean(jnp.sum(anchor ** 2, axis=1))
+           + jnp.mean(jnp.sum(positive ** 2, axis=1))) * 0.25 * l2_reg
+    sim = anchor @ positive.T                      # [N, N]
+    same = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    targets = same / jnp.sum(same, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(targets * logp, axis=1))
+    return ce + reg
+
+
+@def_op("sigmoid_focal_loss")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum", name=None):
+    """reference: F.sigmoid_focal_loss (RetinaNet focal loss)."""
+    p = jax.nn.sigmoid(logit)
+    y = label.astype(logit.dtype)
+    ce = jnp.maximum(logit, 0) - logit * y + jnp.log1p(
+        jnp.exp(-jnp.abs(logit)))
+    p_t = p * y + (1 - p) * (1 - y)
+    loss = ce * ((1 - p_t) ** gamma)
+    if alpha >= 0:
+        loss = loss * (alpha * y + (1 - alpha) * (1 - y))
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+# ------------------------------------------------------------------ RNN-T
+@def_op("rnnt_loss")
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-transducer loss (Graves 2012). reference: F.rnnt_loss wrapping
+    the warprnnt CUDA kernel (paddle/phi/kernels/gpu/warprnnt_kernel.cu);
+    here the forward lattice runs as a ``lax.scan`` over T carrying one
+    U-row of log-alphas, and the gradient falls out of autodiff.
+
+    input:  [B, Tmax, Umax+1, V] raw logits (log_softmax applied inside).
+    label:  [B, Umax] int targets.
+    """
+    logp = jax.nn.log_softmax(input, axis=-1)
+    B, T, U1, V = logp.shape
+    U = U1 - 1
+    neg_inf = jnp.asarray(-1e30, logp.dtype)
+
+    # per-(t,u) transition log-probs
+    blank_lp = logp[..., blank]                               # [B, T, U+1]
+    lab = jnp.minimum(label, V - 1)
+    emit_lp = jnp.take_along_axis(
+        logp[:, :, :U, :], lab[:, None, :, None].repeat(T, 1), axis=-1
+    )[..., 0]                                                  # [B, T, U]
+    if fastemit_lambda:
+        # FastEmit (Yu et al. 2021): up-weight the label-emission path
+        emit_lp = emit_lp + math.log1p(fastemit_lambda)
+
+    u_idx = jnp.arange(U1)
+    # the horizontal (t-1 -> t) move consumes the blank at column t-1
+    blank_prev = jnp.concatenate(
+        [jnp.zeros((B, 1, U1), logp.dtype), blank_lp[:, :-1, :]], axis=1)
+
+    def step(alpha_prev, xs):
+        """alpha column t from column t-1: horizontal blank move from the
+        previous column, then an in-column sweep over u emissions.
+        alpha[t, u] = logaddexp(alpha[t-1, u] + blank[t-1, u],
+                                alpha[t, u-1] + emit[t, u-1])"""
+        blank_tm1, emit_t, first = xs          # [B, U+1], [B, U], bool
+        horiz = jnp.where(first, jnp.where(u_idx == 0, 0.0, neg_inf),
+                          alpha_prev + blank_tm1)
+
+        def body(carry, idx):
+            # carry: alpha[t, u-1] for all B
+            h = horiz[:, idx]                  # [B]
+            e = emit_t[:, jnp.maximum(idx - 1, 0)]   # [B] emit from u-1
+            val = jnp.where(idx == 0, h, jnp.logaddexp(h, carry + e))
+            return val, val
+
+        _, cols = lax.scan(body, jnp.full((B,), neg_inf, logp.dtype),
+                           jnp.arange(U1))
+        alpha_t = jnp.moveaxis(cols, 0, 1)     # [B, U+1]
+        return alpha_t, alpha_t
+
+    first_flags = jnp.arange(T) == 0
+    _, alphas = lax.scan(
+        step, jnp.full((B, U1), neg_inf, logp.dtype),
+        (jnp.moveaxis(blank_prev, 1, 0), jnp.moveaxis(emit_lp, 1, 0),
+         first_flags))
+    alphas = jnp.moveaxis(alphas, 0, 1)        # [B, T, U+1]
+
+    t_last = jnp.clip(input_lengths - 1, 0, T - 1)
+    u_last = jnp.clip(label_lengths, 0, U)
+    a_end = alphas[jnp.arange(B), t_last, u_last]
+    lp_end = blank_lp[jnp.arange(B), t_last, u_last]
+    nll = -(a_end + lp_end)
+    return _reduce(nll, reduction)
+
+
+# ----------------------------------------------- adaptive log softmax
+@def_op("adaptive_log_softmax_with_loss")
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """reference: F.adaptive_log_softmax_with_loss — two-level softmax:
+    a head over [frequent classes + one slot per tail cluster], then a
+    per-cluster tail projection (Grave et al. 2017).
+
+    Computes every cluster's log-prob for every row (TPU-friendly dense
+    compute; rows select their cluster by mask) — returns (out, loss)
+    with out[i] = log p(label_i | input_i).
+    """
+    cutoffs = list(cutoffs)
+    shortlist = cutoffs[0]
+    n_clusters = len(tail_weights)
+    head_out = input @ head_weight
+    if head_bias is not None:
+        head_out = head_out + head_bias
+    head_logp = jax.nn.log_softmax(head_out, axis=-1)   # [N, shortlist+K]
+
+    in_short = label < shortlist
+    short_lp = jnp.take_along_axis(
+        head_logp, jnp.minimum(label, shortlist - 1)[:, None], axis=1)[:, 0]
+
+    out = jnp.where(in_short, short_lp, 0.0)
+    for k in range(n_clusters):
+        lo, hi = cutoffs[k], cutoffs[k + 1]
+        w = tail_weights[k]
+        if isinstance(w, (list, tuple)):    # factorized [proj, out] pair
+            tail_out = (input @ w[0]) @ w[1]
+        else:
+            tail_out = input @ w
+        tail_lp = jax.nn.log_softmax(tail_out, axis=-1)  # [N, hi-lo]
+        cluster_lp = head_logp[:, shortlist + k]
+        rel = jnp.clip(label - lo, 0, hi - lo - 1)
+        lp = cluster_lp + jnp.take_along_axis(
+            tail_lp, rel[:, None], axis=1)[:, 0]
+        out = jnp.where((label >= lo) & (label < hi), lp, out)
+    loss = -jnp.mean(out)
+    return out, loss

@@ -1,0 +1,402 @@
+"""3-D / adaptive / Lp / fractional pooling + 1-D/3-D transpose convs.
+
+Capability parity: python/paddle/nn/functional/pooling.py (max_pool3d,
+avg_pool3d, adaptive_avg_pool3d, adaptive_max_pool1d/3d, lp_pool1d,
+fractional_max_pool3d, max_unpool1d) and conv.py (conv1d_transpose,
+conv3d_transpose).  All windows lower to one ``lax.reduce_window`` /
+``conv_general_dilated`` — XLA tiles them onto the TPU vector/matrix units.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.dispatch import def_op
+# the parent package binds these before importing this module (see the
+# import at the bottom of functional/__init__.py)
+from . import _pool, _norm_tuple, _conv_padding
+from .extra import max_unpool2d
+
+
+# ------------------------------------------------------------ 3-D pooling
+@def_op("max_pool3d")
+def _max_pool3d(x, ksize, stride, padding, channel_last, ceil_mode):
+    return _pool(x, ksize, stride, padding, lax.max, -jnp.inf, 3,
+                 channel_last, ceil_mode)
+
+
+@def_op("max_pool3d_with_index")
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0,
+                          ceil_mode=False):
+    """(pooled, flat argmax into each D*H*W volume) — the 3-D analog of
+    max_pool2d_with_index (reference phi max_pool3d_with_index kernel)."""
+    from .extra import _pool_out_size
+    kd, kh, kw = _norm_tuple(kernel_size, 3)
+    sd, sh, sw = _norm_tuple(stride if stride is not None else kernel_size, 3)
+    pd, ph, pw = _norm_tuple(padding, 3)
+    N, C, D, H, W = x.shape
+    od = _pool_out_size(D, kd, sd, pd, ceil_mode)
+    oh = _pool_out_size(H, kh, sh, ph, ceil_mode)
+    ow = _pool_out_size(W, kw, sw, pw, ceil_mode)
+    ed = max(0, (od - 1) * sd + kd - (D + 2 * pd))
+    eh = max(0, (oh - 1) * sh + kh - (H + 2 * ph))
+    ew = max(0, (ow - 1) * sw + kw - (W + 2 * pw))
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pd, pd + ed), (ph, ph + eh),
+                     (pw, pw + ew)), constant_values=neg)
+    iz = jnp.clip(jnp.arange(D + 2 * pd + ed) - pd, 0, D - 1)
+    iy = jnp.clip(jnp.arange(H + 2 * ph + eh) - ph, 0, H - 1)
+    ix = jnp.clip(jnp.arange(W + 2 * pw + ew) - pw, 0, W - 1)
+    flat_idx = (iz[:, None, None] * (H * W) + iy[None, :, None] * W
+                + ix[None, None, :])
+    vals, idxs = [], []
+    for a in range(kd):
+        for i in range(kh):
+            for j in range(kw):
+                patch = xp[:, :, a:a + od * sd:sd, i:i + oh * sh:sh,
+                           j:j + ow * sw:sw]
+                pidx = flat_idx[a:a + od * sd:sd, i:i + oh * sh:sh,
+                                j:j + ow * sw:sw]
+                vals.append(patch)
+                idxs.append(jnp.broadcast_to(pidx, patch.shape))
+    vals = jnp.stack(vals)
+    idxs = jnp.stack(idxs)
+    best = jnp.argmax(vals, axis=0)
+    pooled = jnp.take_along_axis(vals, best[None], axis=0)[0]
+    index = jnp.take_along_axis(idxs, best[None], axis=0)[0]
+    return pooled, index.astype(jnp.int32)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    if return_mask:
+        from ...tensor.manipulation import transpose
+        if data_format == "NDHWC":
+            pooled, idx = max_pool3d_with_index(
+                transpose(x, [0, 4, 1, 2, 3]), kernel_size, stride, padding,
+                ceil_mode)
+            return transpose(pooled, [0, 2, 3, 4, 1]), \
+                transpose(idx, [0, 2, 3, 4, 1])
+        return max_pool3d_with_index(x, kernel_size, stride, padding,
+                                     ceil_mode)
+    return _max_pool3d(x, kernel_size, stride, padding,
+                       data_format == "NDHWC", ceil_mode)
+
+
+@def_op("avg_pool3d")
+def _avg_pool3d(x, ksize, stride, padding, channel_last, ceil_mode, cip,
+                divisor):
+    out = _pool(x, ksize, stride, padding, None, None, 3, channel_last,
+                ceil_mode, cip, is_avg=True)
+    if divisor is not None:
+        ks = _norm_tuple(ksize, 3)
+        out = out * (float(np.prod(ks)) / float(divisor))
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    cip = not exclusive or divisor_override is not None
+    return _avg_pool3d(x, kernel_size, stride, padding,
+                       data_format == "NDHWC", ceil_mode, cip,
+                       divisor_override)
+
+
+# ------------------------------------------------------- adaptive pooling
+def _adaptive_segments(in_size, out_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = ((np.arange(out_size) + 1) * in_size + out_size - 1) // out_size
+    return starts, ends
+
+
+def _adaptive_reduce(arr, axis, out_size, reduce_fn):
+    starts, ends = _adaptive_segments(arr.shape[axis], out_size)
+    segs = [reduce_fn(lax.slice_in_dim(arr, int(s), int(e), axis=axis),
+                      axis=axis, keepdims=True)
+            for s, e in zip(starts, ends)]
+    return jnp.concatenate(segs, axis=axis)
+
+
+@def_op("adaptive_avg_pool3d_")
+def _adaptive_avg_pool3d(x, out_dhw, channel_last):
+    if channel_last:
+        x = jnp.moveaxis(x, -1, 1)
+    for axis, o in zip((2, 3, 4), out_dhw):
+        x = _adaptive_reduce(x, axis, o, jnp.mean)
+    if channel_last:
+        x = jnp.moveaxis(x, 1, -1)
+    return x
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_avg_pool3d(x, _norm_tuple(output_size, 3),
+                                data_format == "NDHWC")
+
+
+@def_op("adaptive_max_pool3d_")
+def _adaptive_max_pool3d(x, out_dhw):
+    for axis, o in zip((2, 3, 4), out_dhw):
+        x = _adaptive_reduce(x, axis, o, jnp.max)
+    return x
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_max_pool3d(x, _norm_tuple(output_size, 3))
+    if not return_mask:
+        return out
+    return out, _adaptive_argmax_nd(x, _norm_tuple(output_size, 3))
+
+
+def _cells_argmax(x, seg):
+    """Flat index (into the trailing spatial volume) of the max of each
+    output cell, for arbitrary per-axis (starts, ends) partitions — brute
+    force over cells; cell counts are small by construction."""
+    import itertools
+    spatial = x.shape[2:]
+    out_sizes = tuple(len(s) for s, _ in seg)
+    idx_grid = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+    cells = []
+    for cell in itertools.product(*[range(o) for o in out_sizes]):
+        slc = tuple(slice(int(seg[d][0][c]), int(seg[d][1][c]))
+                    for d, c in enumerate(cell))
+        region = x[(slice(None), slice(None)) + slc].reshape(
+            x.shape[0], x.shape[1], -1)
+        ridx = idx_grid[slc].reshape(-1)
+        cells.append(ridx[jnp.argmax(region, axis=-1)])
+    out = jnp.stack(cells, axis=-1)
+    return out.reshape(x.shape[:2] + out_sizes).astype(jnp.int32)
+
+
+@def_op("adaptive_argmax_nd")
+def _adaptive_argmax_nd(x, out_sizes):
+    seg = [_adaptive_segments(n, o)
+           for n, o in zip(x.shape[2:], out_sizes)]
+    return _cells_argmax(x, seg)
+
+
+def _frac_segments(inp, out, u):
+    """Fractional-pooling partition of [0, inp) into `out` bins (the same
+    start formula as the segment-max impl in extra.py)."""
+    alpha = inp / out
+    starts = np.minimum(np.floor(alpha * (np.arange(out) + u)).astype(int),
+                        inp - 1)
+    starts[0] = 0
+    ends = np.append(starts[1:], inp)
+    return starts, ends
+
+
+@def_op("fractional_argmax_nd")
+def _fractional_argmax_nd(x, out_sizes, u):
+    seg = [_frac_segments(n, o, u)
+           for n, o in zip(x.shape[2:], out_sizes)]
+    return _cells_argmax(x, seg)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    o = _norm_tuple(output_size, 1)[0]
+    out = _adaptive_reduce_op(x, o)
+    if not return_mask:
+        return out
+    return out, _adaptive_argmax_nd(x, (o,))
+
+
+@def_op("adaptive_max_pool1d_")
+def _adaptive_reduce_op(x, out_size):
+    return _adaptive_reduce(x, 2, out_size, jnp.max)
+
+
+# ------------------------------------------------------------- Lp pooling
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    from .extra import lp_pool2d
+    out = lp_pool2d(x[..., None], norm_type, (kernel_size, 1),
+                    (stride if stride is not None else kernel_size, 1),
+                    (padding, 0), ceil_mode)
+    return out[..., 0]
+
+
+# ---------------------------------------------------- fractional pooling
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference: F.fractional_max_pool3d; with return_mask also the flat
+    argmax per output cell."""
+    out = _fractional_max_pool3d(x, output_size, kernel_size, random_u)
+    if return_mask:
+        u = 0.5 if random_u is None else float(random_u)
+        return out, _fractional_argmax_nd(x, _norm_tuple(output_size, 3), u)
+    return out
+
+
+@def_op("fractional_max_pool3d")
+def _fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None):
+    """3-D pseudo-random fractional pooling — segment-max per axis, the
+    same O(D*H*W) scheme as the 2-D op (reference phi
+    fractional_max_pool3d kernel)."""
+    od, oh, ow = _norm_tuple(output_size, 3)
+    N, C, D, H, W = x.shape
+    u = 0.5 if random_u is None else float(random_u)
+
+    def seg_ids(inp, out):
+        alpha = inp / out
+        starts = jnp.minimum(
+            jnp.floor(alpha * (jnp.arange(out) + u)).astype(jnp.int32),
+            inp - 1)
+        return jnp.searchsorted(starts, jnp.arange(inp), side="right") - 1
+
+    def reduce_axis(arr, axis, out):
+        ids = jnp.clip(seg_ids(arr.shape[axis], out), 0, out - 1)
+        m = jnp.moveaxis(arr, axis, 0)
+        red = jax.ops.segment_max(m, ids, num_segments=out)
+        return jnp.moveaxis(red, 0, axis)
+
+    for axis, o in zip((2, 3, 4), (od, oh, ow)):
+        x = reduce_axis(x, axis, o)
+    return x
+
+
+# --------------------------------------------------------------- unpool
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    """reference: F.max_unpool1d — scatter back along L via the 2-D op
+    with a singleton W axis (flat plane index == L index when W=1)."""
+    if output_size is not None:
+        output_size = tuple(output_size) + (1,)
+    out = max_unpool2d(
+        x[..., None], indices[..., None], (kernel_size, 1),
+        (stride if stride is not None else kernel_size, 1), (padding, 0),
+        output_size)
+    return out[..., 0]
+
+
+# ------------------------------------------------------- transpose convs
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, channel_last, ndim):
+    """General N-D transpose conv: flip + swap the kernel and run a
+    dilated-LHS forward conv (what the reference's conv_transpose kernels
+    do on the backward-data path)."""
+    strides = _norm_tuple(stride, ndim)
+    dil = _norm_tuple(dilation, ndim)
+    opad = _norm_tuple(output_padding, ndim)
+    k = weight.shape[2:]
+    pads = _conv_padding(padding, ndim)
+    sp = "DHW"[3 - ndim:]
+    lhs_spec = ("N" + sp + "C") if channel_last else ("NC" + sp)
+    if isinstance(pads, str):
+        if pads == "VALID":
+            pads = [(0, 0)] * ndim
+        else:   # SAME
+            w = weight
+            if groups > 1:
+                xs = jnp.split(x, groups, axis=-1 if channel_last else 1)
+                ws = jnp.split(w, groups, axis=0)
+                outs = [lax.conv_transpose(
+                    xi, jnp.moveaxis(wi, (0, 1), (ndim, ndim + 1)),
+                    strides=strides, padding="SAME", rhs_dilation=dil,
+                    dimension_numbers=(lhs_spec, sp + "IO", lhs_spec))
+                    for xi, wi in zip(xs, ws)]
+                out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+            else:
+                out = lax.conv_transpose(
+                    x, jnp.moveaxis(w, (0, 1), (ndim, ndim + 1)),
+                    strides=strides, padding="SAME", rhs_dilation=dil,
+                    dimension_numbers=(lhs_spec, sp + "IO", lhs_spec))
+            return _add_bias(out, bias, channel_last)
+
+    eff = [(dil[i] * (k[i] - 1) - pads[i][0],
+            dil[i] * (k[i] - 1) - pads[i][1] + opad[i]) for i in range(ndim)]
+    flip_axes = tuple(range(2, 2 + ndim))
+    wt = jnp.flip(weight, flip_axes)             # [in, out/g, *k] flipped
+    dn = lax.conv_dimension_numbers(
+        x.shape, (weight.shape[1], weight.shape[0]) + tuple(k),
+        (lhs_spec, "OI" + sp, lhs_spec))
+    if groups > 1:
+        xs = jnp.split(x, groups, axis=-1 if channel_last else 1)
+        ws = jnp.split(wt, groups, axis=0)
+        outs = [lax.conv_general_dilated(
+            xi, wi.swapaxes(0, 1), window_strides=(1,) * ndim, padding=eff,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+            for xi, wi in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+    else:
+        out = lax.conv_general_dilated(
+            x, wt.swapaxes(0, 1), window_strides=(1,) * ndim, padding=eff,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+    return _add_bias(out, bias, channel_last)
+
+
+def opad_from_output_size(output_size, in_spatial, stride, padding,
+                          dilation, k, ndim):
+    """Derive per-axis output_padding from a requested output_size
+    (reference: conv_transpose's output_size contract — the requested
+    length must be one of the stride-ambiguous valid lengths)."""
+    strides = _norm_tuple(stride, ndim)
+    dil = _norm_tuple(dilation, ndim)
+    pads = _conv_padding(padding, ndim)
+    if isinstance(pads, str):
+        raise ValueError(
+            "output_size cannot be combined with string padding")
+    out_sp = _norm_tuple(output_size, ndim)
+    opad = []
+    for i in range(ndim):
+        minimal = ((in_spatial[i] - 1) * strides[i] - pads[i][0]
+                   - pads[i][1] + dil[i] * (k[i] - 1) + 1)
+        op = int(out_sp[i]) - minimal
+        if not 0 <= op < max(strides[i], dil[i]):
+            raise ValueError(
+                f"output_size[{i}]={out_sp[i]} invalid: must be in "
+                f"[{minimal}, {minimal + max(strides[i], dil[i]) - 1}]")
+        opad.append(op)
+    return tuple(opad)
+
+
+def _add_bias(out, bias, channel_last):
+    if bias is None:
+        return out
+    shape = [1] * out.ndim
+    shape[out.ndim - 1 if channel_last else 1] = bias.shape[0]
+    return out + bias.reshape(shape)
+
+
+@def_op("conv1d_transpose")
+def _conv1d_transpose(x, weight, bias, stride, padding, output_padding,
+                      dilation, groups, channel_last):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups,
+                              channel_last, 1)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    channel_last = data_format == "NLC"
+    if output_size is not None:
+        in_sp = (x.shape[1],) if channel_last else (x.shape[2],)
+        output_padding = opad_from_output_size(
+            output_size, in_sp, stride, padding, dilation,
+            tuple(weight.shape[2:]), 1)
+    return _conv1d_transpose(x, weight, bias, stride, padding,
+                             output_padding, dilation, groups, channel_last)
+
+
+@def_op("conv3d_transpose")
+def _conv3d_transpose(x, weight, bias, stride, padding, output_padding,
+                      dilation, groups, channel_last):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups,
+                              channel_last, 3)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    channel_last = data_format == "NDHWC"
+    if output_size is not None:
+        in_sp = tuple(x.shape[1:4]) if channel_last else tuple(x.shape[2:5])
+        output_padding = opad_from_output_size(
+            output_size, in_sp, stride, padding, dilation,
+            tuple(weight.shape[2:]), 3)
+    return _conv3d_transpose(x, weight, bias, stride, padding,
+                             output_padding, dilation, groups, channel_last)

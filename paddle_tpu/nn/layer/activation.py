@@ -103,3 +103,22 @@ class LogSoftmax(Layer):
 
     def forward(self, x):
         return F.log_softmax(x, self.axis)
+
+
+class RReLU(Layer):
+    """reference: paddle.nn.RReLU — randomized leaky slope in train, the
+    mean slope in eval."""
+
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Softmax2D(Layer):
+    """reference: paddle.nn.Softmax2D — softmax over C for NCHW inputs."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

@@ -170,3 +170,175 @@ class CTCLoss(Layer):
                 norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction, norm_by_times)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self.args = (full, epsilon, reduction)
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, *self.args)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.args = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, *self.args)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.args = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, *self.args)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.args = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(input, positive, negative,
+                                                   *self.args)
+
+
+class HSigmoidLoss(Layer):
+    """reference: paddle.nn.HSigmoidLoss — hierarchical sigmoid over a
+    user-supplied code tree (path_table/path_code as in the reference's
+    custom-tree mode; see functional/extra.py hsigmoid_loss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        # one weight/bias row per internal tree node
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr)
+        self.bias = self.create_parameter(
+            (num_classes - 1,), attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if path_table is None or path_code is None:
+            from ... import tensor as T
+            import numpy as np
+            # default complete-binary-tree paths (reference default mode)
+            depth = max(1, int(np.ceil(np.log2(max(self.num_classes, 2)))))
+            lab = label.numpy().reshape(-1)
+            tables, codes = [], []
+            for c in lab:
+                node, tab, code = int(c) + self.num_classes - 1, [], []
+                while node > 0:
+                    parent = (node - 1) // 2
+                    tab.append(parent)
+                    code.append(node % 2)   # 1 = left child? fixed convention
+                    node = parent
+                tab = tab[::-1][:depth] + [-1] * max(0, depth - len(tab))
+                code = code[::-1][:depth] + [0] * max(0, depth - len(code))
+                tables.append(tab[:depth])
+                codes.append(code[:depth])
+            path_table = T.to_tensor(np.array(tables, np.int32))
+            path_code = T.to_tensor(np.array(codes, np.int32))
+        return F.hsigmoid_loss(input, label, self.weight, self.bias,
+                               path_table, path_code)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.args = (blank, fastemit_lambda, reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           *self.args)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """reference: paddle.nn.AdaptiveLogSoftmaxWithLoss (Grave et al.) —
+    head over [shortlist + clusters], factorized per-cluster tails with
+    dims divided by div_value**k."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs) + [n_classes]
+        self.n_clusters = len(self.cutoffs) - 1
+        shortlist = self.cutoffs[0]
+        self.head_weight = self.create_parameter(
+            (in_features, shortlist + self.n_clusters))
+        self.head_bias = self.create_parameter(
+            (shortlist + self.n_clusters,), is_bias=True) if head_bias \
+            else None
+        self.tail_weights = []
+        for k in range(self.n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (k + 1))))
+            osz = self.cutoffs[k + 1] - self.cutoffs[k]
+            proj = self.create_parameter((in_features, hsz))
+            out = self.create_parameter((hsz, osz))
+            setattr(self, f"_tail_{k}_proj", proj)
+            setattr(self, f"_tail_{k}_out", out)
+            self.tail_weights.append([proj, out])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights, self.cutoffs,
+            self.head_bias)
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probability table."""
+        import jax.numpy as jnp
+        from ...framework.dispatch import call_op
+
+        def _fn(x, head_w, head_b, *tails):
+            head = x @ head_w
+            if head_b is not None:
+                head = head + head_b
+            head_lp = jax.nn.log_softmax(head, axis=-1)
+            shortlist = self.cutoffs[0]
+            parts = [head_lp[:, :shortlist]]
+            for k in range(self.n_clusters):
+                proj, out = tails[2 * k], tails[2 * k + 1]
+                tail_lp = jax.nn.log_softmax((x @ proj) @ out, axis=-1)
+                parts.append(head_lp[:, shortlist + k:shortlist + k + 1]
+                             + tail_lp)
+            return jnp.concatenate(parts, axis=-1)
+
+        flat_tails = [w for pair in self.tail_weights for w in pair]
+        import jax
+        return call_op("adaptive_log_prob", _fn,
+                       (input, self.head_weight, self.head_bias,
+                        *flat_tails), {})
+
+    def predict(self, input):
+        from ... import tensor as T
+        return T.argmax(self.log_prob(input), axis=-1)

@@ -264,3 +264,91 @@ class SimpleRNNCell(Layer):
                       (inputs, states, self.weight_ih, self.weight_hh,
                        self.bias_ih, self.bias_hh), {})
         return out, out
+
+
+class RNNCellBase(Layer):
+    """reference: paddle.nn.RNNCellBase — base for user cells consumed by
+    RNN/BiRNN; provides zero initial states."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        if shape is None:
+            shape = (self.hidden_size,)
+        full = (batch,) + tuple(shape)
+        out = T.full(full, init_value, dtype or "float32")
+        return out
+
+
+class RNN(Layer):
+    """reference: paddle.nn.RNN (layer/rnn.py) — run a cell over the time
+    axis.  The step loop is a static Python loop (T is a trace-time
+    constant), so under ``to_static`` the whole unrolled sweep compiles
+    into one XLA program."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        outs = [None] * steps
+        for t in order:
+            x_t = inputs[t] if self.time_major else inputs[:, t]
+            out, new_states = self.cell(x_t, states, **kwargs)
+            if sequence_length is not None:
+                keep = (T.to_tensor(t) < sequence_length).astype(out.dtype)
+                mask = keep.reshape([-1] + [1] * (out.ndim - 1))
+                out = out * mask
+                # before the first step the implicit initial state is zeros;
+                # padded timesteps must carry it, not the cell's garbage
+                # (matters for is_reverse, which starts in the padding)
+                prev = states if states is not None else \
+                    _zeros_like_states(new_states)
+                new_states = _mask_states(new_states, prev, mask)
+            states = new_states
+            outs[t] = out
+        outputs = T.stack(outs, axis=time_axis)
+        return outputs, states
+
+
+def _mask_states(new, old, mask):
+    if isinstance(new, (tuple, list)):
+        return type(new)(_mask_states(n, o, mask) for n, o in zip(new, old))
+    return new * mask + old * (1 - mask)
+
+
+def _zeros_like_states(s):
+    if isinstance(s, (tuple, list)):
+        return type(s)(_zeros_like_states(x) for x in s)
+    return s * 0.0
+
+
+class BiRNN(Layer):
+    """reference: paddle.nn.BiRNN — forward + backward cells, outputs
+    concatenated on the feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length,
+                                    **kwargs)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length,
+                                    **kwargs)
+        return T.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -335,7 +335,7 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
         width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
     else:
         npairs = len(pad) // 2
-        width = [(0, 0)] * (nd - npairs)
+        width = [(0, 0)] * nd
         # paddle: pads apply to the last npairs spatial dims, ordered from the
         # last-but-one... For NCHW 4-d with len(pad)==4: (left,right,top,bottom)
         # applies to W then H? Reference: pad=[l, r, t, b] pads dims (W: l,r) is

@@ -1,0 +1,567 @@
+"""nn/functional long tail: 3-D/adaptive/fractional pooling, transpose
+convs, loss family, RNN-T, adaptive log-softmax, beam search decode,
+attention wrappers, in-place variants (reference:
+python/paddle/nn/functional/{pooling,conv,loss}.py, nn/decode.py)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rs = np.random.RandomState(7)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestPooling3D:
+    x = rs.randn(2, 3, 6, 8, 8).astype(np.float32)
+
+    def test_max_pool3d_matches_torch(self):
+        got = F.max_pool3d(t(self.x), 2, stride=2).numpy()
+        ref = TF.max_pool3d(torch.tensor(self.x), 2, stride=2).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_avg_pool3d_matches_torch(self):
+        got = F.avg_pool3d(t(self.x), 2, stride=2, padding=1).numpy()
+        ref = TF.avg_pool3d(torch.tensor(self.x), 2, stride=2, padding=1,
+                            count_include_pad=False).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_avg_pool3d_divisor_override(self):
+        got = F.avg_pool3d(t(self.x), 2, stride=2, divisor_override=4).numpy()
+        ref = TF.avg_pool3d(torch.tensor(self.x), 2, stride=2,
+                            divisor_override=4).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_max_pool3d_mask_roundtrips_unpool(self):
+        pooled, idx = F.max_pool3d(t(self.x), 2, return_mask=True)
+        restored = F.max_unpool3d(pooled, idx, 2)
+        # every pooled max lands back at its argmax position
+        assert restored.shape == list(self.x.shape)
+        np.testing.assert_allclose(np.sort(restored.numpy()[restored.numpy() != 0]),
+                                   np.sort(pooled.numpy().ravel()), rtol=1e-6)
+
+    def test_adaptive_avg_pool3d_matches_torch(self):
+        got = F.adaptive_avg_pool3d(t(self.x), (3, 4, 5)).numpy()
+        ref = TF.adaptive_avg_pool3d(torch.tensor(self.x), (3, 4, 5)).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_adaptive_max_pool3d_matches_torch(self):
+        got = F.adaptive_max_pool3d(t(self.x), 2).numpy()
+        ref = TF.adaptive_max_pool3d(torch.tensor(self.x), 2).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_adaptive_max_pool1d_with_mask(self):
+        x = rs.randn(2, 3, 12).astype(np.float32)
+        got, mask = F.adaptive_max_pool1d(t(x), 4, return_mask=True)
+        ref, ridx = TF.adaptive_max_pool1d(torch.tensor(x), 4,
+                                           return_indices=True)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(mask.numpy(), ridx.numpy())
+
+    def test_lp_pool1d_matches_torch(self):
+        x = rs.rand(2, 3, 10).astype(np.float32)   # positive: |.|^p == .^p
+        got = F.lp_pool1d(t(x), 2.0, 2).numpy()
+        ref = TF.lp_pool1d(torch.tensor(x), 2.0, 2).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_fractional_max_pool3d_partitions(self):
+        out = F.fractional_max_pool3d(t(self.x), (3, 4, 4), random_u=0.3)
+        assert out.shape == [2, 3, 3, 4, 4]
+        # global max must survive any partition-based pooling
+        assert np.isclose(out.numpy().max(), self.x.max())
+
+    def test_max_unpool1d_roundtrip(self):
+        x = rs.randn(2, 3, 10).astype(np.float32)
+        pooled, idx = F.max_pool1d(t(x), 2, return_mask=True)
+        up = F.max_unpool1d(pooled, idx, 2)
+        assert up.shape == [2, 3, 10]
+
+
+class TestTransposeConvs:
+    def test_conv1d_transpose_matches_torch(self):
+        x = rs.randn(2, 4, 9).astype(np.float32)
+        w = rs.randn(4, 6, 3).astype(np.float32)
+        got = F.conv1d_transpose(t(x), t(w), stride=2, padding=1,
+                                 output_padding=1).numpy()
+        ref = TF.conv_transpose1d(torch.tensor(x), torch.tensor(w), stride=2,
+                                  padding=1, output_padding=1).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_conv3d_transpose_matches_torch(self):
+        x = rs.randn(2, 4, 5, 6, 7).astype(np.float32)
+        w = rs.randn(4, 3, 3, 3, 3).astype(np.float32)
+        b = rs.randn(3).astype(np.float32)
+        got = F.conv3d_transpose(t(x), t(w), t(b), stride=2,
+                                 padding=1).numpy()
+        ref = TF.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                                  torch.tensor(b), stride=2,
+                                  padding=1).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_conv3d_transpose_groups(self):
+        x = rs.randn(2, 4, 5, 5, 5).astype(np.float32)
+        w = rs.randn(4, 2, 3, 3, 3).astype(np.float32)
+        got = F.conv3d_transpose(t(x), t(w), groups=2).numpy()
+        ref = TF.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                                  groups=2).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_layers_forward(self):
+        for layer, shape in ((nn.Conv1DTranspose(4, 6, 3), (2, 4, 9)),
+                             (nn.Conv3DTranspose(4, 6, 3), (2, 4, 5, 5, 5))):
+            out = layer(t(rs.randn(*shape).astype(np.float32)))
+            assert out.shape[1] == 6
+
+
+class TestLossFamily:
+    a = rs.randn(5, 7).astype(np.float32)
+    b = rs.randn(5, 7).astype(np.float32)
+
+    def test_gaussian_nll_matches_torch(self):
+        var = np.abs(rs.randn(5, 7)).astype(np.float32)
+        got = float(F.gaussian_nll_loss(t(self.a), t(self.b), t(var),
+                                        full=True).numpy())
+        ref = float(TF.gaussian_nll_loss(torch.tensor(self.a),
+                                         torch.tensor(self.b),
+                                         torch.tensor(var), full=True))
+        assert abs(got - ref) < 1e-5
+
+    def test_poisson_nll_matches_torch(self):
+        lab = rs.poisson(3, (5, 7)).astype(np.float32)
+        got = float(F.poisson_nll_loss(t(self.a), t(lab), full=True).numpy())
+        ref = float(TF.poisson_nll_loss(torch.tensor(self.a),
+                                        torch.tensor(lab), full=True))
+        assert abs(got - ref) < 1e-5
+
+    def test_soft_margin_matches_torch(self):
+        y = np.sign(rs.randn(5, 7)).astype(np.float32)
+        got = float(F.soft_margin_loss(t(self.a), t(y)).numpy())
+        ref = float(TF.soft_margin_loss(torch.tensor(self.a),
+                                        torch.tensor(y)))
+        assert abs(got - ref) < 1e-6
+
+    def test_multi_label_soft_margin_matches_torch(self):
+        ml = (rs.rand(5, 7) > 0.5).astype(np.float32)
+        got = float(F.multi_label_soft_margin_loss(t(self.a), t(ml)).numpy())
+        ref = float(TF.multilabel_soft_margin_loss(torch.tensor(self.a),
+                                                   torch.tensor(ml)))
+        assert abs(got - ref) < 1e-6
+
+    def test_multi_margin_matches_torch(self):
+        li = rs.randint(0, 7, 5)
+        got = float(F.multi_margin_loss(t(self.a), t(li)).numpy())
+        ref = float(TF.multi_margin_loss(torch.tensor(self.a),
+                                         torch.tensor(li)))
+        assert abs(got - ref) < 1e-6
+
+    def test_triplet_with_distance_matches_torch(self):
+        pos, neg = (rs.randn(5, 7).astype(np.float32) for _ in range(2))
+        got = float(F.triplet_margin_with_distance_loss(
+            t(self.a), t(pos), t(neg), swap=True).numpy())
+        ref = float(TF.triplet_margin_with_distance_loss(
+            torch.tensor(self.a), torch.tensor(pos), torch.tensor(neg),
+            swap=True))
+        assert abs(got - ref) < 1e-5
+
+    def test_pairwise_distance_matches_torch(self):
+        got = F.pairwise_distance(t(self.a), t(self.b)).numpy()
+        ref = TF.pairwise_distance(torch.tensor(self.a),
+                                   torch.tensor(self.b)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_sigmoid_focal_loss_formula(self):
+        lg = rs.randn(4, 3).astype(np.float32)
+        lb = (rs.rand(4, 3) > 0.5).astype(np.float32)
+        p = 1 / (1 + np.exp(-lg))
+        ce = -(lb * np.log(p) + (1 - lb) * np.log(1 - p))
+        pt = p * lb + (1 - p) * (1 - lb)
+        ref = (ce * (1 - pt) ** 2.0 * (0.25 * lb + 0.75 * (1 - lb))).sum()
+        got = float(F.sigmoid_focal_loss(t(lg), t(lb)).numpy())
+        assert abs(got - ref) < 1e-4
+
+    def test_dice_loss_range_and_perfect(self):
+        lab = rs.randint(0, 3, (4, 6, 1))
+        perfect = np.eye(3, dtype=np.float32)[lab[..., 0]]
+        loss = float(F.dice_loss(t(perfect), t(lab)).numpy())
+        assert loss < 1e-3
+        rand = np.full((4, 6, 3), 1 / 3, np.float32)
+        assert float(F.dice_loss(t(rand), t(lab)).numpy()) > loss
+
+    def test_npair_loss_runs(self):
+        anchor = rs.randn(6, 4).astype(np.float32)
+        positive = rs.randn(6, 4).astype(np.float32)
+        labels = np.array([0, 0, 1, 1, 2, 2])
+        out = float(F.npair_loss(t(anchor), t(positive), t(labels)).numpy())
+        assert np.isfinite(out)
+
+    def test_loss_layers_forward(self):
+        y = np.sign(rs.randn(5, 7)).astype(np.float32)
+        assert np.isfinite(float(nn.SoftMarginLoss()(t(self.a),
+                                                     t(y)).numpy()))
+        var = np.abs(rs.randn(5, 7)).astype(np.float32)
+        assert np.isfinite(float(nn.GaussianNLLLoss()(
+            t(self.a), t(self.b), t(var)).numpy()))
+        assert np.isfinite(float(nn.PoissonNLLLoss()(
+            t(self.a), t(np.abs(self.b))).numpy()))
+
+
+class TestRNNT:
+    def test_matches_brute_force(self):
+        B, T, U, V = 2, 4, 2, 5
+        logits = rs.randn(B, T, U + 1, V).astype(np.float32)
+        labels = rs.randint(1, V, (B, U))
+
+        def brute(lg, label):
+            from itertools import combinations
+            logp = torch.log_softmax(torch.tensor(lg), dim=-1).numpy()
+            total = -np.inf
+            for emits in combinations(range(T + U), U):
+                tt, u, lp, ok = 0, 0, 0.0, True
+                for s in range(T + U):
+                    if s in emits:
+                        if tt >= T:
+                            ok = False
+                            break
+                        lp += logp[tt, u, label[u]]
+                        u += 1
+                    else:
+                        if tt >= T:
+                            ok = False
+                            break
+                        lp += logp[tt, u, 0]
+                        tt += 1
+                if ok and tt == T and u == U:
+                    total = np.logaddexp(total, lp)
+            return -total
+
+        exp = np.array([brute(logits[b], labels[b]) for b in range(B)])
+        got = F.rnnt_loss(t(logits), t(labels),
+                          t(np.array([T] * B, np.int32)),
+                          t(np.array([U] * B, np.int32)),
+                          fastemit_lambda=0.0, reduction="none").numpy()
+        np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+    def test_gradient_flows(self):
+        B, T, U, V = 1, 3, 2, 4
+        logits = t(rs.randn(B, T, U + 1, V).astype(np.float32))
+        logits.stop_gradient = False
+        loss = F.rnnt_loss(logits, t(rs.randint(1, V, (B, U))),
+                           t(np.array([T], np.int32)),
+                           t(np.array([U], np.int32)))
+        loss.backward()
+        assert np.isfinite(logits.grad.numpy()).all()
+
+    def test_layer(self):
+        B, T, U, V = 2, 3, 2, 4
+        out = nn.RNNTLoss()(t(rs.randn(B, T, U + 1, V).astype(np.float32)),
+                            t(rs.randint(1, V, (B, U))),
+                            t(np.array([T] * B, np.int32)),
+                            t(np.array([U] * B, np.int32)))
+        assert np.isfinite(float(out.numpy()))
+
+
+class TestAdaptiveLogSoftmax:
+    def test_matches_torch(self):
+        N, D, C = 6, 8, 20
+        tor = torch.nn.AdaptiveLogSoftmaxWithLoss(D, C, cutoffs=[5, 12],
+                                                  div_value=2.0)
+        x = rs.randn(N, D).astype(np.float32)
+        y = rs.randint(0, C, N)
+        with torch.no_grad():
+            ref_out, ref_loss = tor(torch.tensor(x), torch.tensor(y))
+        head_w = tor.head.weight.detach().numpy().T
+        tails = [[t(m[0].weight.detach().numpy().T),
+                  t(m[1].weight.detach().numpy().T)] for m in tor.tail]
+        out, loss = F.adaptive_log_softmax_with_loss(
+            t(x), t(y), t(head_w), tails, [5, 12, C])
+        np.testing.assert_allclose(out.numpy(), ref_out.numpy(), atol=1e-5)
+        assert abs(float(loss.numpy()) - float(ref_loss)) < 1e-5
+
+    def test_layer_log_prob_normalized(self):
+        layer = nn.AdaptiveLogSoftmaxWithLoss(8, 20, cutoffs=[5, 12])
+        x = t(rs.randn(4, 8).astype(np.float32))
+        lp = layer.log_prob(x)
+        assert lp.shape == [4, 20]
+        np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1),
+                                   np.ones(4), rtol=1e-4)
+        pred = layer.predict(x)
+        np.testing.assert_array_equal(pred.numpy(),
+                                      lp.numpy().argmax(-1))
+
+
+class TestDecode:
+    def test_gather_tree_reference_example(self):
+        ids = t(np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]],
+                          [[0, 1], [9, 0]]], np.int32))
+        par = t(np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                          [[0, 0], [0, 1]]], np.int32))
+        expect = [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]]
+        assert F.gather_tree(ids, par).numpy().tolist() == expect
+
+    def test_beam_search_decode(self):
+        V, H, B, K = 7, 8, 2, 3
+        emb = nn.Embedding(V, H)
+        cell = nn.GRUCell(H, H)
+        proj = nn.Linear(H, V)
+        dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                   beam_size=K, embedding_fn=emb,
+                                   output_fn=proj)
+        h0 = t(np.zeros((B, H), np.float32))
+        out, st = nn.dynamic_decode(dec, inits=h0, max_step_num=6)
+        assert out.shape[0] == B and out.shape[2] == K
+        assert out.numpy().max() < V
+        # beam scores are sorted descending
+        scores = np.asarray(st.log_probs)
+        assert (np.diff(scores, axis=1) <= 1e-6).all()
+
+
+class TestRNNLayers:
+    def test_rnn_runs_cell_over_time(self):
+        cell = nn.LSTMCell(4, 8)
+        rnn = nn.RNN(cell)
+        x = t(rs.randn(2, 5, 4).astype(np.float32))
+        out, (h, c) = rnn(x)
+        assert out.shape == [2, 5, 8] and h.shape == [2, 8]
+        # final output column equals final state
+        np.testing.assert_allclose(out.numpy()[:, -1], h.numpy(), rtol=1e-6)
+
+    def test_rnn_sequence_length_masks(self):
+        rnn = nn.RNN(nn.GRUCell(4, 8))
+        x = t(rs.randn(2, 5, 4).astype(np.float32))
+        out, _ = rnn(x, sequence_length=t(np.array([3, 5], np.int32)))
+        assert abs(out.numpy()[0, 3:]).max() == 0.0
+        assert abs(out.numpy()[1, 3:]).max() > 0.0
+
+    def test_birnn_concats_directions(self):
+        bi = nn.BiRNN(nn.GRUCell(4, 6), nn.GRUCell(4, 6))
+        out, (hf, hb) = bi(t(rs.randn(2, 5, 4).astype(np.float32)))
+        assert out.shape == [2, 5, 12]
+
+    def test_rnn_cell_base_initial_states(self):
+        class MyCell(nn.RNNCellBase):
+            def __init__(self):
+                super().__init__()
+                self.hidden_size = 6
+
+        st = MyCell().get_initial_states(t(rs.randn(3, 4).astype(np.float32)))
+        assert st.shape == [3, 6] and abs(st.numpy()).max() == 0
+
+
+class TestAttentionWrappers:
+    B, S, H, D = 2, 8, 2, 4
+    q = rs.randn(B, S, H, D).astype(np.float32)
+    k = rs.randn(B, S, H, D).astype(np.float32)
+    v = rs.randn(B, S, H, D).astype(np.float32)
+
+    def test_qkvpacked_equals_unpacked(self):
+        qkv = np.stack([self.q, self.k, self.v], axis=2)
+        got, _ = F.flash_attn_qkvpacked(t(qkv), causal=True)
+        ref, _ = F.flash_attention(t(self.q), t(self.k), t(self.v),
+                                   causal=True)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), atol=1e-6)
+
+    def test_varlen_isolates_sequences(self):
+        qkv = rs.randn(8, 3, self.H, self.D).astype(np.float32)
+        cu = t(np.array([0, 5, 8], np.int32))
+        out1, _ = F.flash_attn_varlen_qkvpacked(t(qkv), cu, cu)
+        poisoned = qkv.copy()
+        poisoned[5:] += 100.0
+        out2, _ = F.flash_attn_varlen_qkvpacked(t(poisoned), cu, cu)
+        np.testing.assert_allclose(out1.numpy()[:5], out2.numpy()[:5],
+                                   atol=1e-5)
+
+    def test_flashmask_no_extra_mask_equals_causal(self):
+        sri = t(np.full((self.B, 1, self.S, 1), self.S, np.int32))
+        got = F.flashmask_attention(t(self.q), t(self.k), t(self.v), sri,
+                                    causal=True)
+        ref, _ = F.flash_attention(t(self.q), t(self.k), t(self.v),
+                                   causal=True)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), atol=1e-6)
+
+    def test_flashmask_sliding_window(self):
+        w = 3
+        S = self.S
+        start = np.minimum(np.arange(S) + w, S).astype(np.int32)
+        sri = t(np.broadcast_to(start.reshape(1, 1, S, 1),
+                                (self.B, 1, S, 1)).copy())
+        got = F.flashmask_attention(t(self.q), t(self.k), t(self.v), sri,
+                                    causal=True)
+        keep = (np.arange(S)[:, None] >= np.arange(S)[None, :]) & \
+               (np.arange(S)[:, None] < np.arange(S)[None, :] + w)
+        bias = np.where(keep, 0.0, -1e30).astype(np.float32)[None, None]
+        ref = F.scaled_dot_product_attention(t(self.q), t(self.k), t(self.v),
+                                             attn_mask=t(bias))
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), atol=1e-6)
+
+    def test_sparse_attention_causal_pattern(self):
+        S = self.S
+        offs = np.tile(np.concatenate(
+            [[0], np.cumsum(np.arange(1, S + 1))]).astype(np.int32),
+            (self.B, self.H, 1))
+        cols = np.tile(np.concatenate(
+            [np.arange(r + 1) for r in range(S)]).astype(np.int32),
+            (self.B, self.H, 1))
+        qT, kT, vT = (t(np.swapaxes(a, 1, 2))
+                      for a in (self.q, self.k, self.v))
+        got = F.sparse_attention(qT, kT, vT, t(offs), t(cols))
+        ref, _ = F.flash_attention(t(self.q), t(self.k), t(self.v),
+                                   causal=True)
+        np.testing.assert_allclose(got.numpy(),
+                                   np.swapaxes(ref.numpy(), 1, 2), atol=1e-6)
+
+
+class TestMiscLayers:
+    def test_inplace_ops_mutate_and_return(self):
+        x = t(np.array([-1.0, 2.0], np.float32))
+        y = F.relu_(x)
+        assert y is x and x.numpy().tolist() == [0.0, 2.0]
+        x2 = t(np.array([-5.0, 5.0], np.float32))
+        F.hardtanh_(x2)
+        assert x2.numpy().tolist() == [-1.0, 1.0]
+
+    def test_inplace_keeps_autograd(self):
+        x = t(np.array([-1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = F.tanh_(x * 2.0)
+        y.sum().backward()
+        assert x.grad is not None
+
+    def test_zeropads(self):
+        x = t(rs.randn(1, 2, 4).astype(np.float32))
+        assert nn.ZeroPad1D([1, 2])(x).shape == [1, 2, 7]
+        x3 = t(rs.randn(1, 2, 3, 4, 5).astype(np.float32))
+        assert nn.ZeroPad3D([1, 1, 1, 1, 1, 1])(x3).shape == [1, 2, 5, 6, 7]
+        x2 = t(rs.randn(1, 2, 3, 4).astype(np.float32))
+        assert F.zeropad2d(x2, [1, 2, 3, 4]).shape == [1, 2, 10, 7]
+
+    def test_parameter_dict(self):
+        pd = nn.ParameterDict({"a": paddle.framework.tensor.Parameter(
+            np.zeros((2, 2), np.float32))})
+        assert "a" in pd and len(pd) == 1
+        assert len(list(pd.values())) == 1
+
+    def test_softmax2d(self):
+        x = t(rs.randn(2, 3, 4, 5).astype(np.float32))
+        out = nn.Softmax2D()(x)
+        np.testing.assert_allclose(out.numpy().sum(1), np.ones((2, 4, 5)),
+                                   rtol=1e-5)
+
+    def test_rrelu_eval_uses_mean_slope(self):
+        layer = nn.RReLU(0.2, 0.4)
+        layer.eval()
+        x = t(np.array([-10.0], np.float32))
+        np.testing.assert_allclose(layer(x).numpy(), [-3.0], rtol=1e-5)
+
+    def test_feature_alpha_dropout_drops_whole_channels(self):
+        x = t(np.ones((4, 8, 6, 6), np.float32))
+        out = nn.FeatureAlphaDropout(0.5)(x)
+        per_channel = out.numpy().reshape(4, 8, -1)
+        # each channel map is constant (all kept or all dropped)
+        assert (per_channel.max(-1) - per_channel.min(-1)).max() < 1e-6
+
+    def test_pairwise_distance_layer(self):
+        x, y = (t(rs.randn(3, 5).astype(np.float32)) for _ in range(2))
+        d = nn.PairwiseDistance(p=2.0)(x, y)
+        assert d.shape == [3]
+
+    def test_log_sigmoid_alias(self):
+        x = t(np.array([0.0], np.float32))
+        np.testing.assert_allclose(F.log_sigmoid(x).numpy(),
+                                   [np.log(0.5)], rtol=1e-5)
+
+
+class TestReviewRegressions:
+    """Regressions from the round-3 code review."""
+
+    def test_max_pool_ceil_mode_with_mask(self):
+        x = rs.randn(2, 3, 7, 9).astype(np.float32)
+        got, gidx = F.max_pool2d(t(x), 3, stride=2, padding=1,
+                                 ceil_mode=True, return_mask=True)
+        ref, ridx = TF.max_pool2d(torch.tensor(x), 3, stride=2, padding=1,
+                                  ceil_mode=True, return_indices=True)
+        np.testing.assert_allclose(got.numpy(), ref.numpy())
+        np.testing.assert_array_equal(gidx.numpy(), ridx.numpy())
+
+    def test_max_pool_nhwc_with_mask(self):
+        x = rs.randn(2, 3, 6, 8).astype(np.float32)
+        xh = np.transpose(x, (0, 2, 3, 1)).copy()
+        gh, gih = F.max_pool2d(t(xh), 2, return_mask=True,
+                               data_format="NHWC")
+        ref, ridx = TF.max_pool2d(torch.tensor(x), 2, return_indices=True)
+        np.testing.assert_allclose(
+            np.transpose(gh.numpy(), (0, 3, 1, 2)), ref.numpy())
+        np.testing.assert_array_equal(
+            np.transpose(gih.numpy(), (0, 3, 1, 2)), ridx.numpy())
+
+    def test_max_pool3d_ceil_mode_with_mask(self):
+        x = rs.randn(2, 3, 5, 7, 9).astype(np.float32)
+        got, gidx = F.max_pool3d(t(x), 2, stride=2, ceil_mode=True,
+                                 return_mask=True)
+        ref, ridx = TF.max_pool3d(torch.tensor(x), 2, stride=2,
+                                  ceil_mode=True, return_indices=True)
+        np.testing.assert_allclose(got.numpy(), ref.numpy())
+        np.testing.assert_array_equal(gidx.numpy(), ridx.numpy())
+
+    def test_conv_transpose_output_size(self):
+        x = rs.randn(2, 4, 9).astype(np.float32)
+        w = rs.randn(4, 6, 3).astype(np.float32)
+        out = F.conv1d_transpose(t(x), t(w), stride=2, padding=1,
+                                 output_size=[18])
+        assert out.shape[-1] == 18
+        with pytest.raises(ValueError):
+            F.conv1d_transpose(t(x), t(w), stride=2, padding=1,
+                               output_size=[25])
+        x2 = rs.randn(2, 4, 5, 6).astype(np.float32)
+        w2 = rs.randn(4, 3, 3, 3).astype(np.float32)
+        out2 = F.conv2d_transpose(t(x2), t(w2), stride=2, padding=1,
+                                  output_size=[10, 12])
+        assert out2.shape[-2:] == [10, 12]
+
+    def test_fractional_pool_return_mask_gathers_pooled(self):
+        x = rs.randn(2, 3, 8, 8).astype(np.float32)
+        pooled, mask = F.fractional_max_pool2d(t(x), 4, random_u=0.3,
+                                               return_mask=True)
+        gathered = np.take_along_axis(
+            x.reshape(2, 3, -1), mask.numpy().reshape(2, 3, -1),
+            axis=-1).reshape(pooled.shape)
+        np.testing.assert_allclose(gathered, pooled.numpy())
+        layer_out = nn.FractionalMaxPool2D(4, random_u=0.3,
+                                           return_mask=True)(t(x))
+        assert len(layer_out) == 2
+
+    def test_reverse_rnn_ignores_padding_garbage(self):
+        rnn = nn.RNN(nn.GRUCell(4, 8), is_reverse=True)
+        x = rs.randn(2, 5, 4).astype(np.float32)
+        sl = t(np.array([3, 5], np.int32))
+        out_a, _ = rnn(t(x), sequence_length=sl)
+        poisoned = x.copy()
+        poisoned[0, 3:] = 999.0
+        out_b, _ = rnn(t(poisoned), sequence_length=sl)
+        np.testing.assert_allclose(out_a.numpy()[0, :3],
+                                   out_b.numpy()[0, :3], atol=1e-6)
+
+    def test_debug_step_gates_checker(self):
+        from paddle_tpu.amp import debugging as dbg
+        cfg = dbg.TensorCheckerConfig(
+            enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT,
+            debug_step=(1, 1))
+        dbg.enable_tensor_checker(cfg)     # advances to step 1: in range
+        try:
+            with pytest.raises(FloatingPointError):
+                paddle.sqrt(t(np.array([-1.0], np.float32)))
+        finally:
+            dbg.disable_tensor_checker()
+        cfg2 = dbg.TensorCheckerConfig(
+            enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT,
+            debug_step=(2, 3))
+        dbg.enable_tensor_checker(cfg2)    # step 1: out of range, inert
+        try:
+            paddle.sqrt(t(np.array([-1.0], np.float32)))
+        finally:
+            dbg.disable_tensor_checker()
