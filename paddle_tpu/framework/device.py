@@ -53,6 +53,12 @@ def CUDAPlace(device_id: int = 0) -> Place:  # compat shim; maps to accelerator
     return Place(_default_platform(), device_id)
 
 
+def CUDAPinnedPlace() -> Place:
+    """compat shim: pinned host memory is a CUDA concept; host arrays on
+    this stack are already DMA-able by the PJRT runtime."""
+    return Place("cpu", 0)
+
+
 def _platform_matches(platform: str, device_type: str) -> bool:
     if device_type in ("gpu", "cuda"):
         return platform in ("gpu", "cuda", "rocm")

@@ -169,8 +169,8 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     inputs, states, finished = decoder.initialize(inits)
     outputs = []
     step = 0
-    limit = int(max_step_num) if max_step_num is not None else 256
-    while step < limit:
+    limit = int(max_step_num) if max_step_num is not None else None
+    while limit is None or step < limit:
         out, states, inputs, finished = decoder.step(step, inputs, states,
                                                      **kwargs)
         outputs.append(out)

@@ -491,10 +491,11 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     if return_mask:
         # singleton-W plane: the flat plane argmax IS the L index
         from .extra import max_pool2d_with_index
+        k = _norm_tuple(kernel_size, 1)[0]
+        s = _norm_tuple(stride if stride is not None else kernel_size, 1)[0]
+        p = _norm_tuple(padding, 1)[0]
         pooled, idx = max_pool2d_with_index(
-            x[..., None], (kernel_size, 1),
-            (stride if stride is not None else kernel_size, 1), (padding, 0),
-            ceil_mode)
+            x[..., None], (k, 1), (s, 1), (p, 0), ceil_mode)
         return pooled[..., 0], idx[..., 0]
     return _max_pool1d(x, kernel_size, stride, padding, False, ceil_mode)
 
@@ -1102,28 +1103,9 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
     return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
 
 
-@def_op("gather_tree")
-def gather_tree(ids, parents):
-    """reference: F.gather_tree (functional/extension.py) — backtrace beam
-    -search parents to full sequences.  [max_time, batch, beam] layout;
-    a reverse ``lax.scan`` carries the live beam index per (batch, beam)."""
-    T, B, K = ids.shape
-    binds = jnp.arange(B)[:, None]
-
-    def body(beam, xs):
-        ids_t, parents_tp1 = xs
-        beam_prev = parents_tp1[binds, beam]     # who produced this beam
-        return beam_prev, ids_t[binds, beam]
-
-    init = jnp.broadcast_to(jnp.arange(K), (B, K))
-    # step t uses parents at t+1 to pick the beam, then reads ids at t
-    last = ids[T - 1][binds, init]
-    if T == 1:
-        return last[None]
-    beam0 = parents[T - 1][binds, init]
-    _, rest = lax.scan(body, beam0,
-                       (jnp.flip(ids[:-1], 0), jnp.flip(parents[:-1], 0)))
-    return jnp.concatenate([jnp.flip(rest, 0), last[None]], axis=0)
+# gather_tree: single registered implementation lives in tensor/extra_ops
+# (re-registering here would shadow its OP_REGISTRY entry)
+from ...tensor.extra_ops import gather_tree  # noqa: E402
 
 
 # --------------------------------------------------------------- in-place

@@ -212,9 +212,11 @@ def _adaptive_reduce_op(x, out_size):
 def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
               ceil_mode=False, data_format="NCL", name=None):
     from .extra import lp_pool2d
-    out = lp_pool2d(x[..., None], norm_type, (kernel_size, 1),
-                    (stride if stride is not None else kernel_size, 1),
-                    (padding, 0), ceil_mode)
+    k = _norm_tuple(kernel_size, 1)[0]
+    s = _norm_tuple(stride if stride is not None else kernel_size, 1)[0]
+    p = _norm_tuple(padding, 1)[0]
+    out = lp_pool2d(x[..., None], norm_type, (k, 1), (s, 1), (p, 0),
+                    ceil_mode)
     return out[..., 0]
 
 
@@ -264,10 +266,11 @@ def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
     with a singleton W axis (flat plane index == L index when W=1)."""
     if output_size is not None:
         output_size = tuple(output_size) + (1,)
-    out = max_unpool2d(
-        x[..., None], indices[..., None], (kernel_size, 1),
-        (stride if stride is not None else kernel_size, 1), (padding, 0),
-        output_size)
+    k = _norm_tuple(kernel_size, 1)[0]
+    s = _norm_tuple(stride if stride is not None else kernel_size, 1)[0]
+    p = _norm_tuple(padding, 1)[0]
+    out = max_unpool2d(x[..., None], indices[..., None], (k, 1), (s, 1),
+                       (p, 0), output_size)
     return out[..., 0]
 
 

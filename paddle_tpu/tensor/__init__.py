@@ -29,6 +29,7 @@ from .extra_ops import (  # noqa: F401
     standard_gamma, exponential_, gaussian, truncated_gaussian_random,
     top_p_sampling, gather_tree, edit_distance, accuracy,
 )
+from .array_api import *   # noqa: F401,F403  (top-level long tail)
 from . import linalg       # noqa: F401
 from . import math as _math
 from . import manipulation as _manip

@@ -1,0 +1,437 @@
+"""Top-level array-API long tail: numpy-style stack/split/combinatorics,
+predicates, distance ops, random in-place fills, and the module-level
+in-place (`op_`) function family.
+
+Capability parity: the remaining python/paddle/__init__.py exports
+(python/paddle/tensor/{math,manipulation,random,logic}.py) — every name
+here is a reference top-level export that was still missing.
+"""
+from __future__ import annotations
+
+import builtins
+import math as pymath
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import call_op, def_op
+from ..framework.tensor import Tensor, to_tensor, wrap_array
+from ..framework import dtype as dtypes
+from ..framework import random as _random
+from . import math as _math
+from . import manipulation as _manip
+from . import logic as _logic
+from . import search as _search
+from . import creation as _creation
+from . import linalg as _linalg
+from . import extra_ops as _extra
+
+
+# ------------------------------------------------------------- stacks/splits
+@def_op("hstack")
+def hstack(x, name=None):
+    return jnp.hstack(x)
+
+
+@def_op("vstack")
+def vstack(x, name=None):
+    return jnp.vstack(x)
+
+
+@def_op("dstack")
+def dstack(x, name=None):
+    return jnp.dstack(x)
+
+
+@def_op("column_stack")
+def column_stack(x, name=None):
+    return jnp.column_stack(x)
+
+
+@def_op("row_stack")
+def row_stack(x, name=None):
+    return jnp.vstack(x)
+
+
+def _split_sections(x, num_or_indices, axis):
+    if isinstance(num_or_indices, int):
+        return jnp.array_split(x, num_or_indices, axis=axis)
+    return jnp.split(x, list(num_or_indices), axis=axis)
+
+
+@def_op("tensor_split")
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return tuple(_split_sections(x, num_or_indices, axis))
+
+
+@def_op("hsplit")
+def hsplit(x, num_or_indices, name=None):
+    return tuple(_split_sections(x, num_or_indices, 1 if x.ndim > 1 else 0))
+
+
+@def_op("vsplit")
+def vsplit(x, num_or_indices, name=None):
+    return tuple(_split_sections(x, num_or_indices, 0))
+
+
+@def_op("dsplit")
+def dsplit(x, num_or_indices, name=None):
+    return tuple(_split_sections(x, num_or_indices, 2))
+
+
+@def_op("block_diag")
+def block_diag(inputs, name=None):
+    mats = [jnp.atleast_2d(m) for m in inputs]
+    rows = builtins.sum(m.shape[0] for m in mats)
+    cols = builtins.sum(m.shape[1] for m in mats)
+    out = jnp.zeros((rows, cols), mats[0].dtype)
+    r = c = 0
+    for m in mats:
+        out = out.at[r:r + m.shape[0], c:c + m.shape[1]].set(m)
+        r += m.shape[0]
+        c += m.shape[1]
+    return out
+
+
+@def_op("cartesian_prod")
+def cartesian_prod(x, name=None):
+    grids = jnp.meshgrid(*x, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+@def_op("combinations")
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    n = x.shape[0]
+    combo = itertools.combinations_with_replacement(range(n), r) \
+        if with_replacement else itertools.combinations(range(n), r)
+    idx = np.array(list(combo), np.int32).reshape(-1, r)
+    return x[idx]
+
+
+# ---------------------------------------------------------------- predicates
+# (isneginf/isposinf/signbit/sinc/histogram_bin_edges are registered in
+# extra_ops — re-exported here, NOT re-registered: def_op overwrites the
+# registry entry for a duplicate name)
+from .extra_ops import (  # noqa: E402
+    isneginf, isposinf, signbit, sinc, histogram_bin_edges,
+)
+
+
+@def_op("isreal")
+def isreal(x, name=None):
+    return jnp.isreal(x)
+
+
+@def_op("isin")
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return jnp.isin(x, test_x, invert=invert)
+
+
+
+
+@def_op("sgn")
+def sgn(x, name=None):
+    """Complex-aware sign: x/|x| (0 where x == 0) — reference paddle.sgn."""
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+
+
+@def_op("positive")
+def positive(x, name=None):
+    return +x
+
+
+@def_op("is_complex_")
+def _is_complex(x):
+    return jnp.iscomplexobj(x)
+
+
+def is_complex(x):
+    return dtypes.is_complex(x.dtype) if hasattr(x, "dtype") else False
+
+
+def is_floating_point(x):
+    return dtypes.is_floating_point(x.dtype)
+
+
+def is_integer(x):
+    return dtypes.is_integer(x.dtype) if hasattr(dtypes, "is_integer") \
+        else jnp.issubdtype(x.dtype, jnp.integer)
+
+
+# --------------------------------------------------------------- numpy-alikes
+@def_op("take")
+def take(x, index, mode="raise", name=None):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if mode == "wrap":
+        index = ((index % n) + n) % n
+    else:   # raise / clip: OOB clamps (no data-dependent raise under XLA)
+        index = jnp.clip(index, -n, n - 1)
+    index = jnp.where(index < 0, index + n, index)
+    return flat[index]
+
+
+@def_op("matrix_transpose")
+def matrix_transpose(x, name=None):
+    return jnp.swapaxes(x, -1, -2)
+
+
+@def_op("vecdot")
+def vecdot(x, y, axis=-1, name=None):
+    return jnp.sum(x * y, axis=axis)
+
+
+@def_op("unflatten")
+def unflatten(x, axis, shape, name=None):
+    axis = axis % x.ndim
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = x.shape[axis] // max(1, known)
+    new = list(x.shape[:axis]) + shape + list(x.shape[axis + 1:])
+    return x.reshape(new)
+
+
+@def_op("tensor_unfold")
+def unfold(x, axis, size, step, name=None):
+    """Rolling windows along ``axis`` (reference paddle.unfold tensor op):
+    output appends a trailing window dim of length ``size``."""
+    axis = axis % x.ndim
+    n = (x.shape[axis] - size) // step + 1
+    idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+    moved = jnp.moveaxis(x, axis, 0)
+    win = moved[idx]                        # [n, size, ...rest]
+    win = jnp.moveaxis(win, 1, -1)          # [n, ...rest, size]
+    return jnp.moveaxis(win, 0, axis)
+
+
+@def_op("masked_scatter")
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions of x with consecutive elements of value
+    (row-major), reference paddle.masked_scatter."""
+    flat_m = jnp.broadcast_to(mask, x.shape).reshape(-1)
+    flat_x = x.reshape(-1)
+    src = value.reshape(-1)
+    # the i-th True position takes src[count of Trues before i]
+    take_idx = jnp.cumsum(flat_m) - 1
+    take_idx = jnp.clip(take_idx, 0, src.shape[0] - 1)
+    return jnp.where(flat_m, src[take_idx], flat_x).reshape(x.shape)
+
+
+@def_op("slice_scatter")
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(st, en, sr)
+    return x.at[tuple(idx)].set(value)
+
+
+@def_op("add_n")
+def add_n(inputs, name=None):
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+@def_op("broadcast_shape_")
+def _broadcast_shape_stub(x):   # registry entry for parity; logic is static
+    return x
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@def_op("trapezoid")
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+@def_op("cumulative_trapezoid")
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    d = jnp.diff(x, axis=axis) if x is not None else \
+        (1.0 if dx is None else dx)
+    y0 = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+    avg = (y0 + y1) / 2.0
+    return jnp.cumsum(avg * d, axis=axis)
+
+
+
+
+@def_op("pdist")
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of rows (reference paddle.pdist)."""
+    n = x.shape[0]
+    iu = np.triu_indices(n, k=1)
+    d = x[iu[0]] - x[iu[1]]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(d * d, axis=-1))
+    return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+
+@def_op("multigammaln")
+def multigammaln(x, p, name=None):
+    c = 0.25 * p * (p - 1) * pymath.log(pymath.pi)
+    out = c
+    for j in range(p):
+        out = out + jax.scipy.special.gammaln(x - j / 2.0)
+    return out
+
+
+def tolist(x):
+    return x.numpy().tolist()
+
+
+def view_as(x, other, name=None):
+    return x.reshape(list(other.shape))
+
+
+@def_op("log_normal")
+def _log_normal(key, mean, std, shape):
+    return jnp.exp(mean + std * jax.random.normal(key, shape))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    out = _log_normal(_random.split_key(), float(mean), float(std),
+                      tuple(shape or [1]))
+    return out if dtype is None else out.astype(dtypes.convert_dtype(dtype))
+
+
+# ----------------------------------------------------- random in-place fills
+def _fill_inplace(x, new_data):
+    x._data = new_data.astype(x._data.dtype)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    """In-place fill with N(mean, std) (reference Tensor.normal_)."""
+    key = _random.split_key()
+    return _fill_inplace(
+        x, mean + std * jax.random.normal(key, x._data.shape))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    key = _random.split_key()
+    return _fill_inplace(
+        x, jnp.exp(mean + std * jax.random.normal(key, x._data.shape)))
+
+
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    key = _random.split_key()
+    u = jax.random.uniform(key, x._data.shape, jnp.float32, 1e-7, 1 - 1e-7)
+    return _fill_inplace(x, loc + scale * jnp.tan(jnp.pi * (u - 0.5)))
+
+
+def geometric_(x, probs, name=None):
+    key = _random.split_key()
+    u = jax.random.uniform(key, x._data.shape, jnp.float32, 1e-7, 1 - 1e-7)
+    return _fill_inplace(x, jnp.floor(jnp.log(u) / jnp.log1p(-probs)) + 1)
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = _random.split_key()
+    return _fill_inplace(
+        x, jax.random.bernoulli(key, p, x._data.shape).astype(jnp.float32))
+
+
+# ------------------------------------------------------------------- aliases
+less = _logic.less_than
+
+
+# --------------------------------------------- module-level in-place family
+def _module_inplace(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def inner(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._data = out._data
+        x._grad_node = getattr(out, "_grad_node", None)
+        x._node_out_idx = getattr(out, "_node_out_idx", 0)
+        x.stop_gradient = out.stop_gradient and x.stop_gradient
+        return x
+    return inner
+
+
+_NS = [_math, _manip, _logic, _search, _creation, _linalg, _extra]
+
+
+def _lookup(name):
+    for ns in _NS:
+        if hasattr(ns, name):
+            return getattr(ns, name)
+    return globals().get(name)
+
+
+# every reference top-level `op_` whose base op exists gets a module-level
+# in-place variant (reference: inplace api generation in
+# python/paddle/tensor/__init__.py tensor_method_func registry)
+_INPLACE_NAMES = [
+    "abs", "acos", "addmm", "asin", "atan", "bitwise_and", "bitwise_not",
+    "bitwise_or", "bitwise_xor", "bitwise_left_shift", "bitwise_right_shift",
+    "cast", "ceil", "clip", "copysign", "cos", "cosh", "cumprod", "cumsum",
+    "digamma", "divide", "equal", "erf", "exp", "expm1", "fill_diagonal",
+    "flatten", "floor", "floor_divide", "frac", "gammainc", "gammaincc",
+    "gammaln", "gcd", "greater_equal", "greater_than", "hypot", "i0", "lcm",
+    "ldexp", "lerp", "less", "less_equal", "less_than", "lgamma", "log",
+    "log10", "log1p", "log2", "logical_and", "logical_not", "logical_or",
+    "logical_xor", "logit", "masked_fill", "masked_scatter", "mod",
+    "multiply", "nan_to_num", "neg", "polygamma", "pow", "reciprocal",
+    "remainder", "renorm", "reshape", "round", "rsqrt", "scale", "scatter",
+    "sigmoid", "sign", "sin", "sinc", "sinh", "sqrt", "square", "squeeze",
+    "subtract", "tan", "tanh", "tril", "triu", "trunc", "unsqueeze", "where",
+]
+
+_generated = []
+for _name in _INPLACE_NAMES:
+    _fn = _lookup(_name)
+    if _fn is not None:
+        globals()[_name + "_"] = _module_inplace(_fn)
+        _generated.append(_name + "_")
+
+# reference naming quirks
+floor_mod_ = globals().get("mod_", None) or _module_inplace(_math.remainder)
+mod_ = floor_mod_
+bitwise_invert = _logic.bitwise_not
+bitwise_invert_ = globals()["bitwise_not_"]
+
+
+def t_(x, name=None):
+    """In-place 2-D transpose (reference paddle.t_)."""
+    out = _manip.transpose(x, list(range(x.ndim))[::-1])
+    x._data = out._data
+    x._grad_node = getattr(out, "_grad_node", None)
+    x._node_out_idx = getattr(out, "_node_out_idx", 0)
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    return _extra.exponential_(x, lam)
+
+
+__all__ = ([
+    "hstack", "vstack", "dstack", "column_stack", "row_stack",
+    "tensor_split", "hsplit", "vsplit", "dsplit", "block_diag",
+    "cartesian_prod", "combinations", "isneginf", "isposinf", "isreal",
+    "isin", "signbit", "sgn", "sinc", "positive", "is_complex",
+    "is_floating_point", "is_integer", "take", "matrix_transpose", "vecdot",
+    "unflatten", "unfold", "masked_scatter", "slice_scatter", "add_n",
+    "broadcast_shape", "trapezoid", "cumulative_trapezoid",
+    "histogram_bin_edges", "pdist", "multigammaln", "tolist", "view_as",
+    "log_normal", "normal_", "log_normal_", "cauchy_", "geometric_",
+    "bernoulli_", "less", "t_", "exponential_", "floor_mod_", "mod_",
+    "bitwise_invert", "bitwise_invert_", "multigammaln_",
+] + _generated)
+
+multigammaln_ = _module_inplace(multigammaln)
