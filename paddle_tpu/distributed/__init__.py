@@ -63,3 +63,17 @@ from .fleet import elastic  # noqa: E402,F401
 from . import auto_tuner  # noqa: E402,F401
 from . import rpc  # noqa: E402,F401
 from . import ps  # noqa: E402,F401
+from .ps.entry import (  # noqa: E402,F401
+    CountFilterEntry, ProbabilityEntry, ShowClickEntry,
+)
+from .collective import (  # noqa: E402,F401
+    alltoall_single, gather, wait, is_available,
+    gloo_init_parallel_env, gloo_barrier, gloo_release,
+)
+from .sharding_stage import (  # noqa: E402,F401
+    ParallelMode, ShardingStage1, ShardingStage2, ShardingStage3,
+    shard_scaler, split,
+)
+from . import io  # noqa: E402,F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: E402,F401
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: E402,F401

@@ -431,3 +431,117 @@ def scatter_object_list(out_list, in_list, src=0, group=None):
     out_list.append(pickle.loads(p2p.store_get(f"{key}/{rank}")))
     _release_when_all_read(f"{key}/{rank}", 1)   # each slot has one reader
     return out_list
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """reference: communication/all_to_all.py alltoall_single — one tensor
+    split along dim 0 across ranks.  SPMD lane: a Shard(0)->Shard(1)
+    reshard (the compiled all-to-all); multi-process: p2p exchange of the
+    row blocks."""
+    world = _host_world()
+    if world == 1:
+        if isinstance(in_tensor, Tensor) and in_tensor.dist_attr is not None:
+            res = all_to_all(None, in_tensor, group, sync_op)
+            if out_tensor is not None:
+                out_tensor._data = res._data
+                out_tensor.dist_attr = res.dist_attr
+            return res
+        if out_tensor is not None:
+            out_tensor._data = in_tensor._data
+        return in_tensor
+    rank = _host_rank()
+    n = in_tensor.shape[0]
+    if in_split_sizes is None:
+        in_split_sizes = [n // world] * world
+    offs = np.cumsum([0] + list(in_split_sizes))
+    blocks = [in_tensor[int(offs[i]):int(offs[i + 1])]
+              for i in range(world)]
+    got = all_to_all(None, blocks, group, sync_op)
+    from ..tensor.manipulation import concat as _concat
+    res = _concat(got, axis=0)
+    if out_tensor is not None:
+        out_tensor._data = res._data
+    return res
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """reference: communication/gather.py — collect tensors on rank dst.
+    SPMD lane: all ranks see the full value (all_gather then keep);
+    multi-process: p2p to dst."""
+    world = _host_world()
+    if world == 1:
+        out = []
+        all_gather(out, tensor, group, sync_op)
+        if gather_list is not None and _host_rank() == dst:
+            gather_list.extend(out)
+        return out
+    from . import p2p
+    rank = _host_rank()
+    tag = _obj_key("gather")
+    if rank == dst:
+        parts = []
+        for src in range(world):
+            if src == rank:
+                parts.append(tensor)
+            else:
+                t = tensor.clone() if hasattr(tensor, "clone") else tensor
+                parts.append(p2p.recv(t, src=src, tag=tag))
+        if gather_list is not None:
+            gather_list.extend(parts)
+        return parts
+    p2p.send(tensor, dst=dst, tag=tag)
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference: communication/wait.py — block until the tensor's value
+    is materialized (XLA async dispatch barrier)."""
+    import jax
+    jax.block_until_ready(tensor._data)
+    return tensor
+
+
+def is_available() -> bool:
+    """reference: paddle.distributed.is_available."""
+    import jax
+    try:
+        return len(jax.devices()) > 0
+    except Exception:
+        return False
+
+
+# ------------------------------------------------------- gloo CPU barrier
+_gloo_state = {"store": None, "rank": 0, "world": 1, "gen": 0}
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int,
+                           server_endpoint: str):
+    """reference: pybind gloo_init_parallel_env — CPU-side barrier fabric.
+    The TCPStore plays gloo's role on this stack."""
+    from .store import TCPStore
+    host, port = server_endpoint.rsplit(":", 1)
+    _gloo_state["store"] = TCPStore(host, int(port),
+                                    is_master=(rank_id == 0),
+                                    world_size=rank_num)
+    _gloo_state["rank"] = rank_id
+    _gloo_state["world"] = rank_num
+
+
+def gloo_barrier():
+    """reference: pybind gloo_barrier."""
+    from .store import barrier as _store_barrier
+    st = _gloo_state["store"]
+    if st is None:
+        return
+    _gloo_state["gen"] += 1
+    _store_barrier(st, f"gloo/barrier/{_gloo_state['gen']}",
+                   _gloo_state["world"])
+
+
+def gloo_release():
+    """reference: pybind gloo_release."""
+    st = _gloo_state["store"]
+    if st is not None:
+        st.close()
+        _gloo_state["store"] = None

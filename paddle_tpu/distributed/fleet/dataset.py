@@ -1,0 +1,133 @@
+"""Fleet datasets for PS-style training (reference:
+python/paddle/distributed/fleet/dataset/dataset.py — InMemoryDataset
+(load_into_memory/local_shuffle/global_shuffle over slot files) and
+QueueDataset (streaming single-pass)).
+
+TPU-native scope: the reference parses slot files through a C++ DataFeed
+pipeline into the PS trainers; here the datasets are host-side readers
+feeding the eager/compiled path — same API, same file format contract
+(one sample per line; ``parse_fn`` converts a line to a sample, default:
+whitespace-separated floats).
+"""
+from __future__ import annotations
+
+import random
+from typing import Callable, List, Optional
+
+__all__ = ["InMemoryDataset", "QueueDataset"]
+
+
+def _default_parse(line: str):
+    parts = line.split()
+    return [float(p) for p in parts]
+
+
+class _DatasetBase:
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._parse_fn: Callable = _default_parse
+        self._batch_size = 1
+        self._thread_num = 1
+        self._use_var = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name="", fs_ugi="",
+             parse_fn: Optional[Callable] = None, **kwargs):
+        """reference: dataset.init — accepts the reference's knobs;
+        pipe_command is replaced by parse_fn (no external process)."""
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_var = use_var
+        if parse_fn is not None:
+            self._parse_fn = parse_fn
+        return self
+
+    def set_filelist(self, filelist: List[str]):
+        self._filelist = list(filelist)
+
+    def _iter_lines(self):
+        for path in self._filelist:
+            with open(path) as fh:
+                for line in fh:
+                    line = line.rstrip("\n")
+                    if line:
+                        yield line
+
+
+class InMemoryDataset(_DatasetBase):
+    """reference: InMemoryDataset — load to host memory, shuffle, iterate
+    many epochs."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+        self._rng = random.Random(0)
+
+    def load_into_memory(self):
+        self._samples = [self._parse_fn(l) for l in self._iter_lines()]
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        return None
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._samples)
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return len(self._samples)
+
+    def local_shuffle(self):
+        self._rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        """Single-host scope: equivalent to local_shuffle (a multi-host
+        shuffle would exchange buckets over the RPC layer)."""
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = []
+
+    def slots_shuffle(self, slots):
+        """reference: slots_shuffle — shuffle the given feature slots
+        across samples (feature-permutation test utility)."""
+        for slot in slots:
+            col = [s[slot] for s in self._samples]
+            self._rng.shuffle(col)
+            for s, v in zip(self._samples, col):
+                s[slot] = v
+
+    def __iter__(self):
+        batch = []
+        for s in self._samples:
+            batch.append(s)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class QueueDataset(_DatasetBase):
+    """reference: QueueDataset — single-pass streaming over the filelist
+    (no memory residency, no shuffle)."""
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset streams files in one pass and cannot shuffle "
+            "(reference behavior); use InMemoryDataset")
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        raise NotImplementedError(
+            "QueueDataset cannot global_shuffle (reference behavior)")
+
+    def __iter__(self):
+        batch = []
+        for line in self._iter_lines():
+            batch.append(self._parse_fn(line))
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch

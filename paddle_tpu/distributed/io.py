@@ -1,0 +1,38 @@
+"""Distributed IO facade (reference: python/paddle/distributed/io.py —
+save_persistables / load_persistables / is_persistable over the dist
+program; here: the sharded-checkpoint API plus whole-model save/load).
+"""
+from __future__ import annotations
+
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from ..framework.io import save, load  # noqa: F401
+
+__all__ = ["save_state_dict", "load_state_dict", "save", "load",
+           "save_persistables", "load_persistables"]
+
+
+def save_persistables(executor=None, dirname=".", main_program=None,
+                      filename=None, model=None):
+    """reference: distributed/io.py save_persistables.  The static-graph
+    executor/program arguments are accepted for API compatibility; the
+    persistable set here is a Layer's parameter state."""
+    if model is None:
+        raise ValueError(
+            "save_persistables: pass model= (a Layer); the static Program "
+            "path does not exist on this stack (SURVEY §7: jit/XLA "
+            "replaces the Program+Executor machinery)")
+    import os
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    save(model.state_dict(), path)
+    return path
+
+
+def load_persistables(executor=None, dirname=".", main_program=None,
+                      filename=None, model=None):
+    """reference: distributed/io.py load_persistables."""
+    if model is None:
+        raise ValueError("load_persistables: pass model= (a Layer)")
+    import os
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    model.set_state_dict(load(path))
+    return model

@@ -84,3 +84,26 @@ class PSClient:
     def stop_servers(self) -> None:
         for s in self.server_names:
             _rpc.rpc_sync(s, _server._h_stop, ())
+
+    # -- dense tables ------------------------------------------------------
+    def create_dense_table(self, name: str, shape, server: int = 0,
+                           **kwargs) -> None:
+        """Dense tables live whole on one server (reference: dense params
+        are partitioned per-variable, not per-row)."""
+        _rpc.rpc_sync(self.server_names[server % self.n],
+                      _server._h_create_dense, (name, tuple(shape), kwargs))
+
+    def pull_dense(self, name: str, server: int = 0) -> np.ndarray:
+        return _rpc.rpc_sync(self.server_names[server % self.n],
+                             _server._h_dense_pull, (name,))
+
+    def push_dense(self, name: str, grad, learning_rate=None,
+                   server: int = 0) -> None:
+        _rpc.rpc_sync(self.server_names[server % self.n],
+                      _server._h_dense_push,
+                      (name, np.asarray(grad, np.float32), learning_rate))
+
+    def set_dense(self, name: str, value, server: int = 0) -> None:
+        _rpc.rpc_sync(self.server_names[server % self.n],
+                      _server._h_dense_set,
+                      (name, np.asarray(value, np.float32)))

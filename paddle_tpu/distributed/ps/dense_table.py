@@ -1,0 +1,90 @@
+"""Dense parameter table (reference:
+paddle/fluid/distributed/ps/table/memory_dense_table.cc — fixed-shape
+dense params hosted on the PS with per-table optimizer rules: sgd, adam,
+summary/moving-average).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["MemoryDenseTable"]
+
+
+class MemoryDenseTable:
+    """A dense fp32 parameter block on the server.
+
+    optimizer:
+      'sgd'     param -= lr * grad
+      'adam'    bias-corrected Adam (reference dense adam rule)
+      'summary' exponential moving average of pushed VALUES
+                (reference summary accessor: decay * old + value)
+    """
+
+    def __init__(self, shape, optimizer: str = "sgd",
+                 learning_rate: float = 0.05, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8,
+                 summary_decay_rate: float = 0.999999, seed: int = 0):
+        self.shape = tuple(shape)
+        self.optimizer = optimizer
+        self.learning_rate = learning_rate
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.summary_decay_rate = summary_decay_rate
+        rng = np.random.default_rng(seed)
+        if optimizer == "summary":
+            self._param = np.zeros(self.shape, np.float32)
+        else:
+            scale = 1.0 / max(1, int(np.prod(self.shape[:1])))
+            self._param = rng.uniform(-scale, scale, self.shape).astype(
+                np.float32)
+        self._m = np.zeros(self.shape, np.float32)
+        self._v = np.zeros(self.shape, np.float32)
+        self._step = 0
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self._param.copy()
+
+    def push(self, grad: np.ndarray,
+             learning_rate: Optional[float] = None) -> None:
+        g = np.asarray(grad, np.float32)
+        lr = self.learning_rate if learning_rate is None else learning_rate
+        with self._lock:
+            if self.optimizer == "summary":
+                self._param *= self.summary_decay_rate
+                self._param += g
+            elif self.optimizer == "adam":
+                self._step += 1
+                self._m = self.beta1 * self._m + (1 - self.beta1) * g
+                self._v = self.beta2 * self._v + (1 - self.beta2) * g * g
+                mhat = self._m / (1 - self.beta1 ** self._step)
+                vhat = self._v / (1 - self.beta2 ** self._step)
+                self._param -= lr * mhat / (np.sqrt(vhat) + self.epsilon)
+            else:
+                self._param -= lr * g
+
+    def set(self, value: np.ndarray) -> None:
+        with self._lock:
+            self._param = np.asarray(value, np.float32).reshape(self.shape)
+
+    def save(self, path: str) -> None:
+        with self._lock:
+            payload = {"shape": self.shape, "param": self._param,
+                       "m": self._m, "v": self._v, "step": self._step}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(payload, f, protocol=4)
+
+    def load(self, path: str) -> None:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        with self._lock:
+            self._param = payload["param"]
+            self._m = payload.get("m", self._m)
+            self._v = payload.get("v", self._v)
+            self._step = payload.get("step", 0)

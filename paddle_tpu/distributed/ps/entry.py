@@ -1,0 +1,101 @@
+"""Sparse-table row admission policies (reference:
+python/paddle/distributed/entry_attr.py — CountFilterEntry,
+ProbabilityEntry, ShowClickEntry; consumed by the C++ ctr accessors in
+paddle/fluid/distributed/ps/table/ctr_accessor.cc).
+
+An Entry decides whether an unseen feature id gets a materialized row:
+high-cardinality CTR features mostly appear once, and admitting every id
+explodes the table.  Un-admitted ids pull zeros and drop their pushes.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict
+
+__all__ = ["Entry", "CountFilterEntry", "ProbabilityEntry",
+           "ShowClickEntry"]
+
+
+class Entry:
+    def _to_attr(self) -> str:
+        raise NotImplementedError
+
+    def admit(self, key: int) -> bool:
+        """Called once per push of an unseen id; True -> create the row."""
+        raise NotImplementedError
+
+
+class CountFilterEntry(Entry):
+    """Admit an id after it has been pushed ``count`` times (reference:
+    entry_attr.py CountFilterEntry)."""
+
+    def __init__(self, count: int):
+        if count < 0:
+            raise ValueError(
+                f"up_threshold must be >= 0, got {count}")
+        self.count = count
+        self._seen: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count}"
+
+    def admit(self, key: int) -> bool:
+        with self._lock:
+            seen = self._seen.get(key, 0) + 1
+            self._seen[key] = seen
+            return seen >= self.count
+
+
+class ProbabilityEntry(Entry):
+    """Admit an unseen id with probability p (reference:
+    entry_attr.py ProbabilityEntry)."""
+
+    def __init__(self, probability: float, seed: int = 0):
+        if not 0 <= probability <= 1:
+            raise ValueError(
+                f"probability must be in [0, 1], got {probability}")
+        self.probability = probability
+        self._rng = random.Random(seed)
+        self._decided: Dict[int, bool] = {}
+        self._lock = threading.Lock()
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+    def admit(self, key: int) -> bool:
+        with self._lock:
+            if key not in self._decided:
+                self._decided[key] = \
+                    self._rng.random() < self.probability
+            return self._decided[key]
+
+
+class ShowClickEntry(Entry):
+    """Rows carry show/click statistics named by the given variables
+    (reference: entry_attr.py ShowClickEntry — the ctr accessor's
+    show/click decay columns).  Admission is unconditional; the table
+    tracks the stats via ``record_show_click``."""
+
+    def __init__(self, show_name: str, click_name: str):
+        self.show_name = show_name
+        self.click_name = click_name
+        self._stats: Dict[int, list] = {}
+        self._lock = threading.Lock()
+
+    def _to_attr(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"
+
+    def admit(self, key: int) -> bool:
+        return True
+
+    def record(self, key: int, show: float = 1.0, click: float = 0.0):
+        with self._lock:
+            st = self._stats.setdefault(key, [0.0, 0.0])
+            st[0] += show
+            st[1] += click
+
+    def stats(self, key: int):
+        with self._lock:
+            return tuple(self._stats.get(key, (0.0, 0.0)))

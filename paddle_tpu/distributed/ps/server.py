@@ -9,6 +9,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from .table import MemorySparseTable
+from .dense_table import MemoryDenseTable
 
 # process-global registry the RPC handler functions act on (RPC ships the
 # function by pickle; it must resolve state on the *server* side)
@@ -19,6 +20,7 @@ class PSServer:
     def __init__(self, server_index: int = 0):
         self.server_index = server_index
         self._tables: Dict[str, MemorySparseTable] = {}
+        self._dense: Dict[str, MemoryDenseTable] = {}
         self._create_lock = threading.Lock()
         self._stop = threading.Event()
 
@@ -33,6 +35,23 @@ class PSServer:
                 return
             self._tables[name] = MemorySparseTable(
                 dim, seed=self.server_index * 7919 + 1, **kwargs)
+
+    def create_dense_table(self, name: str, shape, **kwargs) -> None:
+        """reference: memory_dense_table.cc — dense param block on the
+        server (adam/sgd/summary rules)."""
+        with self._create_lock:
+            existing = self._dense.get(name)
+            if existing is not None:
+                if existing.shape != tuple(shape):
+                    raise ValueError(
+                        f"dense table '{name}' exists with shape "
+                        f"{existing.shape}, requested {tuple(shape)}")
+                return
+            self._dense[name] = MemoryDenseTable(
+                shape, seed=self.server_index * 104729 + 3, **kwargs)
+
+    def dense_table(self, name: str) -> MemoryDenseTable:
+        return self._dense[name]
 
     def table(self, name: str) -> MemorySparseTable:
         return self._tables[name]
@@ -82,4 +101,23 @@ def _h_load(name, path):
 
 def _h_stop():
     _SERVER.stop()
+    return True
+
+
+def _h_create_dense(name, shape, kwargs):
+    _SERVER.create_dense_table(name, shape, **kwargs)
+    return True
+
+
+def _h_dense_pull(name):
+    return _SERVER.dense_table(name).pull()
+
+
+def _h_dense_push(name, grad, lr):
+    _SERVER.dense_table(name).push(np.asarray(grad), lr)
+    return True
+
+
+def _h_dense_set(name, value):
+    _SERVER.dense_table(name).set(np.asarray(value))
     return True

@@ -18,12 +18,15 @@ class MemorySparseTable:
 
     def __init__(self, dim: int, initializer: str = "uniform",
                  init_scale: float = 0.01, optimizer: str = "sgd",
-                 learning_rate: float = 0.05, seed: int = 0):
+                 learning_rate: float = 0.05, seed: int = 0, entry=None):
         self.dim = dim
         self.initializer = initializer
         self.init_scale = init_scale
         self.optimizer = optimizer
         self.learning_rate = learning_rate
+        # row-admission policy (reference ctr accessor entry configs);
+        # None admits everything
+        self.entry = entry
         self._rows: Dict[int, np.ndarray] = {}
         self._accum: Dict[int, np.ndarray] = {}
         self._rng = np.random.default_rng(seed)
@@ -41,6 +44,11 @@ class MemorySparseTable:
             for i, key in enumerate(np.asarray(ids, np.int64)):
                 row = self._rows.get(int(key))
                 if row is None:
+                    if self.entry is not None:
+                        # un-admitted id: serve zeros, do NOT materialize
+                        # (reference: ctr accessor entry gate)
+                        out[i] = 0.0
+                        continue
                     row = self._rows[int(key)] = self._init_row()
                 out[i] = row
         return out
@@ -54,6 +62,8 @@ class MemorySparseTable:
                 k = int(key)
                 row = self._rows.get(k)
                 if row is None:
+                    if self.entry is not None and not self.entry.admit(k):
+                        continue      # below admission threshold: drop
                     row = self._rows[k] = self._init_row()
                 g = grads[i]
                 if self.optimizer == "sum":

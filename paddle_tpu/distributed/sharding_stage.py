@@ -1,0 +1,140 @@
+"""ShardingStage1/2/3 shard_fn factories + ParallelMode + shard_scaler +
+the model-parallel ``split`` functional.
+
+Capability parity: paddle.distributed.{ShardingStage1,ShardingStage2,
+ShardingStage3,ParallelMode,shard_scaler,split} (reference:
+python/paddle/distributed/auto_parallel/api.py ShardingStage*,
+fleet/base/topology.py ParallelMode, fleet/meta_parallel/parallel_layers).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .auto_parallel.placement import Shard, Replicate
+from .auto_parallel.process_mesh import ProcessMesh, get_mesh
+
+__all__ = ["ParallelMode", "ShardingStage1", "ShardingStage2",
+           "ShardingStage3", "shard_scaler", "split"]
+
+
+class ParallelMode:
+    """reference: fleet/base/topology.py ParallelMode."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class _ShardingStage:
+    """A shard_fn for ``dist.shard_optimizer`` (reference api.py
+    ShardingStage1/2/3): states shard dim-0 over ``mesh_dim``.
+
+    All three stages produce the same *state* placement on this stack —
+    the stage differences (grad reduce-scatter, param sharding) are applied
+    by TrainStep / group_sharded_parallel from the stamped level; see
+    fleet/sharding.py for the compiled-memory distinction."""
+
+    level = "os"
+
+    def __init__(self, mesh_dim: str = "dp",
+                 mesh: Optional[ProcessMesh] = None):
+        self.mesh_dim = mesh_dim
+        self.mesh = mesh
+
+    def _mesh(self):
+        m = self.mesh or get_mesh()
+        if m is None:
+            raise ValueError(
+                f"{type(self).__name__}: no mesh given and no global mesh "
+                f"set (dist.set_mesh / auto_mesh)")
+        return m
+
+    def __call__(self, slot, p):
+        mesh = self._mesh()
+        axis_idx = mesh.dim_names.index(self.mesh_dim)
+        degree = mesh.get_dim_size(self.mesh_dim)
+        placements = [Replicate()] * mesh.ndim
+        if p.ndim > 0 and p.shape[0] % degree == 0:
+            placements[axis_idx] = Shard(0)
+        return placements, mesh
+
+
+class ShardingStage1(_ShardingStage):
+    level = "os"
+
+
+class ShardingStage2(_ShardingStage):
+    level = "os_g"
+
+
+class ShardingStage3(_ShardingStage):
+    level = "p_g_os"
+
+    def __call__(self, slot, p):
+        # stage 3 also shards the PARAMETER itself (reference
+        # group_sharded_stage3.py:85)
+        from .auto_parallel.api import shard_tensor
+        placements, mesh = super().__call__(slot, p)
+        if p.dist_attr is None and any(
+                isinstance(pl, Shard) for pl in placements):
+            from ..framework.tape import no_grad
+            with no_grad():
+                shard_tensor(p, mesh, placements)
+        return placements, mesh
+
+
+def shard_optimizer_with_stage(optimizer, stage):
+    """Attach the stage's gradient/parameter semantics (level stamp reading
+    by jit.TrainStep) in addition to the state sharding."""
+    from .auto_parallel.api import shard_optimizer
+    optimizer = shard_optimizer(optimizer, stage)
+    if isinstance(stage, _ShardingStage):
+        optimizer._sharding_level = stage.level
+        optimizer._sharding_mesh = (stage._mesh(), stage.mesh_dim)
+    return optimizer
+
+
+def shard_scaler(scaler):
+    """reference: dist.shard_scaler (api.py) — make a GradScaler's found-inf
+    reduction span the sharding group.  Under single-process SPMD every
+    lane computes on the global view, so the scaler's ``unscale_`` already
+    sees globally-consistent gradients; the wrapper is the identity with
+    the contract documented (multi-process eager would all_reduce
+    found_inf here)."""
+    return scaler
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference: paddle.distributed.split (fleet/layers/mpu) — build and
+    apply a model-parallel linear/embedding over the 'mp' mesh axis.
+
+    operation='linear': size=(in_features, out_features); axis 1 = column
+    parallel (weight cols sharded), axis 0 = row parallel.
+    operation='embedding': size=(num_embeddings, embedding_dim), vocab
+    sharded over the mp axis.
+    """
+    from .fleet.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    )
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False, gather_output=gather_out)
+        else:
+            layer = RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=not gather_out)
+        return layer(x)
+    if operation == "embedding":
+        num_emb, dim = size
+        layer = VocabParallelEmbedding(num_emb, dim,
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(
+        f"split: operation must be 'linear' or 'embedding', "
+        f"got {operation!r}")

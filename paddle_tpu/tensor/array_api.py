@@ -172,9 +172,14 @@ def take(x, index, mode="raise", name=None):
     n = flat.shape[0]
     if mode == "wrap":
         index = ((index % n) + n) % n
-    else:   # raise / clip: OOB clamps (no data-dependent raise under XLA)
+    elif mode == "clip":
+        # reference: clip mode disables negative indexing — negatives
+        # clamp to 0, overlarge to n-1
+        index = jnp.clip(index, 0, n - 1)
+    else:   # raise: OOB clamps after python-style negative handling
+        # (no data-dependent raise inside an XLA program)
         index = jnp.clip(index, -n, n - 1)
-    index = jnp.where(index < 0, index + n, index)
+        index = jnp.where(index < 0, index + n, index)
     return flat[index]
 
 
@@ -185,7 +190,8 @@ def matrix_transpose(x, name=None):
 
 @def_op("vecdot")
 def vecdot(x, y, axis=-1, name=None):
-    return jnp.sum(x * y, axis=axis)
+    # reference (linalg.py): conj(x) . y — the complex inner product
+    return jnp.sum(jnp.conj(x) * y, axis=axis)
 
 
 @def_op("unflatten")
@@ -390,7 +396,7 @@ _INPLACE_NAMES = [
     "multiply", "nan_to_num", "neg", "polygamma", "pow", "reciprocal",
     "remainder", "renorm", "reshape", "round", "rsqrt", "scale", "scatter",
     "sigmoid", "sign", "sin", "sinc", "sinh", "sqrt", "square", "squeeze",
-    "subtract", "tan", "tanh", "tril", "triu", "trunc", "unsqueeze", "where",
+    "subtract", "tan", "tanh", "tril", "triu", "trunc", "unsqueeze",
 ]
 
 _generated = []
@@ -399,6 +405,21 @@ for _name in _INPLACE_NAMES:
     if _fn is not None:
         globals()[_name + "_"] = _module_inplace(_fn)
         _generated.append(_name + "_")
+
+def where_(condition, x=None, y=None, name=None):
+    """reference: paddle.where_ (search.py:860) — the result is written
+    into X (the second argument), not the condition."""
+    if x is None or y is None:
+        raise ValueError(
+            "where_ requires both x and y (the nonzero() form of where "
+            "has no in-place variant)")
+    out = _manip.where(condition, x, y)
+    x._data = out._data
+    x._grad_node = getattr(out, "_grad_node", None)
+    x._node_out_idx = getattr(out, "_node_out_idx", 0)
+    x.stop_gradient = out.stop_gradient and x.stop_gradient
+    return x
+
 
 # reference naming quirks
 floor_mod_ = globals().get("mod_", None) or _module_inplace(_math.remainder)
@@ -431,7 +452,7 @@ __all__ = ([
     "histogram_bin_edges", "pdist", "multigammaln", "tolist", "view_as",
     "log_normal", "normal_", "log_normal_", "cauchy_", "geometric_",
     "bernoulli_", "less", "t_", "exponential_", "floor_mod_", "mod_",
-    "bitwise_invert", "bitwise_invert_", "multigammaln_",
+    "bitwise_invert", "bitwise_invert_", "multigammaln_", "where_",
 ] + _generated)
 
 multigammaln_ = _module_inplace(multigammaln)

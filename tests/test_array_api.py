@@ -293,3 +293,23 @@ class TestReviewRegressions2:
         from paddle_tpu.tensor import array_api, extra_ops
         assert array_api.sinc is extra_ops.sinc
         assert array_api.signbit is extra_ops.signbit
+
+    def test_where_inplace_mutates_x(self):
+        cond = t(np.array([True, False]))
+        x = t(np.array([1.0, 2.0], np.float32))
+        y = t(np.array([9.0, 9.0], np.float32))
+        out = paddle.where_(cond, x, y)
+        assert out is x and x.numpy().tolist() == [1.0, 9.0]
+        assert cond.numpy().tolist() == [True, False]   # cond untouched
+        with pytest.raises(ValueError):
+            paddle.where_(cond)
+
+    def test_vecdot_complex_conjugates(self):
+        x = t(np.array([1j], np.complex64))
+        np.testing.assert_allclose(paddle.vecdot(x, x).numpy(), 1 + 0j)
+
+    def test_take_clip_negative_goes_to_front(self):
+        x = t(np.arange(12).reshape(3, 4))
+        assert paddle.take(x, t(np.array([-1])),
+                           mode="clip").numpy().tolist() == [0]
+        assert paddle.take(x, t(np.array([-1]))).numpy().tolist() == [11]
