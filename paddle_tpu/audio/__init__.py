@@ -55,5 +55,10 @@ def load(path: str, sr=None, mono: bool = True, dtype: str = "float32"):
     return data, rate
 
 
+from . import backends  # noqa: E402,F401
+from . import datasets  # noqa: E402,F401
+from .backends import info, save  # noqa: E402,F401
+
 __all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC", "load"]
+           "LogMelSpectrogram", "MFCC", "load", "backends", "datasets",
+           "info", "save"]

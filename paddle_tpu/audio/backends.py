@@ -1,0 +1,95 @@
+"""Audio IO backends (reference: python/paddle/audio/backends/ —
+backend.py AudioInfo + wave_backend.py load/save/info; the soundfile
+backend is used when the optional dependency exists)."""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+
+class AudioInfo:
+    """reference: backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample})")
+
+
+def info(path: str) -> AudioInfo:
+    """reference: audio.info (wave_backend.info)."""
+    try:
+        import soundfile
+        i = soundfile.info(path)
+        return AudioInfo(i.samplerate, i.frames, i.channels,
+                         16 if "16" in str(i.subtype) else 32)
+    except ImportError:
+        with wave.open(path, "rb") as w:
+            return AudioInfo(w.getframerate(), w.getnframes(),
+                             w.getnchannels(), 8 * w.getsampwidth())
+
+
+def save(path: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_S", bits_per_sample: int = 16):
+    """reference: audio.save (wave_backend.save) — 16-bit PCM wav."""
+    data = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if data.ndim == 1:
+        data = data[None, :]
+    if not channels_first:
+        data = data.T
+    ch, n = data.shape
+    if bits_per_sample != 16:
+        raise ValueError(
+            "wave backend writes 16-bit PCM; install soundfile for other "
+            "widths (reference wave_backend has the same limit)")
+    pcm = np.clip(data, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype("<i2")
+    with wave.open(path, "wb") as w:
+        w.setnchannels(ch)
+        w.setsampwidth(2)
+        w.setframerate(int(sample_rate))
+        w.writeframes(pcm.T.tobytes())
+
+
+def load(path, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """reference: backends load — see paddle_tpu.audio.load for the
+    simplified rate contract."""
+    from . import load as _load
+    data, rate = _load(path, mono=False)
+    if data.ndim == 1:
+        data = data[None, :] if channels_first else data[:, None]
+    elif channels_first:
+        data = data.T
+    if frame_offset:
+        data = data[..., frame_offset:]
+    if num_frames >= 0:
+        data = data[..., :num_frames]
+    return data, rate
+
+
+def list_available_backends():
+    try:
+        import soundfile  # noqa: F401
+        return ["soundfile", "wave"]
+    except ImportError:
+        return ["wave"]
+
+
+def get_current_backend():
+    return list_available_backends()[0]
+
+
+def set_backend(backend_name: str):
+    if backend_name not in list_available_backends():
+        raise ValueError(f"backend {backend_name!r} not available")

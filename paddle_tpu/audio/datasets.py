@@ -1,0 +1,91 @@
+"""Audio datasets (reference: python/paddle/audio/datasets/ — TESS, ESC50;
+download-based there, local-folder based here (zero-egress deployment)).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class _FolderAudioDataset(Dataset):
+    """Audio files in class-encoded filenames/folders; yields
+    (waveform_or_features, label)."""
+
+    def __init__(self, path, mode="train", feat_type="raw", split_ratio=0.8,
+                 **feat_kwargs):
+        if path is None or not os.path.isdir(path):
+            raise ValueError(
+                f"{type(self).__name__}: pass path= to a local data folder "
+                f"(auto-download is unavailable in this deployment)")
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        files = self._collect(path)
+        split = int(len(files) * split_ratio)
+        self.files = files[:split] if mode == "train" else files[split:]
+
+    def _collect(self, path):
+        raise NotImplementedError
+
+    def _features(self, wav, sr):
+        if self.feat_type == "raw":
+            return wav.astype(np.float32)
+        from . import features as F
+        layer = {"spectrogram": F.Spectrogram,
+                 "melspectrogram": F.MelSpectrogram,
+                 "logmelspectrogram": F.LogMelSpectrogram,
+                 "mfcc": F.MFCC}[self.feat_type](sr=sr, **self.feat_kwargs)
+        from ..framework.tensor import to_tensor
+        return layer(to_tensor(wav[None].astype(np.float32))).numpy()[0]
+
+    def __getitem__(self, idx):
+        from . import load
+        path, label = self.files[idx]
+        wav, sr = load(path)
+        return self._features(wav, sr), np.int64(label)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(_FolderAudioDataset):
+    """reference: audio/datasets/tess.py — Toronto emotional speech set;
+    emotion is the folder/filename suffix (7 classes)."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad"]
+
+    def _collect(self, path):
+        out = []
+        for root, _, names in sorted(os.walk(path)):
+            for n in sorted(names):
+                if not n.lower().endswith((".wav", ".flac")):
+                    continue
+                stem = os.path.splitext(n)[0].lower()
+                emo = stem.rsplit("_", 1)[-1]
+                if emo in self.EMOTIONS:
+                    out.append((os.path.join(root, n),
+                                self.EMOTIONS.index(emo)))
+        return out
+
+
+class ESC50(_FolderAudioDataset):
+    """reference: audio/datasets/esc50.py — environmental sounds; target
+    class is the last dash field of the filename (fold-target coding
+    '{fold}-{id}-{take}-{target}.wav')."""
+
+    def _collect(self, path):
+        out = []
+        for root, _, names in sorted(os.walk(path)):
+            for n in sorted(names):
+                if not n.lower().endswith(".wav"):
+                    continue
+                stem = os.path.splitext(n)[0]
+                parts = stem.split("-")
+                try:
+                    out.append((os.path.join(root, n), int(parts[-1])))
+                except ValueError:
+                    continue
+        return out

@@ -115,3 +115,74 @@ class stream_guard:
         return False
 
 from . import cuda  # noqa: E402,F401  (imported last: cuda.py re-uses Stream/Event)
+
+
+# ------------------------------------------------ compile-config predicates
+def XPUPlace(device_id: int = 0):
+    """compat shim (reference XPUPlace): maps to the accelerator place."""
+    from ..framework.device import CUDAPlace
+    return CUDAPlace(device_id)
+
+
+def IPUPlace():
+    """compat shim (reference IPUPlace): IPU is not a PJRT target here."""
+    from ..framework.device import CPUPlace
+    return CPUPlace()
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    """XLA is the compiler backend (the role CINN plays in the reference)
+    — but CINN itself is not linked."""
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str = "") -> bool:
+    """The axon TPU plugin IS a PJRT custom device."""
+    import jax as _jax
+    try:
+        return any(d.platform not in ("cpu", "gpu", "cuda")
+                   for d in _jax.devices())
+    except Exception:
+        return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def get_cudnn_version():
+    """reference: device.get_cudnn_version — None when not a CUDA build."""
+    return None
+
+
+class _PlatformNamespace:
+    """device.gpu / device.xpu / device.npu namespaces (reference exposes
+    per-vendor helper modules; each maps onto the single PJRT device
+    surface here)."""
+
+    def __init__(self, name):
+        self._name = name
+
+    def device_count(self):
+        import jax as _jax
+        try:
+            return len([d for d in _jax.devices()
+                        if d.platform != "cpu"])
+        except Exception:
+            return 0
+
+    def synchronize(self, device=None):
+        return synchronize(device)
+
+
+gpu = _PlatformNamespace("gpu")
+xpu = _PlatformNamespace("xpu")
+npu = _PlatformNamespace("npu")

@@ -11,7 +11,7 @@ from .discrete import (Bernoulli, ContinuousBernoulli, Categorical,
 from .gamma_family import (ExponentialFamily, Gamma, Chi2, Exponential,
                            Beta, Dirichlet)
 from .location_scale import Uniform, Cauchy, Gumbel, Laplace, StudentT
-from .multivariate import MultivariateNormal, Independent
+from .multivariate import MultivariateNormal, Independent, LKJCholesky
 from .transform import (Transform, Type, AbsTransform, AffineTransform,
                         ChainTransform, ExpTransform, IndependentTransform,
                         PowerTransform, ReshapeTransform, SigmoidTransform,
@@ -25,7 +25,7 @@ __all__ = [
     "ContinuousBernoulli", "Categorical", "Multinomial", "Binomial",
     "Geometric", "Poisson", "ExponentialFamily", "Gamma", "Chi2",
     "Exponential", "Beta", "Dirichlet", "Uniform", "Cauchy", "Gumbel",
-    "Laplace", "StudentT", "MultivariateNormal", "Independent",
+    "Laplace", "StudentT", "MultivariateNormal", "Independent", "LKJCholesky",
     "Transform", "Type", "AbsTransform", "AffineTransform",
     "ChainTransform", "ExpTransform", "IndependentTransform",
     "PowerTransform", "ReshapeTransform", "SigmoidTransform",

@@ -133,3 +133,77 @@ class Independent(Distribution):
         def fn(x):
             return jnp.sum(x, axis=tuple(range(-n, 0)))
         return _op("independent_entropy", fn, ent)
+
+
+class LKJCholesky(Distribution):
+    """reference: distribution/lkj_cholesky.py — LKJ distribution over
+    Cholesky factors of correlation matrices (Lewandowski et al. 2009).
+
+    sample_method='onion': each row k appends a point from a scaled Beta
+    radius on the unit sphere; the construction yields exact LKJ(eta)
+    samples without rejection."""
+
+    def __init__(self, dim=2, concentration=1.0, sample_method="onion",
+                 name=None):
+        if dim < 2:
+            raise ValueError(f"LKJCholesky needs dim >= 2, got {dim}")
+        self.dim = int(dim)
+        self.concentration = _t(concentration)
+        self.sample_method = sample_method
+
+    # marginal Beta exponents of the onion construction
+    def _beta_params(self):
+        d = self.dim
+        eta = self.concentration
+        order = jnp.arange(2, d + 1, dtype=jnp.float32)
+        alpha = eta._data + (d - order) / 2.0      # [d-1]
+        return alpha, order
+
+    def sample(self, shape=()):
+        shape = tuple(shape)
+        d = self.dim
+        alpha, order = self._beta_params()
+
+        def fn(eta, key):
+            ks = jax.random.split(key, 2)
+            # onion method: row k's squared radius y ~ Beta((k-1)/2,
+            # alpha_k) — (k-1) is the sphere dimension of the new row
+            beta_a = (order - 1.0) / 2.0
+            y = jax.random.beta(ks[0], beta_a, alpha,
+                                shape + (d - 1,))          # [..., d-1]
+            # directions: standard normals on the sphere (row k uses k dims)
+            u = jax.random.normal(ks[1], shape + (d - 1, d - 1))
+            mask = (jnp.arange(d - 1)[None, :]
+                    <= jnp.arange(d - 1)[:, None]).astype(u.dtype)
+            u = u * mask
+            norm = jnp.sqrt(jnp.sum(u * u, axis=-1, keepdims=True))
+            dirs = u / jnp.maximum(norm, 1e-12)
+            r = jnp.sqrt(y)[..., None]
+            w = r * dirs                                   # rows 1..d-1
+            L = jnp.zeros(shape + (d, d), jnp.float32)
+            L = L.at[..., 0, 0].set(1.0)
+            L = L.at[..., 1:, :d - 1].set(w)
+            diag = jnp.sqrt(jnp.clip(1.0 - y, 1e-12, 1.0))
+            L = L.at[..., jnp.arange(1, d), jnp.arange(1, d)].set(diag)
+            return L
+        return _op("lkj_sample", fn, self.concentration, _key())
+
+    def log_prob(self, value):
+        """Matches the normalized LKJ density over Cholesky factors
+        (reference lkj_cholesky.py log_prob)."""
+        d = self.dim
+        eta = self.concentration
+
+        def fn(L, eta):
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            order = jnp.arange(2, d + 1, dtype=L.dtype)
+            unnorm = jnp.sum((d - order + 2.0 * eta - 2.0)
+                             * jnp.log(diag), axis=-1)
+            # normalizer (torch lkj_cholesky formulation)
+            alpha = eta + 0.5 * (d - 1)
+            k = jnp.arange(1, d, dtype=L.dtype)
+            lnorm = (k * (math.log(math.pi) / 2)
+                     + jax.scipy.special.gammaln(alpha - 0.5 * k)
+                     - jax.scipy.special.gammaln(alpha))
+            return unnorm - jnp.sum(lnorm)
+        return _op("lkj_log_prob", fn, _t(value), self.concentration)

@@ -73,6 +73,36 @@ def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
                          norm=_norm(norm))
 
 
+@def_op("hfftn")
+def hfftn(x, s=None, axes=None, norm="backward"):
+    """reference: paddle.fft.hfftn — n-dim Hermitian FFT: inverse
+    transforms over the leading axes, hfft over the last."""
+    import numpy as _np
+    nd = x.ndim
+    ax = list(range(nd)) if axes is None else [a % nd for a in axes]
+    lead, last = ax[:-1], ax[-1]
+    y = x
+    if lead:
+        y = jnp.fft.ifftn(y, s=None if s is None else s[:-1], axes=lead,
+                          norm=_norm(norm))
+    return jnp.fft.hfft(y, n=None if s is None else s[-1], axis=last,
+                        norm=_norm(norm))
+
+
+@def_op("ihfftn")
+def ihfftn(x, s=None, axes=None, norm="backward"):
+    """reference: paddle.fft.ihfftn — inverse of hfftn."""
+    nd = x.ndim
+    ax = list(range(nd)) if axes is None else [a % nd for a in axes]
+    lead, last = ax[:-1], ax[-1]
+    y = jnp.fft.ihfft(x, n=None if s is None else s[-1], axis=last,
+                      norm=_norm(norm))
+    if lead:
+        y = jnp.fft.fftn(y, s=None if s is None else s[:-1], axes=lead,
+                         norm=_norm(norm))
+    return y
+
+
 @def_op("fftshift")
 def fftshift(x, axes=None):
     return jnp.fft.fftshift(x, axes=axes)

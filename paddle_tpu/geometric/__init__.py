@@ -160,3 +160,74 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
     counts = np.asarray(out_cnt, dtype=np.int64)
     return (wrap_array(jnp.asarray(neighbors.astype(np.int64))),
             wrap_array(jnp.asarray(counts)))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """reference: geometric/sampling/neighbors.py weighted_sample_neighbors
+    — neighbor sampling where selection probability follows edge weight
+    (weighted reservoir / choice without replacement)."""
+    rows = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    ptr = np.asarray(colptr.numpy() if isinstance(colptr, Tensor)
+                     else colptr)
+    w = np.asarray(edge_weight.numpy()
+                   if isinstance(edge_weight, Tensor) else edge_weight,
+                   np.float64)
+    nodes = np.asarray(input_nodes.numpy()
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    rng = np.random.default_rng(0)
+    out_nb, out_cnt, out_eids = [], [], []
+    for nd in nodes.tolist():
+        lo, hi = int(ptr[nd]), int(ptr[nd + 1])
+        nbrs = rows[lo:hi]
+        ww = w[lo:hi]
+        ids = np.arange(lo, hi)
+        if sample_size >= 0 and len(nbrs) > sample_size:
+            probs = ww / ww.sum() if ww.sum() > 0 else None
+            pick = rng.choice(len(nbrs), size=sample_size, replace=False,
+                              p=probs)
+            nbrs, ids = nbrs[pick], ids[pick]
+        out_nb.append(nbrs)
+        out_cnt.append(len(nbrs))
+        out_eids.append(ids)
+    neighbors = np.concatenate(out_nb) if out_nb else np.zeros(0, np.int64)
+    counts = np.asarray(out_cnt, np.int64)
+    res = (wrap_array(jnp.asarray(neighbors.astype(np.int64))),
+           wrap_array(jnp.asarray(counts)))
+    if return_eids:
+        flat_eids = np.concatenate(out_eids) if out_eids else \
+            np.zeros(0, np.int64)
+        if eids is not None:
+            e = np.asarray(eids.numpy() if isinstance(eids, Tensor)
+                           else eids)
+            flat_eids = e[flat_eids]
+        res = res + (wrap_array(jnp.asarray(flat_eids.astype(np.int64))),)
+    return res
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reference: geometric/reindex.py reindex_heter_graph — reindex over
+    per-edge-type neighbor lists sharing one seed set and ONE id space."""
+    xs = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    nbs = [np.asarray(n.numpy() if isinstance(n, Tensor) else n)
+           for n in neighbors]
+    cnts = [np.asarray(c.numpy() if isinstance(c, Tensor) else c)
+            for c in count]
+    mapping = {}
+    for v in xs.tolist():
+        mapping.setdefault(v, len(mapping))
+    for nb in nbs:
+        for v in nb.tolist():
+            mapping.setdefault(v, len(mapping))
+    reindexed = [np.array([mapping[v] for v in nb.tolist()], np.int64)
+                 for nb in nbs]
+    out_nodes = np.array(sorted(mapping, key=mapping.get), np.int64)
+    dsts = [np.repeat(np.arange(len(xs), dtype=np.int64), c)
+            for c in cnts]
+    return (wrap_array(jnp.asarray(np.concatenate(reindexed)
+                                   if reindexed else np.zeros(0, np.int64))),
+            wrap_array(jnp.asarray(np.concatenate(dsts)
+                                   if dsts else np.zeros(0, np.int64))),
+            wrap_array(jnp.asarray(out_nodes)))

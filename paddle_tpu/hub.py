@@ -1,0 +1,6 @@
+"""paddle.hub as an importable module (reference: python/paddle/hub.py
+re-exporting the hapi hub implementation: list/help/load)."""
+from .hapi.hub import *  # noqa: F401,F403
+from .hapi import hub as _impl
+
+__all__ = [n for n in dir(_impl) if not n.startswith("_")]

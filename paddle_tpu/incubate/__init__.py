@@ -2,3 +2,61 @@
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
+# graph / segment ops graduated into paddle.geometric — incubate keeps the
+# original names (reference: python/paddle/incubate/__init__.py)
+from ..geometric import (  # noqa: F401
+    segment_sum, segment_mean, segment_min, segment_max,
+    sample_neighbors as graph_sample_neighbors,
+    reindex_graph as graph_reindex,
+)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+from ..nn.functional.extra import (  # noqa: F401
+    fused_softmax_mask as softmax_mask_fuse,
+    fused_softmax_mask_upper_triangle as softmax_mask_fuse_upper_triangle,
+    identity_loss,
+)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from . import inference  # noqa: F401
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """reference: incubate.graph_khop_sampler — multi-hop neighbor
+    sampling: chained single-hop sample_neighbors, then one reindex over
+    the union.  Returns (edge_src, edge_dst, sample_index, reindex_nodes)
+    matching the reference contract (khop_sampler op)."""
+    from ..geometric import sample_neighbors, reindex_graph
+    import numpy as np
+    from ..framework.tensor import Tensor, wrap_array
+    import jax.numpy as jnp
+
+    def _np(x):
+        return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+    nodes = _np(input_nodes)
+    all_src, all_dst = [], []
+    frontier = nodes
+    for k in sample_sizes:
+        nb, cnt = sample_neighbors(row, colptr, frontier,
+                                   sample_size=int(k))
+        nb, cnt = _np(nb), _np(cnt)
+        # expand each dst seed by its neighbor count
+        all_src.append(nb)
+        all_dst.append(np.repeat(frontier, cnt))
+        frontier = np.unique(nb)
+    src = np.concatenate(all_src) if all_src else np.zeros(0, np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.zeros(0, np.int64)
+    # one shared id space: seeds first, then new nodes in appearance order
+    mapping = {}
+    for v in nodes.tolist():
+        mapping.setdefault(v, len(mapping))
+    for v in np.concatenate([src, dst]).tolist():
+        mapping.setdefault(v, len(mapping))
+    local_src = np.array([mapping[v] for v in src.tolist()], np.int64)
+    local_dst = np.array([mapping[v] for v in dst.tolist()], np.int64)
+    reindex_nodes = np.array(sorted(mapping, key=mapping.get), np.int64)
+    sample_index = reindex_nodes            # global id of each local id
+    return (wrap_array(jnp.asarray(local_src)),
+            wrap_array(jnp.asarray(local_dst)),
+            wrap_array(jnp.asarray(sample_index)),
+            wrap_array(jnp.asarray(reindex_nodes)))

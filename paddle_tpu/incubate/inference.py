@@ -1,0 +1,9 @@
+"""incubate.inference (reference: python/paddle/incubate/inference/ — the
+decorated-predictor experimental surface)."""
+
+
+def convert_to_trt(model, *args, **kwargs):
+    raise NotImplementedError(
+        "TensorRT conversion is CUDA-specific; on this stack serve the "
+        "StableHLO artifact via paddle_tpu.inference (XLA is the "
+        "optimizing runtime)")

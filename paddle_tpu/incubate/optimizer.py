@@ -1,0 +1,127 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/ —
+LookAhead (lookahead.py), ModelAverage (modelaverage.py))."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor, wrap_array
+from ..framework.tape import no_grad
+
+
+class LookAhead:
+    """reference: incubate.LookAhead — wrap an inner optimizer; every k
+    steps pull the fast weights toward slow weights:
+    slow += alpha * (fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = None
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        if self._slow is None:
+            # copies, not references: the inner optimizer's fused step
+            # donates the param buffers, deleting the originals
+            import jax.numpy as jnp
+            self._slow = [jnp.copy(p._data)
+                          for p in self.inner_optimizer._parameter_list]
+        self.inner_optimizer.step()
+        self._step_num += 1
+        params = list(self.inner_optimizer._parameter_list)
+        if self._step_num % self.k == 0:
+            import jax.numpy as jnp
+            with no_grad():
+                for i, p in enumerate(params):
+                    slow = self._slow[i] + self.alpha * (
+                        p._data.astype(self._slow[i].dtype) - self._slow[i])
+                    self._slow[i] = slow
+                    # distinct buffer: same-dtype astype aliases, and the
+                    # inner optimizer's next step donates p._data
+                    p._data = jnp.copy(slow).astype(p._data.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_num
+        return sd
+
+    def set_state_dict(self, sd):
+        self._step_num = sd.pop("lookahead_step", 0)
+        self.inner_optimizer.set_state_dict(sd)
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """reference: incubate.ModelAverage — maintain a running average of
+    parameters; apply()/restore() swap averaged weights in and out for
+    evaluation."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.params = list(parameters or [])
+        self.rate = average_window_rate
+        self.min_w = min_average_window
+        self.max_w = max_average_window
+        self._sum = [0.0 * p._data.astype("float32") for p in self.params]
+        self._num = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current weights (call after optimizer.step)."""
+        self._num += 1
+        for i, p in enumerate(self.params):
+            self._sum[i] = self._sum[i] + p._data.astype("float32")
+        if self._num > self.max_w:
+            # restart the window (reference: the window cap)
+            for i in range(len(self._sum)):
+                self._sum[i] = self._sum[i] * 0.0
+            self._num = 0
+            self.step()
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap in the averaged weights (context-style; reference apply)."""
+        if self._num == 0:
+            return self
+        self._backup = [p._data for p in self.params]
+        with no_grad():
+            for p, s in zip(self.params, self._sum):
+                p._data = (s / self._num).astype(p._data.dtype)
+        if not need_restore:
+            self._backup = None
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self.params, self._backup):
+                p._data = b
+            self._backup = None
+
+    def __enter__(self):
+        self.apply()
+        return self
+
+    def __exit__(self, *exc):
+        self.restore()
+        return False
+
+    def minimize(self, loss):
+        raise NotImplementedError(
+            "ModelAverage wraps evaluation, not training: call step() "
+            "after the inner optimizer's step, apply()/restore() around "
+            "eval (reference usage)")

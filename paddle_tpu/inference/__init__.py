@@ -261,3 +261,98 @@ class PredictorPool:
 
     def retrieve(self, idx: int) -> Predictor:
         return self._predictors[idx]
+
+
+class DataType:
+    """reference: paddle.inference.DataType enum."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    FLOAT64 = 7
+    BOOL = 8
+
+
+class PlaceType:
+    """reference: paddle.inference.PlaceType enum."""
+    kUNK = -1
+    kHOST = 0
+    kGPU = 1
+    kXPU = 2
+    kNPU = 3
+    kIPU = 4
+    kCUSTOM = 5
+
+
+# reference: paddle.inference.Tensor is the predictor IO handle type
+Tensor = InferTensor
+
+
+class XpuConfig:
+    """reference: paddle.inference.XpuConfig — accepted for config
+    portability; XPU knobs have no PJRT equivalent and are ignored."""
+
+    def __init__(self, **kwargs):
+        self.__dict__.update(kwargs)
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    """reference: inference.get_num_bytes_of_data_type."""
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2, DataType.FLOAT64: 8, DataType.BOOL: 1}
+    if dtype in sizes:
+        return sizes[dtype]
+    import numpy as _np
+    return _np.dtype(dtype).itemsize
+
+
+def get_trt_compile_version():
+    """reference: inference.get_trt_compile_version — (0,0,0) when built
+    without TensorRT (XLA is the optimizing runtime here)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """reference: inference.convert_to_mixed_precision — rewrite a saved
+    model's weights to fp16/bf16.  Operates on the jit.save artifact
+    (params pickle + StableHLO): casts floating params and re-saves; the
+    compute dtype follows the params at load."""
+    import pickle
+    import shutil
+    import numpy as np
+    from ..framework import dtype as dtypes
+    target = "bfloat16" if mixed_precision in (None, "bfloat16",
+                                               PrecisionType.Bfloat16) \
+        else "float16"
+    import ml_dtypes
+    np_target = ml_dtypes.bfloat16 if target == "bfloat16" else np.float16
+    with open(params_file, "rb") as f:
+        params = pickle.load(f)
+    black = set(black_list or [])
+    out = {}
+    for k, v in params.items():
+        arr = np.asarray(v)
+        if arr.dtype in (np.float32, np.float64) and k not in black:
+            arr = arr.astype(np_target)
+        out[k] = arr
+    with open(mixed_params_file, "wb") as f:
+        pickle.dump(out, f, protocol=4)
+    if model_file != mixed_model_file:
+        shutil.copy(model_file, mixed_model_file)
+    return mixed_model_file
+
+
+__all__ += ["DataType", "PlaceType", "Tensor", "XpuConfig",
+            "get_num_bytes_of_data_type", "get_trt_compile_version",
+            "get_trt_runtime_version", "convert_to_mixed_precision"]

@@ -574,3 +574,22 @@ def _from_numpy_batch(obj):
 
 def get_worker_info():
     return None
+
+
+class SubsetRandomSampler(Sampler):
+    """reference: io/sampler.py SubsetRandomSampler — sample the given
+    indices without replacement, in random order."""
+
+    def __init__(self, indices):
+        if len(indices) == 0:
+            raise ValueError(
+                "SubsetRandomSampler requires a non-empty index list")
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as _np
+        order = _np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in order])
+
+    def __len__(self):
+        return len(self.indices)

@@ -391,3 +391,22 @@ def load(path, **configs):
 
 
 from .train_step import TrainStep  # noqa: E402  (whole-step compilation)
+
+
+# --------------------------------------------------- debugging verbosity
+_code_level = 0
+_verbosity = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference: jit.set_code_level — dump transformed code at this
+    level.  Trace-based to_static has no bytecode rewrite stages; level>0
+    prints the traced jaxpr of each newly compiled function."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference: jit.set_verbosity — dy2static logging verbosity."""
+    global _verbosity
+    _verbosity = level

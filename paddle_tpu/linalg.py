@@ -1,0 +1,6 @@
+"""paddle.linalg as an importable module (reference:
+python/paddle/linalg.py re-exporting tensor.linalg)."""
+from .tensor.linalg import *  # noqa: F401,F403
+from .tensor import linalg as _impl
+
+__all__ = [n for n in dir(_impl) if not n.startswith("_")]

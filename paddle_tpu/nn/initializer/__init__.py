@@ -205,3 +205,30 @@ def set_global_initializer(weight_init, bias_init=None):
 
 _GLOBAL_WEIGHT_INIT = None
 _GLOBAL_BIAS_INIT = None
+
+
+class Bilinear(Initializer):
+    """reference: nn/initializer/Bilinear — transposed-conv weights that
+    perform bilinear upsampling (weight shape [C_in, C_out, k, k] or
+    [C_out, C_in, k, k]; each spatial kernel is the bilinear interpolation
+    stencil)."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        if len(shape) < 3:
+            raise ValueError(
+                f"Bilinear initializer needs a conv weight (>=3 dims), "
+                f"got shape {shape}")
+        spatial = shape[2:]
+        kernels = []
+        for k in spatial:
+            f = (k + 1) // 2
+            c = f - 1.0 if k % 2 == 1 else f - 0.5
+            kernels.append(1 - np.abs(np.arange(k) - c) / f)
+        stencil = kernels[0]
+        for kern in kernels[1:]:
+            stencil = np.multiply.outer(stencil, kern)
+        w = np.zeros(shape, np.float32)
+        w[...] = stencil            # same stencil per channel pair
+        import jax.numpy as jnp
+        return jnp.asarray(w, dtype)

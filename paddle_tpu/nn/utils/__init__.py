@@ -1,0 +1,165 @@
+"""nn.utils (reference: python/paddle/nn/utils/ — weight_norm_hook.py,
+spectral_norm_hook.py, clip_grad_norm_.py, clip_grad_value_.py,
+transform_parameters.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, Parameter, wrap_array
+from ...framework.tape import no_grad
+from ... import tensor as T
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """reference: nn.utils.weight_norm — reparameterize ``name`` as
+    g * v/||v||, recomputed before every forward via a pre-hook.
+    ``dim=None`` uses one scalar norm over the whole tensor; negative
+    dims count from the end."""
+    w = getattr(layer, name)
+    if dim is not None:
+        dim = dim % w.ndim
+    # reduction axes: everything but `dim` (all axes when dim is None)
+    axes = [i for i in range(w.ndim) if i != dim]
+    g = Parameter(jnp.sqrt(jnp.sum(w._data * w._data, axis=tuple(axes),
+                                   keepdims=True)))
+    v = Parameter(jnp.asarray(w._data))
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    # demote the original to a plain attribute recomputed per call
+    del layer._parameters[name]
+
+    def _recompute(layer_, *args):
+        # TAPE-AWARE recompute (tensor ops, not raw jnp): the forward must
+        # see a weight whose grad flows back into weight_g / weight_v
+        vv = getattr(layer_, name + "_v")
+        gg = getattr(layer_, name + "_g")
+        norm = T.sqrt(T.sum(vv * vv, axis=axes, keepdim=True))
+        setattr(layer_, name, gg * vv / (norm + 1e-12))
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_handle = (handle, name, axes)
+    _recompute(layer)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """reference: nn.utils.remove_weight_norm."""
+    handle, nm, axes = layer._weight_norm_handle
+    handle.remove()
+    v = getattr(layer, nm + "_v")
+    g = getattr(layer, nm + "_g")
+    norm = jnp.sqrt(jnp.sum(v._data * v._data, axis=tuple(axes),
+                            keepdims=True))
+    w = Parameter(g._data * v._data / (norm + 1e-12))
+    del layer._parameters[nm + "_v"]
+    del layer._parameters[nm + "_g"]
+    layer.add_parameter(nm, w)
+    del layer._weight_norm_handle
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """reference: nn.utils.spectral_norm — normalize ``name`` by its
+    largest singular value (power iteration per forward)."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    mat = jnp.moveaxis(w._data, dim, 0).reshape(w.shape[dim], -1)
+    rng = np.random.default_rng(0)
+    u = jnp.asarray(rng.normal(size=(mat.shape[0],)), mat.dtype)
+    v = jnp.asarray(rng.normal(size=(mat.shape[1],)), mat.dtype)
+    orig = Parameter(jnp.asarray(w._data))
+    layer.add_parameter(name + "_orig", orig)
+    del layer._parameters[name]
+    state = {"u": u / jnp.linalg.norm(u), "v": v / jnp.linalg.norm(v)}
+
+    def _recompute(layer_, *args):
+        w_param = getattr(layer_, name + "_orig")
+        ww = w_param._data
+        m = jnp.moveaxis(ww, dim, 0).reshape(ww.shape[dim], -1)
+        # power iteration on raw arrays — u/v carry no gradient (torch
+        # semantics: they are buffers)
+        u_, v_ = state["u"], state["v"]
+        for _ in range(n_power_iterations):
+            v_ = m.T @ u_
+            v_ = v_ / (jnp.linalg.norm(v_) + eps)
+            u_ = m @ v_
+            u_ = u_ / (jnp.linalg.norm(u_) + eps)
+        state["u"], state["v"] = u_, v_
+        # sigma through TAPE-AWARE ops so grads reach weight_orig
+        uT = wrap_array(u_)
+        vT = wrap_array(v_)
+        m_param = T.reshape(T.moveaxis(w_param, dim, 0),
+                            [ww.shape[dim], -1])
+        sigma = T.matmul(T.matmul(uT, m_param), vT)
+        setattr(layer_, name, w_param / sigma)
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._spectral_norm_handle = (handle, name)
+    _recompute(layer)
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """reference: nn.utils.clip_grad_norm_ — clip IN PLACE, return the
+    total norm."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return wrap_array(jnp.asarray(0.0))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._data))
+                                   for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"gradient norm is non-finite ({float(total)}); set "
+            f"error_if_nonfinite=False to clip anyway")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = (p.grad._data * scale).astype(p.grad._data.dtype)
+    return wrap_array(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """reference: nn.utils.clip_grad_value_."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    cv = float(clip_value)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -cv, cv)
+    return parameters
+
+
+def parameters_to_vector(parameters, name=None):
+    """reference: nn.utils.parameters_to_vector — flatten+concat."""
+    return wrap_array(jnp.concatenate(
+        [p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """reference: nn.utils.vector_to_parameters — scatter a flat vector
+    back into the parameter tensors (in place)."""
+    off = 0
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    with no_grad():
+        for p in parameters:
+            n = int(np.prod(p.shape)) if p.ndim else 1
+            p._data = data[off:off + n].reshape(p.shape).astype(
+                p._data.dtype)
+            off += n
+    return parameters

@@ -1,6 +1,6 @@
 """Optimizers + LR schedulers (reference: python/paddle/optimizer/)."""
 from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
-    RMSProp, Lamb, ASGD, Rprop, L1Decay, L2Decay,
+    RMSProp, Lamb, ASGD, Rprop, L1Decay, L2Decay, NAdam, RAdam, LBFGS,
 )
 from . import lr  # noqa: F401

@@ -323,3 +323,25 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             scale = self.exp_gamma ** self.last_epoch
         return self.base_lr + (self.max_lr - self.base_lr) * pct * scale
+
+
+class LinearLR(LRScheduler):
+    """reference: optimizer/lr.py LinearLR — linear ramp of the factor
+    from start_factor to end_factor over total_steps."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1. / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        if not 0 < start_factor <= 1:
+            raise ValueError("start_factor must be in (0, 1]")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(self.last_epoch, self.total_steps)
+        factor = self.start_factor + (
+            self.end_factor - self.start_factor) * t / self.total_steps
+        return self.base_lr * factor

@@ -26,12 +26,20 @@ from ..framework import dtype as dtypes
 from .lr import LRScheduler
 
 
-class L2Decay:
+class WeightDecayRegularizer:
+    """Base regularizer (reference: python/paddle/regularizer.py
+    WeightDecayRegularizer) — subclasses carry a decay coefficient the
+    optimizer folds into the fused update."""
+
+    coeff = 0.0
+
+
+class L2Decay(WeightDecayRegularizer):
     def __init__(self, coeff=0.0):
         self.coeff = float(coeff)
 
 
-class L1Decay:
+class L1Decay(WeightDecayRegularizer):
     def __init__(self, coeff=0.0):
         self.coeff = float(coeff)
 
@@ -533,3 +541,202 @@ class Rprop(Optimizer):
         g = jnp.where(sign < 0, 0.0, grad)
         new_p = param - (lr_t * jnp.sign(g)).astype(param.dtype)
         return new_p, {"prev_grad": g, "lr_t": lr_t}
+
+
+class NAdam(Optimizer):
+    """reference: paddle.optimizer.NAdam (Dozat 2016) — Adam with Nesterov
+    momentum via the momentum-decay schedule mu_t."""
+
+    _state_slots = ["moment1", "moment2", "mu_product"]
+    _uses_step = True
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon = epsilon
+        self._momentum_decay = momentum_decay
+
+    def _init_slot(self, slot, p):
+        if slot == "mu_product":
+            return jnp.ones((), jnp.float32)
+        return super()._init_slot(slot, p)
+
+    def _update_rule(self, param, grad, state, lr, step):
+        b1 = jnp.asarray(self._beta1, param.dtype)
+        b2 = jnp.asarray(self._beta2, param.dtype)
+        lr = lr.astype(param.dtype)
+        t = step.astype(param.dtype)
+        psi = jnp.asarray(self._momentum_decay, param.dtype)
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * psi))
+        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+        mu_prod = state["mu_product"].astype(param.dtype) * mu_t
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        mhat = (mu_t1 * m / (1 - mu_prod * mu_t1)
+                + (1 - mu_t) * grad / (1 - mu_prod))
+        vhat = v / (1 - b2 ** t)
+        new_p = param - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return new_p, {"moment1": m, "moment2": v,
+                       "mu_product": mu_prod.astype(jnp.float32)}
+
+
+class RAdam(Optimizer):
+    """reference: paddle.optimizer.RAdam (Liu et al. 2020) — rectified
+    Adam: falls back to un-adapted SGD-with-momentum while the variance
+    estimate is untrustworthy (rho_t <= 5)."""
+
+    _state_slots = ["moment1", "moment2"]
+    _uses_step = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon = epsilon
+
+    def _update_rule(self, param, grad, state, lr, step):
+        b1 = jnp.asarray(self._beta1, param.dtype)
+        b2 = jnp.asarray(self._beta2, param.dtype)
+        lr = lr.astype(param.dtype)
+        t = step.astype(param.dtype)
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        mhat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2.0 * t * b2 ** t / (1 - b2 ** t)
+        r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+        r_den = (rho_inf - 4) * (rho_inf - 2) * rho_t
+        rect = jnp.sqrt(jnp.maximum(r_num / r_den, 0.0))
+        vhat = jnp.sqrt(v / (1 - b2 ** t)) + self._epsilon
+        adapted = param - lr * rect * mhat / vhat
+        plain = param - lr * mhat
+        new_p = jnp.where(rho_t > 5.0, adapted, plain)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class LBFGS(Optimizer):
+    """reference: paddle.optimizer.LBFGS (lbfgs.py) — limited-memory BFGS
+    with optional strong-Wolfe line search.  Host-driven (the reference's
+    is too): ``step(closure)`` re-evaluates the loss/gradients, so the
+    two-loop recursion and line search run eagerly between XLA calls."""
+
+    _state_slots: List[str] = []
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s, self._y = [], []      # curvature pairs
+        self._prev_flat_grad = None
+
+    def _flat(self, arrs):
+        # f32 working precision for the curvature math (the nn.utils
+        # flatteners preserve dtype; LBFGS solves in f32)
+        return jnp.concatenate([a.reshape(-1).astype(jnp.float32)
+                                for a in arrs])
+
+    def _unflatten_to_params(self, flat, params):
+        from ..nn.utils import vector_to_parameters
+        vector_to_parameters(flat, params)
+
+    def _gather(self, params):
+        x = self._flat([p._data for p in params])
+        g = self._flat([p.grad._data if p.grad is not None
+                        else jnp.zeros(p.shape) for p in params])
+        return x, g
+
+    def _direction(self, g):
+        """Two-loop recursion over the stored (s, y) pairs."""
+        q = g
+        alphas = []
+        for s, y in reversed(list(zip(self._s, self._y))):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            gamma = jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-10)
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + (a - b) * s
+        return -q
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError(
+                "LBFGS.step requires a closure that recomputes the loss "
+                "and gradients (reference contract)")
+        params = [p for p in self._parameter_list
+                  if getattr(p, "trainable", True)]
+        loss = closure()
+        x0, g = self._gather(params)
+        if float(jnp.max(jnp.abs(g))) <= self.tol_grad:
+            return loss
+        n_evals = 1
+        for _ in range(self.max_iter):
+            d = self._direction(g)
+            lr = float(self.get_lr())
+            # strong-wolfe backtracking (sufficient decrease + curvature)
+            t = lr
+            f0 = float(loss.numpy()) if hasattr(loss, "numpy") \
+                else float(loss)
+            gtd = float(jnp.vdot(g, d))
+            if self.line_search_fn == "strong_wolfe":
+                c1, c2 = 1e-4, 0.9
+                t = lr
+                for _ls in range(10):
+                    self._unflatten_to_params(x0 + t * d, params)
+                    self.clear_grad()
+                    loss_t = closure()
+                    n_evals += 1
+                    _, g_t = self._gather(params)
+                    f_t = float(loss_t.numpy())
+                    if f_t > f0 + c1 * t * gtd:
+                        t *= 0.5
+                        continue
+                    if abs(float(jnp.vdot(g_t, d))) > c2 * abs(gtd):
+                        t *= 2.0
+                        continue
+                    break
+                loss, g_new = loss_t, g_t
+                x_new = x0 + t * d
+            else:
+                x_new = x0 + t * d
+                self._unflatten_to_params(x_new, params)
+                self.clear_grad()
+                loss = closure()
+                n_evals += 1
+                _, g_new = self._gather(params)
+            s = x_new - x0
+            ygrad = g_new - g
+            if float(jnp.vdot(s, ygrad)) > 1e-10:
+                self._s.append(s)
+                self._y.append(ygrad)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if float(jnp.max(jnp.abs(g_new))) <= self.tol_grad:
+                break
+            if float(jnp.max(jnp.abs(s))) <= self.tol_change:
+                break
+            if n_evals >= self.max_eval:
+                break
+            x0, g = x_new, g_new
+        return loss

@@ -1,6 +1,8 @@
 """paddle.regularizer parity (reference: python/paddle/regularizer.py —
 L1Decay/L2Decay applied via ParamAttr.regularizer or the optimizer's
 weight_decay)."""
-from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+from .optimizer.optimizer import (  # noqa: F401
+    L1Decay, L2Decay, WeightDecayRegularizer,
+)
 
-__all__ = ["L1Decay", "L2Decay"]
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]

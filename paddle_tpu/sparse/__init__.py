@@ -78,9 +78,13 @@ class SparseCooTensor:
         sparse_ndim = self._indices.shape[0]
 
         def fn(vals, idx):
-            out = jnp.zeros(shape, vals.dtype)
             locs = tuple(idx[i].astype(jnp.int32)
                          for i in range(sparse_ndim))
+            if vals.dtype == jnp.bool_:
+                # scatter-add has no bool variant; any-of-duplicates
+                out = jnp.zeros(shape, jnp.int32)
+                return out.at[locs].add(vals.astype(jnp.int32)) > 0
+            out = jnp.zeros(shape, vals.dtype)
             return out.at[locs].add(vals)
         return call_op("coo_to_dense", fn, (self._values, self._indices), {})
 
@@ -246,6 +250,11 @@ def to_sparse_coo(x: Tensor, sparse_dim=None) -> SparseCooTensor:
     vals = arr[nz]
     return SparseCooTensor(wrap_array(jnp.asarray(idx)),
                            wrap_array(jnp.asarray(vals)), list(arr.shape))
+
+
+def to_sparse_csr(x: Tensor) -> SparseCsrTensor:
+    """Dense (2-D) -> CSR via the COO bridge."""
+    return to_sparse_coo(x, 2).to_sparse_csr()
 
 
 # ------------------------------------------------------------- unary ops
@@ -444,3 +453,77 @@ __all__ = [
     "mv", "softmax", "sum", "transpose", "is_same_shape", "nn",
     "deg2rad", "rad2deg",
 ]
+
+
+tan = _unary("tan", jnp.tan)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def coalesce(x, name=None):
+    """reference: paddle.sparse.coalesce — functional form of
+    SparseCooTensor.coalesce."""
+    return x.coalesce()
+
+
+def reshape(x, shape, name=None):
+    """reference: paddle.sparse.reshape — reshape via the dense bridge
+    (index remapping keeps nnz static)."""
+    from ..tensor.manipulation import reshape as dense_reshape
+    dense = x.to_dense()
+    out = dense_reshape(dense, shape)
+    if isinstance(x, SparseCsrTensor):
+        return to_sparse_csr(out) if out.ndim == 2 else \
+            to_sparse_coo(out, out.ndim)
+    return to_sparse_coo(out, out.ndim)
+
+
+def slice(x, axes, starts, ends, name=None):   # noqa: A001
+    """reference: paddle.sparse.slice — dense-bridge slice."""
+    import builtins
+    dense = x.to_dense()
+    idx = [builtins.slice(None)] * dense.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(st, en)
+    out = dense[tuple(idx)]
+    if isinstance(x, SparseCsrTensor) and out.ndim == 2:
+        return to_sparse_csr(out)
+    return to_sparse_coo(out, out.ndim)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """reference: paddle.sparse.addmm — beta*input + alpha*(x @ y);
+    x sparse, y dense."""
+    return beta * (input.to_dense() if hasattr(input, "to_dense")
+                   else input) + alpha * matmul(x, y)
+
+
+def mask_as(x, mask, name=None):
+    """reference: paddle.sparse.mask_as — take dense x's values at the
+    sparsity pattern of mask."""
+    dense = x if isinstance(x, Tensor) else x.to_dense()
+    if isinstance(mask, SparseCooTensor):
+        idx = mask.indices()
+        vals = dense._data[tuple(idx._data[i] for i in range(idx.shape[0]))]
+        return SparseCooTensor(idx, wrap_array(vals), dense.shape)
+    if isinstance(mask, SparseCsrTensor):
+        coo = mask.to_sparse_coo(len(mask.shape))
+        idx = coo.indices()
+        vals = dense._data[tuple(idx._data[i] for i in range(idx.shape[0]))]
+        return SparseCooTensor(idx, wrap_array(vals),
+                               dense.shape).to_sparse_csr()
+    raise TypeError("mask_as: mask must be a sparse tensor")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference: paddle.sparse.pca_lowrank / paddle.linalg.pca_lowrank —
+    randomized PCA via svd_lowrank on the (centered) matrix."""
+    from ..tensor.linalg import svd_lowrank
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    m, n = dense.shape[-2], dense.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        from ..tensor.math import mean
+        dense = dense - mean(dense, axis=-2, keepdim=True)
+    u, s, v = svd_lowrank(dense, q=q, niter=niter)
+    return u, s, v

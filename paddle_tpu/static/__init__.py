@@ -43,3 +43,324 @@ def default_startup_program():
 
 def data(name, shape, dtype="float32", lod_level=0):
     return InputSpec(shape, dtype, name)
+
+
+# ---------------------------------------------------------------------------
+# The rest of the reference static namespace (python/paddle/static/
+# __init__.py).  Functional names map to their eager/jit equivalents;
+# Program-machinery names exist with clear errors (deliberate shim —
+# SURVEY §7: XLA replaces the Program+Executor stack).
+# ---------------------------------------------------------------------------
+from ..tensor.extra_ops import accuracy  # noqa: E402,F401
+from ..framework.device import CPUPlace, CUDAPlace  # noqa: E402
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference: static.create_parameter (same as the top-level API;
+    lazy import — static loads before the top-level name exists)."""
+    import paddle_tpu
+    return paddle_tpu.create_parameter(shape, dtype, name, attr, is_bias,
+                                       default_initializer)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """reference: static.auc — the metric.Auc computation, functional."""
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(input, label)
+    import numpy as np
+    from ..framework.tensor import to_tensor
+    return to_tensor(np.asarray(m.accumulate(), np.float32))
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """reference: static.ctr_metric_bundle — (auc, squared error, ...)."""
+    import numpy as np
+    from ..framework.tensor import to_tensor
+    a = auc(input, label)
+    p = input.numpy().reshape(-1)
+    l = label.numpy().reshape(-1)
+    sqerr = to_tensor(np.asarray(((p - l) ** 2).sum(), np.float32))
+    abserr = to_tensor(np.asarray(np.abs(p - l).sum(), np.float32))
+    return a, sqerr, abserr
+
+
+def cpu_places(device_count=None):
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    import jax as _jax
+    try:
+        n = len([d for d in _jax.devices() if d.platform != "cpu"])
+    except Exception:
+        n = 0
+    ids = device_ids if device_ids is not None else range(max(n, 1))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference: static.create_global_var — a full Tensor (globals are
+    plain tensors in eager)."""
+    from .. import full
+    v = full(shape, value, dtype)
+    v.stop_gradient = True
+    return v
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference: static.device_guard — scoped placement."""
+    from ..framework.device import set_device, get_device
+    prev = get_device()
+    if device is not None:
+        set_device(device.split(":")[0])
+    try:
+        yield
+    finally:
+        set_device(prev)
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+def global_scope():
+    """reference: static.global_scope — a dict-backed scope facade."""
+    return _GLOBAL_SCOPE
+
+
+class _Scope(dict):
+    def find_var(self, name):
+        return self.get(name)
+
+    def var(self, name):
+        return self.setdefault(name, None)
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: static.gradients — eager autograd equivalent."""
+    from ..autograd import grad
+    return grad(targets, inputs, grad_outputs=target_gradients)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """reference: static.append_backward — eager equivalent: backward()."""
+    loss.backward()
+    params = parameter_list or []
+    return [(p, p.grad) for p in params]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: static.py_func — host callback; eager equivalent is a
+    direct call (jit paths use jax.pure_callback via cpp_extension)."""
+    res = func(*x) if isinstance(x, (list, tuple)) else func(x)
+    return res
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """reference: static.save_inference_model — maps to jit.save of the
+    traced function."""
+    raise NotImplementedError(
+        "save_inference_model needs a traced callable on this stack: use "
+        "paddle_tpu.jit.save(layer_or_function, path_prefix) — the "
+        "StableHLO artifact is the inference model format here")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.load(path_prefix) / paddle_tpu.inference."
+        "Config+Predictor — StableHLO is the inference model format here")
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None):
+    raise NotImplementedError(
+        "no Program IR on this stack; jit.save writes StableHLO")
+
+
+def deserialize_program(data):
+    raise NotImplementedError(
+        "no Program IR on this stack; jit.load reads StableHLO")
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None):
+    raise NotImplementedError(
+        "persistables are the Layer state_dict here: paddle_tpu.save")
+
+
+def deserialize_persistables(program=None, data=None, executor=None):
+    raise NotImplementedError(
+        "persistables are the Layer state_dict here: paddle_tpu.load")
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content if isinstance(content, bytes) else content.encode())
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def set_program_state(program, state):
+    raise NotImplementedError(
+        "no Program on this stack; Layer.set_state_dict is the equivalent")
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io import load
+    return load(model_path)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    raise NotImplementedError("no Program IR on this stack")
+
+
+class Variable:
+    """reference: static.Variable — eager Tensors play this role."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "static Variable is replaced by the eager Tensor")
+
+
+class Executor:
+    """reference: static.Executor — XLA executes compiled programs."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        raise NotImplementedError(
+            "Executor.run has no Program to run: call the jitted function "
+            "(jit.to_static) directly — XLA is the executor (SURVEY §7)")
+
+
+class CompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "CompiledProgram is replaced by jit.to_static/XLA compilation")
+
+
+class BuildStrategy:
+    """reference: static.BuildStrategy — accepted for config portability;
+    XLA owns fusion/scheduling decisions on this stack."""
+
+    def __init__(self):
+        self.__dict__["_opts"] = {}
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        if k.startswith("_"):
+            raise AttributeError(k)
+        return self._opts.get(k)
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU is not a PJRT target on this stack")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU is not a PJRT target on this stack")
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("IPU is not a PJRT target on this stack")
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("IPU is not a PJRT target on this stack")
+
+
+class ExponentialMovingAverage:
+    """reference: static.ExponentialMovingAverage — EMA of parameters
+    with apply/restore, eager-state implementation (the incubate
+    ModelAverage pattern with exponential decay)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = decay
+        self._ema = {}
+        self._backup = None
+        self._params = None
+        self._step = 0
+
+    def update(self, parameters=None):
+        from ..framework.tape import no_grad
+        if parameters is not None:
+            self._params = list(parameters)
+        if self._params is None:
+            raise ValueError(
+                "ExponentialMovingAverage.update needs parameters= on "
+                "first call (eager mode has no global Program to scan)")
+        self._step += 1
+        d = min(self.decay, (1 + self._step) / (10 + self._step))
+        with no_grad():
+            for p in self._params:
+                prev = self._ema.get(id(p))
+                cur = p._data.astype("float32")
+                self._ema[id(p)] = cur if prev is None else \
+                    d * prev + (1 - d) * cur
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = [(p, p._data) for p in self._params or []]
+        for p in self._params or []:
+            if id(p) in self._ema:
+                p._data = self._ema[id(p)].astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p, data in self._backup or []:
+            p._data = data
+        self._backup = None
+
+
+class WeightNormParamAttr:
+    """reference: static.WeightNormParamAttr — weight-norm reparam config;
+    the eager path is nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, **kwargs):
+        self.dim = dim
+        self.name = name
+        self.kwargs = kwargs
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference: static.Print — host-side debug print of a tensor."""
+    msg = message or ""
+    print(f"{msg} shape={list(input.shape)} dtype={input.dtype} "
+          f"value={input.numpy().reshape(-1)[:summarize]}")
+    return input
+
+
+class InputSpec(InputSpec):   # noqa: F811  (re-exported name, same class)
+    pass

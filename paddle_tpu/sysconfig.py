@@ -1,0 +1,18 @@
+"""Build configuration introspection (reference:
+python/paddle/sysconfig.py — get_include/get_lib)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """Directory of C headers (the C-ABI custom-op descriptor; reference:
+    paddle include dir)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "utils", "cpp_extension", "include")
+
+
+def get_lib() -> str:
+    """Directory of built native libraries (TCPStore, host tracer)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native")

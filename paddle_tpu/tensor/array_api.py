@@ -183,15 +183,12 @@ def take(x, index, mode="raise", name=None):
     return flat[index]
 
 
-@def_op("matrix_transpose")
-def matrix_transpose(x, name=None):
-    return jnp.swapaxes(x, -1, -2)
+# matrix_transpose/vecdot: single registrations live in tensor/linalg.py
+from .linalg import matrix_transpose, vecdot  # noqa: E402
 
 
-@def_op("vecdot")
-def vecdot(x, y, axis=-1, name=None):
-    # reference (linalg.py): conj(x) . y — the complex inner product
-    return jnp.sum(jnp.conj(x) * y, axis=axis)
+# vecdot: single registration lives in tensor/linalg.py (imported with
+# matrix_transpose below)
 
 
 @def_op("unflatten")

@@ -290,3 +290,51 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
 @def_op("matrix_transpose")
 def matrix_transpose(x):
     return jnp.swapaxes(x, -1, -2)
+
+
+@def_op("cholesky_inverse")
+def cholesky_inverse(x, upper=False, name=None):
+    """reference: linalg.cholesky_inverse — inverse of A from its Cholesky
+    factor: (LL^T)^-1 via two triangular solves."""
+    import jax.scipy.linalg as jsl
+    eye = jnp.eye(x.shape[-1], dtype=x.dtype)
+    if upper:
+        # A = U^T U
+        z = jsl.solve_triangular(x, eye, lower=False)
+        return z @ z.T
+    z = jsl.solve_triangular(x, eye, lower=True)
+    return z.T @ z
+
+
+# linalg re-exports (reference linalg namespace carries these names)
+from .math import cross  # noqa: E402,F401
+
+
+@def_op("vecdot")
+def vecdot(x, y, axis=-1, name=None):
+    """reference (linalg.py): conj(x) . y — the complex inner product."""
+    return jnp.sum(jnp.conj(x) * y, axis=axis)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, output_dtype="float16",
+                            scale=1.0, activation_type="identity"):
+    """reference: linalg.fp8_fp8_half_gemm_fused (cuBLASLt fp8 kernel).
+    TPU-native: fp8 operands upcast into the MXU's native bf16 matmul —
+    XLA fuses the casts; dedicated fp8 MXU paths arrive with hardware
+    support."""
+    a = x.astype("bfloat16")
+    b = y.astype("bfloat16")
+    if transpose_x:
+        a = a.transpose([*range(a.ndim - 2), a.ndim - 1, a.ndim - 2])
+    if transpose_y:
+        b = b.transpose([*range(b.ndim - 2), b.ndim - 1, b.ndim - 2])
+    out = (a @ b).astype(output_dtype)
+    if scale != 1.0:
+        out = out * scale
+    if bias is not None:
+        out = out + bias.astype(output_dtype)
+    if activation_type in ("gelu", "relu"):
+        from ..nn import functional as F
+        out = getattr(F, activation_type)(out)
+    return out

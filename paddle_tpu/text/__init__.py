@@ -5,5 +5,9 @@ functional form), datasets (download-based; pass local files here).
 """
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
 from . import datasets  # noqa: F401
+from .datasets import (  # noqa: F401
+    UCIHousing, Imdb, Imikolov, Conll05st, Movielens, WMT14, WMT16,
+)
 
-__all__ = ["ViterbiDecoder", "viterbi_decode", "datasets"]
+__all__ = ["ViterbiDecoder", "viterbi_decode", "datasets", "UCIHousing",
+           "Imdb", "Imikolov", "Conll05st", "Movielens", "WMT14", "WMT16"]

@@ -293,3 +293,120 @@ class Movielens(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class _WMTBase(Dataset):
+    """Shared machinery for the WMT translation datasets (reference:
+    text/datasets/wmt14.py, wmt16.py): parallel corpora in a tar file,
+    word dicts with <s>/<e>/<unk> specials, samples as
+    (src_ids, trg_ids, trg_ids_next)."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def _build_dict(self, sentences, dict_size):
+        from collections import Counter
+        counts = Counter(w for s in sentences for w in s)
+        words = [w for w, _ in counts.most_common()]
+        if dict_size > 0:
+            words = words[:max(0, dict_size - 3)]
+        d = {"<s>": self.BOS, "<e>": self.EOS, "<unk>": self.UNK}
+        for i, w in enumerate(words):
+            d[w] = i + 3
+        return d
+
+    def _encode(self, words, dct):
+        return [dct.get(w, self.UNK) for w in words]
+
+    def _read_lines(self, path, mode):
+        import tarfile
+        import os
+        lines = []
+        if os.path.isdir(path):
+            names = [os.path.join(path, n) for n in sorted(os.listdir(path))
+                     if mode is None or mode in n]
+            for n in names:
+                lines += open(n, encoding="utf8").read().splitlines()
+        elif tarfile.is_tarfile(path):
+            with tarfile.open(path) as tf:
+                for m in tf.getmembers():
+                    if m.isfile() and (mode is None or mode in
+                                       os.path.basename(m.name)):
+                        lines += tf.extractfile(m).read().decode(
+                            "utf8").splitlines()
+        else:
+            lines = open(path, encoding="utf8").read().splitlines()
+        return lines
+
+    @staticmethod
+    def _to_pairs(lines):
+        pairs = []
+        for ln in lines:
+            parts = ln.split("\t")
+            if len(parts) >= 2:
+                pairs.append((parts[0].split(), parts[1].split()))
+        return pairs
+
+    def _load_pairs(self, path, mode, dict_size):
+        """Samples come from the `mode` split; the word dicts are built
+        from the WHOLE corpus so train/test share one id space
+        (reference: the datasets ship corpus-level dict files)."""
+        all_pairs = self._to_pairs(self._read_lines(path, None))
+        pairs = self._to_pairs(self._read_lines(path, mode))
+        if not pairs:
+            raise ValueError(f"no '{mode}' parallel lines found in {path}")
+        self.src_dict = self._build_dict([p[0] for p in all_pairs],
+                                         dict_size)
+        self.trg_dict = self._build_dict([p[1] for p in all_pairs],
+                                         dict_size)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for src, trg in pairs:
+            s = self._encode(src, self.src_dict)
+            t = [self.BOS] + self._encode(trg, self.trg_dict)
+            self.src_ids.append(np.array(s, np.int64))
+            self.trg_ids.append(np.array(t, np.int64))
+            self.trg_ids_next.append(
+                np.array(t[1:] + [self.EOS], np.int64))
+
+    def get_dict(self, lang="en", reverse=False):
+        """reference: WMT14.get_dict — the word dict (id->word when
+        reverse)."""
+        d = self.src_dict if lang in ("en", "src") else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d
+
+    def __getitem__(self, idx):
+        return (self.src_ids[idx], self.trg_ids[idx],
+                self.trg_ids_next[idx])
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT14(_WMTBase):
+    """reference: text/datasets/wmt14.py — EN-FR parallel set.  Pass
+    data_file (tar/dir/txt of tab-separated parallel lines); the
+    reference's bcebos tarball also works when downloadable."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        assert mode in ("train", "test", "gen")
+        if data_file is None:
+            raise ValueError(
+                "WMT14: pass data_file= (zero-egress deployment: the "
+                "reference's auto-download of wmt14.tgz is unavailable)")
+        self._load_pairs(data_file, mode, dict_size)
+
+
+class WMT16(_WMTBase):
+    """reference: text/datasets/wmt16.py — EN-DE parallel set with
+    src/trg language selection."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        assert mode in ("train", "test", "val")
+        if data_file is None:
+            raise ValueError(
+                "WMT16: pass data_file= (zero-egress deployment: the "
+                "reference's auto-download is unavailable)")
+        self.lang = lang
+        self._load_pairs(data_file, mode,
+                         max(src_dict_size, trg_dict_size))

@@ -1,6 +1,8 @@
 from .resnet import (  # noqa: F401
     ResNet, BasicBlock, BottleneckBlock, resnet18, resnet34, resnet50,
-    resnet101, resnet152, wide_resnet50_2,
+    resnet101, resnet152, wide_resnet50_2, wide_resnet101_2,
+    resnext50_32x4d, resnext50_64x4d, resnext101_32x4d, resnext101_64x4d,
+    resnext152_32x4d, resnext152_64x4d,
 )
 from .small_nets import (  # noqa: F401
     LeNet, AlexNet, VGG, SqueezeNet, alexnet, vgg11, vgg13, vgg16, vgg19,
@@ -9,7 +11,10 @@ from .small_nets import (  # noqa: F401
 from .mobilenets import (  # noqa: F401
     MobileNetV1, MobileNetV2, MobileNetV3Small, MobileNetV3Large,
     ShuffleNetV2, DenseNet, mobilenet_v1, mobilenet_v2, mobilenet_v3_small,
-    mobilenet_v3_large, shufflenet_v2_x1_0, densenet121,
+    mobilenet_v3_large, shufflenet_v2_x1_0, densenet121, densenet161,
+    densenet169, densenet201, densenet264, shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33, shufflenet_v2_x0_5, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0, shufflenet_v2_swish,
 )
 from .inception import (  # noqa: F401
     GoogLeNet, InceptionV3, googlenet, inception_v3,

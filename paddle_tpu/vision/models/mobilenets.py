@@ -237,26 +237,26 @@ def _channel_shuffle(x, groups):
 
 
 class _ShuffleUnit(Layer):
-    def __init__(self, in_ch, out_ch, stride):
+    def __init__(self, in_ch, out_ch, stride, act=ReLU):
         super().__init__()
         self.stride = stride
         branch = out_ch // 2
         if stride == 1:
             self.branch2 = Sequential(
-                _conv_bn(in_ch // 2, branch, 1),
+                _conv_bn(in_ch // 2, branch, 1, act=act),
                 _conv_bn(branch, branch, 3, stride=1, groups=branch,
                          act=None),
-                _conv_bn(branch, branch, 1))
+                _conv_bn(branch, branch, 1, act=act))
         else:
             self.branch1 = Sequential(
                 _conv_bn(in_ch, in_ch, 3, stride=stride, groups=in_ch,
                          act=None),
-                _conv_bn(in_ch, branch, 1))
+                _conv_bn(in_ch, branch, 1, act=act))
             self.branch2 = Sequential(
-                _conv_bn(in_ch, branch, 1),
+                _conv_bn(in_ch, branch, 1, act=act),
                 _conv_bn(branch, branch, 3, stride=stride, groups=branch,
                          act=None),
-                _conv_bn(branch, branch, 1))
+                _conv_bn(branch, branch, 1, act=act))
 
     def forward(self, x):
         if self.stride == 1:
@@ -271,27 +271,32 @@ class _ShuffleUnit(Layer):
 class ShuffleNetV2(Layer):
     """reference: shufflenetv2.py."""
 
-    _WIDTH = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+    _WIDTH = {0.25: [24, 48, 96, 512], 0.33: [32, 64, 128, 512],
+              0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
               1.5: [176, 352, 704, 1024], 2.0: [244, 488, 976, 2048]}
 
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
         super().__init__()
+        from ...nn import Swish
+        act_layer = Swish if act == "swish" else ReLU
         self.num_classes = num_classes
         self.with_pool = with_pool
         widths = self._WIDTH[scale]
-        self.conv1 = _conv_bn(3, 24, 3, stride=2)
+        self.conv1 = _conv_bn(3, 24, 3, stride=2, act=act_layer)
         self.maxpool = MaxPool2D(3, 2, padding=1)
         in_ch = 24
         stages = []
         for i, repeats in enumerate([4, 8, 4]):
             out_ch = widths[i]
-            units = [_ShuffleUnit(in_ch, out_ch, 2)]
+            units = [_ShuffleUnit(in_ch, out_ch, 2, act=act_layer)]
             for _ in range(repeats - 1):
-                units.append(_ShuffleUnit(out_ch, out_ch, 1))
+                units.append(_ShuffleUnit(out_ch, out_ch, 1,
+                                          act=act_layer))
             stages.append(Sequential(*units))
             in_ch = out_ch
         self.stages = Sequential(*stages)
-        self.conv_last = _conv_bn(in_ch, widths[3], 1)
+        self.conv_last = _conv_bn(in_ch, widths[3], 1, act=act_layer)
         if with_pool:
             self.pool = AdaptiveAvgPool2D(1)
         if num_classes > 0:
@@ -322,8 +327,9 @@ class _DenseLayer(Layer):
 class DenseNet(Layer):
     """reference: densenet.py (121/169/201/264 via block_config)."""
 
-    _CONFIGS = {121: (6, 12, 24, 16), 169: (6, 12, 32, 32),
-                201: (6, 12, 48, 32), 264: (6, 12, 64, 48)}
+    _CONFIGS = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+                169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+                264: (6, 12, 64, 48)}
 
     def __init__(self, layers=121, growth_rate=32, bn_size=4,
                  num_classes=1000, with_pool=True):
@@ -382,3 +388,44 @@ def shufflenet_v2_x1_0(pretrained=False, **kwargs):
 
 def densenet121(pretrained=False, **kwargs):
     return DenseNet(layers=121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    # reference densenet161: growth 48, 96-ch stem
+    return DenseNet(layers=161, growth_rate=48, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(layers=169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(layers=201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(layers=264, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

@@ -150,3 +150,38 @@ def resnet152(pretrained=False, **kwargs):
 def wide_resnet50_2(pretrained=False, **kwargs):
     kwargs["width"] = 128
     return _resnet(BottleneckBlock, 50, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    kwargs["width"] = 128
+    return _resnet(BottleneckBlock, 101, **kwargs)
+
+
+def _resnext(depth, groups, width, **kwargs):
+    kwargs["groups"] = groups
+    kwargs["width"] = width
+    return _resnet(BottleneckBlock, depth, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnext(50, 32, 4, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext(50, 64, 4, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnext(101, 32, 4, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnext(101, 64, 4, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext(152, 32, 4, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext(152, 64, 4, **kwargs)

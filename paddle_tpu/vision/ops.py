@@ -764,3 +764,178 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return wrap_array(jnp.asarray(arr))
+
+
+@def_op("yolo_loss")
+def _yolo_loss(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+               class_num, ignore_thresh, downsample_ratio,
+               use_label_smooth, scale_x_y):
+    """YOLOv3 loss (reference: vision/ops.py yolo_loss, phi yolo_loss
+    kernel).  x: [N, mask*(5+C), H, W] raw head output; gt boxes are
+    (cx, cy, w, h) normalized to [0, 1].
+
+    Dense TPU formulation: the per-gt anchor assignment loop (B static)
+    scatters objectness/box/class targets into the [N, M, H, W] grids,
+    then every term is one fused elementwise reduction — no dynamic
+    shapes.
+    """
+    N, _, H, W = x.shape
+    M = len(anchor_mask)
+    C = class_num
+    B = gt_box.shape[1]
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)      # [A, 2]
+    mask_idx = jnp.asarray(anchor_mask, jnp.int32)
+    an_mask = an_all[mask_idx]                                      # [M, 2]
+    in_w = W * downsample_ratio
+    in_h = H * downsample_ratio
+
+    x = x.reshape(N, M, 5 + C, H, W)
+    px, py = x[:, :, 0], x[:, :, 1]            # raw tx, ty
+    pw, ph = x[:, :, 2], x[:, :, 3]            # raw tw, th
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]                         # [N, M, C, H, W]
+
+    # predicted boxes (normalized) for the ignore-mask IoU test
+    gx = (jnp.arange(W) + 0.5) / W
+    gy = (jnp.arange(H) + 0.5) / H
+    bx = (jax.nn.sigmoid(px) + jnp.arange(W)[None, None, None, :]) / W
+    by = (jax.nn.sigmoid(py) + jnp.arange(H)[None, None, :, None]) / H
+    bw = jnp.exp(pw) * an_mask[None, :, 0, None, None] / in_w
+    bh = jnp.exp(ph) * an_mask[None, :, 1, None, None] / in_h
+
+    # iou of every predicted box with every gt (per image)
+    def box_iou(bx, by, bw, bh, g):            # g: [4]
+        x1 = jnp.maximum(bx - bw / 2, g[0] - g[2] / 2)
+        y1 = jnp.maximum(by - bh / 2, g[1] - g[3] / 2)
+        x2 = jnp.minimum(bx + bw / 2, g[0] + g[2] / 2)
+        y2 = jnp.minimum(by + bh / 2, g[1] + g[3] / 2)
+        inter = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+        union = bw * bh + g[2] * g[3] - inter
+        return inter / jnp.maximum(union, 1e-10)
+
+    best_iou = jnp.zeros((N, M, H, W), jnp.float32)
+    tobj = jnp.zeros((N, M, H, W), jnp.float32)
+    tscore = jnp.zeros((N, M, H, W), jnp.float32)
+    txy = jnp.zeros((N, M, 2, H, W), jnp.float32)
+    twh = jnp.zeros((N, M, 2, H, W), jnp.float32)
+    tcls = jnp.zeros((N, M, C, H, W), jnp.float32)
+    wxy = jnp.zeros((N, M, H, W), jnp.float32)   # box-size loss weight
+
+    n_idx = jnp.arange(N)
+    for b in range(B):
+        g = gt_box[:, b]                        # [N, 4]
+        lab = gt_label[:, b].astype(jnp.int32)  # [N]
+        sc = gt_score[:, b]
+        valid = (g[:, 2] > 0) & (g[:, 3] > 0)
+        # ignore mask: any pred overlapping a gt above thresh
+        iou_b = jax.vmap(lambda bx_, by_, bw_, bh_, g_: box_iou(
+            bx_, by_, bw_, bh_, g_))(bx, by, bw, bh, g)
+        best_iou = jnp.maximum(best_iou,
+                               jnp.where(valid[:, None, None, None],
+                                         iou_b, 0.0))
+        # best anchor over the FULL anchor set by wh-IoU
+        gw, gh = g[:, 2] * in_w, g[:, 3] * in_h
+        inter = jnp.minimum(gw[:, None], an_all[None, :, 0]) * \
+            jnp.minimum(gh[:, None], an_all[None, :, 1])
+        union = gw[:, None] * gh[:, None] + \
+            an_all[None, :, 0] * an_all[None, :, 1] - inter
+        best_a = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=1)
+        # position in THIS head's mask (or -1)
+        in_mask = (mask_idx[None, :] == best_a[:, None])
+        m_pos = jnp.where(in_mask.any(1), jnp.argmax(in_mask, 1), -1)
+        gi = jnp.clip((g[:, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((g[:, 1] * H).astype(jnp.int32), 0, H - 1)
+        assign = valid & (m_pos >= 0)
+        mp = jnp.maximum(m_pos, 0)
+        w_b = jnp.where(assign, 2.0 - g[:, 2] * g[:, 3], 0.0)
+        tobj = tobj.at[n_idx, mp, gj, gi].max(
+            jnp.where(assign, 1.0, 0.0))
+        tscore = tscore.at[n_idx, mp, gj, gi].max(
+            jnp.where(assign, sc, 0.0))
+        wxy = wxy.at[n_idx, mp, gj, gi].max(w_b)
+        txy = txy.at[n_idx, mp, 0, gj, gi].set(
+            jnp.where(assign, g[:, 0] * W - gi,
+                      txy[n_idx, mp, 0, gj, gi]))
+        txy = txy.at[n_idx, mp, 1, gj, gi].set(
+            jnp.where(assign, g[:, 1] * H - gj,
+                      txy[n_idx, mp, 1, gj, gi]))
+        twh = twh.at[n_idx, mp, 0, gj, gi].set(
+            jnp.where(assign, jnp.log(jnp.maximum(
+                gw / an_all[best_a, 0], 1e-9)),
+                twh[n_idx, mp, 0, gj, gi]))
+        twh = twh.at[n_idx, mp, 1, gj, gi].set(
+            jnp.where(assign, jnp.log(jnp.maximum(
+                gh / an_all[best_a, 1], 1e-9)),
+                twh[n_idx, mp, 1, gj, gi]))
+        smooth = 1.0 / max(C, 1) if use_label_smooth else 0.0
+        onehot = jax.nn.one_hot(lab, C) * (1 - smooth) + smooth / max(C, 1)
+        cur = tcls[n_idx, mp, :, gj, gi]
+        tcls = tcls.at[n_idx, mp, :, gj, gi].set(
+            jnp.where(assign[:, None], onehot, cur))
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    obj_mask = tobj
+    noobj_mask = (1.0 - tobj) * (best_iou < ignore_thresh)
+    loss_xy = wxy[:, :, None] * obj_mask[:, :, None] * bce(
+        jnp.stack([px, py], 2), txy)
+    loss_wh = 0.5 * wxy[:, :, None] * obj_mask[:, :, None] * \
+        (jnp.stack([pw, ph], 2) - twh) ** 2
+    loss_obj = tscore * bce(pobj, jnp.ones_like(pobj)) + \
+        noobj_mask * bce(pobj, jnp.zeros_like(pobj))
+    loss_cls = obj_mask[:, :, None] * bce(pcls, tcls)
+    per_img = (loss_xy.sum((1, 2, 3, 4)) + loss_wh.sum((1, 2, 3, 4))
+               + loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+    return per_img
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference: vision/ops.py yolo_loss — per-image YOLOv3 loss [N]."""
+    if gt_score is None:
+        from .. import tensor as T
+        gt_score = T.ones_like(gt_label).astype("float32")
+    return _yolo_loss(x, gt_box, gt_label, gt_score, tuple(anchors),
+                      tuple(anchor_mask), int(class_num),
+                      float(ignore_thresh), int(downsample_ratio),
+                      bool(use_label_smooth), float(scale_x_y))
+
+
+class RoIPool(Layer):
+    """reference: vision/ops.py RoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, *self.args)
+
+
+class RoIAlign(Layer):
+    """reference: vision/ops.py RoIAlign."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, *self.args, aligned=aligned)
+
+
+class PSRoIPool(Layer):
+    """reference: vision/ops.py PSRoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, *self.args)
+
+
+# reference: generate_proposals_v2 is the op name behind generate_proposals
+generate_proposals_v2 = generate_proposals

@@ -142,3 +142,456 @@ def normalize(img, mean, std, data_format="CHW"):
 
 def resize(img, size):
     return Resize(size)(img)
+
+
+# --------------------------------------------------------- functional suite
+# (reference: python/paddle/vision/transforms/functional.py — numpy HWC
+# host-side preprocessing; the reference's PIL/cv2 backends collapse to one
+# numpy implementation)
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return np.asarray(img)[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    return crop(arr, max((h - th) // 2, 0), max((w - tw) // 2, 0), th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    width = [(pt, pb), (pl, pr)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, width, constant_values=fill)
+    mode = {"reflect": "reflect", "edge": "edge",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(arr, width, mode=mode)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = np.asarray(img)
+    out = arr.astype(np.float32) * brightness_factor
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def _grayscale_f(arr):
+    a = arr.astype(np.float32)
+    if a.ndim == 2 or a.shape[-1] == 1:
+        return a.reshape(a.shape[:2])
+    return 0.299 * a[..., 0] + 0.587 * a[..., 1] + 0.114 * a[..., 2]
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = np.asarray(img)
+    mean = _grayscale_f(arr).mean()
+    out = (arr.astype(np.float32) - mean) * contrast_factor + mean
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = np.asarray(img)
+    gray = _grayscale_f(arr)[..., None]
+    out = arr.astype(np.float32) * saturation_factor + \
+        gray * (1 - saturation_factor)
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) via HSV."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor must be in [-0.5, 0.5], "
+                         f"got {hue_factor}")
+    arr = np.asarray(img)
+    a = arr.astype(np.float32) / (255.0 if arr.dtype == np.uint8 else 1.0)
+    r, g, b = a[..., 0], a[..., 1], a[..., 2]
+    maxc = np.max(a[..., :3], -1)
+    minc = np.min(a[..., :3], -1)
+    v = maxc
+    rng_ = maxc - minc
+    s = np.where(maxc > 0, rng_ / np.maximum(maxc, 1e-12), 0)
+    rc = (maxc - r) / np.maximum(rng_, 1e-12)
+    gc = (maxc - g) / np.maximum(rng_, 1e-12)
+    bc = (maxc - b) / np.maximum(rng_, 1e-12)
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(rng_ == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    # hsv -> rgb
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], -1)
+    if arr.dtype == np.uint8:
+        return np.clip(out * 255.0, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = np.asarray(img)
+    gray = _grayscale_f(arr)
+    if arr.dtype == np.uint8:
+        gray = np.clip(gray, 0, 255).astype(np.uint8)
+    out = gray[..., None]
+    if num_output_channels == 3:
+        out = np.repeat(out, 3, axis=-1)
+    return out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """reference: F.erase — fill the region with value/tensor v."""
+    arr = np.asarray(img) if not inplace else img
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+def _warp(arr, inv3, out_hw, fill=0, interpolation="bilinear"):
+    """Inverse-map warp: output pixel (x, y, 1) pulls from inv3 @ (x,y,1)."""
+    oh, ow = out_hw
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float32),
+                         np.arange(ow, dtype=np.float32), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1)
+    src = inv3 @ coords
+    sx = src[0] / np.maximum(np.abs(src[2]), 1e-12) * np.sign(src[2])
+    sy = src[1] / np.maximum(np.abs(src[2]), 1e-12) * np.sign(src[2])
+    h, w = arr.shape[:2]
+    a = arr.astype(np.float32)
+    if a.ndim == 2:
+        a = a[..., None]
+    if interpolation == "nearest":
+        xi = np.round(sx).astype(np.int64)
+        yi = np.round(sy).astype(np.int64)
+        valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        out = np.full((oh * ow, a.shape[-1]), float(fill), np.float32)
+        out[valid] = a[yi[valid], xi[valid]]
+    else:
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        dx = sx - x0
+        dy = sy - y0
+        out = np.zeros((oh * ow, a.shape[-1]), np.float32)
+        wsum = np.zeros((oh * ow, 1), np.float32)
+        for ox, oy, wgt in ((0, 0, (1 - dx) * (1 - dy)),
+                            (1, 0, dx * (1 - dy)),
+                            (0, 1, (1 - dx) * dy),
+                            (1, 1, dx * dy)):
+            xi, yi = x0 + ox, y0 + oy
+            valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+            out[valid] += wgt[valid, None] * a[yi[valid], xi[valid]]
+            wsum[valid, 0] += wgt[valid]
+        out = out + (1 - wsum) * float(fill)
+    out = out.reshape(oh, ow, a.shape[-1])
+    if np.asarray(arr).ndim == 2:
+        out = out[..., 0]
+    if np.asarray(arr).dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    else:
+        out = out.astype(np.asarray(arr).dtype)
+    return out
+
+
+def _affine_inv(angle, translate, scale, shear, center):
+    """Inverse affine matrix for output->input mapping (reference
+    functional.affine composition: T * C * RSS * C^-1)."""
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    cx, cy = center
+    tx, ty = translate
+    # forward matrix M = T(t) C R Shear Scale C^-1
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    M = np.array([[a * scale, b * scale, 0],
+                  [c * scale, d * scale, 0],
+                  [0, 0, 1]], np.float64)
+    T = np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1]], np.float64)
+    Cinv = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float64)
+    fwd = T @ M @ Cinv
+    return np.linalg.inv(fwd)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    out_hw = (h, w)
+    if expand:
+        rot = np.deg2rad(angle)
+        # round before ceil: cos(90°) is 6e-17, not 0, and would otherwise
+        # inflate the expanded canvas by one pixel
+        nw = int(np.ceil(np.round(abs(w * np.cos(rot))
+                                  + abs(h * np.sin(rot)), 6)))
+        nh = int(np.ceil(np.round(abs(w * np.sin(rot))
+                                  + abs(h * np.cos(rot)), 6)))
+        out_hw = (nh, nw)
+        inv = _affine_inv(angle, ((nw - w) / 2, (nh - h) / 2), 1.0,
+                          (0, 0), center)
+    else:
+        inv = _affine_inv(angle, (0, 0), 1.0, (0, 0), center)
+    return _warp(arr, inv, out_hw, fill, interpolation)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    inv = _affine_inv(angle, tuple(translate), scale, tuple(shear), center)
+    return _warp(arr, inv, (h, w), fill, interpolation)
+
+
+def _homography(src_pts, dst_pts):
+    """3x3 homography mapping src->dst (4 point pairs, DLT)."""
+    A = []
+    for (x, y), (u, v) in zip(src_pts, dst_pts):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y, -u])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y, -v])
+    _, _, vh = np.linalg.svd(np.asarray(A, np.float64))
+    return vh[-1].reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """reference: F.perspective — map startpoints->endpoints."""
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    fwd = _homography(startpoints, endpoints)
+    return _warp(arr, np.linalg.inv(fwd), (h, w), fill, interpolation)
+
+
+# ------------------------------------------------------------ class forms
+class Transpose(BaseTransform):
+    """reference: transforms.Transpose — HWC -> CHW (or given order)."""
+
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """reference: transforms.ColorJitter — random order of the four
+    jitters."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def __call__(self, img):
+        order = list(self.transforms)
+        random.shuffle(order)
+        for t in order:
+            img = t(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.args = (padding, fill, padding_mode)
+
+    def __call__(self, img):
+        return pad(img, *self.args)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if random.random() < self.prob:
+            return vflip(img)
+        return np.asarray(img)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.args = (interpolation, expand, center, fill)
+
+    def __call__(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, *self.args)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.kwargs = dict(interpolation=interpolation, fill=fill,
+                           center=center)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        sh = (random.uniform(*self.shear), 0.0) if self.shear else (0.0, 0.0)
+        return affine(arr, angle, (tx, ty), sc, sh, **self.kwargs)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def __call__(self, img):
+        if random.random() >= self.prob:
+            return np.asarray(img)
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = int(h * d / 2), int(w * d / 2)
+        tl = (random.randint(0, half_w), random.randint(0, half_h))
+        tr = (w - 1 - random.randint(0, half_w), random.randint(0, half_h))
+        br = (w - 1 - random.randint(0, half_w),
+              h - 1 - random.randint(0, half_h))
+        bl = (random.randint(0, half_w), h - 1 - random.randint(0, half_h))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return perspective(arr, start, [tl, tr, br, bl],
+                           self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """reference: transforms.RandomErasing (Zhong et al.)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if random.random() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                v = self.value if not isinstance(self.value, str) else \
+                    np.random.randn(eh, ew, *arr.shape[2:])
+                return erase(arr, i, j, eh, ew, v, self.inplace)
+        return arr
