@@ -123,7 +123,9 @@ def _is_noop(tensor: Tensor, group: Optional[Group]) -> bool:
         return False
     if group is not None and group.mesh is not None:
         return False
-    return get_world_size() <= 1
+    # jax.process_count() covers multi-host SPMD; the launcher env contract
+    # covers multi-process eager jobs (each process runs its own jax)
+    return get_world_size() <= 1 and _host_world() <= 1
 
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
@@ -134,6 +136,8 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     (in-place on the wrapper, paddle semantics)."""
     if _is_noop(tensor, group):
         return tensor
+    if _mp_eager(tensor, group):
+        return _mp_all_reduce(tensor, op, group)
     group = group or _default_axis_group(tensor)
     axis = group.axis
     red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
@@ -163,6 +167,8 @@ def all_gather(tensor_list: Optional[List[Tensor]], tensor: Tensor,
         if tensor_list is not None:
             tensor_list.append(tensor.clone())
         return tensor_list
+    if _mp_eager(tensor, group):
+        return _mp_all_gather(tensor_list, tensor, group)
     group = group or _default_axis_group(tensor)
     attr = tensor.dist_attr
     mesh = attr.process_mesh
@@ -197,7 +203,177 @@ def _host_rank():
     return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
 
 
-_obj_gen = {"bcast": 0, "scatter": 0, "gather": 0, "a2a": 0}
+_obj_gen = {"bcast": 0, "scatter": 0, "gather": 0, "a2a": 0, "ar": 0,
+            "red": 0, "ag": 0, "rs": 0, "tbcast": 0, "tscatter": 0}
+
+
+# ----------------------------------------------- cross-process eager lane
+# (reference: ProcessGroupGloo/NCCL eager collectives — plain tensors in a
+# multi-process job, no mesh.  Transport is the store-brokered p2p
+# substrate; rank 0 is the reduction root.)
+
+def _mp_eager(tensor, group) -> bool:
+    """True when this call must run on the cross-process eager lane."""
+    return (tensor.dist_attr is None and _host_world() > 1
+            and (group is None or group.mesh is None))
+
+
+def _mp_peers(group):
+    """The participating global ranks: a mesh-less group's explicit rank
+    list, else the whole launcher world."""
+    if group is not None and group.mesh is None and group.ranks:
+        return list(group.ranks)
+    return list(range(_host_world()))
+
+
+def _clone(t):
+    return t.clone()
+
+
+def _mp_tag(kind, peers):
+    """Per-(collective, participant-set) generation tag: members of a
+    subgroup advance their own sequence, so a rank outside the group can
+    run other collectives without desynchronizing the members' tags."""
+    key = (kind, tuple(peers))
+    _obj_gen[key] = _obj_gen.get(key, 0) + 1
+    return f"objcoll/{kind}/{'-'.join(map(str, peers))}/{_obj_gen[key]}"
+
+
+def _np_combine(acc, other, opname):
+    if opname in ("sum", "avg"):
+        return acc + other
+    if opname == "max":
+        return np.maximum(acc, other)
+    if opname == "min":
+        return np.minimum(acc, other)
+    return acc * other
+
+
+def _mp_all_reduce(tensor, op, group=None):
+    from . import p2p
+    peers = _mp_peers(group)
+    rank = _host_rank()
+    if rank not in peers:
+        return tensor
+    tag = _mp_tag("ar", peers)
+    opname = str(op)
+    root = peers[0]
+    if rank == root:
+        acc = np.asarray(tensor.numpy(), np.float64) \
+            if opname == "avg" else np.asarray(tensor.numpy()).copy()
+        buf = _clone(tensor)
+        for src in peers[1:]:
+            p2p.recv(buf, src=src, tag=tag)
+            acc = _np_combine(acc, np.asarray(buf.numpy()), opname)
+        if opname == "avg":
+            acc = acc / len(peers)
+        result = wrap_array(jnp.asarray(
+            acc.astype(np.asarray(tensor.numpy()).dtype)))
+        for dst in peers[1:]:
+            p2p.send(result, dst=dst, tag=tag + "o")
+        tensor._data = result._data
+    else:
+        p2p.send(tensor, dst=root, tag=tag)
+        p2p.recv(tensor, src=root, tag=tag + "o")
+    return tensor
+
+
+def _mp_broadcast(tensor, src, group=None):
+    from . import p2p
+    peers = _mp_peers(group)
+    rank = _host_rank()
+    if rank not in peers:
+        return tensor
+    tag = _mp_tag("tbcast", peers)
+    if rank == src:
+        for dst in peers:
+            if dst != src:
+                p2p.send(tensor, dst=dst, tag=tag)
+    else:
+        p2p.recv(tensor, src=src, tag=tag)
+    return tensor
+
+
+def _mp_all_gather(tensor_list, tensor, group=None):
+    from . import p2p
+    peers = _mp_peers(group)
+    rank = _host_rank()
+    if rank not in peers:
+        return []
+    tag = _mp_tag("ag", peers)
+    for dst in peers:
+        if dst != rank:
+            p2p.send(tensor, dst=dst, tag=tag)
+    parts = []
+    for src in peers:
+        if src == rank:
+            parts.append(_clone(tensor))
+        else:
+            parts.append(p2p.recv(_clone(tensor), src=src, tag=tag))
+    if tensor_list is not None:
+        tensor_list.extend(parts)
+    return parts
+
+
+def _mp_reduce(tensor, dst, op, group=None):
+    from . import p2p
+    peers = _mp_peers(group)
+    rank = _host_rank()
+    if rank not in peers:
+        return tensor
+    tag = _mp_tag("red", peers)
+    opname = str(op)
+    if rank == dst:
+        acc = np.asarray(tensor.numpy()).copy()
+        buf = _clone(tensor)
+        for src in peers:
+            if src == dst:
+                continue
+            p2p.recv(buf, src=src, tag=tag)
+            acc = _np_combine(acc, np.asarray(buf.numpy()), opname)
+        if opname == "avg":
+            acc = acc / len(peers)
+        tensor._data = jnp.asarray(acc)
+    else:
+        p2p.send(tensor, dst=dst, tag=tag)
+    return tensor
+
+
+def _mp_scatter(tensor, tensor_list, src, group=None):
+    from . import p2p
+    peers = _mp_peers(group)
+    rank = _host_rank()
+    if rank not in peers:
+        return tensor
+    tag = _mp_tag("tscatter", peers)
+    if rank == src:
+        if not tensor_list or len(tensor_list) != len(peers):
+            raise ValueError(
+                f"scatter on rank {src} needs tensor_list of length "
+                f"{len(peers)}")
+        for i, dst in enumerate(peers):
+            if dst != src:
+                p2p.send(tensor_list[i], dst=dst, tag=tag)
+        tensor._data = tensor_list[peers.index(src)]._data
+    else:
+        p2p.recv(tensor, src=src, tag=tag)
+    return tensor
+
+
+def _mp_reduce_scatter(output, input, op, group=None):
+    peers = _mp_peers(group)
+    rank = _host_rank()
+    if rank not in peers:
+        return output
+    if input.shape[0] % len(peers) != 0:
+        raise ValueError(
+            f"reduce_scatter: dim 0 ({input.shape[0]}) must divide the "
+            f"group size ({len(peers)})")
+    reduced = _mp_all_reduce(_clone(input), op, group)
+    n = input.shape[0] // len(peers)
+    i = peers.index(rank)
+    output._data = reduced._data[i * n:(i + 1) * n]
+    return output
 
 
 def _obj_key(kind):
@@ -236,10 +412,13 @@ def all_gather_object(object_list, obj, group=None):
 
 def reduce_scatter(output: Tensor, input: Tensor, op=ReduceOp.SUM,
                    group: Optional[Group] = None, sync_op=True):
-    """reference: communication/reduce_scatter.py — Partial→Shard(0)."""
+    """reference: communication/reduce_scatter.py — Partial→Shard(0) on
+    SPMD lanes; all-reduce + local slice across processes."""
     if _is_noop(input, group):
         output._data = input._data
         return output
+    if _mp_eager(input, group):
+        return _mp_reduce_scatter(output, input, op, group)
     group = group or _default_axis_group(input)
     attr = input.dist_attr
     mesh = attr.process_mesh
@@ -257,9 +436,12 @@ def reduce_scatter(output: Tensor, input: Tensor, op=ReduceOp.SUM,
 def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
               sync_op=True):
     """reference: paddle.distributed.broadcast — on SPMD lanes this is a
-    reshard to Replicate (XLA broadcasts from the owning shard)."""
+    reshard to Replicate (XLA broadcasts from the owning shard); across
+    processes, rank-to-rank p2p from src."""
     if _is_noop(tensor, group):
         return tensor
+    if _mp_eager(tensor, group):
+        return _mp_broadcast(tensor, src, group)
     attr = tensor.dist_attr
     if attr is not None:
         out = reshard(tensor, attr.process_mesh,
@@ -272,13 +454,19 @@ def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
 def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM,
            group: Optional[Group] = None, sync_op=True):
     """reduce-to-root == all_reduce on SPMD lanes (root extraction is a
-    local slice; XLA keeps one copy per device anyway)."""
+    local slice; XLA keeps one copy per device anyway); across processes
+    only dst receives the reduced value."""
+    if _mp_eager(tensor, group):
+        return _mp_reduce(tensor, dst, op, group)
     return all_reduce(tensor, op, group)
 
 
 def scatter(tensor: Tensor, tensor_list=None, src=0,
             group: Optional[Group] = None, sync_op=True):
-    """reference: paddle.distributed.scatter — Replicate→Shard(0)."""
+    """reference: paddle.distributed.scatter — Replicate→Shard(0) on SPMD
+    lanes; rank-to-rank p2p from src across processes."""
+    if _mp_eager(tensor, group):
+        return _mp_scatter(tensor, tensor_list, src, group)
     if tensor_list:
         from ..tensor.manipulation import concat
         full = concat(tensor_list, axis=0)
@@ -376,7 +564,14 @@ def irecv(tensor, src=0, group=None):
 
 
 def barrier(group=None):
-    """reference: paddle.distributed.barrier."""
+    """reference: paddle.distributed.barrier — multi-host SPMD syncs
+    global devices; multi-process eager jobs rendezvous on the store."""
+    if _host_world() > 1:
+        from . import p2p
+        from .store import barrier as _store_barrier
+        _store_barrier(p2p._state.get_store(), "coll/barrier",
+                       _host_world())
+        return
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("paddle_tpu_barrier")

@@ -194,3 +194,148 @@ class TestP2PSingleProcess:
         out = []
         dist.scatter_object_list(out, ["x", "y"], src=0)
         assert out == ["x"]
+
+
+def _mp_collective_proc(rank, world, port, q):
+    try:
+        from paddle_tpu.framework.backend_guard import helper_process_init
+        helper_process_init()
+        _env(rank, world, port)
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        # all_reduce sum: every rank ends with 0+1+2
+        x = paddle.to_tensor(np.full((3,), float(rank), np.float32))
+        dist.all_reduce(x)
+        np.testing.assert_allclose(x.numpy(), sum(range(world)))
+
+        # all_reduce max
+        m = paddle.to_tensor(np.array([float(rank)], np.float32))
+        dist.all_reduce(m, op=dist.ReduceOp.MAX)
+        assert float(m.numpy()[0]) == world - 1
+
+        # broadcast from rank 1
+        b = paddle.to_tensor(np.full((2,), float(rank), np.float32))
+        dist.broadcast(b, src=1)
+        np.testing.assert_allclose(b.numpy(), 1.0)
+
+        # all_gather: rank-major pieces
+        parts = []
+        dist.all_gather(parts, paddle.to_tensor(
+            np.array([rank * 10.0], np.float32)))
+        assert [float(p.numpy()[0]) for p in parts] == \
+            [r * 10.0 for r in range(world)]
+
+        # reduce to dst=2
+        r = paddle.to_tensor(np.array([1.0], np.float32))
+        dist.reduce(r, dst=world - 1)
+        if rank == world - 1:
+            assert float(r.numpy()[0]) == world
+
+        # scatter from rank 0
+        s = paddle.to_tensor(np.zeros((2,), np.float32))
+        chunks = [paddle.to_tensor(np.full((2,), 7.0 + i, np.float32))
+                  for i in range(world)] if rank == 0 else None
+        dist.scatter(s, chunks, src=0)
+        np.testing.assert_allclose(s.numpy(), 7.0 + rank)
+
+        # reduce_scatter: world*L input, each keeps its reduced slice
+        inp = paddle.to_tensor(
+            np.arange(world * 2, dtype=np.float32) + rank)
+        out = paddle.to_tensor(np.zeros(2, np.float32))
+        dist.reduce_scatter(out, inp)
+        base = np.arange(world * 2, dtype=np.float32) * world + \
+            sum(range(world))
+        np.testing.assert_allclose(out.numpy(),
+                                   base[rank * 2:(rank + 1) * 2])
+        q.put((rank, "ok"))
+    except Exception as e:   # noqa: BLE001
+        import traceback
+        q.put((rank, f"FAIL: {e}\n{traceback.format_exc()}"))
+
+
+class TestMultiProcessEagerCollectives:
+    def test_three_rank_collectives(self):
+        port = _free_port()
+        world = 3
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_mp_collective_proc,
+                             args=(r, world, port, q))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        results = {}
+        for _ in range(world):
+            rank, status = q.get(timeout=180)
+            results[rank] = status
+        for p in procs:
+            p.join(timeout=30)
+        assert all(v == "ok" for v in results.values()), results
+
+
+def _subgroup_proc(rank, world, port, q):
+    try:
+        from paddle_tpu.framework.backend_guard import helper_process_init
+        helper_process_init()
+        _env(rank, world, port)
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        # subgroup {0, 2}: rank 1 must be a no-op non-member
+        g = dist.new_group(ranks=[0, 2])
+        x = paddle.to_tensor(np.array([float(rank + 1)], np.float32))
+        dist.all_reduce(x, group=g)
+        if rank in (0, 2):
+            assert float(x.numpy()[0]) == 4.0      # 1 + 3
+        else:
+            assert float(x.numpy()[0]) == 2.0      # untouched
+
+        # cross-process barrier actually synchronizes
+        import time
+        t0 = time.monotonic()
+        if rank == 0:
+            time.sleep(1.0)
+        dist.barrier()
+        elapsed = time.monotonic() - t0
+        assert elapsed >= 0.9, elapsed              # everyone waited on 0
+
+        # reduce_scatter rejects non-divisible dim 0
+        bad_out = paddle.to_tensor(np.zeros(2, np.float32))
+        bad_in = paddle.to_tensor(np.zeros(7, np.float32))
+        try:
+            dist.reduce_scatter(bad_out, bad_in)
+            q.put((rank, "no-error"))
+            return
+        except ValueError:
+            pass
+        # input of reduce_scatter must NOT be mutated
+        keep = paddle.to_tensor(
+            np.arange(world * 2, dtype=np.float32) + rank)
+        before = keep.numpy().copy()
+        out = paddle.to_tensor(np.zeros(2, np.float32))
+        dist.reduce_scatter(out, keep)
+        np.testing.assert_allclose(keep.numpy(), before)
+        q.put((rank, "ok"))
+    except Exception as e:   # noqa: BLE001
+        import traceback
+        q.put((rank, f"FAIL: {e}\n{traceback.format_exc()}"))
+
+
+class TestSubgroupAndBarrier:
+    def test_subgroup_barrier_reduce_scatter(self):
+        port = _free_port()
+        world = 3
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_subgroup_proc, args=(r, world, port, q))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        results = {}
+        for _ in range(world):
+            rank, status = q.get(timeout=180)
+            results[rank] = status
+        for p in procs:
+            p.join(timeout=30)
+        assert all(v == "ok" for v in results.values()), results
