@@ -50,6 +50,7 @@ class Group:
                  axis: Optional[str] = None, ranks: Optional[List[int]] = None):
         self.mesh = mesh
         self.axis = axis
+        self._explicit_ranks = ranks is not None
         self.ranks = ranks if ranks is not None else (
             list(range(mesh.get_dim_size(axis))) if mesh else
             list(range(get_world_size())))
@@ -203,8 +204,9 @@ def _host_rank():
     return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
 
 
-_obj_gen = {"bcast": 0, "scatter": 0, "gather": 0, "a2a": 0, "ar": 0,
-            "red": 0, "ag": 0, "rs": 0, "tbcast": 0, "tscatter": 0}
+# string keys: world-wide object collectives (_obj_key); tuple keys
+# (kind, peers): per-participant-set tensor collectives (_mp_tag)
+_obj_gen = {"bcast": 0, "scatter": 0, "gather": 0, "a2a": 0}
 
 
 # ----------------------------------------------- cross-process eager lane
@@ -220,8 +222,12 @@ def _mp_eager(tensor, group) -> bool:
 
 def _mp_peers(group):
     """The participating global ranks: a mesh-less group's explicit rank
-    list, else the whole launcher world."""
-    if group is not None and group.mesh is None and group.ranks:
+    list, else the whole launcher world.  A default-constructed group
+    (new_group() with no ranks) means the whole world too — its ranks
+    default from jax.process_count(), which is 1 in every spawned eager
+    process and would otherwise shrink the group to [0]."""
+    if group is not None and group.mesh is None and group.ranks \
+            and getattr(group, "_explicit_ranks", False):
         return list(group.ranks)
     return list(range(_host_world()))
 
@@ -284,6 +290,9 @@ def _mp_broadcast(tensor, src, group=None):
     rank = _host_rank()
     if rank not in peers:
         return tensor
+    if src not in peers:
+        raise ValueError(f"broadcast src {src} is not in the group "
+                         f"{peers}")
     tag = _mp_tag("tbcast", peers)
     if rank == src:
         for dst in peers:
@@ -321,6 +330,8 @@ def _mp_reduce(tensor, dst, op, group=None):
     rank = _host_rank()
     if rank not in peers:
         return tensor
+    if dst not in peers:
+        raise ValueError(f"reduce dst {dst} is not in the group {peers}")
     tag = _mp_tag("red", peers)
     opname = str(op)
     if rank == dst:
@@ -345,6 +356,8 @@ def _mp_scatter(tensor, tensor_list, src, group=None):
     rank = _host_rank()
     if rank not in peers:
         return tensor
+    if src not in peers:
+        raise ValueError(f"scatter src {src} is not in the group {peers}")
     tag = _mp_tag("tscatter", peers)
     if rank == src:
         if not tensor_list or len(tensor_list) != len(peers):
@@ -367,8 +380,8 @@ def _mp_reduce_scatter(output, input, op, group=None):
         return output
     if input.shape[0] % len(peers) != 0:
         raise ValueError(
-            f"reduce_scatter: dim 0 ({input.shape[0]}) must divide the "
-            f"group size ({len(peers)})")
+            f"reduce_scatter: group size ({len(peers)}) must divide "
+            f"dim 0 ({input.shape[0]})")
     reduced = _mp_all_reduce(_clone(input), op, group)
     n = input.shape[0] // len(peers)
     i = peers.index(rank)

@@ -15,7 +15,21 @@ from typing import Dict, List, Optional
 import numpy as np
 
 _EXCLUDED: Dict[int, List[str]] = {}
-_MASKS: Dict[int, "np.ndarray"] = {}   # id(param) -> mask
+import weakref
+
+# id(param) -> (weakref(param), mask).  The weakref validates identity on
+# every read: a bare id()-keyed dict resurrects stale masks when a dead
+# parameter's id is reused by a new object (observed as a cross-test shape
+# mismatch).  (Tensor keys can't go in a WeakKeyDictionary — Tensor.__eq__
+# is elementwise and bucket collisions would need bool(array).)
+_MASKS: Dict[int, tuple] = {}
+
+
+def _mask_for(p):
+    entry = _MASKS.get(id(p))
+    if entry is None or entry[0]() is not p:
+        return None
+    return entry[1]
 
 
 def calculate_density(x) -> float:
@@ -115,7 +129,7 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         mask = mask2d if orig_shape is None else mask2d.reshape(orig_shape)
         p._data = jnp.asarray(arr * mask)
         if with_mask:
-            _MASKS[id(p)] = mask
+            _MASKS[id(p)] = (weakref.ref(p), mask)
             masks[name] = mask
     return masks
 
@@ -123,7 +137,7 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
 def check_sparsity(model, n=2, m=4) -> bool:
     """True iff every pruned weight satisfies the n:m pattern."""
     for name, p in model.named_parameters():
-        mask = _MASKS.get(id(p))
+        mask = _mask_for(p)
         if mask is None:
             continue
         arr = np.asarray(p.numpy())
@@ -149,7 +163,7 @@ class OptimizerWithSparsityGuarantee:
         import jax.numpy as jnp
         self._optimizer.step()
         for p in self._optimizer._parameter_list:
-            mask = _MASKS.get(id(p))
+            mask = _mask_for(p)
             if mask is not None:
                 p._data = p._data * jnp.asarray(
                     mask, p._data.dtype)

@@ -356,3 +356,7 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
 __all__ += ["DataType", "PlaceType", "Tensor", "XpuConfig",
             "get_num_bytes_of_data_type", "get_trt_compile_version",
             "get_trt_runtime_version", "convert_to_mixed_precision"]
+
+from . import server  # noqa: E402,F401  (HTTP serving over the Predictor)
+from .server import InferenceServer  # noqa: E402,F401
+__all__ += ["server", "InferenceServer"]

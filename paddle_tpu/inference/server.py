@@ -1,0 +1,154 @@
+"""HTTP model server over the Predictor (reference: the C++ fluid
+inference server / Paddle Serving's role — here a dependency-free
+stdlib implementation fronting the StableHLO Predictor).
+
+Endpoints (JSON; arrays as nested lists with dtype strings):
+  GET  /health          -> {"status": "ok", "model": prefix}
+  GET  /metadata        -> input/output names
+  POST /predict         -> {"inputs": {name: {"data": [...], "dtype": ...,
+                            "shape": [...]}}} -> {"outputs": {...}}
+
+A PredictorPool serves concurrent requests; the ThreadingHTTPServer
+dispatches each request to a pool slot.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from . import Config, Predictor, PredictorPool
+
+__all__ = ["InferenceServer", "serve"]
+
+
+class InferenceServer:
+    """Serve a jit.save artifact over HTTP.
+
+    Usage::
+
+        server = InferenceServer("ckpt/model", device="cpu", pool_size=2)
+        server.start()              # non-blocking; .port has the port
+        ...
+        server.stop()
+    """
+
+    def __init__(self, model_prefix: str, host: str = "127.0.0.1",
+                 port: int = 0, pool_size: int = 1, device: str = ""):
+        config = Config(model_prefix)
+        if device == "cpu":
+            config.disable_gpu()
+        elif device not in ("", "tpu", "gpu"):
+            raise ValueError(
+                f"device must be '', 'cpu', 'tpu' or 'gpu', got {device!r}")
+        self._prefix = model_prefix
+        self._pool = PredictorPool(config, pool_size)
+        self._pool_lock = threading.Lock()
+        self._next = [0]
+        self._size = pool_size
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # quiet by default
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._reply(200, {"status": "ok",
+                                      "model": outer._prefix})
+                elif self.path == "/metadata":
+                    p = outer._pool.retrieve(0)
+                    self._reply(200, {
+                        "inputs": p.get_input_names(),
+                        "outputs": p.get_output_names()})
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    out = outer._predict(req)
+                    self._reply(200, out)
+                except Exception as e:   # noqa: BLE001
+                    self._reply(400, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def _predict(self, req):
+        inputs = req.get("inputs", {})
+        with self._pool_lock:
+            idx = self._next[0] % self._size
+            self._next[0] += 1
+        pred = self._pool.retrieve(idx)
+        names = pred.get_input_names()
+        missing = [n for n in names if n not in inputs]
+        if missing:
+            raise ValueError(f"missing inputs: {missing}")
+        arrays = []
+        for name in names:
+            spec = inputs[name]
+            arr = np.asarray(spec["data"],
+                             dtype=spec.get("dtype", "float32"))
+            if "shape" in spec:
+                arr = arr.reshape(spec["shape"])
+            arrays.append(arr)
+        # handle-free run: inputs are passed per call and outputs returned
+        # directly, so concurrent requests sharing a pool slot never race
+        # through the copy_from_cpu/run/copy_to_cpu handle state
+        results = pred.run(arrays)
+        outputs = {}
+        for name, out in zip(pred.get_output_names(), results):
+            a = np.asarray(out)
+            outputs[name] = {"data": a.tolist(), "dtype": str(a.dtype),
+                             "shape": list(a.shape)}
+        return {"outputs": outputs}
+
+    # ------------------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def serve(model_prefix: str, host: str = "127.0.0.1", port: int = 8000,
+          pool_size: int = 1):
+    """Blocking CLI-style entry: serve the model until interrupted."""
+    server = InferenceServer(model_prefix, host, port, pool_size)
+    print(f"serving {model_prefix} at http://{server.host}:{server.port}")
+    try:
+        server._httpd.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()

@@ -1,0 +1,98 @@
+"""HTTP inference server over the StableHLO Predictor (reference: the
+C++ inference server / Paddle Serving role)."""
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import save, InputSpec
+from paddle_tpu.inference import InferenceServer
+
+
+@pytest.fixture(scope="module")
+def served_model(tmp_path_factory):
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model.eval()
+    prefix = str(tmp_path_factory.mktemp("srv") / "model")
+    save(model, prefix, input_spec=[InputSpec([None, 4], "float32")])
+    server = InferenceServer(prefix, pool_size=2).start()
+    yield model, server
+    server.stop()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req).read())
+
+
+class TestInferenceServer:
+    def test_health_and_metadata(self, served_model):
+        _, srv = served_model
+        base = f"http://{srv.host}:{srv.port}"
+        assert json.loads(urllib.request.urlopen(
+            base + "/health").read())["status"] == "ok"
+        meta = json.loads(urllib.request.urlopen(
+            base + "/metadata").read())
+        assert meta["inputs"] and meta["outputs"]
+
+    def test_predict_matches_local(self, served_model):
+        model, srv = served_model
+        base = f"http://{srv.host}:{srv.port}"
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        resp = _post(base + "/predict", {"inputs": {"input_0": {
+            "data": x.tolist(), "dtype": "float32"}}})
+        out = np.asarray(resp["outputs"]["output_0"]["data"])
+        np.testing.assert_allclose(out, model(paddle.to_tensor(x)).numpy(),
+                                   atol=1e-6)
+
+    def test_predict_polymorphic_batch(self, served_model):
+        model, srv = served_model
+        base = f"http://{srv.host}:{srv.port}"
+        for bs in (1, 5):
+            x = np.zeros((bs, 4), np.float32)
+            resp = _post(base + "/predict", {"inputs": {"input_0": {
+                "data": x.tolist(), "dtype": "float32"}}})
+            assert np.asarray(
+                resp["outputs"]["output_0"]["data"]).shape == (bs, 2)
+
+    def test_concurrent_requests_distinct_inputs(self, served_model):
+        # DISTINCT inputs per request: a pool-slot race would cross-wire
+        # requests and return another caller's outputs
+        import concurrent.futures as cf
+        model, srv = served_model
+        base = f"http://{srv.host}:{srv.port}"
+
+        def call(i):
+            x = np.full((2, 4), float(i), np.float32)
+            r = _post(base + "/predict", {"inputs": {"input_0": {
+                "data": x.tolist(), "dtype": "float32"}}})
+            ref = model(paddle.to_tensor(x)).numpy()
+            np.testing.assert_allclose(
+                np.asarray(r["outputs"]["output_0"]["data"]), ref,
+                atol=1e-5)
+            return True
+
+        with cf.ThreadPoolExecutor(8) as ex:
+            assert all(ex.map(call, range(24)))
+
+    def test_bad_request_is_400(self, served_model):
+        _, srv = served_model
+        base = f"http://{srv.host}:{srv.port}"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + "/predict",
+                  {"inputs": {"nonexistent": {"data": [1.0]}}})
+        assert e.value.code == 400
+
+    def test_unknown_route_404(self, served_model):
+        _, srv = served_model
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/nope")
+        assert e.value.code == 404

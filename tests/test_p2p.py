@@ -339,3 +339,54 @@ class TestSubgroupAndBarrier:
         for p in procs:
             p.join(timeout=30)
         assert all(v == "ok" for v in results.values()), results
+
+
+def _default_group_proc(rank, world, port, q):
+    try:
+        from paddle_tpu.framework.backend_guard import helper_process_init
+        helper_process_init()
+        _env(rank, world, port)
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        # a default-constructed group must span the launcher world, not
+        # the local jax.process_count() == 1
+        g = dist.new_group()
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        dist.all_reduce(x, group=g)
+        assert float(x.numpy()[0]) == world
+
+        # non-member src must raise, not hang
+        sub = dist.new_group(ranks=[0, 2])
+        if rank in (0, 2):
+            try:
+                dist.broadcast(paddle.to_tensor(
+                    np.zeros(1, np.float32)), src=1, group=sub)
+                q.put((rank, "no-error"))
+                return
+            except ValueError:
+                pass
+        q.put((rank, "ok"))
+    except Exception as e:   # noqa: BLE001
+        import traceback
+        q.put((rank, f"FAIL: {e}\n{traceback.format_exc()}"))
+
+
+class TestDefaultGroupSemantics:
+    def test_default_group_spans_launcher_world(self):
+        port = _free_port()
+        world = 3
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_default_group_proc,
+                             args=(r, world, port, q))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        results = {}
+        for _ in range(world):
+            rank, status = q.get(timeout=180)
+            results[rank] = status
+        for p in procs:
+            p.join(timeout=30)
+        assert all(v == "ok" for v in results.values()), results
