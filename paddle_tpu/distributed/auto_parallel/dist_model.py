@@ -27,10 +27,10 @@ __all__ = ["DistModel", "to_static", "Strategy"]
 class Strategy:
     """reference: dist.Strategy — pass/parallelism configuration knobs.
     Consumed knobs: ``sharding`` (ZeRO stage + degree for the optimizer),
-    ``amp`` (o1/o2 autocast in the compiled step).  ``pipeline`` and
-    ``gradient_merge`` are accepted for config compatibility but NOT
-    consumed here (PP is configured on the layers themselves; gradient
-    accumulation is a warned no-op until TrainStep grows it)."""
+    ``amp`` (o1/o2 autocast in the compiled step), ``gradient_merge``
+    (k-step gradient accumulation compiled into the train step).
+    ``pipeline`` is accepted for config compatibility but configured on
+    the layers themselves."""
 
     def __init__(self, config: Optional[dict] = None):
         config = config or {}
@@ -84,11 +84,9 @@ class DistModel:
         else:
             self._amp_level, self._amp_dtype = "O0", "bfloat16"
 
-        if self._strategy.gradient_merge.enable:
-            import warnings
-            warnings.warn(
-                "Strategy.gradient_merge is not consumed by the TPU engine "
-                "yet — steps apply every batch (no accumulation)")
+        self._accumulate_steps = (
+            int(self._strategy.gradient_merge.k_steps)
+            if self._strategy.gradient_merge.enable else 1)
         if self._strategy.sharding.enable and optimizer is not None:
             from ..fleet.sharding import group_sharded_parallel
             stage = self._strategy.sharding.stage
@@ -143,7 +141,8 @@ class DistModel:
             from ...jit.train_step import TrainStep
             self._train_step = TrainStep(
                 self.network, self._loss_fn, self._optimizer,
-                amp_level=self._amp_level, amp_dtype=self._amp_dtype)
+                amp_level=self._amp_level, amp_dtype=self._amp_dtype,
+                accumulate_steps=self._accumulate_steps)
         return self._train_step
 
     def _get_eval_fn(self):

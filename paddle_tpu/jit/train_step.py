@@ -58,12 +58,22 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn: Callable, optimizer,
-                 amp_level: str = "O0", amp_dtype: str = "bfloat16"):
+                 amp_level: str = "O0", amp_dtype: str = "bfloat16",
+                 accumulate_steps: int = 1):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.amp_level = amp_level
         self.amp_dtype = amp_dtype
+        # gradient accumulation (reference: gradient_merge pass /
+        # accumulate_steps): grads sum across k calls; the optimizer
+        # update applies on every k-th call via lax.cond INSIDE the
+        # compiled program — one executable, no per-branch recompiles
+        self.accumulate_steps = int(accumulate_steps)
+        if self.accumulate_steps < 1:
+            raise ValueError(
+                f"accumulate_steps (gradient_merge k_steps) must be >= 1, "
+                f"got {accumulate_steps}")
 
         all_params = list(model.parameters())
         self._train_params = [p for p in all_params
@@ -83,6 +93,14 @@ class TrainStep:
                          else jnp.copy(opt._master_weights[id(p)])
                          for p in self._train_params]
         self._update_fn = opt._functional_update_fn(self._train_params)
+        # accumulate in fp32 whenever a master weight exists: summing k
+        # bf16 micro-grads in bf16 rounds away exactly the small terms
+        # the master-weight machinery protects
+        self._grad_accum = [
+            jnp.zeros_like(m if m is not None else a)
+            for a, m in zip(self._arrays, self._masters)] \
+            if self.accumulate_steps > 1 else []
+        self._micro_step = 0
         self._compiled = None
         self._last_loss = None
 
@@ -106,8 +124,10 @@ class TrainStep:
             def cast_ctx():
                 return contextlib.nullcontext()
 
-        def pure_step(arrays, states, masters, frozen, lr, stepno,
-                      in_leaves, label_leaves, treedefs):
+        K = self.accumulate_steps
+
+        def pure_step(arrays, states, masters, accum, frozen, lr, stepno,
+                      apply_flag, in_leaves, label_leaves, treedefs):
             in_tree, label_tree = treedefs
 
             def loss_of(arrs):
@@ -148,16 +168,45 @@ class TrainStep:
             # are that stage's definition.
             if getattr(opt, "_sharding_level", None) != "os":
                 grads = [_pin(g, s) for g, s in zip(grads, grad_shardings)]
-            if grad_clip is not None:
+
+            def apply_clip(gs):
+                if grad_clip is None:
+                    return gs
                 # real Parameter objects, not bare wraps: the clip consults
                 # per-param flags (need_clip) that live on the Parameter
                 pairs = [(p, wrap_array(g))
-                         for p, g in zip(train_params, grads)]
+                         for p, g in zip(train_params, gs)]
                 with no_grad():
                     clipped = grad_clip(pairs)
-                grads = [g._data for _, g in clipped]
-            new_arrays, new_states, new_masters = update_fn(
-                lr, stepno, arrays, grads, states, masters)
+                return [g._data for _, g in clipped]
+
+            if K == 1:
+                grads = apply_clip(grads)
+                new_arrays, new_states, new_masters = update_fn(
+                    lr, stepno, arrays, grads, states, masters)
+                new_accum = accum
+            else:
+                # accumulate; the k-th call applies the averaged update and
+                # resets the accumulators — both arms of ONE compiled cond
+                summed = [a + g for a, g in zip(accum, grads)]
+
+                def do_update(operand):
+                    arrays_, states_, masters_, summed_ = operand
+                    # back to the grad dtype the update rule expects (the
+                    # K=1 path feeds raw param-dtype grads)
+                    avg = apply_clip([(g / K).astype(a.dtype)
+                                      for g, a in zip(summed_, arrays_)])
+                    na, ns, nm = update_fn(lr, stepno, arrays_, avg,
+                                           states_, masters_)
+                    return na, ns, nm, [jnp.zeros_like(g) for g in summed_]
+
+                def skip_update(operand):
+                    arrays_, states_, masters_, summed_ = operand
+                    return arrays_, states_, masters_, summed_
+
+                new_arrays, new_states, new_masters, new_accum = \
+                    jax.lax.cond(apply_flag, do_update, skip_update,
+                                 (arrays, states, masters, summed))
             # pin outputs to their INITIAL placements: donated-buffer steps
             # otherwise drift to whatever GSPMD chose (e.g. ZeRO-1 params
             # silently becoming sharded after one step, erasing the
@@ -169,7 +218,13 @@ class TrainStep:
                           for k in new_states}
             new_masters = [_pin(a, s)
                            for a, s in zip(new_masters, master_shardings)]
-            return loss, outs, new_arrays, new_states, new_masters
+            # accumulators follow the gradient placement (same reason as
+            # the pins above: donated-buffer steps must not drift
+            # shardings between calls, which would recompile every step)
+            new_accum = [_pin(a, s)
+                         for a, s in zip(new_accum, grad_shardings)]
+            return (loss, outs, new_arrays, new_states, new_masters,
+                    new_accum)
 
         param_shardings = [_keep(a) for a in self._arrays]
         state_shardings = {k: [_keep(a) for a in v]
@@ -183,8 +238,8 @@ class TrainStep:
                        if state_shardings[k][i] is not None), None)
             grad_shardings.append(sh or master_shardings[i])
 
-        self._compiled = jax.jit(pure_step, donate_argnums=(0, 1, 2),
-                                 static_argnums=(8,))
+        self._compiled = jax.jit(pure_step, donate_argnums=(0, 1, 2, 3),
+                                 static_argnums=(10,))
 
     # ------------------------------------------------------------------- call
     def _prepare_args(self, inputs, labels):
@@ -214,14 +269,20 @@ class TrainStep:
             inputs, labels)
 
         opt = self.optimizer
-        opt._global_step += 1
+        K = self.accumulate_steps
+        self._micro_step += 1
+        apply_now = (self._micro_step % K == 0)
+        if apply_now:
+            # the optimizer's schedule advances once per APPLIED update
+            opt._global_step += 1
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         stepno = jnp.asarray(opt._global_step, jnp.int32)
 
-        loss, outs, self._arrays, self._states, self._masters = \
-            self._compiled(self._arrays, self._states, self._masters,
-                           frozen, lr, stepno, in_leaves, label_leaves,
-                           treedefs)
+        (loss, outs, self._arrays, self._states, self._masters,
+         self._grad_accum) = self._compiled(
+            self._arrays, self._states, self._masters, self._grad_accum,
+            frozen, lr, stepno, jnp.asarray(apply_now), in_leaves,
+            label_leaves, treedefs)
         self._last_outputs = [wrap_array(o) for o in outs]
         self._last_loss = wrap_array(loss)
         return self._last_loss
@@ -247,8 +308,9 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         stepno = jnp.asarray(self.optimizer._global_step + 1, jnp.int32)
         lowered = self._compiled.lower(
-            self._arrays, self._states, self._masters, frozen, lr, stepno,
-            in_leaves, label_leaves, treedefs)
+            self._arrays, self._states, self._masters, self._grad_accum,
+            frozen, lr, stepno, jnp.asarray(True), in_leaves, label_leaves,
+            treedefs)
         mem = lowered.compile().memory_analysis()
         out = {
             "argument_bytes": getattr(mem, "argument_size_in_bytes", 0),

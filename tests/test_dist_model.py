@@ -6,6 +6,7 @@ import pytest
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
 import paddle_tpu.optimizer as optim
 from paddle_tpu.distributed import Replicate, Shard
 
@@ -120,3 +121,105 @@ class TestDistModel:
         for _ in range(5):
             loss = dm(x, y)
         assert float(loss.numpy()) < l0
+
+
+class TestGradientAccumulation:
+    """TrainStep accumulate_steps (reference: gradient_merge pass /
+    pipeline accumulate_steps): k micro-batches must equal one full-batch
+    step (up to float reassociation), with no param motion
+    mid-accumulation."""
+
+    def _make(self):
+        import numpy as np
+        m = nn.Linear(4, 2)
+        m.weight.set_value(paddle.to_tensor(
+            np.linspace(-1, 1, 8).reshape(4, 2).astype(np.float32)))
+        m.bias.set_value(paddle.to_tensor(np.zeros(2, np.float32)))
+        return m
+
+    def test_k_microbatches_equal_full_batch(self):
+        import numpy as np
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.jit import TrainStep
+        rs = np.random.RandomState(0)
+        X = rs.randn(8, 4).astype(np.float32)
+        Y = rs.randn(8, 2).astype(np.float32)
+        loss_fn = lambda out, y: F.mse_loss(out, y)
+
+        m1 = self._make()
+        o1 = optim.SGD(learning_rate=0.1, parameters=m1.parameters())
+        s1 = TrainStep(m1, loss_fn, o1)
+        s1(paddle.to_tensor(X), paddle.to_tensor(Y))
+        s1.sync()
+        m2 = self._make()
+        o2 = optim.SGD(learning_rate=0.1, parameters=m2.parameters())
+        s2 = TrainStep(m2, loss_fn, o2, accumulate_steps=4)
+        for i in range(4):
+            s2(paddle.to_tensor(X[i * 2:(i + 1) * 2]),
+               paddle.to_tensor(Y[i * 2:(i + 1) * 2]))
+        s2.sync()
+        np.testing.assert_allclose(m2.weight.numpy(), m1.weight.numpy(),
+                                   atol=1e-7)
+        np.testing.assert_allclose(m2.bias.numpy(), m1.bias.numpy(),
+                                   atol=1e-7)
+        assert o1._global_step == o2._global_step == 1
+
+    def test_params_frozen_mid_accumulation(self):
+        import numpy as np
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.jit import TrainStep
+        rs = np.random.RandomState(1)
+        m = self._make()
+        opt = optim.SGD(learning_rate=0.1, parameters=m.parameters())
+        step = TrainStep(m, lambda o, y: F.mse_loss(o, y), opt,
+                         accumulate_steps=3)
+        w0 = np.asarray(step._arrays[0]).copy()
+        for i in range(2):
+            step(paddle.to_tensor(rs.randn(2, 4).astype(np.float32)),
+                 paddle.to_tensor(rs.randn(2, 2).astype(np.float32)))
+            np.testing.assert_array_equal(np.asarray(step._arrays[0]), w0)
+        step(paddle.to_tensor(rs.randn(2, 4).astype(np.float32)),
+             paddle.to_tensor(rs.randn(2, 2).astype(np.float32)))
+        assert abs(np.asarray(step._arrays[0]) - w0).max() > 0
+
+    def test_dist_model_consumes_gradient_merge(self):
+        import numpy as np
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.optimizer as optim
+        m = self._make()
+        opt = optim.SGD(learning_rate=0.1, parameters=m.parameters())
+        strategy = dist.Strategy({"gradient_merge": {"enable": True,
+                                                     "k_steps": 2}})
+        dm = dist.to_static(m, loss=lambda o, y: F.mse_loss(o, y),
+                            optimizer=opt, strategy=strategy)
+        assert dm._accumulate_steps == 2
+        step = dm._get_train_step()
+        assert step.accumulate_steps == 2
+
+    def test_k_steps_validation(self):
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.jit import TrainStep
+        m = self._make()
+        opt = optim.SGD(learning_rate=0.1, parameters=m.parameters())
+        with pytest.raises(ValueError):
+            TrainStep(m, lambda o, y: F.mse_loss(o, y), opt,
+                      accumulate_steps=0)
+
+    def test_fp32_accumulators_with_master_weights(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.jit import TrainStep
+        m = self._make()
+        for p in m.parameters():
+            p._data = p._data.astype(jnp.bfloat16)
+        opt = optim.AdamW(learning_rate=0.1, parameters=m.parameters(),
+                          multi_precision=True)
+        step = TrainStep(m, lambda o, y: F.mse_loss(o, y), opt,
+                         accumulate_steps=4)
+        assert all(a.dtype == jnp.float32 for a in step._grad_accum)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32).astype(np.float32))
+        y = paddle.to_tensor(np.ones((2, 2), np.float32))
+        for _ in range(4):
+            step(x.astype("bfloat16"), y)
+        assert all(a.dtype == jnp.float32 for a in step._grad_accum)
