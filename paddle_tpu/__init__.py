@@ -62,6 +62,12 @@ from . import incubate  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+# stft/istft live in the signal module; the reference patches them onto
+# Tensor too
+Tensor.stft = lambda self, *a, **k: signal.stft(self, *a, **k)
+Tensor.istft = lambda self, *a, **k: signal.istft(self, *a, **k)
+Tensor.create_parameter = staticmethod(
+    lambda *a, **k: create_parameter(*a, **k))
 from . import geometric  # noqa: F401
 from . import sparse  # noqa: F401
 from . import profiler  # noqa: F401

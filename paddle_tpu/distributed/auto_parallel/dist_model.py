@@ -16,6 +16,7 @@ over those placements.
 """
 from __future__ import annotations
 
+from builtins import bool as builtins_bool
 from typing import Optional
 
 from ...framework.tape import no_grad
@@ -42,7 +43,7 @@ class Strategy:
                                  enable=False, schedule_mode="1F1B",
                                  accumulate_steps=1)
         self.gradient_merge = _Section(config.get("gradient_merge", {}),
-                                       enable=False, k_steps=1)
+                                       enable=False, k_steps=1, avg=True)
 
 
 class _Section:
@@ -87,6 +88,8 @@ class DistModel:
         self._accumulate_steps = (
             int(self._strategy.gradient_merge.k_steps)
             if self._strategy.gradient_merge.enable else 1)
+        self._accumulate_avg = builtins_bool(
+            getattr(self._strategy.gradient_merge, "avg", True))
         if self._strategy.sharding.enable and optimizer is not None:
             from ..fleet.sharding import group_sharded_parallel
             stage = self._strategy.sharding.stage
@@ -142,7 +145,8 @@ class DistModel:
             self._train_step = TrainStep(
                 self.network, self._loss_fn, self._optimizer,
                 amp_level=self._amp_level, amp_dtype=self._amp_dtype,
-                accumulate_steps=self._accumulate_steps)
+                accumulate_steps=self._accumulate_steps,
+                accumulate_avg=self._accumulate_avg)
         return self._train_step
 
     def _get_eval_fn(self):

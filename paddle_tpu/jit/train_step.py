@@ -59,7 +59,7 @@ class TrainStep:
 
     def __init__(self, model, loss_fn: Callable, optimizer,
                  amp_level: str = "O0", amp_dtype: str = "bfloat16",
-                 accumulate_steps: int = 1):
+                 accumulate_steps: int = 1, accumulate_avg: bool = True):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -70,6 +70,9 @@ class TrainStep:
         # update applies on every k-th call via lax.cond INSIDE the
         # compiled program — one executable, no per-branch recompiles
         self.accumulate_steps = int(accumulate_steps)
+        # reference gradient_merge 'avg' knob: True -> mean of the k
+        # micro-grads, False -> their sum
+        self.accumulate_avg = bool(accumulate_avg)
         if self.accumulate_steps < 1:
             raise ValueError(
                 f"accumulate_steps (gradient_merge k_steps) must be >= 1, "
@@ -194,7 +197,8 @@ class TrainStep:
                     arrays_, states_, masters_, summed_ = operand
                     # back to the grad dtype the update rule expects (the
                     # K=1 path feeds raw param-dtype grads)
-                    avg = apply_clip([(g / K).astype(a.dtype)
+                    denom = K if self.accumulate_avg else 1
+                    avg = apply_clip([(g / denom).astype(a.dtype)
                                       for g, a in zip(summed_, arrays_)])
                     na, ns, nm = update_fn(lr, stepno, arrays_, avg,
                                            states_, masters_)

@@ -176,7 +176,11 @@ _METHODS = [
     "norm", "dist", "cholesky", "inv", "pinv", "det",
 ]
 
-_NAMESPACES = [_math, _manip, _logic, _search, _creation, linalg]
+from . import extra_ops as _extra_ops
+from . import array_api as _array_api
+
+_NAMESPACES = [_math, _manip, _logic, _search, _creation, linalg,
+               _extra_ops, _array_api]
 
 
 def _find_fn(name):
@@ -235,6 +239,58 @@ def monkey_patch_tensor():
         fn = _find_fn(name)
         if fn is not None:
             setattr(Tensor, name + "_", _make_inplace(fn))
+    # the rest of the reference's patched-method surface: every name the
+    # reference's tensor/__init__ exposes on Tensor whose function exists
+    # in our namespaces (python/paddle/tensor/__init__.py
+    # tensor_method_func registry)
+    for name in _REF_EXTRA_METHODS:
+        if hasattr(Tensor, name):
+            continue
+        fn = _find_fn(name)
+        if fn is not None:
+            setattr(Tensor, name, _make_method(fn))
 
+
+_REF_EXTRA_METHODS = [
+    "acos_", "acosh_", "add_n", "addmm_", "as_complex", "as_real",
+    "asin_", "asinh_", "atan_", "atanh_", "atleast_1d", "atleast_2d",
+    "atleast_3d", "bernoulli_", "bitwise_and_", "bitwise_invert",
+    "bitwise_invert_", "bitwise_left_shift", "bitwise_left_shift_",
+    "bitwise_not_", "bitwise_or_", "bitwise_right_shift",
+    "bitwise_right_shift_", "bitwise_xor_", "block_diag",
+    "broadcast_shape", "broadcast_tensors", "bucketize", "cauchy_",
+    "cdist", "cholesky_inverse", "cholesky_solve", "cond", "copysign",
+    "copysign_", "corrcoef", "cos_", "cosh_", "cov", "cummax", "cummin",
+    "cumprod_", "cumsum_", "cumulative_trapezoid", "diag_embed",
+    "diagflat", "diagonal_scatter", "digamma_", "dsplit", "eig",
+    "eigvals", "eigvalsh", "equal_", "erfinv_", "exponential_",
+    "floor_divide_", "floor_mod", "floor_mod_", "frac_", "frexp",
+    "gammainc", "gammainc_", "gammaincc", "gammaincc_", "gammaln",
+    "gammaln_", "gcd", "gcd_", "geometric_", "greater_equal_",
+    "greater_than_", "histogram_bin_edges", "histogramdd",
+    "householder_product", "hsplit", "hypot", "hypot_", "i0", "i0_",
+    "i0e", "i1", "i1e", "increment", "index_fill", "index_fill_",
+    "index_put", "index_put_", "inverse", "is_complex", "is_empty",
+    "is_floating_point", "is_integer", "is_tensor", "isin", "isneginf",
+    "isposinf", "isreal", "istft", "lcm", "lcm_", "ldexp", "ldexp_",
+    "less", "less_", "less_equal_", "less_than_", "lgamma_", "log10_",
+    "log1p_", "log2_", "log_", "log_normal_", "logaddexp",
+    "logcumsumexp", "logical_and_", "logical_not_", "logical_or_",
+    "logical_xor_", "logit_", "lstsq", "lu", "lu_unpack",
+    "masked_scatter", "masked_scatter_", "matrix_power", "mod_",
+    "multi_dot", "multigammaln", "multigammaln_", "multinomial",
+    "multiplex", "nan_to_num_", "nanmedian", "nanquantile", "nextafter",
+    "normal_", "not_equal_", "ormqr", "pca_lowrank", "polar",
+    "polygamma", "polygamma_", "put_along_axis_", "qr", "rank",
+    "reduce_as", "renorm", "renorm_", "reverse", "scatter_",
+    "scatter_nd", "select_scatter", "set_", "sgn", "shard_index",
+    "signbit", "sin_", "sinc", "sinc_", "sinh_", "slice_scatter",
+    "solve", "square_", "stack", "stanh", "stft", "strided_slice",
+    "svd_lowrank", "t", "t_", "take", "tan_", "tensor_split",
+    "top_p_sampling", "transpose_", "trapezoid", "triangular_solve",
+    "tril_", "triu_", "unflatten", "unfold", "uniform_",
+    "unique_consecutive", "vander", "view", "view_as", "vsplit",
+    "where_", "as_strided", "create_tensor", "create_parameter",
+]
 
 monkey_patch_tensor()

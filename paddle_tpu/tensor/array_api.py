@@ -348,6 +348,63 @@ def bernoulli_(x, p=0.5, name=None):
         x, jax.random.bernoulli(key, p, x._data.shape).astype(jnp.float32))
 
 
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    """In-place fill with U(min, max) (reference Tensor.uniform_)."""
+    key = _random.split_key()
+    return _fill_inplace(
+        x, jax.random.uniform(key, x._data.shape, jnp.float32, min, max))
+
+
+def set_(x, source=None, shape=None, stride=None, offset=0, name=None):
+    """reference: Tensor.set_ — re-point x at source's storage (a copy
+    here: functional arrays have no aliasing views).  ``shape`` without
+    ``stride`` is a contiguous view of source storage starting at
+    ``offset``."""
+    if source is None:
+        x._data = jnp.zeros(tuple(shape or [0]), x._data.dtype)
+        return x
+    data = source._data if isinstance(source, Tensor) \
+        else jnp.asarray(source)
+    if shape is not None:
+        if stride is not None:
+            data = as_strided(wrap_array(data), shape, stride,
+                              offset)._data
+        else:
+            n = int(np.prod(shape)) if len(shape) else 1
+            data = data.reshape(-1)[offset:offset + n].reshape(
+                tuple(shape))
+    elif offset:
+        data = data.reshape(-1)[offset:]
+    x._data = data
+    return x
+
+
+@def_op("as_strided")
+def as_strided(x, shape, stride, offset=0, name=None):
+    """reference: Tensor.as_strided — strided view materialized by a
+    gather (XLA arrays have no stride metadata; the index arithmetic
+    reproduces the view's element mapping)."""
+    flat = x.reshape(-1)
+    idx = jnp.full((), int(offset), jnp.int32)
+    for n, s in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(n, dtype=jnp.int32) * int(s)
+    return flat[idx]
+
+
+def rank(x, name=None):
+    """reference: paddle.rank — 0-D tensor holding ndim."""
+    import numpy as _np2
+    from ..framework.tensor import to_tensor
+    return to_tensor(_np2.asarray(x.ndim, _np2.int32))
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    """reference: paddle.create_tensor — an empty typed tensor var."""
+    import numpy as _np2
+    from ..framework.tensor import to_tensor
+    return to_tensor(_np2.zeros(0, dtypes.convert_dtype(dtype)))
+
+
 # ------------------------------------------------------------------- aliases
 less = _logic.less_than
 
@@ -381,7 +438,9 @@ def _lookup(name):
 # in-place variant (reference: inplace api generation in
 # python/paddle/tensor/__init__.py tensor_method_func registry)
 _INPLACE_NAMES = [
-    "abs", "acos", "addmm", "asin", "atan", "bitwise_and", "bitwise_not",
+    "abs", "acos", "acosh", "addmm", "asin", "asinh", "atan", "atanh",
+    "erfinv", "not_equal", "index_put", "index_fill", "put_along_axis",
+    "bitwise_and", "bitwise_not",
     "bitwise_or", "bitwise_xor", "bitwise_left_shift", "bitwise_right_shift",
     "cast", "ceil", "clip", "copysign", "cos", "cosh", "cumprod", "cumsum",
     "digamma", "divide", "equal", "erf", "exp", "expm1", "fill_diagonal",
@@ -450,6 +509,7 @@ __all__ = ([
     "log_normal", "normal_", "log_normal_", "cauchy_", "geometric_",
     "bernoulli_", "less", "t_", "exponential_", "floor_mod_", "mod_",
     "bitwise_invert", "bitwise_invert_", "multigammaln_", "where_",
+    "uniform_", "set_", "as_strided", "rank", "create_tensor",
 ] + _generated)
 
 multigammaln_ = _module_inplace(multigammaln)

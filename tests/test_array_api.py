@@ -313,3 +313,42 @@ class TestReviewRegressions2:
         assert paddle.take(x, t(np.array([-1])),
                            mode="clip").numpy().tolist() == [0]
         assert paddle.take(x, t(np.array([-1]))).numpy().tolist() == [11]
+
+
+class TestTensorMethodSurface:
+    def test_no_missing_tensor_methods(self):
+        t_ = t(np.array([1.0]))
+        ref = open("/root/reference/python/paddle/tensor/"
+                   "__init__.py").read()
+        names = sorted(set(re.findall(r"^\s+'(\w+)',?$", ref, re.M)))
+        missing = [n for n in names if not hasattr(t_, n)]
+        assert missing == [], missing
+
+    def test_method_forms_route_to_functions(self):
+        a = t(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert a.t().shape == [3, 2]
+        assert a.take(t(np.array([5]))).numpy().tolist() == [5]
+        assert len(a.tensor_split(3, axis=1)) == 3
+        assert int(a.rank().numpy()) == 2
+
+    def test_inplace_methods(self):
+        x = t(np.array([-1.0, 4.0]))
+        assert x.abs_() is x and x.numpy().tolist() == [1.0, 4.0]
+        u = t(np.zeros(500, np.float32))
+        u.uniform_(0.0, 2.0)
+        assert 0 <= u.numpy().min() and u.numpy().max() <= 2
+
+    def test_set_and_as_strided(self):
+        s = t(np.zeros(3, np.float32))
+        s.set_(t(np.ones((2, 2), np.float32)))
+        assert s.shape == [2, 2]
+        a = t(np.arange(9, dtype=np.float32))
+        assert paddle.as_strided(a, [2, 3], [3, 1]).numpy().tolist() == \
+            [[0, 1, 2], [3, 4, 5]]
+        # overlapping strided view
+        assert paddle.as_strided(a, [3, 3], [2, 1]).numpy()[1].tolist() \
+            == [2, 3, 4]
+
+    def test_stft_method(self):
+        sig = t(np.sin(np.linspace(0, 100, 512)).astype(np.float32))
+        assert sig.stft(n_fft=64).ndim == 2
