@@ -16,7 +16,6 @@ over those placements.
 """
 from __future__ import annotations
 
-from builtins import bool as builtins_bool
 from typing import Optional
 
 from ...framework.tape import no_grad
@@ -88,8 +87,7 @@ class DistModel:
         self._accumulate_steps = (
             int(self._strategy.gradient_merge.k_steps)
             if self._strategy.gradient_merge.enable else 1)
-        self._accumulate_avg = builtins_bool(
-            getattr(self._strategy.gradient_merge, "avg", True))
+        self._accumulate_avg = bool(self._strategy.gradient_merge.avg)
         if self._strategy.sharding.enable and optimizer is not None:
             from ..fleet.sharding import group_sharded_parallel
             stage = self._strategy.sharding.stage

@@ -126,3 +126,194 @@ distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
 worker_index = lambda: get_rank()  # noqa: E731
 worker_num = lambda: get_world_size()  # noqa: E731
+
+
+class Role:
+    """reference: fleet/base/role_maker.py Role enum."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class _RoleMakerBase:
+    """Shared role-maker surface (reference: role_maker.py
+    RoleMakerBase): who am I, how many of each role, endpoints."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        self._role = kwargs.get("current_id_role", Role.WORKER)
+
+    def _worker_index(self):
+        return get_rank()
+
+    worker_index = _worker_index
+
+    def _worker_num(self):
+        return get_world_size()
+
+    worker_num = _worker_num
+
+    def _is_first_worker(self):
+        return get_rank() == 0
+
+    is_first_worker = _is_first_worker
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    is_worker = _is_worker
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    is_server = _is_server
+
+    def _get_trainer_endpoints(self):
+        import os
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    get_trainer_endpoints = _get_trainer_endpoints
+
+
+class PaddleCloudRoleMaker(_RoleMakerBase):
+    """reference: role_maker.py PaddleCloudRoleMaker — roles resolved
+    from the launcher env contract (PADDLE_TRAINER_ID / TRAINERS_NUM /
+    PADDLE_PORT...)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        import os
+        super().__init__(is_collective, **kwargs)
+        training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        self._role = Role.SERVER if training_role == "PSERVER" \
+            else Role.WORKER
+
+
+class UserDefinedRoleMaker(_RoleMakerBase):
+    """reference: role_maker.py UserDefinedRoleMaker — roles given
+    explicitly by the caller."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        super().__init__(is_collective, **kwargs)
+        self._kwargs = kwargs
+        self._role = kwargs.get("role", kwargs.get("current_id_role",
+                                                   Role.WORKER))
+        self._worker_endpoints = kwargs.get("worker_endpoints", [])
+        self._server_endpoints = kwargs.get("server_endpoints", [])
+        self._current_id = kwargs.get("current_id", 0)
+
+    def _worker_index(self):
+        return self._current_id
+
+    worker_index = _worker_index
+
+    def _worker_num(self):
+        return max(len(self._worker_endpoints), 1)
+
+    worker_num = _worker_num
+
+    def _get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    get_trainer_endpoints = _get_trainer_endpoints
+
+    def _is_first_worker(self):
+        return self._current_id == 0
+
+    is_first_worker = _is_first_worker
+
+
+class UtilBase:
+    """reference: fleet/utils/fleet_util.py UtilBase — small cross-worker
+    helpers over the collective/store substrate."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+        from ..collective import all_reduce as _ar, ReduceOp
+        from ...framework.tensor import to_tensor
+        t = to_tensor(np.asarray(input))
+        _ar(t, op={"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+                   "min": ReduceOp.MIN}[mode])
+        return t.numpy()
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier as _barrier
+        _barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from ..collective import all_gather_object
+        out = []
+        all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files):
+        """Split a file list contiguously across workers (reference:
+        UtilBase.get_file_shard)."""
+        rank, world = get_rank(), max(get_world_size(), 1)
+        import os
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", world))
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", rank))
+        n = len(files)
+        base, rem = divmod(n, world)
+        start = rank * base + min(rank, rem)
+        return files[start:start + base + (1 if rank < rem else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        if get_rank() == rank_id:
+            print(message)
+
+
+class MultiSlotDataGenerator:
+    """reference: fleet/data_generator — user subclasses implement
+    ``generate_sample(line)`` yielding [(slot_name, [values]), ...];
+    ``run_from_stdin``/``run_from_files`` emit the slot wire format the
+    datasets consume."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass MultiSlotDataGenerator and implement "
+            "generate_sample(line) returning an iterator of "
+            "[(slot_name, values), ...]")
+
+    def _format(self, sample):
+        parts = []
+        for _name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_files(self, filelist, output_prefix="part"):
+        outputs = []
+        for i, path in enumerate(filelist):
+            out_path = f"{output_prefix}-{i:05d}"
+            with open(path) as fin, open(out_path, "w") as fout:
+                for line in fin:
+                    gen = self.generate_sample(line.rstrip("\n"))
+                    if gen is None:
+                        continue
+                    for sample in (gen() if callable(gen) else gen):
+                        fout.write(self._format(sample) + "\n")
+            outputs.append(out_path)
+        return outputs
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            gen = self.generate_sample(line.rstrip("\n"))
+            if gen is None:
+                continue
+            for sample in (gen() if callable(gen) else gen):
+                sys.stdout.write(self._format(sample) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-slot variant (reference: MultiSlotStringDataGenerator)."""
+
+
+# reference exposes the singleton type too
+Fleet = _Fleet
+util = UtilBase()

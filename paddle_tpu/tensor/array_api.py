@@ -349,8 +349,9 @@ def bernoulli_(x, p=0.5, name=None):
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
-    """In-place fill with U(min, max) (reference Tensor.uniform_)."""
-    key = _random.split_key()
+    """In-place fill with U(min, max) (reference Tensor.uniform_);
+    a nonzero seed draws deterministically from that seed."""
+    key = jax.random.PRNGKey(seed) if seed else _random.split_key()
     return _fill_inplace(
         x, jax.random.uniform(key, x._data.shape, jnp.float32, min, max))
 
@@ -361,7 +362,8 @@ def set_(x, source=None, shape=None, stride=None, offset=0, name=None):
     ``stride`` is a contiguous view of source storage starting at
     ``offset``."""
     if source is None:
-        x._data = jnp.zeros(tuple(shape or [0]), x._data.dtype)
+        x._data = jnp.zeros((0,) if shape is None else tuple(shape),
+                            x._data.dtype)
         return x
     data = source._data if isinstance(source, Tensor) \
         else jnp.asarray(source)
@@ -383,7 +385,26 @@ def set_(x, source=None, shape=None, stride=None, offset=0, name=None):
 def as_strided(x, shape, stride, offset=0, name=None):
     """reference: Tensor.as_strided — strided view materialized by a
     gather (XLA arrays have no stride metadata; the index arithmetic
-    reproduces the view's element mapping)."""
+    reproduces the view's element mapping).  Bounds are validated
+    statically — JAX gather would otherwise clamp out-of-range indices
+    and return plausible-looking wrong data."""
+    if len(shape) != len(stride):
+        raise ValueError(
+            f"as_strided: shape ({len(shape)} dims) and stride "
+            f"({len(stride)} dims) must have equal length")
+    total = 1
+    for d in x.shape:
+        total *= d
+    hi = int(offset) + builtins.sum(
+        (int(n) - 1) * int(s) for n, s in zip(shape, stride)
+        if int(s) > 0 and int(n) > 0)
+    lo = int(offset) + builtins.sum(
+        (int(n) - 1) * int(s) for n, s in zip(shape, stride)
+        if int(s) < 0 and int(n) > 0)
+    if lo < 0 or hi >= max(total, 1):
+        raise ValueError(
+            f"as_strided: view spans flat indices [{lo}, {hi}] outside "
+            f"the {total}-element storage")
     flat = x.reshape(-1)
     idx = jnp.full((), int(offset), jnp.int32)
     for n, s in zip(shape, stride):

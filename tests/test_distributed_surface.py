@@ -232,3 +232,54 @@ class TestMPSplit:
             assert emb_out.shape == [1, 2, 4]
         finally:
             d.set_mesh(None)
+
+
+class TestFleetSurface:
+    def test_fleet_exports_complete(self):
+        import re
+        import paddle_tpu.distributed.fleet as fleet
+        ref = open("/root/reference/python/paddle/distributed/fleet/"
+                   "__init__.py").read()
+        names = sorted(
+            set(re.findall(r'^\s+"(\w+)",?$', ref, re.M))
+            | set(re.findall(r"^\s+'(\w+)',?$", ref, re.M)))
+        assert [n for n in names if not hasattr(fleet, n)] == []
+
+    def test_util_file_shard(self, monkeypatch):
+        import paddle_tpu.distributed.fleet as fleet
+        u = fleet.UtilBase()
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "3")
+        files = [f"f{i}" for i in range(7)]
+        shards = []
+        for r in range(3):
+            monkeypatch.setenv("PADDLE_TRAINER_ID", str(r))
+            shards.append(u.get_file_shard(files))
+        assert sum(shards, []) == files          # partition, in order
+        assert [len(s) for s in shards] == [3, 2, 2]
+
+    def test_role_makers(self):
+        import paddle_tpu.distributed.fleet as fleet
+        rm = fleet.PaddleCloudRoleMaker()
+        assert rm.is_worker() and not rm.is_server()
+        um = fleet.UserDefinedRoleMaker(
+            current_id=2, worker_endpoints=["a", "b", "c"],
+            role=fleet.Role.WORKER)
+        assert um.worker_index() == 2 and um.worker_num() == 3
+        assert um.get_trainer_endpoints() == ["a", "b", "c"]
+
+    def test_data_generator(self, tmp_path, monkeypatch):
+        import paddle_tpu.distributed.fleet as fleet
+
+        class Gen(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    vals = [int(v) for v in line.split()]
+                    yield [("ids", vals), ("label", [vals[0] % 2])]
+                return it
+
+        src = tmp_path / "in.txt"
+        src.write_text("1 2 3\n4 5 6\n")
+        monkeypatch.chdir(tmp_path)
+        outs = Gen().run_from_files([str(src)])
+        lines = open(outs[0]).read().strip().splitlines()
+        assert lines == ["3 1 2 3 1 1", "3 4 5 6 1 0"]
