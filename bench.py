@@ -320,6 +320,12 @@ def main():
         head = {"metric": "llama_110m_pretrain_tokens_per_sec_per_chip",
                 "value": 0.0, "unit": "tokens/sec", "vs_baseline": 0.0}
     head["device"] = "tpu" if on_tpu else "cpu"
+    if not on_tpu:
+        head["note"] = (
+            "TPU unreachable at capture time (accelerator probe failed/"
+            "timed out); numbers are the CPU fallback at tiny shapes, not "
+            "comparable with TPU rounds — see BENCH_r01 for the last "
+            "TPU-measured figure")
     head["suite"] = suite
     if errors:
         head["errors"] = errors
