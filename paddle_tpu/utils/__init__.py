@@ -7,3 +7,92 @@ try:  # guard: requires a host toolchain
     from . import cpp_extension  # noqa: F401
 except Exception:  # pragma: no cover
     pass
+
+from . import dlpack  # noqa: E402,F401
+from . import unique_name  # noqa: E402,F401
+
+
+def try_import(module_name, err_msg=None):
+    """reference: utils/lazy_import.py try_import."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"optional dependency {module_name!r} could not "
+                       f"be imported: {e}") from e
+
+
+class VersionError(Exception):
+    """Raised when the installed version is outside the required range."""
+
+
+def require_version(min_version, max_version=None):
+    """reference: utils/__init__.py require_version — validate the
+    installed framework version is within range.  Tuples are zero-padded
+    to equal length; non-numeric segments (rc/dev suffixes) compare by
+    their leading digits."""
+    import re as _re
+    import paddle_tpu
+
+    def _tuple(v):
+        out = []
+        for seg in str(v).split(".")[:3]:
+            m = _re.match(r"\d+", seg)
+            out.append(int(m.group()) if m else 0)
+        while len(out) < 3:
+            out.append(0)
+        return tuple(out)
+
+    cur = _tuple(paddle_tpu.__version__)
+    if _tuple(min_version) > cur:
+        raise VersionError(
+            f"version {paddle_tpu.__version__} < required {min_version}")
+    if max_version is not None and _tuple(max_version) < cur:
+        raise VersionError(
+            f"version {paddle_tpu.__version__} > allowed {max_version}")
+    return True
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    """reference: utils/deprecated.py — level 1 warns on call, level 2
+    raises; level 0 is a no-op marker."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            msg = f"API {fn.__name__!r} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            if level >= 2:
+                raise RuntimeError(msg)
+            if level == 1:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return inner
+    return deco
+
+
+def run_check():
+    """reference: utils/install_check.py run_check — train one tiny step
+    to prove the install works (prints the verdict)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    model = nn.Linear(4, 2)
+    opt = optimizer.SGD(learning_rate=0.1,
+                        parameters=model.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    n = paddle.device_count()
+    print(f"paddle_tpu is installed successfully! ({n} device(s) "
+          f"available)")
+    return True

@@ -1,0 +1,16 @@
+"""paddle.utils.dlpack (reference: python/paddle/utils/dlpack.py) —
+the canonical home of the dlpack interop (top-level from_dlpack/to_dlpack
+alias here)."""
+from __future__ import annotations
+
+__all__ = ["from_dlpack", "to_dlpack"]
+
+
+def from_dlpack(ext):
+    import paddle_tpu
+    return paddle_tpu.from_dlpack(ext)
+
+
+def to_dlpack(x):
+    import paddle_tpu
+    return paddle_tpu.to_dlpack(x)

@@ -1,0 +1,49 @@
+"""paddle.utils.unique_name (reference: base/unique_name.py) — process-wide
+unique name generation with guard scopes."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _Generator:
+    def __init__(self):
+        self._counters = {}
+        self._lock = threading.Lock()
+
+    def generate(self, key: str) -> str:
+        with self._lock:
+            n = self._counters.get(key, 0)
+            self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+
+_generator = _Generator()
+
+
+def generate(key: str) -> str:
+    """reference: unique_name.generate — '<key>_<n>' with a per-key
+    monotonic counter."""
+    return _generator.generate(key)
+
+
+def switch(new_generator=None):
+    """reference: unique_name.switch — swap the generator, return the
+    old one."""
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """reference: unique_name.guard — fresh name scope for the block."""
+    old = switch(new_generator if isinstance(new_generator, _Generator)
+                 else None)
+    try:
+        yield
+    finally:
+        switch(old)

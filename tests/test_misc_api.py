@@ -205,3 +205,58 @@ class TestRNGTracker:
         with tr.rng_state("test-stream"):
             b = paddle.rand([4]).numpy()
         assert not np.allclose(a, b)   # stream state advances
+
+
+class TestUtilsSubmodules:
+    def test_dlpack_roundtrip(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.utils as u
+        a = paddle.to_tensor(np.arange(3, dtype=np.float32))
+        b = u.dlpack.from_dlpack(u.dlpack.to_dlpack(a))
+        np.testing.assert_allclose(b.numpy(), a.numpy())
+
+    def test_unique_name_guard(self):
+        import paddle_tpu.utils as u
+        base = u.unique_name.generate("scope_test")
+        n = int(base.rsplit("_", 1)[1])
+        with u.unique_name.guard():
+            assert u.unique_name.generate("scope_test") == "scope_test_0"
+        assert u.unique_name.generate("scope_test") == \
+            f"scope_test_{n + 1}"
+
+    def test_require_version(self):
+        import pytest as _pytest
+        import paddle_tpu.utils as u
+        assert u.require_version("0.0.1")
+        with _pytest.raises(u.VersionError, match="required"):
+            u.require_version("999.0.0")
+        # zero-padding: a shorter ceiling that matches must pass
+        assert u.require_version("0.0.1", max_version="0.1")
+        # suffixed versions parse by their leading digits
+        assert u.require_version("0.0.1rc1")
+
+    def test_deprecated_warns(self):
+        import warnings
+        import paddle_tpu.utils as u
+
+        @u.deprecated(update_to="new_api", since="0.1")
+        def old():
+            return 7
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old() == 7
+            assert any("deprecated" in str(x.message) for x in w)
+
+    def test_try_import(self):
+        import pytest as _pytest
+        import paddle_tpu.utils as u
+        assert u.try_import("json") is not None
+        with _pytest.raises(ImportError):
+            u.try_import("definitely_not_a_module_xyz")
+
+    def test_run_check(self, capsys):
+        import paddle_tpu.utils as u
+        assert u.run_check()
+        assert "successfully" in capsys.readouterr().out
