@@ -155,13 +155,19 @@ def _call_op_impl(name: str, fn: Callable, args: tuple, kwargs: dict):
     # TensorWrappers only for inputs needed by the grad node).
     diff_arrays = [arrays[p] for p in diff_pos]
 
-    def _pure(*diff_args):
-        full = list(arrays)
-        for p, a in zip(diff_pos, diff_args):
-            full[p] = a
-        return _call_with(full)
+    cached = _cached_grad_call(name, fn, leaves, treedef, tensor_idx,
+                               diff_pos, arrays) \
+        if get_flag("eager_cached_grad") else None
+    if cached is not None:
+        out_arrays, vjp_fn = cached
+    else:
+        def _pure(*diff_args):
+            full = list(arrays)
+            for p, a in zip(diff_pos, diff_args):
+                full[p] = a
+            return _call_with(full)
 
-    out_arrays, vjp_fn = jax.vjp(_pure, *diff_arrays)
+        out_arrays, vjp_fn = jax.vjp(_pure, *diff_arrays)
 
     edges = []
     for p in diff_pos:
@@ -249,6 +255,113 @@ def _apply_spmd_rule(name, leaves, tensor_idx, treedef, result):
             print(f"WARNING: spmd rule for op '{name}' failed:")
             traceback.print_exc()
         return
+
+
+# --------------------------------------------------------------------------
+# FLAGS_eager_cached_grad: compile-cached eager autograd.  The default
+# record path runs jax.vjp per op call — two Python traces of the op every
+# step (~0.5 ms for a small op).  With the flag on, forward and backward
+# are jitted ONCE per (op, input signature) and replayed from the compile
+# cache; the backward recomputes the forward inside its jit (op-level
+# rematerialization — the TPU-native trade: FLOPs are cheap, Python
+# dispatch is the eager bottleneck).  Off by default: identical numerics,
+# but op-level remat changes the eager memory/compute profile.
+# --------------------------------------------------------------------------
+_GRAD_CACHE: Dict[Any, Any] = {}
+_GRAD_CACHE_CAP = 1024
+
+
+def _cached_grad_call(name, fn, leaves, treedef, tensor_idx, diff_pos,
+                      arrays):
+    """(out_arrays, vjp_fn) via per-signature jitted fwd/bwd, or None when
+    the call signature isn't hashable (fall back to plain jax.vjp)."""
+    static_leaves = [None if _is_tensor(leaf) else leaf for leaf in leaves]
+    try:
+        # id(fn) distinguishes re-registrations of the same op name; the
+        # entry's closures pin fn alive, so the id cannot be recycled
+        # while its entry exists
+        key = (name, id(fn), treedef, tuple(tensor_idx), tuple(diff_pos),
+               tuple((a.shape, str(a.dtype)) for a in arrays),
+               tuple((i, s) for i, s in enumerate(static_leaves)
+                     if s is not None))
+        hash(key)
+    except TypeError:
+        return None
+
+    entry = _GRAD_CACHE.get(key)
+    if entry is None:
+        if len(_GRAD_CACHE) >= _GRAD_CACHE_CAP:
+            _GRAD_CACHE.clear()
+        # close over the BUILD-time static leaves/treedef — equal keys
+        # guarantee they match this call's.  Tensor positions are blanked:
+        # they are always overwritten by _apply, and keeping the first
+        # call's Tensors would pin its activations for the cache lifetime.
+        build_leaves = list(leaves)
+        for i in tensor_idx:
+            build_leaves[i] = None
+        build_treedef = treedef
+        build_tensor_idx = list(tensor_idx)
+        build_diff_pos = list(diff_pos)
+
+        def _apply(arrs):
+            new_leaves = list(build_leaves)
+            for i, a in zip(build_tensor_idx, arrs):
+                new_leaves[i] = a
+            a2, k2 = jtu.tree_unflatten(build_treedef, new_leaves)
+            return fn(*a2, **k2)
+
+        def _make_bwd(f0_meta, ct_tree):
+            # f0_meta: ((leaf_index, shape), ...) of float0 cotangents
+            # (integer outputs).  float0 arrays have no XLA buffer form,
+            # so they are rebuilt INSIDE the trace as constants instead
+            # of being passed as jit arguments.
+            f0_idx = {i for i, _ in f0_meta}
+
+            def _bwd(arrs, live_cts):
+                full, it = [], iter(live_cts)
+                n_leaves = len(f0_meta) + len(live_cts)
+                shapes = dict(f0_meta)
+                for i in range(n_leaves):
+                    if i in f0_idx:
+                        import numpy as _np
+                        full.append(_np.zeros(shapes[i],
+                                              jax.dtypes.float0))
+                    else:
+                        full.append(next(it))
+                cts = jtu.tree_unflatten(ct_tree, full)
+
+                def pure_diff(*diff_args):
+                    fully = list(arrs)
+                    for p, a in zip(build_diff_pos, diff_args):
+                        fully[p] = a
+                    return _apply(fully)
+
+                diff = [arrs[p] for p in build_diff_pos]
+                return jax.vjp(pure_diff, *diff)[1](cts)
+
+            return jax.jit(_bwd)
+
+        entry = (jax.jit(_apply), {}, _make_bwd)
+        _GRAD_CACHE[key] = entry
+
+    fwd_jit, bwd_cache, make_bwd = entry
+    out_arrays = fwd_jit(arrays)
+
+    def vjp_fn(cts):
+        ct_leaves, ct_tree = jtu.tree_flatten(cts)
+        f0_meta = tuple(
+            (i, tuple(c.shape))
+            for i, c in enumerate(ct_leaves)
+            if getattr(c, "dtype", None) == jax.dtypes.float0)
+        live = [c for i, c in enumerate(ct_leaves)
+                if getattr(c, "dtype", None) != jax.dtypes.float0]
+        bkey = (f0_meta, ct_tree)
+        bwd = bwd_cache.get(bkey)
+        if bwd is None:
+            bwd = bwd_cache[bkey] = make_bwd(f0_meta, ct_tree)
+        return bwd(arrays, live)
+
+    return out_arrays, vjp_fn
 
 
 def _wrap_outputs(out):

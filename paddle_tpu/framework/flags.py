@@ -95,3 +95,8 @@ define_flag("use_stride_kernel", False, "kept for API parity; strides are XLA-in
 define_flag("allocator_strategy", "pjrt", "memory is owned by PJRT on TPU; informational")
 define_flag("tracer_mgpu_memory_fraction", 1.0, "informational on TPU")
 define_flag("comm_timeout_seconds", 600, "collective watchdog timeout (host-side)")
+
+define_flag("eager_cached_grad", False,
+            "compile-cache eager autograd per (op, signature): jitted "
+            "fwd/bwd replayed from cache, backward rematerializes the "
+            "forward (see dispatch._cached_grad_call)")
