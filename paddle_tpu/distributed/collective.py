@@ -344,7 +344,7 @@ def _mp_reduce(tensor, dst, op, group=None):
             acc = _np_combine(acc, np.asarray(buf.numpy()), opname)
         if opname == "avg":
             acc = acc / len(peers)
-        tensor._data = jnp.asarray(acc)
+        tensor._data = jnp.asarray(acc).astype(tensor._data.dtype)
     else:
         p2p.send(tensor, dst=dst, tag=tag)
     return tensor
@@ -516,28 +516,34 @@ def all_to_all(out_tensor_list, in_tensor_list,
         new_dim = 1 if (isinstance(cur, Shard) and cur.dim == 0) else 0
         placements[axis_idx] = Shard(new_dim)
         return reshard(x, mesh, placements)
-    world = _host_world()
-    if world > 1:
-        # real rank-to-rank exchange over the p2p substrate: rank i sends
-        # in_tensor_list[j] to rank j and receives slot i from every rank
+    if _host_world() > 1:
+        # real rank-to-rank exchange over the p2p substrate: group member
+        # at slot i sends in_tensor_list[j] to the member at slot j and
+        # receives slot i from every member.  Routed through _mp_peers so a
+        # subgroup only exchanges among its members (non-members return
+        # immediately instead of blocking in recv).
         from . import p2p
+        peers = _mp_peers(group)
         rank = _host_rank()
-        if len(in_tensor_list) != world:
+        if rank not in peers:
+            return []
+        if len(in_tensor_list) != len(peers):
             raise ValueError(
-                f"all_to_all needs one input tensor per rank "
-                f"({len(in_tensor_list)} != world {world})")
-        tag = _obj_key("a2a")
-        for j in range(world):
-            if j != rank:
-                p2p.send(in_tensor_list[j], dst=j, tag=tag)
+                f"all_to_all needs one input tensor per group rank "
+                f"({len(in_tensor_list)} != group size {len(peers)})")
+        me = peers.index(rank)
+        tag = _mp_tag("a2a", peers)
+        for j, dst in enumerate(peers):
+            if dst != rank:
+                p2p.send(in_tensor_list[j], dst=dst, tag=tag)
         parts = []
-        for i in range(world):
-            if i == rank:
-                parts.append(in_tensor_list[rank])
+        for i, src in enumerate(peers):
+            if src == rank:
+                parts.append(in_tensor_list[me])
             else:
                 t = in_tensor_list[i].clone() if hasattr(
                     in_tensor_list[i], "clone") else in_tensor_list[i]
-                parts.append(p2p.recv(t, src=i, tag=tag))
+                parts.append(p2p.recv(t, src=src, tag=tag))
         if out_tensor_list is not None:
             out_tensor_list.extend(parts)
         return parts
@@ -658,13 +664,20 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
         if out_tensor is not None:
             out_tensor._data = in_tensor._data
         return in_tensor
-    rank = _host_rank()
+    peers = _mp_peers(group)
+    if _host_rank() not in peers:
+        return in_tensor
+    nparts = len(peers)
     n = in_tensor.shape[0]
     if in_split_sizes is None:
-        in_split_sizes = [n // world] * world
+        if n % nparts != 0:
+            raise ValueError(
+                f"alltoall_single: dim 0 ({n}) not divisible by group "
+                f"size ({nparts}); pass in_split_sizes explicitly")
+        in_split_sizes = [n // nparts] * nparts
     offs = np.cumsum([0] + list(in_split_sizes))
     blocks = [in_tensor[int(offs[i]):int(offs[i + 1])]
-              for i in range(world)]
+              for i in range(nparts)]
     got = all_to_all(None, blocks, group, sync_op)
     from ..tensor.manipulation import concat as _concat
     res = _concat(got, axis=0)
@@ -685,11 +698,16 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
             gather_list.extend(out)
         return out
     from . import p2p
+    peers = _mp_peers(group)
     rank = _host_rank()
-    tag = _obj_key("gather")
+    if rank not in peers:
+        return None
+    if dst not in peers:
+        raise ValueError(f"gather dst {dst} is not in the group {peers}")
+    tag = _mp_tag("gath", peers)
     if rank == dst:
         parts = []
-        for src in range(world):
+        for src in peers:
             if src == rank:
                 parts.append(tensor)
             else:

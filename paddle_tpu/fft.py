@@ -73,13 +73,23 @@ def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
                          norm=_norm(norm))
 
 
+def _default_axes(nd, s, axes):
+    """reference contract: axes=None with s given means the LAST len(s)
+    axes, not all axes."""
+    if axes is None:
+        if s is None:
+            return list(range(nd))
+        if len(s) > nd:
+            raise ValueError(f"len(s)={len(s)} exceeds input ndim {nd}")
+        return list(range(nd - len(s), nd))
+    return [a % nd for a in axes]
+
+
 @def_op("hfftn")
 def hfftn(x, s=None, axes=None, norm="backward"):
     """reference: paddle.fft.hfftn — n-dim Hermitian FFT: inverse
     transforms over the leading axes, hfft over the last."""
-    import numpy as _np
-    nd = x.ndim
-    ax = list(range(nd)) if axes is None else [a % nd for a in axes]
+    ax = _default_axes(x.ndim, s, axes)
     lead, last = ax[:-1], ax[-1]
     y = x
     if lead:
@@ -92,8 +102,7 @@ def hfftn(x, s=None, axes=None, norm="backward"):
 @def_op("ihfftn")
 def ihfftn(x, s=None, axes=None, norm="backward"):
     """reference: paddle.fft.ihfftn — inverse of hfftn."""
-    nd = x.ndim
-    ax = list(range(nd)) if axes is None else [a % nd for a in axes]
+    ax = _default_axes(x.ndim, s, axes)
     lead, last = ax[:-1], ax[-1]
     y = jnp.fft.ihfft(x, n=None if s is None else s[-1], axis=last,
                       norm=_norm(norm))

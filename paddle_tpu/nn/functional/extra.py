@@ -411,41 +411,28 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
     """reference: F.fractional_max_pool2d — pseudo-random fractional
     pooling; with return_mask also the flat argmax per output cell."""
-    out = _fractional_max_pool2d(x, output_size, kernel_size, random_u)
+    from .pool_conv import _fractional_argmax_nd, _frac_u
+    u = _frac_u(random_u)   # one draw shared by value and mask paths
+    ks = None if kernel_size is None else _pair(kernel_size)
+    out = _fractional_max_pool2d(x, output_size, ks, u)
     if return_mask:
-        from .pool_conv import _fractional_argmax_nd
-        u = 0.5 if random_u is None else float(random_u)
-        return out, _fractional_argmax_nd(x, _pair(output_size), u)
+        return out, _fractional_argmax_nd(x, _pair(output_size), u, ks)
     return out
 
 
 @def_op("fractional_max_pool2d")
 def _fractional_max_pool2d(x, output_size, kernel_size=None,
-                           random_u=None):
-    """Pseudo-random fractional pooling (Graham 2014): bin edges from the
-    deterministic u when given (test mode) else evenly fractional.
-    Segment-max per axis — O(H*W) memory, not O(oh*ow*H*W)."""
+                           random_u=0.5):
+    """Pseudo-random fractional pooling (Graham 2014): bin edges from u.
+    Disjoint segment-max per axis without kernel_size (O(H*W) memory);
+    overlapping [start, start+k) windows with it."""
+    from .pool_conv import _frac_reduce_axis
     oh, ow = _pair(output_size)
-    N, C, H, W = x.shape
-    u = 0.5 if random_u is None else float(random_u)
-
-    def seg_ids(inp, out):
-        alpha = inp / out
-        starts = jnp.minimum(
-            jnp.floor(alpha * (jnp.arange(out) + u)).astype(jnp.int32),
-            inp - 1)
-        # row i belongs to the last bin whose start <= i
-        return jnp.searchsorted(starts, jnp.arange(inp), side="right") - 1
-
-    rid = jnp.clip(seg_ids(H, oh), 0, oh - 1)
-    cid = jnp.clip(seg_ids(W, ow), 0, ow - 1)
-    # reduce H: [N, C, H, W] -> [N, C, oh, W] via segment max
-    hx = jnp.moveaxis(x, 2, 0)                     # [H, N, C, W]
-    hred = jax.ops.segment_max(hx, rid, num_segments=oh)
-    hred = jnp.moveaxis(hred, 0, 2)                # [N, C, oh, W]
-    wx = jnp.moveaxis(hred, 3, 0)                  # [W, N, C, oh]
-    wred = jax.ops.segment_max(wx, cid, num_segments=ow)
-    return jnp.moveaxis(wred, 0, 3)                # [N, C, oh, ow]
+    u = float(random_u)
+    ks = (None, None) if kernel_size is None else _pair(kernel_size)
+    for axis, o, k in zip((2, 3), (oh, ow), ks):
+        x = _frac_reduce_axis(x, axis, o, u, k)
+    return x
 
 
 @def_op("affine_channel")

@@ -177,21 +177,42 @@ def _adaptive_argmax_nd(x, out_sizes):
     return _cells_argmax(x, seg)
 
 
-def _frac_segments(inp, out, u):
+def _frac_segments(inp, out, u, kernel=None):
     """Fractional-pooling partition of [0, inp) into `out` bins (the same
-    start formula as the segment-max impl in extra.py)."""
+    start formula as the segment-max impl in extra.py).  With ``kernel``
+    given, windows overlap: [start, start+kernel) instead of the disjoint
+    [start_i, start_{i+1})."""
     alpha = inp / out
     starts = np.minimum(np.floor(alpha * (np.arange(out) + u)).astype(int),
                         inp - 1)
     starts[0] = 0
-    ends = np.append(starts[1:], inp)
+    if kernel is not None:
+        # pin the last window to the input end (Graham 2014 interval
+        # generation) so trailing rows are always covered
+        starts[-1] = max(inp - int(kernel), 0)
+        ends = np.minimum(starts + int(kernel), inp)
+    else:
+        ends = np.append(starts[1:], inp)
     return starts, ends
 
 
+def _frac_u(random_u):
+    """The pseudo-random offset u ∈ [0, 1): the caller's deterministic value
+    (test mode) or a fresh draw from the framework RNG (reference: phi
+    fractional pool kernels draw per call when random_u is unset)."""
+    if random_u is not None:
+        return float(random_u)
+    import jax as _jax
+    from ...framework.random import split_key
+    return float(_jax.random.uniform(split_key(), ()))
+
+
 @def_op("fractional_argmax_nd")
-def _fractional_argmax_nd(x, out_sizes, u):
-    seg = [_frac_segments(n, o, u)
-           for n, o in zip(x.shape[2:], out_sizes)]
+def _fractional_argmax_nd(x, out_sizes, u, kernel_sizes=None):
+    if kernel_sizes is None:
+        kernel_sizes = (None,) * len(out_sizes)
+    seg = [_frac_segments(n, o, u, k)
+           for n, o, k in zip(x.shape[2:], out_sizes, kernel_sizes)]
     return _cells_argmax(x, seg)
 
 
@@ -225,38 +246,46 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
     """reference: F.fractional_max_pool3d; with return_mask also the flat
     argmax per output cell."""
-    out = _fractional_max_pool3d(x, output_size, kernel_size, random_u)
+    u = _frac_u(random_u)   # one draw shared by value and mask paths
+    ks = None if kernel_size is None else _norm_tuple(kernel_size, 3)
+    out = _fractional_max_pool3d(x, output_size, ks, u)
     if return_mask:
-        u = 0.5 if random_u is None else float(random_u)
-        return out, _fractional_argmax_nd(x, _norm_tuple(output_size, 3), u)
+        return out, _fractional_argmax_nd(x, _norm_tuple(output_size, 3),
+                                          u, ks)
     return out
 
 
 @def_op("fractional_max_pool3d")
-def _fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None):
-    """3-D pseudo-random fractional pooling — segment-max per axis, the
-    same O(D*H*W) scheme as the 2-D op (reference phi
-    fractional_max_pool3d kernel)."""
+def _fractional_max_pool3d(x, output_size, kernel_size=None, random_u=0.5):
+    """3-D pseudo-random fractional pooling — per-axis reduction, the same
+    O(D*H*W) scheme as the 2-D op (reference phi fractional_max_pool3d
+    kernel).  Disjoint segments without kernel_size; overlapping
+    [start, start+k) windows with it."""
     od, oh, ow = _norm_tuple(output_size, 3)
-    N, C, D, H, W = x.shape
-    u = 0.5 if random_u is None else float(random_u)
-
-    def seg_ids(inp, out):
-        alpha = inp / out
-        starts = jnp.minimum(
-            jnp.floor(alpha * (jnp.arange(out) + u)).astype(jnp.int32),
-            inp - 1)
-        return jnp.searchsorted(starts, jnp.arange(inp), side="right") - 1
-
-    def reduce_axis(arr, axis, out):
-        ids = jnp.clip(seg_ids(arr.shape[axis], out), 0, out - 1)
-        m = jnp.moveaxis(arr, axis, 0)
-        red = jax.ops.segment_max(m, ids, num_segments=out)
-        return jnp.moveaxis(red, 0, axis)
-
-    for axis, o in zip((2, 3, 4), (od, oh, ow)):
-        x = reduce_axis(x, axis, o)
+    u = float(random_u)
+    ks = (None,) * 3 if kernel_size is None else _norm_tuple(kernel_size, 3)
+    for axis, o, k in zip((2, 3, 4), (od, oh, ow), ks):
+        x = _frac_reduce_axis(x, axis, o, u, k)
     return x
+
+
+def _frac_reduce_axis(arr, axis, out, u, kernel=None):
+    """Max-reduce one spatial axis into `out` fractional bins."""
+    inp = arr.shape[axis]
+    if kernel is None:
+        starts, _ = _frac_segments(inp, out, u)
+        ids = jnp.searchsorted(jnp.asarray(starts), jnp.arange(inp),
+                               side="right") - 1
+        m = jnp.moveaxis(arr, axis, 0)
+        red = jax.ops.segment_max(m, jnp.clip(ids, 0, out - 1),
+                                  num_segments=out)
+        return jnp.moveaxis(red, 0, axis)
+    starts, ends = _frac_segments(inp, out, u, kernel)
+    idx = np.minimum(starts[:, None] + np.arange(int(kernel))[None, :],
+                     ends[:, None] - 1)                    # [out, k]
+    m = jnp.moveaxis(arr, axis, 0)                         # [inp, ...]
+    g = m[jnp.asarray(idx)]                                # [out, k, ...]
+    return jnp.moveaxis(g.max(axis=1), 0, axis)
 
 
 # --------------------------------------------------------------- unpool

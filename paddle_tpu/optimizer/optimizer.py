@@ -61,8 +61,9 @@ class Optimizer:
                 "optimizer.py checks in dygraph mode)")
         self._parameter_list = list(parameters)
         self._learning_rate = learning_rate
-        if isinstance(weight_decay, float):
-            self.regularization = L2Decay(weight_decay)
+        if isinstance(weight_decay, (int, float)) and \
+                not isinstance(weight_decay, bool):
+            self.regularization = L2Decay(float(weight_decay))
         else:
             self.regularization = weight_decay
         self._grad_clip = grad_clip
@@ -655,9 +656,25 @@ class LBFGS(Optimizer):
         vector_to_parameters(flat, params)
 
     def _gather(self, params):
+        """Flatten params/grads, applying the configured grad_clip and
+        coupled weight decay (the base fused path does this in step();
+        LBFGS bypasses that path, so it must apply them itself)."""
+        grads = [p.grad for p in params]
+        if self._grad_clip is not None:
+            pg = [(p, g) for p, g in zip(params, grads) if g is not None]
+            clipped = dict(zip((id(p) for p, _ in pg),
+                               (g for _, g in self._grad_clip(pg))))
+            grads = [clipped.get(id(p), g) for p, g in zip(params, grads)]
         x = self._flat([p._data for p in params])
-        g = self._flat([p.grad._data if p.grad is not None
-                        else jnp.zeros(p.shape) for p in params])
+        g = self._flat([g._data if g is not None
+                        else jnp.zeros(p.shape) for p, g in
+                        zip(params, grads)])
+        if isinstance(self.regularization, (L1Decay, L2Decay)):
+            coeff = jnp.float32(self.regularization.coeff)
+            if isinstance(self.regularization, L1Decay):
+                g = g + coeff * jnp.sign(x)
+            else:
+                g = g + coeff * x
         return x, g
 
     def _direction(self, g):

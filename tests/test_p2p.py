@@ -291,6 +291,35 @@ def _subgroup_proc(rank, world, port, q):
         else:
             assert float(x.numpy()[0]) == 2.0      # untouched
 
+        # gather / all_to_all / alltoall_single also honor the subgroup:
+        # rank 1 returns immediately instead of blocking in recv
+        gl = []
+        res = dist.gather(x, gather_list=gl, dst=0, group=g)
+        if rank == 0:
+            got = sorted(float(t.numpy()[0]) for t in gl)
+            assert got == [4.0, 4.0], got        # both members post-allreduce
+        elif rank == 1:
+            assert res is None
+
+        ins = [paddle.to_tensor(np.array([rank * 10 + j], np.float32))
+               for j in range(2)]
+        outs = []
+        res = dist.all_to_all(outs, ins, group=g)
+        if rank in (0, 2):
+            me = [0, 2].index(rank)
+            vals = [float(t.numpy()[0]) for t in outs]
+            assert vals == [0 * 10 + me, 2 * 10 + me], vals
+        else:
+            assert res == [] and outs == []
+
+        single_in = paddle.to_tensor(
+            np.array([rank * 10, rank * 10 + 1], np.float32))
+        res = dist.alltoall_single(None, single_in, group=g)
+        if rank in (0, 2):
+            me = [0, 2].index(rank)
+            np.testing.assert_allclose(
+                res.numpy(), [0 * 10 + me, 2 * 10 + me])
+
         # cross-process barrier actually synchronizes
         import time
         t0 = time.monotonic()
