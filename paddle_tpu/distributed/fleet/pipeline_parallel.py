@@ -124,10 +124,23 @@ class PipelineStack(Layer):
                  num_stages: Optional[int] = None,
                  num_microbatches: int = 1, mesh: Optional[ProcessMesh] = None,
                  pp_axis: str = "pp", schedule: str = "1F1B",
-                 remat: bool = False, num_virtual_stages: int = 1):
+                 remat: bool = False, num_virtual_stages: int = 1,
+                 data_axis: Optional[str] = None):
         super().__init__()
         mesh, axis = _pp_mesh(mesh, pp_axis, num_stages)
         self._mesh, self._axis = mesh, axis
+        if data_axis is not None and data_axis not in mesh.dim_names:
+            raise ValueError(
+                f"data_axis {data_axis!r} not in mesh axes {mesh.dim_names}")
+        if data_axis == axis:
+            raise ValueError(
+                f"data_axis {data_axis!r} is the pipeline axis — the stage "
+                f"ring cannot double as the data-parallel axis")
+        # hybrid dp x pp: the microbatch dim shards over data_axis, so each
+        # data-parallel slice pipelines its own sub-batch in the SAME
+        # compiled program (reference: hybrid_parallel dp+pp orchestration,
+        # meta_parallel/pipeline_parallel.py — there via nested groups)
+        self._data_axis = data_axis
         self.num_stages = num_stages or mesh.get_dim_size(axis)
         if mesh.get_dim_size(axis) != self.num_stages:
             raise ValueError(
@@ -324,9 +337,12 @@ class PipelineStack(Layer):
             s[1] = axis
             return P(*s)
 
+        data_spec = [None] * x.ndim
+        if self._data_axis is not None:
+            data_spec[1] = self._data_axis   # shard the microbatch rows
         in_specs = (tuple(spec_for(p) for p in param_tensors),
-                    P(*([None] * (x.ndim))))
-        out_specs = P(*([None] * x.ndim))
+                    P(*data_spec))
+        out_specs = P(*data_spec)
         # jit is required: remat (closed_call) can't be eagerly evaluated
         # inside shard_map, and the schedule should compile to one XLA
         # program anyway

@@ -306,6 +306,41 @@ class TestPipeline:
         ref = self._stack_reference(stack, x)
         np.testing.assert_allclose(_np(y), ref, atol=1e-4)
 
+    def test_hybrid_dp_pp_data_axis_matches_sequential(self):
+        # data_axis shards the microbatch rows over 'dp' while 'pp' runs
+        # the stage ring — one compiled program, numerics identical to
+        # sequential execution
+        from paddle_tpu.distributed.fleet.pipeline_parallel import (
+            PipelineStack)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 2, 2),
+                           dim_names=["dp", "pp", "mp"])
+        stack = PipelineStack(lambda: nn.Linear(8, 8), num_layers=4,
+                              num_stages=2, num_microbatches=2, mesh=mesh,
+                              schedule="VPP", num_virtual_stages=2,
+                              data_axis="dp")
+        x = np.random.randn(2, 4, 8).astype("float32")   # mb rows = 4 (dp 2)
+        y = stack(paddle.to_tensor(x))
+        ref = self._stack_reference(stack, x)
+        np.testing.assert_allclose(_np(y), ref, atol=1e-4)
+        # gradients flow through the hybrid program too
+        xt = paddle.to_tensor(x)
+        xt.stop_gradient = False
+        stack(xt).sum().backward()
+        for p in stack.parameters():
+            assert p.grad is not None
+
+    def test_data_axis_must_be_a_mesh_axis(self):
+        from paddle_tpu.distributed.fleet.pipeline_parallel import (
+            PipelineStack)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                           dim_names=["pp", "dp"])
+        with pytest.raises(ValueError):
+            PipelineStack(lambda: nn.Linear(8, 8), num_layers=4,
+                          num_stages=2, mesh=mesh, data_axis="bogus")
+        with pytest.raises(ValueError):   # the stage ring can't carry data
+            PipelineStack(lambda: nn.Linear(8, 8), num_layers=4,
+                          num_stages=2, mesh=mesh, data_axis="pp")
+
     def test_interleaved_requires_divisible_microbatches(self):
         from paddle_tpu.distributed.fleet.pipeline_parallel import (
             PipelineStack)
