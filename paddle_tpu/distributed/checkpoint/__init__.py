@@ -1,15 +1,21 @@
 """Distributed (sharded) checkpointing.
 
 Capability parity: python/paddle/distributed/checkpoint/ in the reference —
-save_state_dict (:145) with per-rank shard files + global metadata + dedup of
-replicated tensors, load_state_dict with cross-topology resharding.
+save_state_dict (save_state_dict.py:117,145) writes per-rank shard files +
+global metadata with cross-rank dedup of replicated shards;
+load_state_dict (load_state_dict.py) reassembles across topology changes.
 
-TPU-native: each host writes the shards it owns (addressable shards of the
-jax.Array); metadata records global shape + placements; load re-assembles and
-``device_put``s to whatever mesh/placements the new topology wants —
-load-N-way-save-M-way falls out of resharding (reference tests:
-semi_auto_parallel_checkpoint_dedup_tensor.py).  Async save offloads to a
-background thread (reference: save_state_dict.py:46 task queue).
+TPU-native design: ownership is computed deterministically from the
+jax.Array sharding's ``devices_indices_map`` — every process derives the
+same owner for every global shard with NO communication (the reference
+needs a dedup pass over rank metadata; here the sharding IS the metadata).
+Each rank writes only the shards it owns: replicated placements collapse to
+one owner, so total bytes on disk == one copy of the state dict, split
+across ranks.  Load never materializes the global array: each target
+device's buffer is filled from the overlapping saved shards and the
+distributed array is built with ``jax.make_array_from_single_device_arrays``
+— save-N-way / load-M-way falls out of slice intersection.  Async save
+offloads to a background thread (reference: save_state_dict.py:46).
 """
 from __future__ import annotations
 
@@ -17,7 +23,7 @@ import json
 import os
 import pickle
 import threading
-from typing import Dict, Optional
+from typing import Dict, Tuple
 
 import numpy as np
 import jax
@@ -31,7 +37,59 @@ from ..env import get_rank
 _async_tasks = []
 
 
-def _tensor_meta(name, t: Tensor):
+def _ckpt_rank() -> int:
+    """This process's checkpoint rank: the launcher env contract when
+    present (multi-process eager lane), else the jax process index
+    (multi-host SPMD lane)."""
+    v = os.environ.get("PADDLE_TRAINER_ID")
+    return int(v) if v is not None else get_rank()
+
+
+def _owner_rank_of_device(device) -> int:
+    """The checkpoint rank that owns shards living on ``device``.  One file
+    per host process (device.process_index) in a real multi-host job; tests
+    monkeypatch this to ``lambda d: d.id`` to emulate an 8-host layout on
+    the virtual CPU mesh."""
+    return device.process_index
+
+
+def _norm_index(index, shape) -> Tuple[Tuple[int, int], ...]:
+    """Normalize a devices_indices_map entry (tuple of slices) to
+    ((start, stop), ...) against the global shape."""
+    out = []
+    for sl, n in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = n if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _shard_key(span) -> str:
+    return ";".join(f"{a}:{b}" for a, b in span)
+
+
+def _parse_key(key: str) -> Tuple[Tuple[int, int], ...]:
+    if not key:          # 0-dim (scalar) tensors have the empty span
+        return ()
+    return tuple(tuple(int(v) for v in part.split(":"))
+                 for part in key.split(";"))
+
+
+def _owner_map(arr: jax.Array):
+    """For every distinct global shard span, the owning (rank, device):
+    the minimal (owner_rank, device.id) among the replicas holding it.
+    Deterministic on every process — no collective needed."""
+    shape = arr.shape
+    owners: Dict[Tuple, Tuple[int, int]] = {}
+    for d, index in arr.sharding.devices_indices_map(shape).items():
+        span = _norm_index(index, shape)
+        cand = (_owner_rank_of_device(d), d.id)
+        if span not in owners or cand < owners[span]:
+            owners[span] = cand
+    return owners
+
+
+def _tensor_meta(name, t: Tensor, owners=None):
     meta = {"name": name, "global_shape": list(t.shape),
             "dtype": str(t.dtype)}
     if t.dist_attr is not None:
@@ -42,32 +100,60 @@ def _tensor_meta(name, t: Tensor):
             {"type": "shard", "dim": p.dim} if isinstance(p, Shard)
             else {"type": "replicate"}
             for p in t.dist_attr.placements]
+    if owners is not None:
+        meta["shards"] = [{"span": _shard_key(span), "rank": rank}
+                          for span, (rank, _dev) in sorted(owners.items())]
     return meta
 
 
 def save_state_dict(state_dict: Dict[str, Tensor], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     async_save: bool = False) -> None:
-    """reference: dist.checkpoint.save_state_dict (save_state_dict.py:145)."""
+    """reference: dist.checkpoint.save_state_dict (save_state_dict.py:145).
+
+    Each rank writes ``rank_{r}.pkl`` holding ONLY the shards it owns
+    (replicated shards dedup to their first owner); the coordinator also
+    writes ``metadata.json`` with the global span->rank index."""
     os.makedirs(path, exist_ok=True)
-    rank = get_rank()
+    rank = _ckpt_rank()
 
     metas = []
-    shards = {}
+    shards: Dict[str, Dict[str, np.ndarray]] = {}
     for name, t in state_dict.items():
         if not isinstance(t, Tensor):
-            shards.setdefault("__objects__", {})[name] = t
+            if rank == coordinator_rank:   # objects dedup to coordinator
+                shards.setdefault("__objects__", {})[name] = t
             continue
-        metas.append(_tensor_meta(name, t))
         arr = t._data
-        # dedup: only the process owning the first addressable shard of a
-        # fully-replicated tensor writes it (reference: dedup_tensor)
-        shards[name] = np.asarray(arr)
+        single_device = (not isinstance(arr, jax.Array)
+                         or (arr.is_fully_addressable
+                             and len(arr.sharding.device_set) == 1))
+        if single_device:
+            # single-device / host value: plain replicated tensor
+            span = tuple((0, n) for n in arr.shape)
+            owners = {span: (coordinator_rank, -1)}
+        else:
+            owners = _owner_map(arr)
+        metas.append(_tensor_meta(name, t, owners))
+        mine = {span for span, (r, _d) in owners.items() if r == rank}
+        if not mine:
+            continue
+        local = {}
+        if single_device:
+            local[_shard_key(tuple((0, n) for n in arr.shape))] = \
+                np.asarray(arr)
+        else:
+            for sh in arr.addressable_shards:
+                span = _norm_index(sh.index, arr.shape)
+                if span in mine and _shard_key(span) not in local:
+                    local[_shard_key(span)] = np.asarray(sh.data)
+        if local:
+            shards[name] = local
 
     def _write():
         if rank == coordinator_rank:
             with open(os.path.join(path, "metadata.json"), "w") as f:
-                json.dump({"tensors": metas}, f)
+                json.dump({"version": 2, "tensors": metas}, f)
         with open(os.path.join(path, f"rank_{rank}.pkl"), "wb") as f:
             pickle.dump(shards, f, protocol=4)
 
@@ -85,11 +171,120 @@ def wait_async_save():
     _async_tasks.clear()
 
 
+class _ShardReader:
+    """Lazy per-rank shard-file loader shared across tensors."""
+
+    def __init__(self, path):
+        self.path = path
+        self._files: Dict[int, dict] = {}
+
+    def get(self, rank: int) -> dict:
+        if rank not in self._files:
+            fname = os.path.join(self.path, f"rank_{rank}.pkl")
+            with open(fname, "rb") as f:
+                self._files[rank] = pickle.load(f)
+        return self._files[rank]
+
+
+def _fill_from_shards(buf, offset, pieces):
+    """Copy the overlap of every saved (span, array) piece into ``buf``,
+    whose global position starts at ``offset``."""
+    for span, arr in pieces:
+        sel_dst, sel_src, empty = [], [], False
+        for (a, b), o, n in zip(span, offset, buf.shape):
+            lo, hi = max(a, o), min(b, o + n)
+            if lo >= hi:
+                empty = True
+                break
+            sel_dst.append(slice(lo - o, hi - o))
+            sel_src.append(slice(lo - a, hi - a))
+        if not empty:
+            buf[tuple(sel_dst)] = arr[tuple(sel_src)]
+
+
+def _assemble(meta, reader, target_sharding, dtype):
+    """Build a jax.Array for the target sharding device-buffer by
+    device-buffer — the global array is never materialized."""
+    shape = tuple(meta["global_shape"])
+    shard_index = [( _parse_key(s["span"]), s["rank"])
+                   for s in meta["shards"]]
+
+    def pieces_overlapping(offset, local_shape):
+        out = []
+        for span, rank in shard_index:
+            if all(max(a, o) < min(b, o + n)
+                   for (a, b), o, n in zip(span, offset, local_shape)):
+                data = reader.get(rank).get(meta["name"], {})
+                arr = data.get(_shard_key(span))
+                if arr is None:
+                    raise FileNotFoundError(
+                        f"shard {span} of {meta['name']} missing from "
+                        f"rank_{rank}.pkl")
+                out.append((span, arr))
+        return out
+
+    if target_sharding is None:
+        buf = np.zeros(shape, dtype)
+        _fill_from_shards(buf, (0,) * len(shape), pieces_overlapping(
+            (0,) * len(shape), shape))
+        return jax.numpy.asarray(buf)
+
+    span_bufs: Dict[Tuple, np.ndarray] = {}   # replicas share one assembly
+    bufs = []
+    for d, index in target_sharding.addressable_devices_indices_map(
+            shape).items():
+        span = _norm_index(index, shape)
+        buf = span_bufs.get(span)
+        if buf is None:
+            offset = tuple(a for a, _b in span)
+            local_shape = tuple(b - a for a, b in span)
+            buf = np.zeros(local_shape, dtype)
+            _fill_from_shards(buf, offset,
+                              pieces_overlapping(offset, local_shape))
+            span_bufs[span] = buf
+        bufs.append(jax.device_put(buf, d))
+    return jax.make_array_from_single_device_arrays(
+        shape, target_sharding, bufs)
+
+
 def load_state_dict(state_dict: Dict[str, Tensor], path: str,
                     process_group=None, coordinator_rank: int = 0) -> None:
     """reference: dist.checkpoint.load_state_dict — reshards on load so the
-    target topology may differ from the save topology."""
-    rank = get_rank()
+    target topology may differ from the save topology; each rank reads only
+    the shard files overlapping its addressable devices."""
+    meta_file = os.path.join(path, "metadata.json")
+    metadata = None
+    if os.path.exists(meta_file):
+        with open(meta_file) as f:
+            metadata = json.load(f)
+    if not metadata or metadata.get("version", 1) < 2:
+        return _load_v1(state_dict, path)
+    by_name = {m["name"]: m for m in metadata["tensors"]}
+    reader = _ShardReader(path)
+
+    # objects live deduped in the coordinator's file
+    objs = reader.get(coordinator_rank).get("__objects__", {})
+    for name, t in state_dict.items():
+        if not isinstance(t, Tensor):
+            if name in objs:
+                state_dict[name] = objs[name]
+            continue
+        meta = by_name.get(name)
+        if meta is None:
+            continue
+        dtype = np.dtype(t._data.dtype)
+        if t.dist_attr is not None:
+            from ..auto_parallel.api import _sharding_for
+            ns = _sharding_for(t.dist_attr.process_mesh,
+                               t.dist_attr.placements, t._data.ndim)
+            t._data = _assemble(meta, reader, ns, dtype)
+        else:
+            t._data = _assemble(meta, reader, None, dtype)
+
+
+def _load_v1(state_dict, path):
+    """Legacy (round<=3) checkpoints: full arrays in per-rank files."""
+    rank = _ckpt_rank()
     fname = os.path.join(path, f"rank_{rank}.pkl")
     if not os.path.exists(fname):
         fname = os.path.join(path, "rank_0.pkl")
@@ -102,9 +297,15 @@ def load_state_dict(state_dict: Dict[str, Tensor], path: str,
         if not isinstance(t, Tensor):
             state_dict[name] = value
             continue
+        if isinstance(value, dict):
+            raise FileNotFoundError(
+                f"{path!r} holds v2 (sharded) checkpoint data for "
+                f"{name!r} but metadata.json is missing — on multi-host "
+                "jobs the checkpoint dir must be a shared filesystem "
+                "visible to every rank (reference: save_state_dict "
+                "coordinator metadata contract)")
         arr = jax.numpy.asarray(value).astype(t._data.dtype)
         if t.dist_attr is not None:
-            # reshard into the target placement
             from ..auto_parallel.api import _sharding_for
             ns = _sharding_for(t.dist_attr.process_mesh,
                                t.dist_attr.placements, arr.ndim)

@@ -435,3 +435,93 @@ class TestDistCheckpoint:
         target = {"w": dist.shard_tensor(paddle.to_tensor(np.zeros_like(a)), mesh, [Replicate()])}
         dist.checkpoint.load_state_dict(target, str(tmp_path))
         np.testing.assert_allclose(_np(target["w"]), a)
+
+    def test_truly_sharded_files_and_topology_change(self, tmp_path,
+                                                     monkeypatch):
+        """VERDICT r3 item 3: per-rank files hold ONLY owned shards
+        (~ global/8 on an 8-way emulated-host layout), replicated tensors
+        dedup to one owner, and a {dp:2,mp:4} save loads on {dp:4,mp:2}."""
+        import pickle
+        from paddle_tpu.distributed import checkpoint as ckpt
+        # emulate an 8-host layout: one checkpoint rank per device
+        monkeypatch.setattr(ckpt, "_owner_rank_of_device", lambda d: d.id)
+
+        mesh_a = ProcessMesh(np.arange(8).reshape(2, 4),
+                             dim_names=["dp", "mp"])
+        a = np.random.randn(16, 32).astype("float32")   # 2048 bytes
+        r = np.random.randn(4, 4).astype("float32")
+        sd = {
+            # sharded both ways: each device owns a distinct 8x8 tile
+            "w": dist.shard_tensor(paddle.to_tensor(a), mesh_a,
+                                   [Shard(0), Shard(1)]),
+            # fully replicated: must dedup to exactly one owner rank
+            "b": dist.shard_tensor(paddle.to_tensor(r), mesh_a,
+                                   [Replicate(), Replicate()]),
+            "step": 7,                                  # non-tensor object
+        }
+        # emulate each host writing its own file
+        for rank in range(8):
+            monkeypatch.setenv("PADDLE_TRAINER_ID", str(rank))
+            ckpt.save_state_dict(dict(sd), str(tmp_path))
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+
+        # every rank file carries ~1/8 of w (one 8x8 tile = 256 floats)
+        sizes = {}
+        w_shards, b_shards = 0, 0
+        for rank in range(8):
+            with open(tmp_path / f"rank_{rank}.pkl", "rb") as f:
+                data = pickle.load(f)
+            if "w" in data:
+                for key, arr in data["w"].items():
+                    w_shards += 1
+                    assert arr.shape == (8, 8), (rank, key, arr.shape)
+            b_shards += len(data.get("b", {}))
+            sizes[rank] = sum(arr.nbytes
+                              for td in data.values()
+                              if isinstance(td, dict)
+                              for arr in td.values()
+                              if isinstance(arr, np.ndarray))
+        assert w_shards == 8                       # all tiles, no overlap
+        assert b_shards == 1                       # replicated: ONE owner
+        per_rank_w = a.nbytes / 8
+        for rank, nbytes in sizes.items():
+            assert nbytes <= per_rank_w + r.nbytes + 1, (rank, sizes)
+
+        # topology change on load: {dp:2,mp:4} -> {dp:4,mp:2} + new spec
+        mesh_b = ProcessMesh(np.arange(8).reshape(4, 2),
+                             dim_names=["dp", "mp"])
+        target = {
+            "w": dist.shard_tensor(
+                paddle.to_tensor(np.zeros_like(a)), mesh_b,
+                [Shard(1), Shard(0)]),
+            "b": dist.shard_tensor(
+                paddle.to_tensor(np.zeros_like(r)), mesh_b,
+                [Replicate(), Shard(0)]),
+            "step": 0,
+        }
+        ckpt.load_state_dict(target, str(tmp_path))
+        np.testing.assert_allclose(_np(target["w"]), a)
+        np.testing.assert_allclose(_np(target["b"]), r)
+        assert target["step"] == 7
+
+    def test_scalar_and_plain_tensor_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+        sd = {"scale": paddle.to_tensor(np.float32(3.5)),
+              "vec": paddle.to_tensor(np.arange(4, dtype=np.float32))}
+        ckpt.save_state_dict(sd, str(tmp_path))
+        tgt = {"scale": paddle.to_tensor(np.float32(0.0)),
+               "vec": paddle.to_tensor(np.zeros(4, np.float32))}
+        ckpt.load_state_dict(tgt, str(tmp_path))
+        assert float(_np(tgt["scale"])) == 3.5
+        np.testing.assert_allclose(_np(tgt["vec"]), [0, 1, 2, 3])
+
+    def test_async_save_roundtrip(self, tmp_path):
+        mesh = ProcessMesh(np.arange(8), dim_names=["x"])
+        a = np.random.randn(8, 2).astype("float32")
+        sd = {"w": dist.shard_tensor(paddle.to_tensor(a), mesh, [Shard(0)])}
+        dist.checkpoint.save_state_dict(sd, str(tmp_path), async_save=True)
+        dist.checkpoint.wait_async_save()
+        target = {"w": dist.shard_tensor(
+            paddle.to_tensor(np.zeros_like(a)), mesh, [Shard(0)])}
+        dist.checkpoint.load_state_dict(target, str(tmp_path))
+        np.testing.assert_allclose(_np(target["w"]), a)
