@@ -43,9 +43,46 @@ def _keep(arr):
     return sh if isinstance(sh, NamedSharding) else None
 
 
+def _is_offloaded(sh):
+    return sh is not None and \
+        getattr(sh, "memory_kind", None) not in (None, "device")
+
+
 def _pin(x, sh):
-    return x if x is None or sh is None else \
-        jax.lax.with_sharding_constraint(x, sh)
+    """Constrain an in-program value to its initial placement; offloaded
+    (host-memory) state returns home via a real transfer."""
+    if x is None or sh is None:
+        return x
+    if _is_offloaded(sh):
+        return jax.device_put(x, sh)
+    return jax.lax.with_sharding_constraint(x, sh)
+
+
+def _to_compute(x, sh):
+    """Stream an offloaded operand into device memory for the update."""
+    if x is None or not _is_offloaded(sh):
+        return x
+    return jax.device_put(x, sh.with_memory_kind("device"))
+
+
+def _device_kind(sh):
+    """The device-memory variant of a sharding (grads never offload —
+    they are consumed immediately by the fused update)."""
+    if _is_offloaded(sh):
+        return sh.with_memory_kind("device")
+    return sh
+
+
+def _copy(arr):
+    """jnp.copy drops a non-default memory kind; restore it so offloaded
+    optimizer state stays in host memory."""
+    if arr is None:
+        return None
+    out = jnp.copy(arr)
+    sh = _keep(arr)
+    if _is_offloaded(sh):
+        out = jax.device_put(out, sh)
+    return out
 
 
 class TrainStep:
@@ -89,18 +126,27 @@ class TrainStep:
         # and donating the model's/optimizer's own arrays would leave them
         # holding deleted buffers until sync()
         self._arrays = [jnp.copy(p._data) for p in self._train_params]
-        self._states = {s: [jnp.copy(opt._accumulators[s][id(p)])
+        self._states = {s: [_copy(opt._accumulators[s][id(p)])
                             for p in self._train_params]
                         for s in opt._state_slots}
-        self._masters = [None if opt._master_weights.get(id(p)) is None
-                         else jnp.copy(opt._master_weights[id(p)])
+        self._masters = [_copy(opt._master_weights.get(id(p)))
                          for p in self._train_params]
         self._update_fn = opt._functional_update_fn(self._train_params)
         # accumulate in fp32 whenever a master weight exists: summing k
         # bf16 micro-grads in bf16 rounds away exactly the small terms
-        # the master-weight machinery protects
+        # the master-weight machinery protects.  Accumulators always live
+        # in DEVICE memory (they're touched every micro-step) even when
+        # the master they mirror is host-offloaded.
+        def _accum_init(a, m):
+            src = m if m is not None else a
+            z = jnp.zeros_like(src)
+            sh = _keep(src)
+            if _is_offloaded(sh):
+                z = jax.device_put(z, sh.with_memory_kind("device"))
+            return z
+
         self._grad_accum = [
-            jnp.zeros_like(m if m is not None else a)
+            _accum_init(a, m)
             for a, m in zip(self._arrays, self._masters)] \
             if self.accumulate_steps > 1 else []
         self._micro_step = 0
@@ -132,6 +178,13 @@ class TrainStep:
         def pure_step(arrays, states, masters, accum, frozen, lr, stepno,
                       apply_flag, in_leaves, label_leaves, treedefs):
             in_tree, label_tree = treedefs
+            # ZeRO offload: stream host-resident optimizer state into
+            # device memory for the fused update (returned home by _pin)
+            states = {k: [_to_compute(a, s)
+                          for a, s in zip(states[k], state_shardings[k])]
+                      for k in states}
+            masters = [_to_compute(m, s)
+                       for m, s in zip(masters, master_shardings)]
 
             def loss_of(arrs):
                 saved = [p._data for p in train_params]
@@ -234,13 +287,32 @@ class TrainStep:
         state_shardings = {k: [_keep(a) for a in v]
                            for k, v in self._states.items()}
         master_shardings = [_keep(m) for m in self._masters]
+        # ZeRO offload mode: on TPU the host-resident state stays
+        # pinned_host ACROSS the program boundary (streamed in/out inside
+        # the compiled step — overlappable transfers).  Other backends
+        # (CPU tests) can't compile mixed-memory donated programs, so the
+        # state is staged eagerly around the call instead — the same
+        # semantics the reference's cpu_offload staging has
+        # (group_sharded_stage3.py:85); host==device memory there anyway.
+        offloaded = (any(_is_offloaded(s)
+                         for v in state_shardings.values() for s in v)
+                     or any(_is_offloaded(s) for s in master_shardings))
+        self._offload_boundary = offloaded and \
+            jax.default_backend() != "tpu"
+        if self._offload_boundary:
+            self._state_homes = (state_shardings, master_shardings)
+            state_shardings = {k: [_device_kind(s) for s in v]
+                               for k, v in state_shardings.items()}
+            master_shardings = [_device_kind(s) for s in master_shardings]
+        else:
+            self._state_homes = None
         # grad placement follows the param's sharded state (or master) —
         # the gradient's consumer
         grad_shardings = []
         for i in range(len(self._arrays)):
             sh = next((state_shardings[k][i] for k in self._states
                        if state_shardings[k][i] is not None), None)
-            grad_shardings.append(sh or master_shardings[i])
+            grad_shardings.append(_device_kind(sh or master_shardings[i]))
 
         self._compiled = jax.jit(pure_step, donate_argnums=(0, 1, 2, 3),
                                  static_argnums=(10,))
@@ -265,6 +337,35 @@ class TrainStep:
         frozen = [p._data for p in self._frozen_params]
         return in_leaves, label_leaves, (in_tree, label_tree), frozen
 
+    def _stage_in(self):
+        """Boundary-mode offload: transfer host-resident state into device
+        memory for the compiled call (no-op in program mode)."""
+        if not getattr(self, "_offload_boundary", False):
+            return self._states, self._masters
+        homes_s, homes_m = self._state_homes
+        states = {k: [jax.device_put(a, _device_kind(s))
+                      if _is_offloaded(s) else a
+                      for a, s in zip(self._states[k], homes_s[k])]
+                  for k in self._states}
+        masters = [jax.device_put(m, _device_kind(s))
+                   if m is not None and _is_offloaded(s) else m
+                   for m, s in zip(self._masters, homes_m)]
+        return states, masters
+
+    def _stage_out(self):
+        """Boundary-mode offload: return the fresh state home to host
+        memory after the compiled call."""
+        if not getattr(self, "_offload_boundary", False):
+            return
+        homes_s, homes_m = self._state_homes
+        self._states = {k: [jax.device_put(a, s)
+                            if _is_offloaded(s) else a
+                            for a, s in zip(self._states[k], homes_s[k])]
+                        for k in self._states}
+        self._masters = [jax.device_put(m, s)
+                         if m is not None and _is_offloaded(s) else m
+                         for m, s in zip(self._masters, homes_m)]
+
     def __call__(self, inputs, labels=()):
         """One fused train step.  ``inputs``/``labels`` are a Tensor/array or
         (possibly nested) tuple/list of them; returns the scalar loss Tensor
@@ -282,11 +383,13 @@ class TrainStep:
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         stepno = jnp.asarray(opt._global_step, jnp.int32)
 
+        states, masters = self._stage_in()
         (loss, outs, self._arrays, self._states, self._masters,
          self._grad_accum) = self._compiled(
-            self._arrays, self._states, self._masters, self._grad_accum,
+            self._arrays, states, masters, self._grad_accum,
             frozen, lr, stepno, jnp.asarray(apply_now), in_leaves,
             label_leaves, treedefs)
+        self._stage_out()
         self._last_outputs = [wrap_array(o) for o in outs]
         self._last_loss = wrap_array(loss)
         return self._last_loss
@@ -311,8 +414,9 @@ class TrainStep:
             return dict(cached)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         stepno = jnp.asarray(self.optimizer._global_step + 1, jnp.int32)
+        states, masters = self._stage_in()
         lowered = self._compiled.lower(
-            self._arrays, self._states, self._masters, self._grad_accum,
+            self._arrays, states, masters, self._grad_accum,
             frozen, lr, stepno, jnp.asarray(True), in_leaves, label_leaves,
             treedefs)
         mem = lowered.compile().memory_analysis()
@@ -323,6 +427,14 @@ class TrainStep:
             "alias_bytes": getattr(mem, "alias_size_in_bytes", 0),
             "generated_code_bytes": getattr(
                 mem, "generated_code_size_in_bytes", 0),
+            # ZeRO offload moves bytes from the device columns above into
+            # these host columns (populated on backends with distinct
+            # host/device memories, i.e. TPU)
+            "host_argument_bytes": getattr(
+                mem, "host_argument_size_in_bytes", 0),
+            "host_output_bytes": getattr(
+                mem, "host_output_size_in_bytes", 0),
+            "host_temp_bytes": getattr(mem, "host_temp_size_in_bytes", 0),
         }
         if return_hlo:
             out["hlo"] = lowered.as_text()

@@ -183,14 +183,40 @@ class Optimizer:
         states = {s: [self._accumulators[s][id(p)] for p, _ in params_grads]
                   for s in self._state_slots}
         masters = [self._master_weights.get(id(p)) for p, _ in params_grads]
+        # ZeRO offload (group_sharded offload=True): host-resident state
+        # is staged through device memory around the fused update, then
+        # returned home — the eager analog of TrainStep's streaming
+        offloaded = getattr(self, "_sharding_offload", False)
+        if offloaded:
+            def _stage(x):
+                sh = getattr(x, "sharding", None)
+                if x is not None and getattr(sh, "memory_kind", None) \
+                        == "pinned_host":
+                    return jax.device_put(x, sh.with_memory_kind("device"))
+                return x
+
+            states = {s: [_stage(a) for a in v] for s, v in states.items()}
+            masters = [_stage(m) for m in masters]
         new_params, new_states, new_masters = self._jit_update(
             lr, step, param_arrays, grad_arrays, states, masters)
         for i, (p, _) in enumerate(params_grads):
             p._data = new_params[i]
             for s in self._state_slots:
-                self._accumulators[s][id(p)] = new_states[s][i]
+                arr = new_states[s][i]
+                if offloaded:
+                    home = getattr(self._accumulators[s][id(p)],
+                                   "sharding", None)
+                    if getattr(home, "memory_kind", None) == "pinned_host":
+                        arr = jax.device_put(arr, home)
+                self._accumulators[s][id(p)] = arr
             if new_masters[i] is not None:
-                self._master_weights[id(p)] = new_masters[i]
+                m = new_masters[i]
+                if offloaded:
+                    home = getattr(self._master_weights.get(id(p)),
+                                   "sharding", None)
+                    if getattr(home, "memory_kind", None) == "pinned_host":
+                        m = jax.device_put(m, home)
+                self._master_weights[id(p)] = m
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
