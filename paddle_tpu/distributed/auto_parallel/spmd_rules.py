@@ -304,6 +304,343 @@ def sum_rule(x: ShardedArg, axis=None, dtype=None, keepdim=False):
     return _reduction_rule(x, axis, bool(keepdim))
 
 
+# ------------------------------------------------- shared shape-rule helpers
+def _keep_except(x: ShardedArg, drop) -> List[Placement]:
+    """x's placements with the given tensor dims unsharded."""
+    drop = {d % x.ndim for d in drop}
+    dmap = {d: a for d, a in x.dims_map().items() if d not in drop}
+    return _from_dims_map(dmap, _n_axes(x))
+
+
+def _remap_dims(x: ShardedArg, dim_map) -> List[Placement]:
+    """Placements after a dim renumbering old->new (missing = dropped)."""
+    dmap = {}
+    for d, axes in x.dims_map().items():
+        nd = dim_map.get(d)
+        if nd is not None:
+            dmap[nd] = axes
+    return _from_dims_map(dmap, _n_axes(x))
+
+
+def _replicate(x: ShardedArg) -> List[Placement]:
+    return [Replicate() for _ in range(_n_axes(x))]
+
+
+# -------------------------------------------------- index / gather / scatter
+def gather_rule(x: ShardedArg, index, axis=0):
+    """reference: spmd_rules/gather.cc — our gather op flattens the index
+    to 1-D (tensor/manipulation.py), so the output keeps x's rank: the
+    gather axis follows a 1-D index's sharding, every other dim keeps
+    x's shard."""
+    axis = axis % max(x.ndim, 1)
+    dmap = {d: a for d, a in x.dims_map().items() if d != axis}
+    if isinstance(index, ShardedArg) and index.ndim == 1:
+        axes = index.dims_map().get(0)
+        if axes:
+            dmap.setdefault(axis, axes)
+    return _from_dims_map(dmap, _n_axes(x))
+
+
+def gather_nd_rule(x: ShardedArg, index):
+    """reference: spmd_rules/gather_nd.cc — out = index.shape[:-1] +
+    x.shape[k:]; batch dims follow index, trailing dims follow x."""
+    if not isinstance(index, ShardedArg):
+        return None
+    k = index.shape[-1] if index.ndim > 0 else 1
+    out_batch = index.ndim - 1
+    dmap = {d: a for d, a in index.dims_map().items() if d < out_batch}
+    for d, axes in x.dims_map().items():
+        if d >= k:
+            dmap.setdefault(out_batch + d - k, axes)
+    return _from_dims_map(dmap, _n_axes(x))
+
+
+def take_along_axis_rule(x: ShardedArg, indices, axis, broadcast=True):
+    return _keep_except(x, [axis])
+
+
+def same_as_x_rule(x: ShardedArg, *args, **kwargs):
+    """Scatter-family / fill-family: output has x's shape and keeps x's
+    placements (reference: spmd_rules/scatter.cc forward)."""
+    return list(x.placements)
+
+
+def index_select_rule(x: ShardedArg, index, axis=0):
+    pl = _keep_except(x, [axis])
+    if isinstance(index, ShardedArg):
+        axes = index.dims_map().get(0)
+        if axes:
+            axis = axis % x.ndim
+            for ax in axes:
+                if isinstance(pl[ax], Replicate):
+                    pl[ax] = Shard(axis)
+    return pl
+
+
+# ----------------------------------------------------------- slice / squeeze
+def slice_rule(x: ShardedArg, axes, starts, ends):
+    """reference: spmd_rules/slice.cc — sliced dims must unshard (their
+    size changes per-shard unevenly); others keep."""
+    return _keep_except(x, list(axes))
+
+
+def strided_slice_rule(x: ShardedArg, axes, starts, ends, strides):
+    return _keep_except(x, list(axes))
+
+
+def squeeze_rule(x: ShardedArg, axis=None):
+    """reference: spmd_rules/squeeze.cc — surviving dims renumber down."""
+    if axis is None:
+        dropped = {d for d, s in enumerate(x.shape) if s == 1}
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        dropped = {a % x.ndim for a in axes if x.shape[a % x.ndim] == 1}
+    dim_map, nd = {}, 0
+    for d in range(x.ndim):
+        if d not in dropped:
+            dim_map[d] = nd
+            nd += 1
+    return _remap_dims(x, dim_map)
+
+
+def unsqueeze_rule(x: ShardedArg, axis):
+    """reference: spmd_rules/unsqueeze.cc — old dims shift past the new
+    singleton dims."""
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    out_ndim = x.ndim + len(axes)
+    new_pos = sorted(a % out_ndim for a in axes)
+    old_positions = [d for d in range(out_ndim) if d not in new_pos]
+    dim_map = {old: new for old, new in enumerate(old_positions)}
+    return _remap_dims(x, dim_map)
+
+
+def flatten_rule(x: ShardedArg, start, stop):
+    """Dims before `start` keep; the flattened group takes the FIRST
+    grouped dim's sharding (sizes multiply, shard stays even iff the lead
+    dim was the sharded one); trailing dims renumber."""
+    start = start % x.ndim
+    stop = stop % x.ndim
+    dim_map = {d: d for d in range(start)}
+    dim_map[start] = start          # lead of the flattened group survives
+    for d in range(stop + 1, x.ndim):
+        dim_map[d] = d - (stop - start)
+    return _remap_dims(x, dim_map)
+
+
+def expand_rule(x: ShardedArg, shape):
+    """Right-aligned broadcast: dims whose size is unchanged keep their
+    shard; broadcast (1 -> n) and new leading dims replicate."""
+    out_ndim = len(shape)
+    shift = out_ndim - x.ndim
+    dmap = {}
+    for d, axes in x.dims_map().items():
+        od = d + shift
+        if 0 <= od < out_ndim and shape[od] in (-1, x.shape[d]):
+            dmap[od] = axes
+    return _from_dims_map(dmap, _n_axes(x))
+
+
+def stack_rule(xs, axis=0):
+    """reference: spmd_rules/stack.cc — inputs' dim d lands at d(+1 past
+    the new axis); the new axis replicates."""
+    lead = _first_sharded(*xs) if isinstance(xs, (list, tuple)) \
+        else _first_sharded(xs)
+    if lead is None:
+        return None
+    out_ndim = lead.ndim + 1
+    axis = axis % out_ndim
+    dmap = {}
+    for d, axes in lead.dims_map().items():
+        dmap[d + (1 if d >= axis else 0)] = axes
+    return _from_dims_map(dmap, _n_axes(lead))
+
+
+def unbind_rule(x: ShardedArg, axis):
+    axis = axis % x.ndim
+    dim_map = {d: (d if d < axis else d - 1)
+               for d in range(x.ndim) if d != axis}
+    pl = _remap_dims(x, dim_map)
+    return tuple(list(pl) for _ in range(x.shape[axis]))
+
+
+def tile_rule(x: ShardedArg, reps):
+    """reference: spmd_rules/tile.cc — tiled dims (rep > 1) unshard."""
+    reps = list(reps) if isinstance(reps, (list, tuple)) else [reps]
+    out_ndim = max(x.ndim, len(reps))
+    shift = out_ndim - x.ndim
+    reps = [1] * (out_ndim - len(reps)) + reps
+    dmap = {}
+    for d, axes in x.dims_map().items():
+        od = d + shift
+        if reps[od] == 1:
+            dmap[od] = axes
+    return _from_dims_map(dmap, _n_axes(x))
+
+
+def pad_rule(x: ShardedArg, pad_width, mode=None, value=None):
+    """Padded dims unshard (per-shard sizes go uneven); others keep."""
+    try:
+        padded = [d for d, (lo, hi) in enumerate(pad_width)
+                  if lo or hi]
+    except TypeError:
+        return _replicate(x)
+    return _keep_except(x, padded)
+
+
+def one_hot_rule(x: ShardedArg, num_classes):
+    dmap = dict(x.dims_map())
+    return _from_dims_map(dmap, _n_axes(x))
+
+
+def roll_rule(x: ShardedArg, shifts, axis=None):
+    """Roll along a sharded axis is a collective permute — legal; every
+    placement survives (reference treats roll as dim-preserving)."""
+    return list(x.placements)
+
+
+def flip_rule(x: ShardedArg, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return _keep_except(x, list(axes))
+
+
+# ----------------------------------------------------- sort / topk / argmax
+def topk_rule(x: ShardedArg, k, axis=-1, largest=True, sorted=True):
+    """reference: the topk ordering needs the full axis: unshard it; both
+    outputs (values, indices) share the placement."""
+    pl = _keep_except(x, [axis])
+    return (pl, list(pl))
+
+
+def sort_rule(x: ShardedArg, axis=-1, descending=False, stable=True):
+    return _keep_except(x, [axis])
+
+
+def kthvalue_rule(x: ShardedArg, k, axis=-1, keepdim=False):
+    pl = _reduction_rule(x, axis, keepdim)
+    return (pl, list(pl))
+
+
+def mode_rule(x: ShardedArg, axis=-1, keepdim=False):
+    pl = _reduction_rule(x, axis, keepdim)
+    return (pl, list(pl))
+
+
+def argmax_rule(x: ShardedArg, axis=None, keepdim=False, dtype=None):
+    """reference: spmd_rules/argmax.cc."""
+    return _reduction_rule(x, axis, bool(keepdim))
+
+
+def median_rule(x: ShardedArg, axis=None, keepdim=False, mode="avg"):
+    return _reduction_rule(x, axis, bool(keepdim))
+
+
+# -------------------------------------------------------- scan (cumsum etc.)
+def cumsum_rule(x: ShardedArg, axis=None):
+    """reference: spmd_rules/cumsum.cc — axis=None flattens (1-D out);
+    the scan axis itself may stay sharded (the compiler chains partial
+    sums), but we unshard it conservatively like the reference."""
+    if axis is None:
+        return _from_dims_map({}, _n_axes(x))
+    return _keep_except(x, [axis])
+
+
+def cumprod_rule(x: ShardedArg, dim=None):
+    return cumsum_rule(x, dim)
+
+
+# ------------------------------------------------------------- convolutions
+def conv_rule(x: ShardedArg, weight, bias=None, stride=1, padding=0,
+              dilation=1, groups=1, channel_last=False):
+    """reference: spmd_rules/conv2d.cc — batch follows x, C_out follows
+    the weight's dim-0 sharding, spatial dims unshard (halo exchange is
+    the compiler's problem only when it chooses to shard them)."""
+    n_axes = _n_axes(x)
+    c_dim = x.ndim - 1 if channel_last else 1
+    dmap = {}
+    batch_axes = x.dims_map().get(0)
+    if batch_axes:
+        dmap[0] = batch_axes
+    if isinstance(weight, ShardedArg):
+        out_c_axes = weight.dims_map().get(0)
+        if out_c_axes:
+            dmap[c_dim] = out_c_axes
+    return _from_dims_map(dmap, n_axes)
+
+
+# --------------------------------------------------------------- loss / misc
+def cross_entropy_rule(logits: ShardedArg, label, weight=None,
+                       ignore_index=-100, reduction="mean", soft_label=False,
+                       axis=-1, label_smoothing=0.0):
+    """reference: spmd_rules/cross_entropy_with_softmax.cc — the class
+    axis reduces away; batch dims keep their shards; 'mean'/'sum' collapse
+    to a replicated scalar."""
+    if reduction in ("mean", "sum"):
+        return _from_dims_map({}, _n_axes(logits))
+    return _reduction_rule(logits, axis, False)
+
+
+def p_norm_rule(x: ShardedArg, porder=2.0, axis=None, epsilon=1e-12,
+                keepdim=False, asvector=False):
+    """reference: spmd_rules/p_norm.cc."""
+    if axis is None or asvector:
+        return _from_dims_map({}, _n_axes(x))
+    return _reduction_rule(x, axis, bool(keepdim))
+
+
+def norm_rule(x: ShardedArg, p=None, axis=None, keepdim=False):
+    """linalg.norm facade over the p_norm semantics."""
+    if axis is None:
+        return _from_dims_map({}, _n_axes(x))
+    return _reduction_rule(x, axis, bool(keepdim))
+
+
+def scalar_out_rule(x: ShardedArg, *args, **kwargs):
+    """squared_l2_norm / numel: replicated scalar output."""
+    return _from_dims_map({}, _n_axes(x))
+
+
+def swiglu_rule(x: ShardedArg, y=None):
+    """reference: spmd_rules/swiglu.cc — elementwise in both operands;
+    without y the last dim halves (unshard it)."""
+    if y is None:
+        return _keep_except(x, [x.ndim - 1])
+    return elementwise_rule(x, y)
+
+
+def nonzero_rule(x: ShardedArg, *args, **kwargs):
+    """Data-dependent output shape: replicate (reference nonzero.cc)."""
+    return _from_dims_map({}, _n_axes(x))
+
+
+def variance_rule(x: ShardedArg, axis=None, unbiased=True, keepdim=False):
+    return _reduction_rule(x, axis, bool(keepdim))
+
+
+def prod_rule(x: ShardedArg, axis=None, keepdim=False, dtype=None):
+    return _reduction_rule(x, axis, bool(keepdim))
+
+
+def mv_rule(x: ShardedArg, vec):
+    return matmul_rule(x, vec)
+
+
+def dot_rule(x: ShardedArg, y):
+    return _from_dims_map({}, _n_axes(x)) if x.ndim == 1 \
+        else _keep_except(x, [x.ndim - 1])
+
+
+def outer_rule(x: ShardedArg, y):
+    dmap = {}
+    xa = x.dims_map().get(0)
+    if xa:
+        dmap[0] = xa
+    if isinstance(y, ShardedArg):
+        ya = y.dims_map().get(0)
+        if ya:
+            dmap.setdefault(1, ya)
+    return _from_dims_map(dmap, _n_axes(x))
+
+
 def register_all():
     """Install the rules into the op registry (idempotent)."""
     from ...framework.dispatch import OP_REGISTRY, register_spmd_rule
@@ -331,6 +668,65 @@ def register_all():
         "logsumexp": reduction_rule,
         "nansum": reduction_rule,
         "nanmean": reduction_rule,
+        # --- round-4 expansion toward the reference's full inventory
+        # (paddle/phi/infermeta/spmd_rules/: gather, scatter, slice, stack,
+        # tile, squeeze/unsqueeze, conv2d, cross_entropy_with_softmax,
+        # argmax, cumsum, p_norm, swiglu, where, topk-family, nonzero...)
+        "gather": gather_rule,
+        "gather_nd": gather_nd_rule,
+        "take_along_axis": take_along_axis_rule,
+        "put_along_axis": same_as_x_rule,
+        "scatter": same_as_x_rule,
+        "scatter_nd_add": same_as_x_rule,
+        "index_add": same_as_x_rule,
+        "index_put": same_as_x_rule,
+        "masked_fill": same_as_x_rule,
+        "index_select": index_select_rule,
+        "slice_": slice_rule,
+        "strided_slice": strided_slice_rule,
+        "squeeze": squeeze_rule,
+        "unsqueeze": unsqueeze_rule,
+        "flatten_": flatten_rule,
+        "expand_": expand_rule,
+        "stack_": stack_rule,
+        "unbind_": unbind_rule,
+        "tile_": tile_rule,
+        "pad_": pad_rule,
+        "one_hot_f": one_hot_rule,
+        "one_hot": one_hot_rule,
+        "roll": roll_rule,
+        "flip": flip_rule,
+        "triu": same_as_x_rule,
+        "tril": same_as_x_rule,
+        "topk": topk_rule,
+        "sort": sort_rule,
+        "argsort": sort_rule,
+        "kthvalue": kthvalue_rule,
+        "mode": mode_rule,
+        "argmax": argmax_rule,
+        "argmin": argmax_rule,
+        "median": median_rule,
+        "cumsum": cumsum_rule,
+        "cumprod": cumprod_rule,
+        "conv1d": conv_rule,
+        "conv2d": conv_rule,
+        "conv3d": conv_rule,
+        "cross_entropy_f": cross_entropy_rule,
+        "p_norm": p_norm_rule,
+        "norm": norm_rule,
+        "squared_l2_norm": scalar_out_rule,
+        "numel_op": scalar_out_rule,
+        "nonzero": nonzero_rule,
+        "swiglu": swiglu_rule,
+        "std": variance_rule,
+        "var": variance_rule,
+        "any": reduction_rule,
+        "all": reduction_rule,
+        "prod": prod_rule,
+        "bmm": matmul_rule,
+        "mv": mv_rule,
+        "dot": dot_rule,
+        "outer": outer_rule,
     }
     # elementwise family: same broadcast-aligned rule
     for name in ("add", "subtract", "multiply", "divide", "pow", "maximum",

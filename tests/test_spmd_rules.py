@@ -255,3 +255,162 @@ class TestRuleUnderJit:
                               NamedSharding(mesh.jax_mesh, P(None, "mp"))))
         assert "dp" in str(y.sharding.spec) and "mp" in str(y.sharding.spec)
         np.testing.assert_allclose(np.asarray(y), xa @ wa, rtol=1e-4)
+
+
+def _pl(t):
+    return t.dist_attr.placements
+
+
+def _is_shard(p, dim):
+    return isinstance(p, Shard) and p.dim == dim
+
+
+def _is_rep(p):
+    return isinstance(p, Replicate)
+
+
+class TestRound4Rules:
+    """Placement assertions for the round-4 rule expansion (reference:
+    paddle/phi/infermeta/spmd_rules/ gather, slice, squeeze, stack, tile,
+    topk, conv2d, cross_entropy_with_softmax, cumsum, p_norm, swiglu...)."""
+
+    def test_registry_count_expanded(self):
+        n = sum(1 for o in OP_REGISTRY.values() if o.spmd_rule is not None)
+        assert n >= 80, f"only {n} SPMD rules registered (reference: 80+)"
+
+    def test_gather_keeps_other_dims(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 16), mesh, [Replicate(), Shard(1)])
+        idx = dist.shard_tensor(
+            paddle.to_tensor(np.array([0, 2, 4, 6], "int64")), mesh,
+            [Replicate(), Replicate()])
+        y = paddle.gather(x, idx, axis=0)
+        assert _is_shard(_pl(y)[1], 1), _pl(y)
+
+    def test_gather_2d_index_flattened_rank(self):
+        # the op flattens a 2-D index to 1-D: output keeps x's rank and
+        # trailing shard; the rule must not invent an extra dim
+        mesh = _mesh()
+        x = _dt(_rand(8, 16), mesh, [Replicate(), Shard(1)])
+        idx = dist.shard_tensor(
+            paddle.to_tensor(np.array([[0, 1, 2], [3, 4, 5]], "int64")),
+            mesh, [Replicate(), Replicate()])
+        y = paddle.gather(x, idx, axis=0)
+        assert y.shape == [6, 16]
+        assert _is_shard(_pl(y)[1], 1), _pl(y)
+
+    def test_slice_unshards_sliced_axis(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 16), mesh, [Shard(0), Shard(1)])
+        y = paddle.slice(x, axes=[1], starts=[0], ends=[8])
+        pl = _pl(y)
+        assert _is_shard(pl[0], 0) and _is_rep(pl[1]), pl
+
+    def test_squeeze_renumbers_dims(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 1, 16), mesh, [Shard(0), Shard(2)])
+        y = paddle.squeeze(x, axis=1)
+        pl = _pl(y)
+        assert _is_shard(pl[0], 0) and _is_shard(pl[1], 1), pl
+
+    def test_unsqueeze_shifts_dims(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 16), mesh, [Shard(0), Shard(1)])
+        y = paddle.unsqueeze(x, axis=0)
+        pl = _pl(y)
+        assert _is_shard(pl[0], 1) and _is_shard(pl[1], 2), pl
+
+    def test_stack_inserts_replicated_axis(self):
+        mesh = _mesh()
+        a = _dt(_rand(8, 16), mesh, [Shard(0), Shard(1)])
+        b = _dt(_rand(8, 16), mesh, [Shard(0), Shard(1)])
+        y = paddle.stack([a, b], axis=0)
+        pl = _pl(y)
+        assert _is_shard(pl[0], 1) and _is_shard(pl[1], 2), pl
+
+    def test_tile_unshards_tiled_dim(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 16), mesh, [Shard(0), Shard(1)])
+        y = paddle.tile(x, [1, 2])
+        pl = _pl(y)
+        assert _is_shard(pl[0], 0) and _is_rep(pl[1]), pl
+
+    def test_topk_both_outputs_unshard_axis(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 16), mesh, [Shard(0), Shard(1)])
+        vals, idx = paddle.topk(x, k=4, axis=1)
+        assert _is_shard(_pl(vals)[0], 0) and _is_rep(_pl(vals)[1])
+        assert _is_shard(_pl(idx)[0], 0) and _is_rep(_pl(idx)[1])
+
+    def test_argmax_reduction(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 16), mesh, [Shard(0), Replicate()])
+        y = paddle.argmax(x, axis=1)
+        assert _is_shard(_pl(y)[0], 0), _pl(y)
+
+    def test_cumsum_keeps_other_dims(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 16), mesh, [Shard(0), Shard(1)])
+        y = paddle.cumsum(x, axis=1)
+        pl = _pl(y)
+        assert _is_shard(pl[0], 0) and _is_rep(pl[1]), pl
+
+    def test_cross_entropy_mean_replicates(self):
+        mesh = _mesh()
+        logits = _dt(_rand(8, 10), mesh, [Shard(0), Replicate()])
+        label = dist.shard_tensor(
+            paddle.to_tensor(np.zeros(8, "int64")), mesh,
+            [Shard(0), Replicate()])
+        loss = F.cross_entropy(logits, label)
+        assert all(_is_rep(p) for p in _pl(loss)), _pl(loss)
+
+    def test_conv2d_follows_batch_and_out_channels(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 4, 8, 8), mesh, [Shard(0), Replicate()])
+        w = _dt(_rand(16, 4, 3, 3), mesh, [Replicate(), Shard(0)])
+        y = F.conv2d(x, w, padding=1)
+        pl = _pl(y)
+        assert _is_shard(pl[0], 0) and _is_shard(pl[1], 1), pl
+
+    def test_p_norm_axis_reduction(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 16), mesh, [Shard(0), Replicate()])
+        y = paddle.linalg.norm(x, p=2, axis=1)
+        assert _is_shard(_pl(y)[0], 0), _pl(y)
+
+    def test_scatter_keeps_x_placements(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 16), mesh, [Replicate(), Shard(1)])
+        idx = paddle.to_tensor(np.array([0, 1], "int64"))
+        upd = paddle.to_tensor(_rand(2, 16).astype("float32"))
+        y = paddle.scatter(x, idx, upd)
+        assert _is_shard(_pl(y)[1], 1), _pl(y)
+
+    def test_flip_unshards_flipped_axis(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 16), mesh, [Shard(0), Shard(1)])
+        y = paddle.flip(x, axis=[1])
+        pl = _pl(y)
+        assert _is_shard(pl[0], 0) and _is_rep(pl[1]), pl
+
+    def test_expand_keeps_unchanged_dims(self):
+        mesh = _mesh()
+        x = _dt(_rand(8, 1), mesh, [Shard(0), Replicate()])
+        y = paddle.expand(x, [8, 16])
+        assert _is_shard(_pl(y)[0], 0), _pl(y)
+
+    def test_numerics_sharded_vs_dense(self):
+        # the rules must never change VALUES, only placements
+        mesh = _mesh()
+        xa = _rand(8, 16)
+        x = _dt(xa, mesh, [Shard(0), Shard(1)])
+        np.testing.assert_allclose(
+            np.asarray(paddle.cumsum(x, axis=1).numpy()),
+            np.cumsum(xa, axis=1), rtol=1e-5)
+        vals, idx = paddle.topk(x, k=4, axis=1)
+        ref = np.sort(xa, axis=1)[:, ::-1][:, :4]
+        np.testing.assert_allclose(np.asarray(vals.numpy()), ref, rtol=1e-5)
+        y = paddle.squeeze(_dt(_rand(8, 1, 16), mesh,
+                               [Shard(0), Replicate()]), axis=1)
+        np.testing.assert_allclose(np.asarray(y.numpy()),
+                                   _rand(8, 1, 16)[:, 0, :], rtol=1e-5)
