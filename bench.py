@@ -31,6 +31,58 @@ import numpy as np
 
 R01_LLAMA_TOKENS_PER_SEC = 94072.4   # measured on this chip, BENCH_r01.json
 
+# Peak bf16 matmul throughput per chip (TFLOP/s), by device_kind prefix —
+# public spec-sheet numbers (cloud.google.com/tpu/docs/system-architecture).
+# Longest-prefix match; MFU is omitted when the kind is unknown.
+PEAK_BF16_TFLOPS = {
+    "TPU v2": 46, "TPU v3": 123,
+    "TPU v4 lite": 137, "TPU v4": 275,
+    "TPU v5 lite": 197, "TPU v5e": 197,
+    "TPU v5p": 459, "TPU v5": 459,
+    "TPU v6 lite": 918, "TPU v6e": 918, "TPU v6": 918,
+    "TPU7x": 2308, "TPU v7": 2308,
+}
+
+
+def _peak_tflops():
+    import jax
+    kind = jax.devices()[0].device_kind
+    best = None
+    for prefix, tf in PEAK_BF16_TFLOPS.items():
+        if kind.startswith(prefix) and (best is None or
+                                        len(prefix) > len(best[0])):
+            best = (prefix, tf)
+    return kind, (best[1] if best else None)
+
+
+def _mfu_fields(step, x, y, per_sec, units_per_step, on_tpu,
+                compute_dtype="bf16"):
+    """MFU = XLA-counted FLOPs/step x steps/sec / chip peak (bf16).
+
+    BASELINE config 5 asks for MFU explicitly; reporting it for every
+    config makes single-chip numbers comparable across rounds/hardware.
+    ``mfu_dtype`` labels what precision the FLOPs actually ran in — an
+    fp32/mixed config's MFU against the bf16 peak is a lower bound, not
+    directly comparable with a pure-bf16 config.  Uses the memoized
+    memory_analysis (one extra AOT compile per config).
+    """
+    try:
+        flops = step.memory_analysis(x, y).get("flops_per_step", 0.0)
+    except Exception:   # noqa: BLE001 — never let analysis kill the bench
+        return {}
+    if flops <= 0:      # some cost models report -1 for "can't count"
+        return {}
+    steps_per_sec = per_sec / units_per_step
+    out = {"flops_per_step": flops}
+    if on_tpu:
+        kind, peak = _peak_tflops()
+        out["device_kind"] = kind
+        if peak:
+            out["peak_tflops_bf16"] = peak
+            out["mfu"] = round(flops * steps_per_sec / (peak * 1e12), 4)
+            out["mfu_dtype"] = compute_dtype
+    return out
+
 
 def _measure(step_fn, sync, units_per_step, steps, warmup=2):
     """Median-free simple wall measure: warmup (compile) then timed steps."""
@@ -97,13 +149,15 @@ def bench_llama(on_tpu):
     x = paddle.to_tensor(ids[:, :-1])
     y = paddle.to_tensor(ids[:, 1:])
 
-    tok_s = _measure(lambda: step(x, y), _sync, batch * seq, steps)
+    units = batch * seq
+    tok_s = _measure(lambda: step(x, y), _sync, units, steps)
     return {
         "metric": "llama_110m_pretrain_tokens_per_sec_per_chip",
         "value": round(tok_s, 1), "unit": "tokens/sec",
         "vs_baseline": round(tok_s / R01_LLAMA_TOKENS_PER_SEC, 3)
         if on_tpu else 0.0,
         "path": "jit.TrainStep + optimizer.AdamW(multi_precision) + bf16",
+        **_mfu_fields(step, x, y, tok_s, units, on_tpu, "bf16"),
     }
 
 
@@ -135,12 +189,14 @@ def bench_resnet_cifar(on_tpu):
         (batch, 3, size, size)).astype("float32"))
     y = paddle.to_tensor(rng.integers(0, 10, (batch,)).astype("int64"))
 
-    img_s = _measure(lambda: step(x, y), _sync, batch, steps)
+    units = batch
+    img_s = _measure(lambda: step(x, y), _sync, units, steps)
     return {
         "metric": "resnet50_cifar10_images_per_sec" if on_tpu
         else "resnet18_cifar10_images_per_sec",
         "value": round(img_s, 1), "unit": "images/sec", "vs_baseline": 0.0,
         "path": "jit.TrainStep + optimizer.Momentum + amp O1",
+        **_mfu_fields(step, x, y, img_s, units, on_tpu, "amp_o1_mixed"),
     }
 
 
@@ -174,11 +230,13 @@ def bench_bert_sst2(on_tpu):
         rng.integers(0, cfg.vocab_size, (batch, seq)).astype("int32"))
     y = paddle.to_tensor(rng.integers(0, 2, (batch,)).astype("int64"))
 
-    tok_s = _measure(lambda: step(x, y), _sync, batch * seq, steps)
+    units = batch * seq
+    tok_s = _measure(lambda: step(x, y), _sync, units, steps)
     return {
         "metric": "bert_base_sst2_finetune_tokens_per_sec_per_chip",
         "value": round(tok_s, 1), "unit": "tokens/sec", "vs_baseline": 0.0,
         "path": "jit.TrainStep + optimizer.AdamW + amp O1",
+        **_mfu_fields(step, x, y, tok_s, units, on_tpu, "amp_o1_mixed"),
     }
 
 
@@ -211,11 +269,13 @@ def bench_ocr_crnn(on_tpu):
         return F.ctc_loss(logits, labels, ilen, llen)
 
     step = TrainStep(model, loss_fn, opt)
-    img_s = _measure(lambda: step(x, y), _sync, B, steps)
+    units = B
+    img_s = _measure(lambda: step(x, y), _sync, units, steps)
     return {
         "metric": "crnn_ctc_ocr_rec_images_per_sec",
         "value": round(img_s, 1), "unit": "images/sec", "vs_baseline": 0.0,
         "path": "jit.TrainStep + optimizer.Adam + lax.scan CTC",
+        **_mfu_fields(step, x, y, img_s, units, on_tpu, "fp32"),
     }
 
 

@@ -419,8 +419,15 @@ class TrainStep:
             self._arrays, states, masters, self._grad_accum,
             frozen, lr, stepno, jnp.asarray(True), in_leaves, label_leaves,
             treedefs)
-        mem = lowered.compile().memory_analysis()
+        compiled = lowered.compile()
+        mem = compiled.memory_analysis()
+        try:   # XLA's analytic FLOP count for the WHOLE step program —
+               # the numerator of MFU (BASELINE config 5)
+            flops = float((compiled.cost_analysis() or {}).get("flops", 0.0))
+        except Exception:   # noqa: BLE001 — backend without cost model
+            flops = 0.0
         out = {
+            "flops_per_step": flops,
             "argument_bytes": getattr(mem, "argument_size_in_bytes", 0),
             "output_bytes": getattr(mem, "output_size_in_bytes", 0),
             "temp_bytes": getattr(mem, "temp_size_in_bytes", 0),
