@@ -60,7 +60,7 @@ def mha_reference(q, k, v, causal=False, scale=None, bias=None):
 # ------------------------------------------------------------------ kernel
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_kv,
-                kv_seq_len):
+                kv_seq_len, causal_offset):
     q_idx = pl.program_id(2)
     kv_idx = pl.program_id(3)
     n_kv = pl.num_programs(3)
@@ -71,10 +71,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # For causal attention, tiles strictly above the diagonal contribute
-    # nothing; predicate them off (grid still visits, compute is skipped).
+    # For causal attention, tiles strictly above the (bottom-right-aligned,
+    # offset = sk - sq) diagonal contribute nothing; predicate them off
+    # (grid still visits, compute is skipped).
     if causal:
-        run = q_idx * block_q + block_q - 1 >= kv_idx * block_kv
+        run = (q_idx * block_q + block_q - 1 + causal_offset
+               >= kv_idx * block_kv)
     else:
         run = True
 
@@ -89,7 +91,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 jnp.int32, (block_q, block_kv), 0)
             cols = kv_idx * block_kv + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+            s = jnp.where(rows + causal_offset >= cols, s,
+                          DEFAULT_MASK_VALUE)
         # mask kv padding (kv_seq_len may be < padded length)
         cols = kv_idx * block_kv + lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 1)
@@ -140,7 +143,7 @@ def flash_attention_forward(q, k, v, causal=False, scale=None,
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_kv=block_kv, kv_seq_len=sk)
+        block_kv=block_kv, kv_seq_len=sk, causal_offset=sk - sq)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -175,7 +178,245 @@ def flash_attention_forward(q, k, v, causal=False, scale=None,
     return out[:, :, :sq, :], lse[:, :, :sq, 0]
 
 
-# ---------------------------------------------------------------- backward
+# ------------------------------------------------- backward (Pallas, TPU)
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                    block_q, block_kv, q_seq_len, causal_offset):
+    """FA2 backward, dk/dv: grid (b, h, kv_blocks, q_blocks); the q axis is
+    sequential so dk/dv accumulate in VMEM scratch across q tiles
+    (reference: flash_attn_grad_kernel.cu dk/dv pass)."""
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(3)
+    n_q = pl.num_programs(3)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    if causal:   # tiles strictly above the diagonal contribute nothing
+        run = (q_idx * block_q + block_q - 1 + causal_offset
+               >= kv_idx * block_kv)
+    else:
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]                        # (block_q, d)
+        k = k_ref[0, 0]                        # (block_kv, d)
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)  # (block_q, d)
+        lse = lse_ref[0, 0][:, :1]             # (block_q, 1)
+        delta = delta_ref[0, 0][:, :1]
+
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        rows = q_idx * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        cols = kv_idx * block_kv + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        mask = rows < q_seq_len                # q padding rows contribute 0
+        if causal:
+            mask = mask & (rows + causal_offset >= cols)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        # dv += p^T @ do
+        dv_scr[:] = dv_scr[:] + lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dp = do @ v^T ; ds = p * (dp - delta) * scale
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        # dk += ds^T @ q
+        dk_scr[:] = dk_scr[:] + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(q_idx == n_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, scale, causal, block_q, block_kv,
+                   kv_seq_len, causal_offset):
+    """FA2 backward, dq: grid (b, h, q_blocks, kv_blocks); the kv axis is
+    sequential so dq accumulates in VMEM scratch across kv tiles."""
+    q_idx = pl.program_id(2)
+    kv_idx = pl.program_id(3)
+    n_kv = pl.num_programs(3)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    if causal:
+        run = (q_idx * block_q + block_q - 1 + causal_offset
+               >= kv_idx * block_kv)
+    else:
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        rows = q_idx * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        cols = kv_idx * block_kv + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        mask = cols < kv_seq_len               # kv padding cols
+        if causal:
+            mask = mask & (rows + causal_offset >= cols)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] = dq_scr[:] + lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _expand_to_128(x, pad_to):
+    """(b, h, s) -> (b, h, pad_to, 128) f32 — the lane-broadcast layout the
+    TPU kernels read scalars-per-row from (same trick as the fwd lse out).
+
+    Deliberate 128x HBM cost for these two per-row scalars: jax's own
+    production TPU flash kernel broadcasts l/m/di identically before its
+    backward pallas_calls (jax/experimental/pallas/ops/tpu/
+    flash_attention.py _flash_attention_bwd_dkv) — lane-1 blocks don't
+    tile; the arrays are transient within the backward step."""
+    b, h, s = x.shape
+    x = x.astype(jnp.float32)
+    if pad_to != s:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad_to - s)))
+    return jnp.broadcast_to(x[..., None], (b, h, pad_to, 128))
+
+
+def flash_attention_backward(q, k, v, out, lse, do, causal, scale,
+                             block_q=512, block_kv=512, interpret=False):
+    """Pallas FA2 backward (dq, dk, dv) in layout (b, h, s, d).
+
+    Two kernels: dk/dv with the q axis sequential, dq with the kv axis
+    sequential.  GQA folds the head group AFTER the kernels (sum over the
+    repeated q-heads), like the XLA fallback.
+    """
+    b, h, sq, d = q.shape
+    kv_h, sk = k.shape[1], k.shape[2]
+    group = h // kv_h
+    k_full = jnp.repeat(k, group, axis=1) if group != 1 else k
+    v_full = jnp.repeat(v, group, axis=1) if group != 1 else v
+
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1)                           # (b, h, sq)
+
+    block_q = min(block_q, _ceil_to(sq, 128))
+    block_kv = min(block_kv, _ceil_to(sk, 128))
+    sq_p, sk_p = _ceil_to(sq, block_q), _ceil_to(sk, block_kv)
+    if sq_p != sq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+        do = jnp.pad(do, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    if sk_p != sk:
+        k_full = jnp.pad(k_full, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+        v_full = jnp.pad(v_full, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+    lse128 = _expand_to_128(lse, sq_p)
+    delta128 = _expand_to_128(delta, sq_p)
+
+    n_q, n_kv = sq_p // block_q, sk_p // block_kv
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, q_seq_len=sq, causal_offset=sk - sq)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, n_kv, n_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, ki, qi: (b_, h_, qi, 0)),   # q
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, ki, qi: (b_, h_, ki, 0)),   # k
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, ki, qi: (b_, h_, ki, 0)),   # v
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, ki, qi: (b_, h_, qi, 0)),   # do
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b_, h_, ki, qi: (b_, h_, qi, 0)),   # lse
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b_, h_, ki, qi: (b_, h_, qi, 0)),   # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
+        ],
+        out_shape=[
+            # f32 so the GQA group sum below accumulates in full precision
+            # (the XLA fallback sums the group in f32 too)
+            jax.ShapeDtypeStruct((b, h, sk_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sk_p, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k_full, v_full, do, lse128, delta128)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, kv_seq_len=sk, causal_offset=sk - sq)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),   # q
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, qi, ki: (b_, h_, ki, 0)),   # k
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, qi, ki: (b_, h_, ki, 0)),   # v
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),   # do
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),   # lse
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),   # delta
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k_full, v_full, do, lse128, delta128)
+
+    dq = dq[:, :, :sq, :]
+    dk = dk[:, :, :sk, :]
+    dv = dv[:, :, :sk, :]
+    if group != 1:
+        dk = dk.reshape(b, kv_h, group, sk, d).sum(axis=2)
+        dv = dv.reshape(b, kv_h, group, sk, d).sum(axis=2)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ------------------------------------------------ backward (XLA fallback)
 def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_kv=1024):
     """Flash-attention-2 backward via lax.scan over kv blocks (pure XLA)."""
     b, h, sq, d = q.shape
@@ -208,8 +449,8 @@ def _bwd_blockwise(q, k, v, out, lse, do, causal, scale, block_kv=1024):
         cols = blk_idx * block_kv + jnp.arange(block_kv)[None, :]
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32)) * scale
         mask = cols < sk
-        if causal:
-            mask = mask & (rows >= cols)
+        if causal:   # bottom-right aligned (offset sk - sq), like the fwd
+            mask = mask & (rows + (sk - sq) >= cols)
         p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
         dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
         dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vb.astype(jnp.float32))
@@ -279,7 +520,11 @@ def _fa_bwd(causal, scale, res, do):
     q, k, v, out, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    dq, dk, dv = _bwd_blockwise(q, k, v, out, lse, do, causal, scale)
+    if _use_pallas():
+        dq, dk, dv = flash_attention_backward(q, k, v, out, lse, do,
+                                              causal, scale)
+    else:
+        dq, dk, dv = _bwd_blockwise(q, k, v, out, lse, do, causal, scale)
     return dq, dk, dv
 
 

@@ -1,0 +1,84 @@
+"""Pallas flash-attention backward kernels (VERDICT r3 item 4a; reference:
+paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu).  The kernels run in
+interpret mode on CPU; on TPU the same code compiles via Mosaic.  Every
+path — Pallas fwd/bwd, XLA blockwise bwd, plain autodiff of the dense
+reference — must agree, including bottom-right-aligned causal masking
+when kv is longer than q (the KV-cache decode shape)."""
+import math
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas import flash_attention as FA
+
+
+def _make(b, h, kvh, sq, sk, d=128, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, h, sq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, kvh, sk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, kvh, sk, d)), jnp.float32)
+    do = jnp.asarray(rng.standard_normal((b, h, sq, d)), jnp.float32)
+    return q, k, v, do
+
+
+CASES = [
+    (2, 4, 4, 256, 256, False),
+    (2, 4, 4, 256, 256, True),
+    (1, 8, 2, 384, 384, True),      # GQA, non-block-multiple seq
+    (1, 4, 4, 128, 512, True),      # causal decode: kv longer than q
+]
+
+
+class TestPallasBackward:
+    @pytest.mark.parametrize("b,h,kvh,sq,sk,causal", CASES)
+    def test_bwd_kernels_match_autodiff(self, b, h, kvh, sq, sk, causal):
+        q, k, v, do = _make(b, h, kvh, sq, sk)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        out, lse = FA._fwd_impl(q, k, v, causal, scale)
+
+        def loss(q_, k_, v_):
+            return (FA.mha_reference(q_, k_, v_, causal, scale) * do).sum()
+
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        dq, dk, dv = FA.flash_attention_backward(
+            q, k, v, out, lse, do, causal, scale,
+            block_q=128, block_kv=128, interpret=True)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(gq),
+                                   rtol=5e-3, atol=5e-3)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(gk),
+                                   rtol=5e-3, atol=5e-3)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(gv),
+                                   rtol=5e-3, atol=5e-3)
+
+    @pytest.mark.parametrize("b,h,kvh,sq,sk,causal", CASES)
+    def test_xla_blockwise_matches_autodiff(self, b, h, kvh, sq, sk,
+                                            causal):
+        q, k, v, do = _make(b, h, kvh, sq, sk)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        out, lse = FA._fwd_impl(q, k, v, causal, scale)
+
+        def loss(q_, k_, v_):
+            return (FA.mha_reference(q_, k_, v_, causal, scale) * do).sum()
+
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        dq, dk, dv = FA._bwd_blockwise(q, k, v, out, lse, do, causal, scale)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(gq),
+                                   rtol=5e-3, atol=5e-3)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(gk),
+                                   rtol=5e-3, atol=5e-3)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(gv),
+                                   rtol=5e-3, atol=5e-3)
+
+    def test_fwd_kernel_bottom_right_causal(self):
+        # decode shape: each of the 128 query rows attends to the first
+        # (sk - sq + row + 1) keys — the flash-attn v2.1 convention the
+        # reference wraps
+        q, k, v, _ = _make(1, 2, 2, 128, 512)
+        out_p, _ = FA.flash_attention_forward(q, k, v, True, None,
+                                              block_q=128, block_kv=128,
+                                              interpret=True)
+        ref = FA.mha_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out_p), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)

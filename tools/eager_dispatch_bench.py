@@ -1,0 +1,161 @@
+"""Eager dispatch benchmark: FLAGS_eager_cached_grad off vs on.
+
+VERDICT r3 item 6 — decide the eager fast-path default with a measurement.
+The reference's eager hot loop is per-op O(1) C++ (SURVEY §3A); our default
+record path re-traces every op through jax.vjp twice per step.  The cached
+path jits fwd/bwd once per (op, signature) and replays.
+
+Measures, per flag state:
+  - per-op dispatch latency (matmul small/large, add, layer_norm) with and
+    without grad recording
+  - eager train-step wall time for an MLP and a transformer block
+  - live residual bytes after forward (the op-level remat trade: the cached
+    backward recomputes the forward, so no residuals are pinned)
+
+Run:  python tools/eager_dispatch_bench.py        (CPU-pinned, self-driving)
+Emits one JSON line; the committed measurement lives in
+tools/eager_dispatch_measurement.json.
+"""
+import json
+import subprocess
+import sys
+
+CHILD = r"""
+import json
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu.framework.flags import set_flags
+
+FLAG_ON = %(flag)s
+set_flags({"eager_cached_grad": FLAG_ON})
+
+
+def timeit(f, n=200, warmup=20):
+    for _ in range(warmup):
+        r = f()
+    jax.block_until_ready(getattr(r, "_data", r))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f()
+    jax.block_until_ready(getattr(r, "_data", r))
+    return (time.perf_counter() - t0) / n * 1e6   # us
+
+
+out = {"flag": FLAG_ON}
+rng = np.random.default_rng(0)
+
+# ---- per-op dispatch latency
+a128 = paddle.to_tensor(rng.standard_normal((128, 128)).astype("float32"))
+b128 = paddle.to_tensor(rng.standard_normal((128, 128)).astype("float32"))
+a1k = paddle.to_tensor(rng.standard_normal((1024, 1024)).astype("float32"))
+b1k = paddle.to_tensor(rng.standard_normal((1024, 1024)).astype("float32"))
+
+with paddle.no_grad():
+    out["matmul128_nograd_us"] = round(timeit(lambda: paddle.matmul(a128, b128)), 1)
+    out["add128_nograd_us"] = round(timeit(lambda: a128 + b128), 1)
+
+a128.stop_gradient = False
+a1k.stop_gradient = False
+out["matmul128_grad_us"] = round(timeit(lambda: paddle.matmul(a128, b128)), 1)
+out["matmul1024_grad_us"] = round(timeit(lambda: paddle.matmul(a1k, b1k)), 1)
+out["add128_grad_us"] = round(timeit(lambda: a128 + b128), 1)
+
+# ---- eager train step: MLP
+paddle.seed(0)
+mlp = nn.Sequential(nn.Linear(256, 1024), nn.GELU(), nn.Linear(1024, 256))
+opt = optim.AdamW(learning_rate=1e-3, parameters=mlp.parameters())
+x = paddle.to_tensor(rng.standard_normal((32, 256)).astype("float32"))
+y = paddle.to_tensor(rng.standard_normal((32, 256)).astype("float32"))
+
+
+def mlp_step():
+    loss = ((mlp(x) - y) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return loss
+
+
+out["mlp_eager_step_us"] = round(timeit(mlp_step, n=50, warmup=10), 1)
+
+# ---- eager train step: transformer block
+from paddle_tpu.nn import MultiHeadAttention
+
+class Block(nn.Layer):
+    def __init__(self, d=256, heads=8):
+        super().__init__()
+        self.attn = MultiHeadAttention(d, heads)
+        self.ln1 = nn.LayerNorm(d)
+        self.ln2 = nn.LayerNorm(d)
+        self.fc1 = nn.Linear(d, 4 * d)
+        self.fc2 = nn.Linear(4 * d, d)
+
+    def forward(self, x):
+        h = self.ln1(x)
+        x = x + self.attn(h, h, h)
+        return x + self.fc2(nn.functional.gelu(self.fc1(self.ln2(x))))
+
+
+paddle.seed(0)
+blk = Block()
+optb = optim.AdamW(learning_rate=1e-3, parameters=blk.parameters())
+xb = paddle.to_tensor(rng.standard_normal((8, 64, 256)).astype("float32"))
+yb = paddle.to_tensor(rng.standard_normal((8, 64, 256)).astype("float32"))
+
+
+def blk_step():
+    loss = ((blk(xb) - yb) ** 2).mean()
+    loss.backward()
+    optb.step()
+    optb.clear_grad()
+    return loss
+
+
+out["transformer_block_eager_step_us"] = round(timeit(blk_step, n=30,
+                                                      warmup=5), 1)
+
+# ---- residual memory after a recorded forward (remat trade)
+import gc
+gc.collect()
+base = sum(arr.nbytes for arr in jax.live_arrays())
+loss = ((blk(xb) - yb) ** 2).mean()       # recorded forward, not yet bwd
+gc.collect()
+out["live_bytes_forward_recorded"] = \
+    sum(arr.nbytes for arr in jax.live_arrays()) - base
+loss.backward()
+optb.clear_grad()
+
+print(json.dumps(out))
+"""
+
+
+def run(flag):
+    res = subprocess.run([sys.executable, "-c", CHILD % {"flag": flag}],
+                         capture_output=True, text=True, timeout=900)
+    if res.returncode != 0:
+        raise RuntimeError(res.stderr[-2000:])
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+def main():
+    off = run(False)
+    on = run(True)
+    speedups = {
+        k.replace("_us", "_speedup"): round(off[k] / on[k], 2)
+        for k in off
+        if k.endswith("_us") and on.get(k)
+    }
+    result = {"off": off, "on": on, "on_vs_off_speedup": speedups}
+    print(json.dumps(result, indent=2))
+    return result
+
+
+if __name__ == "__main__":
+    main()
