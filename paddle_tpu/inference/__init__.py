@@ -360,3 +360,7 @@ __all__ += ["DataType", "PlaceType", "Tensor", "XpuConfig",
 from . import server  # noqa: E402,F401  (HTTP serving over the Predictor)
 from .server import InferenceServer  # noqa: E402,F401
 __all__ += ["server", "InferenceServer"]
+
+from . import paged  # noqa: E402,F401  (paged-KV serving path)
+from .paged import PagedGenerator  # noqa: E402,F401
+__all__ += ["paged", "PagedGenerator"]

@@ -1,0 +1,129 @@
+"""Paged-KV-cache serving for causal LMs (reference: the
+block_multihead_attention serving path,
+python/paddle/incubate/nn/functional/block_multihead_attention.py +
+paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu).
+
+``PagedGenerator`` drives a LlamaForCausalLM-shaped model: prefill runs
+dense causal flash attention while writing K/V into fixed-size pages;
+each decode step attends one token per sequence against the paged cache
+via the Pallas decode kernel (ops/pallas/paged_attention.py).  Sequences
+share one page pool — finished sequences free their pages immediately,
+so ragged batches don't hold rectangular KV memory (the serving win the
+reference gets from its block allocator).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, wrap_array
+from ..framework.tape import no_grad
+from ..ops.pallas.paged_attention import PagedKVCache, paged_attention
+
+
+class _PagedContext:
+    """Per-forward attention driver handed down to attention layers."""
+
+    def __init__(self, cache: PagedKVCache, seq_ids: Sequence[int],
+                 prefill: bool):
+        self.cache = cache
+        self.seq_ids = list(seq_ids)
+        self.prefill = prefill
+        self.layer_idx = 0
+
+    def attend(self, q: Tensor, k: Tensor, v: Tensor) -> Tensor:
+        """q/k/v: (batch, s, heads, head_dim) post-rope.  Writes k/v into
+        the pages, returns the attention output (batch, s, q_heads, d)."""
+        cache = self.cache
+        layer = self.layer_idx
+        for i, sid in enumerate(self.seq_ids):
+            cache.write(layer, sid, k[i]._data, v[i]._data)
+        if self.prefill:
+            # fresh sequences: the cache holds exactly this prompt, so
+            # dense causal attention over the batch is equivalent
+            from ..nn import functional as F
+            out, _ = F.flash_attention(q, k, v, causal=True)
+            return out
+        tab, lens = cache.page_table(self.seq_ids)
+        if layer < cache.num_layers - 1:
+            # length advances when the LAST layer writes; earlier layers
+            # must already count the token they just wrote
+            lens = lens + k.shape[1]
+        out = paged_attention(q._data[:, 0], cache.k_pages[layer],
+                              cache.v_pages[layer], lens, tab)
+        return wrap_array(out[:, None])      # (batch, 1, q_heads, d)
+
+
+class PagedGenerator:
+    """Batched greedy/sampled decoding over a shared page pool.
+
+    Usage::
+
+        gen = PagedGenerator(model, total_pages=512, page_size=16)
+        out_ids = gen.generate(input_ids, max_new_tokens=64)
+    """
+
+    def __init__(self, model, total_pages: int = 256, page_size: int = 16):
+        self.model = model
+        c = model.config
+        self._next_seq = 0
+        self.cache = PagedKVCache(
+            num_layers=c.num_hidden_layers,
+            kv_heads=c.num_key_value_heads,
+            head_dim=c.hidden_size // c.num_attention_heads,
+            total_pages=total_pages, page_size=page_size,
+            dtype=model.model.embed_tokens.weight._data.dtype)
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 seed: int = 0):
+        """Returns (batch, prompt + generated) token ids (numpy)."""
+        ids = np.asarray(input_ids._data if isinstance(input_ids, Tensor)
+                         else input_ids)
+        b, s = ids.shape
+        seq_ids = list(range(self._next_seq, self._next_seq + b))
+        self._next_seq += b
+        rng = np.random.default_rng(seed)
+        model = self.model
+
+        with no_grad():
+            for sid in seq_ids:
+                self.cache.allocate(sid, s)
+            ctx = _PagedContext(self.cache, seq_ids, prefill=True)
+            hidden = model.model(wrap_array(jnp.asarray(ids)),
+                                 0, paged_ctx=ctx)
+            logits = model._logits_of(hidden[:, -1:])
+
+            out = [ids]
+            finished = np.zeros(b, bool)
+            pos = s
+            for _ in range(max_new_tokens):
+                step = np.asarray(logits._data[:, -1].astype(jnp.float32))
+                if do_sample:
+                    step = step / max(temperature, 1e-6)
+                    p = np.exp(step - step.max(-1, keepdims=True))
+                    p /= p.sum(-1, keepdims=True)
+                    nxt = np.array([rng.choice(p.shape[-1], p=pi)
+                                    for pi in p])
+                else:
+                    nxt = step.argmax(-1)
+                if eos_token_id is not None:
+                    nxt = np.where(finished, eos_token_id, nxt)
+                    finished |= nxt == eos_token_id
+                out.append(nxt[:, None].astype(ids.dtype))
+                if eos_token_id is not None and finished.all():
+                    break
+                for sid in seq_ids:
+                    self.cache.allocate(sid, 1)
+                ctx = _PagedContext(self.cache, seq_ids, prefill=False)
+                hidden = model.model(
+                    wrap_array(jnp.asarray(out[-1])), pos, paged_ctx=ctx)
+                logits = model._logits_of(hidden)
+                pos += 1
+
+        for sid in seq_ids:
+            self.cache.free(sid)
+        return np.concatenate(out, axis=1)

@@ -73,19 +73,33 @@ def _rope_tables(head_dim, max_pos, theta):
 @def_op("fused_rope")
 def apply_rope(q, k, cos, sin, position_offset=0):
     """Rotary embedding on (b, s, h, d) — the reference's fused_rope kernel
-    (paddle/phi/kernels/fusion/gpu/fused_rope_*); XLA fuses this chain."""
-    s = q.shape[1]
-    c = cos[position_offset:position_offset + s][None, :, None, :]
-    si = sin[position_offset:position_offset + s][None, :, None, :]
+    (paddle/phi/kernels/fusion/gpu/fused_rope_*).  XLA fuses the chain by
+    default; per shape, ops/autotune may select the single-pass Pallas
+    kernel (ops/pallas/fused_norm_rope.py, custom_vjp so training
+    differentiates through it) on TPU."""
+    from ..ops import autotune as _autotune
+    from ..ops.pallas.fused_norm_rope import (fused_rope_fused,
+                                              fused_rope_xla)
 
-    def rot(x):
-        x1, x2 = jnp.split(x, 2, axis=-1)
-        xf1 = x1.astype(jnp.float32)
-        xf2 = x2.astype(jnp.float32)
-        o1 = xf1 * c - xf2 * si
-        o2 = xf2 * c + xf1 * si
-        return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
-    return rot(q), rot(k)
+    s = q.shape[1]
+    if not isinstance(position_offset, jax.core.Tracer) \
+            and int(position_offset) + s > cos.shape[0]:
+        raise ValueError(
+            f"rope position {int(position_offset) + s} exceeds the table "
+            f"({cos.shape[0]} = max_position_embeddings); dynamic_slice "
+            "would silently clamp and reuse the last angles")
+    c = jax.lax.dynamic_slice_in_dim(cos, position_offset, s)
+    si = jax.lax.dynamic_slice_in_dim(sin, position_offset, s)
+
+    key = f"fused_rope:{tuple(q.shape)}:{tuple(k.shape)}:{q.dtype}"
+    impl = _autotune.select(
+        key, q,
+        {"xla": lambda: fused_rope_xla(q, k, c, si),
+         "pallas": lambda: fused_rope_fused(q, k, c, si)},
+        default="xla")
+    if impl == "pallas":
+        return fused_rope_fused(q, k, c, si)
+    return fused_rope_xla(q, k, c, si)
 
 
 class LlamaAttention(Layer):
@@ -105,12 +119,17 @@ class LlamaAttention(Layer):
         self.o_proj = Linear(self.num_heads * self.head_dim, c.hidden_size,
                              weight_attr=init, bias_attr=False)
 
-    def forward(self, x, cos, sin, position_offset=0, kv_cache=None):
+    def forward(self, x, cos, sin, position_offset=0, kv_cache=None,
+                paged_ctx=None):
         b, s = x.shape[0], x.shape[1]
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         q, k = apply_rope(q, k, cos, sin, position_offset)
+        if paged_ctx is not None:
+            out = paged_ctx.attend(q, k, v)
+            return self.o_proj(
+                out.reshape([b, s, self.num_heads * self.head_dim]))
         new_cache = None
         if kv_cache is not None:
             pk, pv = kv_cache
@@ -151,9 +170,14 @@ class LlamaDecoderLayer(Layer):
                                                 epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, cos, sin, position_offset=0, kv_cache=None):
+    def forward(self, x, cos, sin, position_offset=0, kv_cache=None,
+                paged_ctx=None):
         attn_in = self.input_layernorm(x)
-        if kv_cache is not None:
+        if paged_ctx is not None:
+            attn_out = self.self_attn(attn_in, cos, sin, position_offset,
+                                      paged_ctx=paged_ctx)
+            new_cache = None
+        elif kv_cache is not None:
             attn_out, new_cache = self.self_attn(attn_in, cos, sin,
                                                  position_offset, kv_cache)
         else:
@@ -181,11 +205,16 @@ class LlamaModel(Layer):
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, input_ids, position_offset=0, kv_caches=None):
+    def forward(self, input_ids, position_offset=0, kv_caches=None,
+                paged_ctx=None):
         x = self.embed_tokens(input_ids)
         new_caches = [] if kv_caches is not None else None
         for i, layer in enumerate(self.layers):
-            if kv_caches is not None:
+            if paged_ctx is not None:
+                paged_ctx.layer_idx = i
+                x = layer(x, self.rope_cos, self.rope_sin, position_offset,
+                          paged_ctx=paged_ctx)
+            elif kv_caches is not None:
                 x, cache = layer(x, self.rope_cos, self.rope_sin,
                                  position_offset, kv_caches[i])
                 new_caches.append(cache)

@@ -635,12 +635,24 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
 
 @def_op("rms_norm_f")
 def _rms_norm(x, weight, epsilon):
-    # Fused rmsnorm: XLA fuses this fine; a Pallas variant exists for the
-    # long-seq path (ops/pallas/rmsnorm.py).
-    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
-    out = x.astype(jnp.float32) * lax.rsqrt(var + epsilon)
-    out = out.astype(x.dtype)
-    return out * weight if weight is not None else out
+    """Fused rmsnorm: XLA fuses the chain by default; per shape,
+    ops/autotune may pick the single-pass Pallas kernel
+    (ops/pallas/fused_norm_rope.py, custom_vjp so training
+    differentiates through it) on TPU."""
+    from ...ops import autotune as _autotune
+    from ...ops.pallas.fused_norm_rope import rms_norm_fused, rms_norm_xla
+
+    if weight is not None and x.ndim >= 2 \
+            and weight.shape == x.shape[-1:]:
+        key = f"rms_norm:{tuple(x.shape)}:{x.dtype}"
+        impl = _autotune.select(
+            key, x,
+            {"xla": lambda: rms_norm_xla(x, weight, epsilon),
+             "pallas": lambda: rms_norm_fused(x, weight, epsilon)},
+            default="xla")
+        if impl == "pallas":
+            return rms_norm_fused(x, weight, epsilon)
+    return rms_norm_xla(x, weight, epsilon)
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):

@@ -106,6 +106,19 @@ def _time_one(fn: Callable, repeats: int = 3) -> float:
     return best
 
 
+def select(key: str, arr, candidates: Dict[str, Callable],
+           default: str, tpu_only: bool = True) -> str:
+    """Shared impl-selection policy (attention / rmsnorm / rope):
+    under tracing use the cached winner (or default, never measure);
+    eagerly on TPU measure-and-cache; elsewhere the default."""
+    import jax
+    if isinstance(arr, jax.core.Tracer):
+        return lookup(key) or default
+    if tpu_only and jax.default_backend() != "tpu":
+        return default
+    return autotune(key, candidates, default)
+
+
 def autotune(key: str, candidates: Dict[str, Callable],
              default: str) -> str:
     """Winner for ``key``: cached if known; measured now if enabled and all

@@ -1,0 +1,247 @@
+"""Paged attention + paged KV cache for serving (TPU decode path).
+
+Capability parity: the reference's block attention serving stack —
+paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu and
+python/paddle/incubate/nn/functional/block_multihead_attention.py: KV lives
+in fixed-size pages, a per-sequence block table maps logical positions to
+pages, decode attends one query token against the paged cache.
+
+TPU-native design (see /opt/skills/guides/pallas_guide.md):
+  - the decode kernel is a Pallas grid (batch, kv_heads, pages) with the
+    page axis sequential; the page table rides in as a SCALAR-PREFETCH
+    argument so each page's BlockSpec index_map points the pipeline DMA at
+    the right page (pltpu.PrefetchScalarGridSpec) — the same mechanism
+    jax's production paged_attention kernel uses;
+  - online softmax in VMEM scratch across pages; pages past a sequence's
+    length are predicated off (@pl.when), the tail page is column-masked;
+  - GQA: the q-head group of each kv head computes together (group x
+    head_dim MXU tiles);
+  - off-TPU the same math runs as gather + dense masked attention (the
+    correctness reference).
+
+The page allocator (PagedKVCache) is host-side bookkeeping like the
+reference's BlockTable scheduler; page data lives on device.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import DEFAULT_MASK_VALUE, _use_pallas
+
+
+# ------------------------------------------------------------------ kernel
+def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, page_size):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lens_ref[b]
+    valid = p * page_size < length
+
+    @pl.when(valid)
+    def _compute():
+        q = q_ref[0]                            # (group, d)
+        k = k_ref[0, 0]                         # (page_size, d)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        cols = p * page_size + lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(cols < length, s, DEFAULT_MASK_VALUE)
+
+        m_prev = m_scr[:, :1]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_next)
+        pexp = jnp.exp(s - m_next)
+        l_scr[:] = jnp.broadcast_to(
+            alpha * l_scr[:, :1] + jnp.sum(pexp, axis=1, keepdims=True),
+            l_scr.shape)
+        acc_scr[:] = acc_scr[:] * alpha + lax.dot_general(
+            pexp.astype(v_ref.dtype), v_ref[0, 0],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_next, m_scr.shape)
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _decode_pallas(q, k_pages, v_pages, lengths, page_tables, scale,
+                   interpret=False):
+    batch, q_heads, d = q.shape
+    kv_heads, _tot, page_size, _d = k_pages.shape
+    group = q_heads // kv_heads
+    max_pages = page_tables.shape[1]
+
+    kernel = functools.partial(_decode_kernel, scale=scale,
+                               page_size=page_size)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # lengths, page_tables
+        grid=(batch, kv_heads, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, group, d),
+                         lambda b, h, p, lens, tabs: (b, h, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda b, h, p, lens, tabs: (h, tabs[b, p], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda b, h, p, lens, tabs: (h, tabs[b, p], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, group, d),
+                               lambda b, h, p, lens, tabs: (b, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, q_heads, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(lengths, page_tables, q, k_pages, v_pages)
+
+
+def _decode_xla(q, k_pages, v_pages, lengths, page_tables, scale):
+    """Gather + dense masked attention (CPU fallback / correctness ref)."""
+    batch, q_heads, d = q.shape
+    kv_heads, _tot, page_size, _d = k_pages.shape
+    group = q_heads // kv_heads
+    max_tokens = page_tables.shape[1] * page_size
+
+    # (kv_heads, batch, max_pages, page_size, d) -> (batch, kv_heads, T, d)
+    def gather(pages):
+        g = jnp.take(pages, page_tables, axis=1)
+        return g.transpose(1, 0, 2, 3, 4).reshape(
+            batch, kv_heads, max_tokens, d)
+
+    k = gather(k_pages)
+    v = gather(v_pages)
+    if group != 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    s = jnp.einsum("bhd,bhkd->bhk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    cols = jnp.arange(max_tokens)[None, None, :]
+    s = jnp.where(cols < lengths[:, None, None], s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhk,bhkd->bhd", p.astype(v.dtype), v).astype(q.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, lengths, page_tables, scale=None,
+                    interpret=False):
+    """Decode-step attention over a paged KV cache.
+
+    q:           (batch, q_heads, head_dim) — ONE new token per sequence
+    k/v_pages:   (kv_heads, total_pages, page_size, head_dim)
+    lengths:     (batch,) int32 — valid cached tokens per sequence
+                 (including the current token, already written to pages)
+    page_tables: (batch, max_pages_per_seq) int32
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if _use_pallas() or interpret:
+        return _decode_pallas(q, k_pages, v_pages, lengths, page_tables,
+                              scale, interpret=interpret)
+    return _decode_xla(q, k_pages, v_pages, lengths, page_tables, scale)
+
+
+# ------------------------------------------------------------- page cache
+class PagedKVCache:
+    """Paged KV cache: device page pools per layer + host-side page-table
+    bookkeeping (reference: the BlockTable management around
+    block_multihead_attention).
+
+    Layout per layer: (kv_heads, total_pages, page_size, head_dim).
+    """
+
+    def __init__(self, num_layers: int, kv_heads: int, head_dim: int,
+                 total_pages: int = 256, page_size: int = 16,
+                 dtype=jnp.float32):
+        self.num_layers = num_layers
+        self.kv_heads = kv_heads
+        self.head_dim = head_dim
+        self.page_size = page_size
+        self.total_pages = total_pages
+        shape = (kv_heads, total_pages, page_size, head_dim)
+        self.k_pages = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.v_pages = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self._free: List[int] = list(range(total_pages))
+        self._seq_pages: Dict[int, List[int]] = {}
+        self._seq_len: Dict[int, int] = {}
+
+    # ------------------------------------------------------- bookkeeping
+    def allocate(self, seq_id: int, n_tokens: int) -> None:
+        """Reserve pages so the sequence can hold n_tokens MORE tokens."""
+        pages = self._seq_pages.setdefault(seq_id, [])
+        need_total = -(-(self._seq_len.get(seq_id, 0) + n_tokens)
+                       // self.page_size)
+        while len(pages) < need_total:
+            if not self._free:
+                raise RuntimeError(
+                    f"PagedKVCache out of pages "
+                    f"({self.total_pages} x {self.page_size} tokens); "
+                    "free() finished sequences or grow total_pages")
+            pages.append(self._free.pop())
+
+    def free(self, seq_id: int) -> None:
+        self._free.extend(self._seq_pages.pop(seq_id, []))
+        self._seq_len.pop(seq_id, None)
+
+    def length(self, seq_id: int) -> int:
+        return self._seq_len.get(seq_id, 0)
+
+    def page_table(self, seq_ids, max_pages: Optional[int] = None):
+        """(batch, max_pages) int32 table + (batch,) lengths for a batch."""
+        tables = [self._seq_pages.get(s, []) for s in seq_ids]
+        if max_pages is None:
+            max_pages = max(1, max(len(t) for t in tables))
+        tab = np.zeros((len(seq_ids), max_pages), np.int32)
+        for i, t in enumerate(tables):
+            tab[i, :len(t)] = t
+        lens = np.asarray([self._seq_len.get(s, 0) for s in seq_ids],
+                          np.int32)
+        return jnp.asarray(tab), jnp.asarray(lens)
+
+    # ------------------------------------------------------- data writes
+    def write(self, layer: int, seq_id: int, k_new, v_new) -> None:
+        """Append (tokens, kv_heads, head_dim) k/v for one sequence into
+        its pages (call allocate() first; layer 0 advances the length)."""
+        n = k_new.shape[0]
+        start = self._seq_len.get(seq_id, 0)
+        pages = self._seq_pages[seq_id]
+        kp, vp = self.k_pages[layer], self.v_pages[layer]
+        # token t -> (page_id, slot); contiguous runs write page-at-a-time
+        t = 0
+        while t < n:
+            pos = start + t
+            page = pages[pos // self.page_size]
+            slot = pos % self.page_size
+            run = min(self.page_size - slot, n - t)
+            ks = jnp.swapaxes(k_new[t:t + run], 0, 1)   # (kv_heads, run, d)
+            vs = jnp.swapaxes(v_new[t:t + run], 0, 1)
+            kp = kp.at[:, page, slot:slot + run].set(ks.astype(kp.dtype))
+            vp = vp.at[:, page, slot:slot + run].set(vs.astype(vp.dtype))
+            t += run
+        self.k_pages[layer], self.v_pages[layer] = kp, vp
+        if layer == self.num_layers - 1:
+            self._seq_len[seq_id] = start + n
