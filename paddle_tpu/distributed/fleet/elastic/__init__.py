@@ -12,11 +12,12 @@ and signals RESTART/EXIT.  On TPU pods, preemption notices arrive as SIGTERM;
 see fault_tolerance.py for the checkpoint-resume loop.
 """
 from .manager import (  # noqa: F401
-    ElasticManager, ElasticStatus, LauncherInterface,
+    ElasticManager, ElasticStatus, ElasticController, LauncherInterface,
     ELASTIC_EXIT_CODE, ELASTIC_AUTO_PARALLEL_EXIT_CODE, launch_elastic,
 )
 
 __all__ = [
-    "ElasticManager", "ElasticStatus", "LauncherInterface",
+    "ElasticManager", "ElasticStatus", "ElasticController",
+    "LauncherInterface",
     "ELASTIC_EXIT_CODE", "ELASTIC_AUTO_PARALLEL_EXIT_CODE", "launch_elastic",
 ]

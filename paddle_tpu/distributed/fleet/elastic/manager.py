@@ -163,6 +163,168 @@ class LauncherInterface:
             self.proc.wait()
 
 
+class ElasticController:
+    """Coordinated multi-node elastic restart over the shared store
+    (VERDICT r3 item 9; reference: fleet/elastic/manager.py:125 — there
+    the HOLD/RESTART decisions ride etcd watches, here the TCPStore).
+
+    One controller per node supervises that node's trainer process.  The
+    coordination point is a RESTART GENERATION counter in the store
+    (``elastic/restart_gen``):
+
+    - a node whose trainer exits nonzero bumps the generation;
+    - a node observing membership loss (heartbeat expiry of a peer)
+      bumps it too;
+    - every controller polls the counter; on a bump it tears down its
+      local trainer, re-rendezvouses at the new generation's barrier,
+      and relaunches with ``PADDLE_ELASTIC_GEN``/``PADDLE_TRAINER_ID``
+      env — trainers resume from their checkpoint
+      (fault_tolerance.run_with_resume / dist.checkpoint).
+
+    No external scheduler: the surviving nodes restart IN PLACE once the
+    roster is whole again (a replacement node registering under a new
+    host id joins the next rendezvous).
+    """
+
+    def __init__(self, store, node_id: str, nnodes: int, cmd_factory,
+                 min_nodes: Optional[int] = None, max_restarts: int = 3,
+                 env: Optional[dict] = None, poll_interval: float = 0.1,
+                 rendezvous_timeout: float = 60.0, ttl: float = 5.0,
+                 log_dir: Optional[str] = None):
+        self._store = store
+        self.node_id = node_id
+        self.nnodes = nnodes
+        self.cmd_factory = cmd_factory      # (rank, nnodes, gen) -> argv
+        self.max_restarts = max_restarts
+        self.env = env or {}
+        self._poll = poll_interval
+        self._rdv_timeout = rendezvous_timeout
+        self.log_dir = log_dir
+        self.manager = ElasticManager(store, np=nnodes, host=node_id,
+                                      min_np=min_nodes, ttl=ttl)
+        self.generations_seen: List[int] = []
+
+    def _gen(self) -> int:
+        return self._store.add("elastic/restart_gen", 0)
+
+    def _bump(self, gen: int) -> None:
+        # bump once per incident: only advance if nobody else already has
+        if self._gen() == gen:
+            self._store.add("elastic/restart_gen", 1)
+
+    def _rendezvous(self, gen: int) -> int:
+        """Barrier: every node posts ready for the CURRENT generation and
+        waits for all ``nnodes``.  Follows further bumps while waiting so
+        concurrent incidents can't split nodes across generations."""
+        posted = set()
+        deadline = time.monotonic() + self._rdv_timeout
+        while time.monotonic() < deadline:
+            gen = max(gen, self._gen())
+            if gen not in posted:
+                self._store.add(f"elastic/gen/{gen}/ready", 1)
+                posted.add(gen)
+            if self._store.add(f"elastic/gen/{gen}/ready", 0) \
+                    >= self.nnodes:
+                return gen
+            time.sleep(self._poll)
+        raise TimeoutError(
+            f"elastic rendezvous for generation {gen} timed out "
+            f"({self._rdv_timeout}s) — roster never reached "
+            f"{self.nnodes} nodes")
+
+    def run(self) -> int:
+        restarts = 0
+        gen = self._gen()
+        while True:
+            self.manager.register()
+            try:
+                gen = self._rendezvous(gen)
+            except TimeoutError:
+                self.manager.exit(completed=False)
+                return ELASTIC_EXIT_CODE
+            self.generations_seen.append(gen)
+            if not self.manager.wait_for_np(self.nnodes,
+                                            timeout=self._rdv_timeout):
+                self.manager.exit(completed=False)
+                return ELASTIC_EXIT_CODE
+            # ranks come from the FIRST nnodes of the sorted roster: a
+            # stale (dying) entry still inside its TTL plus a fresh
+            # replacement can make the roster momentarily larger than
+            # nnodes — a node outside the window (or missing itself)
+            # retries the rendezvous instead of launching a bogus rank
+            roster = sorted(self.manager.alive_nodes())[:self.nnodes]
+            if self.node_id not in roster:
+                self._bump(gen)
+                gen = self._gen()
+                restarts += 1
+                if restarts > self.max_restarts:
+                    self.manager.exit(completed=False)
+                    return ELASTIC_EXIT_CODE
+                continue
+            rank = roster.index(self.node_id)
+            env = {**self.env,
+                   "PADDLE_TRAINER_ID": str(rank),
+                   "PADDLE_TRAINERS_NUM": str(self.nnodes),
+                   "PADDLE_ELASTIC_GEN": str(gen),
+                   "PADDLE_RESTART_COUNT": str(restarts)}
+            log = os.path.join(self.log_dir,
+                               f"{self.node_id}.gen{gen}.log") \
+                if self.log_dir else None
+            launcher = LauncherInterface(
+                self.cmd_factory(rank, self.nnodes, gen), env=env,
+                log_path=log)
+            launcher.launch()
+
+            reason = None
+            while reason is None:
+                code = launcher.watch()
+                if self._gen() > gen:
+                    reason = "peer"           # someone else called restart
+                    break
+                if code is not None:
+                    if code == 0:
+                        reason = self._await_peers_done(gen)
+                        break
+                    self._bump(gen)           # local failure: signal all
+                    reason = "local"
+                    break
+                if self.manager.watch() != ElasticStatus.HOLD:
+                    self._bump(gen)           # membership changed
+                    reason = "membership"
+                    break
+                time.sleep(self._poll)
+
+            launcher.stop()
+            if reason == "done":
+                self.manager.exit(completed=True)
+                return 0
+            restarts += 1
+            if restarts > self.max_restarts:
+                self.manager.exit(completed=False)
+                return ELASTIC_EXIT_CODE
+            gen = self._gen()
+
+    def _await_peers_done(self, gen: int) -> str:
+        """Local trainer finished cleanly: wait for every node's trainer
+        to finish this generation too (or for a restart signal — a peer
+        failing AFTER we finished still restarts everyone, data-parallel
+        training needs the full world).  A peer CONTROLLER dying (no
+        done post, no bump, heartbeat expired) triggers a restart from
+        here; the rendezvous timeout bounds the overall wait."""
+        self._store.add(f"elastic/gen/{gen}/done", 1)
+        deadline = time.monotonic() + self._rdv_timeout
+        while True:
+            if self._store.add(f"elastic/gen/{gen}/done", 0) >= self.nnodes:
+                return "done"
+            if self._gen() > gen:
+                return "peer"
+            if self.manager.watch() != ElasticStatus.HOLD \
+                    or time.monotonic() > deadline:
+                self._bump(gen)
+                return "membership"
+            time.sleep(self._poll)
+
+
 def launch_elastic(cmd: List[str], max_restarts: int = 3,
                    env: Optional[dict] = None,
                    poll_interval: float = 0.2) -> int:

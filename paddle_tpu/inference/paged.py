@@ -7,9 +7,12 @@ paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu).
 dense causal flash attention while writing K/V into fixed-size pages;
 each decode step attends one token per sequence against the paged cache
 via the Pallas decode kernel (ops/pallas/paged_attention.py).  Sequences
-share one page pool — finished sequences free their pages immediately,
-so ragged batches don't hold rectangular KV memory (the serving win the
-reference gets from its block allocator).
+share one page pool and hold only length-proportional pages (no
+rectangular max-seq allocation — the serving win the reference gets
+from its block allocator); the whole batch's pages are reclaimed when
+the batch finishes (per-sequence early free on EOS would change the
+batch shape mid-decode and recompile — a continuous-batching scheduler
+is the follow-up that needs it).
 """
 from __future__ import annotations
 
@@ -87,8 +90,20 @@ class PagedGenerator:
         seq_ids = list(range(self._next_seq, self._next_seq + b))
         self._next_seq += b
         rng = np.random.default_rng(seed)
-        model = self.model
 
+        try:
+            return self._generate(ids, seq_ids, max_new_tokens,
+                                  eos_token_id, do_sample, temperature, rng)
+        finally:
+            # an exception mid-generation (e.g. page-pool exhaustion)
+            # must not leak the batch's pages
+            for sid in seq_ids:
+                self.cache.free(sid)
+
+    def _generate(self, ids, seq_ids, max_new_tokens, eos_token_id,
+                  do_sample, temperature, rng):
+        b, s = ids.shape
+        model = self.model
         with no_grad():
             for sid in seq_ids:
                 self.cache.allocate(sid, s)
@@ -124,6 +139,4 @@ class PagedGenerator:
                 logits = model._logits_of(hidden)
                 pos += 1
 
-        for sid in seq_ids:
-            self.cache.free(sid)
         return np.concatenate(out, axis=1)

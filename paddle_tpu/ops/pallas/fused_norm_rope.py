@@ -193,14 +193,32 @@ def fused_rope_fused(q, k, cos, sin):
 
 
 def _rope_fwd(q, k, cos, sin):
-    return fused_rope_fused(q, k, cos, sin), (cos, sin)
+    return fused_rope_fused(q, k, cos, sin), (q, k, cos, sin)
 
 
 def _rope_bwd(res, g):
-    cos, sin = res
+    q, k, cos, sin = res
     gq, gk = g
     dq, dk = fused_rope_pallas(gq, gk, cos, -sin)
-    return dq, dk, jnp.zeros_like(cos), jnp.zeros_like(sin)
+
+    # true table cotangents (matching the XLA path's autodiff — tables
+    # are usually frozen buffers, but a learned/scaled rope experiment
+    # must not get silent zeros): with o1 = x1 c - x2 s, o2 = x2 c + x1 s,
+    #   dc = Σ g1 x1 + g2 x2,   ds = Σ g2 x1 - g1 x2   (over batch, heads)
+    def table_grads(x, gx):
+        half = x.shape[-1] // 2
+        x1 = x[..., :half].astype(jnp.float32)
+        x2 = x[..., half:].astype(jnp.float32)
+        g1 = gx[..., :half].astype(jnp.float32)
+        g2 = gx[..., half:].astype(jnp.float32)
+        dc = jnp.sum(g1 * x1 + g2 * x2, axis=(0, 2))
+        ds = jnp.sum(g2 * x1 - g1 * x2, axis=(0, 2))
+        return dc, ds
+
+    dc_q, ds_q = table_grads(q, gq)
+    dc_k, ds_k = table_grads(k, gk)
+    return (dq, dk, (dc_q + dc_k).astype(cos.dtype),
+            (ds_q + ds_k).astype(sin.dtype))
 
 
 fused_rope_fused.defvjp(_rope_fwd, _rope_bwd)
