@@ -208,29 +208,50 @@ class ElasticController:
         return self._store.add("elastic/restart_gen", 0)
 
     def _bump(self, gen: int) -> None:
-        # bump once per incident: only advance if nobody else already has
-        if self._gen() == gen:
+        """Advance the restart generation ONCE per incident: the store's
+        atomic counter elects a single bumper for generation ``gen`` —
+        N nodes observing the same failure concurrently still advance
+        the generation by exactly one."""
+        if self._store.add(f"elastic/incident/{gen}", 1) == 1:
             self._store.add("elastic/restart_gen", 1)
 
-    def _rendezvous(self, gen: int) -> int:
-        """Barrier: every node posts ready for the CURRENT generation and
-        waits for all ``nnodes``.  Follows further bumps while waiting so
-        concurrent incidents can't split nodes across generations."""
+    def _rendezvous(self, gen: int):
+        """Barrier + roster COMMIT: every node posts ready for the
+        current generation (following further bumps so concurrent
+        incidents can't split nodes across generations).  Once all
+        ``nnodes`` are ready — or the timeout passes with at least
+        ``min_nodes`` — ONE node (store-elected) commits the agreed
+        roster into the store; everyone derives rank and world size
+        from that single committed snapshot, so no two nodes can launch
+        with conflicting ranks.  Returns (gen, roster)."""
+        import json as _json
+
         posted = set()
         deadline = time.monotonic() + self._rdv_timeout
-        while time.monotonic() < deadline:
+        while True:
             gen = max(gen, self._gen())
             if gen not in posted:
                 self._store.add(f"elastic/gen/{gen}/ready", 1)
                 posted.add(gen)
-            if self._store.add(f"elastic/gen/{gen}/ready", 0) \
-                    >= self.nnodes:
-                return gen
+            rkey = f"elastic/gen/{gen}/roster"
+            if self._store.check(rkey):
+                return gen, _json.loads(self._store.get(rkey).decode())
+            ready = self._store.add(f"elastic/gen/{gen}/ready", 0)
+            expired = time.monotonic() > deadline
+            if ready >= self.nnodes or \
+                    (expired and ready >= self.manager.min_np):
+                if self._store.add(f"elastic/gen/{gen}/commit_lock",
+                                   1) == 1:
+                    roster = sorted(
+                        self.manager.alive_nodes())[:self.nnodes]
+                    self._store.set(rkey, _json.dumps(roster).encode())
+                    return gen, roster
+            elif expired:
+                raise TimeoutError(
+                    f"elastic rendezvous for generation {gen} timed out "
+                    f"({self._rdv_timeout}s) with {ready} < min_nodes="
+                    f"{self.manager.min_np} nodes ready")
             time.sleep(self._poll)
-        raise TimeoutError(
-            f"elastic rendezvous for generation {gen} timed out "
-            f"({self._rdv_timeout}s) — roster never reached "
-            f"{self.nnodes} nodes")
 
     def run(self) -> int:
         restarts = 0
@@ -238,41 +259,34 @@ class ElasticController:
         while True:
             self.manager.register()
             try:
-                gen = self._rendezvous(gen)
+                gen, roster = self._rendezvous(gen)
             except TimeoutError:
                 self.manager.exit(completed=False)
                 return ELASTIC_EXIT_CODE
             self.generations_seen.append(gen)
-            if not self.manager.wait_for_np(self.nnodes,
-                                            timeout=self._rdv_timeout):
-                self.manager.exit(completed=False)
-                return ELASTIC_EXIT_CODE
-            # ranks come from the FIRST nnodes of the sorted roster: a
-            # stale (dying) entry still inside its TTL plus a fresh
-            # replacement can make the roster momentarily larger than
-            # nnodes — a node outside the window (or missing itself)
-            # retries the rendezvous instead of launching a bogus rank
-            roster = sorted(self.manager.alive_nodes())[:self.nnodes]
             if self.node_id not in roster:
-                self._bump(gen)
+                # standby (e.g. a replacement beyond the committed
+                # roster): wait for the next generation, costs no restart
+                deadline = time.monotonic() + self._rdv_timeout
+                while self._gen() == gen:
+                    if time.monotonic() > deadline:
+                        self.manager.exit(completed=False)
+                        return ELASTIC_EXIT_CODE
+                    time.sleep(self._poll)
                 gen = self._gen()
-                restarts += 1
-                if restarts > self.max_restarts:
-                    self.manager.exit(completed=False)
-                    return ELASTIC_EXIT_CODE
                 continue
+            world = len(roster)
             rank = roster.index(self.node_id)
             env = {**self.env,
                    "PADDLE_TRAINER_ID": str(rank),
-                   "PADDLE_TRAINERS_NUM": str(self.nnodes),
+                   "PADDLE_TRAINERS_NUM": str(world),
                    "PADDLE_ELASTIC_GEN": str(gen),
                    "PADDLE_RESTART_COUNT": str(restarts)}
             log = os.path.join(self.log_dir,
                                f"{self.node_id}.gen{gen}.log") \
                 if self.log_dir else None
             launcher = LauncherInterface(
-                self.cmd_factory(rank, self.nnodes, gen), env=env,
-                log_path=log)
+                self.cmd_factory(rank, world, gen), env=env, log_path=log)
             launcher.launch()
 
             reason = None
@@ -283,13 +297,15 @@ class ElasticController:
                     break
                 if code is not None:
                     if code == 0:
-                        reason = self._await_peers_done(gen)
+                        reason = self._await_peers_done(gen, world)
                         break
                     self._bump(gen)           # local failure: signal all
                     reason = "local"
                     break
-                if self.manager.watch() != ElasticStatus.HOLD:
-                    self._bump(gen)           # membership changed
+                if len(self.manager.alive_nodes()) != world:
+                    # a roster node died OR a new node arrived (expand
+                    # back toward full size): restart either way
+                    self._bump(gen)
                     reason = "membership"
                     break
                 time.sleep(self._poll)
@@ -304,22 +320,20 @@ class ElasticController:
                 return ELASTIC_EXIT_CODE
             gen = self._gen()
 
-    def _await_peers_done(self, gen: int) -> str:
-        """Local trainer finished cleanly: wait for every node's trainer
-        to finish this generation too (or for a restart signal — a peer
-        failing AFTER we finished still restarts everyone, data-parallel
-        training needs the full world).  A peer CONTROLLER dying (no
-        done post, no bump, heartbeat expired) triggers a restart from
-        here; the rendezvous timeout bounds the overall wait."""
+    def _await_peers_done(self, gen: int, world: int) -> str:
+        """Local trainer finished cleanly: wait for every roster node's
+        trainer to finish this generation too (or for a restart signal —
+        a peer failing AFTER we finished still restarts everyone,
+        data-parallel training needs the full world).  Completion skew
+        is NOT a fault — there is no deadline here; a peer CONTROLLER
+        dying is caught by its heartbeat expiry (membership check)."""
         self._store.add(f"elastic/gen/{gen}/done", 1)
-        deadline = time.monotonic() + self._rdv_timeout
         while True:
-            if self._store.add(f"elastic/gen/{gen}/done", 0) >= self.nnodes:
+            if self._store.add(f"elastic/gen/{gen}/done", 0) >= world:
                 return "done"
             if self._gen() > gen:
                 return "peer"
-            if self.manager.watch() != ElasticStatus.HOLD \
-                    or time.monotonic() > deadline:
+            if len(self.manager.alive_nodes()) < world:
                 self._bump(gen)
                 return "membership"
             time.sleep(self._poll)

@@ -271,7 +271,8 @@ class TestCoordinatedElasticRestart:
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=60)
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "controllers hung"
         assert codes == {0: 0, 1: 0}, codes
 
         # both ranks completed every step after the resume
@@ -292,3 +293,49 @@ class TestCoordinatedElasticRestart:
         # starts past step 0
         lines = [l for l in trace0.splitlines() if not l.startswith("gen=0")]
         assert lines and not lines[0].endswith("step=0"), trace0
+
+    def test_degraded_world_when_peer_controller_dies(self, store,
+                                                      tmp_path):
+        """A whole peer CONTROLLER vanishing (not just its trainer) must
+        not hang the survivor: heartbeat expiry bumps the generation and
+        the survivor re-rendezvouses at min_nodes with a REDUCED world."""
+        import threading
+
+        trainer = str(tmp_path / "trainer.py")
+        with open(trainer, "w") as f:
+            f.write(
+                "import json, os, sys, time\n"
+                "time.sleep(0.3)\n"
+                "json.dump({'world': os.environ['PADDLE_TRAINERS_NUM'],"
+                " 'gen': os.environ['PADDLE_ELASTIC_GEN']},"
+                " open(sys.argv[1] + '/run_' +"
+                " os.environ['PADDLE_ELASTIC_GEN'] + '_' +"
+                " os.environ['PADDLE_TRAINER_ID'] + '.json', 'w'))\n")
+
+        def factory(rank, nnodes, gen):
+            return [sys.executable, trainer, str(tmp_path)]
+
+        survivor = ElasticController(
+            store, node_id="sv", nnodes=2, cmd_factory=factory,
+            min_nodes=1, max_restarts=3, poll_interval=0.05,
+            rendezvous_timeout=4, ttl=0.6)
+        # the doomed peer: registers (so gen-0 rendezvous completes at
+        # full size) then its controller "crashes" — heartbeat stops
+        doomed = ElasticManager(store, np=2, host="dd", ttl=0.6,
+                                heartbeat_interval=0.1)
+        doomed.register()
+        store.add("elastic/gen/0/ready", 1)   # doomed posts ready, then dies
+
+        def kill_later():
+            time.sleep(0.6)
+            doomed._stop.set()                # heartbeat thread halts
+
+        threading.Thread(target=kill_later).start()
+        code = survivor.run()
+        assert code == 0, code
+        import json, glob
+        runs = sorted(glob.glob(str(tmp_path / "run_*.json")))
+        final = json.load(open(runs[-1]))
+        assert final["world"] == "1", (runs, final)   # degraded world
+        assert int(final["gen"]) >= 1
+        assert len(survivor.generations_seen) >= 2

@@ -1,0 +1,147 @@
+"""Loss-curve parity harness (VERDICT r3 item 10; BASELINE north star:
+"loss-curve parity").
+
+Fixed-seed LLaMA-small pretrain through the framework path
+(jit.TrainStep + AdamW) on synthetic fixed-seed data, logging the loss
+per step.  Modes:
+
+  python tools/loss_curve.py                      # emit curve JSON to stdout
+  python tools/loss_curve.py --steps 200 --out tools/loss_curve_ref.json
+  python tools/loss_curve.py --check tools/loss_curve_ref.json
+      # regress the current build against the committed reference curve:
+      # round-over-round drift beyond tolerance fails loudly
+  python tools/loss_curve.py --bf16-check
+      # bf16-vs-fp32 divergence bound: same seed, both precisions; the
+      # curve gap must stay within the master-weight tolerance band
+
+The committed reference (tools/loss_curve_ref.json) is the CPU fp32
+curve — deterministic per jax version; each round re-runs --check so a
+numerics regression anywhere in the stack (ops, autograd, optimizer,
+TrainStep) shows up as curve drift.  reference analog: the convergence
+tests of test/legacy_test (e.g. test_dist_train convergence asserts).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def run_curve(steps=200, dtype="float32", seed=0, batch=4, seq=128):
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as optim
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=seq)
+    paddle.seed(seed)
+    model = LlamaForCausalLM(cfg)
+    if dtype == "bfloat16":
+        for p in model.parameters():
+            if p._data.dtype == jnp.float32:
+                p._data = p._data.astype(jnp.bfloat16)
+    opt = optim.AdamW(learning_rate=3e-4, parameters=model.parameters(),
+                      multi_precision=(dtype == "bfloat16"))
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]).astype("float32"),
+            labels.reshape([-1]))
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(seed)
+    # a fixed synthetic corpus: 32 batches cycled — the curve must DROP
+    # (memorization) so optimizer/grad regressions surface as slope loss
+    data = [rng.integers(0, cfg.vocab_size,
+                         (batch, seq + 1)).astype("int32")
+            for _ in range(32)]
+    losses = []
+    for i in range(steps):
+        ids = data[i % len(data)]
+        loss = step(paddle.to_tensor(ids[:, :-1]),
+                    paddle.to_tensor(ids[:, 1:]))
+        losses.append(round(float(np.asarray(loss._data)), 6))
+    return {"model": "llama-tiny(2L,128h,512v)", "steps": steps,
+            "batch": batch, "seq": seq, "seed": seed, "dtype": dtype,
+            "optimizer": "AdamW(3e-4)", "jax": jax.__version__,
+            "losses": losses}
+
+
+def check_against(ref_path, atol=2e-3, rtol=2e-3):
+    ref = json.load(open(ref_path))
+    cur = run_curve(steps=ref["steps"], dtype=ref["dtype"],
+                    seed=ref["seed"], batch=ref["batch"], seq=ref["seq"])
+    a = np.asarray(ref["losses"])
+    b = np.asarray(cur["losses"])
+    worst = int(np.argmax(np.abs(a - b)))
+    report = {
+        "metric": "loss_curve_parity",
+        "ref_jax": ref.get("jax"), "cur_jax": cur["jax"],
+        "max_abs_dev": round(float(np.abs(a - b).max()), 6),
+        "worst_step": worst,
+        "final_ref": a[-1], "final_cur": float(b[-1]),
+        "ok": bool(np.allclose(a, b, atol=atol, rtol=rtol)),
+    }
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+def bf16_check(steps=100, max_final_gap=0.35, max_mean_gap=0.25):
+    """bf16 (with fp32 master weights) must track the fp32 curve within a
+    tolerance band — the divergence bound BASELINE config 5 asks for."""
+    f32 = np.asarray(run_curve(steps=steps, dtype="float32")["losses"])
+    bf16 = np.asarray(run_curve(steps=steps, dtype="bfloat16")["losses"])
+    gap = np.abs(f32 - bf16)
+    report = {
+        "metric": "bf16_vs_fp32_loss_divergence",
+        "steps": steps,
+        "mean_gap": round(float(gap.mean()), 4),
+        "final_gap": round(float(gap[-1]), 4),
+        "final_f32": float(f32[-1]), "final_bf16": float(bf16[-1]),
+        "ok": bool(gap[-1] <= max_final_gap
+                   and gap.mean() <= max_mean_gap
+                   and bf16[-1] < bf16[0]),   # bf16 must LEARN too
+    }
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out")
+    ap.add_argument("--check")
+    ap.add_argument("--bf16-check", action="store_true")
+    args = ap.parse_args()
+
+    if args.check:
+        sys.exit(check_against(args.check))
+    if args.bf16_check:
+        sys.exit(bf16_check())
+    curve = run_curve(steps=args.steps, dtype=args.dtype, seed=args.seed)
+    text = json.dumps(curve)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}: final loss {curve['losses'][-1]}")
+    else:
+        print(text)
+
+
+if __name__ == "__main__":
+    main()
