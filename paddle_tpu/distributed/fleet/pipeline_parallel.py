@@ -103,10 +103,53 @@ def _pp_mesh(mesh: Optional[ProcessMesh], axis: str,
 #:   1F1B   : rematerialize per microbatch — peak activations O(stages),
 #:            the 1F1B footprint; XLA owns instruction-level overlap
 #:   VPP    : interleaved virtual chunks (smaller per-stage layer groups)
-#:   ZB     : 1F1B memory; the weight-grad/input-grad split that makes the
-#:            bubble "zero" is instruction scheduling, which XLA performs on
-#:            the fused backward program (no hand schedule needed on TPU)
+#:   ZB     : accepted for reference API parity; runs the 1F1B policy.
+#:
+#: Why there is NO hand-scheduled zero-bubble here (measured analysis,
+#: tools/pp_schedule_bench.py): ZB's dW/dX split fills *idle* stage time
+#: in MPMD runtimes (reference: pipeline_zero_bubble.py runs per-rank
+#: instruction streams).  This pipeline is one SPMD program — shard_map
+#: + ppermute run every stage in lockstep, so a "bubble" tick is not
+#: idle time but masked compute that executes anyway; per-device wall
+#: time is T x tick_cost regardless of scheduling.  Splitting dW out of
+#: the reverse ring at stage granularity costs 2T + 2Mv tick-units
+#: (ring recompute+dX, then a dW sweep that must recompute activations)
+#: vs plain autodiff's 3T, winning only when M*v < S — i.e. never at
+#: production microbatch counts.  The lever that DOES shrink wasted
+#: ticks in this formulation is interleaving: VPP divides the fill/drain
+#: overhead by v, which pp_schedule_bench measures directly.
 SCHEDULES = ("FThenB", "1F1B", "VPP", "ZB")
+
+
+def schedule_stats(schedule: str, num_stages: int, num_microbatches: int,
+                   num_virtual_stages: int = 1):
+    """Pure arithmetic on (schedule, S, M, v) — no stack required; the
+    PipelineStack method delegates here and tools/pp_schedule_bench.py
+    uses it directly for the bubble table."""
+    S, M, v = num_stages, num_microbatches, num_virtual_stages
+    if v > 1 and M % S != 0:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches ({M}) "
+            f"divisible by num_stages ({S}) — these stats would "
+            f"describe a schedule forward() refuses to run")
+    n_groups = -(-M // S)
+    GV = n_groups * v
+    T = GV * S + S
+    busy = np.zeros(S, np.int64)
+    for t in range(T):
+        for s in range(S):
+            u = t - s
+            G, i = u // S, u % S
+            if u >= 0 and G < GV and (G // v) * S + i < M:
+                busy[s] += 1
+    return {
+        "schedule": schedule,
+        "ticks": T,
+        "per_stage_busy_ticks": busy.tolist(),
+        "per_stage_utilization": (busy / T).round(4).tolist(),
+        "bubble_fraction": round(1.0 - float(busy.sum()) / (T * S), 4),
+        "relative_step_time": round(T / v, 2),
+    }
 
 
 class PipelineStack(Layer):
@@ -213,31 +256,9 @@ class PipelineStack(Layer):
         (ticks x per-tick cost 1/v): the number the interleaved schedule
         shrinks.  reference: the bubble analysis in
         fleet/meta_parallel/pipeline_parallel.py:1179 (interleaved 1F1B)."""
-        S, M, v = self.num_stages, self.num_microbatches, \
-            self.num_virtual_stages
-        if v > 1 and M % S != 0:
-            raise ValueError(
-                f"interleaved schedule needs num_microbatches ({M}) "
-                f"divisible by num_stages ({S}) — these stats would "
-                f"describe a schedule forward() refuses to run")
-        n_groups = -(-M // S)
-        GV = n_groups * v
-        T = GV * S + S
-        busy = np.zeros(S, np.int64)
-        for t in range(T):
-            for s in range(S):
-                u = t - s
-                G, i = u // S, u % S
-                if u >= 0 and G < GV and (G // v) * S + i < M:
-                    busy[s] += 1
-        return {
-            "schedule": self.schedule,
-            "ticks": T,
-            "per_stage_busy_ticks": busy.tolist(),
-            "per_stage_utilization": (busy / T).round(4).tolist(),
-            "bubble_fraction": round(1.0 - float(busy.sum()) / (T * S), 4),
-            "relative_step_time": round(T / v, 2),
-        }
+        return schedule_stats(self.schedule, self.num_stages,
+                              self.num_microbatches,
+                              self.num_virtual_stages)
 
     def forward(self, x):
         """x: (microbatches, mb_size, ...) or (batch, ...) auto-split.
