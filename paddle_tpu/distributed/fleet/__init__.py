@@ -43,6 +43,13 @@ class DistributedStrategy:
         self.find_unused_parameters = False
         self.gradient_merge = False
         self.gradient_merge_configs = {}
+        # meta-optimizer pipeline (reference: fleet/meta_optimizers/)
+        self.lars = False
+        self.lars_configs = {}
+        self.dgc = False
+        self.dgc_configs = {}
+        self.localsgd = False
+        self.localsgd_configs = {}
 
 
 class _Fleet:
@@ -89,7 +96,18 @@ class _Fleet:
         return model
 
     def distributed_optimizer(self, optimizer, strategy=None):
-        """reference: fleet.distributed_optimizer (fleet.py:1427)."""
+        """reference: fleet.distributed_optimizer (fleet.py:1427).
+
+        Order matters: meta-optimizer CONVERSIONS (lars) run first,
+        ZeRO state sharding patches the resulting real Optimizer's
+        _init_slot, and the DGC/LocalSGD WRAPPERS go outermost — a
+        wrapper between shard_optimizer and the Optimizer would absorb
+        the _init_slot patch and silently disable state sharding."""
+        from .meta_optimizers import (convert_meta_optimizers,
+                                      wrap_meta_optimizers)
+        strat = strategy or self._strategy
+        if strat is not None:
+            optimizer = convert_meta_optimizers(optimizer, strat)
         if self._hcg is not None and \
                 self._hcg.get_sharding_parallel_world_size() > 1:
             from ..auto_parallel.api import shard_optimizer as _shard_opt
@@ -101,7 +119,9 @@ class _Fleet:
                 if p.ndim > 0 and p.shape[0] % mesh.get_dim_size("sharding") == 0:
                     placements[mesh.dim_names.index("sharding")] = Shard(0)
                 return placements, mesh
-            return _shard_opt(optimizer, shard_fn)
+            optimizer = _shard_opt(optimizer, shard_fn)
+        if strat is not None:
+            optimizer = wrap_meta_optimizers(optimizer, strat)
         return optimizer
 
     @property

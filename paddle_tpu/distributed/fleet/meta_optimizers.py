@@ -1,0 +1,264 @@
+"""Strategy-driven meta optimizers (reference:
+python/paddle/distributed/fleet/meta_optimizers/ — lars_optimizer.py,
+localsgd_optimizer.py, dgc_optimizer.py), applied by
+``fleet.distributed_optimizer`` when the DistributedStrategy enables them.
+
+TPU-native mapping: the reference builds these as graph passes over the
+static program; here they are optimizer conversions/wrappers over the
+fused eager step —
+  - LARS: layer-wise adaptive rate scaling folded into the per-param
+    update (one jitted step, like every other optimizer); applies only
+    to Momentum, like the reference's _can_apply guard;
+  - LocalSGD: workers step independently and average parameters every
+    k steps over the cross-process eager lane (in-SPMD data parallelism
+    already averages gradients every step, so LocalSGD only changes
+    behavior on the multi-process lane — same as the reference, where it
+    exists to cut allreduce frequency);
+  - DGC: momentum correction + top-k gradient sparsification with error
+    feedback; the sparsified gradient is what crosses the wire on the
+    eager lane.  DGC OWNS the momentum (the reference's
+    DGCMomentumOptimizer replaces the momentum op): a Momentum inner has
+    its own velocity disabled to avoid double momentum.
+
+Ordering with ZeRO-1 (fleet.distributed_optimizer): LARS CONVERTS the
+optimizer first, shard_optimizer then patches the real Optimizer's
+_init_slot, and the DGC/LocalSGD WRAPPERS go on outermost — so state
+sharding still reaches the inner optimizer.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Momentum, Optimizer
+
+
+class LarsMomentum(Momentum):
+    """LARS (You et al. 2017): per-layer trust ratio
+    ``coeff * ||w|| / (||g|| + wd * ||w|| + eps)`` scales the learning
+    rate (reference: fleet/meta_optimizers/lars_optimizer.py wrapping
+    Momentum).  Params matching ``exclude_from_weight_decay`` substrings
+    skip both the decay and the trust scaling (reference behavior)."""
+
+    _state_slots = ["velocity", "decay_on"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, epsilon=1e-9,
+                 exclude_from_weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate=learning_rate, momentum=momentum,
+                         parameters=parameters, weight_decay=None,
+                         grad_clip=grad_clip,
+                         multi_precision=multi_precision, name=name)
+        self.lars_coeff = float(lars_coeff)
+        self.lars_weight_decay = float(lars_weight_decay)
+        self.epsilon = float(epsilon)
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _init_slot(self, slot, p):
+        if slot == "decay_on":
+            name = getattr(p, "name", "") or ""
+            excluded = any(tok in name for tok in self._exclude)
+            return jnp.asarray(0.0 if excluded else 1.0, jnp.float32)
+        return super()._init_slot(slot, p)
+
+    def _update_rule(self, param, grad, state, lr, step):
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(param.astype(jnp.float32))))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(grad.astype(jnp.float32))))
+        decay_on = state["decay_on"].astype(jnp.float32)
+        wd = self.lars_weight_decay * decay_on
+        trust = self.lars_coeff * w_norm / (
+            g_norm + wd * w_norm + self.epsilon)
+        # excluded params (and ||w||==0 zeros-init) use the plain rate
+        local_lr = jnp.where((w_norm > 0) & (decay_on > 0),
+                             lr * trust, lr)
+        g = grad.astype(jnp.float32) + wd * param.astype(jnp.float32)
+        vel = state["velocity"].astype(jnp.float32)
+        vel = self._momentum * vel + local_lr * g
+        new_param = (param.astype(jnp.float32) - vel).astype(param.dtype)
+        return new_param, {"velocity": vel.astype(state["velocity"].dtype),
+                           "decay_on": state["decay_on"]}
+
+
+class LocalSGD:
+    """Average parameters across workers every ``k_steps`` inner steps
+    (reference: fleet/meta_optimizers/localsgd_optimizer.py).  Wraps any
+    inner optimizer; delegates everything else to it."""
+
+    def __init__(self, inner: Optimizer, k_steps: int = 1,
+                 begin_step: int = 1):
+        self.inner = inner
+        self.k_steps = max(int(k_steps), 1)
+        self.begin_step = int(begin_step)
+        self._local_steps = 0
+
+    def step(self):
+        self.inner.step()
+        self._local_steps += 1
+        if self._local_steps >= self.begin_step and \
+                self._local_steps % self.k_steps == 0:
+            self._sync_params()
+
+    def _sync_params(self):
+        from .. import collective
+
+        if collective._host_world() <= 1:
+            return                      # SPMD lane averages grads already
+        from ..collective import ReduceOp, all_reduce
+        for p in self.inner._parameter_list:
+            all_reduce(p, op=ReduceOp.AVG)
+
+    def state_dict(self):
+        sd = self.inner.state_dict()
+        sd["localsgd_local_steps"] = self._local_steps
+        return sd
+
+    def set_state_dict(self, sd):
+        self._local_steps = int(sd.pop("localsgd_local_steps",
+                                       self._local_steps))
+        return self.inner.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class DGCMomentum:
+    """Deep Gradient Compression (Lin et al. 2018; reference:
+    fleet/meta_optimizers/dgc_optimizer.py): momentum correction + top-k
+    gradient sparsification with error feedback.  Before
+    ``rampup_begin_step`` the inner optimizer runs untouched; afterwards
+    each param's gradient is replaced by the top-``(1 - sparsity)``
+    fraction (by magnitude) of the velocity-corrected accumulator, the
+    remainder staying local as error feedback.  ``sparsity`` may be a
+    warmup LIST: each entry holds for ``rampup_step`` steps (reference
+    config contract).
+
+    DGC owns the momentum: a Momentum inner has its own velocity
+    neutralized (the reference's DGCMomentumOptimizer REPLACES the
+    momentum op rather than stacking a second one)."""
+
+    def __init__(self, inner: Optimizer, rampup_begin_step: int = 0,
+                 sparsity=(0.999,), momentum: float = 0.9,
+                 rampup_step: int = 1):
+        self.inner = inner
+        self.rampup_begin_step = int(rampup_begin_step)
+        self.sparsity = tuple(sparsity) if not isinstance(
+            sparsity, (int, float)) else (float(sparsity),)
+        self.rampup_step = max(int(rampup_step), 1)
+        self.momentum = float(momentum)
+        if isinstance(inner, Momentum):
+            inner._momentum = 0.0       # avoid double momentum
+        self._step_count = 0
+        self._u = {}                    # momentum-corrected accumulation
+        self._v = {}                    # error feedback
+
+    def _current_sparsity(self):
+        idx = max(self._step_count - self.rampup_begin_step, 0) \
+            // self.rampup_step
+        return self.sparsity[min(idx, len(self.sparsity) - 1)]
+
+    def _compress(self, p):
+        g = p.grad._data.astype(jnp.float32)
+        pid = id(p)
+        u = self._u.get(pid)
+        u = g if u is None else self.momentum * u + g
+        v = self._v.get(pid)
+        v = u if v is None else v + u
+        sp = self._current_sparsity()
+        k = max(int(round(v.size * (1.0 - sp))), 1)
+        flat = jnp.abs(v.reshape(-1))
+        thresh = jax.lax.top_k(flat, k)[0][-1]
+        mask = jnp.abs(v) >= thresh
+        sent = jnp.where(mask, v, 0.0)
+        self._u[pid] = jnp.where(mask, 0.0, u)
+        self._v[pid] = jnp.where(mask, 0.0, v)
+        return sent.astype(p.grad._data.dtype)
+
+    def step(self):
+        if self._step_count >= self.rampup_begin_step:
+            for p in self.inner._parameter_list:
+                if p.grad is None or not getattr(p, "trainable", True):
+                    continue
+                p.grad._data = self._compress(p)
+        self._step_count += 1
+        self.inner.step()
+
+    def state_dict(self):
+        sd = self.inner.state_dict()
+        order = {id(p): i for i, p in enumerate(self.inner._parameter_list)}
+        sd["dgc_step_count"] = self._step_count
+        sd["dgc_u"] = {order[pid]: np.asarray(a)
+                       for pid, a in self._u.items() if pid in order}
+        sd["dgc_v"] = {order[pid]: np.asarray(a)
+                       for pid, a in self._v.items() if pid in order}
+        return sd
+
+    def set_state_dict(self, sd):
+        self._step_count = int(sd.pop("dgc_step_count", self._step_count))
+        params = self.inner._parameter_list
+        for key, store in (("dgc_u", "_u"), ("dgc_v", "_v")):
+            saved = sd.pop(key, None)
+            if saved is not None:
+                setattr(self, store,
+                        {id(params[int(i)]): jnp.asarray(a)
+                         for i, a in saved.items()
+                         if int(i) < len(params)})
+        return self.inner.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def convert_meta_optimizers(optimizer: Optimizer, strategy):
+    """CONVERSION stage (runs before ZeRO sharding patches _init_slot):
+    strategy.lars turns a Momentum into LarsMomentum (reference
+    _can_apply: LARS applies to Momentum only; other optimizers warn and
+    pass through unchanged)."""
+    if getattr(strategy, "lars", False):
+        if type(optimizer) is not Momentum:
+            warnings.warn(
+                f"strategy.lars applies to Momentum only (reference "
+                f"LarsOptimizer._can_apply); leaving "
+                f"{type(optimizer).__name__} unchanged", stacklevel=3)
+        else:
+            cfg = getattr(strategy, "lars_configs", {}) or {}
+            optimizer = LarsMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                parameters=optimizer._parameter_list,
+                lars_coeff=cfg.get("lars_coeff", 0.001),
+                lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                epsilon=cfg.get("epsilon", 1e-9),
+                exclude_from_weight_decay=cfg.get(
+                    "exclude_from_weight_decay", None),
+                grad_clip=optimizer._grad_clip,
+                multi_precision=optimizer._multi_precision)
+    return optimizer
+
+
+def wrap_meta_optimizers(optimizer, strategy):
+    """WRAPPER stage (outermost, after any state sharding)."""
+    if getattr(strategy, "dgc", False):
+        cfg = getattr(strategy, "dgc_configs", {}) or {}
+        optimizer = DGCMomentum(
+            optimizer,
+            rampup_begin_step=cfg.get("rampup_begin_step", 0),
+            sparsity=cfg.get("sparsity", [0.999]),
+            rampup_step=cfg.get("rampup_step", 1),
+            momentum=getattr(optimizer, "_momentum", 0.9))
+    if getattr(strategy, "localsgd", False):
+        cfg = getattr(strategy, "localsgd_configs", {}) or {}
+        optimizer = LocalSGD(optimizer,
+                             k_steps=cfg.get("k_steps", 1),
+                             begin_step=cfg.get("begin_step", 1))
+    return optimizer
+
+
+def apply_meta_optimizers(optimizer: Optimizer, strategy):
+    """Both stages, for callers without a sharding step in between."""
+    return wrap_meta_optimizers(
+        convert_meta_optimizers(optimizer, strategy), strategy)
