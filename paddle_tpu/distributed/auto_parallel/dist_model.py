@@ -204,13 +204,35 @@ class DistModel:
         self._eval_fn = None
 
     def dist_main_program(self, mode=None):
-        """reference: DistModel.dist_main_program — the partitioned program
-        text; here the StableHLO of the compiled step (one SPMD program)."""
-        if self._train_step is not None and \
-                self._train_step._compiled is not None:
-            return "<compiled whole-step XLA program; use " \
-                   "TrainStep.memory_analysis(return_hlo=True) for HLO>"
-        return "<not compiled yet — run one step first>"
+        """reference: DistModel.dist_main_program — the partitioned
+        program text.  Here: a parameter-placement table followed by the
+        compiled whole-step program as StableHLO (ONE SPMD program; the
+        reference prints a per-rank partitioned fragment instead).
+        Shardings appear as sdy.sharding (Shardy) attributes in the
+        text."""
+        header = ["== parameter placements =="]
+        for name, p in self.network.named_parameters():
+            attr = getattr(p, "dist_attr", None)
+            if attr is not None:
+                mesh = attr.process_mesh
+                header.append(
+                    f"{name}: shape={list(p.shape)} "
+                    f"mesh={dict(zip(mesh.dim_names, mesh.shape))} "
+                    f"placements={attr.placements}")
+            else:
+                header.append(f"{name}: shape={list(p.shape)} replicated")
+        text = None
+        if self._train_step is not None:
+            text = self._train_step.program_text()
+        if text is None:
+            if self._optimizer is None:
+                text = ("<eval/predict-only DistModel: the program is a "
+                        "cached jitted forward; no whole-step train "
+                        "program exists in this mode>")
+            else:
+                text = "<not compiled yet — run one train step first>"
+        return "\n".join(header) + "\n\n== whole-step program " \
+            "(StableHLO) ==\n" + text
 
 
 def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,

@@ -383,6 +383,15 @@ class TrainStep:
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         stepno = jnp.asarray(opt._global_step, jnp.int32)
 
+        # signature only (no arrays pinned): lets program_text() lower the
+        # compiled step later without holding batch data alive; shardings
+        # ride along so the lowered text matches the executed partitioning
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                        sharding=_keep(a))
+
+        self._last_sig = ([sds(a) for a in in_leaves],
+                          [sds(a) for a in label_leaves], treedefs)
         states, masters = self._stage_in()
         (loss, outs, self._arrays, self._states, self._masters,
          self._grad_accum) = self._compiled(
@@ -395,6 +404,36 @@ class TrainStep:
         return self._last_loss
 
     # -------------------------------------------------------------- analysis
+    def _lower(self, in_leaves, label_leaves, treedefs, as_avals=False):
+        """Single lowering call site shared by memory_analysis and
+        program_text, so the argument list cannot drift from the compiled
+        signature.  ``as_avals=True`` lowers the params/state operands as
+        ShapeDtypeStructs carrying the staged shardings — no arrays are
+        materialized (in boundary-mode offload, _stage_in would otherwise
+        device_put the whole host-resident state just to lower)."""
+        frozen = [p._data for p in self._frozen_params]
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        stepno = jnp.asarray(self.optimizer._global_step + 1, jnp.int32)
+        if as_avals:
+            def staged_sds(a):
+                if a is None:
+                    return None
+                return jax.ShapeDtypeStruct(
+                    a.shape, a.dtype, sharding=_device_kind(_keep(a)))
+
+            arrays = [staged_sds(a) for a in self._arrays]
+            states = {k: [staged_sds(a) for a in v]
+                      for k, v in self._states.items()}
+            masters = [staged_sds(m) for m in self._masters]
+            accum = [staged_sds(a) for a in self._grad_accum]
+        else:
+            arrays = self._arrays
+            states, masters = self._stage_in()
+            accum = self._grad_accum
+        return self._compiled.lower(
+            arrays, states, masters, accum, frozen, lr, stepno,
+            jnp.asarray(True), in_leaves, label_leaves, treedefs)
+
     def memory_analysis(self, inputs, labels=(), return_hlo=False):
         """Per-device compiled memory profile of the whole train step
         (argument/output/temp/alias bytes) — the observability the
@@ -412,13 +451,7 @@ class TrainStep:
         cached = getattr(self, "_mem_cache", {}).get(key)
         if cached is not None:
             return dict(cached)
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        stepno = jnp.asarray(self.optimizer._global_step + 1, jnp.int32)
-        states, masters = self._stage_in()
-        lowered = self._compiled.lower(
-            self._arrays, states, masters, self._grad_accum,
-            frozen, lr, stepno, jnp.asarray(True), in_leaves, label_leaves,
-            treedefs)
+        lowered = self._lower(in_leaves, label_leaves, treedefs)
         compiled = lowered.compile()
         mem = compiled.memory_analysis()
         try:   # XLA's analytic FLOP count for the WHOLE step program —
@@ -449,6 +482,26 @@ class TrainStep:
             self._mem_cache = {}
         self._mem_cache[key] = dict(out)
         return out
+
+    def program_text(self) -> Optional[str]:
+        """The whole-step program as StableHLO text (the TPU-native analog
+        of the reference's partitioned dist_main_program) — available
+        after the first call; shardings appear as sdy.sharding (Shardy)
+        attributes.  Lowered from avals only (no state materialized) and
+        memoized per signature."""
+        sig = getattr(self, "_last_sig", None)
+        if self._compiled is None or sig is None:
+            return None
+        in_sds, label_sds, treedefs = sig
+        key = (tuple((s.shape, str(s.dtype)) for s in in_sds + label_sds),
+               treedefs)
+        cache = getattr(self, "_program_text_cache", None)
+        if cache is not None and cache[0] == key:
+            return cache[1]
+        text = self._lower(in_sds, label_sds, treedefs,
+                           as_avals=True).as_text()
+        self._program_text_cache = (key, text)
+        return text
 
     # ------------------------------------------------------------------- sync
     def sync(self):
