@@ -279,6 +279,58 @@ def bench_ocr_crnn(on_tpu):
     }
 
 
+def bench_paged_decode(on_tpu):
+    """Serving decode throughput: batched autoregressive decode through
+    the paged-KV path (PagedGenerator + the Pallas paged-attention
+    kernel on TPU) — the reference's block_multihead_attention serving
+    benchmark shape."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.paged import PagedGenerator
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=768, intermediate_size=2048,
+            num_hidden_layers=12, num_attention_heads=12,
+            max_position_embeddings=2048, dtype="bfloat16")
+        batch, prompt, decode = 8, 128, 32
+        # 8 x (128 + 32) tokens needs ~80 pages; 256 keeps headroom while
+        # staying far from the chip's OOM-wedge regime (BENCH_r01 history)
+        pages, page_size = 256, 16
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                          intermediate_size=128, num_hidden_layers=2,
+                          num_attention_heads=4,
+                          max_position_embeddings=256)
+        batch, prompt, decode = 2, 16, 8
+        pages, page_size = 64, 8
+
+    model = LlamaForCausalLM(cfg)
+    gen = PagedGenerator(model, total_pages=pages, page_size=page_size)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype("int32")
+
+    gen.generate(ids, max_new_tokens=4)        # warmup (compile caches)
+    # prefill-only timing (prompt forward + 1 token) so the decode metric
+    # measures pure steady-state decode, not prefill
+    t0 = time.perf_counter()
+    gen.generate(ids, max_new_tokens=1)
+    t_prefill = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = gen.generate(ids, max_new_tokens=decode)
+    t_full = time.perf_counter() - t0
+    decode_tokens = (out.shape[1] - prompt - 1) * batch
+    dt = max(t_full - t_prefill, 1e-9)
+    return {
+        "metric": "llama_110m_paged_decode_tokens_per_sec",
+        "value": round(decode_tokens / dt, 1), "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "batch": batch, "prompt_len": prompt,
+        "prefill_ms": round(t_prefill * 1e3, 1),
+        "path": "PagedGenerator + paged-attention decode kernel",
+    }
+
+
 def bench_dp_scaling():
     """BASELINE config 4 (shape only): DP ResNet weak-scaling efficiency on
     an 8-device virtual CPU mesh, measured in a CPU-pinned subprocess so it
@@ -363,7 +415,8 @@ def main():
 
     suite = []
     errors = []
-    for fn in (bench_resnet_cifar, bench_bert_sst2, bench_ocr_crnn):
+    for fn in (bench_resnet_cifar, bench_bert_sst2, bench_ocr_crnn,
+               bench_paged_decode):
         try:
             suite.append(fn(on_tpu))
         except Exception as e:  # noqa: BLE001

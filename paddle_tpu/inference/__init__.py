@@ -358,8 +358,8 @@ __all__ += ["DataType", "PlaceType", "Tensor", "XpuConfig",
             "get_trt_runtime_version", "convert_to_mixed_precision"]
 
 from . import server  # noqa: E402,F401  (HTTP serving over the Predictor)
-from .server import InferenceServer  # noqa: E402,F401
-__all__ += ["server", "InferenceServer"]
+from .server import GenerationServer, InferenceServer  # noqa: E402,F401
+__all__ += ["server", "InferenceServer", "GenerationServer"]
 
 from . import paged  # noqa: E402,F401  (paged-KV serving path)
 from .paged import PagedGenerator  # noqa: E402,F401

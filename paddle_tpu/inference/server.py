@@ -22,10 +22,52 @@ import numpy as np
 
 from . import Config, Predictor, PredictorPool
 
-__all__ = ["InferenceServer", "serve"]
+__all__ = ["InferenceServer", "GenerationServer", "serve"]
 
 
-class InferenceServer:
+class _JsonHandler(BaseHTTPRequestHandler):
+    """Shared HTTP plumbing: quiet logs + JSON replies."""
+
+    def log_message(self, fmt, *args):   # quiet by default
+        pass
+
+    def _reply(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n))
+
+
+class _ServerLifecycle:
+    """start/stop/context-manager block shared by both servers."""
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class InferenceServer(_ServerLifecycle):
     """Serve a jit.save artifact over HTTP.
 
     Usage::
@@ -51,18 +93,7 @@ class InferenceServer:
         self._size = pool_size
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, fmt, *args):   # quiet by default
-                pass
-
-            def _reply(self, code, payload):
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
+        class Handler(_JsonHandler):
             def do_GET(self):
                 if self.path == "/health":
                     self._reply(200, {"status": "ok",
@@ -80,9 +111,7 @@ class InferenceServer:
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n))
-                    out = outer._predict(req)
+                    out = outer._predict(self._read_json())
                     self._reply(200, out)
                 except Exception as e:   # noqa: BLE001
                     self._reply(400, {"error": str(e)})
@@ -122,25 +151,75 @@ class InferenceServer:
                              "shape": list(a.shape)}
         return {"outputs": outputs}
 
-    # ------------------------------------------------------------------
-    def start(self):
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-        return self
 
-    def stop(self):
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+class GenerationServer(_ServerLifecycle):
+    """Serve a causal LM's paged-KV decode path over HTTP (the serving
+    role of the reference's block_multihead_attention deployment stack).
 
-    def __enter__(self):
-        return self.start()
+    POST /generate  {"input_ids": [[...], ...], "max_new_tokens": N,
+                     "eos_token_id": id?, "do_sample": bool?,
+                     "temperature": float?}
+        -> {"output_ids": [[...], ...], "new_tokens": N}
 
-    def __exit__(self, *exc):
-        self.stop()
-        return False
+    One PagedGenerator (shared page pool) guarded by a lock — batches run
+    sequentially; batch the prompts client-side for throughput.  Sampled
+    requests draw a fresh per-request seed unless the request pins one.
+    """
+
+    def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
+                 total_pages: int = 512, page_size: int = 16):
+        from .paged import PagedGenerator
+
+        self._gen = PagedGenerator(model, total_pages=total_pages,
+                                   page_size=page_size)
+        self._lock = threading.Lock()
+        self._request_count = 0
+        outer = self
+
+        class Handler(_JsonHandler):
+            def do_GET(self):
+                if self.path == "/health":
+                    cache = outer._gen.cache
+                    self._reply(200, {
+                        "status": "ok",
+                        "free_pages": cache.free_pages,
+                        "total_pages": cache.total_pages,
+                        "page_size": cache.page_size})
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    req = self._read_json()
+                    ids = np.asarray(req["input_ids"], np.int32)
+                    if ids.ndim != 2:
+                        raise ValueError("input_ids must be 2-D "
+                                         "(batch, seq)")
+                    with outer._lock:
+                        outer._request_count += 1
+                        seed = int(req.get("seed",
+                                           outer._request_count))
+                        out = outer._gen.generate(
+                            ids,
+                            max_new_tokens=int(
+                                req.get("max_new_tokens", 32)),
+                            eos_token_id=req.get("eos_token_id"),
+                            do_sample=bool(req.get("do_sample", False)),
+                            temperature=float(req.get("temperature", 1.0)),
+                            seed=seed)
+                    self._reply(200, {
+                        "output_ids": out.tolist(),
+                        "new_tokens": int(out.shape[1] - ids.shape[1])})
+                except Exception as e:   # noqa: BLE001
+                    self._reply(400, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
 
 
 def serve(model_prefix: str, host: str = "127.0.0.1", port: int = 8000,

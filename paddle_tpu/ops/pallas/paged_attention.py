@@ -207,6 +207,11 @@ class PagedKVCache:
         self._free.extend(self._seq_pages.pop(seq_id, []))
         self._seq_len.pop(seq_id, None)
 
+    @property
+    def free_pages(self) -> int:
+        """Unallocated pages remaining in the pool."""
+        return len(self._free)
+
     def length(self, seq_id: int) -> int:
         return self._seq_len.get(seq_id, 0)
 

@@ -96,3 +96,65 @@ class TestInferenceServer:
             urllib.request.urlopen(
                 f"http://{srv.host}:{srv.port}/nope")
         assert e.value.code == 404
+
+
+class TestGenerationServer:
+    def test_generate_endpoint_matches_local(self):
+        import json
+        import urllib.request
+        import numpy as np
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import GenerationServer
+
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=1,
+                          num_attention_heads=2, num_key_value_heads=2,
+                          max_position_embeddings=64)
+        model = LlamaForCausalLM(cfg)
+        ids = np.random.default_rng(0).integers(
+            0, 64, (2, 5)).astype("int32")
+        expect = model.generate(paddle.to_tensor(ids), max_new_tokens=4)
+        expect = np.asarray(expect.numpy() if hasattr(expect, "numpy")
+                            else expect)
+
+        with GenerationServer(model, total_pages=64, page_size=8) as srv:
+            req = urllib.request.Request(
+                f"http://{srv.host}:{srv.port}/generate",
+                data=json.dumps({"input_ids": ids.tolist(),
+                                 "max_new_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                out = json.loads(resp.read())
+            assert out["new_tokens"] == 4
+            np.testing.assert_array_equal(np.asarray(out["output_ids"]),
+                                          expect)
+            # health reports the page pool, fully reclaimed after the call
+            with urllib.request.urlopen(
+                    f"http://{srv.host}:{srv.port}/health",
+                    timeout=10) as resp:
+                health = json.loads(resp.read())
+            assert health["free_pages"] == health["total_pages"] == 64
+
+    def test_bad_request_is_400(self):
+        import json
+        import urllib.error
+        import urllib.request
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import GenerationServer
+
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=32, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=1,
+                          num_attention_heads=2,
+                          max_position_embeddings=32)
+        with GenerationServer(LlamaForCausalLM(cfg)) as srv:
+            req = urllib.request.Request(
+                f"http://{srv.host}:{srv.port}/generate",
+                data=json.dumps({"input_ids": [1, 2, 3]}).encode())
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert "2-D" in json.loads(e.read())["error"]
