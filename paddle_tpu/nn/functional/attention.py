@@ -26,6 +26,18 @@ from ...ops.pallas.flash_attention import flash_attention_bshd, mha_reference
 _XLA_SCORE_BYTES_LIMIT = 1 << 29
 
 
+def _flashmask_pallas_module():
+    """The Pallas flashmask module when it should handle dispatch, else
+    None.  _FORCE_DISPATCH (tests) is separate from _INTERPRET so the
+    dense path below stays reachable as the correctness ORACLE while the
+    kernels run interpreted."""
+    from ...ops.pallas import flashmask_attention as _fm
+    if jax.default_backend() == "tpu" or getattr(_fm, "_FORCE_DISPATCH",
+                                                 False):
+        return _fm
+    return None
+
+
 def _mha_ref_bshd(q, k, v, causal):
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     return jnp.swapaxes(mha_reference(qt, kt, vt, causal=causal), 1, 2)
@@ -163,6 +175,20 @@ def _flashmask_attention(q, k, v, startend_row_indices, causal):
     # reference's flashmask_attention): column j of the score matrix is
     # masked for rows r in [start_j, end_j).  1 col: causal LT mask with
     # rows >= start masked; 2 cols: [start, end); 4 cols: LT + UT bands.
+    # On TPU the Pallas interval-mask kernels run (O(seq) mask memory +
+    # fully-masked tiles skipped — ops/pallas/flashmask_attention.py);
+    # _flashmask_dense below is the CPU fallback and oracle.
+    _fm = _flashmask_pallas_module()
+    if _fm is not None:
+        qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+        out = _fm.flashmask_attention_fused(qt, kt, vt,
+                                            startend_row_indices, causal)
+        return jnp.swapaxes(out, 1, 2)
+    return _flashmask_dense(q, k, v, startend_row_indices, causal)
+
+
+def _flashmask_dense(q, k, v, startend_row_indices, causal):
+    """Dense-bias FlashMask (CPU fallback + the kernels' oracle)."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     idx = startend_row_indices

@@ -1,0 +1,478 @@
+"""FlashMask attention: sparse-mask flash kernels (the 'splash' slot of
+SURVEY §7's kernel list).
+
+Capability parity: the reference's flashmask_attention (PaddlePaddle 3.0
+headline; python/paddle/nn/functional/flash_attention.py flashmask_attention)
+— attention masks encoded as per-column row INTERVALS
+(startend_row_indices, O(seq) memory) instead of a dense O(seq^2) bias:
+column j of the score matrix is masked for rows in [start_j, end_j)
+(1 col: [start, Sq); 2 cols: [start, end); 4 cols: two bands).
+
+TPU-native design:
+  - forward + FA2 backward Pallas kernels modeled on flash_attention.py,
+    with the interval tensor streamed per kv tile in an (ncol, seq)
+    layout (lane-aligned blocks);
+  - REAL flop sparsity: a per-(b, h, q_block, kv_block) skip table is
+    precomputed in XLA from the intervals and scalar-prefetched; fully
+    masked tiles are predicated off, so banded masks (sliding window,
+    causal document masks) cost near-linear compute like the splash
+    kernels — the dense-bias path pays O(s^2) regardless;
+  - off-TPU the dense reference in nn/functional/attention.py stays the
+    fallback and the correctness oracle (interpret mode runs the
+    kernels on CPU in tests).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import DEFAULT_MASK_VALUE, _ceil_to
+
+#: Flip to True in CPU tests to run through the Pallas interpreter.
+_INTERPRET = False
+
+
+def _keep_mask(rows, cols_base, se, ncol, sq, causal, block_kv):
+    """KEEP mask (True = attend) for one tile.  rows: (block_q, 1) global
+    row ids; se: (ncol, block_kv) intervals for this kv tile."""
+    def band(lo, hi):
+        return (rows >= lo[None, :]) & (rows < hi[None, :])
+
+    if ncol == 1:
+        masked = band(se[0], jnp.full_like(se[0], sq))
+    elif ncol == 2:
+        masked = band(se[0], se[1])
+    else:
+        masked = band(se[0], se[1]) | band(se[2], se[3])
+    if causal:
+        cols = cols_base + lax.broadcasted_iota(
+            jnp.int32, masked.shape, 1)
+        masked = masked | (rows < cols)
+    return ~masked
+
+
+def _tile(skip_ref, se_ref, q_idx, kv_idx, *, ncol, sq, causal, block_q,
+          block_kv):
+    b_ = pl.program_id(0)
+    h_ = pl.program_id(1)
+    run = skip_ref[b_, h_, q_idx, kv_idx] == 0
+    rows = q_idx * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+    keep = _keep_mask(rows, kv_idx * block_kv, se_ref[0], ncol, sq,
+                      causal, block_kv)
+    return run, rows, keep
+
+
+def _fwd_kernel(skip_ref, q_ref, k_ref, v_ref, se_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q,
+                block_kv, kv_seq_len, q_seq_len, ncol):
+    q_idx = pl.program_id(2)
+    kv_idx = pl.program_id(3)
+    n_kv = pl.num_programs(3)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run, rows, keep = _tile(skip_ref, se_ref, q_idx, kv_idx, ncol=ncol,
+                            sq=q_seq_len, causal=causal, block_q=block_q,
+                            block_kv=block_kv)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        cols = kv_idx * block_kv + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        kp = keep & (cols < kv_seq_len)
+        s = jnp.where(kp, s, DEFAULT_MASK_VALUE)
+        m_prev = m_scr[:, :1]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.where(kp, jnp.exp(s - m_next), 0.0)
+        l_scr[:] = jnp.broadcast_to(
+            alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc_scr[:] = acc_scr[:] * alpha + lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_next, m_scr.shape)
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # fully-masked rows keep lse = -big so exp(s - lse) stays 0 in bwd
+        lse_ref[0, 0] = jnp.where(
+            l > 0.0, m_scr[:] + jnp.log(l_safe),
+            DEFAULT_MASK_VALUE).astype(jnp.float32)
+
+
+def _bwd_dkv_kernel(skip_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, se_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    scale, causal, block_q, block_kv, q_seq_len, ncol):
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(3)
+    n_q = pl.num_programs(3)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run, rows, keep = _tile(skip_ref, se_ref, q_idx, kv_idx, ncol=ncol,
+                            sq=q_seq_len, causal=causal, block_q=block_q,
+                            block_kv=block_kv)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        kp = keep & (rows < q_seq_len)
+        p = jnp.where(kp, jnp.exp(s - lse), 0.0)
+        dv_scr[:] = dv_scr[:] + lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_scr[:] = dk_scr[:] + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(q_idx == n_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(skip_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, se_ref, dq_ref, dq_scr, *, scale, causal,
+                   block_q, block_kv, kv_seq_len, q_seq_len, ncol):
+    q_idx = pl.program_id(2)
+    kv_idx = pl.program_id(3)
+    n_kv = pl.num_programs(3)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run, rows, keep = _tile(skip_ref, se_ref, q_idx, kv_idx, ncol=ncol,
+                            sq=q_seq_len, causal=causal, block_q=block_q,
+                            block_kv=block_kv)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        cols = kv_idx * block_kv + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        kp = keep & (cols < kv_seq_len)
+        p = jnp.where(kp, jnp.exp(s - lse), 0.0)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] = dq_scr[:] + lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+# ------------------------------------------------------------- skip table
+def _skip_table(se_bh, ncol, sq, block_q, block_kv, n_q, n_kv, causal,
+                b, h, hm):
+    """(b, h, n_q, n_kv) int32: 1 where the tile is FULLY masked (the
+    kernels predicate it off).  Conservative for the 4-col case (a tile
+    covered only by the UNION of both bands still runs)."""
+    bh = se_bh.shape[0]
+    sqz = se_bh.reshape(bh, ncol, n_kv, block_kv)
+    smax = jnp.max(sqz, axis=3)                     # (bh, ncol, n_kv)
+    smin = jnp.min(sqz, axis=3)
+    q0 = jnp.arange(n_q)[:, None] * block_q         # (n_q, 1)
+    q1 = jnp.minimum(q0 + block_q, sq)
+
+    def covered(lo_max, hi_min):
+        return (lo_max[:, None, :] <= q0[None]) & \
+               (hi_min[:, None, :] >= q1[None])
+
+    if ncol == 1:
+        full = covered(smax[:, 0], jnp.full_like(smin[:, 0], sq))
+    elif ncol == 2:
+        full = covered(smax[:, 0], smin[:, 1])
+    else:
+        full = covered(smax[:, 0], smin[:, 1]) | \
+               covered(smax[:, 2], smin[:, 3])
+    full = full.reshape(b, hm, n_q, n_kv)
+    full = jnp.broadcast_to(full[:, :, None].repeat(h // hm, axis=2)
+                            .reshape(b, h, n_q, n_kv), (b, h, n_q, n_kv))
+    if causal:
+        k0 = jnp.arange(n_kv)[None, None, None, :] * block_kv
+        above = q1.reshape(1, 1, n_q, 1) <= k0
+        full = full | above
+    return full.astype(jnp.int32)
+
+
+def _prep(q, k, v, startend_row_indices, block_q, block_kv, causal):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    hm = startend_row_indices.shape[1]
+    ncol = startend_row_indices.shape[-1]
+    if ncol not in (1, 2, 4):
+        raise ValueError(f"startend_row_indices last dim must be 1, 2 or "
+                         f"4, got {ncol}")
+    if h % hm != 0:
+        raise ValueError(f"mask heads ({hm}) must divide q heads ({h})")
+    block_q = min(block_q, _ceil_to(sq, 128))
+    block_kv = min(block_kv, _ceil_to(sk, 128))
+    sq_p, sk_p = _ceil_to(sq, block_q), _ceil_to(sk, block_kv)
+    pads = {}
+    if sq_p != sq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    if sk_p != sk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+    se = jnp.swapaxes(startend_row_indices, 2, 3).astype(jnp.int32)
+    se = se.reshape(b * hm, ncol, sk)
+    if sk_p != sk:
+        # padded cols: start=sq, end=sq -> empty band; the kv_seq_len
+        # in-kernel mask excludes them anyway
+        se = jnp.pad(se, ((0, 0), (0, 0), (0, sk_p - sk)),
+                     constant_values=sq)
+    n_q, n_kv = sq_p // block_q, sk_p // block_kv
+    skip = _skip_table(se, ncol, sq, block_q, block_kv, n_q, n_kv, causal,
+                       b, h, hm)
+    # expand the per-mask-head intervals to per-q-head blocks
+    se_h = se.reshape(b, hm, ncol, sk_p)
+    se_h = jnp.repeat(se_h, h // hm, axis=1).reshape(b * h, ncol, sk_p)
+    meta = dict(b=b, h=h, sq=sq, sk=sk, d=d, ncol=ncol,
+                block_q=block_q, block_kv=block_kv, sq_p=sq_p, sk_p=sk_p,
+                n_q=n_q, n_kv=n_kv)
+    return q, k, v, se_h, skip, meta
+
+
+def _se_spec(meta):
+    # (b*h, ncol, sk_p) indexed per (b, h, kv tile)
+    h = meta["h"]
+    return pl.BlockSpec(
+        (1, meta["ncol"], meta["block_kv"]),
+        lambda b_, h_, qi, ki, skip_r: (b_ * h + h_, 0, ki))
+
+
+def flashmask_attention_forward(q, k, v, startend_row_indices,
+                                causal=False, scale=None, block_q=512,
+                                block_kv=512, interpret=None):
+    """Layout (b, h, s, d); returns (out, lse)."""
+    if interpret is None:
+        interpret = _INTERPRET
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    q, k, v, se, skip, meta = _prep(q, k, v, startend_row_indices,
+                                    block_q, block_kv, causal)
+    m = meta
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=m["block_q"],
+        block_kv=m["block_kv"], kv_seq_len=m["sk"], q_seq_len=m["sq"],
+        ncol=m["ncol"])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m["b"], m["h"], m["n_q"], m["n_kv"]),
+        in_specs=[
+            pl.BlockSpec((1, 1, m["block_q"], m["d"]),
+                         lambda b_, h_, qi, ki, s_: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, m["block_kv"], m["d"]),
+                         lambda b_, h_, qi, ki, s_: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, m["block_kv"], m["d"]),
+                         lambda b_, h_, qi, ki, s_: (b_, h_, ki, 0)),
+            _se_spec(m),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, m["block_q"], m["d"]),
+                         lambda b_, h_, qi, ki, s_: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, m["block_q"], 128),
+                         lambda b_, h_, qi, ki, s_: (b_, h_, qi, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((m["block_q"], 128), jnp.float32),
+            pltpu.VMEM((m["block_q"], 128), jnp.float32),
+            pltpu.VMEM((m["block_q"], m["d"]), jnp.float32),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((m["b"], m["h"], m["sq_p"], m["d"]),
+                                 q.dtype),
+            jax.ShapeDtypeStruct((m["b"], m["h"], m["sq_p"], 128),
+                                 jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(skip, q, k, v, se)
+    return out[:, :, :m["sq"], :], lse[:, :, :m["sq"], 0]
+
+
+def flashmask_attention_backward(q, k, v, out, lse, do,
+                                 startend_row_indices, causal=False,
+                                 scale=None, block_q=512, block_kv=512,
+                                 interpret=None):
+    """FA2 backward under the interval mask; returns (dq, dk, dv)."""
+    from .flash_attention import _expand_to_128
+
+    if interpret is None:
+        interpret = _INTERPRET
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1)
+    qp, kp_, vp, se, skip, meta = _prep(q, k, v, startend_row_indices,
+                                        block_q, block_kv, causal)
+    m = meta
+    if m["sq_p"] != m["sq"]:
+        do = jnp.pad(do, ((0, 0), (0, 0), (0, m["sq_p"] - m["sq"]),
+                          (0, 0)))
+    lse128 = _expand_to_128(lse, m["sq_p"])
+    delta128 = _expand_to_128(delta, m["sq_p"])
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=m["block_q"],
+        block_kv=m["block_kv"], q_seq_len=m["sq"], ncol=m["ncol"])
+    dkv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m["b"], m["h"], m["n_kv"], m["n_q"]),
+        in_specs=[
+            pl.BlockSpec((1, 1, m["block_q"], m["d"]),
+                         lambda b_, h_, ki, qi, s_: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, m["block_kv"], m["d"]),
+                         lambda b_, h_, ki, qi, s_: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, m["block_kv"], m["d"]),
+                         lambda b_, h_, ki, qi, s_: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, m["block_q"], m["d"]),
+                         lambda b_, h_, ki, qi, s_: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, m["block_q"], 128),
+                         lambda b_, h_, ki, qi, s_: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, m["block_q"], 128),
+                         lambda b_, h_, ki, qi, s_: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, m["ncol"], m["block_kv"]),
+                         lambda b_, h_, ki, qi, s_:
+                         (b_ * m["h"] + h_, 0, ki)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, m["block_kv"], m["d"]),
+                         lambda b_, h_, ki, qi, s_: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, m["block_kv"], m["d"]),
+                         lambda b_, h_, ki, qi, s_: (b_, h_, ki, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((m["block_kv"], m["d"]), jnp.float32),
+            pltpu.VMEM((m["block_kv"], m["d"]), jnp.float32),
+        ],
+    )
+    # the dkv grid iterates (kv, q) but _tile receives the caller's own
+    # (q_idx, kv_idx) and indexes the table [b, h, q, kv] — no transpose
+    dk, dv = pl.pallas_call(
+        dkv_kernel, grid_spec=dkv_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((m["b"], m["h"], m["sk_p"], m["d"]),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((m["b"], m["h"], m["sk_p"], m["d"]),
+                                 jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(skip, qp, kp_, vp, do, lse128, delta128, se)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, block_q=m["block_q"],
+        block_kv=m["block_kv"], kv_seq_len=m["sk"], q_seq_len=m["sq"],
+        ncol=m["ncol"])
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m["b"], m["h"], m["n_q"], m["n_kv"]),
+        in_specs=[
+            pl.BlockSpec((1, 1, m["block_q"], m["d"]),
+                         lambda b_, h_, qi, ki, s_: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, m["block_kv"], m["d"]),
+                         lambda b_, h_, qi, ki, s_: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, m["block_kv"], m["d"]),
+                         lambda b_, h_, qi, ki, s_: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, m["block_q"], m["d"]),
+                         lambda b_, h_, qi, ki, s_: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, m["block_q"], 128),
+                         lambda b_, h_, qi, ki, s_: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, m["block_q"], 128),
+                         lambda b_, h_, qi, ki, s_: (b_, h_, qi, 0)),
+            _se_spec(m),
+        ],
+        out_specs=pl.BlockSpec((1, 1, m["block_q"], m["d"]),
+                               lambda b_, h_, qi, ki, s_: (b_, h_, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((m["block_q"], m["d"]), jnp.float32)],
+    )
+    dq = pl.pallas_call(
+        dq_kernel, grid_spec=dq_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (m["b"], m["h"], m["sq_p"], m["d"]), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(skip, qp, kp_, vp, do, lse128, delta128, se)
+
+    dq = dq[:, :, :m["sq"]]
+    dk = dk[:, :, :m["sk"]].astype(k.dtype)
+    dv = dv[:, :, :m["sk"]].astype(v.dtype)
+    return dq.astype(q.dtype), dk, dv
+
+
+# ------------------------------------------------------------ public vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flashmask_attention_fused(q, k, v, startend_row_indices, causal=False,
+                              scale=None):
+    """Differentiable FlashMask attention, layout (b, h, s, d)."""
+    out, _ = flashmask_attention_forward(q, k, v, startend_row_indices,
+                                         causal, scale)
+    return out
+
+
+def _fm_fwd(q, k, v, se, causal, scale):
+    out, lse = flashmask_attention_forward(q, k, v, se, causal, scale)
+    return out, (q, k, v, se, out, lse)
+
+
+def _fm_bwd(causal, scale, res, do):
+    q, k, v, se, out, lse = res
+    dq, dk, dv = flashmask_attention_backward(
+        q, k, v, out, lse, do, se, causal, scale)
+    return dq, dk, dv, jnp.zeros_like(se)
+
+
+flashmask_attention_fused.defvjp(_fm_fwd, _fm_bwd)
