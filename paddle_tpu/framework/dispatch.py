@@ -20,6 +20,7 @@ propagation rules.
 from __future__ import annotations
 
 import functools
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
@@ -269,7 +270,7 @@ def _apply_spmd_rule(name, leaves, tensor_idx, treedef, result):
 # tools/eager_dispatch_measurement.json); FLAGS_eager_cached_grad=0
 # restores the per-call jax.vjp record path.
 # --------------------------------------------------------------------------
-_GRAD_CACHE: Dict[Any, Any] = {}
+_GRAD_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
 _GRAD_CACHE_CAP = 1024
 
 
@@ -290,10 +291,17 @@ def _cached_grad_call(name, fn, leaves, treedef, tensor_idx, diff_pos,
     except TypeError:
         return None
 
+    if _GRAD_CACHE_CAP <= 0:
+        return None                    # caching disabled -> plain vjp path
     entry = _GRAD_CACHE.get(key)
-    if entry is None:
-        if len(_GRAD_CACHE) >= _GRAD_CACHE_CAP:
-            _GRAD_CACHE.clear()
+    if entry is not None:
+        _GRAD_CACHE.move_to_end(key)   # LRU touch
+    else:
+        # LRU eviction: drop only the single coldest signature.  A
+        # wholesale clear() here caused a recompile thundering-herd for
+        # workloads cycling through >CAP distinct signatures.
+        while len(_GRAD_CACHE) >= _GRAD_CACHE_CAP:
+            _GRAD_CACHE.popitem(last=False)
         # close over the BUILD-time static leaves/treedef — equal keys
         # guarantee they match this call's.  Tensor positions are blanked:
         # they are always overwritten by _apply, and keeping the first

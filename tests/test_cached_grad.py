@@ -122,6 +122,31 @@ class TestCachedGrad:
         assert np.isfinite(g).all()
         assert int((g != 0).sum()) == 12        # 4 rows * k=3
 
+    def test_lru_eviction_no_thundering_herd(self, cached_grad):
+        # Cycling through more signatures than the cap must evict one
+        # cold entry at a time, never wholesale-clear: a hot signature
+        # used throughout stays cached the entire time.
+        from paddle_tpu.framework import dispatch
+        dispatch._GRAD_CACHE.clear()
+        old_cap = dispatch._GRAD_CACHE_CAP
+        dispatch._GRAD_CACHE_CAP = 8
+        try:
+            hot = paddle.to_tensor(np.ones((5, 5), np.float32))
+            hot.stop_gradient = False
+            hot.tanh().sum().backward()
+            hot_keys = set(dispatch._GRAD_CACHE)
+            for n in range(2, 20):     # 18 distinct cold signatures
+                c = paddle.to_tensor(np.ones((1, n), np.float32))
+                c.stop_gradient = False
+                c.tanh().sum().backward()
+                hot.tanh().sum().backward()      # keep hot entry warm
+                assert len(dispatch._GRAD_CACHE) <= 8
+                # every hot-path entry survived all evictions
+                assert hot_keys <= set(dispatch._GRAD_CACHE)
+        finally:
+            dispatch._GRAD_CACHE_CAP = old_cap
+            dispatch._GRAD_CACHE.clear()
+
     def test_cache_does_not_pin_first_call_tensors(self, cached_grad):
         import gc
         import weakref

@@ -1,0 +1,271 @@
+"""Opportunistic TPU benchmark capture.
+
+The deployment has ONE real TPU chip behind a tunnel that is frequently
+unreachable, and — measured in round 1 — the chip *wedges permanently*
+(``jax.devices()`` hangs forever) after a RESOURCE_EXHAUSTED allocation.
+The reference gates merges on hardware-measured op benchmarks
+(reference: tools/ci_op_benchmark.sh:1, tools/check_op_benchmark_result.py:1);
+this harness is the TPU-native stand-in for that CI lane under a flaky
+single chip:
+
+  * ``--probe``   one guarded probe, appended to ``tools/tpu_probe_log.jsonl``
+                  (the audit trail that the chip was / was not up).
+  * ``--watch``   probe on a timer all round; the first healthy probe
+                  triggers one OOM-safe bench ladder and writes
+                  ``BENCH_tpu_opportunistic.json`` at the repo root.
+  * ``--once``    probe now; if healthy run the ladder; exit.
+
+OOM discipline (the reason this file exists instead of just re-running
+bench.py): every ladder rung runs in its own subprocess; before a rung's
+timed loop touches the chip it compiles the whole step AOT and checks
+``TrainStep.memory_analysis()`` (argument+output+temp bytes) against the
+device's ``memory_stats()['bytes_limit']`` with a safety margin.  Rungs
+ascend in size so the first memory-gate rejection stops the climb with the
+chip still healthy.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_LOG = os.path.join(REPO, "tools", "tpu_probe_log.jsonl")
+OUT_JSON = os.path.join(REPO, "BENCH_tpu_opportunistic.json")
+
+# Fraction of the reported HBM bytes_limit a rung may plan to use.  The
+# wedge-after-OOM failure mode makes this margin load-bearing: planned
+# bytes are XLA's static analysis and exclude runtime fragmentation.
+SAFETY = 0.80
+DEFAULT_HBM = 8 << 30   # assume one conservative v2-core HBM if stats absent
+
+# Ascending LLaMA pretrain ladder (BASELINE config 5 shape family).  Each
+# rung is (name, llama-config overrides, batch, seq, steps).  The last rung
+# is bench.py's full TPU config — reaching it reproduces the headline.
+LLAMA_LADDER = [
+    ("llama_tiny", dict(vocab_size=2048, hidden_size=256,
+                        intermediate_size=688, num_hidden_layers=4,
+                        num_attention_heads=4), 4, 256, 10),
+    ("llama_small", dict(vocab_size=8192, hidden_size=512,
+                         intermediate_size=1376, num_hidden_layers=8,
+                         num_attention_heads=8), 8, 512, 10),
+    ("llama_110m", dict(vocab_size=32000, hidden_size=768,
+                        intermediate_size=2048, num_hidden_layers=12,
+                        num_attention_heads=12), 8, 1024, 20),
+    # widened batch — the round-1 figure was batch 8; a 16-batch rung
+    # tests whether the chip leaves throughput on the table at 8
+    ("llama_110m_b16", dict(vocab_size=32000, hidden_size=768,
+                            intermediate_size=2048, num_hidden_layers=12,
+                            num_attention_heads=12), 16, 1024, 20),
+]
+
+
+def log_probe(entry: dict) -> None:
+    os.makedirs(os.path.dirname(PROBE_LOG), exist_ok=True)
+    with open(PROBE_LOG, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def probe(timeout: float = 120.0) -> dict:
+    sys.path.insert(0, REPO)
+    from paddle_tpu.framework.backend_guard import probe_accelerator
+    t0 = time.time()
+    ok, n, platform = probe_accelerator(timeout=timeout)
+    entry = {"ts": round(t0, 1),
+             "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)),
+             "ok": bool(ok), "n_devices": n, "platform": platform,
+             "probe_seconds": round(time.time() - t0, 1)}
+    log_probe(entry)
+    return entry
+
+
+def _run_rung_subprocess(spec: dict, timeout: float = 1800.0) -> dict:
+    """Execute one ladder rung in a throwaway process; a chip wedge mid-rung
+    costs us the child, not the harness."""
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--run-rung", json.dumps(spec)]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"name": spec["name"], "status": "timeout"}
+    if res.returncode != 0:
+        return {"name": spec["name"], "status": "error",
+                "stderr": res.stderr[-2000:]}
+    try:
+        return json.loads(res.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001
+        return {"name": spec["name"], "status": "unparseable",
+                "stdout": res.stdout[-2000:]}
+
+
+def run_rung(spec: dict) -> dict:
+    """Inside the child: build the step, memory-gate, then measure.
+
+    Prints one JSON line.  Only ever called with a healthy probe ≤ one
+    interval old; still re-verifies the platform before any compile.
+    """
+    sys.path.insert(0, REPO)
+    import jax
+    import numpy as np
+
+    devs = jax.devices()
+    if devs[0].platform != "tpu":
+        return {"name": spec["name"], "status": "not_tpu",
+                "platform": devs[0].platform}
+    stats = devs[0].memory_stats() or {}
+    hbm = int(stats.get("bytes_limit", DEFAULT_HBM))
+
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(max_position_embeddings=max(2048, spec["seq"]),
+                      dtype="bfloat16", **spec["cfg"])
+    model = LlamaForCausalLM(cfg)
+    for p in model.parameters():
+        if p._data.dtype == jnp.float32:
+            p._data = p._data.astype(jnp.bfloat16)
+    opt = optim.AdamW(learning_rate=1e-3, parameters=model.parameters(),
+                      multi_precision=True)
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]).astype("float32"),
+            labels.reshape([-1]))
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    batch, seq, steps = spec["batch"], spec["seq"], spec["steps"]
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype("int32")
+    x = paddle.to_tensor(ids[:, :-1])
+    y = paddle.to_tensor(ids[:, 1:])
+
+    # ---- memory gate: AOT compile only (no HBM-resident temporaries) ----
+    mem = step.memory_analysis(x, y)
+    planned = (mem["argument_bytes"] + mem["output_bytes"]
+               + mem["temp_bytes"])
+    gate = {"planned_bytes": planned, "hbm_bytes_limit": hbm,
+            "hbm_fraction": round(planned / hbm, 3)}
+    if planned > SAFETY * hbm:
+        return {"name": spec["name"], "status": "memory_gate_rejected",
+                **gate}
+
+    # ---- timed loop --------------------------------------------------
+    for _ in range(2):
+        loss = step(x, y)
+        jax.block_until_ready(loss._data)
+    v = float(np.asarray(loss._data))
+    assert np.isfinite(v), f"non-finite warmup loss {v}"
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    jax.block_until_ready(loss._data)
+    dt = time.perf_counter() - t0
+    tok_s = batch * seq * steps / dt
+
+    out = {"name": spec["name"], "status": "ok", "device": "tpu",
+           "device_kind": devs[0].device_kind,
+           "tokens_per_sec": round(tok_s, 1),
+           "batch": batch, "seq": seq, "steps": steps, **gate}
+    flops = mem.get("flops_per_step", 0.0)
+    if flops > 0:
+        sys.path.insert(0, REPO)
+        import bench
+        kind, peak = bench._peak_tflops()
+        out["flops_per_step"] = flops
+        if peak:
+            out["peak_tflops_bf16"] = peak
+            out["mfu"] = round(flops * (tok_s / (batch * seq))
+                               / (peak * 1e12), 4)
+    return out
+
+
+def run_ladder() -> dict:
+    results = []
+    for name, cfg, batch, seq, steps in LLAMA_LADDER:
+        spec = {"name": name, "cfg": cfg, "batch": batch, "seq": seq,
+                "steps": steps}
+        r = _run_rung_subprocess(spec)
+        results.append(r)
+        print(f"[ladder] {name}: {r.get('status')} "
+              f"{r.get('tokens_per_sec', '')}", file=sys.stderr)
+        if r.get("status") != "ok":
+            break   # ascending ladder: stop at first failure/rejection
+    ok_rungs = [r for r in results if r.get("status") == "ok"]
+    head = ok_rungs[-1] if ok_rungs else {}
+    doc = {
+        "metric": "llama_pretrain_tokens_per_sec_per_chip_opportunistic",
+        "value": head.get("tokens_per_sec", 0.0),
+        "unit": "tokens/sec",
+        "device": "tpu" if ok_rungs else "unreachable",
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "vs_baseline": round(head.get("tokens_per_sec", 0.0) / 94072.4, 3),
+        "ladder": results,
+    }
+    if "mfu" in head:
+        doc["mfu"] = head["mfu"]
+        doc["device_kind"] = head.get("device_kind")
+    with open(OUT_JSON, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe", action="store_true")
+    ap.add_argument("--once", action="store_true")
+    ap.add_argument("--watch", action="store_true")
+    ap.add_argument("--interval", type=float, default=900.0)
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--run-rung", type=str, default=None,
+                    help="(internal) JSON rung spec; executes on the chip")
+    args = ap.parse_args()
+
+    if args.run_rung:
+        out = run_rung(json.loads(args.run_rung))
+        print(json.dumps(out))
+        return 0
+
+    if args.probe:
+        print(json.dumps(probe()))
+        return 0
+
+    if args.once:
+        p = probe()
+        print(json.dumps(p))
+        if p["ok"] and p["platform"] == "tpu":
+            doc = run_ladder()
+            print(json.dumps({"captured": True,
+                              "value": doc["value"]}))
+            return 0
+        return 1
+
+    if args.watch:
+        deadline = time.time() + args.max_hours * 3600
+        captured = False
+        while time.time() < deadline:
+            p = probe()
+            print(json.dumps(p), flush=True)
+            if p["ok"] and p["platform"] == "tpu" and not captured:
+                doc = run_ladder()
+                captured = bool(doc["value"])
+                print(json.dumps({"captured": captured,
+                                  "value": doc["value"]}), flush=True)
+                if captured:
+                    return 0   # got the number; stop burning probes
+            time.sleep(args.interval)
+        return 0 if captured else 1
+
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
