@@ -278,6 +278,8 @@ def _cached_grad_call(name, fn, leaves, treedef, tensor_idx, diff_pos,
                       arrays):
     """(out_arrays, vjp_fn) via per-signature jitted fwd/bwd, or None when
     the call signature isn't hashable (fall back to plain jax.vjp)."""
+    if _GRAD_CACHE_CAP <= 0:
+        return None                    # caching disabled -> plain vjp path
     static_leaves = [None if _is_tensor(leaf) else leaf for leaf in leaves]
     try:
         # id(fn) distinguishes re-registrations of the same op name; the
@@ -291,8 +293,6 @@ def _cached_grad_call(name, fn, leaves, treedef, tensor_idx, diff_pos,
     except TypeError:
         return None
 
-    if _GRAD_CACHE_CAP <= 0:
-        return None                    # caching disabled -> plain vjp path
     entry = _GRAD_CACHE.get(key)
     if entry is not None:
         _GRAD_CACHE.move_to_end(key)   # LRU touch

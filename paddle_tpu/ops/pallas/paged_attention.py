@@ -56,7 +56,7 @@ def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(valid)
     def _compute():
-        q = q_ref[0]                            # (group, d)
+        q = q_ref[0, 0]                         # (group, d)
         k = k_ref[0, 0]                         # (page_size, d)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -80,7 +80,7 @@ def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, o_ref,
     def _finalize():
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
 
 
 def _decode_pallas(q, k_pages, v_pages, lengths, page_tables, scale,
@@ -90,35 +90,44 @@ def _decode_pallas(q, k_pages, v_pages, lengths, page_tables, scale,
     group = q_heads // kv_heads
     max_pages = page_tables.shape[1]
 
+    # (batch, q_heads, d) -> (batch, kv_heads, group, d): the kv-head
+    # group rides as its own FULL axis so the q block's trailing dims
+    # (group, d) match the array dims exactly — Mosaic requires trailing
+    # block dims divisible by (8, 128) or spanning the whole axis, and
+    # group (e.g. 3) satisfies neither as a partial slice of q_heads
+    q4 = q.reshape(batch, kv_heads, group, d)
+
     kernel = functools.partial(_decode_kernel, scale=scale,
                                page_size=page_size)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,          # lengths, page_tables
         grid=(batch, kv_heads, max_pages),
         in_specs=[
-            pl.BlockSpec((1, group, d),
-                         lambda b, h, p, lens, tabs: (b, h, 0)),
+            pl.BlockSpec((1, 1, group, d),
+                         lambda b, h, p, lens, tabs: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, page_size, d),
                          lambda b, h, p, lens, tabs: (h, tabs[b, p], 0, 0)),
             pl.BlockSpec((1, 1, page_size, d),
                          lambda b, h, p, lens, tabs: (h, tabs[b, p], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, group, d),
-                               lambda b, h, p, lens, tabs: (b, h, 0)),
+        out_specs=pl.BlockSpec((1, 1, group, d),
+                               lambda b, h, p, lens, tabs: (b, h, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((group, 128), jnp.float32),
             pltpu.VMEM((group, 128), jnp.float32),
             pltpu.VMEM((group, d), jnp.float32),
         ],
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((batch, q_heads, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((batch, kv_heads, group, d),
+                                       q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(lengths, page_tables, q, k_pages, v_pages)
+    )(lengths, page_tables, q4, k_pages, v_pages)
+    return out.reshape(batch, q_heads, d)
 
 
 def _decode_xla(q, k_pages, v_pages, lengths, page_tables, scale):

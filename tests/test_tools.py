@@ -133,6 +133,30 @@ class TestTpuCapture:
         names = [r[0] for r in tc.LLAMA_LADDER]
         assert "llama_110m" in names    # reproduces the r01 headline config
 
+    def test_analytic_init_gate_math(self):
+        tc = self._load()
+        cfg = tc.LLAMA_LADDER[2][1]          # llama_110m
+        est = tc._estimate_init_bytes(cfg, batch=8, seq=1024)
+        # ~110M params -> 18P ≈ 2 GB, plus the 8*1024*32000 fp32 logits
+        assert est > 18 * 100e6
+        assert est < 16 << 30                # sane on any real HBM
+
+    def test_failed_retry_never_clobbers_good_capture(self, tmp_path,
+                                                      monkeypatch):
+        tc = self._load()
+        out = tmp_path / "bench.json"
+        monkeypatch.setattr(tc, "OUT_JSON", str(out))
+        good = {"metric": "m", "value": 1234.5, "device": "tpu"}
+        out.write_text(json.dumps(good))
+        monkeypatch.setattr(
+            tc, "_run_rung_subprocess",
+            lambda spec, timeout=0: {"name": spec["name"],
+                                     "status": "timeout"})
+        tc.run_ladder()
+        kept = json.load(open(out))
+        assert kept["value"] == 1234.5        # the capture survived
+        assert kept["later_failed_attempts"][0]["device"] == "unreachable"
+
     def test_ladder_stops_at_first_failure(self, tmp_path, monkeypatch):
         tc = self._load()
         monkeypatch.setattr(tc, "OUT_JSON", str(tmp_path / "out.json"))
