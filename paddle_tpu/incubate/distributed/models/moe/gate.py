@@ -37,20 +37,26 @@ def moe_capacity(top_k, num_tokens, num_expert, factor):
     return max(1, min(cap, num_tokens))
 
 
-def _capacity_gating(gates, top_k, capacity, normalize, random_keep=None):
-    """Dense capacity-based top-k routing.
+def _topk_routing(gates, top_k, capacity, normalize, random_keep=None):
+    """Capacity-based top-k routing WITHOUT densification — the shared
+    core of both the dense [T,E,C] oracle and the O(T) ragged dispatch.
 
     gates: [T, E] softmax probabilities.  ``random_keep``: optional [T]
     uniforms — when given, the second-choice expert is kept only where
-    u < 2 * p2 (GShard random routing).  Returns (combine [T,E,C],
-    dispatch [T,E,C] float 0/1, l_aux scalar).
-    """
+    u < 2 * p2 (GShard random routing).
+
+    Returns (expert_idx [k,T] int32, slot_pos [k,T] int32, keep [k,T]
+    bool, weight [k,T] — capacity-masked, normalized if requested —
+    l_aux scalar).  Slot positions count EVERY token that chose the
+    expert (in round-major, token order), so dropped assignments leave
+    holes in the capacity buffer — GShard semantics, and identical to
+    what the dense path always did.  Largest intermediate is [T, E]
+    (which the gate's softmax already materializes); nothing here is
+    O(T*E*C)."""
     T, E = gates.shape
     remaining = gates
-    combine = jnp.zeros((T, E, capacity), gates.dtype)
     fill = jnp.zeros((E,), jnp.int32)        # tokens already placed per expert
-    picked_w = []
-    picked_mask = []
+    eidx_l, pos_l, keep_l, w_l = [], [], [], []
     first_mask = None
     for k in range(top_k):
         idx = jnp.argmax(remaining, axis=-1)                    # [T]
@@ -61,27 +67,44 @@ def _capacity_gating(gates, top_k, capacity, normalize, random_keep=None):
         # earlier tokens (and earlier rounds) get earlier slots.
         pos_grid = jnp.cumsum(onehot, axis=0) - onehot + fill[None, :]
         pos = jnp.sum(pos_grid * onehot, axis=1)                # [T]
-        within = (pos < capacity).astype(gates.dtype)
+        within = pos < capacity
         gate_val = jnp.take_along_axis(gates, idx[:, None], axis=1)[:, 0]
         if k == 1 and random_keep is not None:
-            within = within * (random_keep < 2.0 * gate_val).astype(
-                gates.dtype)
-        pos_oh = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)
-        sel = onehot.astype(gates.dtype)[:, :, None] * pos_oh[:, None, :]
-        picked_w.append(gate_val * within)
-        picked_mask.append(sel * within[:, None, None])
+            within = within & (random_keep < 2.0 * gate_val)
+        eidx_l.append(idx.astype(jnp.int32))
+        pos_l.append(pos.astype(jnp.int32))
+        keep_l.append(within)
+        w_l.append(gate_val * within.astype(gates.dtype))
         fill = fill + jnp.sum(onehot, axis=0)
         remaining = remaining * (1 - onehot).astype(gates.dtype)
-    wsum = sum(picked_w)
-    for w, sel in zip(picked_w, picked_mask):
-        weight = w / jnp.maximum(wsum, 1e-9) if normalize else w
-        combine = combine + weight[:, None, None] * sel
-    dispatch = (combine > 0).astype(gates.dtype)
+    w = jnp.stack(w_l)                                          # [k, T]
+    if normalize:
+        w = w / jnp.maximum(jnp.sum(w, axis=0, keepdims=True), 1e-9)
     # GShard load-balance loss over the primary (top-1) assignment:
     # E * sum_e(mean_prob_e * fraction_tokens_e).
     me = jnp.mean(gates, axis=0)                                 # [E]
     ce = jnp.mean(first_mask.astype(gates.dtype), axis=0)        # [E]
     l_aux = jnp.sum(me * ce) * E
+    return (jnp.stack(eidx_l), jnp.stack(pos_l), jnp.stack(keep_l), w,
+            l_aux)
+
+
+def _capacity_gating(gates, top_k, capacity, normalize, random_keep=None):
+    """Dense capacity-based top-k routing — the numerics ORACLE.
+
+    Densifies _topk_routing into (combine [T,E,C], dispatch [T,E,C]
+    float 0/1, l_aux).  O(T*E*C) memory: use the ragged path
+    (moe_ragged_dispatch/combine) at scale; this form remains for the
+    einsum path and for checking the ragged path against."""
+    E = gates.shape[1]
+    eidx, pos, keep, w, l_aux = _topk_routing(
+        gates, top_k, capacity, normalize, random_keep)
+    oh_e = jax.nn.one_hot(eidx, E, dtype=gates.dtype)           # [k,T,E]
+    oh_c = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)     # [k,T,C]
+    sel = (oh_e[..., :, None] * oh_c[..., None, :]
+           * keep[..., None, None].astype(gates.dtype))         # [k,T,E,C]
+    combine = jnp.sum(w[..., None, None] * sel, axis=0)
+    dispatch = (combine > 0).astype(gates.dtype)
     return combine, dispatch, l_aux
 
 
@@ -89,6 +112,13 @@ def _capacity_gating(gates, top_k, capacity, normalize, random_keep=None):
 def _moe_gating(logits, top_k, capacity, normalize, random_keep=None):
     gates = jax.nn.softmax(logits, axis=-1)
     return _capacity_gating(gates, top_k, capacity, normalize, random_keep)
+
+
+@def_op("moe_topk_routing")
+def _moe_topk_routing(logits, top_k, capacity, normalize,
+                      random_keep=None):
+    gates = jax.nn.softmax(logits, axis=-1)
+    return _topk_routing(gates, top_k, capacity, normalize, random_keep)
 
 
 class BaseGate(Layer):
@@ -149,6 +179,18 @@ class NaiveGate(BaseGate):
             self._random_keep(x.shape[0]))
         self.set_loss(l_aux if self.use_balance_loss else None)
         return combine, dispatch
+
+    def route(self, x):
+        """Ragged routing: x [T, d_model] -> (expert_idx, slot_pos, keep,
+        weight) each [top_k, T], plus capacity — O(T) memory, no [T,E,C]
+        tensor.  Same selection math as forward(); MoELayer's fast path."""
+        logits = self.gate_logits(x)
+        cap = self.capacity(x.shape[0], self.training)
+        eidx, pos, keep, w, l_aux = _moe_topk_routing(
+            logits, self.top_k, cap, self.normalize,
+            self._random_keep(x.shape[0]))
+        self.set_loss(l_aux if self.use_balance_loss else None)
+        return eidx, pos, keep, w, cap
 
 
 class GShardGate(NaiveGate):

@@ -47,17 +47,57 @@ def _combine(combine, y):
     return jnp.einsum("tec,ecm->tm", combine, y)
 
 
+@def_op("moe_ragged_dispatch")
+def _ragged_dispatch(x, expert_idx, slot_pos, keep, num_expert, capacity):
+    """Scatter tokens into the [E, C, M] expert buffers by routing
+    assignment — O(T*k) work and O(E*C*M) output, never materializing the
+    [T, E, C] one-hot (the reference moves the same token payloads with
+    global_scatter alltoall, moe_layer.py:119; under an 'ep' sharding of
+    the expert axis GSPMD lowers this scatter into that all_to_all).
+
+    x [T, M]; expert_idx/slot_pos/keep [k, T].  Dropped assignments
+    (keep=False) land in a dump row that is sliced off."""
+    k, T = expert_idx.shape
+    M = x.shape[-1]
+    dump = num_expert * capacity
+    flat = jnp.where(keep, expert_idx * capacity + slot_pos, dump)
+    buf = jnp.zeros((dump + 1, M), x.dtype)
+    # round-major assignment order matches flat's [k, T] layout; kept
+    # slots are unique by construction so .add == .set for them
+    buf = buf.at[flat.reshape(-1)].add(jnp.tile(x, (k, 1)))
+    return buf[:dump].reshape(num_expert, capacity, M)
+
+
+@def_op("moe_ragged_combine")
+def _ragged_combine(y, expert_idx, slot_pos, keep, weight):
+    """Gather each assignment's expert output and weighted-sum per token:
+    the inverse of _ragged_dispatch (reference: global_gather,
+    moe_layer.py:167).  y [E, C, M] -> out [T, M]."""
+    E, C, M = y.shape
+    flat = jnp.where(keep, expert_idx * C + slot_pos, E * C)
+    y_flat = jnp.concatenate(
+        [y.reshape(E * C, M), jnp.zeros((1, M), y.dtype)])
+    g = y_flat[flat.reshape(-1)].reshape(*expert_idx.shape, M)  # [k,T,M]
+    return jnp.sum(weight[..., None].astype(y.dtype) * g, axis=0)
+
+
 @def_op("expert_ffn")
 def _expert_ffn(x, w1, b1, w2, b2, activation):
-    """Stacked-expert FFN on [E, C, M] buffers (batched einsum -> MXU)."""
+    """Stacked-expert FFN on [E, C, M] buffers (batched einsum -> MXU).
+    Biases may be None (the fused_moe functional path shares this body)."""
     import jax
-    h = jnp.einsum("ecm,emh->ech", x, w1) + b1[:, None, :]
+    h = jnp.einsum("ecm,emh->ech", x, w1)
+    if b1 is not None:
+        h = h + b1[:, None, :]
     if activation == "swiglu":
         u, g = jnp.split(h, 2, axis=-1)
         h = u * jax.nn.silu(g)
     else:
         h = getattr(jax.nn, activation)(h)
-    return jnp.einsum("ech,ehm->ecm", h, w2) + b2[:, None, :]
+    y = jnp.einsum("ech,ehm->ecm", h, w2)
+    if b2 is not None:
+        y = y + b2[:, None, :]
+    return y
 
 
 class ExpertFFN(Layer):
@@ -149,12 +189,24 @@ class MoELayer(Layer):
     def forward(self, x: Tensor) -> Tensor:
         orig_shape = x.shape
         tokens = x.reshape([-1, self.d_model])
-        combine, dispatch = self.gate(tokens)
-        expert_in = _dispatch(dispatch, tokens)          # [E, C, M]
-        expert_out = self._run_experts(
-            expert_in,
-            use_recompute=self.recompute_interval > 0 and self.training)
-        y = _combine(combine, expert_out)                # [T, M]
+        use_recompute = self.recompute_interval > 0 and self.training
+        if (isinstance(self.gate, NaiveGate)
+                and type(self.gate).forward is NaiveGate.forward):
+            # ragged fast path: O(T) routing metadata + scatter/gather,
+            # no [T, E, C] tensor.  A subclass that overrides forward()
+            # (the documented combine/dispatch contract) keeps its
+            # override — only stock gate routing is substituted.
+            eidx, pos, keep, w, cap = self.gate.route(tokens)
+            expert_in = _ragged_dispatch(tokens, eidx, pos, keep,
+                                         self.num_expert, cap)
+            expert_out = self._run_experts(expert_in, use_recompute)
+            y = _ragged_combine(expert_out, eidx, pos, keep, w)
+        else:
+            # custom gates keep the dense combine/dispatch contract
+            combine, dispatch = self.gate(tokens)
+            expert_in = _dispatch(dispatch, tokens)      # [E, C, M]
+            expert_out = self._run_experts(expert_in, use_recompute)
+            y = _combine(combine, expert_out)            # [T, M]
         return y.reshape(orig_shape)
 
 

@@ -13,46 +13,69 @@ import jax
 import jax.numpy as jnp
 
 from ....framework.dispatch import def_op
-from ...distributed.models.moe.gate import _capacity_gating
+from ...distributed.models.moe.gate import _capacity_gating, _topk_routing
 
 
-def _act(name):
-    return {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
-            "silu": jax.nn.silu, "swiglu": None}[name]
+def _expert_ffn_block(expert_in, ffn1_weight, ffn1_bias, ffn2_weight,
+                      ffn2_bias, activation):
+    """Stacked-expert FFN on [E, C, M] buffers — single shared body with
+    MoELayer's expert op so the two MoE paths cannot diverge."""
+    from ...distributed.models.moe.moe_layer import _expert_ffn
+    return _expert_ffn.raw_fn(expert_in, ffn1_weight, ffn1_bias,
+                              ffn2_weight, ffn2_bias, activation)
 
 
 @def_op("fused_moe")
 def _fused_moe(x, gate_weight, ffn1_weight, ffn1_bias, ffn2_weight,
-               ffn2_bias, top_k, capacity, activation, normalize):
-    """Single-region MoE: gate -> dense dispatch -> stacked-expert FFN ->
+               ffn2_bias, top_k, capacity, activation, normalize,
+               dispatch_mode="ragged"):
+    """Single-region MoE: gate -> dispatch -> stacked-expert FFN ->
     combine.  Weight shapes: gate [M, E], ffn1 [E, M, H], ffn2 [E, H, M].
     Shard ffn weights + the [E, C, M] buffers on an 'ep' mesh axis and GSPMD
     emits the cross-rank all_to_all (reference does this with
-    global_scatter/global_gather around per-rank experts)."""
+    global_scatter/global_gather around per-rank experts,
+    moe_layer.py:119,167).
+
+    dispatch_mode:
+      'ragged' (default) — scatter/gather by routing assignment, O(T*k)
+        metadata, never materializes [T, E, C]; the production path.
+      'dense' — one-hot einsum dispatch, O(T*E*C) memory; the numerics
+        oracle the ragged path is tested against.
+    """
+    if dispatch_mode not in ("ragged", "dense"):
+        raise ValueError(
+            f"dispatch_mode must be 'ragged' or 'dense', got "
+            f"{dispatch_mode!r}")
     orig_shape = x.shape
     tokens = x.reshape(-1, x.shape[-1])
     logits = tokens @ gate_weight
-    combine, dispatch, l_aux = _capacity_gating(
-        jax.nn.softmax(logits, axis=-1), top_k, capacity, normalize)
-    expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(x.dtype), tokens)
-    h = jnp.einsum("ecm,emh->ech", expert_in, ffn1_weight)
-    if ffn1_bias is not None:
-        h = h + ffn1_bias[:, None, :]
-    if activation == "swiglu":
-        u, g = jnp.split(h, 2, axis=-1)
-        h = u * jax.nn.silu(g)
+    gates = jax.nn.softmax(logits, axis=-1)
+    if dispatch_mode == "ragged":
+        from ...distributed.models.moe.moe_layer import (
+            _ragged_combine, _ragged_dispatch)
+        E = gate_weight.shape[-1]
+        eidx, pos, keep, w, l_aux = _topk_routing(
+            gates, top_k, capacity, normalize)
+        expert_in = _ragged_dispatch.raw_fn(tokens, eidx, pos, keep, E,
+                                        capacity)
+        y = _expert_ffn_block(expert_in, ffn1_weight, ffn1_bias,
+                              ffn2_weight, ffn2_bias, activation)
+        out = _ragged_combine.raw_fn(y, eidx, pos, keep, w)
     else:
-        h = _act(activation)(h)
-    y = jnp.einsum("ech,ehm->ecm", h, ffn2_weight)
-    if ffn2_bias is not None:
-        y = y + ffn2_bias[:, None, :]
-    out = jnp.einsum("tec,ecm->tm", combine.astype(x.dtype), y)
+        combine, dispatch, l_aux = _capacity_gating(
+            gates, top_k, capacity, normalize)
+        expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(x.dtype),
+                               tokens)
+        y = _expert_ffn_block(expert_in, ffn1_weight, ffn1_bias,
+                              ffn2_weight, ffn2_bias, activation)
+        out = jnp.einsum("tec,ecm->tm", combine.astype(x.dtype), y)
     return out.reshape(orig_shape), l_aux
 
 
 def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
               ffn2_bias=None, top_k=2, capacity_factor=1.25,
-              activation="gelu", normalize=True, name=None):
+              activation="gelu", normalize=True, dispatch_mode="ragged",
+              name=None):
     """reference: incubate/nn/functional/fused_moe.py fused_moe."""
     from ...distributed.models.moe.gate import moe_capacity
     num_tokens = 1
@@ -61,7 +84,8 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
     capacity = moe_capacity(top_k, num_tokens, gate_weight.shape[-1],
                             capacity_factor)
     return _fused_moe(x, gate_weight, ffn1_weight, ffn1_bias, ffn2_weight,
-                      ffn2_bias, top_k, capacity, activation, normalize)
+                      ffn2_bias, top_k, capacity, activation, normalize,
+                      dispatch_mode)
 
 
 __all__ = ["fused_moe"]

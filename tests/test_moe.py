@@ -213,3 +213,156 @@ class TestGlobalScatterGather:
         gathered = global_gather(scattered)
         assert isinstance(gathered.dist_attr.placements[0], Replicate)
         np.testing.assert_allclose(gathered.numpy(), buf.numpy(), rtol=1e-6)
+
+
+class TestRaggedDispatch:
+    """Ragged sort-free scatter/gather MoE dispatch (VERDICT r4 item 3):
+    O(T*k) routing metadata instead of the O(T*E*C) one-hot; dense einsum
+    path retained as the numerics oracle."""
+
+    def _routing_inputs(self, T=24, E=4, seed=0):
+        rng = np.random.default_rng(seed)
+        gates = jax.nn.softmax(jnp.asarray(
+            rng.standard_normal((T, E)).astype("float32")), axis=-1)
+        return gates
+
+    @pytest.mark.parametrize("top_k,capacity,normalize", [
+        (1, 8, False), (2, 8, True), (2, 3, True), (3, 24, True)])
+    def test_ragged_matches_dense_oracle(self, top_k, capacity, normalize):
+        from paddle_tpu.incubate.distributed.models.moe.gate import (
+            _capacity_gating, _topk_routing)
+        from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+            _ragged_combine, _ragged_dispatch)
+        T, E, M = 24, 4, 16
+        gates = self._routing_inputs(T, E)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((T, M)).astype("float32"))
+        y_expert = jnp.asarray(
+            rng.standard_normal((E, capacity, M)).astype("float32"))
+
+        combine, dispatch, l_dense = _capacity_gating(
+            gates, top_k, capacity, normalize)
+        eidx, pos, keep, w, l_ragged = _topk_routing(
+            gates, top_k, capacity, normalize)
+        np.testing.assert_allclose(float(l_dense), float(l_ragged),
+                                   rtol=1e-6)
+
+        # dispatch: ragged scatter == one-hot einsum
+        dense_in = jnp.einsum("tec,tm->ecm", dispatch, x)
+        ragged_in = _ragged_dispatch.raw_fn(x, eidx, pos, keep, E,
+                                            capacity)
+        np.testing.assert_allclose(np.asarray(ragged_in),
+                                   np.asarray(dense_in), atol=1e-6)
+
+        # combine: ragged gather == one-hot einsum
+        dense_out = jnp.einsum("tec,ecm->tm", combine, y_expert)
+        ragged_out = _ragged_combine.raw_fn(y_expert, eidx, pos, keep, w)
+        np.testing.assert_allclose(np.asarray(ragged_out),
+                                   np.asarray(dense_out), atol=1e-6)
+
+    def test_ragged_matches_dense_with_random_keep(self):
+        from paddle_tpu.incubate.distributed.models.moe.gate import (
+            _capacity_gating, _topk_routing)
+        T, E, C = 24, 4, 6
+        gates = self._routing_inputs(T, E)
+        u = jnp.asarray(np.random.default_rng(2).uniform(size=T)
+                        .astype("float32"))
+        combine, dispatch, _ = _capacity_gating(gates, 2, C, True,
+                                                random_keep=u)
+        eidx, pos, keep, w, _ = _topk_routing(gates, 2, C, True,
+                                              random_keep=u)
+        # densify the ragged routing and compare one-to-one
+        oh = np.zeros((T, E, C), np.float32)
+        kk, TT = np.asarray(eidx).shape
+        for k in range(kk):
+            for t in range(TT):
+                if np.asarray(keep)[k, t]:
+                    oh[t, np.asarray(eidx)[k, t],
+                       np.asarray(pos)[k, t]] = np.asarray(w)[k, t]
+        np.testing.assert_allclose(oh, np.asarray(combine), atol=1e-6)
+
+    def test_fused_moe_ragged_matches_dense(self):
+        rng = np.random.default_rng(3)
+        T, M, H, E = 32, 16, 32, 4
+        x = paddle.to_tensor(rng.standard_normal((2, T // 2, M))
+                             .astype("float32"))
+        gw = paddle.to_tensor(rng.standard_normal((M, E))
+                              .astype("float32") * 0.1)
+        w1 = paddle.to_tensor(rng.standard_normal((E, M, H))
+                              .astype("float32") * 0.1)
+        w2 = paddle.to_tensor(rng.standard_normal((E, H, M))
+                              .astype("float32") * 0.1)
+        out_r, aux_r = fused_moe(x, gw, w1, w2, dispatch_mode="ragged")
+        out_d, aux_d = fused_moe(x, gw, w1, w2, dispatch_mode="dense")
+        np.testing.assert_allclose(out_r.numpy(), out_d.numpy(), atol=1e-5)
+        np.testing.assert_allclose(float(aux_r.numpy()),
+                                   float(aux_d.numpy()), rtol=1e-6)
+
+    def test_moe_layer_ragged_grads_match_dense_path(self):
+        """MoELayer's ragged fast path must produce the same loss AND
+        parameter gradients as the dense einsum path."""
+        from paddle_tpu.incubate.distributed.models.moe import gate as G
+
+        def run(force_dense):
+            paddle.seed(7)
+            np.random.seed(7)
+            layer = MoELayer(D, ExpertFFN(4, D, 32),
+                             gate={"type": "naive", "top_k": 2})
+            if force_dense:
+                # strip the fast path by making the gate look custom
+                orig = layer.gate
+
+                class DenseOnly(G.BaseGate):
+                    def __init__(self):
+                        G.BaseGate.__init__(self, orig.tot_expert, 1)
+
+                    def forward(self, x):
+                        return orig.forward(x)
+
+                    def parameters(self, include_sublayers=True):
+                        return orig.parameters(include_sublayers)
+
+                dense_gate = DenseOnly()
+                layer.gate = dense_gate
+            x = paddle.to_tensor(
+                np.random.default_rng(9).standard_normal(
+                    (8, D)).astype("float32"))
+            x.stop_gradient = False
+            out = layer(x)
+            loss = (out ** 2).sum()
+            loss.backward()
+            grads = [p.grad.numpy().copy()
+                     for p in layer.experts.parameters()]
+            return float(loss.numpy()), x.grad.numpy().copy(), grads
+
+        loss_r, xg_r, g_r = run(force_dense=False)
+        loss_d, xg_d, g_d = run(force_dense=True)
+        np.testing.assert_allclose(loss_r, loss_d, rtol=1e-5)
+        np.testing.assert_allclose(xg_r, xg_d, atol=1e-5)
+        for a, b in zip(g_r, g_d):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+    def test_dispatch_memory_linear_in_tokens(self):
+        """The compiled ragged dispatch must not materialize any
+        [T, E, C]-sized temp: at T=4096, E=64, C=128 that one-hot alone
+        is 128 MB; the ragged path's live set stays under 1/4 of it."""
+        from paddle_tpu.incubate.distributed.models.moe.gate import (
+            _topk_routing)
+        from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+            _ragged_dispatch)
+        T, E, C, M = 4096, 64, 128, 64
+        one_hot_bytes = T * E * C * 4
+
+        def ragged(gates, x):
+            eidx, pos, keep, w, _ = _topk_routing(gates, 2, C, True)
+            return _ragged_dispatch.raw_fn(x, eidx, pos, keep, E, C)
+
+        lowered = jax.jit(ragged).lower(
+            jax.ShapeDtypeStruct((T, E), jnp.float32),
+            jax.ShapeDtypeStruct((T, M), jnp.float32))
+        mem = lowered.compile().memory_analysis()
+        temp = getattr(mem, "temp_size_in_bytes", None)
+        if temp is None:
+            pytest.skip("backend exposes no memory analysis")
+        assert temp < one_hot_bytes / 4, (
+            f"ragged dispatch temps {temp} vs one-hot {one_hot_bytes}")
