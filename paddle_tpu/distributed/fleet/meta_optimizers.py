@@ -150,6 +150,14 @@ class DGCMomentum:
             sparsity, (int, float)) else (float(sparsity),)
         self.rampup_step = max(int(rampup_step), 1)
         self.momentum = float(momentum)
+        if isinstance(inner, LarsMomentum):
+            # DGC's accumulator replaces the inner momentum, which for
+            # LARS would silently discard the trust-ratio-scaled velocity
+            # — the combination degrades to plain DGC semantics
+            raise ValueError(
+                "DGC cannot wrap LarsMomentum: DGC neutralizes the inner "
+                "momentum, which erases LARS's trust-ratio scaling. "
+                "Enable either strategy.lars or strategy.dgc, not both.")
         if isinstance(inner, Momentum):
             inner._momentum = 0.0       # avoid double momentum
         self._step_count = 0

@@ -1,0 +1,291 @@
+"""Continuous batching over the paged-KV pool.
+
+Reference capability: the block-multi-head serving path
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu) —
+sequences share a page pool and join/leave the running decode batch per
+step.  The round-4 GenerationServer serialized whole requests behind a
+lock; this engine admits each sequence independently:
+
+  * requests enqueue; a scheduler thread admits them whenever a running
+    slot and enough pool pages are free (admission RESERVES the
+    sequence's worst-case pages so mid-decode allocation can never fail
+    and wedge the batch);
+  * every decode step runs ALL active sequences as one batch — each at
+    its own length/position (per-row rope positions, per-row page
+    tables), so a long generation no longer blocks short ones behind it;
+  * finished sequences retire per step (pages freed, waiter woken) and
+    their slots are immediately re-admissible.
+
+Batch shapes are bucketed to powers of two (padding rows ride on a
+scratch sequence that is truncated every step) so the decode step
+compiles once per bucket, not once per active-count.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tape import no_grad
+from ..framework.tensor import wrap_array
+from ..ops.pallas.paged_attention import PagedKVCache
+from .paged import _PagedContext
+
+__all__ = ["ContinuousBatchingEngine"]
+
+_PAD_SEQ = "__pad__"
+
+
+class _Request:
+    """One sequence's life in the engine."""
+
+    def __init__(self, prompt, max_new_tokens, eos_token_id, do_sample,
+                 temperature, seed):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.rng = np.random.default_rng(seed)
+        self.generated: List[int] = []
+        self.next_token: Optional[int] = None   # sampled, not yet decoded
+        self.seq_id: Optional[int] = None
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+
+    @property
+    def output_ids(self) -> np.ndarray:
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)])
+
+    def result(self, timeout=None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            raise TimeoutError("generation still running")
+        if self.error is not None:
+            raise self.error
+        return self.output_ids
+
+
+class ContinuousBatchingEngine:
+    """Scheduler + decode loop over one shared PagedKVCache.
+
+    ``submit`` is thread-safe and non-blocking; ``generate`` is the
+    blocking batch facade with PagedGenerator's signature.
+    """
+
+    def __init__(self, model, total_pages: int = 512, page_size: int = 16,
+                 max_batch: int = 8):
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.max_position = int(model.config.max_position_embeddings)
+        self.cache = PagedKVCache.from_model(
+            model, total_pages=total_pages, page_size=page_size)
+        # one scratch sequence backs every padding row of every bucket;
+        # its single page is allocated only for the duration of a padded
+        # step (so an idle engine reports a fully reclaimed pool), but
+        # admission arithmetic always reserves 1 page for it
+        self._reserved_pages = 1               # headroom for the pad page
+        self._queue: List[_Request] = []
+        self._active: List[_Request] = []
+        self._cond = threading.Condition()
+        self._stop = False
+        self._next_seq = 0
+        self.steps = 0                          # decode steps executed
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- public
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None, do_sample: bool = False,
+               temperature: float = 1.0, seed: int = 0) -> _Request:
+        req = _Request(prompt, max_new_tokens, eos_token_id, do_sample,
+                       temperature, seed)
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.max_position:
+            # past the rope table the gather would silently clamp and
+            # reuse the last angles (the scalar path raises; so do we)
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the model's "
+                f"max_position_embeddings ({self.max_position})")
+        need = self._pages_for(req)
+        if need > self.cache.total_pages - 1:
+            raise RuntimeError(
+                f"request needs {need} pages but the pool holds "
+                f"{self.cache.total_pages} total; grow total_pages")
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("engine stopped")
+            self._queue.append(req)
+            self._cond.notify_all()
+        return req
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 seed: int = 0):
+        """Blocking batch API (PagedGenerator-compatible): submits each
+        row as its own sequence and eos-pads rows to a common length."""
+        ids = np.asarray(input_ids, np.int32)
+        reqs = [self.submit(row, max_new_tokens, eos_token_id, do_sample,
+                            temperature, seed + i)
+                for i, row in enumerate(ids)]
+        rows = [r.result() for r in reqs]
+        width = max(len(r) for r in rows)
+        pad = 0 if eos_token_id is None else eos_token_id
+        out = np.full((len(rows), width), pad, np.int32)
+        for i, r in enumerate(rows):
+            out[i, :len(r)] = r
+        return out
+
+    def stop(self):
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ---------------------------------------------------------- scheduler
+    def _pages_for(self, req) -> int:
+        ps = self.cache.page_size
+        return -(-(len(req.prompt) + req.max_new_tokens) // ps)
+
+    def _pop_admissible(self) -> List[_Request]:
+        """Under the lock: move queued requests to 'admitted' while slots
+        and reserved pages allow, assigning seq ids and RESERVING their
+        worst-case pages (prompt + full max_new_tokens) so decode-time
+        allocate() can never exhaust the pool.  Prefill itself runs
+        outside the lock — submit() must never wait on device work."""
+        admitted = []
+        while self._queue and len(self._active) + len(admitted) < self.max_batch:
+            req = self._queue[0]
+            need = self._pages_for(req)
+            if self._reserved_pages + need > self.cache.total_pages:
+                break                     # wait for a retirement
+            self._queue.pop(0)
+            self._reserved_pages += need
+            req.seq_id = self._next_seq
+            self._next_seq += 1
+            admitted.append(req)
+        return admitted
+
+    def _prefill(self, req):
+        with no_grad():
+            self.cache.allocate(req.seq_id, len(req.prompt))
+            ctx = _PagedContext(self.cache, [req.seq_id], prefill=True)
+            hidden = self.model.model(
+                wrap_array(jnp.asarray(req.prompt[None])), 0,
+                paged_ctx=ctx)
+            logits = self.model._logits_of(hidden[:, -1:])
+        req.next_token = self._pick(req,
+                                    np.asarray(logits._data[0, -1],
+                                               np.float32))
+        req.first_token_at = time.perf_counter()
+
+    def _pick(self, req, logits_row) -> int:
+        from .paged import sample_token
+        return sample_token(logits_row, req.do_sample, req.temperature,
+                            req.rng)
+
+    def _retire(self, req):
+        self.cache.free(req.seq_id)
+        self._reserved_pages -= self._pages_for(req)
+        req.finished_at = time.perf_counter()
+        req.done.set()
+
+    def _bucket(self, n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.max_batch)
+
+    def _decode_step(self):
+        """One token for every active sequence, padded to a bucket."""
+        active = self._active
+        B = self._bucket(len(active))
+        npad = B - len(active)
+        # the new token enters the sequence now: record it first so its
+        # rope position (== current length) is read before the write
+        tokens = np.zeros((B, 1), np.int32)
+        pos = np.zeros(B, np.int32)
+        seq_ids = []
+        for i, r in enumerate(active):
+            r.generated.append(r.next_token)
+            tokens[i, 0] = r.next_token
+            pos[i] = self.cache.length(r.seq_id)
+            self.cache.allocate(r.seq_id, 1)
+            seq_ids.append(r.seq_id)
+        # pad rows: a scratch sequence rewrites its slot 0 every step
+        if npad:
+            self.cache.allocate(_PAD_SEQ, 1)
+            self.cache.truncate(_PAD_SEQ, 0)
+            seq_ids.extend([_PAD_SEQ] * npad)
+        try:
+            with no_grad():
+                ctx = _PagedContext(self.cache, seq_ids, prefill=False)
+                hidden = self.model.model(wrap_array(jnp.asarray(tokens)),
+                                          jnp.asarray(pos), paged_ctx=ctx)
+                logits = self.model._logits_of(hidden)
+            logits_np = np.asarray(logits._data[:, -1], np.float32)
+        finally:
+            if npad:
+                self.cache.free(_PAD_SEQ)
+        self.steps += 1
+
+        still = []
+        for i, r in enumerate(active):
+            eos_hit = (r.eos_token_id is not None
+                       and r.generated[-1] == r.eos_token_id)
+            if eos_hit or len(r.generated) >= r.max_new_tokens:
+                self._retire(r)
+                continue
+            r.next_token = self._pick(r, logits_np[i])
+            still.append(r)
+        self._active = still
+
+    def _fail_all(self, exc, admitted):
+        """Error out every in-flight request WITHOUT leaking pool
+        capacity: sequences that already own pages are freed and their
+        reservations rolled back, so the engine stays usable."""
+        with self._cond:
+            for r in self._active + admitted + self._queue:
+                r.error = exc
+                r.done.set()
+            for r in self._active + admitted:
+                if r.seq_id is not None:
+                    self.cache.free(r.seq_id)
+            self._reserved_pages = 1          # only the pad headroom
+            self._active, self._queue = [], []
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._stop and not self._queue and not self._active:
+                    self._cond.wait(timeout=0.5)
+                if self._stop:
+                    for r in self._queue + self._active:
+                        r.error = RuntimeError("engine stopped")
+                        r.done.set()
+                    return
+                admitted = self._pop_admissible()
+            try:
+                for req in admitted:           # device work: outside lock
+                    self._prefill(req)
+                with self._cond:
+                    self._active.extend(admitted)
+                    admitted = []
+                if self._active:
+                    self._decode_step()
+            except BaseException as e:  # noqa: BLE001 — fail loudly, not hang
+                self._fail_all(e, admitted)

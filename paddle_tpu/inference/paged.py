@@ -41,8 +41,9 @@ class _PagedContext:
         the pages, returns the attention output (batch, s, q_heads, d)."""
         cache = self.cache
         layer = self.layer_idx
-        for i, sid in enumerate(self.seq_ids):
-            cache.write(layer, sid, k[i]._data, v[i]._data)
+        # whole batch in ONE scatter per pool (not per sequence — the
+        # per-seq loop copied the full pool batch times per step)
+        cache.write_batch(layer, self.seq_ids, k._data, v._data)
         if self.prefill:
             # fresh sequences: the cache holds exactly this prompt, so
             # dense causal attention over the batch is equivalent
@@ -59,6 +60,18 @@ class _PagedContext:
         return wrap_array(out[:, None])      # (batch, 1, q_heads, d)
 
 
+def sample_token(logits_row, do_sample, temperature, rng) -> int:
+    """One row's next token: greedy argmax or temperature sampling —
+    the single sampling definition shared by PagedGenerator and the
+    continuous-batching engine."""
+    if do_sample:
+        z = np.asarray(logits_row, np.float32) / max(temperature, 1e-6)
+        p = np.exp(z - z.max())
+        p /= p.sum()
+        return int(rng.choice(p.shape[-1], p=p))
+    return int(np.asarray(logits_row).argmax())
+
+
 class PagedGenerator:
     """Batched greedy/sampled decoding over a shared page pool.
 
@@ -70,14 +83,14 @@ class PagedGenerator:
 
     def __init__(self, model, total_pages: int = 256, page_size: int = 16):
         self.model = model
-        c = model.config
         self._next_seq = 0
-        self.cache = PagedKVCache(
-            num_layers=c.num_hidden_layers,
-            kv_heads=c.num_key_value_heads,
-            head_dim=c.hidden_size // c.num_attention_heads,
-            total_pages=total_pages, page_size=page_size,
-            dtype=model.model.embed_tokens.weight._data.dtype)
+        self.cache = PagedKVCache.from_model(
+            model, total_pages=total_pages, page_size=page_size)
+        # per-phase wall times of the last generate() call, so callers
+        # (bench, schedulers) can split prefill from steady-state decode
+        # without a second subtraction run
+        self.last_prefill_seconds = 0.0
+        self.last_decode_seconds = 0.0
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None,
@@ -102,29 +115,30 @@ class PagedGenerator:
 
     def _generate(self, ids, seq_ids, max_new_tokens, eos_token_id,
                   do_sample, temperature, rng):
+        import time as _time
+
         b, s = ids.shape
         model = self.model
         with no_grad():
+            t0 = _time.perf_counter()
             for sid in seq_ids:
                 self.cache.allocate(sid, s)
             ctx = _PagedContext(self.cache, seq_ids, prefill=True)
             hidden = model.model(wrap_array(jnp.asarray(ids)),
                                  0, paged_ctx=ctx)
             logits = model._logits_of(hidden[:, -1:])
+            jnp.asarray(logits._data).block_until_ready()
+            self.last_prefill_seconds = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
 
             out = [ids]
             finished = np.zeros(b, bool)
             pos = s
             for _ in range(max_new_tokens):
                 step = np.asarray(logits._data[:, -1].astype(jnp.float32))
-                if do_sample:
-                    step = step / max(temperature, 1e-6)
-                    p = np.exp(step - step.max(-1, keepdims=True))
-                    p /= p.sum(-1, keepdims=True)
-                    nxt = np.array([rng.choice(p.shape[-1], p=pi)
-                                    for pi in p])
-                else:
-                    nxt = step.argmax(-1)
+                nxt = np.array([
+                    sample_token(row, do_sample, temperature, rng)
+                    for row in step])
                 if eos_token_id is not None:
                     nxt = np.where(finished, eos_token_id, nxt)
                     finished |= nxt == eos_token_id
@@ -138,5 +152,6 @@ class PagedGenerator:
                     wrap_array(jnp.asarray(out[-1])), pos, paged_ctx=ctx)
                 logits = model._logits_of(hidden)
                 pos += 1
+            self.last_decode_seconds = _time.perf_counter() - t0
 
         return np.concatenate(out, axis=1)

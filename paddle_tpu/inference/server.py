@@ -161,30 +161,40 @@ class GenerationServer(_ServerLifecycle):
                      "temperature": float?}
         -> {"output_ids": [[...], ...], "new_tokens": N}
 
-    One PagedGenerator (shared page pool) guarded by a lock — batches run
-    sequentially; batch the prompts client-side for throughput.  Sampled
-    requests draw a fresh per-request seed unless the request pins one.
+    Requests are CONTINUOUSLY BATCHED: every row of every in-flight HTTP
+    request is its own sequence in one shared ContinuousBatchingEngine —
+    concurrent requests decode together per step instead of queueing
+    behind a server lock, and short generations retire without waiting
+    for long ones.  Sampled requests draw a fresh per-request seed
+    unless the request pins one.
+
+    Error mapping: 400 = malformed request, 503 = pool/capacity
+    exhaustion (retry later), 500 = unexpected server fault.
     """
 
     def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
-                 total_pages: int = 512, page_size: int = 16):
-        from .paged import PagedGenerator
+                 total_pages: int = 512, page_size: int = 16,
+                 max_batch: int = 8):
+        from .continuous import ContinuousBatchingEngine
 
-        self._gen = PagedGenerator(model, total_pages=total_pages,
-                                   page_size=page_size)
-        self._lock = threading.Lock()
+        self._engine = ContinuousBatchingEngine(
+            model, total_pages=total_pages, page_size=page_size,
+            max_batch=max_batch)
+        self._count_lock = threading.Lock()
         self._request_count = 0
         outer = self
 
         class Handler(_JsonHandler):
             def do_GET(self):
                 if self.path == "/health":
-                    cache = outer._gen.cache
+                    cache = outer._engine.cache
                     self._reply(200, {
                         "status": "ok",
                         "free_pages": cache.free_pages,
                         "total_pages": cache.total_pages,
-                        "page_size": cache.page_size})
+                        "page_size": cache.page_size,
+                        "active_sequences": len(outer._engine._active),
+                        "queued_sequences": len(outer._engine._queue)})
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -193,33 +203,52 @@ class GenerationServer(_ServerLifecycle):
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
                 try:
-                    req = self._read_json()
-                    ids = np.asarray(req["input_ids"], np.int32)
-                    if ids.ndim != 2:
-                        raise ValueError("input_ids must be 2-D "
-                                         "(batch, seq)")
-                    with outer._lock:
-                        outer._request_count += 1
-                        seed = int(req.get("seed",
-                                           outer._request_count))
-                        out = outer._gen.generate(
-                            ids,
-                            max_new_tokens=int(
-                                req.get("max_new_tokens", 32)),
-                            eos_token_id=req.get("eos_token_id"),
-                            do_sample=bool(req.get("do_sample", False)),
-                            temperature=float(req.get("temperature", 1.0)),
+                    try:
+                        req = self._read_json()
+                        if not isinstance(req, dict):
+                            raise ValueError("request body must be a "
+                                             "JSON object")
+                        ids = np.asarray(req["input_ids"], np.int32)
+                        if ids.ndim != 2:
+                            raise ValueError("input_ids must be 2-D "
+                                             "(batch, seq)")
+                        max_new = int(req.get("max_new_tokens", 32))
+                        eos = req.get("eos_token_id")
+                        do_sample = bool(req.get("do_sample", False))
+                        temperature = float(req.get("temperature", 1.0))
+                        with outer._count_lock:
+                            outer._request_count += 1
+                            seed = int(req.get("seed",
+                                               outer._request_count))
+                    except (KeyError, ValueError, TypeError,
+                            json.JSONDecodeError) as e:
+                        self._reply(400, {"error": str(e)})
+                        return
+                    try:
+                        out = outer._engine.generate(
+                            ids, max_new_tokens=max_new, eos_token_id=eos,
+                            do_sample=do_sample, temperature=temperature,
                             seed=seed)
+                    except ValueError as e:      # request-shape problems
+                        self._reply(400, {"error": str(e)})
+                        return
                     self._reply(200, {
                         "output_ids": out.tolist(),
                         "new_tokens": int(out.shape[1] - ids.shape[1])})
-                except Exception as e:   # noqa: BLE001
-                    self._reply(400, {"error": str(e)})
+                except RuntimeError as e:
+                    # capacity (page-pool/queue) exhaustion: retryable
+                    self._reply(503, {"error": str(e)})
+                except Exception as e:   # noqa: BLE001 — server fault
+                    self._reply(500, {"error": str(e)})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def stop(self):
+        super().stop()
+        self._engine.stop()
 
 
 def serve(model_prefix: str, host: str = "127.0.0.1", port: int = 8000,

@@ -82,6 +82,24 @@ def apply_rope(q, k, cos, sin, position_offset=0):
                                               fused_rope_xla)
 
     s = q.shape[1]
+    if getattr(position_offset, "ndim", 0) == 1:
+        # per-row positions (continuous batching: each sequence in the
+        # decode batch sits at its own length) — gather each row's angle
+        # window instead of one shared dynamic slice
+        pos = jnp.asarray(position_offset, jnp.int32)      # (b,)
+        idx = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        c = cos[idx][:, :, None, :]                        # (b, s, 1, half)
+        si = sin[idx][:, :, None, :]
+
+        def rot(x):
+            half = x.shape[-1] // 2
+            x1 = x[..., :half].astype(jnp.float32)
+            x2 = x[..., half:].astype(jnp.float32)
+            return jnp.concatenate(
+                [x1 * c - x2 * si, x2 * c + x1 * si],
+                axis=-1).astype(x.dtype)
+
+        return rot(q), rot(k)
     if not isinstance(position_offset, jax.core.Tracer) \
             and int(position_offset) + s > cos.shape[0]:
         raise ValueError(

@@ -175,6 +175,16 @@ def paged_attention(q, k_pages, v_pages, lengths, page_tables, scale=None,
 
 
 # ------------------------------------------------------------- page cache
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages(pool, pages, slots, vals):
+    """One scatter for a whole step's writes (all sequences at once).
+    The pool buffer is DONATED so XLA updates it in place instead of
+    copying the full pool per write — the per-sequence .at[].set loop
+    this replaces copied ~the whole pool batch x layers times per
+    decoded token."""
+    return pool.at[:, pages, slots].set(vals.astype(pool.dtype))
+
+
 class PagedKVCache:
     """Paged KV cache: device page pools per layer + host-side page-table
     bookkeeping (reference: the BlockTable management around
@@ -182,6 +192,19 @@ class PagedKVCache:
 
     Layout per layer: (kv_heads, total_pages, page_size, head_dim).
     """
+
+    @classmethod
+    def from_model(cls, model, total_pages: int = 256,
+                   page_size: int = 16) -> "PagedKVCache":
+        """Cache sized for a causal-LM model's config (single wiring
+        point shared by PagedGenerator and ContinuousBatchingEngine)."""
+        c = model.config
+        return cls(
+            num_layers=c.num_hidden_layers,
+            kv_heads=c.num_key_value_heads,
+            head_dim=c.hidden_size // c.num_attention_heads,
+            total_pages=total_pages, page_size=page_size,
+            dtype=model.model.embed_tokens.weight._data.dtype)
 
     def __init__(self, num_layers: int, kv_heads: int, head_dim: int,
                  total_pages: int = 256, page_size: int = 16,
@@ -216,6 +239,13 @@ class PagedKVCache:
         self._free.extend(self._seq_pages.pop(seq_id, []))
         self._seq_len.pop(seq_id, None)
 
+    def truncate(self, seq_id, length: int) -> None:
+        """Roll a sequence's logical length back (pages stay allocated,
+        their tail slots are simply rewritten by later writes) — used by
+        the continuous-batching scheduler's scratch padding sequence."""
+        if self._seq_len.get(seq_id, 0) > length:
+            self._seq_len[seq_id] = length
+
     @property
     def free_pages(self) -> int:
         """Unallocated pages remaining in the pool."""
@@ -239,23 +269,37 @@ class PagedKVCache:
     # ------------------------------------------------------- data writes
     def write(self, layer: int, seq_id: int, k_new, v_new) -> None:
         """Append (tokens, kv_heads, head_dim) k/v for one sequence into
-        its pages (call allocate() first; layer 0 advances the length)."""
-        n = k_new.shape[0]
-        start = self._seq_len.get(seq_id, 0)
-        pages = self._seq_pages[seq_id]
-        kp, vp = self.k_pages[layer], self.v_pages[layer]
-        # token t -> (page_id, slot); contiguous runs write page-at-a-time
-        t = 0
-        while t < n:
-            pos = start + t
-            page = pages[pos // self.page_size]
-            slot = pos % self.page_size
-            run = min(self.page_size - slot, n - t)
-            ks = jnp.swapaxes(k_new[t:t + run], 0, 1)   # (kv_heads, run, d)
-            vs = jnp.swapaxes(v_new[t:t + run], 0, 1)
-            kp = kp.at[:, page, slot:slot + run].set(ks.astype(kp.dtype))
-            vp = vp.at[:, page, slot:slot + run].set(vs.astype(vp.dtype))
-            t += run
-        self.k_pages[layer], self.v_pages[layer] = kp, vp
+        its pages (call allocate() first; the last layer's write advances
+        the length)."""
+        self.write_batch(layer, [seq_id], k_new[None], v_new[None])
+
+    def write_batch(self, layer: int, seq_ids, k_new, v_new) -> None:
+        """Append one step's k/v for MANY sequences in a single scatter
+        per pool: k_new/v_new (batch, tokens, kv_heads, head_dim).  All
+        (page, slot) targets for the step are computed host-side from the
+        allocator tables, then written with one donated-buffer .set per
+        layer — O(step tokens) device work instead of O(pool) per
+        sequence (the write-amplification the per-sequence path had).
+        The last layer's write advances the lengths."""
+        b, n = k_new.shape[0], k_new.shape[1]
+        pages_flat = np.empty(b * n, np.int32)
+        slots_flat = np.empty(b * n, np.int32)
+        for i, sid in enumerate(seq_ids):
+            start = self._seq_len.get(sid, 0)
+            pages = self._seq_pages[sid]
+            pos = start + np.arange(n)
+            pages_flat[i * n:(i + 1) * n] = [
+                pages[p] for p in pos // self.page_size]
+            slots_flat[i * n:(i + 1) * n] = pos % self.page_size
+        pg = jnp.asarray(pages_flat)
+        sl = jnp.asarray(slots_flat)
+        # (b, n, kvh, d) -> (kvh, b*n, d) to line up with pool[:, pg, sl]
+        kv_flat = (jnp.reshape(k_new, (b * n,) + k_new.shape[2:]),
+                   jnp.reshape(v_new, (b * n,) + v_new.shape[2:]))
+        self.k_pages[layer] = _scatter_pages(
+            self.k_pages[layer], pg, sl, jnp.swapaxes(kv_flat[0], 0, 1))
+        self.v_pages[layer] = _scatter_pages(
+            self.v_pages[layer], pg, sl, jnp.swapaxes(kv_flat[1], 0, 1))
         if layer == self.num_layers - 1:
-            self._seq_len[seq_id] = start + n
+            for sid in seq_ids:
+                self._seq_len[sid] = self._seq_len.get(sid, 0) + n

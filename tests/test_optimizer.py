@@ -255,6 +255,17 @@ class TestFleetMetaOptimizers:
         np.testing.assert_allclose(np.asarray(lin.weight.numpy()),
                                    w0 - 0.1 * g0, rtol=1e-5, atol=1e-6)
 
+    def test_dgc_refuses_lars_inner(self):
+        # DGC neutralizes the inner momentum, which would silently erase
+        # LARS's trust-ratio-scaled velocity — refuse the combination
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            DGCMomentum, LarsMomentum)
+        lin = self._model_and_grads()
+        lars = LarsMomentum(learning_rate=0.1, momentum=0.9,
+                            parameters=lin.parameters())
+        with pytest.raises(ValueError, match="LARS"):
+            DGCMomentum(lars)
+
     def test_lars_guard_and_exclusions(self):
         from paddle_tpu.distributed.fleet.meta_optimizers import (
             LarsMomentum, convert_meta_optimizers)
