@@ -334,6 +334,14 @@ class ElasticController:
             if self._gen() > gen:
                 return "peer"
             if len(self.manager.alive_nodes()) < world:
+                # a peer that FINISHED and exited cleanly tombstones its
+                # heartbeat right after bumping the done counter — by
+                # heartbeat alone that is indistinguishable from a crash.
+                # Re-read the done counter before declaring an incident:
+                # this poll's done-check may predate the peer's final add
+                # while the alive-check postdates its exit.
+                if self._store.add(f"elastic/gen/{gen}/done", 0) >= world:
+                    return "done"
                 self._bump(gen)
                 return "membership"
             time.sleep(self._poll)

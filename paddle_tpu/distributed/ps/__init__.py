@@ -13,14 +13,17 @@ batch, run the dense compute on-chip, and push gradients back.  Transport is
 the RPC layer (paddle_tpu/distributed/rpc.py); rows shard by ``id % n``.
 """
 from .table import MemorySparseTable  # noqa: F401
+from .ssd_table import SSDSparseTable  # noqa: F401
 from .dense_table import MemoryDenseTable  # noqa: F401
 from .entry import (  # noqa: F401
     Entry, CountFilterEntry, ProbabilityEntry, ShowClickEntry,
 )
 from .server import PSServer, run_server  # noqa: F401
 from .client import PSClient  # noqa: F401
+from .geo import GeoSparseWorker  # noqa: F401
 from .embedding import DistributedEmbedding  # noqa: F401
 
-__all__ = ["MemorySparseTable", "MemoryDenseTable", "PSServer",
-           "run_server", "PSClient", "DistributedEmbedding", "Entry",
-           "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry"]
+__all__ = ["MemorySparseTable", "SSDSparseTable", "MemoryDenseTable",
+           "PSServer", "run_server", "PSClient", "GeoSparseWorker",
+           "DistributedEmbedding", "Entry", "CountFilterEntry",
+           "ProbabilityEntry", "ShowClickEntry"]

@@ -3,6 +3,9 @@
 the_one_ps.py worker side)."""
 from __future__ import annotations
 
+import concurrent.futures
+import time
+import uuid
 from typing import List, Sequence
 
 import numpy as np
@@ -13,34 +16,65 @@ from . import server as _server
 
 class PSClient:
     """Rows shard by ``id % num_servers``; pulls/pushes fan out as one
-    async RPC per involved server."""
+    async RPC per involved server.
 
-    def __init__(self, server_names: Sequence[str]):
+    ``retry_deadline`` > 0 enables crash-restart failover: a connection
+    failure re-resolves the server's endpoint from the store (it may have
+    been relaunched by a supervisor with ``init_rpc(..., rejoin=True)``)
+    and retries until the deadline — the reference's brpc client
+    reconnect behavior (brpc_ps_client.cc)."""
+
+    def __init__(self, server_names: Sequence[str],
+                 retry_deadline: float = 0.0):
         self.server_names = list(server_names)
         self.n = len(self.server_names)
+        self.retry_deadline = float(retry_deadline)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max(self.n * 2, 4))
+
+    # -- failure-aware RPC plumbing ---------------------------------------
+    def _sync(self, server: str, fn, args):
+        # retry ONLY transport failures — a remote-raised exception (even
+        # an OSError subclass like FileNotFoundError from a bad load
+        # path) is a real answer, not a flap
+        deadline = time.monotonic() + self.retry_deadline
+        while True:
+            try:
+                return _rpc.rpc_sync(server, fn, args)
+            except _rpc.TransportError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.25)
+                try:
+                    _rpc.refresh_worker(server)
+                except Exception:   # noqa: BLE001 — store itself flaky
+                    pass
+
+    def _submit(self, server: str, fn, args):
+        return self._pool.submit(self._sync, server, fn, args)
 
     # -- table mgmt --------------------------------------------------------
     def create_table(self, name: str, dim: int, **kwargs) -> None:
-        futs = [_rpc.rpc_async(s, _server._h_create_table,
-                               (name, dim, kwargs))
+        futs = [self._submit(s, _server._h_create_table,
+                             (name, dim, kwargs))
                 for s in self.server_names]
         for f in futs:
             f.result()
 
     def table_size(self, name: str) -> int:
-        return sum(_rpc.rpc_sync(s, _server._h_size, (name,))
+        return sum(self._sync(s, _server._h_size, (name,))
                    for s in self.server_names)
 
     def save(self, name: str, path_prefix: str) -> None:
-        futs = [_rpc.rpc_async(s, _server._h_save,
-                               (name, f"{path_prefix}.shard{i}"))
+        futs = [self._submit(s, _server._h_save,
+                             (name, f"{path_prefix}.shard{i}"))
                 for i, s in enumerate(self.server_names)]
         for f in futs:
             f.result()
 
     def load(self, name: str, path_prefix: str) -> None:
-        futs = [_rpc.rpc_async(s, _server._h_load,
-                               (name, f"{path_prefix}.shard{i}"))
+        futs = [self._submit(s, _server._h_load,
+                             (name, f"{path_prefix}.shard{i}"))
                 for i, s in enumerate(self.server_names)]
         for f in futs:
             f.result()
@@ -59,8 +93,8 @@ class PSClient:
         flat, parts = self._shard(ids)
         dim = None
         out = None
-        futs = [(pos, _rpc.rpc_async(self.server_names[s], _server._h_pull,
-                                     (name, sub_ids)))
+        futs = [(pos, self._submit(self.server_names[s], _server._h_pull,
+                                   (name, sub_ids)))
                 for s, pos, sub_ids in parts if len(sub_ids)]
         for pos, fut in futs:
             rows = fut.result()
@@ -75,35 +109,39 @@ class PSClient:
     def push_sparse(self, name: str, ids, grads, learning_rate=None) -> None:
         flat, parts = self._shard(ids)
         grads = np.asarray(grads, np.float32).reshape(len(flat), -1)
-        futs = [_rpc.rpc_async(self.server_names[s], _server._h_push,
-                               (name, sub_ids, grads[pos], learning_rate))
+        # one idempotency token per (call, shard): a retried push whose
+        # original applied (lost reply) is deduped server-side
+        futs = [self._submit(self.server_names[s], _server._h_push,
+                             (name, sub_ids, grads[pos], learning_rate,
+                              f"{uuid.uuid4().hex}/{s}"))
                 for s, pos, sub_ids in parts if len(sub_ids)]
         for f in futs:
             f.result()
 
     def stop_servers(self) -> None:
         for s in self.server_names:
-            _rpc.rpc_sync(s, _server._h_stop, ())
+            self._sync(s, _server._h_stop, ())
 
     # -- dense tables ------------------------------------------------------
     def create_dense_table(self, name: str, shape, server: int = 0,
                            **kwargs) -> None:
         """Dense tables live whole on one server (reference: dense params
         are partitioned per-variable, not per-row)."""
-        _rpc.rpc_sync(self.server_names[server % self.n],
-                      _server._h_create_dense, (name, tuple(shape), kwargs))
+        self._sync(self.server_names[server % self.n],
+                   _server._h_create_dense, (name, tuple(shape), kwargs))
 
     def pull_dense(self, name: str, server: int = 0) -> np.ndarray:
-        return _rpc.rpc_sync(self.server_names[server % self.n],
-                             _server._h_dense_pull, (name,))
+        return self._sync(self.server_names[server % self.n],
+                          _server._h_dense_pull, (name,))
 
     def push_dense(self, name: str, grad, learning_rate=None,
                    server: int = 0) -> None:
-        _rpc.rpc_sync(self.server_names[server % self.n],
-                      _server._h_dense_push,
-                      (name, np.asarray(grad, np.float32), learning_rate))
+        self._sync(self.server_names[server % self.n],
+                   _server._h_dense_push,
+                   (name, np.asarray(grad, np.float32), learning_rate,
+                    uuid.uuid4().hex))
 
     def set_dense(self, name: str, value, server: int = 0) -> None:
-        _rpc.rpc_sync(self.server_names[server % self.n],
-                      _server._h_dense_set,
-                      (name, np.asarray(value, np.float32)))
+        self._sync(self.server_names[server % self.n],
+                   _server._h_dense_set,
+                   (name, np.asarray(value, np.float32)))

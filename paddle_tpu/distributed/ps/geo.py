@@ -1,0 +1,97 @@
+"""Geo-async SGD for the parameter server.
+
+Capability parity: the reference's geo mode
+(paddle/fluid/distributed/ps/table/memory_sparse_geo_table.cc +
+python/paddle/distributed/transpiler/geo_sgd_transpiler.py): each
+trainer applies optimizer updates to a LOCAL copy of the touched rows
+and only ships the accumulated DELTA to the server every
+``push_interval`` steps; the server folds deltas additively, so the
+global row is init + sum of all trainers' deltas and each trainer's
+staleness is bounded by the interval.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class GeoSparseWorker:
+    """Trainer-side geo cache over one server sparse table.
+
+    The server table must use the ``sum`` rule (deltas fold additively).
+    ``pull`` serves rows from the local cache (fetching misses from the
+    server); ``push`` applies SGD locally AND accumulates the delta;
+    every ``push_interval`` pushes, ``sync`` ships the deltas and
+    refreshes every cached row — the staleness bound.
+    """
+
+    def __init__(self, client, name: str, dim: int,
+                 push_interval: int = 4, learning_rate: float = 0.05,
+                 **table_kwargs):
+        table_kwargs.setdefault("optimizer", "sum")
+        if table_kwargs["optimizer"] != "sum":
+            raise ValueError(
+                "geo mode needs the server table on the 'sum' rule; the "
+                "optimizer runs trainer-side")
+        self.client = client
+        self.name = name
+        self.dim = dim
+        self.push_interval = max(int(push_interval), 1)
+        self.learning_rate = float(learning_rate)
+        client.create_table(name, dim, **table_kwargs)
+        self._cache: Dict[int, np.ndarray] = {}
+        self._delta: Dict[int, np.ndarray] = {}
+        self._pushes_since_sync = 0
+
+    # ------------------------------------------------------------------
+    def pull(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).ravel()
+        missing = [int(k) for k in ids if int(k) not in self._cache]
+        if missing:
+            rows = self.client.pull_sparse(self.name, np.asarray(missing))
+            for k, row in zip(missing, np.asarray(rows, np.float32)):
+                self._cache[k] = row.copy()
+        return np.stack([self._cache[int(k)] for k in ids])
+
+    def push(self, ids, grads,
+             learning_rate: Optional[float] = None) -> None:
+        """Local SGD + delta accumulation; ships every Nth push."""
+        lr = self.learning_rate if learning_rate is None else learning_rate
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        self.pull(ids)                      # ensure rows are cached
+        for k, g in zip(ids, grads):
+            k = int(k)
+            upd = -lr * g
+            self._cache[k] += upd
+            d = self._delta.get(k)
+            if d is None:
+                self._delta[k] = upd.copy()
+            else:
+                d += upd
+        self._pushes_since_sync += 1
+        if self._pushes_since_sync >= self.push_interval:
+            self.sync()
+
+    def sync(self) -> None:
+        """Ship accumulated deltas, then refresh EVERY cached row from
+        the server so other trainers' folded deltas become visible."""
+        if self._delta:
+            ids = np.fromiter(self._delta.keys(), np.int64,
+                              len(self._delta))
+            deltas = np.stack([self._delta[int(k)] for k in ids])
+            self.client.push_sparse(self.name, ids, deltas)
+            self._delta.clear()
+        if self._cache:
+            ids = np.fromiter(self._cache.keys(), np.int64,
+                              len(self._cache))
+            fresh = self.client.pull_sparse(self.name, ids)
+            for k, row in zip(ids, np.asarray(fresh, np.float32)):
+                self._cache[int(k)] = row.copy()
+        self._pushes_since_sync = 0
+
+    @property
+    def staleness(self) -> int:
+        """Local pushes not yet visible to the server (< push_interval)."""
+        return self._pushes_since_sync

@@ -4,6 +4,7 @@ the_one_ps.py server half)."""
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Dict, Optional
 
 import numpy as np
@@ -23,8 +24,26 @@ class PSServer:
         self._dense: Dict[str, MemoryDenseTable] = {}
         self._create_lock = threading.Lock()
         self._stop = threading.Event()
+        # push idempotency: a client retry whose original DID apply (the
+        # reply was lost, not the request) must not double-apply the
+        # gradient.  Bounded FIFO of seen tokens.
+        self._seen_tokens: "OrderedDict[str, bool]" = OrderedDict()
+        self._token_lock = threading.Lock()
 
-    def create_table(self, name: str, dim: int, **kwargs) -> None:
+    def seen_token(self, token) -> bool:
+        """True if this push token was already applied (marks it seen)."""
+        if token is None:
+            return False
+        with self._token_lock:
+            if token in self._seen_tokens:
+                return True
+            self._seen_tokens[token] = True
+            while len(self._seen_tokens) > 65536:
+                self._seen_tokens.popitem(last=False)
+            return False
+
+    def create_table(self, name: str, dim: int,
+                     table_type: str = "memory", **kwargs) -> None:
         with self._create_lock:
             existing = self._tables.get(name)
             if existing is not None:
@@ -33,7 +52,16 @@ class PSServer:
                         f"table '{name}' exists with dim {existing.dim}, "
                         f"requested {dim}")
                 return
-            self._tables[name] = MemorySparseTable(
+            if table_type == "ssd":
+                from .ssd_table import SSDSparseTable
+                cls = SSDSparseTable
+            elif table_type == "memory":
+                cls = MemorySparseTable
+            else:
+                raise ValueError(
+                    f"table_type must be 'memory' or 'ssd', "
+                    f"got {table_type!r}")
+            self._tables[name] = cls(
                 dim, seed=self.server_index * 7919 + 1, **kwargs)
 
     def create_dense_table(self, name: str, shape, **kwargs) -> None:
@@ -80,7 +108,9 @@ def _h_pull(name, ids):
     return _SERVER.table(name).pull(np.asarray(ids))
 
 
-def _h_push(name, ids, grads, lr):
+def _h_push(name, ids, grads, lr, token=None):
+    if _SERVER.seen_token(token):
+        return True                       # duplicate retry: already applied
     _SERVER.table(name).push(np.asarray(ids), np.asarray(grads), lr)
     return True
 
@@ -113,7 +143,9 @@ def _h_dense_pull(name):
     return _SERVER.dense_table(name).pull()
 
 
-def _h_dense_push(name, grad, lr):
+def _h_dense_push(name, grad, lr, token=None):
+    if _SERVER.seen_token(token):
+        return True                       # duplicate retry: already applied
     _SERVER.dense_table(name).push(np.asarray(grad), lr)
     return True
 

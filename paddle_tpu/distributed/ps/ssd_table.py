@@ -1,0 +1,147 @@
+"""Disk-spilling sparse table: hot rows in memory, cold rows in SQLite.
+
+Capability parity: the reference's SSD-backed sparse table
+(paddle/fluid/distributed/ps/table/ssd_sparse_table.cc — RocksDB-backed
+rows behind an in-memory cache, so embedding tables larger than host RAM
+still train).  SQLite plays RocksDB's role here: a single-file,
+zero-daemon local KV store from the stdlib.
+
+Access pattern preserved from MemorySparseTable: the hot set is an LRU
+(most recently pulled/pushed rows stay resident); rows evicted past
+``cache_rows`` move to disk with their optimizer state and page back in
+transparently on next touch.  save()/load() use the same pickle payload
+as the memory table, so a checkpoint written by one table kind restores
+into the other.
+"""
+from __future__ import annotations
+
+import os
+import sqlite3
+import tempfile
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from .table import MemorySparseTable
+
+
+class SSDSparseTable(MemorySparseTable):
+    """LRU memory cache over a SQLite row store.
+
+    ``cache_rows``: max resident rows; ``path``: the database file
+    (a temp file per table by default).
+    """
+
+    def __init__(self, dim: int, cache_rows: int = 4096,
+                 path: Optional[str] = None, **kwargs):
+        super().__init__(dim, **kwargs)
+        self._rows: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self.cache_rows = max(int(cache_rows), 1)
+        if path is None:
+            fd, path = tempfile.mkstemp(suffix=".ps_ssd.db")
+            os.close(fd)
+            self._owns_file = True
+        else:
+            self._owns_file = False
+        self.path = path
+        # all access happens under MemorySparseTable._lock
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS rows ("
+            "key INTEGER PRIMARY KEY, row BLOB, accum BLOB)")
+        self._db.commit()
+
+    # -- storage hooks ----------------------------------------------------
+    def _get(self, k):
+        row = self._rows.get(k)
+        if row is not None:
+            self._rows.move_to_end(k)
+            return row
+        hit = self._db.execute(
+            "SELECT row, accum FROM rows WHERE key = ?", (k,)).fetchone()
+        if hit is None:
+            return None
+        row = np.frombuffer(hit[0], np.float32).copy()
+        if hit[1] is not None:
+            self._accum[k] = np.frombuffer(hit[1], np.float32).copy()
+        # hot and cold sets stay disjoint: promotion removes the disk copy
+        self._db.execute("DELETE FROM rows WHERE key = ?", (k,))
+        self._put(k, row)
+        return row
+
+    def _put(self, k, row):
+        self._rows[k] = row
+        self._rows.move_to_end(k)
+        while len(self._rows) > self.cache_rows:
+            cold_k, cold_row = self._rows.popitem(last=False)
+            acc = self._accum.pop(cold_k, None)
+            self._db.execute(
+                "INSERT OR REPLACE INTO rows VALUES (?, ?, ?)",
+                (cold_k, cold_row.tobytes(),
+                 None if acc is None else acc.tobytes()))
+        # no commit here: one transaction per pull/push batch, not per
+        # evicted row (a spill-heavy batch would pay one fsync per row)
+
+    def pull(self, ids):
+        out = super().pull(ids)
+        with self._lock:
+            self._db.commit()
+        return out
+
+    def push(self, ids, grads, learning_rate=None):
+        super().push(ids, grads, learning_rate)
+        with self._lock:
+            self._db.commit()
+
+    def _all_rows(self):
+        rows = {}
+        accum = {}
+        for k, blob, acc in self._db.execute(
+                "SELECT key, row, accum FROM rows"):
+            rows[k] = np.frombuffer(blob, np.float32).copy()
+            if acc is not None:
+                accum[k] = np.frombuffer(acc, np.float32).copy()
+        rows.update(self._rows)          # hot rows are the fresh copies
+        accum.update(self._accum)
+        return rows, accum
+
+    def _import_rows(self, rows, accum):
+        self._rows = OrderedDict()
+        self._accum = {}
+        self._db.execute("DELETE FROM rows")
+        for k, row in rows.items():
+            acc = accum.get(k)
+            self._db.execute(
+                "INSERT OR REPLACE INTO rows VALUES (?, ?, ?)",
+                (int(k), np.asarray(row, np.float32).tobytes(),
+                 None if acc is None
+                 else np.asarray(acc, np.float32).tobytes()))
+        self._db.commit()
+
+    # ---------------------------------------------------------------------
+    def size(self) -> int:
+        with self._lock:
+            (cold,) = self._db.execute(
+                "SELECT COUNT(*) FROM rows").fetchone()
+            return len(self._rows) + cold
+
+    @property
+    def resident_rows(self) -> int:
+        """Rows currently held in memory (<= cache_rows)."""
+        return len(self._rows)
+
+    @property
+    def spilled_rows(self) -> int:
+        """Rows currently on disk."""
+        (cold,) = self._db.execute("SELECT COUNT(*) FROM rows").fetchone()
+        return cold
+
+    def close(self) -> None:
+        self._db.commit()
+        self._db.close()
+        if self._owns_file:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass

@@ -38,18 +38,36 @@ class MemorySparseTable:
         return self._rng.uniform(-self.init_scale, self.init_scale,
                                  self.dim).astype(np.float32)
 
+    # -- row storage hooks (overridden by the disk-spill table) ------------
+    def _get(self, k: int) -> Optional[np.ndarray]:
+        return self._rows.get(k)
+
+    def _put(self, k: int, row: np.ndarray) -> None:
+        self._rows[k] = row
+
+    def _all_rows(self):
+        """(rows, accum) dicts covering EVERY row this table holds."""
+        return dict(self._rows), dict(self._accum)
+
+    def _import_rows(self, rows, accum) -> None:
+        self._rows = dict(rows)
+        self._accum = dict(accum)
+
+    # ----------------------------------------------------------------------
     def pull(self, ids: np.ndarray) -> np.ndarray:
         out = np.empty((len(ids), self.dim), np.float32)
         with self._lock:
             for i, key in enumerate(np.asarray(ids, np.int64)):
-                row = self._rows.get(int(key))
+                k = int(key)
+                row = self._get(k)
                 if row is None:
                     if self.entry is not None:
                         # un-admitted id: serve zeros, do NOT materialize
                         # (reference: ctr accessor entry gate)
                         out[i] = 0.0
                         continue
-                    row = self._rows[int(key)] = self._init_row()
+                    row = self._init_row()
+                    self._put(k, row)
                 out[i] = row
         return out
 
@@ -60,11 +78,12 @@ class MemorySparseTable:
         with self._lock:
             for i, key in enumerate(np.asarray(ids, np.int64)):
                 k = int(key)
-                row = self._rows.get(k)
+                row = self._get(k)
                 if row is None:
                     if self.entry is not None and not self.entry.admit(k):
                         continue      # below admission threshold: drop
-                    row = self._rows[k] = self._init_row()
+                    row = self._init_row()
+                    self._put(k, row)
                 g = grads[i]
                 if self.optimizer == "sum":
                     row += g
@@ -84,8 +103,8 @@ class MemorySparseTable:
     # -- persistence (reference: table save/load) --------------------------
     def save(self, path: str) -> None:
         with self._lock:
-            payload = {"dim": self.dim, "rows": dict(self._rows),
-                       "accum": dict(self._accum)}
+            rows, accum = self._all_rows()
+            payload = {"dim": self.dim, "rows": rows, "accum": accum}
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "wb") as f:
             pickle.dump(payload, f, protocol=4)
@@ -94,5 +113,4 @@ class MemorySparseTable:
         with open(path, "rb") as f:
             payload = pickle.load(f)
         with self._lock:
-            self._rows = payload["rows"]
-            self._accum = payload.get("accum", {})
+            self._import_rows(payload["rows"], payload.get("accum", {}))

@@ -26,7 +26,16 @@ from typing import Any, Dict, List, Optional
 from .store import TCPStore, barrier as _store_barrier
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
-           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo",
+           "TransportError"]
+
+
+class TransportError(ConnectionError):
+    """The CALL failed in transit (dial/send/recv) — distinguishable from
+    an exception the remote function itself raised, which is re-raised
+    verbatim.  Retry logic must only ever retry on this: a remote
+    FileNotFoundError is also an OSError, but retrying it is useless
+    (and double-applies non-idempotent work)."""
 
 
 @dataclass(frozen=True)
@@ -105,7 +114,7 @@ class _RpcServer:
 
 class _RpcAgent:
     def __init__(self, name: str, rank: int, world_size: int,
-                 store: TCPStore):
+                 store: TCPStore, rejoin: bool = False):
         self.name = name
         self.rank = rank
         self.world_size = world_size
@@ -115,8 +124,11 @@ class _RpcAgent:
         self.info = WorkerInfo(name, rank, ip, self.server.port)
         store.set(f"rpc/worker/{rank}",
                   pickle.dumps(self.info, protocol=4))
-        # everyone present before any call resolves names
-        _store_barrier(store, "rpc_init", world_size)
+        if not rejoin:
+            # everyone present before any call resolves names; a REJOINING
+            # worker (supervisor restart after a crash) skips the barrier —
+            # the cluster it re-enters already counted its rank once
+            _store_barrier(store, "rpc_init", world_size)
         self._workers: Dict[str, WorkerInfo] = {}
         for r in range(world_size):
             info = pickle.loads(store.get(f"rpc/worker/{r}"))
@@ -140,8 +152,12 @@ class _RpcAgent:
                 conn = self._conns.get(to)
             if conn is None:
                 info = self._workers[to]
-                conn = socket.create_connection((info.ip, info.port),
-                                                timeout=60)
+                try:
+                    conn = socket.create_connection((info.ip, info.port),
+                                                    timeout=60)
+                except OSError as e:
+                    raise TransportError(
+                        f"dial {to} ({info.ip}:{info.port}): {e}") from e
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 with self._conn_lock:
                     self._conns[to] = conn
@@ -150,7 +166,7 @@ class _RpcAgent:
                 conn.sendall(struct.pack("<Q", len(blob)) + blob)
                 (ln,) = struct.unpack("<Q", _RpcServer._read(conn, 8))
                 status, payload = pickle.loads(_RpcServer._read(conn, ln))
-            except Exception:
+            except Exception as e:
                 # the stream may hold a half frame / orphaned reply — drop
                 # the connection so the next call re-dials cleanly
                 with self._conn_lock:
@@ -159,7 +175,7 @@ class _RpcAgent:
                     conn.close()
                 except OSError:
                     pass
-                raise
+                raise TransportError(f"rpc to {to} failed: {e}") from e
         if status == "exc":
             raise payload
         return payload
@@ -197,9 +213,15 @@ _agent: Optional[_RpcAgent] = None
 
 def init_rpc(name: str, rank: Optional[int] = None,
              world_size: Optional[int] = None,
-             master_endpoint: Optional[str] = None) -> None:
+             master_endpoint: Optional[str] = None,
+             rejoin: bool = False) -> None:
     """reference: paddle.distributed.rpc.init_rpc — rank 0 hosts the store
-    at ``master_endpoint`` (env PADDLE_MASTER_ENDPOINT fallback)."""
+    at ``master_endpoint`` (env PADDLE_MASTER_ENDPOINT fallback).
+
+    ``rejoin=True`` re-registers a RESTARTED worker into a live cluster
+    (HA supervisor relaunch, reference elastic manager semantics): the
+    worker overwrites its rank's endpoint in the store and skips the
+    init barrier; peers pick up the new endpoint via refresh_worker."""
     global _agent
     if _agent is not None:
         raise RuntimeError("RPC already initialized")
@@ -207,12 +229,17 @@ def init_rpc(name: str, rank: Optional[int] = None,
         if rank is None else rank
     world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) \
         if world_size is None else world_size
+    if rejoin and rank == 0:
+        raise ValueError(
+            "rank 0 cannot rejoin: it hosts the TCPStore, which died "
+            "with the old process — restart the whole cluster instead")
     endpoint = master_endpoint or os.environ.get(
         "PADDLE_MASTER_ENDPOINT", "127.0.0.1:8813")
     host, port = endpoint.rsplit(":", 1)
-    store = TCPStore(host, int(port), is_master=(rank == 0),
+    store = TCPStore(host, int(port),
+                     is_master=(rank == 0 and not rejoin),
                      world_size=world_size)
-    _agent = _RpcAgent(name, rank, world_size, store)
+    _agent = _RpcAgent(name, rank, world_size, store, rejoin=rejoin)
 
 
 def _require_agent() -> _RpcAgent:
@@ -236,6 +263,26 @@ def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = 180.0):
 
 def get_worker_info(name: str) -> WorkerInfo:
     return _require_agent()._workers[name]
+
+
+def refresh_worker(name: str) -> WorkerInfo:
+    """Re-resolve a peer's endpoint from the store and drop any cached
+    connection — the client half of crash-restart failover (the restarted
+    peer re-registered its rank with a new port via rejoin)."""
+    ag = _require_agent()
+    old = ag._workers.get(name)
+    if old is None:
+        raise ValueError(f"unknown RPC worker '{name}'")
+    info = pickle.loads(ag.store.get(f"rpc/worker/{old.rank}"))
+    ag._workers[name] = info
+    with ag._conn_lock:
+        conn = ag._conns.pop(name, None)
+    if conn is not None:
+        try:
+            conn.close()
+        except OSError:
+            pass
+    return info
 
 
 def get_all_worker_infos() -> List[WorkerInfo]:

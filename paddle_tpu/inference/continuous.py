@@ -260,6 +260,8 @@ class ContinuousBatchingEngine:
         reservations rolled back, so the engine stays usable."""
         with self._cond:
             for r in self._active + admitted + self._queue:
+                if r.done.is_set():
+                    continue     # already retired successfully this step
                 r.error = exc
                 r.done.set()
             for r in self._active + admitted:

@@ -87,6 +87,13 @@ def apply_rope(q, k, cos, sin, position_offset=0):
         # decode batch sits at its own length) — gather each row's angle
         # window instead of one shared dynamic slice
         pos = jnp.asarray(position_offset, jnp.int32)      # (b,)
+        if not isinstance(pos, jax.core.Tracer):
+            hi = int(jnp.max(pos)) + s
+            if hi > cos.shape[0]:
+                raise ValueError(
+                    f"rope position {hi} exceeds the table ({cos.shape[0]} "
+                    "= max_position_embeddings); the gather would "
+                    "silently clamp and reuse the last angles")
         idx = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
         c = cos[idx][:, :, None, :]                        # (b, s, 1, half)
         si = sin[idx][:, :, None, :]

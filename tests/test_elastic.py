@@ -256,10 +256,14 @@ class TestCoordinatedElasticRestart:
             return [sys.executable, trainer, str(tmp_path), str(total)]
 
         controllers = [
+            # ttl generous vs. the 0.05s poll: on a loaded CI host the
+            # heartbeat thread can be starved for seconds, and a slipped
+            # heartbeat shows up as a spurious membership restart; 5s ttl
+            # made this test flake under load
             ElasticController(store, node_id=f"node-{i}", nnodes=2,
-                              cmd_factory=factory, max_restarts=3,
-                              poll_interval=0.05, rendezvous_timeout=30,
-                              ttl=5.0)
+                              cmd_factory=factory, max_restarts=6,
+                              poll_interval=0.05, rendezvous_timeout=60,
+                              ttl=20.0)
             for i in range(2)
         ]
         codes = {}

@@ -186,3 +186,257 @@ class TestSparseTableLocal:
         assert t2.size() == 10
         np.testing.assert_allclose(t2.pull(np.array([4])),
                                    t.pull(np.array([4])))
+
+
+# ------------------------------------------------------------- ssd table
+class TestSSDSparseTable:
+    """Disk-spill table (VERDICT r4 item 5; reference
+    ssd_sparse_table.cc): LRU hot set + SQLite cold store."""
+
+    def test_spills_past_cache_and_pages_back(self):
+        from paddle_tpu.distributed.ps import SSDSparseTable
+        t = SSDSparseTable(4, cache_rows=8, optimizer="sgd",
+                           learning_rate=0.1, seed=3)
+        ids = np.arange(100)
+        first = t.pull(ids).copy()
+        assert t.resident_rows <= 8
+        assert t.spilled_rows >= 92
+        assert t.size() == 100
+        # paging back returns the same rows (cold hits)
+        again = t.pull(ids)
+        np.testing.assert_allclose(again, first, atol=0)
+        t.close()
+
+    def test_numerics_match_memory_table(self):
+        """Same seed + same traffic => identical rows, SGD and adagrad,
+        even when every row cycles through disk (cache_rows=2)."""
+        from paddle_tpu.distributed.ps import (MemorySparseTable,
+                                               SSDSparseTable)
+        for optim in ("sgd", "adagrad"):
+            mem = MemorySparseTable(4, optimizer=optim, learning_rate=0.2,
+                                    seed=11)
+            ssd = SSDSparseTable(4, cache_rows=2, optimizer=optim,
+                                 learning_rate=0.2, seed=11)
+            rng = np.random.default_rng(0)
+            for step in range(5):
+                ids = rng.integers(0, 20, 6)
+                g = rng.standard_normal((6, 4)).astype("float32")
+                # identical first-touch order => identical rng draws
+                mem.pull(ids)
+                ssd.pull(ids)
+                mem.push(ids, g)
+                ssd.push(ids, g)
+            all_ids = np.arange(20)
+            np.testing.assert_allclose(ssd.pull(all_ids),
+                                       mem.pull(all_ids), atol=1e-6)
+            ssd.close()
+
+    def test_checkpoint_interoperates_with_memory_table(self, tmp_path):
+        from paddle_tpu.distributed.ps import (MemorySparseTable,
+                                               SSDSparseTable)
+        ssd = SSDSparseTable(3, cache_rows=4, seed=5)
+        ssd.pull(np.arange(50))
+        ssd.save(str(tmp_path / "t.pkl"))
+        # restore into a plain memory table — same payload format
+        mem = MemorySparseTable(3)
+        mem.load(str(tmp_path / "t.pkl"))
+        assert mem.size() == 50
+        np.testing.assert_allclose(mem.pull(np.array([17])),
+                                   ssd.pull(np.array([17])), atol=0)
+        # and back into a fresh ssd table
+        ssd2 = SSDSparseTable(3, cache_rows=4)
+        ssd2.load(str(tmp_path / "t.pkl"))
+        assert ssd2.size() == 50
+        assert ssd2.resident_rows == 0          # loads land cold
+        np.testing.assert_allclose(ssd2.pull(np.array([17])),
+                                   ssd.pull(np.array([17])), atol=0)
+        ssd.close()
+        ssd2.close()
+
+
+# ------------------------------------------------------------- geo mode
+class _LocalPSClient:
+    """In-process PSClient stand-in over real tables (no RPC) for geo
+    semantics tests."""
+
+    def __init__(self):
+        from paddle_tpu.distributed.ps import MemorySparseTable
+        self._cls = MemorySparseTable
+        self.tables = {}
+
+    def create_table(self, name, dim, **kw):
+        if name not in self.tables:
+            self.tables[name] = self._cls(dim, seed=1, **kw)
+
+    def pull_sparse(self, name, ids):
+        return self.tables[name].pull(np.asarray(ids))
+
+    def push_sparse(self, name, ids, grads, learning_rate=None):
+        self.tables[name].push(np.asarray(ids), np.asarray(grads),
+                               learning_rate)
+
+
+class TestGeoSparseWorker:
+    """Geo-async SGD (VERDICT r4 item 5; reference
+    memory_sparse_geo_table.cc + geo_sgd_transpiler.py)."""
+
+    def test_single_worker_matches_plain_sgd_after_sync(self):
+        from paddle_tpu.distributed.ps import GeoSparseWorker
+        client = _LocalPSClient()
+        geo = GeoSparseWorker(client, "t", 4, push_interval=3,
+                              learning_rate=0.1)
+        rng = np.random.default_rng(0)
+        ids = np.array([1, 2, 3], np.int64)
+        init = geo.pull(ids).copy()
+        total = np.zeros((3, 4), np.float32)
+        for _ in range(6):                     # 2 full intervals
+            g = rng.standard_normal((3, 4)).astype("float32")
+            geo.push(ids, g)
+            total += g
+        assert geo.staleness == 0              # interval divides evenly
+        server_rows = client.pull_sparse("t", ids)
+        np.testing.assert_allclose(server_rows, init - 0.1 * total,
+                                   atol=1e-5)
+        np.testing.assert_allclose(geo.pull(ids), server_rows, atol=1e-6)
+
+    def test_staleness_bounded_by_interval(self):
+        from paddle_tpu.distributed.ps import GeoSparseWorker
+        client = _LocalPSClient()
+        geo = GeoSparseWorker(client, "t", 2, push_interval=4,
+                              learning_rate=1.0)
+        ids = np.array([7], np.int64)
+        before = client.pull_sparse("t", ids).copy()
+        for i in range(3):                     # under the interval
+            geo.push(ids, np.ones((1, 2), np.float32))
+            assert geo.staleness == i + 1
+        # server has NOT moved yet (async window)
+        np.testing.assert_allclose(client.pull_sparse("t", ids), before,
+                                   atol=0)
+        geo.push(ids, np.ones((1, 2), np.float32))   # 4th -> auto sync
+        assert geo.staleness == 0
+        np.testing.assert_allclose(client.pull_sparse("t", ids),
+                                   before - 4.0, atol=1e-6)
+
+    def test_two_workers_fold_deltas_additively(self):
+        from paddle_tpu.distributed.ps import GeoSparseWorker
+        client = _LocalPSClient()
+        a = GeoSparseWorker(client, "t", 2, push_interval=2,
+                            learning_rate=0.5)
+        b = GeoSparseWorker(client, "t", 2, push_interval=2,
+                            learning_rate=0.5)
+        ids = np.array([3], np.int64)
+        init = a.pull(ids).copy()
+        b.pull(ids)
+        for _ in range(2):                     # one interval each
+            a.push(ids, np.full((1, 2), 1.0, np.float32))
+            b.push(ids, np.full((1, 2), 2.0, np.float32))
+        # server row = init - 0.5*(2*1) - 0.5*(2*2) = init - 3
+        np.testing.assert_allclose(client.pull_sparse("t", ids),
+                                   init - 3.0, atol=1e-5)
+        # both workers converge to the folded row after their sync
+        a.sync()
+        b.sync()
+        np.testing.assert_allclose(a.pull(ids), b.pull(ids), atol=1e-6)
+
+    def test_rejects_non_sum_server_rule(self):
+        from paddle_tpu.distributed.ps import GeoSparseWorker
+        with pytest.raises(ValueError, match="sum"):
+            GeoSparseWorker(_LocalPSClient(), "t", 2, optimizer="sgd")
+
+
+# ----------------------------------------------------------- HA failover
+def _ha_server_proc(rank, world, port, q, rejoin):
+    try:
+        from paddle_tpu.framework.backend_guard import helper_process_init
+        helper_process_init()
+        from paddle_tpu.distributed import rpc
+        from paddle_tpu.distributed.ps import run_server
+        run_server(server_index=rank)
+        rpc.init_rpc(f"server{rank}", rank, world,
+                     master_endpoint=f"127.0.0.1:{port}", rejoin=rejoin)
+        from paddle_tpu.distributed.ps import server as srv
+        srv._SERVER.wait()
+        rpc.shutdown()
+        q.put((f"server_rejoin{rejoin}", "ok"))
+    except Exception as e:   # noqa: BLE001
+        import traceback
+        q.put((f"server_rejoin{rejoin}",
+               f"FAIL: {e}\n{traceback.format_exc()}"))
+
+
+def _ha_trainer_proc(world, port, q, ckpt_dir, saved_evt, restarted_evt):
+    try:
+        from paddle_tpu.framework.backend_guard import helper_process_init
+        helper_process_init()
+        from paddle_tpu.distributed import rpc
+        from paddle_tpu.distributed.ps import PSClient
+
+        rpc.init_rpc("trainer0", 0, world,
+                     master_endpoint=f"127.0.0.1:{port}")
+        client = PSClient(["server1"], retry_deadline=90.0)
+        client.create_table("emb", 4, optimizer="sgd", learning_rate=0.5,
+                            initializer="zeros")
+        ids = np.arange(6)
+        g = np.ones((6, 4), np.float32)
+        client.push_sparse("emb", ids, g)        # rows -> -0.5
+        before = client.pull_sparse("emb", ids).copy()
+        client.save("emb", os.path.join(ckpt_dir, "emb"))
+        saved_evt.set()                          # parent kills the server
+
+        restarted_evt.wait(timeout=120)
+        # retry plumbing re-resolves the relaunched server, which is
+        # EMPTY: recreate the table and restore the snapshot
+        client.create_table("emb", 4, optimizer="sgd", learning_rate=0.5,
+                            initializer="zeros")
+        client.load("emb", os.path.join(ckpt_dir, "emb"))
+        after = client.pull_sparse("emb", ids)
+        np.testing.assert_allclose(after, before, atol=1e-6)
+        # training continues against the restarted server
+        client.push_sparse("emb", ids, g)
+        final = client.pull_sparse("emb", ids)
+        np.testing.assert_allclose(final, before - 0.5, atol=1e-6)
+        client.stop_servers()
+        rpc.shutdown()
+        q.put(("trainer", "ok"))
+    except Exception as e:   # noqa: BLE001
+        import traceback
+        q.put(("trainer", f"FAIL: {e}\n{traceback.format_exc()}"))
+
+
+class TestPSFailover:
+    """Kill-the-server / resume-from-snapshot (VERDICT r4 item 5): the
+    trainer survives a SIGKILLed server via endpoint re-resolution +
+    snapshot restore — the reference's HA claim for brpc PS."""
+
+    def test_server_crash_snapshot_resume(self, tmp_path):
+        port = _free_port()
+        world = 2   # trainer0 (hosts store), server1
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        saved_evt = ctx.Event()
+        restarted_evt = ctx.Event()
+        server = ctx.Process(target=_ha_server_proc,
+                             args=(1, world, port, q, False))
+        trainer = ctx.Process(
+            target=_ha_trainer_proc,
+            args=(world, port, q, str(tmp_path), saved_evt,
+                  restarted_evt))
+        server.start()
+        trainer.start()
+
+        assert saved_evt.wait(timeout=120), "trainer never snapshotted"
+        server.kill()                          # SIGKILL, no cleanup
+        server.join(timeout=30)
+        replacement = ctx.Process(target=_ha_server_proc,
+                                  args=(1, world, port, q, True))
+        replacement.start()
+        restarted_evt.set()
+
+        results = {}
+        for _ in range(2):                     # trainer + replacement
+            who, status = q.get(timeout=240)
+            results[who] = status
+        trainer.join(timeout=30)
+        replacement.join(timeout=30)
+        assert results.get("trainer") == "ok", results
+        assert results.get("server_rejoinTrue") == "ok", results
