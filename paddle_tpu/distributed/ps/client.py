@@ -33,16 +33,19 @@ class PSClient:
             max(self.n * 2, 4))
 
     # -- failure-aware RPC plumbing ---------------------------------------
-    def _sync(self, server: str, fn, args):
+    def _sync(self, server: str, fn, args, retryable: bool = True):
         # retry ONLY transport failures — a remote-raised exception (even
         # an OSError subclass like FileNotFoundError from a bad load
-        # path) is a real answer, not a flap
+        # path) is a real answer, not a flap.  ``retryable=False`` for
+        # ops that are NOT idempotent across a server restart (save:
+        # retrying a lost-reply save against a relaunched empty server
+        # would clobber the just-written shard with an empty table).
         deadline = time.monotonic() + self.retry_deadline
         while True:
             try:
                 return _rpc.rpc_sync(server, fn, args)
             except _rpc.TransportError:
-                if time.monotonic() >= deadline:
+                if not retryable or time.monotonic() >= deadline:
                     raise
                 time.sleep(0.25)
                 try:
@@ -50,8 +53,18 @@ class PSClient:
                 except Exception:   # noqa: BLE001 — store itself flaky
                     pass
 
-    def _submit(self, server: str, fn, args):
-        return self._pool.submit(self._sync, server, fn, args)
+    def _submit(self, server: str, fn, args, retryable: bool = True):
+        return self._pool.submit(self._sync, server, fn, args, retryable)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     # -- table mgmt --------------------------------------------------------
     def create_table(self, name: str, dim: int, **kwargs) -> None:
@@ -66,8 +79,11 @@ class PSClient:
                    for s in self.server_names)
 
     def save(self, name: str, path_prefix: str) -> None:
+        # not retryable: after a lost reply the server may have restarted
+        # empty, and a retried save would overwrite the good shard
         futs = [self._submit(s, _server._h_save,
-                             (name, f"{path_prefix}.shard{i}"))
+                             (name, f"{path_prefix}.shard{i}"),
+                             retryable=False)
                 for i, s in enumerate(self.server_names)]
         for f in futs:
             f.result()

@@ -31,16 +31,23 @@ class PSServer:
         self._token_lock = threading.Lock()
 
     def seen_token(self, token) -> bool:
-        """True if this push token was already applied (marks it seen)."""
+        """True if this push token was already APPLIED (read-only)."""
         if token is None:
             return False
         with self._token_lock:
-            if token in self._seen_tokens:
-                return True
+            return token in self._seen_tokens
+
+    def mark_token(self, token) -> None:
+        """Record a token AFTER its push applied successfully — marking
+        before the apply would falsely ack a retried push whose original
+        raised mid-apply (client retries are sequential, so
+        mark-after-success cannot double-apply)."""
+        if token is None:
+            return
+        with self._token_lock:
             self._seen_tokens[token] = True
             while len(self._seen_tokens) > 65536:
                 self._seen_tokens.popitem(last=False)
-            return False
 
     def create_table(self, name: str, dim: int,
                      table_type: str = "memory", **kwargs) -> None:
@@ -112,6 +119,7 @@ def _h_push(name, ids, grads, lr, token=None):
     if _SERVER.seen_token(token):
         return True                       # duplicate retry: already applied
     _SERVER.table(name).push(np.asarray(ids), np.asarray(grads), lr)
+    _SERVER.mark_token(token)
     return True
 
 
@@ -147,6 +155,7 @@ def _h_dense_push(name, grad, lr, token=None):
     if _SERVER.seen_token(token):
         return True                       # duplicate retry: already applied
     _SERVER.dense_table(name).push(np.asarray(grad), lr)
+    _SERVER.mark_token(token)
     return True
 
 

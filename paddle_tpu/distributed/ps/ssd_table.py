@@ -134,8 +134,10 @@ class SSDSparseTable(MemorySparseTable):
     @property
     def spilled_rows(self) -> int:
         """Rows currently on disk."""
-        (cold,) = self._db.execute("SELECT COUNT(*) FROM rows").fetchone()
-        return cold
+        with self._lock:
+            (cold,) = self._db.execute(
+                "SELECT COUNT(*) FROM rows").fetchone()
+            return cold
 
     def close(self) -> None:
         self._db.commit()

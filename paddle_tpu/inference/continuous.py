@@ -234,8 +234,10 @@ class ContinuousBatchingEngine:
         try:
             with no_grad():
                 ctx = _PagedContext(self.cache, seq_ids, prefill=False)
+                # pos stays a numpy array so the rope bound check runs
+                # host-side (no device round-trip per layer)
                 hidden = self.model.model(wrap_array(jnp.asarray(tokens)),
-                                          jnp.asarray(pos), paged_ctx=ctx)
+                                          pos, paged_ctx=ctx)
                 logits = self.model._logits_of(hidden)
             logits_np = np.asarray(logits._data[:, -1], np.float32)
         finally:

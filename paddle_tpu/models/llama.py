@@ -86,14 +86,18 @@ def apply_rope(q, k, cos, sin, position_offset=0):
         # per-row positions (continuous batching: each sequence in the
         # decode batch sits at its own length) — gather each row's angle
         # window instead of one shared dynamic slice
-        pos = jnp.asarray(position_offset, jnp.int32)      # (b,)
-        if not isinstance(pos, jax.core.Tracer):
-            hi = int(jnp.max(pos)) + s
+        if isinstance(position_offset, np.ndarray):
+            # host-side bound check — free; device-resident/traced pos
+            # is NOT pulled back (that would force a sync per layer per
+            # decode step); callers feeding device arrays must bound
+            # positions themselves (the batching engine does at submit)
+            hi = int(position_offset.max()) + s
             if hi > cos.shape[0]:
                 raise ValueError(
-                    f"rope position {hi} exceeds the table ({cos.shape[0]} "
-                    "= max_position_embeddings); the gather would "
+                    f"rope position {hi} exceeds the table ({cos.shape[0]}"
+                    " = max_position_embeddings); the gather would "
                     "silently clamp and reuse the last angles")
+        pos = jnp.asarray(position_offset, jnp.int32)      # (b,)
         idx = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
         c = cos[idx][:, :, None, :]                        # (b, s, 1, half)
         si = sin[idx][:, :, None, :]

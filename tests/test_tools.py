@@ -93,6 +93,38 @@ class TestLossCurveHarness:
         assert ref["losses"][-1] < ref["losses"][0]   # the curve learns
 
 
+class TestExternalOracle:
+    def test_framework_curve_matches_plain_jax_oracle(self):
+        """VERDICT r4 item 6: the loss curve must match an EXTERNAL
+        plain-jax reimplementation (tools/llama_oracle.py, zero
+        paddle_tpu imports) on identical weights + data — catches the
+        framework being consistently wrong, which the committed-curve
+        drift gate cannot."""
+        import importlib.util
+        tools = os.path.join(REPO, "tools")
+        sys.path.insert(0, tools)
+        try:
+            spec = importlib.util.spec_from_file_location(
+                "loss_curve", os.path.join(tools, "loss_curve.py"))
+            lc = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(lc)
+            assert lc.external_check(steps=10) == 0
+        finally:
+            sys.path.remove(tools)
+
+    def test_oracle_is_paddle_free(self):
+        import ast
+        src = open(os.path.join(REPO, "tools", "llama_oracle.py")).read()
+        mods = set()
+        for node in ast.walk(ast.parse(src)):
+            if isinstance(node, ast.Import):
+                mods.update(a.name.split(".")[0] for a in node.names)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mods.add(node.module.split(".")[0])
+        assert mods <= {"jax", "numpy"}, (
+            f"oracle must stay framework-free, imports: {mods}")
+
+
 class TestTpuCapture:
     """tools/tpu_capture.py: the opportunistic hardware-capture harness
     (VERDICT r4 item 1).  The chip itself is usually unreachable, so these
