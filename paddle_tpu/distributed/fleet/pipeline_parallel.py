@@ -308,8 +308,12 @@ class PipelineStack(Layer):
 
             if self.schedule in ("1F1B", "ZB", "VPP"):
                 # per-unit remat: backward re-runs each stage pass from the
-                # stage-boundary activation — peak activations O(stages),
-                # the 1F1B footprint.  FThenB stores everything (GPipe).
+                # stage-boundary activation.  NOTE: for v == 1 the 1F1B/ZB
+                # schedules do not reach this path when differentiated —
+                # the custom-vjp manual backward below owns it; this remat
+                # covers VPP's autodiff, whose saved scan carries remain
+                # O(M) (see _build_1f1b_vjp for why plain reverse-AD of
+                # the tick scan cannot do better).
                 stage_block = jax.checkpoint(stage_block)
 
             mb_shape = xs.shape[1:]
@@ -369,9 +373,137 @@ class PipelineStack(Layer):
         # program anyway
         fn = jax.jit(shard_map(run, mesh=mesh.jax_mesh, in_specs=in_specs,
                                out_specs=out_specs, check_vma=False))
+        if self.schedule in ("1F1B", "ZB") and v == 1:
+            fn = self._build_1f1b_vjp(fn, in_specs, out_specs)
         self._compiled_cache[x.ndim] = fn
         out = call_op("pipeline_stack", fn, (tuple(param_tensors), x), {})
         return out
+
+    def _build_1f1b_vjp(self, fwd_fn, in_specs, out_specs):
+        """TRUE 1F1B memory: a custom-vjp whose backward is a HAND-
+        SCHEDULED lockstep loop interleaving forward recompute with
+        backward, holding at most O(S) stage-boundary activations per
+        device (reference: the 1F1B schedule of
+        fleet/meta_parallel/pipeline_parallel.py:255,575).
+
+        Why custom: reverse-mode AD of the tick scan is inherently
+        GPipe-ordered — jax saves every tick's carry, so 'remat 1F1B'
+        still held O(M) temps in the compiled program (measured: temp
+        bytes grew at ~the FThenB slope).  Here the forward saves ONLY
+        (params, x); the backward replays the ring with this schedule:
+
+          forward-recompute of (microbatch m, stage s) at tick  m + s
+          backward          of (m, s)              at tick  m + 2S-1-s
+
+        so stage s's recomputed input activation lives 2(S-s)-1 ticks in
+        a depth-2S circular buffer — the classic 1F1B in-flight profile
+        (deeper at early stages), O(S) per device and independent of M.
+        Cotangents ride the reverse ring (ppermute s -> s-1); the last
+        stage injects dy[m], stage 0 emits dx[m].  Param grads accumulate
+        additively across microbatches, so backward order needs no
+        relationship to the forward's.  Cost: one extra forward replay
+        vs the remat path — the standard 1F1B memory/compute trade.
+
+        v == 1 only; interleaved VPP keeps the remat autodiff path.
+        """
+        M, S = self.num_microbatches, self.num_stages
+        mesh, axis = self._mesh, self._axis
+
+        def bwd_run(params, xs, dys):
+            r = lax.axis_index(axis)
+            D = 2 * S
+            mb_shape = xs.shape[1:]
+            chunk_params = [p[0, 0] for p in params]     # (lps, ...) local
+
+            def block_chain(h, chunk):
+                def scan_body(carry, layer_params):
+                    return self._block_apply(layer_params, carry), None
+                out, _ = lax.scan(scan_body, h, chunk)
+                return out
+
+            fperm = [(i, (i + 1) % S) for i in range(S)]
+            bperm = [(i, (i - 1) % S) for i in range(S)]
+            Tb = M + 2 * S - 1
+
+            buf = jnp.zeros((D,) + mb_shape, xs.dtype)
+            fwd_state = jnp.zeros(mb_shape, xs.dtype)
+            bwd_state = jnp.zeros(mb_shape, xs.dtype)
+            dxs = jnp.zeros((M,) + mb_shape, xs.dtype)
+            gparams = [jnp.zeros_like(c) for c in chunk_params]
+
+            def step(carry, t):
+                fwd_state, bwd_state, buf, dxs, gparams = carry
+                # ---- forward-recompute unit (m_f, r) at t = m_f + r
+                m_f = t - r
+                f_valid = (m_f >= 0) & (m_f < M)
+                inp = jnp.where(r == 0, xs[jnp.clip(m_f, 0, M - 1)],
+                                fwd_state)
+                buf = lax.cond(
+                    f_valid, lambda b: b.at[t % D].set(inp), lambda b: b,
+                    buf)
+                h = block_chain(inp, chunk_params)
+                fwd_state = lax.ppermute(h, axis, fperm)
+                # ---- backward unit (m_b, r) at t = m_b + 2S-1-r
+                m_b = t - (2 * S - 1 - r)
+                b_valid = (m_b >= 0) & (m_b < M)
+                mb_c = jnp.clip(m_b, 0, M - 1)
+                ct_in = jnp.where(r == S - 1, dys[mb_c], bwd_state)
+                a = buf[(mb_c + r) % D]
+                _, vjp_fn = jax.vjp(block_chain, a, chunk_params)
+                da, dchunk = vjp_fn(ct_in.astype(xs.dtype))
+                gparams = [g + jnp.where(b_valid, d, 0)
+                           for g, d in zip(gparams, dchunk)]
+                dxs = lax.cond(
+                    b_valid & (r == 0),
+                    lambda o: o.at[mb_c].set(da.astype(o.dtype)),
+                    lambda o: o, dxs)
+                bwd_state = lax.ppermute(
+                    jnp.where(b_valid, da, jnp.zeros_like(da)), axis,
+                    bperm)
+                return (fwd_state, bwd_state, buf, dxs, gparams), None
+
+            carry, _ = lax.scan(
+                step, (fwd_state, bwd_state, buf, dxs, gparams),
+                jnp.arange(Tb))
+            _, _, _, dxs, gparams = carry
+            dxs = lax.psum(jnp.where(r == 0, dxs,
+                                     jnp.zeros_like(dxs)), axis)
+            if self._data_axis is not None:
+                # each data-parallel slice saw different microbatch rows:
+                # param grads sum across the data axis (the psum jax's AD
+                # of the forward inserts automatically for replicated
+                # params; manual backward must match)
+                gparams = [lax.psum(g, self._data_axis) for g in gparams]
+            # local (lps, ...) grads back to the stacked (v=1, S, lps, ...)
+            # layout: each device contributes its stage slice
+            dparams = tuple(g[None, None] for g in gparams)
+            return dparams, dxs
+
+        bwd_fn = None
+
+        def get_bwd():
+            nonlocal bwd_fn
+            if bwd_fn is None:
+                bwd_fn = jax.jit(shard_map(
+                    bwd_run, mesh=mesh.jax_mesh,
+                    in_specs=(in_specs[0], in_specs[1], out_specs),
+                    out_specs=(in_specs[0], in_specs[1]),
+                    check_vma=False))
+            return bwd_fn
+
+        pipeline = jax.custom_vjp(lambda params, x_: fwd_fn(params, x_))
+
+        def cv_fwd(params, x_):
+            return fwd_fn(params, x_), (params, x_)
+
+        def cv_bwd(res, dy):
+            params, x_ = res
+            dparams, dx = get_bwd()(params, x_, dy)
+            return dparams, dx
+
+        pipeline.defvjp(cv_fwd, cv_bwd)
+        pipeline._fwd_jit = fwd_fn      # cache introspection (tests/tools)
+        return pipeline
 
 
 class PipelineLayer(Layer):

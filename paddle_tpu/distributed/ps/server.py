@@ -26,28 +26,49 @@ class PSServer:
         self._stop = threading.Event()
         # push idempotency: a client retry whose original DID apply (the
         # reply was lost, not the request) must not double-apply the
-        # gradient.  Bounded FIFO of seen tokens.
-        self._seen_tokens: "OrderedDict[str, bool]" = OrderedDict()
+        # gradient.  Bounded FIFO: token -> "done" | in-flight Event.
+        self._tokens: "OrderedDict[str, object]" = OrderedDict()
         self._token_lock = threading.Lock()
 
-    def seen_token(self, token) -> bool:
-        """True if this push token was already APPLIED (read-only)."""
-        if token is None:
-            return False
-        with self._token_lock:
-            return token in self._seen_tokens
+    def claim_token(self, token):
+        """Atomically claim a push token.  Returns:
 
-    def mark_token(self, token) -> None:
-        """Record a token AFTER its push applied successfully — marking
-        before the apply would falsely ack a retried push whose original
-        raised mid-apply (client retries are sequential, so
-        mark-after-success cannot double-apply)."""
-        if token is None:
-            return
+        ('apply', None)  — caller owns the apply; call finish_token /
+                           fail_token afterwards.
+        ('done', None)   — already applied: ack without re-applying.
+        ('wait', event)  — the ORIGINAL request is still applying on
+                           another connection thread (its reply was lost
+                           but it is executing); the retry must wait for
+                           the event, then re-check, never re-apply.
+        """
         with self._token_lock:
-            self._seen_tokens[token] = True
-            while len(self._seen_tokens) > 65536:
-                self._seen_tokens.popitem(last=False)
+            state = self._tokens.get(token)
+            if state == "done":
+                return "done", None
+            if isinstance(state, threading.Event):
+                return "wait", state
+            self._tokens[token] = threading.Event()
+            return "apply", None
+
+    def finish_token(self, token) -> None:
+        with self._token_lock:
+            ev = self._tokens.get(token)
+            self._tokens[token] = "done"
+            while len(self._tokens) > 65536:
+                self._tokens.popitem(last=False)
+        if isinstance(ev, threading.Event):
+            ev.set()
+
+    def fail_token(self, token) -> None:
+        """The apply raised: release the claim so a retry re-applies."""
+        with self._token_lock:
+            ev = self._tokens.pop(token, None)
+        if isinstance(ev, threading.Event):
+            ev.set()
+
+    def token_done(self, token) -> bool:
+        with self._token_lock:
+            return self._tokens.get(token) == "done"
 
     def create_table(self, name: str, dim: int,
                      table_type: str = "memory", **kwargs) -> None:
@@ -115,12 +136,35 @@ def _h_pull(name, ids):
     return _SERVER.table(name).pull(np.asarray(ids))
 
 
-def _h_push(name, ids, grads, lr, token=None):
-    if _SERVER.seen_token(token):
+def _apply_with_token(token, apply_fn):
+    if token is None:
+        apply_fn()
+        return True
+    status, ev = _SERVER.claim_token(token)
+    if status == "done":
         return True                       # duplicate retry: already applied
-    _SERVER.table(name).push(np.asarray(ids), np.asarray(grads), lr)
-    _SERVER.mark_token(token)
+    if status == "wait":
+        # the original is mid-apply on another connection thread (reply
+        # lost, request alive) — wait it out instead of double-applying
+        ev.wait(timeout=300)
+        if _SERVER.token_done(token):
+            return True
+        raise RuntimeError(
+            "duplicate push raced an original that failed; retry")
+    try:
+        apply_fn()
+    except BaseException:
+        _SERVER.fail_token(token)
+        raise
+    _SERVER.finish_token(token)
     return True
+
+
+def _h_push(name, ids, grads, lr, token=None):
+    return _apply_with_token(
+        token,
+        lambda: _SERVER.table(name).push(np.asarray(ids),
+                                         np.asarray(grads), lr))
 
 
 def _h_size(name):
@@ -152,11 +196,9 @@ def _h_dense_pull(name):
 
 
 def _h_dense_push(name, grad, lr, token=None):
-    if _SERVER.seen_token(token):
-        return True                       # duplicate retry: already applied
-    _SERVER.dense_table(name).push(np.asarray(grad), lr)
-    _SERVER.mark_token(token)
-    return True
+    return _apply_with_token(
+        token,
+        lambda: _SERVER.dense_table(name).push(np.asarray(grad), lr))
 
 
 def _h_dense_set(name, value):

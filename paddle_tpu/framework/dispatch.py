@@ -251,6 +251,8 @@ def _apply_spmd_rule(name, leaves, tensor_idx, treedef, result):
             t._data = new_data
             t.dist_attr = attr
     except Exception:   # advisory: never let a rule break dispatch
+        if get_flag("spmd_rule_strict", 0):
+            raise            # CI health mode: a rotted rule must FAIL
         if get_flag("spmd_rule_debug", 0):
             import traceback
             print(f"WARNING: spmd rule for op '{name}' failed:")

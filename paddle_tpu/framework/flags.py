@@ -88,6 +88,8 @@ define_flag("check_nan_inf", False, "scan op outputs for nan/inf (numerical sani
 define_flag("check_nan_inf_level", 0, "0: raise on nan/inf; >0: log only")
 define_flag("spmd_rule_debug", False,
             "print tracebacks when an advisory SPMD sharding rule fails")
+define_flag("spmd_rule_strict", False,
+            "raise instead of swallowing SPMD-rule failures (CI health mode)")
 define_flag("benchmark", False, "sync after every op for timing")
 define_flag("eager_op_jit", True, "cache-jit eager ops instead of op-by-op dispatch")
 define_flag("log_level", 0, "framework verbose log level (VLOG analog)")

@@ -125,8 +125,6 @@ def external_check(steps=40, atol=2e-3, seed=0, batch=4, seq=128):
     implementations train independently; the curves must agree to tight
     tolerance.  Unlike --check (drift vs our own committed curve), this
     catches the framework being consistently WRONG."""
-    import jax.numpy as jnp
-
     import paddle_tpu as paddle
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     import llama_oracle
