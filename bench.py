@@ -407,19 +407,20 @@ print(json.dumps({"img_s_1": r1, "img_s_8": r8, "eff": r8 / (8 * r1)}))
                              capture_output=True, text=True, timeout=900)
         info = json.loads(res.stdout.strip().splitlines()[-1])
     except Exception as e:  # noqa: BLE001
-        return {"metric": "dp_resnet18_weak_scaling_efficiency_8dev",
+        return {"metric": "dp_sharding_correctness_probe_8dev",
                 "value": 0.0, "unit": "ratio", "vs_baseline": 0.0,
-                "error": repr(e)}
+                "kind": "correctness_probe", "error": repr(e)}
     return {
-        "metric": "dp_resnet18_weak_scaling_efficiency_8dev",
+        # labeled a CORRECTNESS PROBE, not a perf metric: 8 virtual
+        # devices share one host's cores, so "efficiency" here can only
+        # show the sharding mechanics executed, never real scaling —
+        # the multi-chip dryrun is the real gate for that
+        "metric": "dp_sharding_correctness_probe_8dev",
         "value": round(info["eff"], 3), "unit": "ratio", "vs_baseline": 0.0,
+        "kind": "correctness_probe",
         "images_per_sec_1dev": round(info["img_s_1"], 1),
         "images_per_sec_8dev": round(info["img_s_8"], 1),
         "path": "GSPMD dp mesh, virtual CPU devices (one real chip on host)",
-        "note": "8 virtual devices share one host's cores, so weak-scaling "
-                "efficiency ~1/8 is the expected ceiling here; this config "
-                "validates DP sharding mechanics until a multi-chip slice "
-                "is available",
     }
 
 

@@ -1,5 +1,7 @@
 """Core framework: Tensor, autograd tape, dispatch, dtype/device/flags/RNG."""
 from .tensor import Tensor, Parameter, to_tensor, wrap_array
+from .selected_rows import (SelectedRows, apply_rows_sgd,
+                            embedding_grad_rows)
 from .tape import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
 from .dtype import set_default_dtype, get_default_dtype, convert_dtype
 from .device import set_device, get_device, get_current_place

@@ -399,9 +399,26 @@ def _stamp_outputs(result, node):
             idx += 1
 
 
+_NAN_CHECK_WARNED = False
+
+
 def _check_nan_inf(name, result):
+    """Numerical sanitizer (FLAGS_check_nan_inf).
+
+    COST WARNING: the bool() forces a device->host sync after EVERY op,
+    destroying async dispatch while enabled — the reference's equivalent
+    runs kernel-side (paddle/fluid/eager/nan_inf_utils.cc).  Debug tool
+    only; a one-time warning states this at first use.
+    """
     if not get_flag("check_nan_inf"):
         return
+    global _NAN_CHECK_WARNED
+    if not _NAN_CHECK_WARNED:
+        _NAN_CHECK_WARNED = True
+        import warnings
+        warnings.warn(
+            "FLAGS_check_nan_inf forces a device sync per op (async "
+            "dispatch is disabled while it is on) — debug runs only")
     import jax.numpy as jnp
     flat, _ = jtu.tree_flatten(result, is_leaf=_is_tensor)
     for t in flat:

@@ -1,0 +1,65 @@
+"""SelectedRows: the sparse row-set gradient representation.
+
+Capability parity: phi::SelectedRows (reference:
+paddle/phi/core/selected_rows.h, kernels paddle/phi/kernels/selected_rows/)
+— an embedding table's gradient holds values only for the rows a batch
+touched, not the full [vocab, dim] dense tensor.  The reference threads
+this type through kernels; the TPU-native mapping keeps XLA-friendly
+dense arrays and derives the rows form with unique + segment-sum:
+
+    rows   = unique ids in the batch                  [n_rows]
+    values = segment-sum of output grads per id       [n_rows, dim]
+
+which is exactly what the parameter-server push path consumes
+(PSClient.push_sparse(ids, grads)), so a billion-row embedding never
+materializes a dense gradient.  ``rows_to_dense`` is the lossless bridge
+back for numerics checks, and ``apply_rows_sgd`` the row-wise optimizer
+update.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    """rows [n] int32, values [n, ...], height = dense dim-0 extent."""
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def to_dense(self):
+        shape = (self.height,) + tuple(self.values.shape[1:])
+        return jnp.zeros(shape, self.values.dtype).at[self.rows].add(
+            self.values)
+
+
+def embedding_grad_rows(ids, out_grad, vocab_size: int,
+                        num_rows: int | None = None) -> SelectedRows:
+    """Embedding gradient in rows form, never densifying to [vocab, dim].
+
+    ids: int [*batch]; out_grad: [*batch, dim].  ``num_rows`` bounds the
+    unique-id count for a static output shape (defaults to the flattened
+    batch size — the true upper bound); surplus slots repeat a fill id
+    with ZERO values, so scatter-add consumers (to_dense, apply_rows_sgd,
+    PS push with the 'sum'/'sgd' rules) are unaffected by them.
+    """
+    flat_ids = jnp.reshape(jnp.asarray(ids, jnp.int32), (-1,))
+    dim = out_grad.shape[-1]
+    flat_g = jnp.reshape(out_grad, (-1, dim))
+    n = flat_ids.shape[0]
+    if num_rows is None:
+        num_rows = n
+    uniq, inv = jnp.unique(flat_ids, size=num_rows,
+                           fill_value=vocab_size - 1,
+                           return_inverse=True)
+    values = jax.ops.segment_sum(flat_g, inv, num_segments=num_rows)
+    return SelectedRows(uniq, values, vocab_size)
+
+
+def apply_rows_sgd(table, grad: SelectedRows, lr: float):
+    """Row-wise SGD: touch only grad.rows of ``table`` [vocab, dim]."""
+    return table.at[grad.rows].add(
+        (-lr * grad.values).astype(table.dtype))

