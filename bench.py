@@ -332,7 +332,9 @@ def bench_paged_decode(on_tpu):
                                       max_batch=nb) as eng:
             prompts = [rng.integers(0, cfg.vocab_size, (prompt,))
                        .astype("int32") for _ in range(nb)]
-            warm = [eng.submit(p, max_new_tokens=2) for p in prompts]
+            # warm pass mirrors the timed pass so every admission-ramp
+            # bucket the real run hits is already compiled
+            warm = [eng.submit(p, max_new_tokens=decode) for p in prompts]
             for r in warm:
                 r.result(timeout=600)
             t0 = time.perf_counter()

@@ -52,6 +52,14 @@ def embedding_grad_rows(ids, out_grad, vocab_size: int,
     n = flat_ids.shape[0]
     if num_rows is None:
         num_rows = n
+    if num_rows < min(n, vocab_size):
+        # jnp.unique(size=k) TRUNCATES past k — dropped ids' gradients
+        # would be silently lost or misdirected.  min(n, vocab) is the
+        # provable unique-count bound, so anything smaller is unsafe.
+        raise ValueError(
+            f"num_rows={num_rows} cannot hold the worst-case "
+            f"{min(n, vocab_size)} unique ids of a {n}-token batch — "
+            "truncation would silently corrupt the gradient")
     uniq, inv = jnp.unique(flat_ids, size=num_rows,
                            fill_value=vocab_size - 1,
                            return_inverse=True)

@@ -86,6 +86,8 @@ class ContinuousBatchingEngine:
         self.max_position = int(model.config.max_position_embeddings)
         self.cache = PagedKVCache.from_model(
             model, total_pages=total_pages, page_size=page_size)
+        from .paged import JittedPagedDecoder
+        self._decoder = JittedPagedDecoder(model)
         # one scratch sequence backs every padding row of every bucket;
         # its single page is allocated only for the duration of a padded
         # step (so an idle engine reports a fully reclaimed pool), but
@@ -224,22 +226,17 @@ class ContinuousBatchingEngine:
             r.generated.append(r.next_token)
             tokens[i, 0] = r.next_token
             pos[i] = self.cache.length(r.seq_id)
-            self.cache.allocate(r.seq_id, 1)
-            seq_ids.append(r.seq_id)
+            seq_ids.append(r.seq_id)       # decoder.step allocates pages
         # pad rows: a scratch sequence rewrites its slot 0 every step
         if npad:
             self.cache.allocate(_PAD_SEQ, 1)
             self.cache.truncate(_PAD_SEQ, 0)
             seq_ids.extend([_PAD_SEQ] * npad)
         try:
-            with no_grad():
-                ctx = _PagedContext(self.cache, seq_ids, prefill=False)
-                # pos stays a numpy array so the rope bound check runs
-                # host-side (no device round-trip per layer)
-                hidden = self.model.model(wrap_array(jnp.asarray(tokens)),
-                                          pos, paged_ctx=ctx)
-                logits = self.model._logits_of(hidden)
-            logits_np = np.asarray(logits._data[:, -1], np.float32)
+            # ONE compiled program per decode step for the whole running
+            # batch (per-row positions, pools donated through the step)
+            logits_np = self._decoder.step(self.cache, seq_ids, tokens,
+                                           pos)
         finally:
             if npad:
                 self.cache.free(_PAD_SEQ)

@@ -27,7 +27,13 @@ from ..ops.pallas.paged_attention import PagedKVCache, paged_attention
 
 
 class _PagedContext:
-    """Per-forward attention driver handed down to attention layers."""
+    """Per-forward attention driver handed down to attention layers.
+
+    The prefill path is live in production; the ``prefill=False`` decode
+    branch is the EAGER ORACLE the jitted decode step
+    (JittedPagedDecoder/_TracedPagedContext) is equivalence-tested
+    against — keep the two write/lens protocols in sync
+    (tests/test_paged_attention.py eager-vs-jitted parity)."""
 
     def __init__(self, cache: PagedKVCache, seq_ids: Sequence[int],
                  prefill: bool):
@@ -60,6 +66,113 @@ class _PagedContext:
         return wrap_array(out[:, None])      # (batch, 1, q_heads, d)
 
 
+class _TracedPagedContext:
+    """Paged-attention driver for the JITTED decode step: page pools,
+    (page, slot) write targets, lengths and tables are all TRACED values
+    carried through one compiled program — no host bookkeeping inside.
+    Scatters are functional updates on the carried pools (donated at the
+    jit boundary, so XLA writes in place)."""
+
+    def __init__(self, k_pages, v_pages, pg, sl, lens, tables):
+        self.k_pages = list(k_pages)
+        self.v_pages = list(v_pages)
+        self.pg = pg                    # (batch,) int32 — one token/seq
+        self.sl = sl
+        self.lens = lens                # POST-write lengths
+        self.tables = tables
+        self.prefill = False
+        self.layer_idx = 0
+
+    def attend(self, q, k, v):
+        layer = self.layer_idx
+        kp, vp = self.k_pages[layer], self.v_pages[layer]
+        ks = jnp.swapaxes(k._data[:, 0], 0, 1)      # (kvh, batch, d)
+        vs = jnp.swapaxes(v._data[:, 0], 0, 1)
+        kp = kp.at[:, self.pg, self.sl].set(ks.astype(kp.dtype))
+        vp = vp.at[:, self.pg, self.sl].set(vs.astype(vp.dtype))
+        self.k_pages[layer], self.v_pages[layer] = kp, vp
+        out = paged_attention(q._data[:, 0], kp, vp, self.lens,
+                              self.tables)
+        return wrap_array(out[:, None])
+
+
+class JittedPagedDecoder:
+    """One-compiled-program decode step: embed + every layer's rope /
+    paged write / paged attention / MLP + logits, with the page pools
+    donated through the step.  Replaces per-op eager dispatch in the
+    decode hot loop (dozens of ops x layers per generated token).
+
+    Shared by PagedGenerator and ContinuousBatchingEngine; retraces per
+    (batch, pool-shape) signature and reuses the compile cache after.
+    """
+
+    def __init__(self, model):
+        self.model = model
+        self.params = model.parameters()
+        self.max_position = int(model.config.max_position_embeddings)
+
+        def fn(param_arrays, tokens, pos, pg, sl, lens, tables,
+               k_pages, v_pages):
+            saved = [p._data for p in self.params]
+            try:
+                for p, a in zip(self.params, param_arrays):
+                    p._data = a
+                ctx = _TracedPagedContext(k_pages, v_pages, pg, sl, lens,
+                                          tables)
+                with no_grad():
+                    hidden = model.model(wrap_array(tokens), pos,
+                                         paged_ctx=ctx)
+                    logits = model._logits_of(hidden)
+                return (logits._data[:, -1].astype(jnp.float32),
+                        tuple(ctx.k_pages), tuple(ctx.v_pages))
+            finally:
+                for p, s in zip(self.params, saved):
+                    p._data = s
+
+        import jax
+        self._jitted = jax.jit(fn, donate_argnums=(7, 8))
+
+    def step(self, cache: PagedKVCache, seq_ids, tokens_np,
+             positions_np) -> np.ndarray:
+        """One decode token for every sequence.  tokens_np (batch, 1)
+        int32; positions_np (batch,) int32 — each row's current length.
+        Allocates+advances cache bookkeeping host-side, runs the
+        compiled step, writes the updated pools back.  Returns the last
+        logits (batch, vocab) float32 numpy."""
+        if int(positions_np.max()) + 1 > self.max_position:
+            raise ValueError(
+                f"decode position {int(positions_np.max()) + 1} exceeds "
+                f"max_position_embeddings ({self.max_position})")
+        for sid in seq_ids:
+            cache.allocate(sid, 1)
+        pg, sl = cache.plan_write(seq_ids, 1)
+        cache.advance(seq_ids, 1)
+        # bucket the page-table width to a power of two: an exact width
+        # would change shape every time the longest sequence crosses a
+        # page boundary, recompiling the whole decode program mid-serving
+        needed = max(len(cache._seq_pages.get(s, ())) for s in seq_ids)
+        mp = 1
+        while mp < needed:
+            mp *= 2
+        tabs, lens = cache.page_table(seq_ids, max_pages=mp)
+        try:
+            logits, k_pages, v_pages = self._jitted(
+                [p._data for p in self.params],
+                jnp.asarray(tokens_np), jnp.asarray(positions_np),
+                jnp.asarray(pg), jnp.asarray(sl), lens, tabs,
+                tuple(cache.k_pages), tuple(cache.v_pages))
+        except BaseException:
+            # the pools were DONATED: after a mid-step failure (e.g.
+            # device OOM) they may be invalidated — rebuild them so the
+            # cache object stays usable (sequence KV is lost; callers
+            # fail the affected requests anyway)
+            cache.reset_pools()
+            raise
+        cache.k_pages = list(k_pages)
+        cache.v_pages = list(v_pages)
+        return np.asarray(logits)
+
+
 def sample_token(logits_row, do_sample, temperature, rng) -> int:
     """One row's next token: greedy argmax or temperature sampling —
     the single sampling definition shared by PagedGenerator and the
@@ -86,6 +199,7 @@ class PagedGenerator:
         self._next_seq = 0
         self.cache = PagedKVCache.from_model(
             model, total_pages=total_pages, page_size=page_size)
+        self._decoder = JittedPagedDecoder(model)
         # per-phase wall times of the last generate() call, so callers
         # (bench, schedulers) can split prefill from steady-state decode
         # without a second subtraction run
@@ -134,8 +248,8 @@ class PagedGenerator:
             out = [ids]
             finished = np.zeros(b, bool)
             pos = s
+            step = np.asarray(logits._data[:, -1].astype(jnp.float32))
             for _ in range(max_new_tokens):
-                step = np.asarray(logits._data[:, -1].astype(jnp.float32))
                 nxt = np.array([
                     sample_token(row, do_sample, temperature, rng)
                     for row in step])
@@ -145,12 +259,12 @@ class PagedGenerator:
                 out.append(nxt[:, None].astype(ids.dtype))
                 if eos_token_id is not None and finished.all():
                     break
-                for sid in seq_ids:
-                    self.cache.allocate(sid, 1)
-                ctx = _PagedContext(self.cache, seq_ids, prefill=False)
-                hidden = model.model(
-                    wrap_array(jnp.asarray(out[-1])), pos, paged_ctx=ctx)
-                logits = model._logits_of(hidden)
+                # ONE compiled program per decode token (embed + all
+                # layers + logits), pools donated through the step
+                step = self._decoder.step(
+                    self.cache, seq_ids,
+                    out[-1].astype(np.int32),
+                    np.full(b, pos, np.int32))
                 pos += 1
             self.last_decode_seconds = _time.perf_counter() - t0
 

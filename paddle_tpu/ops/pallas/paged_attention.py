@@ -239,6 +239,18 @@ class PagedKVCache:
         self._free.extend(self._seq_pages.pop(seq_id, []))
         self._seq_len.pop(seq_id, None)
 
+    def reset_pools(self) -> None:
+        """Reallocate zeroed page pools (same shapes/dtype).  For
+        recovery after a failed donated-buffer step invalidated the old
+        pools: bookkeeping survives, cached K/V content does not."""
+        shape = (self.kv_heads, self.total_pages, self.page_size,
+                 self.head_dim)
+        dtype = self.k_pages[0].dtype if self.k_pages else jnp.float32
+        self.k_pages = [jnp.zeros(shape, dtype)
+                        for _ in range(self.num_layers)]
+        self.v_pages = [jnp.zeros(shape, dtype)
+                        for _ in range(self.num_layers)]
+
     def truncate(self, seq_id, length: int) -> None:
         """Roll a sequence's logical length back (pages stay allocated,
         their tail slots are simply rewritten by later writes) — used by
@@ -273,15 +285,13 @@ class PagedKVCache:
         the length)."""
         self.write_batch(layer, [seq_id], k_new[None], v_new[None])
 
-    def write_batch(self, layer: int, seq_ids, k_new, v_new) -> None:
-        """Append one step's k/v for MANY sequences in a single scatter
-        per pool: k_new/v_new (batch, tokens, kv_heads, head_dim).  All
-        (page, slot) targets for the step are computed host-side from the
-        allocator tables, then written with one donated-buffer .set per
-        layer — O(step tokens) device work instead of O(pool) per
-        sequence (the write-amplification the per-sequence path had).
-        The last layer's write advances the lengths."""
-        b, n = k_new.shape[0], k_new.shape[1]
+    def plan_write(self, seq_ids, n: int):
+        """Host-side half of a step's write: (page, slot) targets for
+        ``n`` new tokens per sequence, as flat (batch*n,) int32 arrays,
+        WITHOUT touching the device — the jitted decode path scatters
+        inside its compiled program using these.  Does NOT advance
+        lengths (call advance() once the write is in flight)."""
+        b = len(seq_ids)
         pages_flat = np.empty(b * n, np.int32)
         slots_flat = np.empty(b * n, np.int32)
         for i, sid in enumerate(seq_ids):
@@ -291,6 +301,23 @@ class PagedKVCache:
             pages_flat[i * n:(i + 1) * n] = [
                 pages[p] for p in pos // self.page_size]
             slots_flat[i * n:(i + 1) * n] = pos % self.page_size
+        return pages_flat, slots_flat
+
+    def advance(self, seq_ids, n: int) -> None:
+        """Advance logical lengths by ``n`` tokens per sequence."""
+        for sid in seq_ids:
+            self._seq_len[sid] = self._seq_len.get(sid, 0) + n
+
+    def write_batch(self, layer: int, seq_ids, k_new, v_new) -> None:
+        """Append one step's k/v for MANY sequences in a single scatter
+        per pool: k_new/v_new (batch, tokens, kv_heads, head_dim).  All
+        (page, slot) targets for the step are computed host-side from the
+        allocator tables, then written with one donated-buffer .set per
+        layer — O(step tokens) device work instead of O(pool) per
+        sequence (the write-amplification the per-sequence path had).
+        The last layer's write advances the lengths."""
+        b, n = k_new.shape[0], k_new.shape[1]
+        pages_flat, slots_flat = self.plan_write(seq_ids, n)
         pg = jnp.asarray(pages_flat)
         sl = jnp.asarray(slots_flat)
         # (b, n, kvh, d) -> (kvh, b*n, d) to line up with pool[:, pg, sl]
@@ -301,5 +328,4 @@ class PagedKVCache:
         self.v_pages[layer] = _scatter_pages(
             self.v_pages[layer], pg, sl, jnp.swapaxes(kv_flat[1], 0, 1))
         if layer == self.num_layers - 1:
-            for sid in seq_ids:
-                self._seq_len[sid] = self._seq_len.get(sid, 0) + n
+            self.advance(seq_ids, n)

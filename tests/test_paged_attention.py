@@ -170,3 +170,64 @@ class TestFusedNormRope:
                                    rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(np.asarray(ok_p), np.asarray(ok_x),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestJittedDecoderOracle:
+    """The compiled decode step (JittedPagedDecoder) vs the eager
+    _PagedContext decode branch — the branch stays as the numerics
+    oracle for the write/lens protocol."""
+
+    def test_jitted_step_matches_eager_context(self):
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        from paddle_tpu.framework.tape import no_grad
+        from paddle_tpu.framework.tensor import wrap_array
+        from paddle_tpu.inference.paged import (
+            JittedPagedDecoder, PagedGenerator, _PagedContext)
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=64)
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 64, (2, 7)).astype("int32")
+
+        def prefill(gen, seq_ids):
+            for sid in seq_ids:
+                gen.cache.allocate(sid, ids.shape[1])
+            ctx = _PagedContext(gen.cache, seq_ids, prefill=True)
+            with no_grad():
+                hidden = model.model(wrap_array(jnp.asarray(ids)), 0,
+                                     paged_ctx=ctx)
+                return np.asarray(
+                    model._logits_of(hidden[:, -1:])._data[:, -1],
+                    np.float32)
+
+        # eager decode: one token through the _PagedContext branch
+        gen_e = PagedGenerator(model, total_pages=32, page_size=8)
+        logits0 = prefill(gen_e, [0, 1])
+        nxt = logits0.argmax(-1).astype("int32")[:, None]
+        for sid in (0, 1):
+            gen_e.cache.allocate(sid, 1)
+        ctx = _PagedContext(gen_e.cache, [0, 1], prefill=False)
+        with no_grad():
+            hidden = model.model(wrap_array(jnp.asarray(nxt)),
+                                 ids.shape[1], paged_ctx=ctx)
+            eager_logits = np.asarray(
+                model._logits_of(hidden)._data[:, -1], np.float32)
+
+        # jitted decode: same token through the compiled step
+        gen_j = PagedGenerator(model, total_pages=32, page_size=8)
+        prefill(gen_j, [0, 1])
+        dec = JittedPagedDecoder(model)
+        jit_logits = dec.step(gen_j.cache, [0, 1], nxt,
+                              np.full(2, ids.shape[1], np.int32))
+        np.testing.assert_allclose(jit_logits, eager_logits, atol=2e-5)
+        # both protocols agree on the cache state too
+        for l in range(cfg.num_hidden_layers):
+            np.testing.assert_allclose(
+                np.asarray(gen_j.cache.k_pages[l]),
+                np.asarray(gen_e.cache.k_pages[l]), atol=2e-5)
