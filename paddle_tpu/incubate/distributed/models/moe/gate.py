@@ -117,6 +117,29 @@ def _moe_gating(logits, top_k, capacity, normalize, random_keep=None):
 @def_op("moe_topk_routing")
 def _moe_topk_routing(logits, top_k, capacity, normalize,
                       random_keep=None):
+    import jax.numpy as _jnp
+    if random_keep is None and logits.dtype == _jnp.float32:
+        # fused Pallas gating on TPU (per-shape measured dispatch, the
+        # same policy the attention/rmsnorm/rope kernels use); the XLA
+        # oracle everywhere else, for GShard random routing, and for
+        # non-f32 logits (the kernel computes in f32, so low-precision
+        # inputs could route differently than the same-dtype oracle —
+        # argmax ties break differently after the upcast)
+        from .....ops import autotune as _autotune
+        from .....ops.pallas.moe_gating import topk_gating_pallas
+
+        key = (f"moe_gating:{tuple(logits.shape)}:{top_k}:{capacity}:"
+               f"{logits.dtype}")
+        impl = _autotune.select(
+            key, logits,
+            {"xla": lambda: _topk_routing(
+                jax.nn.softmax(logits, axis=-1), top_k, capacity,
+                normalize),
+             "pallas": lambda: topk_gating_pallas(
+                 logits, top_k, capacity, normalize)},
+            default="xla")
+        if impl == "pallas":
+            return topk_gating_pallas(logits, top_k, capacity, normalize)
     gates = jax.nn.softmax(logits, axis=-1)
     return _topk_routing(gates, top_k, capacity, normalize, random_keep)
 

@@ -163,3 +163,14 @@ class TestLoweredProgramSanity:
         # Mosaic payloads are serialized into the custom call backend
         # config; a real kernel at these shapes is tens of KB of MLIR
         assert len(mlir) > 10_000
+
+
+class TestMoEGatingLowering:
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_gating(self, top_k):
+        from paddle_tpu.ops.pallas.moe_gating import topk_gating_pallas
+
+        fn = functools.partial(topk_gating_pallas, top_k=top_k,
+                               capacity=128, normalize=True,
+                               interpret=False)
+        lower_tpu(fn, sds(4096, 64, dtype=jnp.float32))
