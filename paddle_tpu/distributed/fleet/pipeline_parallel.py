@@ -373,7 +373,7 @@ class PipelineStack(Layer):
         # program anyway
         fn = jax.jit(shard_map(run, mesh=mesh.jax_mesh, in_specs=in_specs,
                                out_specs=out_specs, check_vma=False))
-        if self.schedule in ("1F1B", "ZB") and v == 1:
+        if self.schedule in ("1F1B", "ZB", "VPP"):
             fn = self._build_1f1b_vjp(fn, in_specs, out_specs)
         self._compiled_cache[x.ndim] = fn
         out = call_op("pipeline_stack", fn, (tuple(param_tensors), x), {})
@@ -382,38 +382,46 @@ class PipelineStack(Layer):
     def _build_1f1b_vjp(self, fwd_fn, in_specs, out_specs):
         """TRUE 1F1B memory: a custom-vjp whose backward is a HAND-
         SCHEDULED lockstep loop interleaving forward recompute with
-        backward, holding at most O(S) stage-boundary activations per
-        device (reference: the 1F1B schedule of
-        fleet/meta_parallel/pipeline_parallel.py:255,575).
+        backward, holding at most O(S*v) stage-boundary activations per
+        device (reference: the 1F1B / interleaved-VPP schedules of
+        fleet/meta_parallel/pipeline_parallel.py:255,575,1179).
 
         Why custom: reverse-mode AD of the tick scan is inherently
         GPipe-ordered — jax saves every tick's carry, so 'remat 1F1B'
         still held O(M) temps in the compiled program (measured: temp
         bytes grew at ~the FThenB slope).  Here the forward saves ONLY
-        (params, x); the backward replays the ring with this schedule:
+        (params, x) and the backward replays the ring.  With
+        G(m) = m//S, i = m%S, the unit chain of microbatch m is chunks
+        j = 0..v-1 each through stages s = 0..S-1:
 
-          forward-recompute of (microbatch m, stage s) at tick  m + s
-          backward          of (m, s)              at tick  m + 2S-1-s
+          forward-recompute of (m, chunk j, stage s)
+              at tick  (G(m)*v + j)*S + i + s
+          backward of (m, j, s)
+              at tick  vS + (G(m)*v + (v-1-j))*S + i + (S-1-s)
 
-        so stage s's recomputed input activation lives 2(S-s)-1 ticks in
-        a depth-2S circular buffer — the classic 1F1B in-flight profile
-        (deeper at early stages), O(S) per device and independent of M.
-        Cotangents ride the reverse ring (ppermute s -> s-1); the last
-        stage injects dy[m], stage 0 emits dx[m].  Param grads accumulate
-        additively across microbatches, so backward order needs no
-        relationship to the forward's.  Cost: one extra forward replay
-        vs the remat path — the standard 1F1B memory/compute trade.
-
-        v == 1 only; interleaved VPP keeps the remat autodiff path.
+        i.e. the backward runs the REVERSED chain with a vS offset, so a
+        recomputed input activation lives at most 2vS-1 ticks in a
+        depth-2vS circular buffer — the in-flight 1F1B window, O(S*v)
+        per device and independent of M.  Cotangents ride the reverse
+        ring (ppermute s -> s-1; the s=0 -> S-1 wrap moves chunk j to
+        j-1, mirroring the forward wrap); the last stage injects dy[m]
+        at chunk v-1, stage 0 emits dx[m] at chunk 0.  Param grads
+        accumulate additively across microbatches, so backward order
+        needs no relationship to the forward's.  Cost: one extra forward
+        replay vs the remat path — the standard 1F1B memory/compute
+        trade.  FThenB keeps plain autodiff (GPipe semantics intended).
         """
         M, S = self.num_microbatches, self.num_stages
+        v = self.num_virtual_stages
         mesh, axis = self._mesh, self._axis
+        n_groups = -(-M // S)
+        GV = n_groups * v
 
         def bwd_run(params, xs, dys):
             r = lax.axis_index(axis)
-            D = 2 * S
+            D = 2 * v * S
             mb_shape = xs.shape[1:]
-            chunk_params = [p[0, 0] for p in params]     # (lps, ...) local
+            local = [p[:, 0] for p in params]       # (v, lps, ...) local
 
             def block_chain(h, chunk):
                 def scan_body(carry, layer_params):
@@ -421,40 +429,65 @@ class PipelineStack(Layer):
                 out, _ = lax.scan(scan_body, h, chunk)
                 return out
 
+            def chunk_at(j):
+                return [lax.dynamic_index_in_dim(p, j, 0, keepdims=False)
+                        for p in local]
+
             fperm = [(i, (i + 1) % S) for i in range(S)]
             bperm = [(i, (i - 1) % S) for i in range(S)]
-            Tb = M + 2 * S - 1
+            delta = v * S
+            # exact tick count: the LAST backward unit is (m=M-1, chunk 0,
+            # stage 0) — group-rounding GV*S here would add up to S-1
+            # fully-masked (but fully-executed) ticks per step
+            Tb = (delta + (((M - 1) // S) * v + v - 1) * S
+                  + (M - 1) % S + S)
 
             buf = jnp.zeros((D,) + mb_shape, xs.dtype)
             fwd_state = jnp.zeros(mb_shape, xs.dtype)
             bwd_state = jnp.zeros(mb_shape, xs.dtype)
             dxs = jnp.zeros((M,) + mb_shape, xs.dtype)
-            gparams = [jnp.zeros_like(c) for c in chunk_params]
+            gparams = [jnp.zeros_like(p) for p in local]
+
+            def unit_of(u):
+                """(G, i) -> (chunk j, microbatch m, in-range)."""
+                G = u // S
+                i = u % S
+                Gc = jnp.clip(G, 0, GV - 1)
+                j = Gc % v
+                m = (Gc // v) * S + i
+                ok = (G >= 0) & (G < GV) & (m < M)
+                return j, m, ok
 
             def step(carry, t):
                 fwd_state, bwd_state, buf, dxs, gparams = carry
-                # ---- forward-recompute unit (m_f, r) at t = m_f + r
-                m_f = t - r
-                f_valid = (m_f >= 0) & (m_f < M)
-                inp = jnp.where(r == 0, xs[jnp.clip(m_f, 0, M - 1)],
+                # ---- forward-recompute unit at t = (G*v+j)*S + i + r
+                j_f, m_f, f_valid = unit_of(t - r)
+                inject = (r == 0) & (j_f == 0)
+                inp = jnp.where(inject, xs[jnp.clip(m_f, 0, M - 1)],
                                 fwd_state)
                 buf = lax.cond(
                     f_valid, lambda b: b.at[t % D].set(inp), lambda b: b,
                     buf)
-                h = block_chain(inp, chunk_params)
+                h = block_chain(inp, chunk_at(j_f))
                 fwd_state = lax.ppermute(h, axis, fperm)
-                # ---- backward unit (m_b, r) at t = m_b + 2S-1-r
-                m_b = t - (2 * S - 1 - r)
-                b_valid = (m_b >= 0) & (m_b < M)
+                # ---- backward unit: reversed chain, offset delta
+                q = t - delta - (S - 1 - r)
+                jr, m_b, b_valid = unit_of(q)
+                j_b = v - 1 - jr                    # reversed chunk order
                 mb_c = jnp.clip(m_b, 0, M - 1)
-                ct_in = jnp.where(r == S - 1, dys[mb_c], bwd_state)
-                a = buf[(mb_c + r) % D]
-                _, vjp_fn = jax.vjp(block_chain, a, chunk_params)
+                ct_in = jnp.where((r == S - 1) & (j_b == v - 1),
+                                  dys[mb_c], bwd_state)
+                # this unit's forward tick, for the buffer index
+                f_tick = ((mb_c // S * v + j_b) * S + mb_c % S + r)
+                a = buf[f_tick % D]
+                chunk_b = chunk_at(j_b)
+                _, vjp_fn = jax.vjp(block_chain, a, chunk_b)
                 da, dchunk = vjp_fn(ct_in.astype(xs.dtype))
-                gparams = [g + jnp.where(b_valid, d, 0)
-                           for g, d in zip(gparams, dchunk)]
+                gparams = [
+                    g.at[j_b].add(jnp.where(b_valid, d, 0))
+                    for g, d in zip(gparams, dchunk)]
                 dxs = lax.cond(
-                    b_valid & (r == 0),
+                    b_valid & (r == 0) & (j_b == 0),
                     lambda o: o.at[mb_c].set(da.astype(o.dtype)),
                     lambda o: o, dxs)
                 bwd_state = lax.ppermute(
@@ -474,9 +507,9 @@ class PipelineStack(Layer):
                 # of the forward inserts automatically for replicated
                 # params; manual backward must match)
                 gparams = [lax.psum(g, self._data_axis) for g in gparams]
-            # local (lps, ...) grads back to the stacked (v=1, S, lps, ...)
-            # layout: each device contributes its stage slice
-            dparams = tuple(g[None, None] for g in gparams)
+            # local (v, lps, ...) grads back to the stacked
+            # (v, S, lps, ...) layout: each device contributes its slice
+            dparams = tuple(g[:, None] for g in gparams)
             return dparams, dxs
 
         bwd_fn = None

@@ -83,10 +83,12 @@ def _round_kernel(logits_ref, fill_in_ref, eidx_ref, pos_ref, keep_ref,
     keep_ref[0] = within.astype(jnp.int32)
     w_ref[0] = gate_val * within.astype(jnp.float32)
     fill_scr[0] = fill + jnp.sum(onehot, axis=0)
-    # per-expert sum of gate probabilities over valid tokens — the l_aux
-    # ingredient, accumulated here so the caller never replays softmax
-    gsum_scr[0] = gsum_scr[0] + jnp.sum(
-        gates * valid.astype(jnp.float32), axis=0)
+    if round_k == 0:
+        # per-expert sum of gate probabilities over valid tokens — the
+        # l_aux ingredient; only round 0's is consumed, so later rounds
+        # skip the accumulation entirely (round_k is trace-static)
+        gsum_scr[0] = gsum_scr[0] + jnp.sum(
+            gates * valid.astype(jnp.float32), axis=0)
 
     @pl.when(t_idx == n_tiles - 1)
     def _flush():
