@@ -306,15 +306,10 @@ class PipelineStack(Layer):
                 out, _ = lax.scan(body, h, chunk_params)
                 return out
 
-            if self.schedule in ("1F1B", "ZB", "VPP"):
-                # per-unit remat: backward re-runs each stage pass from the
-                # stage-boundary activation.  NOTE: for v == 1 the 1F1B/ZB
-                # schedules do not reach this path when differentiated —
-                # the custom-vjp manual backward below owns it; this remat
-                # covers VPP's autodiff, whose saved scan carries remain
-                # O(M) (see _build_1f1b_vjp for why plain reverse-AD of
-                # the tick scan cannot do better).
-                stage_block = jax.checkpoint(stage_block)
+            # 1F1B/ZB/VPP are never differentiated through this loop —
+            # _build_1f1b_vjp's manual backward owns their gradients —
+            # so no per-unit remat wrap here; FThenB's autodiff is the
+            # intended GPipe (store-everything) policy.
 
             mb_shape = xs.shape[1:]
             state = jnp.zeros(mb_shape, xs.dtype)

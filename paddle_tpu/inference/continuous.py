@@ -27,12 +27,7 @@ import time
 from typing import List, Optional
 
 import numpy as np
-import jax.numpy as jnp
-
-from ..framework.tape import no_grad
-from ..framework.tensor import wrap_array
 from ..ops.pallas.paged_attention import PagedKVCache
-from .paged import _PagedContext
 
 __all__ = ["ContinuousBatchingEngine"]
 
@@ -183,16 +178,11 @@ class ContinuousBatchingEngine:
         return admitted
 
     def _prefill(self, req):
-        with no_grad():
-            self.cache.allocate(req.seq_id, len(req.prompt))
-            ctx = _PagedContext(self.cache, [req.seq_id], prefill=True)
-            hidden = self.model.model(
-                wrap_array(jnp.asarray(req.prompt[None])), 0,
-                paged_ctx=ctx)
-            logits = self.model._logits_of(hidden[:, -1:])
-        req.next_token = self._pick(req,
-                                    np.asarray(logits._data[0, -1],
-                                               np.float32))
+        # bucketed compiled prefill: one compile per power-of-two prompt
+        # length, not one per distinct length
+        logits = self._decoder.prefill(self.cache, [req.seq_id],
+                                       req.prompt[None], bucket=True)
+        req.next_token = self._pick(req, logits[0])
         req.first_token_at = time.perf_counter()
 
     def _pick(self, req, logits_row) -> int:

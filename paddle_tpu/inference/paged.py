@@ -29,10 +29,10 @@ from ..ops.pallas.paged_attention import PagedKVCache, paged_attention
 class _PagedContext:
     """Per-forward attention driver handed down to attention layers.
 
-    The prefill path is live in production; the ``prefill=False`` decode
-    branch is the EAGER ORACLE the jitted decode step
-    (JittedPagedDecoder/_TracedPagedContext) is equivalence-tested
-    against — keep the two write/lens protocols in sync
+    BOTH branches are the EAGER ORACLE the jitted steps
+    (JittedPagedDecoder/_TracedPagedContext) are equivalence-tested
+    against — production prefill AND decode run through the compiled
+    paths; keep the write/lens protocols in sync
     (tests/test_paged_attention.py eager-vs-jitted parity)."""
 
     def __init__(self, cache: PagedKVCache, seq_ids: Sequence[int],
@@ -67,25 +67,44 @@ class _PagedContext:
 
 
 class _TracedPagedContext:
-    """Paged-attention driver for the JITTED decode step: page pools,
-    (page, slot) write targets, lengths and tables are all TRACED values
-    carried through one compiled program — no host bookkeeping inside.
-    Scatters are functional updates on the carried pools (donated at the
-    jit boundary, so XLA writes in place)."""
+    """Paged-attention driver for the JITTED decode/prefill steps: page
+    pools, (page, slot) write targets, lengths and tables are all TRACED
+    values carried through one compiled program — no host bookkeeping
+    inside.  Scatters are functional updates on the carried pools
+    (donated at the jit boundary, so XLA writes in place).
 
-    def __init__(self, k_pages, v_pages, pg, sl, lens, tables):
+    Prefill mode: ``pg``/``sl`` are (batch*seq,) flat targets — pad
+    positions carry an out-of-bounds page index, which jax scatter DROPS
+    (mode 'drop' is the .at[] default), so a right-padded bucketed
+    prompt never writes garbage KV; attention is dense causal flash over
+    the padded batch (pads sit to the RIGHT of every real token, so
+    causality keeps them out of real tokens' windows)."""
+
+    def __init__(self, k_pages, v_pages, pg, sl, lens=None, tables=None,
+                 prefill=False):
         self.k_pages = list(k_pages)
         self.v_pages = list(v_pages)
-        self.pg = pg                    # (batch,) int32 — one token/seq
+        self.pg = pg
         self.sl = sl
-        self.lens = lens                # POST-write lengths
+        self.lens = lens                # POST-write lengths (decode)
         self.tables = tables
-        self.prefill = False
+        self.prefill = prefill
         self.layer_idx = 0
 
     def attend(self, q, k, v):
         layer = self.layer_idx
         kp, vp = self.k_pages[layer], self.v_pages[layer]
+        if self.prefill:
+            b, s = k.shape[0], k.shape[1]
+            kvh, d = k.shape[2], k.shape[3]
+            ks = jnp.swapaxes(k._data.reshape(b * s, kvh, d), 0, 1)
+            vs = jnp.swapaxes(v._data.reshape(b * s, kvh, d), 0, 1)
+            kp = kp.at[:, self.pg, self.sl].set(ks.astype(kp.dtype))
+            vp = vp.at[:, self.pg, self.sl].set(vs.astype(vp.dtype))
+            self.k_pages[layer], self.v_pages[layer] = kp, vp
+            from ..nn import functional as F
+            out, _ = F.flash_attention(q, k, v, causal=True)
+            return out
         ks = jnp.swapaxes(k._data[:, 0], 0, 1)      # (kvh, batch, d)
         vs = jnp.swapaxes(v._data[:, 0], 0, 1)
         kp = kp.at[:, self.pg, self.sl].set(ks.astype(kp.dtype))
@@ -131,6 +150,83 @@ class JittedPagedDecoder:
 
         import jax
         self._jitted = jax.jit(fn, donate_argnums=(7, 8))
+
+        def prefill_fn(param_arrays, ids, last_idx, pg, sl,
+                       k_pages, v_pages):
+            saved = [p._data for p in self.params]
+            try:
+                for p, a in zip(self.params, param_arrays):
+                    p._data = a
+                ctx = _TracedPagedContext(k_pages, v_pages, pg, sl,
+                                          prefill=True)
+                with no_grad():
+                    hidden = model.model(wrap_array(ids), 0,
+                                         paged_ctx=ctx)
+                    # per-row last REAL position (bucketed prompts are
+                    # right-padded past it)
+                    b = hidden.shape[0]
+                    last = hidden._data[jnp.arange(b),
+                                        last_idx.astype(jnp.int32)]
+                    logits = model._logits_of(wrap_array(last[:, None]))
+                return (logits._data[:, -1].astype(jnp.float32),
+                        tuple(ctx.k_pages), tuple(ctx.v_pages))
+            finally:
+                for p, s in zip(self.params, saved):
+                    p._data = s
+
+        self._jitted_prefill = jax.jit(prefill_fn, donate_argnums=(5, 6))
+
+    def prefill(self, cache: PagedKVCache, seq_ids, ids_np,
+                bucket: bool = False) -> np.ndarray:
+        """Prompt pass as ONE compiled program: embed + all layers
+        (dense causal flash + paged KV writes) + last-token logits.
+
+        ids_np (batch, s) int32, all rows the same real length s.  With
+        ``bucket=True`` the sequence pads right to a power of two so the
+        engine's per-request prefills compile once per bucket, not once
+        per prompt length; pad positions scatter to an out-of-bounds
+        page (dropped) and sit after every real token (causal-masked).
+        Returns last-real-token logits (batch, vocab) float32."""
+        b, s = ids_np.shape
+        if s + 0 > self.max_position:
+            raise ValueError(
+                f"prompt length {s} exceeds max_position_embeddings "
+                f"({self.max_position})")
+        for sid in seq_ids:
+            cache.allocate(sid, s)
+        pg, sl = cache.plan_write(seq_ids, s)
+        cache.advance(seq_ids, s)
+        s_b = s
+        if bucket:
+            s_b = 1
+            while s_b < s:
+                s_b *= 2
+            # never pad past the rope table: a 600-token prompt on a
+            # 1000-position model must bucket to 1000, not 1024
+            s_b = min(s_b, self.max_position)
+        if s_b != s:
+            pad = s_b - s
+            ids_np = np.pad(ids_np, ((0, 0), (0, pad)))
+            pg = np.concatenate(
+                [pg.reshape(b, s),
+                 np.full((b, pad), cache.total_pages, np.int32)],
+                axis=1).reshape(-1)
+            sl = np.concatenate(
+                [sl.reshape(b, s), np.zeros((b, pad), np.int32)],
+                axis=1).reshape(-1)
+        last_idx = np.full(b, s - 1, np.int32)
+        try:
+            logits, k_pages, v_pages = self._jitted_prefill(
+                [p._data for p in self.params],
+                jnp.asarray(ids_np.astype(np.int32)),
+                jnp.asarray(last_idx), jnp.asarray(pg), jnp.asarray(sl),
+                tuple(cache.k_pages), tuple(cache.v_pages))
+        except BaseException:
+            cache.reset_pools()
+            raise
+        cache.k_pages = list(k_pages)
+        cache.v_pages = list(v_pages)
+        return np.asarray(logits)
 
     def step(self, cache: PagedKVCache, seq_ids, tokens_np,
              positions_np) -> np.ndarray:
@@ -232,23 +328,17 @@ class PagedGenerator:
         import time as _time
 
         b, s = ids.shape
-        model = self.model
         with no_grad():
             t0 = _time.perf_counter()
-            for sid in seq_ids:
-                self.cache.allocate(sid, s)
-            ctx = _PagedContext(self.cache, seq_ids, prefill=True)
-            hidden = model.model(wrap_array(jnp.asarray(ids)),
-                                 0, paged_ctx=ctx)
-            logits = model._logits_of(hidden[:, -1:])
-            jnp.asarray(logits._data).block_until_ready()
+            # ONE compiled prefill program (keyed by prompt length)
+            step = self._decoder.prefill(self.cache, seq_ids,
+                                         ids.astype(np.int32))
             self.last_prefill_seconds = _time.perf_counter() - t0
             t0 = _time.perf_counter()
 
             out = [ids]
             finished = np.zeros(b, bool)
             pos = s
-            step = np.asarray(logits._data[:, -1].astype(jnp.float32))
             for _ in range(max_new_tokens):
                 nxt = np.array([
                     sample_token(row, do_sample, temperature, rng)

@@ -116,6 +116,26 @@ class TestEngine:
             with pytest.raises(ValueError, match="max_position"):
                 eng.submit(np.zeros(40, np.int32), max_new_tokens=100)
 
+    def test_prefill_bucket_capped_at_rope_table(self):
+        """A prompt whose power-of-two bucket exceeds a non-power-of-two
+        max_position_embeddings must still prefill (bucket capped at the
+        rope table) and match the reference generate."""
+        from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+
+        paddle.seed(2)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=1,
+                          num_attention_heads=2, num_key_value_heads=2,
+                          max_position_embeddings=24)   # not a power of 2
+        m = LlamaForCausalLM(cfg)
+        p = np.random.default_rng(6).integers(0, 64, (17,)).astype("int32")
+        want = m.generate(paddle.to_tensor(p[None]), max_new_tokens=5)
+        want = np.asarray(want.numpy() if hasattr(want, "numpy") else want)
+        with ContinuousBatchingEngine(m, total_pages=32, page_size=8,
+                                      max_batch=2) as eng:
+            got = eng.submit(p, max_new_tokens=5).result(timeout=120)
+        np.testing.assert_array_equal(got, want[0])
+
     def test_sampled_rows_reproducible_by_seed(self, model):
         from paddle_tpu.inference.continuous import ContinuousBatchingEngine
 
