@@ -88,4 +88,27 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
                       dispatch_mode)
 
 
-__all__ = ["fused_moe"]
+@def_op("fused_linear_cross_entropy")
+def _fused_linear_ce_op(hidden, weight, bias, labels, ignore_index,
+                        chunk_rows):
+    from ....nn.functional.fused_loss import fused_linear_cross_entropy_raw
+    return fused_linear_cross_entropy_raw(
+        hidden, weight, labels, bias=bias, ignore_index=ignore_index,
+        chunk_rows=chunk_rows)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, bias=None,
+                               ignore_index=-100, chunk_rows=1024,
+                               name=None):
+    """Chunked LM-head loss: mean CE of ``hidden @ weight (+bias)`` vs
+    ``labels`` without ever materializing the [tokens, vocab] logits
+    (nn/functional/fused_loss.py — lax.scan over row chunks, recompute-
+    in-backward custom VJP).  The single-chip analog of the reference's
+    fused CE region (paddle/phi/kernels/fusion/ softmax/CE family; the
+    vocab-parallel variant c_softmax_with_cross_entropy_op.cu is mapped
+    separately in distributed/fleet/mp_layers.py)."""
+    return _fused_linear_ce_op(hidden, weight, bias, labels,
+                               int(ignore_index), int(chunk_rows))
+
+
+__all__ = ["fused_moe", "fused_linear_cross_entropy"]

@@ -197,10 +197,8 @@ class ContinuousBatchingEngine:
         req.done.set()
 
     def _bucket(self, n: int) -> int:
-        b = 1
-        while b < n:
-            b *= 2
-        return min(b, self.max_batch)
+        from .paged import next_pow2
+        return min(next_pow2(n), self.max_batch)
 
     def _decode_step(self):
         """One token for every active sequence, padded to a bucket."""

@@ -26,6 +26,17 @@ from ..framework.tape import no_grad
 from ..ops.pallas.paged_attention import PagedKVCache, paged_attention
 
 
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n — the shared bucketing rule for prefill
+    length, decode page-table width, and the continuous-batching engine's
+    running-batch size (all three must stay in sync: each bucket is one
+    compiled program)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
 class _PagedContext:
     """Per-forward attention driver handed down to attention layers.
 
@@ -188,7 +199,7 @@ class JittedPagedDecoder:
         page (dropped) and sit after every real token (causal-masked).
         Returns last-real-token logits (batch, vocab) float32."""
         b, s = ids_np.shape
-        if s + 0 > self.max_position:
+        if s > self.max_position:
             raise ValueError(
                 f"prompt length {s} exceeds max_position_embeddings "
                 f"({self.max_position})")
@@ -198,12 +209,9 @@ class JittedPagedDecoder:
         cache.advance(seq_ids, s)
         s_b = s
         if bucket:
-            s_b = 1
-            while s_b < s:
-                s_b *= 2
             # never pad past the rope table: a 600-token prompt on a
             # 1000-position model must bucket to 1000, not 1024
-            s_b = min(s_b, self.max_position)
+            s_b = min(next_pow2(s), self.max_position)
         if s_b != s:
             pad = s_b - s
             ids_np = np.pad(ids_np, ((0, 0), (0, pad)))
@@ -247,10 +255,7 @@ class JittedPagedDecoder:
         # would change shape every time the longest sequence crosses a
         # page boundary, recompiling the whole decode program mid-serving
         needed = max(len(cache._seq_pages.get(s, ())) for s in seq_ids)
-        mp = 1
-        while mp < needed:
-            mp *= 2
-        tabs, lens = cache.page_table(seq_ids, max_pages=mp)
+        tabs, lens = cache.page_table(seq_ids, max_pages=next_pow2(needed))
         try:
             logits, k_pages, v_pages = self._jitted(
                 [p._data for p in self.params],

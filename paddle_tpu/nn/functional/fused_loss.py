@@ -1,0 +1,136 @@
+"""Chunked fused linear + softmax cross-entropy.
+
+The LM-head loss is the largest single activation in decoder pretraining:
+``[batch*seq, vocab]`` logits in f32 (the bench headline config: 8*1024 x
+32000 = 1.05 GB) written to HBM in the forward and read back (plus the
+same-size softmax gradient) in the backward.  On TPU the matmul FLOPs are
+cheap next to that HBM traffic.  This op never materializes the full
+logits: a ``lax.scan`` over row chunks computes each chunk's logits in
+VMEM-sized pieces, reduces them to the scalar loss, and the custom VJP
+recomputes each chunk's logits in the backward (one extra ``N*H*V``
+matmul — the classic remat trade, same recipe as jax.checkpoint but
+specialized so that dW accumulates across chunks in f32).
+
+Reference parity: the reference fuses this region too, on the same
+motivation — paddle/phi/kernels/fusion/ (fused softmax/CE family) and the
+mp variant c_softmax_with_cross_entropy_op.cu (vocab-sharded CE, mapped
+in distributed/fleet/mp_layers.py).  This file is the single-chip fusion.
+
+Numerics contract: identical math to ``F.cross_entropy(hidden @ W + b,
+labels)`` with reduction='mean' over non-ignored rows, computed in f32
+regardless of input dtype (the unfused path casts logits to f32 the same
+way in the bench loss).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _chunk_logits(h_chunk, weight, bias):
+    """[c, H] @ [H, V] -> [c, V] in f32 on the MXU."""
+    logits = jnp.dot(h_chunk, weight, preferred_element_type=jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    return logits
+
+
+def _fwd_scan(hidden, weight, bias, labels, valid, chunk_rows):
+    n_pad = hidden.shape[0]
+    n_chunks = n_pad // chunk_rows
+    h_c = hidden.reshape(n_chunks, chunk_rows, hidden.shape[1])
+    l_c = labels.reshape(n_chunks, chunk_rows)
+    v_c = valid.reshape(n_chunks, chunk_rows)
+
+    def body(acc, inp):
+        h, lab, val = inp
+        logits = _chunk_logits(h, weight, bias)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            logits, lab[:, None].astype(jnp.int32), axis=1)[:, 0]
+        loss = jnp.where(val, lse - picked, 0.0)
+        return acc + jnp.sum(loss), None
+
+    total, _ = lax.scan(body, jnp.float32(0.0), (h_c, l_c, v_c))
+    return total
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused_linear_ce(hidden, weight, bias, labels, ignore_index,
+                     chunk_rows):
+    loss, _ = _fused_linear_ce_fwd(hidden, weight, bias, labels,
+                                   ignore_index, chunk_rows)
+    return loss
+
+
+def _pad_rows(x, chunk_rows, fill=0):
+    n = x.shape[0]
+    pad = (-n) % chunk_rows
+    if pad:
+        width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        x = jnp.pad(x, width, constant_values=fill)
+    return x
+
+
+def _fused_linear_ce_fwd(hidden, weight, bias, labels, ignore_index,
+                         chunk_rows):
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0)
+    h_p = _pad_rows(hidden, chunk_rows)
+    l_p = _pad_rows(safe, chunk_rows)
+    v_p = _pad_rows(valid, chunk_rows, fill=False)
+    total = _fwd_scan(h_p, weight, bias, l_p, v_p, chunk_rows)
+    n_valid = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    loss = total / n_valid
+    return loss, (hidden, weight, bias, safe, valid, n_valid)
+
+
+def _fused_linear_ce_bwd(ignore_index, chunk_rows, res, g):
+    hidden, weight, bias, safe, valid, n_valid = res
+    n, h_dim = hidden.shape
+    h_p = _pad_rows(hidden, chunk_rows)
+    l_p = _pad_rows(safe, chunk_rows)
+    v_p = _pad_rows(valid, chunk_rows, fill=False)
+    n_pad = h_p.shape[0]
+    n_chunks = n_pad // chunk_rows
+    h_c = h_p.reshape(n_chunks, chunk_rows, h_dim)
+    l_c = l_p.reshape(n_chunks, chunk_rows)
+    v_c = v_p.reshape(n_chunks, chunk_rows)
+    scale = g / n_valid                       # d(mean-loss)/d(row-loss)
+    vocab = weight.shape[1]
+
+    def body(dw_acc, inp):
+        h, lab, val = inp
+        logits = _chunk_logits(h, weight, bias)
+        p = jax.nn.softmax(logits, axis=-1)
+        delta = p - jax.nn.one_hot(lab, vocab, dtype=p.dtype)
+        delta = delta * (val.astype(p.dtype) * scale)[:, None]
+        dh = jnp.dot(delta, weight.astype(jnp.float32).T)
+        dw_acc = dw_acc + jnp.dot(h.astype(jnp.float32).T, delta)
+        return dw_acc, (dh, jnp.sum(delta, axis=0))
+
+    dw0 = jnp.zeros((h_dim, vocab), jnp.float32)
+    dw, (dh_c, db_c) = lax.scan(body, dw0, (h_c, l_c, v_c))
+    dh = dh_c.reshape(n_pad, h_dim)[:n].astype(hidden.dtype)
+    dw = dw.astype(weight.dtype)
+    db = jnp.sum(db_c, axis=0).astype(bias.dtype) \
+        if bias is not None else None
+    return dh, dw, db, None
+
+
+_fused_linear_ce.defvjp(_fused_linear_ce_fwd, _fused_linear_ce_bwd)
+
+
+def fused_linear_cross_entropy_raw(hidden, weight, labels, bias=None,
+                                   ignore_index=-100, chunk_rows=1024):
+    """Mean CE of ``hidden @ weight (+ bias)`` against ``labels`` without
+    materializing logits.  hidden: [..., H] (leading dims flattened),
+    weight: [H, V], labels: [...] int.  Returns a f32 scalar."""
+    h2 = hidden.reshape(-1, hidden.shape[-1])
+    l1 = labels.reshape(-1)
+    chunk_rows = min(chunk_rows, max(h2.shape[0], 1))
+    return _fused_linear_ce(h2, weight, bias, l1, int(ignore_index),
+                            int(chunk_rows))
