@@ -105,16 +105,61 @@ def _sync(loss):
     return v
 
 
-def bench_llama(on_tpu):
-    """Config 5 analog (single-chip): LLaMA decoder pretrain step."""
-    import jax.numpy as jnp
+def build_llama_train_step(cfg, bf16, use_fused):
+    """One LLaMA pretrain TrainStep — THE definition both the headline
+    bench and tools/fused_ce_ab.py run, so the A/B that picks the loss
+    path measures exactly the computation the headline switches to.
 
+    use_fused=True routes the loss through the chunked fused linear+CE
+    (incubate.nn.functional.fused_linear_cross_entropy, logits never
+    materialized); False is the classic f32-logits cross_entropy."""
+    import jax.numpy as jnp
     import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
     import paddle_tpu.optimizer as optim
     from paddle_tpu.jit import TrainStep
-    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    model = LlamaForCausalLM(cfg)
+    if bf16:    # bf16 params + f32 master weights in the fused optimizer
+        for p in model.parameters():
+            if p._data.dtype == jnp.float32:
+                p._data = p._data.astype(jnp.bfloat16)
+    opt = optim.AdamW(learning_rate=1e-3, parameters=model.parameters(),
+                      multi_precision=bf16)
+
+    if use_fused:
+        from paddle_tpu.incubate.nn.functional import (
+            fused_linear_cross_entropy)
+
+        class _HiddenLM(nn.Layer):
+            def __init__(self, lm):
+                super().__init__()
+                self.lm = lm
+
+            def forward(self, input_ids):
+                return self.lm.model(input_ids)
+
+        def loss_fn(hidden, labels):
+            return fused_linear_cross_entropy(
+                hidden.reshape([-1, cfg.hidden_size]),
+                model.lm_head.weight, labels.reshape([-1]),
+                chunk_rows=1024)
+
+        return TrainStep(_HiddenLM(model), loss_fn, opt), model
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]).astype("float32"),
+            labels.reshape([-1]))
+
+    return TrainStep(model, loss_fn, opt), model
+
+
+def bench_llama(on_tpu):
+    """Config 5 analog (single-chip): LLaMA decoder pretrain step."""
+    from paddle_tpu.models.llama import LlamaConfig
     import paddle_tpu as paddle
-    import paddle_tpu.nn.functional as F
 
     if on_tpu:
         cfg = LlamaConfig(
@@ -129,21 +174,24 @@ def bench_llama(on_tpu):
             max_position_embeddings=256)
         batch, seq, steps = 2, 128, 3
 
-    model = LlamaForCausalLM(cfg)
-    if on_tpu:   # bf16 params + f32 master weights in the fused optimizer
-        for p in model.parameters():
-            if p._data.dtype == jnp.float32:
-                p._data = p._data.astype(jnp.bfloat16)
+    # Loss-path selection is MEASURED, never assumed (autotune policy,
+    # SURVEY #86): tools/fused_ce_ab.py A/Bs the chunked fused linear+CE
+    # against the unfused logits path on the real chip at this exact
+    # config (via the SAME build_llama_train_step); the headline follows
+    # the recorded winner.
+    use_fused = False
+    if on_tpu:
+        try:
+            import os
+            ab = json.load(open(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "fused_ce_ab.json")))
+            use_fused = ab.get("fused_speedup", 0.0) > 1.02
+        except Exception:   # noqa: BLE001 — no A/B artifact: unfused
+            pass
 
-    opt = optim.AdamW(learning_rate=1e-3, parameters=model.parameters(),
-                      multi_precision=on_tpu)
-
-    def loss_fn(logits, labels):
-        return F.cross_entropy(
-            logits.reshape([-1, cfg.vocab_size]).astype("float32"),
-            labels.reshape([-1]))
-
-    step = TrainStep(model, loss_fn, opt)
+    step, _model = build_llama_train_step(cfg, bf16=on_tpu,
+                                          use_fused=use_fused)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype("int32")
     x = paddle.to_tensor(ids[:, :-1])
@@ -156,7 +204,9 @@ def bench_llama(on_tpu):
         "value": round(tok_s, 1), "unit": "tokens/sec",
         "vs_baseline": round(tok_s / R01_LLAMA_TOKENS_PER_SEC, 3)
         if on_tpu else 0.0,
-        "path": "jit.TrainStep + optimizer.AdamW(multi_precision) + bf16",
+        "path": "jit.TrainStep + optimizer.AdamW(multi_precision) + bf16"
+                + (" + fused_linear_cross_entropy (A/B winner)"
+                   if use_fused else ""),
         **_mfu_fields(step, x, y, tok_s, units, on_tpu, "bf16"),
     }
 

@@ -22,8 +22,10 @@ from .server import PSServer, run_server  # noqa: F401
 from .client import PSClient  # noqa: F401
 from .geo import GeoSparseWorker  # noqa: F401
 from .embedding import DistributedEmbedding  # noqa: F401
+from .heter import DeviceEmbeddingCache, HeterEmbedding  # noqa: F401
 
 __all__ = ["MemorySparseTable", "SSDSparseTable", "MemoryDenseTable",
            "PSServer", "run_server", "PSClient", "GeoSparseWorker",
            "DistributedEmbedding", "Entry", "CountFilterEntry",
-           "ProbabilityEntry", "ShowClickEntry"]
+           "ProbabilityEntry", "ShowClickEntry", "DeviceEmbeddingCache",
+           "HeterEmbedding"]

@@ -1,0 +1,171 @@
+"""HeterPS analog: HBM-resident hot-row embedding cache over the PS.
+
+The reference's Heter/GPU parameter server (reference:
+paddle/fluid/framework/fleet/heter_ps/ps_gpu_wrapper.cc — build_gpu_task
+pulls a pass's keys from the CPU/SSD tables into GPU hash tables, the
+minibatch loop trains against HBM rows with an on-GPU optimizer, and
+end_pass flushes the updated rows back) exists because per-step host
+round-trips dominate sparse training.  The TPU-native mapping:
+
+  * ``DeviceEmbeddingCache`` — a fixed-capacity ``[C, dim]`` jax array in
+    HBM + a host-side id->slot map with LRU eviction.  Misses batch-pull
+    from the PS and enter the cache in ONE scatter; lookups are a device
+    gather; gradient application is ONE scatter-add SGD update on device.
+  * Flush-back is *delta-additive*: the device trains rows locally and
+    ships ``row_now - row_at_admission`` to a ``optimizer='sum'`` server
+    table (the same additive fold the geo-async path uses, geo.py), so
+    multiple workers' cached training composes on the server instead of
+    last-writer-wins.
+  * ``end_pass()`` == the reference's end_pass: flush every dirty row.
+
+The cache optimizer is SGD (duplicate ids in one batch accumulate
+exactly like MemorySparseTable's sequential ``row -= lr*g`` loop since
+scatter-add sums duplicate indices).  Server-side adagrad/ctr accessors
+stay available on the *uncached* DistributedEmbedding path.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, to_tensor, wrap_array
+from ...nn.layer.layers import Layer
+from .client import PSClient
+
+
+class DeviceEmbeddingCache:
+    def __init__(self, client: PSClient, table_name: str, dim: int,
+                 capacity: int = 4096, learning_rate: float = 0.05):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.client = client
+        self.table_name = table_name
+        self.dim = int(dim)
+        self.capacity = int(capacity)
+        self.learning_rate = float(learning_rate)
+        self.buf = jnp.zeros((self.capacity, self.dim), jnp.float32)
+        # admission-time server values, host-side: flush ships buf - base
+        self._base = np.zeros((self.capacity, self.dim), np.float32)
+        self._slot_of: "OrderedDict[int, int]" = OrderedDict()  # LRU order
+        self._dirty: set = set()
+        self._free: List[int] = list(range(self.capacity))
+        self.hits = 0
+        self.misses = 0
+
+    # ------------------------------------------------------------- admit
+    def _ensure(self, ids_np: np.ndarray) -> np.ndarray:
+        """Admit every id (batch-pulling misses), return their slots.
+        The batch must fit: len(unique ids) <= capacity."""
+        uniq = list(dict.fromkeys(int(i) for i in ids_np))
+        missing = [i for i in uniq if i not in self._slot_of]
+        if len(missing) > len(self._free):
+            need = len(missing) - len(self._free)
+            in_batch = set(uniq)
+            victims = [k for k in self._slot_of if k not in in_batch]
+            if len(victims) < need:
+                raise RuntimeError(
+                    f"DeviceEmbeddingCache capacity {self.capacity} is "
+                    f"smaller than one batch's {len(uniq)} unique ids")
+            self._evict(victims[:need])
+        if missing:
+            self.misses += len(missing)
+            rows = self.client.pull_sparse(
+                self.table_name, np.asarray(missing, np.int64))
+            slots = [self._free.pop() for _ in missing]
+            for k, s in zip(missing, slots):
+                self._slot_of[k] = s
+            self._base[slots] = rows
+            self.buf = self.buf.at[jnp.asarray(slots)].set(
+                jnp.asarray(rows))
+        self.hits += len(uniq) - len(missing)
+        for k in uniq:                      # refresh LRU recency
+            self._slot_of.move_to_end(k)
+        return np.asarray([self._slot_of[int(i)] for i in ids_np],
+                          np.int32)
+
+    def _evict(self, keys: List[int]) -> None:
+        self._flush_keys([k for k in keys if k in self._dirty])
+        for k in keys:
+            s = self._slot_of.pop(k)
+            self._free.append(s)
+            self._dirty.discard(k)
+
+    # ------------------------------------------------------------ lookup
+    def lookup(self, ids_np: np.ndarray):
+        """[n, dim] device rows for ``ids`` (gather from the HBM cache)."""
+        slots = self._ensure(np.asarray(ids_np, np.int64))
+        return jnp.take(self.buf, jnp.asarray(slots), axis=0), slots
+
+    # ------------------------------------------------------------- train
+    def apply_grads(self, ids_np: np.ndarray, grads,
+                    learning_rate: float | None = None) -> None:
+        """One scatter-add SGD step on device; rows become dirty.
+
+        Re-admits ids evicted since their lookup (the autograd pattern
+        runs several forwards before backward fires the hooks; eviction
+        flushed those rows' deltas, so the server value the re-admission
+        pulls is exactly the state this grad should apply on top of)."""
+        lr = self.learning_rate if learning_rate is None else learning_rate
+        ids_np = np.asarray(ids_np, np.int64)
+        slots = self._ensure(ids_np)
+        g = grads if isinstance(grads, jnp.ndarray) else jnp.asarray(
+            np.asarray(grads, np.float32))
+        self.buf = self.buf.at[jnp.asarray(slots)].add(
+            -lr * g.astype(jnp.float32))
+        self._dirty.update(int(i) for i in ids_np)
+
+    # ------------------------------------------------------------- flush
+    def _flush_keys(self, keys: List[int]) -> None:
+        if not keys:
+            return
+        slots = np.asarray([self._slot_of[k] for k in keys], np.int32)
+        now = np.asarray(self.buf[jnp.asarray(slots)])
+        delta = now - self._base[slots]
+        self.client.push_sparse(self.table_name,
+                                np.asarray(keys, np.int64), delta)
+        self._base[slots] = now          # flushed: new admission baseline
+
+    def end_pass(self) -> None:
+        """Flush every dirty row back to the servers (reference:
+        ps_gpu_wrapper end_pass)."""
+        self._flush_keys(sorted(self._dirty))
+        self._dirty.clear()
+
+    flush = end_pass
+
+
+class HeterEmbedding(Layer):
+    """DistributedEmbedding with the HeterPS hot cache: forward is a
+    device gather, backward applies SGD on device, server sees additive
+    deltas at ``end_pass()``/eviction.  The PS table is created with
+    ``optimizer='sum'`` — the cache owns the optimizer math."""
+
+    def __init__(self, client: PSClient, table_name: str,
+                 embedding_dim: int, capacity: int = 4096,
+                 learning_rate: float = 0.05, **table_kwargs):
+        super().__init__()
+        table_kwargs["optimizer"] = "sum"
+        client.create_table(table_name, embedding_dim, **table_kwargs)
+        self.cache = DeviceEmbeddingCache(client, table_name,
+                                          embedding_dim, capacity,
+                                          learning_rate)
+
+    def forward(self, ids) -> Tensor:
+        ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids,
+                            np.int64)
+        rows_dev, _ = self.cache.lookup(ids_np)
+        rows = wrap_array(rows_dev)
+        rows.stop_gradient = False
+
+        def _apply(grad: Tensor):
+            self.cache.apply_grads(ids_np, grad._data)
+            return grad
+
+        rows.register_hook(_apply)
+        return rows
+
+    def end_pass(self) -> None:
+        self.cache.end_pass()

@@ -68,6 +68,25 @@ def set_amp_cast_hook(hook: Optional[Callable]) -> None:
     _amp_cast_hook = hook
 
 
+# Static Program recorder (static/program.py): while a program_guard is
+# active every op ON THE GUARDING THREAD records into the Program instead
+# of executing — the reference's Program-build mode (python/paddle/
+# static/).  THREAD-LOCAL to match program_guard's thread-local stack:
+# background threads doing eager work (e.g. the continuous-batching
+# decode thread) must never record into another thread's Program.
+import threading as _threading
+
+_static_tls = _threading.local()
+
+
+def set_static_recorder(rec: Optional[Callable]) -> None:
+    _static_tls.rec = rec
+
+
+def _get_static_recorder() -> Optional[Callable]:
+    return getattr(_static_tls, "rec", None)
+
+
 # Post-op observer hooks (numerical sanitizers, operator-stats collectors —
 # SURVEY §5 "race/numerical sanitizers"; reference: the check_nan_inf plumbing
 # of paddle/fluid/framework/details/nan_inf_utils_detail.cc and the low-
@@ -119,6 +138,9 @@ def call_op(name: str, fn: Callable, args: tuple, kwargs: dict):
 
 
 def _call_op_impl(name: str, fn: Callable, args: tuple, kwargs: dict):
+    _rec = _get_static_recorder()
+    if _rec is not None:
+        return _rec(name, fn, args, kwargs)
     if _amp_cast_hook is not None:
         args, kwargs = _amp_cast_hook(name, args, kwargs)
 

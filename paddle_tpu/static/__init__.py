@@ -19,29 +19,46 @@ def name_scope(prefix=None):
     yield
 
 
-@contextlib.contextmanager
+from .program import (  # noqa: E402
+    Program, Variable, Executor, _ProgramGuard, current_program,
+)
+
+# module-level defaults, created lazily (reference: the global default
+# main/startup programs of python/paddle/static/)
+_default_main: Program | None = None
+_default_startup: Program | None = None
+
+
+def default_main_program() -> Program:
+    global _default_main
+    if _default_main is None:
+        _default_main = Program()
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    global _default_startup
+    if _default_startup is None:
+        _default_startup = Program()
+    return _default_startup
+
+
 def program_guard(main_program=None, startup_program=None):
-    raise NotImplementedError(
-        "paddle_tpu has no static Program builder; XLA compilation replaces "
-        "it — use paddle_tpu.jit.to_static (see SURVEY §7).")
-    yield
-
-
-class Program:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "static Program is replaced by jit.to_static/XLA on TPU")
-
-
-def default_main_program():
-    raise NotImplementedError("no static Program stack; use jit.to_static")
-
-
-def default_startup_program():
-    raise NotImplementedError("no static Program stack; use jit.to_static")
+    """Record ops called inside the guard into ``main_program``
+    (reference: static.program_guard).  Parameter creation stays eager —
+    running the startup program is therefore a no-op by construction."""
+    return _ProgramGuard(main_program or default_main_program(),
+                         startup_program)
 
 
 def data(name, shape, dtype="float32", lod_level=0):
+    """Feed declaration.  Under a ``program_guard``: a symbolic feed
+    Variable of the active Program (reference: static.data).  Outside:
+    an InputSpec for the jit.to_static path."""
+    prog = current_program()
+    if prog is not None:
+        shape = [-1 if s is None else s for s in shape]
+        return prog.add_feed(name, shape, dtype)
     return InputSpec(shape, dtype, name)
 
 
@@ -232,30 +249,17 @@ def normalize_program(program, feed_vars, fetch_vars, **kwargs):
     raise NotImplementedError("no Program IR on this stack")
 
 
-class Variable:
-    """reference: static.Variable — eager Tensors play this role."""
-
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "static Variable is replaced by the eager Tensor")
-
-
-class Executor:
-    """reference: static.Executor — XLA executes compiled programs."""
-
-    def __init__(self, place=None):
-        self.place = place
-
-    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        raise NotImplementedError(
-            "Executor.run has no Program to run: call the jitted function "
-            "(jit.to_static) directly — XLA is the executor (SURVEY §7)")
-
-
 class CompiledProgram:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "CompiledProgram is replaced by jit.to_static/XLA compilation")
+    """reference: static.CompiledProgram — on this stack every Program
+    run already compiles to one XLA executable (cached per feed
+    signature in the Executor), so this wrapper is identity."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+    def __getattr__(self, k):
+        return getattr(self.__dict__["program"], k)
 
 
 class BuildStrategy:

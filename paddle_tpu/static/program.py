@@ -1,0 +1,309 @@
+"""A real static Program builder over the eager op dispatch.
+
+Reference surface: python/paddle/static/ — Program, program_guard,
+static.data, Executor.run(feed=..., fetch_list=...) (the Program/
+StandaloneExecutor stack, SURVEY §2 #24/#25/#48).  The TPU-native
+mapping keeps the USER MODEL intact — build a graph by calling ordinary
+paddle ops under ``program_guard``, then execute with ``Executor.run``
+— while the execution engine is one jitted XLA replay of the recorded
+op list instead of a C++ interpreter:
+
+  * every eager op already funnels through ``framework.dispatch.call_op``;
+    under a ``program_guard`` the dispatcher hands the call to the active
+    ``Program``, which records (fn, input wiring) and returns SYMBOLIC
+    ``Variable`` outputs shaped via ``jax.eval_shape`` — no device work
+    at build time, exactly like Program construction in the reference.
+  * ``Executor.run`` compiles the whole recorded graph into ONE XLA
+    program (cached per feed signature) — the StandaloneExecutor role is
+    played by XLA, per SURVEY §7's architecture mapping.
+  * eager Tensors touched by recorded ops (parameters built by
+    ``create_parameter`` / initialized layers) become *captured state*:
+    their CURRENT value is read at every ``run``, so scope updates
+    between runs behave like the reference's persistable variables.
+
+Static *training* (append_backward/optimizer ops inside the Program) is
+out of scope — training is ``jit.to_static``/``TrainStep`` territory on
+TPU; the builder raises a clear error if asked to differentiate.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+
+from ..framework import dispatch as _dispatch
+from ..framework import dtype as _dtypes
+from ..framework.tensor import Tensor
+
+
+class Variable(Tensor):
+    """Symbolic value inside a Program: carries shape/dtype (its ``_data``
+    is a ShapeDtypeStruct), never real numbers.
+
+    ``declared_shape`` may hold -1 wildcards (dynamic batch): the
+    executor matches feeds against it and re-specializes the compiled
+    replay per concrete signature; build-time shape inference sees a
+    size-1 placeholder for wildcard dims (same caveat the reference's
+    -1 dims carry in shape-reading build code)."""
+
+    __slots__ = ("program", "var_id", "is_feed", "declared_shape")
+
+    def __init__(self, program: "Program", shape, dtype, name: str = "",
+                 is_feed: bool = False):
+        declared = tuple(int(s) for s in shape)
+        concrete = tuple(1 if s < 0 else s for s in declared)
+        sds = jax.ShapeDtypeStruct(concrete, _dtypes.convert_dtype(dtype))
+        self._init_from_array(sds, stop_gradient=True, name=name)
+        self.program = program
+        self.var_id = program._new_var_id()
+        self.is_feed = is_feed
+        self.declared_shape = declared
+
+    def numpy(self):  # pragma: no cover - guard
+        raise RuntimeError(
+            f"Variable '{self.name}' is symbolic (static Program); run it "
+            f"through Executor.run(fetch_list=[...]) to get values")
+
+
+class _Ref:
+    """Wiring marker inside a recorded op's flattened args: a Variable
+    (kind 'v', by var_id) or captured eager state (kind 'c', by index).
+    A dedicated class — a plain tuple could collide with literal args."""
+
+    __slots__ = ("kind", "idx")
+
+    def __init__(self, kind: str, idx: int):
+        self.kind = kind
+        self.idx = idx
+
+
+class _OpRecord:
+    __slots__ = ("name", "fn", "leaves", "treedef", "out_ids",
+                 "out_treedef")
+
+    def __init__(self, name, fn, leaves, treedef, out_ids, out_treedef):
+        self.name = name
+        self.fn = fn
+        self.leaves = leaves          # _Ref markers / literals
+        self.treedef = treedef
+        self.out_ids = out_ids
+        self.out_treedef = out_treedef
+
+
+class Program:
+    """Recorded op graph (reference: static.Program).  Build under
+    ``program_guard(prog)``; execute with ``Executor.run``."""
+
+    def __init__(self):
+        self.ops: List[_OpRecord] = []
+        self.feed_vars: Dict[str, Variable] = {}
+        self.captured: List[Tensor] = []       # eager state read per run
+        self._captured_ids: Dict[int, int] = {}
+        self._next_var = 0
+        self.version = 0                       # bumps invalidate exec cache
+
+    # ------------------------------------------------------------ plumbing
+    def _new_var_id(self) -> int:
+        v = self._next_var
+        self._next_var += 1
+        return v
+
+    def _capture(self, t: Tensor) -> int:
+        idx = self._captured_ids.get(id(t))
+        if idx is None:
+            idx = len(self.captured)
+            self.captured.append(t)
+            self._captured_ids[id(t)] = idx
+        return idx
+
+    def add_feed(self, name: str, shape, dtype) -> Variable:
+        if name in self.feed_vars:
+            return self.feed_vars[name]
+        v = Variable(self, shape, dtype, name=name, is_feed=True)
+        self.feed_vars[name] = v
+        self.version += 1
+        return v
+
+    # ------------------------------------------------------------- record
+    def record(self, name: str, fn, args: tuple, kwargs: dict):
+        leaves, treedef = jtu.tree_flatten((args, kwargs),
+                                           is_leaf=_dispatch._is_tensor)
+        markers: List[Any] = []
+        abstract: List[Any] = []
+        for leaf in leaves:
+            if isinstance(leaf, Variable):
+                if leaf.program is not self:
+                    raise RuntimeError(
+                        f"op '{name}' mixes Variables from different "
+                        f"Programs")
+                markers.append(_Ref("v", leaf.var_id))
+                abstract.append(leaf._data)
+            elif _dispatch._is_tensor(leaf):
+                idx = self._capture(leaf)
+                markers.append(_Ref("c", idx))
+                abstract.append(jax.ShapeDtypeStruct(
+                    leaf._data.shape, leaf._data.dtype))
+            else:
+                markers.append(leaf)
+                abstract.append(leaf)
+
+        def _abstract_call(*tensor_slots):
+            it = iter(tensor_slots)
+            rebuilt = [next(it) if isinstance(m, _Ref) else m
+                       for m in markers]
+            a2, k2 = jtu.tree_unflatten(treedef, rebuilt)
+            return fn(*a2, **k2)
+
+        slots = [a for m, a in zip(markers, abstract)
+                 if isinstance(m, _Ref)]
+        out_sds = jax.eval_shape(_abstract_call, *slots)
+
+        out_leaves, out_treedef = jtu.tree_flatten(out_sds)
+        out_vars = []
+        out_ids = []
+        for sds in out_leaves:
+            v = Variable(self, sds.shape, sds.dtype)
+            out_vars.append(v)
+            out_ids.append(v.var_id)
+        self.ops.append(_OpRecord(name, fn, markers, treedef, out_ids,
+                                  out_treedef))
+        self.version += 1
+        out_tree = jtu.tree_unflatten(out_treedef, out_vars)
+        return out_tree
+
+    # ----------------------------------------------------------- executor
+    def _replay(self, feed_arrays: Dict[str, Any],
+                captured_arrays: Sequence[Any],
+                fetch_ids: Sequence[int]):
+        env: Dict[int, Any] = {}
+        for name, v in self.feed_vars.items():
+            env[v.var_id] = feed_arrays[name]
+        for op in self.ops:
+            rebuilt = []
+            for m in op.leaves:
+                if isinstance(m, _Ref):
+                    rebuilt.append(env[m.idx] if m.kind == "v"
+                                   else captured_arrays[m.idx])
+                else:
+                    rebuilt.append(m)
+            a2, k2 = jtu.tree_unflatten(op.treedef, rebuilt)
+            out = op.fn(*a2, **k2)
+            for vid, arr in zip(op.out_ids, jtu.tree_leaves(out)):
+                env[vid] = arr
+        return [env[i] for i in fetch_ids]
+
+    def global_block(self):
+        return self                      # minimal block facade
+
+    def var(self, name: str) -> Variable:
+        v = self.feed_vars.get(name)
+        if v is None:
+            raise KeyError(f"no variable named '{name}' in this Program")
+        return v
+
+    def __repr__(self):
+        return (f"<static.Program ops={len(self.ops)} "
+                f"feeds={list(self.feed_vars)} "
+                f"captured={len(self.captured)}>")
+
+
+# --------------------------------------------------------------- guard
+_tls = threading.local()
+
+
+def current_program() -> Optional[Program]:
+    return getattr(_tls, "prog", None)
+
+
+class _ProgramGuard:
+    def __init__(self, main: Program, startup: Optional[Program]):
+        self.main = main
+        self.startup = startup
+
+    def __enter__(self):
+        self._prev = current_program()
+        _tls.prog = self.main
+        _dispatch.set_static_recorder(self.main.record)
+        return self.main
+
+    def __exit__(self, *exc):
+        _tls.prog = self._prev
+        _dispatch.set_static_recorder(
+            self._prev.record if self._prev is not None else None)
+        return False
+
+
+class Executor:
+    """reference: static.Executor — runs a Program on feeds, returns
+    fetches.  The whole graph compiles to one XLA program per feed
+    signature (cached)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Tuple, Any] = {}
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy: bool = True, **kwargs):
+        if program is None:
+            program = current_program()
+        if program is not None and not isinstance(program, Program):
+            program = getattr(program, "program", program)  # CompiledProgram
+        if program is None or not isinstance(program, Program):
+            raise ValueError("Executor.run needs a static Program (build "
+                             "one under static.program_guard)")
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        if not program.ops and not fetch_list:
+            return []                     # startup program: init is eager
+
+        fetch_ids = []
+        for f in fetch_list:
+            if isinstance(f, Variable):
+                fetch_ids.append(f.var_id)
+            elif isinstance(f, str):
+                fetch_ids.append(program.var(f).var_id)
+            else:
+                raise TypeError(f"fetch_list entries must be Variable or "
+                                f"name, got {type(f)}")
+
+        missing = set(program.feed_vars) - set(feed)
+        if missing:
+            raise ValueError(f"missing feeds: {sorted(missing)}")
+
+        feed_arrays = {}
+        for name, v in program.feed_vars.items():
+            arr = feed[name]
+            arr = arr._data if isinstance(arr, Tensor) else jnp.asarray(arr)
+            ok = len(arr.shape) == len(v.declared_shape) and all(
+                d < 0 or d == s
+                for d, s in zip(v.declared_shape, arr.shape))
+            if not ok:
+                raise ValueError(
+                    f"feed '{name}' shape {tuple(arr.shape)} != declared "
+                    f"{v.declared_shape}")
+            feed_arrays[name] = arr
+
+        key = (id(program), program.version, tuple(fetch_ids),
+               tuple(sorted((n, a.shape, str(a.dtype))
+                            for n, a in feed_arrays.items())))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            names = sorted(feed_arrays)
+
+            def _run(feed_vals, captured_vals):
+                return program._replay(dict(zip(names, feed_vals)),
+                                       captured_vals, fetch_ids)
+
+            compiled = jax.jit(_run)
+            self._cache[key] = compiled
+
+        captured_vals = [t._data for t in program.captured]
+        outs = compiled([feed_arrays[n] for n in sorted(feed_arrays)],
+                        captured_vals)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]

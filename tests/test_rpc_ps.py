@@ -440,3 +440,94 @@ class TestPSFailover:
         replacement.join(timeout=30)
         assert results.get("trainer") == "ok", results
         assert results.get("server_rejoinTrue") == "ok", results
+
+
+# ------------------------------------------------------------ heter cache
+class TestHeterEmbedding:
+    """HBM hot-row cache over the PS (HeterPS analog; reference:
+    paddle/fluid/framework/fleet/heter_ps/ps_gpu_wrapper.cc)."""
+
+    def test_lookup_serves_server_rows(self):
+        from paddle_tpu.distributed.ps.heter import DeviceEmbeddingCache
+        client = _LocalPSClient()
+        client.create_table("t", 4, optimizer="sum")
+        ids = np.array([5, 9, 5, 2], np.int64)
+        ref = client.pull_sparse("t", ids)
+        cache = DeviceEmbeddingCache(client, "t", 4, capacity=8)
+        rows, _ = cache.lookup(ids)
+        np.testing.assert_allclose(np.asarray(rows), ref, atol=1e-6)
+        assert cache.misses == 3 and cache.hits == 0
+        cache.lookup(ids)                       # all hot now
+        assert cache.hits == 3
+
+    def test_training_matches_uncached_sgd(self):
+        """Same id/grad sequence through (a) direct push to an sgd table
+        and (b) the device cache + delta flush: identical server rows."""
+        from paddle_tpu.distributed.ps.heter import DeviceEmbeddingCache
+        rng = np.random.default_rng(0)
+        ids_seq = [np.array([1, 2, 3], np.int64),
+                   np.array([2, 2, 7], np.int64),     # duplicate id
+                   np.array([1, 7, 3], np.int64)]
+        grads = [rng.standard_normal((3, 4)).astype("float32")
+                 for _ in ids_seq]
+
+        direct = _LocalPSClient()
+        direct.create_table("t", 4, optimizer="sgd", learning_rate=0.1)
+        for ids, g in zip(ids_seq, grads):
+            direct.pull_sparse("t", ids)
+            direct.push_sparse("t", ids, g)
+
+        cached = _LocalPSClient()
+        cached.create_table("t", 4, optimizer="sum")
+        cache = DeviceEmbeddingCache(cached, "t", 4, capacity=8,
+                                     learning_rate=0.1)
+        for ids, g in zip(ids_seq, grads):
+            cache.lookup(ids)
+            cache.apply_grads(ids, g)
+        cache.end_pass()
+
+        all_ids = np.array([1, 2, 3, 7], np.int64)
+        np.testing.assert_allclose(cached.pull_sparse("t", all_ids),
+                                   direct.pull_sparse("t", all_ids),
+                                   atol=1e-5)
+
+    def test_eviction_flushes_dirty_rows(self):
+        from paddle_tpu.distributed.ps.heter import DeviceEmbeddingCache
+        client = _LocalPSClient()
+        client.create_table("t", 2, optimizer="sum")
+        cache = DeviceEmbeddingCache(client, "t", 2, capacity=4,
+                                     learning_rate=1.0)
+        ids = np.array([0, 1, 2, 3], np.int64)
+        init = client.pull_sparse("t", ids).copy()
+        cache.lookup(ids)
+        g = np.ones((4, 2), np.float32)
+        cache.apply_grads(ids, g)
+        # touching 4 fresh ids evicts ALL four dirty rows -> flushed
+        cache.lookup(np.array([4, 5, 6, 7], np.int64))
+        np.testing.assert_allclose(client.pull_sparse("t", ids),
+                                   init - 1.0, atol=1e-5)
+
+    def test_batch_larger_than_capacity_raises(self):
+        from paddle_tpu.distributed.ps.heter import DeviceEmbeddingCache
+        client = _LocalPSClient()
+        client.create_table("t", 2, optimizer="sum")
+        cache = DeviceEmbeddingCache(client, "t", 2, capacity=2)
+        with pytest.raises(RuntimeError, match="capacity"):
+            cache.lookup(np.array([1, 2, 3], np.int64))
+
+    def test_layer_forward_backward_end_pass(self):
+        from paddle_tpu.distributed.ps.heter import HeterEmbedding
+        client = _LocalPSClient()
+        emb = HeterEmbedding(client, "emb", 8, capacity=16,
+                             learning_rate=0.5)
+        ids = np.array([1, 2, 3, 65], np.int64)
+        rows0 = emb(ids)
+        before = rows0.numpy().copy()
+        loss = (rows0 * rows0).sum()
+        loss.backward()                       # device SGD via hook
+        rows1 = emb(ids).numpy()              # cache hit, updated rows
+        np.testing.assert_allclose(rows1, before - 0.5 * 2 * before,
+                                   atol=1e-5)
+        emb.end_pass()                        # server sees the deltas
+        np.testing.assert_allclose(client.pull_sparse("emb", ids),
+                                   rows1, atol=1e-5)

@@ -1,0 +1,141 @@
+"""Static Program builder + Executor (SURVEY §2 #24/#48; reference:
+python/paddle/static/ Program/program_guard/data/Executor.run).
+
+The graph records through the eager op dispatch chokepoint and executes
+as ONE jitted XLA replay — parity scenarios mirror the reference's
+static workflow: build under program_guard, feed/fetch via Executor.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.static as static
+
+
+def _mlp_eager(fc1, fc2, x_np):
+    x = paddle.to_tensor(x_np)
+    h = F.relu(fc1(x))
+    return F.softmax(fc2(h), axis=-1).numpy()
+
+
+class TestProgramBuild:
+    def test_build_records_ops_not_compute(self):
+        main = static.Program()
+        fc = nn.Linear(4, 3)
+        with static.program_guard(main):
+            x = static.data("x", [2, 4], "float32")
+            y = F.relu(fc(x))
+        assert isinstance(x, static.Variable) and isinstance(
+            y, static.Variable)
+        assert len(main.ops) >= 2          # linear (+bias) + relu
+        assert tuple(y._data.shape) == (2, 3)
+        with pytest.raises(RuntimeError, match="symbolic"):
+            y.numpy()
+
+    def test_run_matches_eager(self):
+        paddle.seed(7)
+        fc1, fc2 = nn.Linear(4, 8), nn.Linear(8, 3)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 4], "float32")
+            out = F.softmax(fc2(F.relu(fc1(x))), axis=-1)
+        exe = static.Executor()
+        x_np = np.random.default_rng(0).standard_normal(
+            (2, 4)).astype("float32")
+        (got,) = exe.run(main, feed={"x": x_np}, fetch_list=[out])
+        np.testing.assert_allclose(got, _mlp_eager(fc1, fc2, x_np),
+                                   rtol=1e-6)
+
+    def test_dynamic_batch_respecializes(self):
+        fc = nn.Linear(4, 2)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [-1, 4], "float32")
+            out = fc(x)
+        exe = static.Executor()
+        for b in (2, 5):
+            x_np = np.ones((b, 4), np.float32)
+            (got,) = exe.run(main, feed={"x": x_np}, fetch_list=[out])
+            assert got.shape == (b, 2)
+            ref = fc(paddle.to_tensor(x_np)).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_captured_parameter_updates_visible(self):
+        """Persistable-variable semantics: mutating an eager parameter
+        between runs changes the next run's result (the executor reads
+        the scope's current values, reference Executor behavior)."""
+        fc = nn.Linear(3, 3)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1, 3], "float32")
+            out = fc(x)
+        exe = static.Executor()
+        x_np = np.ones((1, 3), np.float32)
+        (before,) = exe.run(main, feed={"x": x_np}, fetch_list=[out])
+        with paddle.no_grad():
+            fc.weight.set_value(fc.weight.numpy() * 2.0)
+            fc.bias.set_value(fc.bias.numpy() * 2.0)
+        (after,) = exe.run(main, feed={"x": x_np}, fetch_list=[out])
+        np.testing.assert_allclose(after, before * 2.0, rtol=1e-5)
+
+    def test_feed_validation(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 4], "float32")
+            out = F.relu(x)
+        exe = static.Executor()
+        with pytest.raises(ValueError, match="missing feeds"):
+            exe.run(main, feed={}, fetch_list=[out])
+        with pytest.raises(ValueError, match="shape"):
+            exe.run(main, feed={"x": np.ones((3, 5), np.float32)},
+                    fetch_list=[out])
+
+    def test_fetch_by_name_and_mixing_programs(self):
+        main1, main2 = static.Program(), static.Program()
+        with static.program_guard(main1):
+            x1 = static.data("x", [1, 2], "float32")
+        with static.program_guard(main2):
+            static.data("y", [1, 2], "float32")
+            with pytest.raises(RuntimeError, match="different"):
+                F.relu(x1)              # var from main1 inside main2
+        exe = static.Executor()
+        (got,) = exe.run(main1, feed={"x": np.ones((1, 2), np.float32)},
+                         fetch_list=["x"])
+        np.testing.assert_allclose(got, np.ones((1, 2)))
+
+    def test_default_programs_and_guard_nesting(self):
+        dm = static.default_main_program()
+        assert isinstance(dm, static.Program)
+        own = static.Program()
+        with static.program_guard(own):
+            assert static.current_program() is own
+            inner = static.Program()
+            with static.program_guard(inner):
+                assert static.current_program() is inner
+            assert static.current_program() is own
+        assert static.current_program() is None
+
+    def test_eager_unaffected_outside_guard(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        y = F.relu(x)                       # plain eager path
+        assert not isinstance(y, static.Variable)
+        np.testing.assert_allclose(y.numpy(), np.ones((2, 2)))
+
+    def test_compiled_program_wrapper(self):
+        fc = nn.Linear(2, 2)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1, 2], "float32")
+            out = fc(x)
+        cp = static.CompiledProgram(main)
+        exe = static.Executor()
+        (got,) = exe.run(cp, feed={"x": np.ones((1, 2), np.float32)},
+                         fetch_list=[out])
+        ref = fc(paddle.to_tensor(np.ones((1, 2), np.float32))).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_startup_program_run_is_noop(self):
+        exe = static.Executor()
+        assert exe.run(static.default_startup_program()) == []

@@ -184,12 +184,15 @@ class TestStaticCompat:
             pass
         np.testing.assert_allclose(w.numpy(), backup)
 
-    def test_program_machinery_raises_clearly(self):
+    def test_program_machinery_is_real(self):
+        """r5: Program/program_guard/Executor are a real deferred-graph
+        builder (tests/test_static_program.py covers behavior); here just
+        the namespace contracts."""
         import paddle_tpu.static as st
-        with pytest.raises(NotImplementedError):
-            st.Executor().run()
-        with pytest.raises(NotImplementedError):
-            st.CompiledProgram()
+        with pytest.raises(ValueError):
+            st.Executor().run()            # no active/passed Program
+        p = st.Program()
+        assert st.CompiledProgram(p).program is p
         bs = st.BuildStrategy()
         bs.fuse_bn_act_ops = True
         assert bs.fuse_bn_act_ops is True

@@ -1,0 +1,82 @@
+"""A/B the chunked fused linear+CE against the unfused headline loss on
+the real chip (guarded; bench_llama's exact 110M config).
+
+A: TrainStep over LlamaForCausalLM logits + f32 cross_entropy (the
+   bench.py headline path).
+B: TrainStep over the decoder hidden states + incubate
+   fused_linear_cross_entropy (nn/functional/fused_loss.py) — same math,
+   logits never materialized.
+
+Prints one JSON line with tokens/sec and compiled temp bytes for both.
+The result decides whether bench.py's headline switches loss paths —
+policy: measured, never assumed (the autotune discipline, SURVEY #86).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run() -> dict:
+    sys.path.insert(0, REPO)
+    import jax
+    import numpy as np
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        return {"skipped": True, "platform": dev.platform}
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig
+    import bench   # repo root — the SHARED step builder (review finding:
+                   # the A/B must measure exactly the headline's step)
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=768, intermediate_size=2048,
+        num_hidden_layers=12, num_attention_heads=12,
+        max_position_embeddings=2048, dtype="bfloat16")
+    batch, seq, steps = 8, 1024, 20
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype("int32")
+
+    def build(fused: bool):
+        paddle.seed(0)
+        step, _ = bench.build_llama_train_step(cfg, bf16=True,
+                                               use_fused=fused)
+        return step
+
+    x = paddle.to_tensor(ids[:, :-1])
+    y = paddle.to_tensor(ids[:, 1:])
+    out = {"config": "llama_110m b8 s1024", "device_kind": dev.device_kind}
+    for name, fused in (("unfused", False), ("fused_ce", True)):
+        step = build(fused)
+        mem = step.memory_analysis(x, y)
+        for _ in range(2):
+            loss = step(x, y)
+        jax.block_until_ready(loss._data)
+        v0 = float(np.asarray(loss._data))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(x, y)
+        jax.block_until_ready(loss._data)
+        dt = time.perf_counter() - t0
+        out[name] = {
+            "tokens_per_sec": round(batch * seq * steps / dt, 1),
+            "temp_bytes": int(mem.get("temp_bytes", -1)),
+            "loss_after_warmup": round(v0, 4),
+        }
+    a, b = out["unfused"], out["fused_ce"]
+    out["fused_speedup"] = round(
+        b["tokens_per_sec"] / max(a["tokens_per_sec"], 1e-9), 3)
+    out["fused_temp_saving_mb"] = round(
+        (a["temp_bytes"] - b["temp_bytes"]) / 1e6, 1)
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(run()))

@@ -1,0 +1,134 @@
+"""Opportunistic TPU work queue: when the chip comes back, run EVERYTHING.
+
+The tunnel relay on this deployment dies and resurrects outside our
+control (probe log: healthy 01:03-01:34 UTC, relay process gone by
+01:45).  tpu_capture.py --watch only re-runs the bench ladder; this
+orchestrator drives the full round-5 hardware queue in one healthy
+window, in priority order:
+
+  1. bench ladder (tpu_capture.run_ladder -> BENCH_tpu_opportunistic.json)
+  2. on-device Pallas kernel validation (pallas_tpu_validate --child
+     -> tools/pallas_tpu_validation.json)
+  3. fused-CE A/B at the headline config (fused_ce_ab
+     -> tools/fused_ce_ab.json)
+
+Each stage runs in its own subprocess (a wedge costs the child); stages
+that already produced their artifact are skipped on later windows, so
+a flapping chip makes incremental progress instead of redoing stage 1.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import tpu_capture  # noqa: E402
+
+
+def _have_ladder() -> bool:
+    """Ladder artifact exists AND got past the tiny rung."""
+    try:
+        doc = json.load(open(tpu_capture.OUT_JSON))
+    except Exception:  # noqa: BLE001
+        return False
+    ok = [r for r in doc.get("ladder", []) if r.get("status") == "ok"]
+    return len(ok) >= 3   # tiny+small+110m: the headline-comparable rung
+
+
+def _have(path: str) -> bool:
+    return os.path.exists(os.path.join(REPO, path))
+
+
+def _have_validation() -> bool:
+    """Validation artifact is DONE only when its end-of-run summary was
+    written (the child writes incrementally; a crash mid-way leaves
+    kernels but no summary — that window made progress, not completion)."""
+    try:
+        doc = json.load(open(os.path.join(
+            REPO, "tools", "pallas_tpu_validation.json")))
+    except Exception:  # noqa: BLE001
+        return False
+    return bool(doc.get("summary", {}).get("total"))
+
+
+def _have_ab() -> bool:
+    """A/B artifact counts only if it holds a real measurement (a chip
+    flake between probe and stage 3 yields {'skipped': true})."""
+    try:
+        doc = json.load(open(os.path.join(REPO, "tools",
+                                          "fused_ce_ab.json")))
+    except Exception:  # noqa: BLE001
+        return False
+    return "fused_speedup" in doc and not doc.get("skipped")
+
+
+def _run(cmd, timeout, log_name) -> int:
+    log = os.path.join(REPO, "tools", log_name)
+    with open(log, "a") as f:
+        f.write(f"\n=== {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}"
+                f" {' '.join(cmd)}\n")
+        f.flush()
+        try:
+            res = subprocess.run(cmd, cwd=REPO, stdout=f, stderr=f,
+                                 timeout=timeout)
+            return res.returncode
+        except subprocess.TimeoutExpired:
+            f.write("TIMEOUT\n")
+            return -1
+
+
+def one_window() -> bool:
+    """Run the queue while the chip stays healthy.  True = all done."""
+    if not _have_ladder():
+        print("[window] stage 1: bench ladder", flush=True)
+        tpu_capture.run_ladder()
+        if not _have_ladder():
+            return False           # chip flaked mid-ladder; retry later
+    if not _have_validation():
+        print("[window] stage 2: pallas on-device validation", flush=True)
+        rc = _run([sys.executable, "tools/pallas_tpu_validate.py",
+                   "--child"], 2400, "window_validate.log")
+        if not _have_validation():
+            print(f"[window] validation incomplete (rc={rc})", flush=True)
+            return False
+    if not _have_ab():
+        print("[window] stage 3: fused-CE A/B", flush=True)
+        rc = _run([sys.executable, "-c",
+                   "import json,sys; sys.path.insert(0,'tools');"
+                   "import fused_ce_ab;"
+                   "out=fused_ce_ab.run();"
+                   "skipped=out.get('skipped');"
+                   "open('tools/fused_ce_ab.json','w')"
+                   ".write(json.dumps(out,indent=1)) if not skipped "
+                   "else None;"
+                   "print(json.dumps(out))"], 2400, "window_ab.log")
+        if not _have_ab():
+            print(f"[window] A/B incomplete (rc={rc})", flush=True)
+            return False
+    return True
+
+
+def main() -> int:
+    interval = float(sys.argv[1]) if len(sys.argv) > 1 else 600.0
+    max_hours = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
+    deadline = time.time() + max_hours * 3600
+    while time.time() < deadline:
+        p = tpu_capture.probe()
+        print(json.dumps(p), flush=True)
+        if p["ok"] and p["platform"] == "tpu":
+            if one_window():
+                print("[window] queue complete", flush=True)
+                return 0
+        time.sleep(interval)
+    print("[window] deadline reached", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
