@@ -140,6 +140,12 @@ def call_op(name: str, fn: Callable, args: tuple, kwargs: dict):
 def _call_op_impl(name: str, fn: Callable, args: tuple, kwargs: dict):
     _rec = _get_static_recorder()
     if _rec is not None:
+        # AMP casts must be applied BEFORE recording: symbolic Variables
+        # are Tensor subclasses, so the hook's .astype() re-enters
+        # call_op and the cast lands in the Program — the replayed graph
+        # then matches what the eager path would have executed
+        if _amp_cast_hook is not None:
+            args, kwargs = _amp_cast_hook(name, args, kwargs)
         return _rec(name, fn, args, kwargs)
     if _amp_cast_hook is not None:
         args, kwargs = _amp_cast_hook(name, args, kwargs)

@@ -43,6 +43,12 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     dtype: str = "float32"
+    # Activation recomputation per decoder layer (reference:
+    # use_recompute in PaddleNLP model configs + fleet.recompute) —
+    # jax.checkpoint under the whole-step compile, trading one extra
+    # forward for O(1-layer) activation residency.  The lever that fits
+    # batch 8/16 pretrain into a single chip's HBM.
+    use_recompute: bool = False
 
     def __post_init__(self):
         if self.num_key_value_heads is None:
@@ -247,6 +253,17 @@ class LlamaModel(Layer):
                 x, cache = layer(x, self.rope_cos, self.rope_sin,
                                  position_offset, kv_caches[i])
                 new_caches.append(cache)
+            elif self.config.use_recompute:
+                # fleet.recompute = jax.checkpoint: the layer's
+                # activations are rematerialized inside the compiled
+                # backward instead of living in HBM across the step
+                from ..distributed.fleet.recompute import recompute
+                # position_offset rides as a kwarg so it stays a static
+                # Python int under the checkpoint trace (as in the
+                # non-recompute call) instead of being wrapped to a
+                # traced scalar
+                x = recompute(layer, x, self.rope_cos, self.rope_sin,
+                              position_offset=position_offset)
             else:
                 x = layer(x, self.rope_cos, self.rope_sin, position_offset)
         x = self.norm(x)

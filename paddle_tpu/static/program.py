@@ -122,7 +122,15 @@ class Program:
 
     def add_feed(self, name: str, shape, dtype) -> Variable:
         if name in self.feed_vars:
-            return self.feed_vars[name]
+            v = self.feed_vars[name]
+            if (v.declared_shape != tuple(int(s) for s in shape)
+                    or v._data.dtype != _dtypes.convert_dtype(dtype)):
+                raise ValueError(
+                    f"feed '{name}' re-declared with shape={list(shape)} "
+                    f"dtype={dtype}, but the program already declares it "
+                    f"as shape={list(v.declared_shape)} "
+                    f"dtype={v._data.dtype}")
+            return v
         v = Variable(self, shape, dtype, name=name, is_feed=True)
         self.feed_vars[name] = v
         self.version += 1

@@ -139,3 +139,40 @@ class TestProgramBuild:
     def test_startup_program_run_is_noop(self):
         exe = static.Executor()
         assert exe.run(static.default_startup_program()) == []
+
+
+class TestFeedRedeclareAndAmp:
+    def test_feed_redeclare_mismatch_raises(self):
+        main = static.Program()
+        with static.program_guard(main):
+            static.data("x", [4, 8], "float32")
+            # same declaration is idempotent
+            static.data("x", [4, 8], "float32")
+            with pytest.raises(ValueError, match="re-declared"):
+                static.data("x", [2, 2], "float32")
+            with pytest.raises(ValueError, match="re-declared"):
+                static.data("x", [4, 8], "int32")
+
+    def test_amp_autocast_casts_are_recorded(self):
+        # reference semantics: a program built under amp.auto_cast must
+        # replay with the same low-precision casts the eager path runs
+        paddle.seed(11)
+        fc = nn.Linear(8, 8)
+        x_np = np.random.default_rng(1).standard_normal(
+            (4, 8)).astype("float32")
+
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            eager = fc(paddle.to_tensor(x_np))
+        assert "bfloat16" in str(eager.dtype)
+
+        main = static.Program()
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            with static.program_guard(main):
+                x = static.data("x", [4, 8], "float32")
+                out = fc(x)
+        exe = static.Executor()
+        (got,) = exe.run(main, feed={"x": x_np}, fetch_list=[out])
+        assert got.dtype == np.asarray(eager._data).dtype
+        np.testing.assert_allclose(
+            got.astype(np.float32),
+            np.asarray(eager._data, dtype=np.float32), rtol=1e-2)

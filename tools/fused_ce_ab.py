@@ -39,7 +39,11 @@ def run() -> dict:
         vocab_size=32000, hidden_size=768, intermediate_size=2048,
         num_hidden_layers=12, num_attention_heads=12,
         max_position_embeddings=2048, dtype="bfloat16")
-    batch, seq, steps = 8, 1024, 20
+    # batch 4: the largest batch where BOTH arms clear the HBM safety
+    # gate on an 8GB chip (the unfused arm plans ~11GB at batch 8 —
+    # measured, BENCH_tpu_opportunistic ladder) — an A/B where one arm
+    # cannot run is a memory result, not a speed result
+    batch, seq, steps = 4, 1024, 20
 
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype("int32")
@@ -52,10 +56,19 @@ def run() -> dict:
 
     x = paddle.to_tensor(ids[:, :-1])
     y = paddle.to_tensor(ids[:, 1:])
-    out = {"config": "llama_110m b8 s1024", "device_kind": dev.device_kind}
+    hbm = int((dev.memory_stats() or {}).get("bytes_limit", 8 << 30))
+    out = {"config": "llama_110m b4 s1024", "device_kind": dev.device_kind}
     for name, fused in (("unfused", False), ("fused_ce", True)):
         step = build(fused)
         mem = step.memory_analysis(x, y)
+        # same OOM discipline as the capture ladder: an arm that does
+        # not fit is recorded as rejected, never run
+        planned = bench.planned_peak_bytes(mem)
+        if planned > 0.8 * hbm:
+            out[name] = {"status": "memory_gate_rejected",
+                         "planned_bytes": int(planned),
+                         "hbm_bytes_limit": hbm}
+            continue
         for _ in range(2):
             loss = step(x, y)
         jax.block_until_ready(loss._data)
@@ -66,17 +79,32 @@ def run() -> dict:
         jax.block_until_ready(loss._data)
         dt = time.perf_counter() - t0
         out[name] = {
+            "status": "ok",
             "tokens_per_sec": round(batch * seq * steps / dt, 1),
             "temp_bytes": int(mem.get("temp_bytes", -1)),
             "loss_after_warmup": round(v0, 4),
         }
     a, b = out["unfused"], out["fused_ce"]
-    out["fused_speedup"] = round(
-        b["tokens_per_sec"] / max(a["tokens_per_sec"], 1e-9), 3)
-    out["fused_temp_saving_mb"] = round(
-        (a["temp_bytes"] - b["temp_bytes"]) / 1e6, 1)
+    if "tokens_per_sec" in a and "tokens_per_sec" in b:
+        out["fused_speedup"] = round(
+            b["tokens_per_sec"] / max(a["tokens_per_sec"], 1e-9), 3)
+        out["fused_temp_saving_mb"] = round(
+            (a["temp_bytes"] - b["temp_bytes"]) / 1e6, 1)
+    measured = [(n, out[n]["tokens_per_sec"]) for n in ("unfused",
+                "fused_ce") if "tokens_per_sec" in out[n]]
+    # a path that fits when the other cannot wins outright — memory is
+    # the resource the fused kernel exists to save
+    out["winner"] = (max(measured, key=lambda kv: kv[1])[0]
+                     if measured else None)
     return out
 
 
+OUT_JSON = os.path.join(REPO, "tools", "fused_ce_ab.json")
+
+
 if __name__ == "__main__":
-    print(json.dumps(run()))
+    out = run()
+    if "--write" in sys.argv and not out.get("skipped"):
+        with open(OUT_JSON, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps(out))

@@ -104,11 +104,25 @@ def child() -> int:
             _pl.pallas_call = _forced_interpret
             _pl._validate_patched = True
 
+    # Incremental across windows: already-validated kernels keep their
+    # hardware result; only failed/missing kernels re-run (a Mosaic
+    # remote-compile flake should not cost the whole queue a window).
+    prior_kernels, attempts = {}, 0
+    if not debug_cpu and os.path.exists(OUT_JSON):
+        try:
+            _prior = json.load(open(OUT_JSON))
+            prior_kernels = {k: v
+                             for k, v in _prior.get("kernels", {}).items()
+                             if v.get("status") == "ok"}
+            attempts = int(_prior.get("attempts", 0))
+        except Exception:  # noqa: BLE001
+            pass
     doc = {
         "device_kind": dev.device_kind,
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "geometry": {"B": B, "H": H, "KVH": KVH, "S": S, "D": D},
-        "kernels": {},
+        "kernels": prior_kernels,
+        "attempts": attempts + 1,
     }
     _write(doc)
 
@@ -122,6 +136,8 @@ def child() -> int:
 
     def run_case(name, pallas_fn, xla_fn, args, tol, outputs="first"):
         """Compile both paths, compare numerics on-device, time both."""
+        if doc["kernels"].get(name, {}).get("status") == "ok":
+            return   # validated in an earlier window; don't spend chip time
         try:
             pj = jax.jit(pallas_fn)
             xj = jax.jit(xla_fn)

@@ -32,29 +32,16 @@ import tpu_capture  # noqa: E402
 
 
 def _have_ladder() -> bool:
-    """Ladder artifact exists AND got past the tiny rung."""
-    try:
-        doc = json.load(open(tpu_capture.OUT_JSON))
-    except Exception:  # noqa: BLE001
-        return False
-    ok = [r for r in doc.get("ladder", []) if r.get("status") == "ok"]
-    return len(ok) >= 3   # tiny+small+110m: the headline-comparable rung
-
-
-def _have(path: str) -> bool:
-    return os.path.exists(os.path.join(REPO, path))
+    """The ladder stage is done when EVERY rung currently defined in
+    LLAMA_LADDER has a settled answer (measured ok, or deterministically
+    memory-gate-rejected) in some recorded attempt — adding new rungs to
+    the ladder automatically reopens the stage on the next window."""
+    settled = tpu_capture._prior_rung_results()
+    return all(s["name"] in settled for s in tpu_capture.LLAMA_LADDER)
 
 
 def _have_validation() -> bool:
-    """Validation artifact is DONE only when its end-of-run summary was
-    written (the child writes incrementally; a crash mid-way leaves
-    kernels but no summary — that window made progress, not completion)."""
-    try:
-        doc = json.load(open(os.path.join(
-            REPO, "tools", "pallas_tpu_validation.json")))
-    except Exception:  # noqa: BLE001
-        return False
-    return bool(doc.get("summary", {}).get("total"))
+    return tpu_capture.validation_done()
 
 
 def _have_ab() -> bool:
@@ -65,7 +52,8 @@ def _have_ab() -> bool:
                                           "fused_ce_ab.json")))
     except Exception:  # noqa: BLE001
         return False
-    return "fused_speedup" in doc and not doc.get("skipped")
+    return ((doc.get("winner") is not None or "fused_speedup" in doc)
+            and not doc.get("skipped"))
 
 
 def _run(cmd, timeout, log_name) -> int:
@@ -84,34 +72,31 @@ def _run(cmd, timeout, log_name) -> int:
 
 
 def one_window() -> bool:
-    """Run the queue while the chip stays healthy.  True = all done."""
+    """Run the queue while the chip stays healthy.  True = all done.
+
+    Every stage is attempted each window: the stages are independent, so
+    one stuck stage (e.g. a rung erroring deterministically) must not
+    starve the others of scarce chip time."""
+    done = True
     if not _have_ladder():
         print("[window] stage 1: bench ladder", flush=True)
         tpu_capture.run_ladder()
-        if not _have_ladder():
-            return False           # chip flaked mid-ladder; retry later
+        done = _have_ladder() and done
     if not _have_validation():
         print("[window] stage 2: pallas on-device validation", flush=True)
         rc = _run([sys.executable, "tools/pallas_tpu_validate.py",
                    "--child"], 2400, "window_validate.log")
         if not _have_validation():
             print(f"[window] validation incomplete (rc={rc})", flush=True)
-            return False
+            done = False
     if not _have_ab():
         print("[window] stage 3: fused-CE A/B", flush=True)
-        rc = _run([sys.executable, "-c",
-                   "import json,sys; sys.path.insert(0,'tools');"
-                   "import fused_ce_ab;"
-                   "out=fused_ce_ab.run();"
-                   "skipped=out.get('skipped');"
-                   "open('tools/fused_ce_ab.json','w')"
-                   ".write(json.dumps(out,indent=1)) if not skipped "
-                   "else None;"
-                   "print(json.dumps(out))"], 2400, "window_ab.log")
+        rc = _run([sys.executable, "tools/fused_ce_ab.py", "--write"],
+                  2400, "window_ab.log")
         if not _have_ab():
             print(f"[window] A/B incomplete (rc={rc})", flush=True)
-            return False
-    return True
+            done = False
+    return done
 
 
 def main() -> int:
