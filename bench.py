@@ -84,6 +84,23 @@ def _mfu_fields(step, x, y, per_sec, units_per_step, on_tpu,
     return out
 
 
+# One OOM-gate policy for every consumer (bench headline, capture ladder,
+# fused-CE A/B): the chip wedges permanently on RESOURCE_EXHAUSTED, so the
+# gates must never disagree on EITHER the bytes formula (planned_peak_bytes)
+# or the margin/fallback below.
+HBM_SAFETY_FRACTION = 0.80   # planned bytes exclude runtime fragmentation
+DEFAULT_HBM_BYTES = 8 << 30  # conservative floor when memory_stats() is bare
+
+
+def hbm_bytes_limit(device=None):
+    """Reported HBM bytes_limit of ``device`` (default: first device),
+    falling back to DEFAULT_HBM_BYTES when stats are unavailable."""
+    import jax
+    dev = device if device is not None else jax.devices()[0]
+    return int((dev.memory_stats() or {}).get("bytes_limit",
+                                              DEFAULT_HBM_BYTES))
+
+
 def planned_peak_bytes(mem):
     """Alias-aware planned HBM peak from a TrainStep.memory_analysis()
     dict.  Donated outputs alias their arguments (TrainStep donates the
@@ -223,9 +240,7 @@ def bench_llama(on_tpu):
         # EXHAUSTED): AOT-compile and check the alias-aware planned peak
         # before the first real execution; fall back fused -> smaller
         # batch rather than touch HBM beyond the safety line.
-        import jax
-        hbm = int((jax.devices()[0].memory_stats() or {})
-                  .get("bytes_limit", 8 << 30))
+        hbm = hbm_bytes_limit()
         candidates = list(dict.fromkeys(
             [(use_fused, batch), (True, batch), (True, batch // 2)]))
         step = _model = None
@@ -241,11 +256,11 @@ def bench_llama(on_tpu):
             x = paddle.to_tensor(ids[:, :-1])
             y = paddle.to_tensor(ids[:, 1:])
             planned = planned_peak_bytes(step.memory_analysis(x, y))
-            if planned <= 0.8 * hbm:
+            if planned <= HBM_SAFETY_FRACTION * hbm:
                 use_fused, batch = try_fused, try_batch
                 break
             gate_note = (f"memory gate: planned {planned/1e9:.2f}GB > "
-                         f"0.8x{hbm/1e9:.2f}GB at fused={try_fused} "
+                         f"{HBM_SAFETY_FRACTION}x{hbm/1e9:.2f}GB at fused={try_fused} "
                          f"b{try_batch}; stepped down")
         else:
             return {"metric": "llama_110m_pretrain_tokens_per_sec_per_chip",

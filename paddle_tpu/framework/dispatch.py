@@ -186,7 +186,8 @@ def _call_op_impl(name: str, fn: Callable, args: tuple, kwargs: dict):
 
     cached = _cached_grad_call(name, fn, leaves, treedef, tensor_idx,
                                diff_pos, arrays) \
-        if get_flag("eager_cached_grad") else None
+        if (get_flag("eager_cached_grad")
+            and name not in _PLACEMENT_OPS) else None
     if cached is not None:
         out_arrays, vjp_fn = cached
     else:
@@ -302,6 +303,13 @@ def _apply_spmd_rule(name, leaves, tensor_idx, treedef, result):
 # --------------------------------------------------------------------------
 _GRAD_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
 _GRAD_CACHE_CAP = 1024
+
+# Placement ops MUST execute their device_put eagerly: under the cached
+# path the op fn runs inside jit, where the compiler decides output
+# shardings and the explicit NamedSharding destination is discarded —
+# shard_tensor on a requires-grad Parameter would silently leave it
+# replicated (caught by tests/test_llama_moe.py EP sharding assert).
+_PLACEMENT_OPS = frozenset({"shard_tensor", "reshard"})
 
 
 def _cached_grad_call(name, fn, leaves, treedef, tensor_idx, diff_pos,

@@ -364,3 +364,11 @@ __all__ += ["server", "InferenceServer", "GenerationServer"]
 from . import paged  # noqa: E402,F401  (paged-KV serving path)
 from .paged import PagedGenerator  # noqa: E402,F401
 __all__ += ["paged", "PagedGenerator"]
+
+from . import continuous  # noqa: E402,F401  (continuous batching engine)
+from .continuous import ContinuousBatchingEngine  # noqa: E402,F401
+__all__ += ["continuous", "ContinuousBatchingEngine"]
+
+from . import speculative  # noqa: E402,F401  (draft-verify decoding)
+from .speculative import SpeculativeGenerator  # noqa: E402,F401
+__all__ += ["speculative", "SpeculativeGenerator"]

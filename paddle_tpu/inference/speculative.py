@@ -1,0 +1,160 @@
+"""Speculative decoding: a small draft model proposes, the target model
+verifies k tokens in ONE forward (reference serving capability class:
+the speculative/draft-verify path of PaddleNLP's block-attention serving
+on top of paddle/phi/kernels/fusion/gpu/block_multi_head_attention;
+algorithm: Leviathan et al. 2023, greedy variant).
+
+TPU-native framing: verification is a single batched forward over the k
+proposed tokens — one MXU-friendly [B, k, H] pass instead of k
+sequential [B, 1, H] decode steps — so acceptance rate directly converts
+HBM-bandwidth-bound decode steps into compute-dense verify steps.
+
+Greedy speculative decoding is EXACT: the emitted sequence is
+bit-identical to target-only greedy decoding, whatever the draft
+proposes (every accepted token equals the target's argmax given its
+prefix, and the first disagreement emits the target's own argmax).  The
+equivalence test in tests/test_speculative.py asserts that.
+
+KV caches are plain per-layer (k, v) concat caches (the eager
+LlamaModel cache path); rejected speculative suffixes are rolled back by
+slicing the cache on the sequence axis.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tape import no_grad
+from ..framework.tensor import wrap_array
+
+
+def _empty_caches(model, batch: int):
+    cfg = model.config
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    dtype = model.model.embed_tokens.weight._data.dtype
+    empty = wrap_array(jnp.zeros(
+        (batch, 0, cfg.num_key_value_heads, head_dim), dtype))
+    return [(empty, empty) for _ in range(cfg.num_hidden_layers)]
+
+
+def _trim_caches(caches, length: int):
+    """Roll back every layer's (k, v) cache to ``length`` positions —
+    how rejected speculative tokens are undone."""
+    return [(k[:, :length], v[:, :length]) for k, v in caches]
+
+
+class SpeculativeGenerator:
+    """Greedy speculative decoding over (target, draft) causal LMs.
+
+    Both models must expose the ``model(ids, position_offset, kv_caches)
+    -> (hidden, new_caches)`` cache path and a logits head (LlamaForCausalLM
+    / LlamaMoeForCausalLM shape).  ``num_speculative_tokens`` is the
+    draft lookahead k; acceptance statistics land in ``last_stats``.
+    """
+
+    def __init__(self, target_model, draft_model,
+                 num_speculative_tokens: int = 4):
+        if num_speculative_tokens < 1:
+            raise ValueError("num_speculative_tokens must be >= 1")
+        self.target = target_model
+        self.draft = draft_model
+        self.k = int(num_speculative_tokens)
+        self.last_stats: dict = {}
+
+    # ------------------------------------------------------------ internals
+    def _logits(self, model, hidden):
+        return model.lm_head(hidden) if model.lm_head is not None \
+            else model._logits_of(hidden)
+
+    def _argmax(self, logits) -> np.ndarray:
+        return np.asarray(
+            jnp.argmax(logits._data[:, -1].astype(jnp.float32), axis=-1))
+
+    # ------------------------------------------------------------- generate
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None):
+        """Greedy decode; batch 1 per call (verification rollback is
+        per-sequence).  Returns the full [1, prompt+new] id array."""
+        ids = np.asarray(input_ids._data if hasattr(input_ids, "_data")
+                         else input_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        if ids.shape[0] != 1:
+            raise ValueError("speculative generate is per-sequence "
+                             "(batch 1); batch via the serving engine")
+        t0 = _time.perf_counter()
+        proposed = accepted = rounds = 0
+        with no_grad():
+            tgt_cache = _empty_caches(self.target, 1)
+            dft_cache = _empty_caches(self.draft, 1)
+            x = wrap_array(jnp.asarray(ids, jnp.int32))
+            # prefill both models on the prompt
+            h, tgt_cache = self.target.model(x, 0, tgt_cache)
+            nxt = int(self._argmax(self._logits(self.target, h[:, -1:]))[0])
+            _, dft_cache = self.draft.model(x, 0, dft_cache)
+            out = list(ids[0]) + [nxt]
+            # invariant: caches cover out[:-1]; out[-1] is unverified input
+            while len(out) - ids.shape[1] < max_new_tokens:
+                if eos_token_id is not None and out[-1] == eos_token_id:
+                    break
+                rounds += 1
+                L = len(out) - 1          # verified cached positions
+                budget = max_new_tokens - (len(out) - ids.shape[1])
+                k = min(self.k, budget)
+                # the draft cache can trail L (an all-accepted round
+                # produces its last token without ever feeding it);
+                # ingest the gap in one forward before proposing
+                dft_len = int(dft_cache[0][0].shape[1])
+                if dft_len < L:
+                    fill = wrap_array(jnp.asarray(
+                        [out[dft_len:L]], jnp.int32))
+                    _, dft_cache = self.draft.model(fill, dft_len,
+                                                    dft_cache)
+                # ---- draft proposes k tokens autoregressively --------
+                draft_tokens = []
+                cur = out[-1]
+                for _ in range(k):
+                    step = wrap_array(jnp.asarray([[cur]], jnp.int32))
+                    dh, dft_cache = self.draft.model(
+                        step, L + len(draft_tokens), dft_cache)
+                    cur = int(self._argmax(
+                        self._logits(self.draft, dh))[0])
+                    draft_tokens.append(cur)
+                proposed += k
+                # ---- target verifies in ONE forward over k+1 tokens --
+                block = np.asarray([[out[-1]] + draft_tokens], np.int32)
+                th, tgt_cache = self.target.model(
+                    wrap_array(jnp.asarray(block)), L, tgt_cache)
+                tlogits = self._logits(self.target, th)
+                targets = np.asarray(jnp.argmax(
+                    tlogits._data[0].astype(jnp.float32), axis=-1))
+                # targets[i] = target's next token after block[:i+1]
+                n_ok = 0
+                while n_ok < k and draft_tokens[n_ok] == int(targets[n_ok]):
+                    n_ok += 1
+                accepted += n_ok
+                emitted = draft_tokens[:n_ok] + [int(targets[n_ok])] \
+                    if n_ok < k else draft_tokens + [int(targets[k])]
+                out.extend(emitted)
+                # ---- roll back both caches to the verified length ----
+                new_len = len(out) - 1
+                tgt_cache = _trim_caches(tgt_cache, new_len)
+                dft_cache = _trim_caches(dft_cache, new_len)
+                if eos_token_id is not None and eos_token_id in emitted:
+                    cut = emitted.index(eos_token_id)
+                    out = out[:len(out) - len(emitted) + cut + 1]
+                    break
+        out = out[:ids.shape[1] + max_new_tokens]
+        self.last_stats = {
+            "rounds": rounds,
+            "proposed": proposed,
+            "accepted": accepted,
+            "acceptance_rate": round(accepted / max(proposed, 1), 3),
+            "tokens_per_round": round(
+                (len(out) - ids.shape[1]) / max(rounds, 1), 2),
+            "seconds": round(_time.perf_counter() - t0, 4),
+        }
+        return np.asarray([out], dtype=np.int64)

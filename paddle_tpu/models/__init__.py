@@ -3,6 +3,10 @@ from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama_7b, llama_small,
     shard_llama,
 )
+from .llama_moe import (  # noqa: F401
+    LlamaMoeConfig, LlamaMoeDecoderLayer, LlamaMoeForCausalLM,
+    LlamaMoeModel, shard_llama_moe,
+)
 from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForSequenceClassification, BertForMaskedLM,
     bert_base, bert_tiny,

@@ -1,0 +1,185 @@
+"""Mixtral-style sparse-MoE LLaMA decoder — the MoE model family the
+reference trains through its EP stack (reference capability:
+python/paddle/incubate/distributed/models/moe/moe_layer.py MoELayer +
+the decoder architecture of models/llama.py here).
+
+TPU-native end to end: attention is the shared LlamaAttention (Pallas
+flash path on TPU), each decoder's FFN is a MoELayer over an ExpertFFN
+with stacked [E, ...] weights (batched on the MXU, shardable over an
+'ep' mesh axis via shard_moe_layer), routing is the ragged O(T)
+scatter/gather dispatch, and the gate's load-balancing auxiliary loss is
+returned alongside the logits so the whole thing compiles into one
+donated-buffer TrainStep program.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..nn.layer.layers import Layer, LayerList
+from ..nn.layer.common import Linear, Embedding
+from ..nn.layer.norm import RMSNorm
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..framework.tensor import Tensor
+from ..incubate.distributed.models.moe import ExpertFFN, MoELayer
+from .llama import LlamaAttention, LlamaConfig, _rope_tables
+
+
+@dataclass
+class LlamaMoeConfig(LlamaConfig):
+    """LlamaConfig + sparse-MoE routing knobs (Mixtral shape family)."""
+    num_experts: int = 8
+    moe_top_k: int = 2
+    gate_type: str = "gshard"          # gshard | switch | naive
+    aux_loss_weight: float = 0.01
+
+
+class LlamaMoeDecoderLayer(Layer):
+    """Attention + sparse-MoE FFN block.
+
+    Recompute note: the whole layer must NOT be wrapped in one
+    jax.checkpoint — the gate records its load-balancing loss as a side
+    channel read after the forward, and trapping that inside a remat
+    trace would detach it from the grad path.  use_recompute therefore
+    remats the attention block and the expert FFNs separately
+    (MoELayer's own recompute_interval), keeping the gate outside.
+    """
+
+    def __init__(self, config: LlamaMoeConfig):
+        super().__init__()
+        self.use_recompute = config.use_recompute
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+        self.moe = MoELayer(
+            config.hidden_size,
+            ExpertFFN(config.num_experts, config.hidden_size,
+                      config.intermediate_size, activation="swiglu"),
+            gate={"type": config.gate_type, "top_k": config.moe_top_k},
+            recompute_interval=1 if config.use_recompute else 0)
+
+    def forward(self, x, cos, sin, position_offset=0, kv_cache=None):
+        attn_in = self.input_layernorm(x)
+        if kv_cache is not None:
+            attn_out, new_cache = self.self_attn(attn_in, cos, sin,
+                                                 position_offset, kv_cache)
+        else:
+            new_cache = None
+            if self.use_recompute and self.training:
+                from ..distributed.fleet.recompute import recompute
+                attn_out = recompute(self.self_attn, attn_in, cos, sin,
+                                     position_offset=position_offset)
+            else:
+                attn_out = self.self_attn(attn_in, cos, sin,
+                                          position_offset)
+        x = x + attn_out
+        x = x + self.moe(self.post_attention_layernorm(x))
+        if new_cache is not None:
+            return x, new_cache
+        return x
+
+
+class LlamaMoeModel(Layer):
+    def __init__(self, config: LlamaMoeConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size,
+                                      weight_attr=Normal(std=0.02))
+        self.layers = LayerList([LlamaMoeDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        cos, sin = _rope_tables(
+            config.hidden_size // config.num_attention_heads,
+            config.max_position_embeddings, config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, position_offset=0, kv_caches=None):
+        x = self.embed_tokens(input_ids)
+        new_caches = [] if kv_caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if kv_caches is not None:
+                x, cache = layer(x, self.rope_cos, self.rope_sin,
+                                 position_offset, kv_caches[i])
+                new_caches.append(cache)
+            else:
+                # recompute happens INSIDE the layer (attention + expert
+                # FFN blocks) so the gate's aux-loss side channel stays
+                # on the grad path — see LlamaMoeDecoderLayer
+                x = layer(x, self.rope_cos, self.rope_sin, position_offset)
+        x = self.norm(x)
+        if new_caches is not None:
+            return x, new_caches
+        return x
+
+    def aux_loss(self):
+        """Sum of per-layer gate load-balancing losses (cleared on read,
+        like the reference's gate.get_loss(clear=True) contract)."""
+        total = None
+        for layer in self.layers:
+            la = layer.moe.gate.get_loss(clear=True)
+            if la is None:
+                continue
+            total = la if total is None else total + la
+        return total
+
+
+class LlamaMoeForCausalLM(Layer):
+    """Causal LM over the MoE decoder.
+
+    ``forward`` returns ``(logits, aux)`` — the gate's weighted
+    load-balancing loss rides next to the logits so a TrainStep
+    ``loss_fn(outputs, labels)`` can add it inside the one compiled
+    program: ``loss = ce(logits, labels) + aux``.
+    """
+
+    def __init__(self, config: LlamaMoeConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaMoeModel(config)
+        self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                              weight_attr=Normal(std=0.02), bias_attr=False)
+
+    def forward(self, input_ids):
+        hidden = self.model(input_ids)
+        logits = self.lm_head(hidden)
+        aux = self.model.aux_loss()
+        if aux is None:
+            from .. import tensor as T
+            aux = T.zeros([], dtype="float32")
+        return logits, aux * self.config.aux_loss_weight
+
+
+def shard_llama_moe(model: LlamaMoeForCausalLM, mesh, dp_axis="dp",
+                    tp_axis=None, ep_axis="ep"):
+    """Canonical hybrid placements for the MoE decoder: expert weights
+    Shard(0) over ``ep_axis`` (GSPMD inserts the token all_to_all the
+    reference issues by hand — moe_layer.py:119,167 global_scatter/
+    global_gather), gates replicated, and optionally Megatron TP on the
+    attention projections + lm_head over ``tp_axis``.  Data rides
+    ``dp_axis`` via the input sharding (caller's batch placement)."""
+    from ..distributed.auto_parallel.placement import Shard, Replicate
+    from ..distributed.auto_parallel.api import shard_tensor
+    from ..incubate.distributed.models.moe import shard_moe_layer
+
+    def place(param, tp_dim):
+        placements = [Replicate()] * mesh.ndim
+        if tp_axis and tp_axis in mesh.dim_names and tp_dim is not None:
+            if param.shape[tp_dim] % mesh.get_dim_size(tp_axis) == 0:
+                placements[mesh.dim_names.index(tp_axis)] = Shard(tp_dim)
+        shard_tensor(param, mesh, placements)
+
+    place(model.model.embed_tokens.weight, None)
+    place(model.lm_head.weight, 1)
+    for layer in model.model.layers:
+        attn = layer.self_attn
+        place(attn.q_proj.weight, 1)        # column-parallel
+        place(attn.k_proj.weight, 1)
+        place(attn.v_proj.weight, 1)
+        place(attn.o_proj.weight, 0)        # row-parallel
+        place(layer.input_layernorm.weight, None)
+        place(layer.post_attention_layernorm.weight, None)
+        shard_moe_layer(layer.moe, mesh, axis=ep_axis)
+    return model

@@ -56,7 +56,7 @@ def run() -> dict:
 
     x = paddle.to_tensor(ids[:, :-1])
     y = paddle.to_tensor(ids[:, 1:])
-    hbm = int((dev.memory_stats() or {}).get("bytes_limit", 8 << 30))
+    hbm = bench.hbm_bytes_limit(dev)
     out = {"config": "llama_110m b4 s1024", "device_kind": dev.device_kind}
     for name, fused in (("unfused", False), ("fused_ce", True)):
         step = build(fused)
@@ -64,7 +64,7 @@ def run() -> dict:
         # same OOM discipline as the capture ladder: an arm that does
         # not fit is recorded as rejected, never run
         planned = bench.planned_peak_bytes(mem)
-        if planned > 0.8 * hbm:
+        if planned > bench.HBM_SAFETY_FRACTION * hbm:
             out[name] = {"status": "memory_gate_rejected",
                          "planned_bytes": int(planned),
                          "hbm_bytes_limit": hbm}

@@ -299,26 +299,29 @@ def child() -> int:
                                           np.asarray(ref[i]))
         return got, ref
 
-    try:
-        got, ref = gate_check(logits)
-        err = max(_maxerr(got[3], ref[3]), _maxerr(got[4], ref[4]))
-        entry = {"status": "ok" if err <= 1e-5 else "NUMERICS_MISMATCH",
-                 "max_rel_err": round(err, 8), "tolerance": 1e-5,
-                 "routing_bit_identical": True}
-        if not debug_cpu:
-            pj = jax.jit(functools.partial(topk_gating_pallas, top_k=2,
-                                           capacity=128, normalize=True,
-                                           interpret=False))
-            xj = jax.jit(gate_oracle)
-            entry["pallas_ms"] = round(_time_compiled(pj, logits), 3)
-            entry["xla_ms"] = round(_time_compiled(xj, logits), 3)
-            entry["speedup_vs_xla"] = round(
-                entry["xla_ms"] / max(entry["pallas_ms"], 1e-9), 2)
-    except AssertionError as e:
-        entry = {"status": "ROUTING_MISMATCH", "error": repr(e)[:300]}
-    except Exception as e:  # noqa: BLE001
-        entry = {"status": "error", "error": repr(e)[:500]}
-    record("moe_topk_gating_f32", entry)
+    if doc["kernels"].get("moe_topk_gating_f32", {}).get("status") != "ok":
+        # same already-validated skip as run_case — a later-window flake
+        # must never clobber a hardware-proven result
+        try:
+            got, ref = gate_check(logits)
+            err = max(_maxerr(got[3], ref[3]), _maxerr(got[4], ref[4]))
+            entry = {"status": "ok" if err <= 1e-5 else "NUMERICS_MISMATCH",
+                     "max_rel_err": round(err, 8), "tolerance": 1e-5,
+                     "routing_bit_identical": True}
+            if not debug_cpu:
+                pj = jax.jit(functools.partial(topk_gating_pallas, top_k=2,
+                                               capacity=128, normalize=True,
+                                               interpret=False))
+                xj = jax.jit(gate_oracle)
+                entry["pallas_ms"] = round(_time_compiled(pj, logits), 3)
+                entry["xla_ms"] = round(_time_compiled(xj, logits), 3)
+                entry["speedup_vs_xla"] = round(
+                    entry["xla_ms"] / max(entry["pallas_ms"], 1e-9), 2)
+        except AssertionError as e:
+            entry = {"status": "ROUTING_MISMATCH", "error": repr(e)[:300]}
+        except Exception as e:  # noqa: BLE001
+            entry = {"status": "error", "error": repr(e)[:500]}
+        record("moe_topk_gating_f32", entry)
 
     # ---------------- paged-attention decode ---------------------------
     from paddle_tpu.ops.pallas.paged_attention import (_decode_pallas,

@@ -17,11 +17,15 @@ single chip:
 
 OOM discipline (the reason this file exists instead of just re-running
 bench.py): every ladder rung runs in its own subprocess; before a rung's
-timed loop touches the chip it compiles the whole step AOT and checks
-``TrainStep.memory_analysis()`` (argument+output+temp bytes) against the
-device's ``memory_stats()['bytes_limit']`` with a safety margin.  Rungs
-ascend in size so the first memory-gate rejection stops the climb with the
-chip still healthy.
+timed loop touches the chip it compiles the whole step AOT and checks the
+alias-aware planned peak (``bench.planned_peak_bytes`` over
+``TrainStep.memory_analysis()``) against the device's
+``memory_stats()['bytes_limit']`` with the shared safety margin
+(``bench.HBM_SAFETY_FRACTION``).  A memory-gate rejection costs nothing
+and does NOT stop the climb — later rungs are leaner (fused loss, SGD,
+remat); the climb stops only when a re-probe says the chip is gone.
+Settled rungs (measured ok, or deterministically gate-rejected under the
+same spec) are cached across windows and never re-spend chip time.
 """
 from __future__ import annotations
 
@@ -39,11 +43,9 @@ OUT_JSON = os.path.join(REPO, "BENCH_tpu_opportunistic.json")
 sys.path.insert(0, REPO)
 import bench  # noqa: E402  (repo root; THE baseline constant + step builder)
 
-# Fraction of the reported HBM bytes_limit a rung may plan to use.  The
-# wedge-after-OOM failure mode makes this margin load-bearing: planned
-# bytes are XLA's static analysis and exclude runtime fragmentation.
-SAFETY = 0.80
-DEFAULT_HBM = 8 << 30   # assume one conservative v2-core HBM if stats absent
+# The safety margin and HBM fallback live in bench.py next to
+# planned_peak_bytes — ONE gate policy for ladder, A/B, and headline.
+SAFETY = bench.HBM_SAFETY_FRACTION
 
 # Ascending LLaMA pretrain ladder (BASELINE config 5 shape family).  The
 # 110m rungs are bench.py's full TPU config — reaching one reproduces the
@@ -178,7 +180,7 @@ def run_rung(spec: dict) -> dict:
         return {"name": spec["name"], "status": "not_tpu",
                 "platform": devs[0].platform}
     stats = devs[0].memory_stats() or {}
-    hbm = int(stats.get("bytes_limit", DEFAULT_HBM))
+    hbm = bench.hbm_bytes_limit(devs[0])
 
     est = _estimate_init_bytes(spec["cfg"], spec["batch"], spec["seq"],
                                use_fused=bool(spec.get("use_fused")),

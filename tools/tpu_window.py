@@ -52,8 +52,14 @@ def _have_ab() -> bool:
                                           "fused_ce_ab.json")))
     except Exception:  # noqa: BLE001
         return False
-    return ((doc.get("winner") is not None or "fused_speedup" in doc)
-            and not doc.get("skipped"))
+    if doc.get("skipped"):
+        return False
+    if doc.get("winner") is not None or "fused_speedup" in doc:
+        return True
+    # both arms deterministically memory-gate-rejected IS a settled
+    # answer (the gate is static); re-running cannot change it
+    return all(doc.get(arm, {}).get("status") == "memory_gate_rejected"
+               for arm in ("unfused", "fused_ce"))
 
 
 def _run(cmd, timeout, log_name) -> int:
