@@ -156,9 +156,11 @@ class MoELayer(Layer):
             if kind == "naive":
                 gate = NaiveGate(d_model, self.num_expert, 1, topk=topk)
             elif kind == "switch":
-                gate = SwitchGate(d_model, self.num_expert, 1)
+                # forwarding topk lets SwitchGate's own top-1 assert fire
+                # on a mismatched config instead of silently ignoring it
+                gate = SwitchGate(d_model, self.num_expert, 1, topk=topk)
             else:
-                gate = GShardGate(d_model, self.num_expert, 1)
+                gate = GShardGate(d_model, self.num_expert, 1, topk=topk)
         assert isinstance(gate, BaseGate)
         assert gate.tot_expert == self.num_expert, (
             f"gate routes over {gate.tot_expert} experts but layer holds "

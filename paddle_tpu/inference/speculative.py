@@ -31,13 +31,7 @@ from ..framework.tape import no_grad
 from ..framework.tensor import wrap_array
 
 
-def _empty_caches(model, batch: int):
-    cfg = model.config
-    head_dim = cfg.hidden_size // cfg.num_attention_heads
-    dtype = model.model.embed_tokens.weight._data.dtype
-    empty = wrap_array(jnp.zeros(
-        (batch, 0, cfg.num_key_value_heads, head_dim), dtype))
-    return [(empty, empty) for _ in range(cfg.num_hidden_layers)]
+from ..models.llama import empty_kv_caches as _empty_caches
 
 
 def _trim_caches(caches, length: int):

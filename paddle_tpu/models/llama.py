@@ -272,6 +272,20 @@ class LlamaModel(Layer):
         return x
 
 
+def empty_kv_caches(model, batch: int):
+    """One empty (k, v) cache pair per layer for the eager decode path —
+    THE cache-layout contract shared by ``generate``, speculative
+    decoding, and tests (shape [batch, 0, kv_heads, head_dim] in the
+    embedding dtype; works for any causal LM with ``.config`` and
+    ``.model.embed_tokens``)."""
+    cfg = model.config
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    dtype = model.model.embed_tokens.weight._data.dtype
+    empty = wrap_array(jnp.zeros(
+        (batch, 0, cfg.num_key_value_heads, head_dim), dtype))
+    return [(empty, empty) for _ in range(cfg.num_hidden_layers)]
+
+
 class LlamaForCausalLM(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -315,13 +329,7 @@ class LlamaForCausalLM(Layer):
         with no_grad():
             ids = input_ids
             # prefill: run the prompt once, building the cache
-            head_dim = (self.config.hidden_size
-                        // self.config.num_attention_heads)
-            empty = wrap_array(jnp.zeros(
-                (int(ids.shape[0]), 0, self.config.num_key_value_heads,
-                 head_dim), self.model.embed_tokens.weight._data.dtype))
-            caches = [(empty, empty)
-                      for _ in range(self.config.num_hidden_layers)]
+            caches = empty_kv_caches(self, int(ids.shape[0]))
             hidden, caches = self.model(ids, 0, caches)
             logits = self._logits_of(hidden[:, -1:])
             out_tokens = [ids]

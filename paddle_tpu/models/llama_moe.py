@@ -172,6 +172,7 @@ def shard_llama_moe(model: LlamaMoeForCausalLM, mesh, dp_axis="dp",
         shard_tensor(param, mesh, placements)
 
     place(model.model.embed_tokens.weight, None)
+    place(model.model.norm.weight, None)
     place(model.lm_head.weight, 1)
     for layer in model.model.layers:
         attn = layer.self_attn

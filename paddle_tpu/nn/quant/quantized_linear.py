@@ -40,11 +40,27 @@ def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float32"):
 @def_op("weight_only_linear")
 def weight_only_linear(x, weight, weight_scale=None, bias=None,
                        weight_dtype="int8", arch=None, group_size=-1):
-    """y = x @ dequant(weight) + bias, weight stored int8 [in, out]."""
-    w = weight.astype(x.dtype)
-    if weight_scale is not None:
-        w = w * weight_scale.astype(x.dtype)
-    y = jnp.matmul(x, w)
+    """y = x @ dequant(weight) + bias, weight stored int8 [in, out].
+
+    On TPU the int8 matmul runs through the Pallas weight-only kernel
+    (ops/pallas/quant_matmul.py): weight tiles stream from HBM as int8
+    and dequantize in VMEM, realizing the bandwidth saving the format
+    exists for.  Elsewhere (and for int4) the inline-dequant XLA path."""
+    if (weight_dtype == "int8" and weight.dtype == jnp.int8
+            and weight_scale is not None and group_size == -1):
+        from ...ops.pallas.quant_matmul import weight_only_matmul
+        lead = x.shape[:-1]
+        rows = 1
+        for n in lead:
+            rows *= n
+        y = weight_only_matmul(x.reshape(rows, x.shape[-1]), weight,
+                               weight_scale)
+        y = y.reshape(*lead, weight.shape[-1])
+    else:
+        w = weight.astype(x.dtype)
+        if weight_scale is not None:
+            w = w * weight_scale.astype(x.dtype)
+        y = jnp.matmul(x, w)
     if bias is not None:
         y = y + bias
     return y

@@ -100,12 +100,8 @@ class TestLlamaMoeTraining:
         x, _ = _data(b=2, s=12, seed=4)
         full_logits, _ = model(x)
 
-        from paddle_tpu.framework.tensor import wrap_array
-        import jax.numpy as jnp
-        head_dim = cfg.hidden_size // cfg.num_attention_heads
-        empty = wrap_array(jnp.zeros(
-            (2, 0, cfg.num_key_value_heads, head_dim), jnp.float32))
-        caches = [(empty, empty) for _ in range(cfg.num_hidden_layers)]
+        from paddle_tpu.models.llama import empty_kv_caches
+        caches = empty_kv_caches(model, 2)
         with paddle.no_grad():
             h1, caches = model.model(x[:, :8], 0, caches)
             h2, _ = model.model(x[:, 8:], 8, caches)

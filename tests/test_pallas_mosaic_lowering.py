@@ -174,3 +174,16 @@ class TestMoEGatingLowering:
                                capacity=128, normalize=True,
                                interpret=False)
         lower_tpu(fn, sds(4096, 64, dtype=jnp.float32))
+
+
+class TestQuantMatmulLowering:
+    @pytest.mark.parametrize("shape", [(1, 768, 2048),    # decode step
+                                       (8192, 768, 32000)])  # lm head
+    def test_weight_only_matmul(self, shape):
+        from paddle_tpu.ops.pallas.quant_matmul import (
+            weight_only_matmul_pallas)
+        m, k, n = shape
+        lower_tpu(
+            functools.partial(weight_only_matmul_pallas, interpret=False),
+            sds(m, k), sds(k, n, dtype=jnp.int8),
+            sds(n, dtype=jnp.float32))

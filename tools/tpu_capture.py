@@ -179,7 +179,6 @@ def run_rung(spec: dict) -> dict:
     if devs[0].platform != "tpu":
         return {"name": spec["name"], "status": "not_tpu",
                 "platform": devs[0].platform}
-    stats = devs[0].memory_stats() or {}
     hbm = bench.hbm_bytes_limit(devs[0])
 
     est = _estimate_init_bytes(spec["cfg"], spec["batch"], spec["seq"],
