@@ -53,11 +53,18 @@ class LlamaMoeDecoderLayer(Layer):
         self.self_attn = LlamaAttention(config)
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
                                                 epsilon=config.rms_norm_eps)
+        # switch gating is top-1 by definition — moe_top_k applies to
+        # the gshard/naive gates only (MoELayer's own dict default
+        # supplies switch's top_k=1; forwarding the config's 2 would
+        # trip SwitchGate's assert)
+        gate = {"type": config.gate_type}
+        if config.gate_type != "switch":
+            gate["top_k"] = config.moe_top_k
         self.moe = MoELayer(
             config.hidden_size,
             ExpertFFN(config.num_experts, config.hidden_size,
                       config.intermediate_size, activation="swiglu"),
-            gate={"type": config.gate_type, "top_k": config.moe_top_k},
+            gate=gate,
             recompute_interval=1 if config.use_recompute else 0)
 
     def forward(self, x, cos, sin, position_offset=0, kv_cache=None):

@@ -49,13 +49,9 @@ def weight_only_linear(x, weight, weight_scale=None, bias=None,
     if (weight_dtype == "int8" and weight.dtype == jnp.int8
             and weight_scale is not None and group_size == -1):
         from ...ops.pallas.quant_matmul import weight_only_matmul
-        lead = x.shape[:-1]
-        rows = 1
-        for n in lead:
-            rows *= n
-        y = weight_only_matmul(x.reshape(rows, x.shape[-1]), weight,
+        y = weight_only_matmul(x.reshape(-1, x.shape[-1]), weight,
                                weight_scale)
-        y = y.reshape(*lead, weight.shape[-1])
+        y = y.reshape(*x.shape[:-1], weight.shape[-1])
     else:
         w = weight.astype(x.dtype)
         if weight_scale is not None:

@@ -1,0 +1,30 @@
+"""Every example script runs end to end in smoke mode (the examples are
+the judge-facing entry points; a bit-rotted example is worse than none).
+Each runs in a subprocess so its jax platform/device config stays
+isolated from the test process.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(f for f in os.listdir(os.path.join(REPO, "examples"))
+                  if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("script", EXAMPLES)
+def test_example_smoke(script):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)        # scripts self-provision devices
+    res = subprocess.run(
+        [sys.executable, os.path.join("examples", script), "--smoke"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert res.returncode == 0, (script, res.stdout[-1500:],
+                                 res.stderr[-1500:])
+    assert res.stdout.strip(), script
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 4
