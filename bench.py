@@ -221,6 +221,7 @@ def bench_llama(on_tpu):
     #      winner exists.
     use_fused = False
     remat = False
+    opt_kind = "adamw"
     ladder_decided = False
     if on_tpu:
         import os
@@ -238,12 +239,14 @@ def bench_llama(on_tpu):
                     use_fused = bool(spec.get("use_fused"))
                     remat = bool(spec.get("cfg", {}).get("use_recompute"))
                     batch = int(spec.get("batch", batch))
+                    opt_kind = spec.get("opt", "adamw")
                 else:
                     # rung measured before spec stamping: its result
                     # fields carry the config (loss_path/batch; remat
-                    # rungs are named *_remat*)
+                    # rungs are named *_remat*, sgd rungs *_sgd*)
                     use_fused = rung.get("loss_path") == "fused_ce"
                     remat = "_remat" in head_name
+                    opt_kind = "sgd" if "_sgd" in head_name else "adamw"
                     batch = int(rung.get("batch", batch))
                 ladder_decided = True
         except Exception:   # noqa: BLE001 — no ladder artifact
@@ -282,7 +285,8 @@ def bench_llama(on_tpu):
             # coexisting pre-gate is itself an OOM-wedge risk
             del step, _model
             step, _model = build_llama_train_step(cfg, bf16=True,
-                                                  use_fused=try_fused)
+                                                  use_fused=try_fused,
+                                                  opt_kind=opt_kind)
             ids = rng.integers(0, cfg.vocab_size,
                                (try_batch, seq + 1)).astype("int32")
             x = paddle.to_tensor(ids[:, :-1])
@@ -314,7 +318,9 @@ def bench_llama(on_tpu):
         "vs_baseline": round(tok_s / R01_LLAMA_TOKENS_PER_SEC, 3)
         if on_tpu else 0.0,
         "batch": batch,
-        "path": "jit.TrainStep + optimizer.AdamW(multi_precision) + bf16"
+        "path": "jit.TrainStep + "
+                + ("optimizer.SGD" if opt_kind == "sgd"
+                   else "optimizer.AdamW(multi_precision)") + " + bf16"
                 + (" + fused_linear_cross_entropy" if use_fused else "")
                 + (" + per-layer recompute" if remat else ""),
         **_mfu_fields(step, x, y, tok_s, units, on_tpu, "bf16"),

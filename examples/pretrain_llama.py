@@ -39,7 +39,6 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     import paddle_tpu as paddle
-    import paddle_tpu.nn.functional as F
     import paddle_tpu.optimizer as optim
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.incubate.nn.functional import fused_linear_cross_entropy

@@ -64,8 +64,12 @@ def main():
 
     step = TrainStep(model, loss_fn, opt)
 
+    if 4 % args.mp != 0:
+        raise SystemExit(f"--mp {args.mp} must divide the demo's 4 "
+                         "attention heads (TP shards the head dim)")
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, (8, 33)).astype("int32")
+    rows = 4 * args.dp                 # batch rows divisible by dp
+    ids = rng.integers(0, cfg.vocab_size, (rows, 33)).astype("int32")
     x = paddle.to_tensor(ids[:, :-1])
     y = paddle.to_tensor(ids[:, 1:])
     # batch rows ride the dp axis

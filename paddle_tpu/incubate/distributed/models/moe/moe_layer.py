@@ -156,9 +156,16 @@ class MoELayer(Layer):
             if kind == "naive":
                 gate = NaiveGate(d_model, self.num_expert, 1, topk=topk)
             elif kind == "switch":
-                # forwarding topk lets SwitchGate's own top-1 assert fire
-                # on a mismatched config instead of silently ignoring it
-                gate = SwitchGate(d_model, self.num_expert, 1, topk=topk)
+                # switch routing is top-1 by definition; a config that
+                # says otherwise is corrected with a warning instead of
+                # tripping SwitchGate's assert (every dict caller would
+                # otherwise need this special case)
+                if topk != 1:
+                    import warnings
+                    warnings.warn(
+                        f"switch gate is top-1 by definition; ignoring "
+                        f"top_k={topk}")
+                gate = SwitchGate(d_model, self.num_expert, 1, topk=1)
             else:
                 gate = GShardGate(d_model, self.num_expert, 1, topk=topk)
         assert isinstance(gate, BaseGate)

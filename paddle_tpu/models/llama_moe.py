@@ -27,11 +27,21 @@ from .llama import LlamaAttention, LlamaConfig, _rope_tables
 
 @dataclass
 class LlamaMoeConfig(LlamaConfig):
-    """LlamaConfig + sparse-MoE routing knobs (Mixtral shape family)."""
+    """LlamaConfig + sparse-MoE routing knobs (Mixtral shape family).
+
+    ``moe_top_k=None`` (default) picks the gate's canonical k: 2 for
+    gshard/naive, 1 for switch (switch routing is top-1 by definition;
+    an explicit mismatched k is corrected with a warning by MoELayer).
+    """
     num_experts: int = 8
-    moe_top_k: int = 2
+    moe_top_k: int = None              # None -> gate-appropriate default
     gate_type: str = "gshard"          # gshard | switch | naive
     aux_loss_weight: float = 0.01
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.moe_top_k is None:
+            self.moe_top_k = 1 if self.gate_type == "switch" else 2
 
 
 class LlamaMoeDecoderLayer(Layer):
@@ -53,18 +63,11 @@ class LlamaMoeDecoderLayer(Layer):
         self.self_attn = LlamaAttention(config)
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
                                                 epsilon=config.rms_norm_eps)
-        # switch gating is top-1 by definition — moe_top_k applies to
-        # the gshard/naive gates only (MoELayer's own dict default
-        # supplies switch's top_k=1; forwarding the config's 2 would
-        # trip SwitchGate's assert)
-        gate = {"type": config.gate_type}
-        if config.gate_type != "switch":
-            gate["top_k"] = config.moe_top_k
         self.moe = MoELayer(
             config.hidden_size,
             ExpertFFN(config.num_experts, config.hidden_size,
                       config.intermediate_size, activation="swiglu"),
-            gate=gate,
+            gate={"type": config.gate_type, "top_k": config.moe_top_k},
             recompute_interval=1 if config.use_recompute else 0)
 
     def forward(self, x, cos, sin, position_offset=0, kv_cache=None):
