@@ -191,6 +191,14 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
     if isinstance(p, Tensor):
         p = float(p.item())
+    if not training or p == 0:
+        # no RNG-key fold on the inference path: eval-mode graphs must
+        # not consume randomness (it breaks key-sequence determinism and
+        # drags PRNG ops into exported/traced graphs).  downscale_in_
+        # infer is the one mode that still scales at inference.
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x * 1.0
     return _dropout(x, _random.split_key(), p, training, mode, axis)
 
 

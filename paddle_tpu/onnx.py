@@ -1,15 +1,16 @@
 """Model interchange export (reference: python/paddle/onnx/export.py —
 a paddle2onnx wrapper).
 
-TPU-native: the portable interchange format on the XLA stack is StableHLO
-(versioned, stable serialization), not ONNX — ``export`` emits the same
-shape-polymorphic StableHLO artifact as ``paddle_tpu.jit.save`` and can be
-loaded by any StableHLO consumer (or ``paddle_tpu.jit.load`` /
-``paddle_tpu.inference``).  Direct ONNX emission is NOT implemented:
-``format='onnx'`` always raises NotImplementedError pointing at the
-StableHLO path (converting between the two graph dialects is out of scope;
-ONNX consumers should ingest StableHLO via onnx-mlir or serve the StableHLO
-artifact directly).
+Two formats:
+
+* ``format='stablehlo'`` (default) — the portable interchange format on
+  the XLA stack; same shape-polymorphic artifact as ``jit.save``, loadable
+  by any StableHLO consumer (or ``jit.load`` / ``paddle_tpu.inference``).
+* ``format='onnx'`` — direct ONNX emission (``onnx_export``): the model
+  is traced to jaxpr primitives and each primitive maps to ONNX ops
+  (opset 13), weights become initializers.  Covers the mapped primitive
+  subset (MLPs, conv nets, attention math without custom-kernel calls);
+  an unmapped primitive raises with its name.
 """
 from __future__ import annotations
 
@@ -18,15 +19,14 @@ from . import jit as _jit
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9,
-           format="stablehlo", **configs):
+def export(layer, path, input_spec=None, opset_version=13,
+           format="stablehlo", example_inputs=None, **configs):
     if format == "stablehlo":
         _jit.save(layer, path, input_spec=input_spec)
         return path + ".stablehlo"
     if format == "onnx":
-        raise NotImplementedError(
-            "direct ONNX emission is not implemented; export StableHLO "
-            "(the default) — it is the portable interchange format on the "
-            "XLA stack and any StableHLO consumer (incl. onnx-mlir "
-            "pipelines) can ingest it")
+        from .onnx_export import export_onnx
+        return export_onnx(layer, path, input_spec=input_spec,
+                           example_inputs=example_inputs,
+                           opset_version=opset_version)
     raise ValueError(f"unknown export format: {format}")

@@ -1,0 +1,511 @@
+"""Direct ONNX emission from a traced jaxpr (SURVEY §2 #85; reference:
+python/paddle/onnx/export.py — a paddle2onnx wrapper over the Program).
+
+The TPU-native trick that makes this tractable: models are traced to
+jaxpr PRIMITIVES first, so only the ~30 primitives below need ONNX
+mappings — every composite (softmax, gelu, layernorm, attention math)
+decomposes into them during tracing instead of needing its own
+converter.  Weights become initializers; the file is stock ONNX
+(ir_version 8, opset 13) serialized through a protoc-compiled subset of
+the public onnx.proto schema (onnx_subset.proto — field numbers match
+the published spec, so `onnx.load` and any ONNX runtime can read it).
+
+Scope: inference graphs over the mapped primitives (MLPs, conv nets,
+attention blocks without custom-kernel calls).  An unmapped primitive
+raises with its name — nothing is silently dropped.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from . import onnx_subset_pb2 as OP
+
+_DTYPE = {
+    "float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+    "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+    "uint32": 12, "uint64": 13, "bfloat16": 16,
+}
+
+
+def _elem_type(dtype) -> int:
+    name = np.dtype(dtype).name if "bfloat16" not in str(dtype) \
+        else "bfloat16"
+    try:
+        return _DTYPE[name]
+    except KeyError:
+        raise NotImplementedError(f"ONNX export: dtype {dtype}")
+
+
+def _tensor_proto(name: str, arr: np.ndarray) -> "OP.TensorProto":
+    t = OP.TensorProto()
+    t.name = name
+    t.dims.extend(int(d) for d in arr.shape)
+    if str(arr.dtype) == "bfloat16":
+        # ONNX BFLOAT16 raw encoding: little-endian uint16 truncation
+        arr = np.asarray(arr, dtype=np.float32)
+        bits = (arr.view(np.uint32) >> 16).astype(np.uint16)
+        t.data_type = 16
+        t.raw_data = bits.tobytes()
+        return t
+    t.data_type = _elem_type(arr.dtype)
+    t.raw_data = np.ascontiguousarray(arr).tobytes()
+    return t
+
+
+class _Graph:
+    """Accumulates nodes/initializers while walking the jaxpr."""
+
+    def __init__(self):
+        self.g = OP.GraphProto()
+        self.g.name = "paddle_tpu"
+        self._n = 0
+        self._const_cache: Dict[Any, str] = {}
+
+    def fresh(self, hint="t"):
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def node(self, op_type: str, inputs: Sequence[str], n_out: int = 1,
+             **attrs) -> List[str]:
+        nd = self.g.node.add()
+        nd.op_type = op_type
+        nd.name = self.fresh(op_type.lower())
+        nd.input.extend(inputs)
+        outs = [self.fresh(op_type.lower()) for _ in range(n_out)]
+        nd.output.extend(outs)
+        for k, v in attrs.items():
+            a = nd.attribute.add()
+            a.name = k
+            if isinstance(v, float):
+                a.type = OP.AttributeProto.FLOAT
+                a.f = v
+            elif isinstance(v, bool) or isinstance(v, (int, np.integer)):
+                a.type = OP.AttributeProto.INT
+                a.i = int(v)
+            elif isinstance(v, str):
+                a.type = OP.AttributeProto.STRING
+                a.s = v.encode()
+            elif isinstance(v, (list, tuple)) and all(
+                    isinstance(x, (int, np.integer)) for x in v):
+                a.type = OP.AttributeProto.INTS
+                a.ints.extend(int(x) for x in v)
+            elif isinstance(v, (list, tuple)):
+                a.type = OP.AttributeProto.FLOATS
+                a.floats.extend(float(x) for x in v)
+            else:
+                raise NotImplementedError(f"attr {k}={v!r}")
+        return outs
+
+    def const(self, arr: np.ndarray, hint="const") -> str:
+        key = (arr.dtype.str, arr.shape, arr.tobytes())
+        if key in self._const_cache:
+            return self._const_cache[key]
+        name = self.fresh(hint)
+        self.g.initializer.append(_tensor_proto(name, arr))
+        self._const_cache[key] = name
+        return name
+
+    def value_info(self, coll, name: str, shape, dtype):
+        vi = coll.add()
+        vi.name = name
+        tt = vi.type.tensor_type
+        tt.elem_type = _elem_type(dtype)
+        for d in shape:
+            tt.shape.dim.add().dim_value = int(d)
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+# --------------------------------------------------------------------------
+# primitive -> ONNX emitters.  Each takes (graph, eqn, in_names) and
+# returns the list of output names.
+# --------------------------------------------------------------------------
+_ELEMENTWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow", "rem": "Mod",
+    "neg": "Neg", "exp": "Exp", "log": "Log", "tanh": "Tanh",
+    "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign", "floor": "Floor",
+    "ceil": "Ceil", "erf": "Erf", "logistic": "Sigmoid",
+    "sin": "Sin", "cos": "Cos",
+    "not": "Not", "and": "And", "or": "Or",
+}
+_COMPARE = {"gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
+            "le": "LessOrEqual", "eq": "Equal", "ne": "Equal"}
+
+
+def _emit(g: _Graph, eqn, ins: List[str]) -> List[str]:
+    p = eqn.primitive.name
+    params = eqn.params
+    aval = eqn.outvars[0].aval
+
+    if p in ("stop_gradient", "copy", "device_put"):
+        return [g.node("Identity", ins)[0]]
+    if p == "convert_element_type":
+        return [g.node("Cast", ins,
+                       to=_elem_type(params["new_dtype"]))[0]]
+    if p in _COMPARE:
+        out = g.node(_COMPARE[p], ins)[0]
+        if p == "ne":
+            out = g.node("Not", [out])[0]
+        return [out]
+    if p in _ELEMENTWISE:
+        return [g.node(_ELEMENTWISE[p], ins)[0]]
+    if p == "rsqrt":
+        return [g.node("Reciprocal", [g.node("Sqrt", ins)[0]])[0]]
+    if p == "erfc":                     # 1 - erf(x)
+        one = g.const(np.asarray(1.0, np.dtype(aval.dtype)), "one")
+        return [g.node("Sub", [one, g.node("Erf", ins)[0]])[0]]
+    if p == "erf_inv":
+        raise NotImplementedError("ONNX export: primitive 'erf_inv'")
+    if p == "integer_pow":
+        y = params["y"]
+        e = g.const(np.asarray(float(y), np.float32), "pow")
+        return [g.node("Pow", [ins[0], e])[0]]
+    if p == "square":
+        return [g.node("Mul", [ins[0], ins[0]])[0]]
+    if p == "select_n":
+        # select_n(pred, case0, case1): pred True -> case1
+        assert len(ins) == 3, "select_n with >2 cases unsupported"
+        return [g.node("Where", [ins[0], ins[2], ins[1]])[0]]
+    if p == "reshape" or p == "squeeze" or p == "expand_dims":
+        shp = g.const(np.asarray(aval.shape, np.int64), "shape")
+        return [g.node("Reshape", [ins[0], shp])[0]]
+    if p == "transpose":
+        return [g.node("Transpose", ins,
+                       perm=list(params["permutation"]))[0]]
+    if p == "broadcast_in_dim":
+        shape = list(aval.shape)
+        bdims = list(params["broadcast_dimensions"])
+        in_aval = eqn.invars[0].aval
+        # insert size-1 dims so rank matches, then Expand
+        inter = [1] * len(shape)
+        for src, dst in enumerate(bdims):
+            inter[dst] = in_aval.shape[src]
+        cur = ins[0]
+        if tuple(inter) != tuple(in_aval.shape):
+            shp = g.const(np.asarray(inter, np.int64), "shape")
+            cur = g.node("Reshape", [cur, shp])[0]
+        if tuple(inter) != tuple(shape):
+            shp = g.const(np.asarray(shape, np.int64), "shape")
+            cur = g.node("Expand", [cur, shp])[0]
+        return [cur]
+    if p == "concatenate":
+        return [g.node("Concat", ins, axis=int(params["dimension"]))[0]]
+    if p == "slice":
+        starts = list(params["start_indices"])
+        ends = list(params["limit_indices"])
+        axes = list(range(len(starts)))
+        steps = list(params["strides"] or [1] * len(starts))
+        return [g.node("Slice", [
+            ins[0],
+            g.const(np.asarray(starts, np.int64), "starts"),
+            g.const(np.asarray(ends, np.int64), "ends"),
+            g.const(np.asarray(axes, np.int64), "axes"),
+            g.const(np.asarray(steps, np.int64), "steps")])[0]]
+    if p == "rev":
+        dims = list(params["dimensions"])
+        in_shape = eqn.invars[0].aval.shape
+        return [g.node("Slice", [
+            ins[0],
+            g.const(np.asarray([in_shape[d] - 1 for d in dims],
+                               np.int64), "starts"),
+            g.const(np.asarray([-(in_shape[d] + 1) for d in dims],
+                               np.int64), "ends"),
+            g.const(np.asarray(dims, np.int64), "axes"),
+            g.const(np.asarray([-1] * len(dims), np.int64), "steps")])[0]]
+    if p == "pad":
+        lo, hi, interior = zip(*params["padding_config"])
+        if any(i != 0 for i in interior):
+            raise NotImplementedError("interior padding")
+        if any(x < 0 for x in lo) or any(x < 0 for x in hi):
+            raise NotImplementedError("negative padding")
+        pads = g.const(np.asarray(list(lo) + list(hi), np.int64), "pads")
+        return [g.node("Pad", [ins[0], pads, ins[1]])[0]]
+    if p in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+             "reduce_and", "reduce_or", "argmax", "argmin"):
+        axes = list(params["axes"])
+        if p == "reduce_sum":
+            ax = g.const(np.asarray(axes, np.int64), "axes")
+            return [g.node("ReduceSum", [ins[0], ax], keepdims=0)[0]]
+        if p in ("argmax", "argmin"):
+            (axis,) = axes
+            out = g.node("ArgMax" if p == "argmax" else "ArgMin",
+                         [ins[0]], axis=int(axis), keepdims=0)[0]
+            want = _elem_type(aval.dtype)
+            if want != 7:               # ArgMax emits int64
+                out = g.node("Cast", [out], to=want)[0]
+            return [out]
+        op = {"reduce_max": "ReduceMax", "reduce_min": "ReduceMin",
+              "reduce_prod": "ReduceProd"}.get(p)
+        if op is None:
+            raise NotImplementedError(f"ONNX export: primitive {p}")
+        return [g.node(op, [ins[0]], axes=axes, keepdims=0)[0]]
+    if p == "gather":
+        dn = params["dimension_numbers"]
+        op_aval = eqn.invars[0].aval
+        ss = tuple(params["slice_sizes"])
+        if (tuple(dn.collapsed_slice_dims) == (0,)
+                and tuple(dn.start_index_map) == (0,)
+                and ss[0] == 1 and ss[1:] == tuple(op_aval.shape[1:])):
+            # the embedding-lookup pattern: weight[ids] along axis 0
+            idx_aval = eqn.invars[1].aval
+            shp = g.const(np.asarray(idx_aval.shape[:-1], np.int64),
+                          "shape")
+            idx = g.node("Reshape", [ins[1], shp])[0]
+            idx = g.node("Cast", [idx], to=7)[0]
+            return [g.node("Gather", [ins[0], idx], axis=0)[0]]
+        raise NotImplementedError(
+            "ONNX export: general gather (only axis-0 embedding lookup "
+            "is mapped)")
+    if p == "dot_general":
+        return _emit_dot(g, eqn, ins)
+    if p == "conv_general_dilated":
+        return _emit_conv(g, eqn, ins)
+    if p == "reduce_window_max":
+        return _emit_maxpool(g, eqn, ins)
+    if p == "iota":
+        # constant-fold: iota is static
+        shape, dim = params["shape"], params["dimension"]
+        arr = np.reshape(
+            np.broadcast_to(
+                np.arange(shape[dim]).reshape(
+                    [-1 if i == dim else 1 for i in range(len(shape))]),
+                shape),
+            shape).astype(np.dtype(params["dtype"]))
+        return [g.const(arr, "iota")]
+    if p in ("custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr",
+             "remat", "checkpoint", "closed_call", "core_call", "pjit",
+             "jit"):
+        sub = (params.get("call_jaxpr") or params.get("jaxpr")
+               or params.get("fun_jaxpr"))
+        if sub is None:
+            raise NotImplementedError(f"ONNX export: call primitive {p} "
+                                      "without an inlinable jaxpr")
+        closed = sub if hasattr(sub, "jaxpr") else None
+        inner = closed.jaxpr if closed else sub
+        consts = closed.consts if closed else []
+        if p in ("custom_jvp_call", "custom_vjp_call"):
+            # primal function args only (no tangent plumbing at trace)
+            n = len(inner.invars)
+            ins = ins[-n:] if len(ins) >= n else ins
+        return _walk(g, inner, consts, ins)
+    raise NotImplementedError(f"ONNX export: primitive '{p}' is not in "
+                              "the mapped subset")
+
+
+def _emit_dot(g: _Graph, eqn, ins):
+    ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    aval = eqn.outvars[0].aval
+    ln, rn = ins
+    if len(lc) != 1 or len(rc) != 1:
+        raise NotImplementedError("dot_general with multiple contractions")
+    lc, rc = lc[0], rc[0]
+    # canonicalize to numpy-matmul form: batch dims leading and matching,
+    # contraction = lhs last / rhs second-to-last
+    lfree = [d for d in range(lhs.ndim) if d not in lb and d != lc]
+    rfree = [d for d in range(rhs.ndim) if d not in rb and d != rc]
+    lperm = list(lb) + lfree + [lc]
+    if lperm != list(range(lhs.ndim)):
+        ln = g.node("Transpose", [ln], perm=lperm)[0]
+    rperm = list(rb) + [rc] + rfree
+    if rperm != list(range(rhs.ndim)):
+        rn = g.node("Transpose", [rn], perm=rperm)[0]
+    # MatMul broadcasts batch dims from the RIGHT, so with explicit batch
+    # dims each side must carry exactly one free dim — collapse extras
+    # (Reshape around the MatMul) or the exported graph mis-broadcasts
+    K = lhs.shape[lc]
+    bshape = [lhs.shape[d] for d in lb]
+    lf = [lhs.shape[d] for d in lfree]
+    rf = [rhs.shape[d] for d in rfree]
+    need_reshape = bool(bshape) and (len(lf) != 1 or len(rf) != 1)
+    if need_reshape:
+        m = int(np.prod(lf)) if lf else 1
+        n = int(np.prod(rf)) if rf else 1
+        shp = g.const(np.asarray(bshape + [m, K], np.int64), "shape")
+        ln = g.node("Reshape", [ln, shp])[0]
+        shp = g.const(np.asarray(bshape + [K, n], np.int64), "shape")
+        rn = g.node("Reshape", [rn, shp])[0]
+    out = g.node("MatMul", [ln, rn])[0]
+    if need_reshape:
+        shp = g.const(np.asarray(aval.shape, np.int64), "shape")
+        out = g.node("Reshape", [out, shp])[0]
+    return [out]
+
+
+def _emit_conv(g: _Graph, eqn, ins):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    # NCHW / OIHW / NCHW only (the framework's conv layout)
+    spatial = len(p["window_strides"])
+    want_lhs = (0, 1) + tuple(range(2, 2 + spatial))
+    if (tuple(dn.lhs_spec) != want_lhs or tuple(dn.out_spec) != want_lhs
+            or tuple(dn.rhs_spec) != want_lhs):
+        raise NotImplementedError(
+            f"conv layout {dn} (only NCHW/OIHW supported)")
+    lo_hi = p["padding"]
+    pads = [x[0] for x in lo_hi] + [x[1] for x in lo_hi]
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise NotImplementedError("transposed conv (lhs dilation)")
+    return [g.node(
+        "Conv", ins,
+        strides=list(p["window_strides"]),
+        pads=pads,
+        dilations=list(p["rhs_dilation"]),
+        group=int(p["feature_group_count"]))[0]]
+
+
+def _emit_maxpool(g: _Graph, eqn, ins):
+    p = eqn.params
+    wd = list(p["window_dimensions"])
+    ws = list(p["window_strides"])
+    pad = list(p["padding"])
+    if wd[0] != 1 or wd[1] != 1:
+        raise NotImplementedError("pooling over batch/channel dims")
+    pads = [x[0] for x in pad[2:]] + [x[1] for x in pad[2:]]
+    return [g.node("MaxPool", ins, kernel_shape=wd[2:],
+                   strides=ws[2:], pads=pads)[0]]
+
+
+def _live_eqns(jaxpr):
+    """Dead-code elimination: equations whose outputs never reach the
+    jaxpr outputs are skipped entirely (e.g. RNG-key folds left behind
+    by eval-mode paths) — their consts are then never materialized."""
+    from jax.extend.core import Literal
+
+    live = {v for v in jaxpr.outvars if not isinstance(v, Literal)}
+    keep = []
+    for eqn in reversed(jaxpr.eqns):
+        if any(v in live for v in eqn.outvars):
+            keep.append(eqn)
+            for v in eqn.invars:
+                if not isinstance(v, Literal):
+                    live.add(v)
+    keep.reverse()
+    return keep
+
+
+def _walk(g: _Graph, jaxpr, consts, in_names: List[str]) -> List[str]:
+    """Emit nodes for one (sub)jaxpr; returns its output names."""
+    from jax.extend.core import Literal
+
+    env: Dict[Any, str] = {}
+    for var, name in zip(jaxpr.invars, in_names):
+        env[var] = name
+    const_map = dict(zip(jaxpr.constvars, consts))
+
+    def read(v):
+        if isinstance(v, Literal):
+            return g.const(np.asarray(v.val), "lit")
+        if v not in env and v in const_map:
+            # lazily materialized: dead consts (e.g. PRNG keys behind
+            # DCE'd random ops) never need a numpy conversion
+            env[v] = g.const(_np(const_map[v]), "const")
+        return env[v]
+
+    for eqn in _live_eqns(jaxpr):
+        ins = [read(v) for v in eqn.invars]
+        outs = _emit(g, eqn, ins)
+        for var, name in zip(eqn.outvars, outs):
+            env[var] = name
+    return [read(v) for v in jaxpr.outvars]
+
+
+def export_onnx(layer, path: str, input_spec=None, example_inputs=None,
+                opset_version: int = 13) -> str:
+    """Trace ``layer``'s forward to a jaxpr and serialize it as ONNX.
+
+    ``example_inputs``: concrete Tensors/arrays (preferred), or
+    ``input_spec``: a list of InputSpec-likes with .shape/.dtype.
+    Returns the written path (``path`` + '.onnx' unless already given).
+    """
+    if not 13 <= int(opset_version) <= 17:
+        # the emitted op forms (ReduceSum axes-as-input, ReduceMax
+        # axes-as-attribute, GreaterOrEqual, ...) are exactly the
+        # opset-13..17 shapes; stamping any other version would produce
+        # a self-inconsistent file that runtimes reject at load
+        raise ValueError(
+            f"opset_version {opset_version} unsupported: the exporter "
+            "emits opset 13-17 op forms")
+    import jax
+    import jax.numpy as jnp
+    from ..framework.tape import no_grad
+    from ..framework.tensor import Tensor, wrap_array
+
+    if example_inputs is None:
+        if input_spec is None:
+            raise ValueError("provide example_inputs or input_spec")
+        example_inputs = [
+            wrap_array(jnp.zeros(
+                [1 if (d is None or int(d) < 0) else int(d)
+                 for d in s.shape],
+                getattr(s, "dtype", "float32") or "float32"))
+            for s in input_spec]
+    example_inputs = [x if isinstance(x, Tensor) else wrap_array(
+        jnp.asarray(x)) for x in example_inputs]
+
+    params = [p for _, p in layer.named_parameters()]
+    pnames = [n for n, _ in layer.named_parameters()]
+
+    def fn(param_arrays, *input_arrays):
+        saved = [p._data for p in params]
+        try:
+            for p, a in zip(params, param_arrays):
+                p._data = a
+            with no_grad():
+                out = layer(*[wrap_array(a) for a in input_arrays])
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            return [o._data if isinstance(o, Tensor) else o for o in outs]
+        finally:
+            for p, s in zip(params, saved):
+                p._data = s
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()                       # inference graph (no dropout)
+    try:
+        closed = jax.make_jaxpr(fn)(
+            [p._data for p in params],
+            *[x._data for x in example_inputs])
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+    g = _Graph()
+    # jaxpr invars = flattened [param_arrays..., inputs...]
+    n_params = len(params)
+    in_names = []
+    for i, var in enumerate(closed.jaxpr.invars):
+        if i < n_params:
+            name = pnames[i].replace(".", "/")
+            g.g.initializer.append(
+                _tensor_proto(name, _np(params[i]._data)))
+        else:
+            name = f"input_{i - n_params}"
+            av = var.aval
+            g.value_info(g.g.input, name, av.shape, av.dtype)
+        in_names.append(name)
+    out_names = _walk(g, closed.jaxpr, closed.consts, in_names)
+    for i, (name, var) in enumerate(zip(out_names, closed.jaxpr.outvars)):
+        av = var.aval
+        # graph outputs must be named node outputs, not initializers
+        final = g.node("Identity", [name])[0]
+        g.value_info(g.g.output, final, av.shape, av.dtype)
+
+    m = OP.ModelProto()
+    m.ir_version = 8
+    m.producer_name = "paddle_tpu"
+    m.producer_version = "0.1"
+    op = m.opset_import.add()
+    op.domain = ""
+    op.version = opset_version
+    m.graph.CopyFrom(g.g)
+    if not path.endswith(".onnx"):
+        path = path + ".onnx"
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+    return path

@@ -261,9 +261,9 @@ class TestCoordinatedElasticRestart:
             # heartbeat shows up as a spurious membership restart; 5s ttl
             # made this test flake under load
             ElasticController(store, node_id=f"node-{i}", nnodes=2,
-                              cmd_factory=factory, max_restarts=6,
-                              poll_interval=0.05, rendezvous_timeout=60,
-                              ttl=20.0)
+                              cmd_factory=factory, max_restarts=8,
+                              poll_interval=0.05, rendezvous_timeout=120,
+                              ttl=30.0)
             for i in range(2)
         ]
         codes = {}
@@ -275,7 +275,7 @@ class TestCoordinatedElasticRestart:
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=120)
+            t.join(timeout=240)
         assert not any(t.is_alive() for t in threads), "controllers hung"
         assert codes == {0: 0, 1: 0}, codes
 

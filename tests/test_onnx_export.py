@@ -1,0 +1,309 @@
+"""Direct ONNX emission (SURVEY #85; reference python/paddle/onnx/export.py).
+
+The semantic check is an INDEPENDENT numpy evaluator implementing ONNX
+operator semantics from the public spec: the exported graph is parsed
+back through the protoc-generated schema and executed with numpy; its
+outputs must match the framework forward.  A wrong primitive mapping
+(flipped transpose, bad pads order, wrong Where arm) fails numerically
+here even though the file would still parse.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.onnx_export import onnx_subset_pb2 as OP
+
+
+# ------------------------------------------------------ numpy ONNX runtime
+def _attr(node, name, default=None):
+    for a in node.attribute:
+        if a.name == name:
+            if a.type == OP.AttributeProto.INT:
+                return a.i
+            if a.type == OP.AttributeProto.FLOAT:
+                return a.f
+            if a.type == OP.AttributeProto.INTS:
+                return list(a.ints)
+            if a.type == OP.AttributeProto.FLOATS:
+                return list(a.floats)
+            if a.type == OP.AttributeProto.STRING:
+                return a.s.decode()
+    return default
+
+
+def _decode_tensor(t):
+    dt = {1: np.float32, 3: np.int8, 6: np.int32, 7: np.int64,
+          9: np.bool_, 11: np.float64}[t.data_type]
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dt).reshape(list(t.dims)).copy()
+    if t.data_type == 1:
+        return np.asarray(t.float_data, dt).reshape(list(t.dims))
+    return np.asarray(t.int64_data, dt).reshape(list(t.dims))
+
+
+def run_onnx(path, feeds):
+    """Execute the graph with numpy, ONNX semantics per the spec."""
+    m = OP.ModelProto()
+    m.ParseFromString(open(path, "rb").read())
+    g = m.graph
+    env = dict(feeds)
+    for init in g.initializer:
+        env[init.name] = _decode_tensor(init)
+
+    for nd in g.node:
+        i = [env[x] for x in nd.input]
+        op = nd.op_type
+        if op == "Identity":
+            o = [i[0]]
+        elif op == "MatMul":
+            o = [np.matmul(i[0], i[1])]
+        elif op in ("Add", "Sub", "Mul", "Div", "Pow"):
+            f = {"Add": np.add, "Sub": np.subtract, "Mul": np.multiply,
+                 "Div": np.divide, "Pow": np.power}[op]
+            o = [f(i[0], i[1])]
+        elif op in ("Max", "Min"):
+            f = np.maximum if op == "Max" else np.minimum
+            r = i[0]
+            for x in i[1:]:
+                r = f(r, x)
+            o = [r]
+        elif op == "Neg":
+            o = [-i[0]]
+        elif op == "Exp":
+            o = [np.exp(i[0])]
+        elif op == "Log":
+            o = [np.log(i[0])]
+        elif op == "Tanh":
+            o = [np.tanh(i[0])]
+        elif op == "Sqrt":
+            o = [np.sqrt(i[0])]
+        elif op == "Reciprocal":
+            o = [1.0 / i[0]]
+        elif op == "Sigmoid":
+            o = [1.0 / (1.0 + np.exp(-i[0]))]
+        elif op == "Erf":
+            from scipy.special import erf
+            o = [erf(i[0]).astype(i[0].dtype)]
+        elif op == "Where":
+            o = [np.where(i[0], i[1], i[2])]
+        elif op in ("Greater", "Less", "GreaterOrEqual", "LessOrEqual",
+                    "Equal"):
+            f = {"Greater": np.greater, "Less": np.less,
+                 "GreaterOrEqual": np.greater_equal,
+                 "LessOrEqual": np.less_equal, "Equal": np.equal}[op]
+            o = [f(i[0], i[1])]
+        elif op == "Not":
+            o = [~i[0]]
+        elif op == "Cast":
+            to = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
+                  11: np.float64}[_attr(nd, "to")]
+            o = [i[0].astype(to)]
+        elif op == "Reshape":
+            o = [i[0].reshape([int(d) for d in i[1]])]
+        elif op == "Transpose":
+            o = [np.transpose(i[0], _attr(nd, "perm"))]
+        elif op == "Expand":
+            o = [np.broadcast_to(i[0], [int(d) for d in i[1]]).copy()]
+        elif op == "Concat":
+            o = [np.concatenate(i, axis=_attr(nd, "axis"))]
+        elif op == "Slice":
+            data, starts, ends, axes, steps = i
+            sl = [slice(None)] * data.ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                s, e, st = int(s), int(e), int(st)
+                sl[int(ax)] = slice(s, None if e < -data.shape[int(ax)]
+                                    else e, st)
+            o = [data[tuple(sl)]]
+        elif op == "ReduceSum":
+            axes = tuple(int(a) for a in i[1])
+            o = [np.sum(i[0], axis=axes,
+                        keepdims=bool(_attr(nd, "keepdims", 1)))]
+        elif op in ("ReduceMax", "ReduceMin", "ReduceProd"):
+            f = {"ReduceMax": np.max, "ReduceMin": np.min,
+                 "ReduceProd": np.prod}[op]
+            o = [f(i[0], axis=tuple(_attr(nd, "axes")),
+                   keepdims=bool(_attr(nd, "keepdims", 1)))]
+        elif op in ("ArgMax", "ArgMin"):
+            f = np.argmax if op == "ArgMax" else np.argmin
+            o = [f(i[0], axis=_attr(nd, "axis")).astype(np.int64)]
+        elif op == "Conv":
+            o = [_np_conv(i[0], i[1], i[2] if len(i) > 2 else None,
+                          _attr(nd, "strides"), _attr(nd, "pads"),
+                          _attr(nd, "dilations"), _attr(nd, "group", 1))]
+        elif op == "MaxPool":
+            o = [_np_maxpool(i[0], _attr(nd, "kernel_shape"),
+                             _attr(nd, "strides"), _attr(nd, "pads"))]
+        elif op == "Gather":
+            o = [np.take(i[0], i[1].astype(np.int64),
+                         axis=_attr(nd, "axis", 0))]
+        elif op == "Pad":
+            pads = [int(x) for x in i[1]]
+            n = len(pads) // 2
+            o = [np.pad(i[0], list(zip(pads[:n], pads[n:])),
+                        constant_values=float(i[2]) if len(i) > 2 else 0)]
+        else:
+            raise NotImplementedError(f"numpy runtime: {op}")
+        for name, val in zip(nd.output, o):
+            env[name] = val
+    return [env[vi.name] for vi in g.output]
+
+
+def _np_conv(x, w, b, strides, pads, dil, group):
+    n = x.ndim - 2
+    lo, hi = pads[:n], pads[n:]
+    x = np.pad(x, [(0, 0), (0, 0)] + list(zip(lo, hi)))
+    B, C, H, W = x.shape
+    O, I, kh, kw = w.shape
+    sh, sw = strides
+    dh, dw = dil
+    oh = (H - (kh - 1) * dh - 1) // sh + 1
+    ow = (W - (kw - 1) * dw - 1) // sw + 1
+    out = np.zeros((B, O, oh, ow), x.dtype)
+    cg = C // group
+    og = O // group
+    for o in range(O):
+        gidx = o // og
+        for y in range(oh):
+            for z in range(ow):
+                patch = x[:, gidx * cg:(gidx + 1) * cg,
+                          y * sh:y * sh + kh * dh:dh,
+                          z * sw:z * sw + kw * dw:dw]
+                out[:, o, y, z] = np.sum(patch * w[o], axis=(1, 2, 3))
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+def _np_maxpool(x, kshape, strides, pads):
+    n = x.ndim - 2
+    lo, hi = pads[:n], pads[n:]
+    x = np.pad(x, [(0, 0), (0, 0)] + list(zip(lo, hi)),
+               constant_values=-np.inf)
+    B, C, H, W = x.shape
+    kh, kw = kshape
+    sh, sw = strides
+    oh = (H - kh) // sh + 1
+    ow = (W - kw) // sw + 1
+    out = np.zeros((B, C, oh, ow), x.dtype)
+    for y in range(oh):
+        for z in range(ow):
+            out[:, :, y, z] = x[:, :, y * sh:y * sh + kh,
+                                z * sw:z * sw + kw].max(axis=(2, 3))
+    return out
+
+
+# ------------------------------------------------------------------- tests
+def _export(layer, x, tmp_path, name):
+    import paddle_tpu.onnx as ponnx
+    return ponnx.export(layer, str(tmp_path / name), format="onnx",
+                        example_inputs=[x])
+
+
+class TestOnnxExport:
+    def test_mlp_softmax(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 32), nn.GELU(),
+                            nn.Linear(32, 8), nn.Softmax(axis=-1))
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (4, 16)).astype("float32"))
+        path = _export(net, x, tmp_path, "mlp")
+        ref = np.asarray(net(x)._data)
+        (got,) = run_onnx(path, {"input_0": np.asarray(x._data)})
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_cnn(self, tmp_path):
+        paddle.seed(1)
+        net = nn.Sequential(
+            nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(8, 4, 3), nn.Sigmoid(),
+            nn.Flatten(), nn.Linear(4 * 6 * 6, 5))
+        x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (2, 3, 16, 16)).astype("float32"))
+        path = _export(net, x, tmp_path, "cnn")
+        ref = np.asarray(net(x)._data)
+        (got,) = run_onnx(path, {"input_0": np.asarray(x._data)})
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_layernorm_residual_block(self, tmp_path):
+        paddle.seed(2)
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.ln = nn.LayerNorm(24)
+                self.fc1 = nn.Linear(24, 48)
+                self.fc2 = nn.Linear(48, 24)
+
+            def forward(self, x):
+                import paddle_tpu.nn.functional as F
+                return x + self.fc2(F.relu(self.fc1(self.ln(x))))
+
+        net = Block()
+        x = paddle.to_tensor(np.random.default_rng(2).standard_normal(
+            (3, 7, 24)).astype("float32"))
+        path = _export(net, x, tmp_path, "block")
+        ref = np.asarray(net(x)._data)
+        (got,) = run_onnx(path, {"input_0": np.asarray(x._data)})
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_bert_classifier(self, tmp_path):
+        # a full transformer encoder: embeddings (Gather), attention
+        # (MatMul/Transpose/softmax decomposition), layernorm, GELU
+        from paddle_tpu.models.bert import (BertConfig,
+                                            BertForSequenceClassification)
+        cfg = BertConfig(hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=2, intermediate_size=128,
+                         vocab_size=512)
+        paddle.seed(4)
+        net = BertForSequenceClassification(cfg)
+        net.eval()
+        ids = paddle.to_tensor(np.random.default_rng(4).integers(
+            0, 512, (2, 16)).astype("int32"))
+        path = _export(net, ids, tmp_path, "bert")
+        ref = np.asarray(net(ids)._data)
+        (got,) = run_onnx(path, {"input_0": np.asarray(ids._data)})
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_resnet_block_exports(self, tmp_path):
+        # resnet18: conv/bn(eval)/relu/maxpool/residuals export end to
+        # end (numerics via the conv-capable numpy runtime on a slice
+        # would be slow; structural + parse check here)
+        from paddle_tpu.vision.models import resnet18
+        paddle.seed(5)
+        net = resnet18(num_classes=10)
+        net.eval()
+        x = paddle.to_tensor(np.random.default_rng(5).standard_normal(
+            (1, 3, 32, 32)).astype("float32"))
+        path = _export(net, x, tmp_path, "resnet18")
+        m = OP.ModelProto()
+        m.ParseFromString(open(path, "rb").read())
+        ops = {n.op_type for n in m.graph.node}
+        assert {"Conv", "MaxPool", "MatMul"} <= ops
+
+    def test_file_is_wellformed_onnx(self, tmp_path):
+        paddle.seed(3)
+        net = nn.Linear(4, 2)
+        x = paddle.to_tensor(np.ones((1, 4), np.float32))
+        path = _export(net, x, tmp_path, "lin")
+        m = OP.ModelProto()
+        m.ParseFromString(open(path, "rb").read())
+        assert m.ir_version == 8
+        assert m.opset_import[0].version == 13
+        assert m.producer_name == "paddle_tpu"
+        assert len(m.graph.input) == 1       # weights are initializers
+        names = {i.name for i in m.graph.initializer}
+        assert any("weight" in n for n in names)
+        assert m.graph.output[0].type.tensor_type.shape.dim[1].dim_value \
+            == 2
+
+    def test_unmapped_primitive_raises_with_name(self, tmp_path):
+        class Weird(nn.Layer):
+            def forward(self, x):
+                import paddle_tpu as pp
+                return pp.cumsum(x, axis=-1)     # cumsum is unmapped
+
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        with pytest.raises(NotImplementedError, match="primitive"):
+            _export(Weird(), x, tmp_path, "weird")
