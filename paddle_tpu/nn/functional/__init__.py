@@ -191,15 +191,12 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
     if isinstance(p, Tensor):
         p = float(p.item())
-    if not training or p == 0:
-        # no RNG-key fold on the inference path: eval-mode graphs must
-        # not consume randomness (it breaks key-sequence determinism and
-        # drags PRNG ops into exported/traced graphs).  downscale_in_
-        # infer is the one mode that still scales at inference.
-        if mode == "downscale_in_infer" and not training:
-            return x * (1.0 - p)
-        return x * 1.0
-    return _dropout(x, _random.split_key(), p, training, mode, axis)
+    # the key is split ONLY when randomness will actually be consumed:
+    # eval-mode graphs must not fold RNG keys (it breaks key-sequence
+    # determinism and drags PRNG ops into exported/traced graphs); the
+    # eval/p==0 semantics themselves live in _dropout, one place
+    key = _random.split_key() if (training and p != 0) else None
+    return _dropout(x, key, p, training, mode, axis)
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):

@@ -169,7 +169,9 @@ def _emit(g: _Graph, eqn, ins: List[str]) -> List[str]:
         return [g.node("Mul", [ins[0], ins[0]])[0]]
     if p == "select_n":
         # select_n(pred, case0, case1): pred True -> case1
-        assert len(ins) == 3, "select_n with >2 cases unsupported"
+        if len(ins) != 3:
+            raise NotImplementedError(
+                "ONNX export: select_n with more than 2 cases")
         return [g.node("Where", [ins[0], ins[2], ins[1]])[0]]
     if p == "reshape" or p == "squeeze" or p == "expand_dims":
         shp = g.const(np.asarray(aval.shape, np.int64), "shape")
@@ -206,6 +208,36 @@ def _emit(g: _Graph, eqn, ins: List[str]) -> List[str]:
             g.const(np.asarray(ends, np.int64), "ends"),
             g.const(np.asarray(axes, np.int64), "axes"),
             g.const(np.asarray(steps, np.int64), "steps")])[0]]
+    if p == "dynamic_slice":
+        # starts ride as scalar operands (constant-folded at export when
+        # literal — the rope-table slice case); sizes are static params.
+        # JAX CLAMPS out-of-range starts into [0, dim - size] so the
+        # output always keeps slice_sizes — reproduce that with
+        # Max(0, Min(starts, dims - sizes)) before the Slice, or the
+        # exported graph shrinks at the boundary where JAX shifts.
+        sizes = list(params["slice_sizes"])
+        dims = list(eqn.invars[0].aval.shape)
+        starts = g.node("Concat", [
+            g.node("Reshape", [g.node("Cast", [s], to=7)[0],
+                               g.const(np.asarray([1], np.int64),
+                                       "shape")])[0]
+            for s in ins[1:]], axis=0)[0]
+        hi = g.const(np.asarray([d - s for d, s in zip(dims, sizes)],
+                                np.int64), "maxstart")
+        zero = g.const(np.zeros(len(sizes), np.int64), "zero")
+        starts = g.node("Max", [g.node("Min", [starts, hi])[0], zero])[0]
+        ends = g.node("Add", [starts,
+                              g.const(np.asarray(sizes, np.int64),
+                                      "sizes")])[0]
+        axes = g.const(np.asarray(range(len(sizes)), np.int64), "axes")
+        return [g.node("Slice", [ins[0], starts, ends, axes])[0]]
+    if p == "dynamic_update_slice":
+        raise NotImplementedError(
+            "ONNX export: primitive 'dynamic_update_slice'")
+    if p == "cumsum":
+        axis = g.const(np.asarray(params["axis"], np.int64), "axis")
+        return [g.node("CumSum", [ins[0], axis],
+                       reverse=int(params.get("reverse", False)))[0]]
     if p == "rev":
         dims = list(params["dimensions"])
         in_shape = eqn.invars[0].aval.shape
@@ -322,7 +354,10 @@ def _emit_dot(g: _Graph, eqn, ins):
     bshape = [lhs.shape[d] for d in lb]
     lf = [lhs.shape[d] for d in lfree]
     rf = [rhs.shape[d] for d in rfree]
-    need_reshape = bool(bshape) and (len(lf) != 1 or len(rf) != 1)
+    # MatMul's numpy-style broadcasting only matches dot_general when
+    # each side carries exactly one free dim (rank-2 rhs with no batch
+    # is the one safe exception, subsumed below by collapsing anyway)
+    need_reshape = len(lf) != 1 or len(rf) != 1
     if need_reshape:
         m = int(np.prod(lf)) if lf else 1
         n = int(np.prod(rf)) if rf else 1
@@ -364,8 +399,17 @@ def _emit_maxpool(g: _Graph, eqn, ins):
     wd = list(p["window_dimensions"])
     ws = list(p["window_strides"])
     pad = list(p["padding"])
-    if wd[0] != 1 or wd[1] != 1:
-        raise NotImplementedError("pooling over batch/channel dims")
+    if wd[0] != 1 or wd[1] != 1 or ws[0] != 1 or ws[1] != 1:
+        raise NotImplementedError(
+            "ONNX export: reduce_window_max pooling over batch/channel "
+            "dims (window or stride != 1 outside spatial dims)")
+    if any(x != (0, 0) for x in pad[:2]):
+        raise NotImplementedError(
+            "ONNX export: reduce_window_max padding on batch/channel")
+    for key in ("base_dilation", "window_dilation"):
+        if any(d != 1 for d in p.get(key) or []):
+            raise NotImplementedError(
+                f"ONNX export: reduce_window_max {key} != 1")
     pads = [x[0] for x in pad[2:]] + [x[1] for x in pad[2:]]
     return [g.node("MaxPool", ins, kernel_shape=wd[2:],
                    strides=ws[2:], pads=pads)[0]]

@@ -189,40 +189,153 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     return res
 
 
+class _LoadedInferenceProgram:
+    """Deserialized frozen inference graph (the object
+    ``load_inference_model`` hands back as its 'program'): holds the
+    StableHLO executable + feed ordering, runnable via ``Executor.run``
+    or directly with ``.call(feed_dict)``."""
+
+    def __init__(self, payload: dict):
+        import jax
+        self._exported = jax.export.deserialize(payload["stablehlo"])
+        self.feed_names = list(payload["feed_names"])
+        self.n_fetch = int(payload["n_fetch"])
+        self.feed_meta = payload.get("feed_meta", [])
+
+    def call(self, feed: dict):
+        import jax.numpy as jnp
+        from ..framework.tensor import Tensor
+        args = []
+        for n in self.feed_names:
+            a = feed[n]
+            args.append(a._data if isinstance(a, Tensor)
+                        else jnp.asarray(a))
+        return list(self._exported.call(*args))
+
+
+def _resolve_program(program):
+    p = program if program is not None else current_program()
+    if p is None:
+        p = default_main_program()
+    return getattr(p, "program", p)
+
+
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
-    """reference: static.save_inference_model — maps to jit.save of the
-    traced function."""
-    raise NotImplementedError(
-        "save_inference_model needs a traced callable on this stack: use "
-        "paddle_tpu.jit.save(layer_or_function, path_prefix) — the "
-        "StableHLO artifact is the inference model format here")
+    """reference: static.save_inference_model — freezes the recorded
+    Program at its current persistable values into ONE shape-polymorphic
+    StableHLO program over the declared feeds (dynamic -1 dims stay
+    dynamic) and writes it to ``path_prefix + '.pdmodel'``.  Weights are
+    baked in, so there is no separate .pdiparams file on this stack."""
+    import pickle
+
+    import jax
+
+    from .program import Program, Variable
+
+    program = _resolve_program(program)
+    if not isinstance(program, Program):
+        raise ValueError("save_inference_model needs a recorded static "
+                         "Program (build under static.program_guard)")
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    for v in feed_vars + fetch_vars:
+        if not isinstance(v, Variable):
+            raise TypeError(f"feed/fetch entries must be static "
+                            f"Variables, got {type(v)}")
+    names = [v.name for v in feed_vars]
+    fetch_ids = [v.var_id for v in fetch_vars]
+    captured = [t._data for t in program.captured]
+
+    n_dynamic = sum(1 for v in feed_vars for d in v.declared_shape
+                    if d < 0)
+    syms = (list(jax.export.symbolic_shape(
+        ",".join(f"_d{i}" for i in range(n_dynamic))))
+        if n_dynamic else [])
+    n_sym = 0
+    specs = []
+    for v in feed_vars:
+        shape = []
+        for d in v.declared_shape:
+            if d < 0:
+                shape.append(syms[n_sym])
+                n_sym += 1
+            else:
+                shape.append(int(d))
+        specs.append(jax.ShapeDtypeStruct(tuple(shape), v._data.dtype))
+
+    def fn(*feeds):
+        return tuple(program._replay(dict(zip(names, feeds)), captured,
+                                     fetch_ids))
+
+    exported = jax.export.export(jax.jit(fn))(*specs)
+    payload = {
+        "stablehlo": exported.serialize(),
+        "feed_names": names,
+        "n_fetch": len(fetch_ids),
+        "feed_meta": [(list(v.declared_shape), str(v._data.dtype))
+                      for v in feed_vars],
+    }
+    path = str(path_prefix) + ".pdmodel"
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+    return path
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
-    raise NotImplementedError(
-        "use paddle_tpu.jit.load(path_prefix) / paddle_tpu.inference."
-        "Config+Predictor — StableHLO is the inference model format here")
+    """reference: static.load_inference_model — returns
+    ``[program, feed_target_names, fetch_targets]`` where ``program`` is
+    runnable via ``Executor.run(program, feed=..., fetch_list=
+    fetch_targets)`` (fetch targets are output positions)."""
+    import pickle
+
+    path = str(path_prefix)
+    if not path.endswith(".pdmodel"):
+        path = path + ".pdmodel"
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    prog = _LoadedInferenceProgram(payload)
+    return [prog, list(prog.feed_names), list(range(prog.n_fetch))]
 
 
 def serialize_program(feed_vars=None, fetch_vars=None, program=None):
-    raise NotImplementedError(
-        "no Program IR on this stack; jit.save writes StableHLO")
+    """The Program 'IR bytes' on this stack ARE the frozen StableHLO
+    payload save_inference_model writes — returned in-memory."""
+    import tempfile
+    import os
+
+    with tempfile.TemporaryDirectory() as td:
+        p = save_inference_model(os.path.join(td, "prog"), feed_vars,
+                                 fetch_vars, program=program)
+        with open(p, "rb") as f:
+            return f.read()
 
 
 def deserialize_program(data):
-    raise NotImplementedError(
-        "no Program IR on this stack; jit.load reads StableHLO")
+    import pickle
+
+    return _LoadedInferenceProgram(pickle.loads(data))
 
 
 def serialize_persistables(feed_vars=None, fetch_vars=None, program=None):
-    raise NotImplementedError(
-        "persistables are the Layer state_dict here: paddle_tpu.save")
+    """Pickle the Program's captured persistable state (name -> array);
+    the inverse of deserialize_persistables."""
+    import pickle
+
+    import numpy as np
+
+    program = _resolve_program(program)
+    state = {}
+    for i, t in enumerate(program.captured):
+        state[getattr(t, "name", "") or f"captured_{i}"] = np.asarray(
+            t._data)
+    return pickle.dumps(state, protocol=4)
 
 
 def deserialize_persistables(program=None, data=None, executor=None):
-    raise NotImplementedError(
-        "persistables are the Layer state_dict here: paddle_tpu.load")
+    import pickle
+
+    set_program_state(_resolve_program(program), pickle.loads(data))
 
 
 def save_to_file(path, content):
@@ -236,8 +349,19 @@ def load_from_file(path):
 
 
 def set_program_state(program, state):
-    raise NotImplementedError(
-        "no Program on this stack; Layer.set_state_dict is the equivalent")
+    """Assign a ``name -> array`` state dict onto the Program's captured
+    persistable tensors (reference: static.set_program_state)."""
+    import jax.numpy as jnp
+
+    program = _resolve_program(program)
+    by_name = {}
+    for i, t in enumerate(program.captured):
+        by_name[getattr(t, "name", "") or f"captured_{i}"] = t
+    for name, arr in state.items():
+        t = by_name.get(name)
+        if t is None:
+            continue
+        t._data = jnp.asarray(arr, t._data.dtype).reshape(t._data.shape)
 
 
 def load_program_state(model_path, var_list=None):
@@ -246,7 +370,29 @@ def load_program_state(model_path, var_list=None):
 
 
 def normalize_program(program, feed_vars, fetch_vars, **kwargs):
-    raise NotImplementedError("no Program IR on this stack")
+    """Prune the Program to the subgraph reachable from ``fetch_vars``
+    (reference: static.normalize_program) — dead ops recorded for other
+    fetches are dropped; the result executes but records no further."""
+    from .program import Program, _Ref
+
+    program = _resolve_program(program)
+    live_vars = {v.var_id for v in fetch_vars}
+    keep = []
+    for op in reversed(program.ops):
+        if any(v in live_vars for v in op.out_ids):
+            keep.append(op)
+            for m in op.leaves:
+                if isinstance(m, _Ref) and m.kind == "v":
+                    live_vars.add(m.idx)
+    keep.reverse()
+    out = Program.__new__(Program)
+    out.ops = keep
+    out.feed_vars = {v.name: v for v in feed_vars}
+    out.captured = program.captured
+    out._captured_ids = dict(program._captured_ids)
+    out._next_var = program._next_var
+    out.version = program.version
+    return out
 
 
 class CompiledProgram:

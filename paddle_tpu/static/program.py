@@ -258,6 +258,16 @@ class Executor:
             fetch_list=None, return_numpy: bool = True, **kwargs):
         if program is None:
             program = current_program()
+        if (program is not None and hasattr(program, "feed_names")
+                and hasattr(program, "call")):
+            # frozen inference program from static.load_inference_model:
+            # fetch_list entries are output positions
+            outs = program.call(dict(feed or {}))
+            sel = ([outs[int(i)] for i in fetch_list]
+                   if fetch_list else outs)
+            if return_numpy:
+                return [np.asarray(o) for o in sel]
+            return [Tensor(o) for o in sel]
         if program is not None and not isinstance(program, Program):
             program = getattr(program, "program", program)  # CompiledProgram
         if program is None or not isinstance(program, Program):

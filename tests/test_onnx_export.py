@@ -108,7 +108,9 @@ def run_onnx(path, feeds):
         elif op == "Concat":
             o = [np.concatenate(i, axis=_attr(nd, "axis"))]
         elif op == "Slice":
-            data, starts, ends, axes, steps = i
+            data, starts, ends = i[0], i[1], i[2]
+            axes = i[3] if len(i) > 3 else np.arange(len(starts))
+            steps = i[4] if len(i) > 4 else np.ones(len(starts), np.int64)
             sl = [slice(None)] * data.ndim
             for s, e, ax, st in zip(starts, ends, axes, steps):
                 s, e, st = int(s), int(e), int(st)
@@ -302,8 +304,27 @@ class TestOnnxExport:
         class Weird(nn.Layer):
             def forward(self, x):
                 import paddle_tpu as pp
-                return pp.cumsum(x, axis=-1)     # cumsum is unmapped
+                return pp.sort(x, axis=-1)       # sort is unmapped
 
-        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 3)).astype("float32"))
         with pytest.raises(NotImplementedError, match="primitive"):
             _export(Weird(), x, tmp_path, "weird")
+
+    def test_llama_decoder_exports(self, tmp_path):
+        # the flagship model family: rope (dynamic_slice + sin/cos),
+        # GQA flash-attention XLA fallback (inlined custom_vjp), rmsnorm,
+        # SwiGLU, tied unembed matmul — all through the primitive subset
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        paddle.seed(6)
+        net = LlamaForCausalLM(LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=2,
+            max_position_embeddings=32))
+        net.eval()
+        ids = paddle.to_tensor(np.random.default_rng(6).integers(
+            0, 128, (2, 8)).astype("int32"))
+        path = _export(net, ids, tmp_path, "llama")
+        ref = np.asarray(net(ids)._data)
+        (got,) = run_onnx(path, {"input_0": np.asarray(ids._data)})
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)

@@ -176,3 +176,93 @@ class TestFeedRedeclareAndAmp:
         np.testing.assert_allclose(
             got.astype(np.float32),
             np.asarray(eager._data, dtype=np.float32), rtol=1e-2)
+
+
+class TestInferenceModelSaveLoad:
+    def test_save_load_round_trip(self, tmp_path):
+        # reference workflow: build under program_guard, freeze with
+        # save_inference_model, reload in a fresh consumer, Executor.run
+        paddle.seed(21)
+        fc1, fc2 = nn.Linear(6, 12), nn.Linear(12, 3)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [-1, 6], "float32")      # dynamic batch
+            out = F.softmax(fc2(F.relu(fc1(x))), axis=-1)
+        path = static.save_inference_model(str(tmp_path / "m"), [x],
+                                           [out], program=main)
+        assert path.endswith(".pdmodel")
+
+        prog, feed_names, fetch_targets = static.load_inference_model(
+            str(tmp_path / "m"))
+        assert feed_names == ["x"]
+        exe = static.Executor()
+        for batch in (2, 5):                              # poly batch dim
+            x_np = np.random.default_rng(batch).standard_normal(
+                (batch, 6)).astype("float32")
+            (got,) = exe.run(prog, feed={"x": x_np},
+                             fetch_list=fetch_targets)
+            ref = _mlp_eager(fc1, fc2, x_np)
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_weights_are_frozen_at_save(self, tmp_path):
+        paddle.seed(22)
+        fc = nn.Linear(4, 2)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1, 4], "float32")
+            out = fc(x)
+        path = static.save_inference_model(str(tmp_path / "f"), [x],
+                                           [out], program=main)
+        x_np = np.ones((1, 4), np.float32)
+        before = np.asarray(fc(paddle.to_tensor(x_np))._data)
+        # mutate the live parameter AFTER saving; the artifact must not
+        # follow (frozen weights = inference-model semantics)
+        fc.weight._data = fc.weight._data * 0.0
+        prog, _, fetch = static.load_inference_model(str(tmp_path / "f"))
+        (got,) = static.Executor().run(prog, feed={"x": x_np},
+                                       fetch_list=fetch)
+        np.testing.assert_allclose(got, before, rtol=1e-6)
+
+    def test_serialize_roundtrip_and_program_state(self):
+        paddle.seed(23)
+        fc = nn.Linear(5, 5)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 5], "float32")
+            out = fc(x)
+        blob = static.serialize_program([x], [out], program=main)
+        prog = static.deserialize_program(blob)
+        x_np = np.random.default_rng(23).standard_normal(
+            (2, 5)).astype("float32")
+        (got,) = static.Executor().run(prog, feed={"x": x_np},
+                                       fetch_list=[0])
+        np.testing.assert_allclose(
+            got, np.asarray(fc(paddle.to_tensor(x_np))._data), rtol=1e-5)
+
+        # persistables round trip through set_program_state
+        pstate = static.serialize_persistables(program=main)
+        saved = {k: v.copy() for k, v in
+                 __import__("pickle").loads(pstate).items()}
+        for t in main.captured:
+            t._data = t._data * 0.0
+        static.deserialize_persistables(program=main, data=pstate)
+        for i, t in enumerate(main.captured):
+            name = getattr(t, "name", "") or f"captured_{i}"
+            np.testing.assert_allclose(np.asarray(t._data), saved[name])
+
+    def test_normalize_program_prunes_dead_ops(self):
+        paddle.seed(24)
+        fc1, fc2 = nn.Linear(4, 4), nn.Linear(4, 4)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 4], "float32")
+            kept = F.relu(fc1(x))
+            _dead = F.sigmoid(fc2(x))        # other fetch, pruned away
+        slim = static.normalize_program(main, [x], [kept])
+        assert len(slim.ops) < len(main.ops)
+        x_np = np.random.default_rng(24).standard_normal(
+            (2, 4)).astype("float32")
+        (got,) = static.Executor().run(slim, feed={"x": x_np},
+                                       fetch_list=[kept])
+        ref = np.maximum(np.asarray(fc1(paddle.to_tensor(x_np))._data), 0)
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
