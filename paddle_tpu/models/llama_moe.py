@@ -161,6 +161,16 @@ class LlamaMoeForCausalLM(Layer):
             aux = T.zeros([], dtype="float32")
         return logits, aux * self.config.aux_loss_weight
 
+    def _logits_of(self, hidden):
+        return self.lm_head(hidden)
+
+    # the cache-path decode loop is model-agnostic (it drives
+    # self.model(ids, offset, caches) + self._logits_of) — reuse the
+    # dense LLaMA implementation verbatim
+    from .llama import LlamaForCausalLM as _Dense
+    generate = _Dense.generate
+    del _Dense
+
 
 def shard_llama_moe(model: LlamaMoeForCausalLM, mesh, dp_axis="dp",
                     tp_axis=None, ep_axis="ep"):

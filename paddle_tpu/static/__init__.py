@@ -220,18 +220,19 @@ def _resolve_program(program):
     return getattr(p, "program", p)
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         program=None, **kwargs):
-    """reference: static.save_inference_model — freezes the recorded
-    Program at its current persistable values into ONE shape-polymorphic
-    StableHLO program over the declared feeds (dynamic -1 dims stay
-    dynamic) and writes it to ``path_prefix + '.pdmodel'``.  Weights are
-    baked in, so there is no separate .pdiparams file on this stack."""
-    import pickle
+def _build_inference_payload(feed_vars, fetch_vars, program):
+    """Freeze (program, feeds, fetches) into the .pdmodel payload dict:
+    the fetched subgraph is pruned first (normalize_program), weights
+    bake in at their current values, -1 dims stay dynamic.
 
+    Dynamic-dim policy: -1 dims at the SAME axis position share one
+    export symbol across feeds (the batch convention — x[-1, 6] and
+    mask[-1] export with one shared batch dim).  Independent dynamic
+    dims belong on different axis positions.
+    """
     import jax
 
-    from .program import Program, Variable
+    from .program import Program, Variable, _Ref
 
     program = _resolve_program(program)
     if not isinstance(program, Program):
@@ -243,39 +244,59 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         if not isinstance(v, Variable):
             raise TypeError(f"feed/fetch entries must be static "
                             f"Variables, got {type(v)}")
+    # prune to the fetched subgraph so dead branches (other fetches,
+    # other feeds) are neither traced nor baked into the artifact
+    pruned = normalize_program(program, feed_vars, fetch_vars)
+    feed_ids = {v.var_id for v in feed_vars}
+    needed = {m.idx for op in pruned.ops for m in op.leaves
+              if isinstance(m, _Ref) and m.kind == "v"}
+    produced = {vid for op in pruned.ops for vid in op.out_ids}
+    missing = needed - produced - feed_ids
+    if missing:
+        by_id = {v.var_id: n for n, v in program.feed_vars.items()}
+        raise ValueError(
+            "the fetched subgraph reads feeds not in feed_vars: "
+            f"{sorted(by_id.get(i, f'var_{i}') for i in missing)}")
+
     names = [v.name for v in feed_vars]
     fetch_ids = [v.var_id for v in fetch_vars]
-    captured = [t._data for t in program.captured]
+    captured = [t._data for t in pruned.captured]
 
-    n_dynamic = sum(1 for v in feed_vars for d in v.declared_shape
-                    if d < 0)
+    max_rank = max((len(v.declared_shape) for v in feed_vars), default=0)
     syms = (list(jax.export.symbolic_shape(
-        ",".join(f"_d{i}" for i in range(n_dynamic))))
-        if n_dynamic else [])
-    n_sym = 0
+        ",".join(f"_d{i}" for i in range(max_rank))))
+        if any(d < 0 for v in feed_vars for d in v.declared_shape)
+        else [])
     specs = []
     for v in feed_vars:
-        shape = []
-        for d in v.declared_shape:
-            if d < 0:
-                shape.append(syms[n_sym])
-                n_sym += 1
-            else:
-                shape.append(int(d))
+        shape = [syms[axis] if d < 0 else int(d)
+                 for axis, d in enumerate(v.declared_shape)]
         specs.append(jax.ShapeDtypeStruct(tuple(shape), v._data.dtype))
 
     def fn(*feeds):
-        return tuple(program._replay(dict(zip(names, feeds)), captured,
-                                     fetch_ids))
+        return tuple(pruned._replay(dict(zip(names, feeds)), captured,
+                                    fetch_ids))
 
     exported = jax.export.export(jax.jit(fn))(*specs)
-    payload = {
+    return {
         "stablehlo": exported.serialize(),
         "feed_names": names,
         "n_fetch": len(fetch_ids),
         "feed_meta": [(list(v.declared_shape), str(v._data.dtype))
                       for v in feed_vars],
     }
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """reference: static.save_inference_model — freezes the recorded
+    Program at its current persistable values into ONE shape-polymorphic
+    StableHLO program over the declared feeds (dynamic -1 dims stay
+    dynamic) and writes it to ``path_prefix + '.pdmodel'``.  Weights are
+    baked in, so there is no separate .pdiparams file on this stack."""
+    import pickle
+
+    payload = _build_inference_payload(feed_vars, fetch_vars, program)
     path = str(path_prefix) + ".pdmodel"
     with open(path, "wb") as f:
         pickle.dump(payload, f, protocol=4)
@@ -300,21 +321,33 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 
 def serialize_program(feed_vars=None, fetch_vars=None, program=None):
     """The Program 'IR bytes' on this stack ARE the frozen StableHLO
-    payload save_inference_model writes — returned in-memory."""
-    import tempfile
-    import os
+    payload save_inference_model writes — built in memory."""
+    import pickle
 
-    with tempfile.TemporaryDirectory() as td:
-        p = save_inference_model(os.path.join(td, "prog"), feed_vars,
-                                 fetch_vars, program=program)
-        with open(p, "rb") as f:
-            return f.read()
+    return pickle.dumps(
+        _build_inference_payload(feed_vars, fetch_vars, program),
+        protocol=4)
 
 
 def deserialize_program(data):
     import pickle
 
     return _LoadedInferenceProgram(pickle.loads(data))
+
+
+def _persistable_keys(program):
+    """Deterministic unique key per captured tensor: the tensor name,
+    disambiguated with ``#<n>`` when two captures share one (no global
+    name uniquing exists on this stack) — the serialize and restore
+    sides MUST agree on this scheme or colliding weights silently merge."""
+    seen = {}
+    keys = []
+    for i, t in enumerate(program.captured):
+        base = getattr(t, "name", "") or f"captured_{i}"
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        keys.append(base if n == 0 else f"{base}#{n}")
+    return keys
 
 
 def serialize_persistables(feed_vars=None, fetch_vars=None, program=None):
@@ -325,10 +358,9 @@ def serialize_persistables(feed_vars=None, fetch_vars=None, program=None):
     import numpy as np
 
     program = _resolve_program(program)
-    state = {}
-    for i, t in enumerate(program.captured):
-        state[getattr(t, "name", "") or f"captured_{i}"] = np.asarray(
-            t._data)
+    keys = _persistable_keys(program)
+    state = {k: np.asarray(t._data)
+             for k, t in zip(keys, program.captured)}
     return pickle.dumps(state, protocol=4)
 
 
@@ -350,17 +382,19 @@ def load_from_file(path):
 
 def set_program_state(program, state):
     """Assign a ``name -> array`` state dict onto the Program's captured
-    persistable tensors (reference: static.set_program_state)."""
+    persistable tensors (reference: static.set_program_state).  Keys
+    follow ``serialize_persistables``'s scheme; an unknown key raises
+    (the reference errors for params not in the program — a typo must
+    not silently skip a weight)."""
     import jax.numpy as jnp
 
     program = _resolve_program(program)
-    by_name = {}
-    for i, t in enumerate(program.captured):
-        by_name[getattr(t, "name", "") or f"captured_{i}"] = t
+    by_key = dict(zip(_persistable_keys(program), program.captured))
+    unknown = sorted(set(state) - set(by_key))
+    if unknown:
+        raise ValueError(f"state keys not in this program: {unknown}")
     for name, arr in state.items():
-        t = by_name.get(name)
-        if t is None:
-            continue
+        t = by_key[name]
         t._data = jnp.asarray(arr, t._data.dtype).reshape(t._data.shape)
 
 

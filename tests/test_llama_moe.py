@@ -141,3 +141,17 @@ class TestLlamaMoeExpertParallel:
         x, y = _data(seed=5)
         losses = [float(np.asarray(step(x, y)._data)) for _ in range(5)]
         assert losses[-1] < losses[0] - 0.2, losses
+
+
+class TestLlamaMoeGenerate:
+    def test_generate_greedy_deterministic(self):
+        paddle.seed(6)
+        model = LlamaMoeForCausalLM(_cfg(gate_type="naive"))
+        model.eval()
+        ids = paddle.to_tensor(np.random.default_rng(6).integers(
+            0, 128, (2, 6)).astype("int32"))
+        a = np.asarray(model.generate(ids, max_new_tokens=8))
+        b = np.asarray(model.generate(ids, max_new_tokens=8))
+        assert a.shape == (2, 14)
+        np.testing.assert_array_equal(a, b)      # greedy = deterministic
+        np.testing.assert_array_equal(a[:, :6], np.asarray(ids._data))
