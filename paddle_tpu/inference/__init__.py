@@ -56,8 +56,13 @@ class Config:
 
     # -- model location ----------------------------------------------------
     def set_model(self, prefix: str, params_file: Optional[str] = None):
-        if prefix.endswith(".stablehlo"):
-            prefix = prefix[:-len(".stablehlo")]
+        # accept either the artifact prefix or the full file path
+        # (save_inference_model returns the .pdmodel path; jit.save the
+        # .stablehlo path)
+        for suffix in (".stablehlo", ".pdmodel"):
+            if prefix.endswith(suffix):
+                prefix = prefix[:-len(suffix)]
+                break
         self._prefix = prefix
 
     def model_dir(self):
@@ -159,6 +164,25 @@ class Predictor:
         prefix = config._prefix
         if prefix is None:
             raise ValueError("Config has no model path; use Config(prefix)")
+        self._input_device = (jax.devices("cpu")[0]
+                              if config._device == "cpu" else None)
+        if (not os.path.exists(prefix + ".stablehlo")
+                and os.path.exists(prefix + ".pdmodel")):
+            # a static.save_inference_model artifact (weights baked in) —
+            # the same workflow the reference's AnalysisPredictor serves.
+            # ONE payload parser: the static loader owns the format.
+            from ..static import _LoadedInferenceProgram
+            with open(prefix + ".pdmodel", "rb") as f:
+                loaded = _LoadedInferenceProgram(pickle.load(f))
+            self._exported = loaded._exported
+            self._meta = {"param_names": [],
+                          "input_names": loaded.feed_names,
+                          "n_outputs": loaded.n_fetch}
+            self._param_names = []
+            self._params = []
+            self._takes_params = False   # fn(*feeds): weights baked in
+            self._init_handles(config)
+            return
         with open(prefix + ".stablehlo", "rb") as f:
             self._exported = jax.export.deserialize(f.read())
         with open(prefix + ".pdiparams", "rb") as f:
@@ -170,6 +194,10 @@ class Predictor:
         self._params = [
             jax.device_put(jnp.asarray(payload[n]), dev)
             for n in self._param_names]
+        self._takes_params = True        # fn(param_list, *inputs)
+        self._init_handles(config)
+
+    def _init_handles(self, config):
         # in_avals = flattened parameter leaves followed by the real inputs
         n_inputs = len(self._exported.in_avals) - len(self._param_names)
         self._input_names = self._meta.get(
@@ -220,8 +248,16 @@ class Predictor:
         else:
             arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
                       for x in inputs]
+        if self._input_device is not None:
+            # honor disable_gpu() on the baked-weights path too: with no
+            # params to pin, CPU placement rides on the inputs
+            import jax
+            arrays = [jax.device_put(a, self._input_device)
+                      for a in arrays]
         # the compiled call is re-entrant — run it outside the lock
-        outs = self._exported.call(self._params, *arrays)
+        outs = (self._exported.call(self._params, *arrays)
+                if self._takes_params
+                else self._exported.call(*arrays))
         np_outs = [np.asarray(o) for o in outs]
         with self._lock:
             for n, o in zip(self._output_names, np_outs):

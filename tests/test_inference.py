@@ -125,9 +125,16 @@ class TestOnnxFacade:
         out = pred.run([np.ones((2, 4), dtype="float32")])
         assert out[0].shape == (2, 2)
 
-    def test_onnx_format_raises(self, tmp_path):
+    def test_onnx_format_emits_real_onnx(self, tmp_path):
+        # r5: format='onnx' emits real opset-13 ONNX (see
+        # tests/test_onnx_export.py for the numerics suite)
         model = nn.Sequential(nn.Linear(4, 2))
-        with pytest.raises(NotImplementedError):
-            paddle.onnx.export(model, str(tmp_path / "m2"),
+        p = paddle.onnx.export(model, str(tmp_path / "m2"),
                                input_spec=[InputSpec([1, 4], "float32")],
                                format="onnx")
+        assert p.endswith(".onnx")
+        from paddle_tpu.onnx_export import onnx_subset_pb2 as OP
+        m = OP.ModelProto()
+        m.ParseFromString(open(p, "rb").read())
+        assert m.opset_import[0].version == 13
+        assert any(n.op_type == "MatMul" for n in m.graph.node)

@@ -266,3 +266,37 @@ class TestInferenceModelSaveLoad:
                                        fetch_list=[kept])
         ref = np.maximum(np.asarray(fc1(paddle.to_tensor(x_np))._data), 0)
         np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+class TestPredictorServesPdmodel:
+    def test_predictor_loads_save_inference_model_artifact(self, tmp_path):
+        # the reference workflow: static save_inference_model ->
+        # AnalysisPredictor; here Config(prefix) detects the .pdmodel
+        # payload and serves it with weights baked in
+        from paddle_tpu.inference import Config, Predictor
+
+        paddle.seed(31)
+        fc = nn.Linear(6, 4)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [-1, 6], "float32")
+            out = F.relu(fc(x))
+        static.save_inference_model(str(tmp_path / "served"), [x], [out],
+                                    program=main)
+
+        pred = Predictor(Config(str(tmp_path / "served")))
+        assert pred.get_input_names() == ["x"]
+        x_np = np.random.default_rng(31).standard_normal(
+            (3, 6)).astype("float32")
+        (got,) = pred.run([x_np])
+        ref = np.maximum(
+            np.asarray(fc(paddle.to_tensor(x_np))._data), 0.0)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+        # two-phase handle flow too
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(x_np)
+        pred.run()
+        out_h = pred.get_output_handle(pred.get_output_names()[0])
+        np.testing.assert_allclose(out_h.copy_to_cpu(), ref, rtol=1e-5,
+                                   atol=1e-6)
