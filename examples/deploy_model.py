@@ -1,0 +1,74 @@
+"""Every deployment path in one script: jit.save (StableHLO), static
+save_inference_model -> Predictor, and direct ONNX export.
+
+    python examples/deploy_model.py --smoke
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--outdir", default=None)
+    args = ap.parse_args()
+    if args.smoke:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.static as static
+    import paddle_tpu.onnx
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.jit import InputSpec
+
+    outdir = args.outdir or tempfile.mkdtemp(prefix="paddle_tpu_deploy_")
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4),
+                        nn.Softmax(axis=-1))
+    x_np = np.random.default_rng(0).standard_normal((3, 16)).astype("float32")
+    ref = np.asarray(net(paddle.to_tensor(x_np))._data)
+
+    # 1. StableHLO (shape-polymorphic; the XLA-stack interchange format)
+    p1 = paddle_tpu.onnx.export(
+        net, os.path.join(outdir, "m_hlo"),
+        input_spec=[InputSpec([None, 16], "float32")])
+    pred = create_predictor(Config(p1))
+    (got,) = pred.run([x_np])
+    assert np.allclose(got, ref, rtol=1e-5)
+    print(f"stablehlo -> Predictor OK  ({p1})")
+
+    # 2. static Program -> save_inference_model -> Predictor
+    main_prog = static.Program()
+    with static.program_guard(main_prog):
+        x = static.data("x", [-1, 16], "float32")
+        out = net(x)
+    p2 = static.save_inference_model(os.path.join(outdir, "m_static"),
+                                     [x], [out], program=main_prog)
+    pred2 = create_predictor(Config(p2))
+    (got2,) = pred2.run([x_np])
+    assert np.allclose(got2, ref, rtol=1e-5)
+    print(f".pdmodel  -> Predictor OK  ({p2})")
+
+    # 3. direct ONNX (opset 13, weights as initializers)
+    p3 = paddle_tpu.onnx.export(net, os.path.join(outdir, "m"),
+                                format="onnx",
+                                example_inputs=[paddle.to_tensor(x_np)])
+    from paddle_tpu.onnx_export import onnx_subset_pb2 as OP
+    m = OP.ModelProto()
+    m.ParseFromString(open(p3, "rb").read())
+    print(f"onnx opset {m.opset_import[0].version} OK  "
+          f"({p3}: {len(m.graph.node)} nodes, "
+          f"{len(m.graph.initializer)} initializers)")
+
+
+if __name__ == "__main__":
+    main()

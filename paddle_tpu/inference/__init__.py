@@ -166,14 +166,17 @@ class Predictor:
             raise ValueError("Config has no model path; use Config(prefix)")
         self._input_device = (jax.devices("cpu")[0]
                               if config._device == "cpu" else None)
-        if (not os.path.exists(prefix + ".stablehlo")
-                and os.path.exists(prefix + ".pdmodel")):
+        if not os.path.exists(prefix + ".stablehlo"):
+            if not os.path.exists(prefix + ".pdmodel"):
+                raise FileNotFoundError(
+                    f"no model artifact at '{prefix}': expected "
+                    f"'{prefix}.stablehlo' (jit.save) or "
+                    f"'{prefix}.pdmodel' (static.save_inference_model)")
             # a static.save_inference_model artifact (weights baked in) —
             # the same workflow the reference's AnalysisPredictor serves.
-            # ONE payload parser: the static loader owns the format.
-            from ..static import _LoadedInferenceProgram
-            with open(prefix + ".pdmodel", "rb") as f:
-                loaded = _LoadedInferenceProgram(pickle.load(f))
+            # The static loader stays the one parser of the format.
+            from ..static import load_inference_model
+            loaded, _, _ = load_inference_model(prefix)
             self._exported = loaded._exported
             self._meta = {"param_names": [],
                           "input_names": loaded.feed_names,
