@@ -24,7 +24,6 @@ def main():
 
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
-    import paddle_tpu.nn.functional as F
     import paddle_tpu.static as static
     import paddle_tpu.onnx
     from paddle_tpu.inference import Config, create_predictor

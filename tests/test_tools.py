@@ -270,3 +270,86 @@ class TestTpuCapture:
         assert "llama_tiny" not in calls and "llama_small" not in calls
         assert calls and calls[0] == "llama_110m"
         assert doc["value"] == 500.0
+
+
+class TestTpuWindow:
+    def _load(self, monkeypatch, tmp_path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "tpu_window_t", os.path.join(REPO, "tools", "tpu_window.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        # point every artifact at the tmp dir so tests never touch the
+        # real round artifacts (the live orchestrator owns those)
+        monkeypatch.setattr(mod.tpu_capture, "OUT_JSON",
+                            str(tmp_path / "bench.json"))
+        monkeypatch.setattr(mod.tpu_capture, "KERNELS_JSON",
+                            str(tmp_path / "kernels.json"))
+        monkeypatch.setattr(mod, "SNAPSHOT", str(tmp_path / "snap.json"))
+        monkeypatch.setattr(mod, "WINDOW_BENCH_LOG",
+                            str(tmp_path / "window_bench.log"))
+        monkeypatch.setattr(mod, "AB_JSON", str(tmp_path / "ab.json"))
+        return mod
+
+    def _write_full_ladder(self, tw, tmp_path, skip_last=False):
+        tc = tw.tpu_capture
+        ladder = [dict(s) for s in tc.LLAMA_LADDER]
+        upto = ladder[:-1] if skip_last else ladder
+        results = [{"name": s["name"], "status": "ok", "device": "tpu",
+                    "tokens_per_sec": 1.0, "spec": s} for s in upto]
+        doc = {"value": 1.0, "headline_rung": ladder[0]["name"],
+               "ladder": results}
+        (tmp_path / "bench.json").write_text(json.dumps(doc))
+
+    def test_ladder_done_requires_every_current_rung(self, monkeypatch,
+                                                     tmp_path):
+        tw = self._load(monkeypatch, tmp_path)
+        self._write_full_ladder(tw, tmp_path, skip_last=True)
+        assert not tw._have_ladder()
+        self._write_full_ladder(tw, tmp_path)
+        assert tw._have_ladder()
+
+    def test_spec_change_reopens_ladder(self, monkeypatch, tmp_path):
+        # editing a rung spec without renaming must re-measure it: the
+        # stale result is not settled, so the window stage reopens
+        tw = self._load(monkeypatch, tmp_path)
+        tc = tw.tpu_capture
+        self._write_full_ladder(tw, tmp_path)
+        assert tw._have_ladder()
+        monkeypatch.setitem(tc.LLAMA_LADDER[-1], "steps", 999)
+        assert tc.LLAMA_LADDER[-1]["name"] not in tc._prior_rung_results()
+        assert not tw._have_ladder()
+
+    def test_ab_settled_states(self, monkeypatch, tmp_path):
+        tw = self._load(monkeypatch, tmp_path)
+
+        def have(doc):
+            (tmp_path / "ab.json").write_text(json.dumps(doc))
+            return tw._have_ab()
+
+        assert have({"fused_speedup": 1.1})
+        assert have({"winner": "fused_ce"})
+        # both arms deterministically gate-rejected IS settled
+        assert have({"unfused": {"status": "memory_gate_rejected"},
+                     "fused_ce": {"status": "memory_gate_rejected"},
+                     "winner": None})
+        assert not have({"skipped": True})
+        # one arm ok but no winner recorded -> unsettled (rerun)
+        assert not have({"winner": None,
+                         "unfused": {"status": "ok"},
+                         "fused_ce": {"status": "memory_gate_rejected"}})
+
+    def test_bench_snapshot_extraction(self, monkeypatch, tmp_path):
+        tw = self._load(monkeypatch, tmp_path)
+        (tmp_path / "window_bench.log").write_text(
+            'garbage\n{"metric": "m", "value": 42.0, '
+            '"device": "tpu", "suite": []}\n')
+        doc = tw._extract_bench_snapshot()
+        assert doc and doc["value"] == 42.0
+        assert tw._have_bench_snapshot()
+        # cpu-fallback lines are never snapshotted
+        (tmp_path / "window_bench.log").write_text(
+            '{"metric": "m", "value": 9.0, "device": "cpu"}\n')
+        (tmp_path / "snap.json").unlink()
+        assert tw._extract_bench_snapshot() is None
+        assert not tw._have_bench_snapshot()

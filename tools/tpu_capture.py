@@ -272,10 +272,20 @@ def best_baseline_comparable() -> float:
     return 0.0
 
 
-def _prior_rung_results() -> dict:
+def _spec_matches(result: dict, spec: dict) -> bool:
+    """THE staleness rule, one definition for the skip logic, the
+    settled set, and the stage gate: a result measured under a different
+    spec than the rung's current definition is stale; results predating
+    spec stamping are trusted by name."""
+    stored = result.get("spec")
+    return stored is None or stored == spec
+
+
+def _all_rung_results() -> dict:
     """name -> best previously captured result (ok preferred over a
-    deterministic memory-gate rejection).  Lets later window attempts
-    spend their chip time only on rungs with something left to learn."""
+    deterministic memory-gate rejection), INCLUDING stale-spec entries —
+    the carry-forward source: a hardware measurement is never deleted
+    from the doc, even when a spec edit means it must be re-measured."""
     out = {}
     if not os.path.exists(OUT_JSON):
         return out
@@ -293,19 +303,29 @@ def _prior_rung_results() -> dict:
     return out
 
 
+def _prior_rung_results() -> dict:
+    """The SETTLED subset of _all_rung_results: only entries whose
+    stored spec still matches the rung's current definition count —
+    editing batch/steps/cfg without renaming reopens the rung for
+    re-measurement (run_ladder's skip and _have_ladder's stage gate
+    both key off this)."""
+    current = {s["name"]: s for s in LLAMA_LADDER}
+    return {n: r for n, r in _all_rung_results().items()
+            if n not in current or _spec_matches(r, current[n])}
+
+
 def run_ladder(specs=None) -> dict:
     if specs is None:
         specs = [dict(s) for s in LLAMA_LADDER]
     settled = _prior_rung_results()
+    every = _all_rung_results()          # carry-forward source incl. stale
     results = []
     ran_live = False
     for spec in specs:
         cached = settled.get(spec["name"])
-        # a settled result only counts if it was measured under THIS
-        # spec — editing a rung's batch/steps/cfg without renaming it
-        # must re-measure, not silently reuse the stale number (results
-        # predating spec stamping are trusted by name)
-        if cached is not None and cached.get("spec", spec) == spec:
+        # settled == measured under THIS spec (one rule: _spec_matches);
+        # a stale-spec result is re-measured, never silently reused
+        if cached is not None and _spec_matches(cached, spec):
             results.append(dict(cached, cached=True))
             continue
         if ran_live:
@@ -353,13 +373,18 @@ def run_ladder(specs=None) -> dict:
     if "mfu" in head:
         doc["mfu"] = head["mfu"]
         doc["device_kind"] = head.get("device_kind")
-    # a mid-climb break must not orphan settled results for rungs this
-    # attempt never reached — carry them so _prior_rung_results (and the
-    # skip-done logic) keeps every hardware measurement ever made
+    # a mid-climb break must not orphan prior results for rungs this
+    # attempt never reached — carry EVERY known measurement (including
+    # stale-spec ones, tagged, so a hardware number is never deleted
+    # from the doc even while awaiting re-measurement)
+    current = {s["name"]: s for s in LLAMA_LADDER}
     present = {r.get("name") for r in results}
-    for n, r in settled.items():
+    for n, r in every.items():
         if n not in present:
-            doc["ladder"].append(dict(r, carried=True))
+            stale = (n in current
+                     and not _spec_matches(r, current[n]))
+            doc["ladder"].append(dict(r, carried=True, **(
+                {"stale_spec": True} if stale else {})))
     prior = {}
     if os.path.exists(OUT_JSON):
         try:

@@ -48,8 +48,7 @@ def _have_ab() -> bool:
     """A/B artifact counts only if it holds a real measurement (a chip
     flake between probe and stage 3 yields {'skipped': true})."""
     try:
-        doc = json.load(open(os.path.join(REPO, "tools",
-                                          "fused_ce_ab.json")))
+        doc = json.load(open(AB_JSON))
     except Exception:  # noqa: BLE001
         return False
     if doc.get("skipped"):
@@ -63,6 +62,8 @@ def _have_ab() -> bool:
 
 
 SNAPSHOT = os.path.join(REPO, "tools", "bench_tpu_snapshot.json")
+WINDOW_BENCH_LOG = os.path.join(REPO, "tools", "window_bench.log")
+AB_JSON = os.path.join(REPO, "tools", "fused_ce_ab.json")
 
 
 def _have_bench_snapshot() -> bool:
@@ -76,9 +77,8 @@ def _have_bench_snapshot() -> bool:
 def _extract_bench_snapshot():
     """Pull the last JSON line bench.py wrote into window_bench.log and
     keep it as the snapshot artifact when it is a real TPU run."""
-    log = os.path.join(REPO, "tools", "window_bench.log")
     try:
-        lines = open(log).read().splitlines()
+        lines = open(WINDOW_BENCH_LOG).read().splitlines()
     except Exception:  # noqa: BLE001
         return None
     for line in reversed(lines):
