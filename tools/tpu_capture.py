@@ -282,10 +282,21 @@ def _spec_matches(result: dict, spec: dict) -> bool:
 
 
 def _all_rung_results() -> dict:
-    """name -> best previously captured result (ok preferred over a
-    deterministic memory-gate rejection), INCLUDING stale-spec entries —
-    the carry-forward source: a hardware measurement is never deleted
-    from the doc, even when a spec edit means it must be re-measured."""
+    """name -> best previously captured result, INCLUDING stale-spec
+    entries — the carry-forward source: a hardware measurement is never
+    deleted from the doc, even when a spec edit means re-measurement.
+
+    Preference order per name: fresh (current-spec) beats stale, then
+    ok beats memory_gate_rejected — so a fresh re-measurement living in
+    later_attempts replaces a stale ok in the main doc instead of being
+    shadowed by it forever."""
+    current = {s["name"]: s for s in LLAMA_LADDER}
+
+    def rank(r):
+        n = r.get("name")
+        fresh = n not in current or _spec_matches(r, current[n])
+        return (1 if fresh else 0, 1 if r.get("status") == "ok" else 0)
+
     out = {}
     if not os.path.exists(OUT_JSON):
         return out
@@ -296,29 +307,33 @@ def _all_rung_results() -> dict:
     for a in [doc] + doc.get("later_attempts", []):
         for r in a.get("ladder", []):
             n, s = r.get("name"), r.get("status")
-            if s == "ok" and out.get(n, {}).get("status") != "ok":
-                out[n] = r
-            elif s == "memory_gate_rejected" and n not in out:
+            if s not in ("ok", "memory_gate_rejected"):
+                continue
+            if n not in out or rank(r) > rank(out[n]):
                 out[n] = r
     return out
 
 
-def _prior_rung_results() -> dict:
-    """The SETTLED subset of _all_rung_results: only entries whose
-    stored spec still matches the rung's current definition count —
+def _settled_filter(every: dict) -> dict:
+    """The SETTLED subset of _all_rung_results output: only entries
+    whose stored spec still matches the rung's current definition —
     editing batch/steps/cfg without renaming reopens the rung for
     re-measurement (run_ladder's skip and _have_ladder's stage gate
     both key off this)."""
     current = {s["name"]: s for s in LLAMA_LADDER}
-    return {n: r for n, r in _all_rung_results().items()
+    return {n: r for n, r in every.items()
             if n not in current or _spec_matches(r, current[n])}
+
+
+def _prior_rung_results() -> dict:
+    return _settled_filter(_all_rung_results())
 
 
 def run_ladder(specs=None) -> dict:
     if specs is None:
         specs = [dict(s) for s in LLAMA_LADDER]
-    settled = _prior_rung_results()
     every = _all_rung_results()          # carry-forward source incl. stale
+    settled = _settled_filter(every)
     results = []
     ran_live = False
     for spec in specs:
@@ -376,9 +391,12 @@ def run_ladder(specs=None) -> dict:
     # a mid-climb break must not orphan prior results for rungs this
     # attempt never reached — carry EVERY known measurement (including
     # stale-spec ones, tagged, so a hardware number is never deleted
-    # from the doc even while awaiting re-measurement)
+    # from the doc even while awaiting re-measurement).  Only a REAL new
+    # result blocks the carry: a failure placeholder (timeout/chip-lost)
+    # for a rung must not drop its old measurement.
     current = {s["name"]: s for s in LLAMA_LADDER}
-    present = {r.get("name") for r in results}
+    present = {r.get("name") for r in results
+               if r.get("status") in ("ok", "memory_gate_rejected")}
     for n, r in every.items():
         if n not in present:
             stale = (n in current
