@@ -479,7 +479,7 @@ def bench_paged_decode(on_tpu):
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype("int32")
 
-    gen.generate(ids, max_new_tokens=4)        # warmup (compile caches)
+    gen.generate(ids, max_new_tokens=decode)   # warmup (compile caches)
     # phase-timed inside ONE generate call (the generator stamps prefill
     # and steady-state decode separately), so run-to-run variance of a
     # separate prefill-only run never lands in the decode figure

@@ -186,6 +186,7 @@ class JittedPagedDecoder:
                     p._data = s
 
         self._jitted_prefill = jax.jit(prefill_fn, donate_argnums=(5, 6))
+        self._jitted_multi = None        # built on first multi_step use
 
     def prefill(self, cache: PagedKVCache, seq_ids, ids_np,
                 bucket: bool = False) -> np.ndarray:
@@ -235,6 +236,90 @@ class JittedPagedDecoder:
         cache.k_pages = list(k_pages)
         cache.v_pages = list(v_pages)
         return np.asarray(logits)
+
+    def _build_multi(self):
+        """Jitted N-step GREEDY decode: lax.scan over the single-step
+        body with the page pools as carry — N tokens per host dispatch
+        instead of one.  On a tunnelled deployment each dispatch costs
+        milliseconds of RPC latency; fusing the loop removes all but one
+        of those round trips per chunk (and on local hardware removes
+        N-1 host synchronizations)."""
+        import jax
+        from jax import lax
+
+        def multi_fn(param_arrays, tokens0, pg_steps, sl_steps, pos_steps,
+                     tables, k_pages, v_pages):
+            saved = [p._data for p in self.params]
+            try:
+                for p, a in zip(self.params, param_arrays):
+                    p._data = a
+
+                def body(carry, xs):
+                    toks, kp, vp = carry
+                    pg, sl, pos = xs
+                    ctx = _TracedPagedContext(list(kp), list(vp), pg, sl,
+                                              pos + 1, tables)
+                    with no_grad():
+                        hidden = self.model.model(
+                            wrap_array(toks[:, None]), pos, paged_ctx=ctx)
+                        logits = self.model._logits_of(hidden)
+                    nxt = jnp.argmax(
+                        logits._data[:, -1].astype(jnp.float32),
+                        axis=-1).astype(jnp.int32)
+                    return ((nxt, tuple(ctx.k_pages), tuple(ctx.v_pages)),
+                            nxt)
+
+                (last, kp, vp), toks = lax.scan(
+                    body, (tokens0, tuple(k_pages), tuple(v_pages)),
+                    (pg_steps, sl_steps, pos_steps))
+                return toks, kp, vp
+            finally:
+                for p, s in zip(self.params, saved):
+                    p._data = s
+
+        return jax.jit(multi_fn, donate_argnums=(6, 7))
+
+    def multi_step(self, cache: PagedKVCache, seq_ids, tokens_np,
+                   positions_np, n_steps: int) -> np.ndarray:
+        """Decode ``n_steps`` GREEDY tokens for every sequence in ONE
+        compiled program.  tokens_np (batch,) int32 — the last sampled
+        token per row; positions_np (batch,) int32 — each row's current
+        length.  Pages for all n_steps are reserved up front; returns
+        (batch, n_steps) int32 of generated tokens."""
+        b = len(seq_ids)
+        if int(positions_np.max()) + n_steps > self.max_position:
+            raise ValueError(
+                f"decode through position "
+                f"{int(positions_np.max()) + n_steps} exceeds "
+                f"max_position_embeddings ({self.max_position})")
+        if self._jitted_multi is None:
+            self._jitted_multi = self._build_multi()
+        for sid in seq_ids:
+            cache.allocate(sid, n_steps)
+        pg, sl = cache.plan_write(seq_ids, n_steps)
+        cache.advance(seq_ids, n_steps)
+        # per-step (pg, sl): plan_write is row-major (batch, n)
+        pg_steps = pg.reshape(b, n_steps).T.copy()       # (n, b)
+        sl_steps = sl.reshape(b, n_steps).T.copy()
+        pos_steps = (positions_np[None, :]
+                     + np.arange(n_steps, dtype=np.int32)[:, None])
+        # table covers the FINAL length (pages reserved above); per-step
+        # attention masks by lens = pos + 1, so later slots stay unseen
+        needed = max(len(cache._seq_pages.get(s, ())) for s in seq_ids)
+        tabs, _ = cache.page_table(seq_ids, max_pages=next_pow2(needed))
+        try:
+            toks, k_pages, v_pages = self._jitted_multi(
+                [p._data for p in self.params],
+                jnp.asarray(tokens_np.astype(np.int32)),
+                jnp.asarray(pg_steps), jnp.asarray(sl_steps),
+                jnp.asarray(pos_steps), tabs,
+                tuple(cache.k_pages), tuple(cache.v_pages))
+        except BaseException:
+            cache.reset_pools()
+            raise
+        cache.k_pages = list(k_pages)
+        cache.v_pages = list(v_pages)
+        return np.asarray(toks).T                        # (batch, n)
 
     def step(self, cache: PagedKVCache, seq_ids, tokens_np,
              positions_np) -> np.ndarray:
@@ -342,6 +427,71 @@ class PagedGenerator:
             t0 = _time.perf_counter()
 
             out = [ids]
+            if (not do_sample and max_new_tokens > 1
+                    and s + max_new_tokens <= self._decoder.max_position):
+                # greedy fast path: ALL remaining tokens decode inside
+                # ONE compiled lax.scan program (one host dispatch per
+                # generation instead of one per token).  eos semantics
+                # are applied post-hoc: everything after a row's first
+                # eos becomes eos — same output as the stepwise path
+                # (whose cache also keeps writing after finish).
+                first = np.asarray(step).argmax(axis=-1).astype(np.int32)
+                toks = []
+                cur, pos, remaining = first, s, max_new_tokens - 1
+                done = (first == eos_token_id) if eos_token_id is not None \
+                    else None
+                try:
+                    # power-of-two chunks (rounded UP, extra tokens
+                    # truncated) so any max_new_tokens reuses a bounded
+                    # set of compiled scan programs — one dispatch for
+                    # totals <= 64, then 64-sized chunks.  The round-up
+                    # must stay inside the rope table.
+                    while remaining > 0:
+                        if done is not None and done.all():
+                            break       # every row has emitted eos
+                        n = min(next_pow2(remaining), 64,
+                                self._decoder.max_position - pos)
+                        chunk = self._decoder.multi_step(
+                            self.cache, seq_ids, cur,
+                            np.full(b, pos, np.int32), n)
+                        toks.append(chunk[:, :remaining])
+                        if done is not None:
+                            done |= (toks[-1] == eos_token_id).any(axis=1)
+                        cur = chunk[:, -1].astype(np.int32)
+                        pos += n
+                        remaining -= n
+                except RuntimeError as e:
+                    if "out of pages" not in str(e):
+                        raise   # a device failure, not pool pressure —
+                        # the pools were reset; stepwise would silently
+                        # decode against an empty cache
+                    if toks:
+                        # chunks already advanced the cache; restarting
+                        # stepwise from the prefill logits would attend
+                        # over those slots at wrong positions — the pool
+                        # is genuinely exhausted mid-generation, exactly
+                        # what the stepwise path would hit too
+                        raise
+                    # the UPFRONT reservation failed before anything ran:
+                    # fall back to stepwise, which allocates per token
+                    # and may finish early on eos
+                    toks = None
+                if toks is not None:
+                    gen = np.concatenate([first[:, None]] + toks, axis=1)
+                    if eos_token_id is not None:
+                        hit = gen == eos_token_id
+                        after = (np.cumsum(hit, axis=1)
+                                 - hit.astype(int)) > 0
+                        gen = np.where(after, eos_token_id, gen)
+                        # match the stepwise width contract: stop at the
+                        # step where the LAST row finished
+                        alldone = (np.cumsum(hit, axis=1) > 0).all(axis=0)
+                        if alldone.any():
+                            gen = gen[:, :int(np.argmax(alldone)) + 1]
+                    out.append(gen.astype(ids.dtype))
+                    self.last_decode_seconds = _time.perf_counter() - t0
+                    return np.concatenate(out, axis=1)
+
             finished = np.zeros(b, bool)
             pos = s
             for _ in range(max_new_tokens):

@@ -281,7 +281,7 @@ def _spec_matches(result: dict, spec: dict) -> bool:
     return stored is None or stored == spec
 
 
-def _all_rung_results() -> dict:
+def _all_rung_results(with_stale_oks: bool = False):
     """name -> best previously captured result, INCLUDING stale-spec
     entries — the carry-forward source: a hardware measurement is never
     deleted from the doc, even when a spec edit means re-measurement.
@@ -289,7 +289,10 @@ def _all_rung_results() -> dict:
     Preference order per name: fresh (current-spec) beats stale, then
     ok beats memory_gate_rejected — so a fresh re-measurement living in
     later_attempts replaces a stale ok in the main doc instead of being
-    shadowed by it forever."""
+    shadowed by it forever.  ``with_stale_oks=True`` additionally
+    returns the stale-spec ok measurements that lost to a fresh
+    non-ok entry, so carry-forward can keep those hardware numbers in
+    the doc (tagged) instead of deleting them."""
     current = {s["name"]: s for s in LLAMA_LADDER}
 
     def rank(r):
@@ -298,20 +301,26 @@ def _all_rung_results() -> dict:
         return (1 if fresh else 0, 1 if r.get("status") == "ok" else 0)
 
     out = {}
-    if not os.path.exists(OUT_JSON):
+    oks = {}
+    if os.path.exists(OUT_JSON):
+        try:
+            doc = json.load(open(OUT_JSON))
+        except Exception:  # noqa: BLE001
+            doc = {}
+        for a in [doc] + doc.get("later_attempts", []):
+            for r in a.get("ladder", []):
+                n, s = r.get("name"), r.get("status")
+                if s not in ("ok", "memory_gate_rejected"):
+                    continue
+                if n not in out or rank(r) > rank(out[n]):
+                    out[n] = r
+                if s == "ok" and n not in oks:
+                    oks[n] = r
+    if not with_stale_oks:
         return out
-    try:
-        doc = json.load(open(OUT_JSON))
-    except Exception:  # noqa: BLE001
-        return out
-    for a in [doc] + doc.get("later_attempts", []):
-        for r in a.get("ladder", []):
-            n, s = r.get("name"), r.get("status")
-            if s not in ("ok", "memory_gate_rejected"):
-                continue
-            if n not in out or rank(r) > rank(out[n]):
-                out[n] = r
-    return out
+    stale_oks = {n: r for n, r in oks.items()
+                 if out.get(n, {}).get("status") != "ok"}
+    return out, stale_oks
 
 
 def _settled_filter(every: dict) -> dict:
@@ -332,7 +341,7 @@ def _prior_rung_results() -> dict:
 def run_ladder(specs=None) -> dict:
     if specs is None:
         specs = [dict(s) for s in LLAMA_LADDER]
-    every = _all_rung_results()          # carry-forward source incl. stale
+    every, stale_oks = _all_rung_results(with_stale_oks=True)
     settled = _settled_filter(every)
     results = []
     ran_live = False
@@ -395,14 +404,26 @@ def run_ladder(specs=None) -> dict:
     # result blocks the carry: a failure placeholder (timeout/chip-lost)
     # for a rung must not drop its old measurement.
     current = {s["name"]: s for s in LLAMA_LADDER}
-    present = {r.get("name") for r in results
-               if r.get("status") in ("ok", "memory_gate_rejected")}
-    for n, r in every.items():
-        if n not in present:
-            stale = (n in current
-                     and not _spec_matches(r, current[n]))
-            doc["ladder"].append(dict(r, carried=True, **(
-                {"stale_spec": True} if stale else {})))
+    new_ok = {r.get("name") for r in results if r.get("status") == "ok"}
+    new_measured = {r.get("name") for r in results
+                    if r.get("status") in ("ok", "memory_gate_rejected")}
+    seen = {(r.get("name"), r.get("status"), r.get("tokens_per_sec"))
+            for r in results}
+    for n, r in list(every.items()) + list(stale_oks.items()):
+        key = (n, r.get("status"), r.get("tokens_per_sec"))
+        if key in seen:
+            continue
+        seen.add(key)
+        if n in new_ok:
+            continue                 # superseded by a fresh ok this run
+        if r.get("status") != "ok" and n in new_measured:
+            continue                 # fresh rejection replaces old one
+        # carry: rungs this attempt never (re)measured, AND ok
+        # measurements a fresh rejection would otherwise erase —
+        # hardware numbers are never deleted from the doc
+        stale = (n in current and not _spec_matches(r, current[n]))
+        doc["ladder"].append(dict(r, carried=True, **(
+            {"stale_spec": True} if stale else {})))
     prior = {}
     if os.path.exists(OUT_JSON):
         try:
