@@ -521,7 +521,8 @@ def bench_paged_decode(on_tpu):
         "batch": batch, "prompt_len": prompt,
         "prefill_ms": round(gen.last_prefill_seconds * 1e3, 1),
         "continuous_batching_scaling": scaling,
-        "path": "PagedGenerator + paged-attention decode kernel; scaling "
+        "path": "PagedGenerator fused multi-step decode (N tokens per "
+                "dispatch via lax.scan) + paged-attention kernel; scaling "
                 "table via ContinuousBatchingEngine",
     }
 

@@ -294,8 +294,9 @@ class JittedPagedDecoder:
                 f"max_position_embeddings ({self.max_position})")
         if self._jitted_multi is None:
             self._jitted_multi = self._build_multi()
-        for sid in seq_ids:
-            cache.allocate(sid, n_steps)
+        # all-or-nothing: a mid-batch exhaustion must not leave earlier
+        # rows hoarding a chunk's worth of pages the fallback then starves on
+        cache.allocate_batch_atomic(seq_ids, n_steps)
         pg, sl = cache.plan_write(seq_ids, n_steps)
         cache.advance(seq_ids, n_steps)
         # per-step (pg, sl): plan_write is row-major (batch, n)
@@ -436,61 +437,62 @@ class PagedGenerator:
                 # eos becomes eos — same output as the stepwise path
                 # (whose cache also keeps writing after finish).
                 first = np.asarray(step).argmax(axis=-1).astype(np.int32)
-                toks = []
+                pieces = [first[:, None]]
                 cur, pos, remaining = first, s, max_new_tokens - 1
                 done = (first == eos_token_id) if eos_token_id is not None \
                     else None
-                try:
-                    # power-of-two chunks (rounded UP, extra tokens
-                    # truncated) so any max_new_tokens reuses a bounded
-                    # set of compiled scan programs — one dispatch for
-                    # totals <= 64, then 64-sized chunks.  The round-up
-                    # must stay inside the rope table.
-                    while remaining > 0:
-                        if done is not None and done.all():
-                            break       # every row has emitted eos
-                        n = min(next_pow2(remaining), 64,
-                                self._decoder.max_position - pos)
+                # power-of-two chunks (rounded UP, extra truncated) so any
+                # max_new_tokens reuses a bounded set of compiled scan
+                # programs; the round-up must stay inside the rope table.
+                # A chunk reservation hitting pool pressure (atomic, rolled
+                # back) drops to the per-token continuation below, which
+                # decodes from the exact (cur, pos) the chunks reached and
+                # can still finish early on eos.
+                while remaining > 0:
+                    if done is not None and done.all():
+                        break           # every row has emitted eos
+                    n = min(next_pow2(remaining), 64,
+                            self._decoder.max_position - pos)
+                    try:
                         chunk = self._decoder.multi_step(
                             self.cache, seq_ids, cur,
                             np.full(b, pos, np.int32), n)
-                        toks.append(chunk[:, :remaining])
-                        if done is not None:
-                            done |= (toks[-1] == eos_token_id).any(axis=1)
-                        cur = chunk[:, -1].astype(np.int32)
-                        pos += n
-                        remaining -= n
-                except RuntimeError as e:
-                    if "out of pages" not in str(e):
-                        raise   # a device failure, not pool pressure —
-                        # the pools were reset; stepwise would silently
-                        # decode against an empty cache
-                    if toks:
-                        # chunks already advanced the cache; restarting
-                        # stepwise from the prefill logits would attend
-                        # over those slots at wrong positions — the pool
-                        # is genuinely exhausted mid-generation, exactly
-                        # what the stepwise path would hit too
-                        raise
-                    # the UPFRONT reservation failed before anything ran:
-                    # fall back to stepwise, which allocates per token
-                    # and may finish early on eos
-                    toks = None
-                if toks is not None:
-                    gen = np.concatenate([first[:, None]] + toks, axis=1)
-                    if eos_token_id is not None:
-                        hit = gen == eos_token_id
-                        after = (np.cumsum(hit, axis=1)
-                                 - hit.astype(int)) > 0
-                        gen = np.where(after, eos_token_id, gen)
-                        # match the stepwise width contract: stop at the
-                        # step where the LAST row finished
-                        alldone = (np.cumsum(hit, axis=1) > 0).all(axis=0)
-                        if alldone.any():
-                            gen = gen[:, :int(np.argmax(alldone)) + 1]
-                    out.append(gen.astype(ids.dtype))
-                    self.last_decode_seconds = _time.perf_counter() - t0
-                    return np.concatenate(out, axis=1)
+                    except RuntimeError as e:
+                        if "out of pages" not in str(e):
+                            raise   # device failure: pools were reset —
+                            # continuing would decode an empty cache
+                        break       # pool pressure: per-token continuation
+                    pieces.append(chunk[:, :remaining])
+                    if done is not None:
+                        done |= (pieces[-1] == eos_token_id).any(axis=1)
+                    cur = chunk[:, -1].astype(np.int32)
+                    pos += n
+                    remaining -= n
+                while remaining > 0:
+                    if done is not None and done.all():
+                        break
+                    logits = self._decoder.step(
+                        self.cache, seq_ids, cur[:, None].astype(np.int32),
+                        np.full(b, pos, np.int32))
+                    cur = logits.argmax(axis=-1).astype(np.int32)
+                    pieces.append(cur[:, None])
+                    if done is not None:
+                        done |= cur == eos_token_id
+                    pos += 1
+                    remaining -= 1
+                gen = np.concatenate(pieces, axis=1)
+                if eos_token_id is not None:
+                    hit = gen == eos_token_id
+                    after = (np.cumsum(hit, axis=1) - hit.astype(int)) > 0
+                    gen = np.where(after, eos_token_id, gen)
+                    # stepwise width contract: stop at the step where the
+                    # LAST row finished
+                    alldone = (np.cumsum(hit, axis=1) > 0).all(axis=0)
+                    if alldone.any():
+                        gen = gen[:, :int(np.argmax(alldone)) + 1]
+                out.append(gen.astype(ids.dtype))
+                self.last_decode_seconds = _time.perf_counter() - t0
+                return np.concatenate(out, axis=1)
 
             finished = np.zeros(b, bool)
             pos = s

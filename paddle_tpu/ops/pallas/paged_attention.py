@@ -222,6 +222,23 @@ class PagedKVCache:
         self._seq_len: Dict[int, int] = {}
 
     # ------------------------------------------------------- bookkeeping
+    def allocate_batch_atomic(self, seq_ids, n_tokens: int) -> None:
+        """Reserve pages for n_tokens MORE tokens on EVERY sequence, or
+        none at all: a mid-batch exhaustion rolls back this call's
+        reservations before re-raising, so a caller can fall back to
+        finer-grained allocation against an undrained pool."""
+        before = {sid: len(self._seq_pages.get(sid, ()))
+                  for sid in seq_ids}
+        try:
+            for sid in seq_ids:
+                self.allocate(sid, n_tokens)
+        except RuntimeError:
+            for sid in seq_ids:
+                pages = self._seq_pages.get(sid, [])
+                while len(pages) > before[sid]:
+                    self._free.append(pages.pop())
+            raise
+
     def allocate(self, seq_id: int, n_tokens: int) -> None:
         """Reserve pages so the sequence can hold n_tokens MORE tokens."""
         pages = self._seq_pages.setdefault(seq_id, [])

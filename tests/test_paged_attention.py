@@ -298,3 +298,29 @@ class TestMultiStepFusedDecode:
         ids = np.random.default_rng(1).integers(0, 128, (1, 5)).astype("int32")
         out = gen.generate(ids, max_new_tokens=4, do_sample=True, seed=7)
         assert out.shape == (1, 9)
+
+    def test_pool_pressure_falls_back_to_per_token_continuation(self):
+        # chunk reservations are atomic (rolled back on exhaustion) and
+        # a mid-generation pool squeeze continues per-token from the
+        # exact (cur, pos) the chunks reached — early eos still finishes
+        # a generation the upfront reservation could never fit
+        from paddle_tpu.inference.paged import PagedGenerator
+        model = self._model(seed=3)
+        ids = np.random.default_rng(3).integers(0, 128, (1, 6)).astype(
+            "int32")
+        probe = PagedGenerator(model, total_pages=128,
+                               page_size=4).generate(ids,
+                                                     max_new_tokens=90)
+        eos = int(probe[0, 6 + 20])          # reachable within the pool
+        # 12 pages x 4 = 48 tokens: the 64-token upfront chunk can never
+        # reserve, but per-token decoding reaches the eos at +20 easily
+        tight = PagedGenerator(model, total_pages=12, page_size=4)
+        out = tight.generate(ids, max_new_tokens=90, eos_token_id=eos)
+        ref = probe.copy()
+        hit = ref[:, 6:] == eos
+        after = (np.cumsum(hit, axis=1) - hit.astype(int)) > 0
+        ref[:, 6:][after] = eos
+        n = min(out.shape[1], ref.shape[1])
+        np.testing.assert_array_equal(out[:, :n], ref[:, :n])
+        # every page returned to the pool (atomic rollback + final free)
+        assert len(tight.cache._free) == tight.cache.total_pages
