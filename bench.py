@@ -537,7 +537,8 @@ def bench_dp_scaling():
     code = r"""
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from paddle_tpu.framework.jax_compat import pin_cpu_devices
+pin_cpu_devices(8)
 import json, time
 import numpy as np
 import paddle_tpu as paddle

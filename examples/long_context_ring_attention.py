@@ -34,7 +34,8 @@ def main():
 
     import jax
     jax.config.update("jax_platforms", "cpu")   # virtual ring on CPU hosts
-    jax.config.update("jax_num_cpu_devices", args.ring)
+    from paddle_tpu.framework.jax_compat import pin_cpu_devices
+    pin_cpu_devices(args.ring)
 
     import paddle_tpu as paddle
     import paddle_tpu.distributed as dist

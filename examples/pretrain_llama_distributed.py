@@ -35,7 +35,8 @@ def main():
     # backend use.  On a real TPU slice with >= n chips, drop these two
     # lines — everything below is device-count-generic.
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
+    from paddle_tpu.framework.jax_compat import pin_cpu_devices
+    pin_cpu_devices(n)
 
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F

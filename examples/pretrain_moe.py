@@ -28,7 +28,8 @@ def main():
     n = args.dp * args.ep
     import jax
     jax.config.update("jax_platforms", "cpu")   # virtual mesh on CPU hosts
-    jax.config.update("jax_num_cpu_devices", n)
+    from paddle_tpu.framework.jax_compat import pin_cpu_devices
+    pin_cpu_devices(n)
 
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F

@@ -71,6 +71,7 @@ Tensor.create_parameter = staticmethod(
 from . import geometric  # noqa: F401
 from . import sparse  # noqa: F401
 from . import profiler  # noqa: F401
+from . import monitor  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
 from . import onnx  # noqa: F401

@@ -2,8 +2,9 @@
 re-exports the hapi callback set)."""
 from .hapi.callbacks import (  # noqa: F401
     Callback, ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping,
-    ReduceLROnPlateau, VisualDL,
+    ReduceLROnPlateau, VisualDL, MonitorCallback,
 )
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "ReduceLROnPlateau", "VisualDL"]
+           "EarlyStopping", "ReduceLROnPlateau", "VisualDL",
+           "MonitorCallback"]

@@ -124,7 +124,7 @@ def reshard(dist_tensor: Tensor, mesh: ProcessMesh,
 
 def _resolve_partial(t: Tensor, attr: DistAttr) -> Tensor:
     """Sum pending-partial axes via shard_map psum (p_to_r)."""
-    from jax import shard_map
+    from ...framework.jax_compat import shard_map
     mesh = attr.process_mesh
     partial_axes = tuple(mesh.dim_names[i]
                          for i, p in enumerate(attr.placements)

@@ -16,20 +16,67 @@ jax.experimental.multihost_utils.
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence
 
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..framework.jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..framework.tensor import Tensor, wrap_array
 from ..framework.dispatch import call_op
+from .. import monitor
 from .auto_parallel.placement import Shard, Replicate, Partial
 from .auto_parallel.process_mesh import ProcessMesh, get_mesh
 from .auto_parallel.api import DistAttr, placements_to_spec, reshard
 from .env import get_rank, get_world_size
+
+
+# ---------------------------------------------------------- telemetry
+# Per-kind collective telemetry (ISSUE 1; the measurement substrate the
+# overlap work in arxiv 2401.16677 presupposes): every eager collective
+# — including world-size-1 no-ops — records a call, its wall latency and
+# its payload size, tagged by collective kind.
+_coll_calls = monitor.counter(
+    "collective_calls_total", "eager collective invocations", ("kind",))
+_coll_latency = monitor.histogram(
+    "collective_latency_seconds", "eager collective wall latency",
+    ("kind",))
+_coll_bytes = monitor.histogram(
+    "collective_bytes", "eager collective payload size",
+    ("kind",), buckets=monitor.BYTES_BUCKETS)
+
+
+def _payload_nbytes(args) -> int:
+    """Best-effort payload size from the first tensor-ish argument."""
+    for a in args:
+        seq = a if isinstance(a, (list, tuple)) else (a,)
+        for t in seq:
+            data = getattr(t, "_data", None)
+            nbytes = getattr(data, "nbytes", None)
+            if nbytes is not None:
+                return int(nbytes)
+    return 0
+
+
+def _instrumented(kind: str):
+    """Wrap a collective: count + latency histogram (span feeds the
+    profiler timeline too) + payload bytes, tagged by kind."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            _coll_calls.inc(kind=kind)
+            nb = _payload_nbytes(args)
+            if nb:
+                _coll_bytes.observe(nb, kind=kind)
+            with monitor.span(f"collective/{kind}",
+                              histogram=_coll_latency, kind=kind):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
 
 
 class ReduceOp:
@@ -129,6 +176,7 @@ def _is_noop(tensor: Tensor, group: Optional[Group]) -> bool:
     return get_world_size() <= 1 and _host_world() <= 1
 
 
+@_instrumented("all_reduce")
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
                sync_op=True):
     """reference: paddle.distributed.all_reduce.
@@ -160,6 +208,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     return tensor
 
 
+@_instrumented("all_gather")
 def all_gather(tensor_list: Optional[List[Tensor]], tensor: Tensor,
                group: Optional[Group] = None, sync_op=True, axis: int = 0):
     """reference: paddle.distributed.all_gather — gathers shards along the
@@ -406,6 +455,7 @@ def _release_when_all_read(key, readers):
             st.get_store().set(key, b"")
 
 
+@_instrumented("all_gather_object")
 def all_gather_object(object_list, obj, group=None):
     """reference: communication/all_gather.py all_gather_object — host
     objects gathered rank-major over the TCPStore substrate."""
@@ -423,6 +473,7 @@ def all_gather_object(object_list, obj, group=None):
         _release_when_all_read(f"{key}/{r}", world)
 
 
+@_instrumented("reduce_scatter")
 def reduce_scatter(output: Tensor, input: Tensor, op=ReduceOp.SUM,
                    group: Optional[Group] = None, sync_op=True):
     """reference: communication/reduce_scatter.py — Partial→Shard(0) on
@@ -446,6 +497,7 @@ def reduce_scatter(output: Tensor, input: Tensor, op=ReduceOp.SUM,
     return output
 
 
+@_instrumented("broadcast")
 def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
               sync_op=True):
     """reference: paddle.distributed.broadcast — on SPMD lanes this is a
@@ -464,6 +516,7 @@ def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
     return tensor
 
 
+@_instrumented("reduce")
 def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM,
            group: Optional[Group] = None, sync_op=True):
     """reduce-to-root == all_reduce on SPMD lanes (root extraction is a
@@ -474,6 +527,7 @@ def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM,
     return all_reduce(tensor, op, group)
 
 
+@_instrumented("scatter")
 def scatter(tensor: Tensor, tensor_list=None, src=0,
             group: Optional[Group] = None, sync_op=True):
     """reference: paddle.distributed.scatter — Replicate→Shard(0) on SPMD
@@ -500,6 +554,7 @@ def scatter(tensor: Tensor, tensor_list=None, src=0,
     return tensor
 
 
+@_instrumented("all_to_all")
 def all_to_all(out_tensor_list, in_tensor_list,
                group: Optional[Group] = None, sync_op=True):
     """reference: communication/all_to_all.py — Shard(i)→Shard(j)."""
@@ -558,6 +613,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     return all_to_all(out_tensor_list, in_tensor_list, group, sync_op)
 
 
+@_instrumented("send")
 def send(tensor, dst=0, group=None, sync_op=True):
     """Eager p2p send (reference: communication/send.py).  Intra-process
     chips exchange via compiled ppermute (fleet/pipeline_parallel.py); eager
@@ -566,6 +622,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     return p2p.send(tensor, dst=dst, group=group, sync_op=sync_op)
 
 
+@_instrumented("recv")
 def recv(tensor, src=0, group=None, sync_op=True):
     """Eager p2p receive, in-place (reference: communication/recv.py)."""
     from . import p2p
@@ -582,6 +639,7 @@ def irecv(tensor, src=0, group=None):
     return p2p.irecv(tensor, src=src, group=group)
 
 
+@_instrumented("barrier")
 def barrier(group=None):
     """reference: paddle.distributed.barrier — multi-host SPMD syncs
     global devices; multi-process eager jobs rendezvous on the store."""
@@ -607,6 +665,7 @@ def get_backend(group=None) -> str:
 
 
 # ------------------------------------------------- host-object collectives
+@_instrumented("broadcast_object_list")
 def broadcast_object_list(object_list, src=0, group=None):
     """reference: communication/broadcast.py broadcast_object_list — replaces
     ``object_list`` contents in-place with ``src``'s list on every rank."""
@@ -624,6 +683,7 @@ def broadcast_object_list(object_list, src=0, group=None):
     return object_list
 
 
+@_instrumented("scatter_object_list")
 def scatter_object_list(out_list, in_list, src=0, group=None):
     """reference: communication/scatter.py scatter_object_list — rank r gets
     in_list[r] from ``src``."""
@@ -647,6 +707,7 @@ def scatter_object_list(out_list, in_list, src=0, group=None):
     return out_list
 
 
+@_instrumented("alltoall_single")
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     """reference: communication/all_to_all.py alltoall_single — one tensor
@@ -686,6 +747,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
     return res
 
 
+@_instrumented("gather")
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     """reference: communication/gather.py — collect tensors on rank dst.
     SPMD lane: all ranks see the full value (all_gather then keep);

@@ -17,7 +17,19 @@ import sys
 import threading
 from typing import Callable, List, Optional
 
+from .. import monitor
 from .fleet.elastic.manager import ELASTIC_EXIT_CODE
+
+# recovery telemetry (ISSUE 1): counts survive within a process and are
+# archived by monitor.dump_on_exit() across preempt/relaunch cycles
+_preemptions_total = monitor.counter(
+    "preemptions_total", "preemption signals received")
+_restarts_total = monitor.counter(
+    "restarts_total", "runs resumed from a checkpoint")
+_ckpts_saved_total = monitor.counter(
+    "checkpoints_saved_total", "checkpoints written")
+_ckpt_last_step = monitor.gauge(
+    "checkpoint_last_step", "step of the newest checkpoint written")
 
 __all__ = [
     "PreemptionHandler", "save_checkpoint", "latest_checkpoint",
@@ -47,6 +59,7 @@ class PreemptionHandler:
 
     def _on_signal(self, signum, frame):
         self._event.set()
+        _preemptions_total.inc()
         for cb in self._callbacks:
             try:
                 cb()
@@ -80,6 +93,8 @@ def save_checkpoint(state_dict: dict, ckpt_dir: str, step: int,
     tmp = final + ".tmp"
     _save(state_dict, tmp)
     os.replace(tmp, final)
+    _ckpts_saved_total.inc()
+    _ckpt_last_step.set(step)
     # prune (always keep at least the checkpoint just written)
     keep = max(keep_last_n, 1)
     ckpts = sorted(_list_checkpoints(ckpt_dir))
@@ -130,6 +145,8 @@ def run_with_resume(train_loop: Callable, ckpt_dir: str,
     handler = PreemptionHandler().install()
     try:
         state, start_step = load_checkpoint(ckpt_dir)
+        if start_step > 0:
+            _restarts_total.inc()
         result = train_loop(state, start_step, handler.preempted)
         if handler.preempted() and exit_on_preemption:
             sys.exit(ELASTIC_EXIT_CODE)

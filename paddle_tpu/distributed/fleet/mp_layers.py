@@ -20,7 +20,7 @@ from typing import Optional
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ...framework.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ...framework.tensor import Tensor

@@ -24,7 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ...framework.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ...framework.tensor import Tensor, wrap_array

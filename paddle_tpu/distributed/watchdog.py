@@ -20,7 +20,22 @@ import time
 import warnings
 from typing import Callable, Dict, Optional
 
+from .. import monitor
 from ..framework.flags import define_flag, get_flag
+
+# watchdog telemetry (ISSUE 1): a scraper can tell a dead watchdog from
+# a healthy-but-quiet one (heartbeat timestamp), see how many host
+# collectives are in flight and how old the oldest is, and count fired
+# timeouts across the job's lifetime
+_tasks_in_flight = monitor.gauge(
+    "comm_tasks_in_flight", "host collectives currently registered")
+_oldest_task_age = monitor.gauge(
+    "comm_oldest_task_age_seconds", "age of the oldest in-flight task")
+_heartbeat_ts = monitor.gauge(
+    "comm_watchdog_heartbeat_timestamp_seconds",
+    "unix time of the watchdog's last scan")
+_timeouts_total = monitor.counter(
+    "comm_timeouts_total", "collectives flagged as timed out")
 
 define_flag("comm_timeout_seconds", 1800.0,
             "watchdog timeout for host-side collectives/rendezvous")
@@ -113,10 +128,16 @@ class CommTaskManager:
                         if t.is_timeout(now) and tid not in self._flagged]
                 for tid, _ in hung:
                     self._flagged.add(tid)
+                _tasks_in_flight.set(len(self._tasks))
+                _oldest_task_age.set(
+                    max((now - t.started_at
+                         for t in self._tasks.values()), default=0.0))
+            _heartbeat_ts.set(time.time())
             for tid, t in hung:
                 self._on_timeout(t)
 
     def _on_timeout(self, task: CommTask) -> None:
+        _timeouts_total.inc()
         msg = (f"[comm-watchdog] collective '{task.name}' on thread "
                f"{task.thread_name} exceeded {task.timeout:.0f}s "
                f"(started {time.monotonic() - task.started_at:.0f}s ago); "

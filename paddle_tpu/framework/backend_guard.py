@@ -48,7 +48,8 @@ def pin_cpu(num_devices: int | None = None) -> bool:
     try:
         jax.config.update("jax_platforms", "cpu")
         if num_devices:
-            jax.config.update("jax_num_cpu_devices", int(num_devices))
+            from .jax_compat import pin_cpu_devices
+            pin_cpu_devices(int(num_devices))
     except Exception:
         return False   # raced with a concurrent init — pin had no effect
     return True

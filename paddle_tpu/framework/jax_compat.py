@@ -1,0 +1,111 @@
+"""Version adapters for the jax API surface this framework targets.
+
+The codebase targets the current jax API (top-level ``jax.shard_map``
+with ``check_vma=``); older jaxlib images (<= 0.4.x) ship it as
+``jax.experimental.shard_map.shard_map`` with ``check_rep=``.  Import
+``shard_map`` from here so both resolve to the same callable.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:                                    # jax >= 0.5
+    from jax import shard_map as _shard_map
+except ImportError:                     # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(f, *args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs.setdefault("check_rep", check_vma)
+        return _shard_map(f, *args, **kwargs)
+
+# jax.export: a real submodule on every supported version, but only
+# auto-exposed as an attribute on newer jax — import it so call sites
+# can keep writing ``jax.export.symbolic_shape(...)``
+import jax.export  # noqa: E402,F401
+
+import jax as _jax  # noqa: E402
+
+if hasattr(_jax.lax, "axis_size"):
+    def axis_size(axis_name):
+        return _jax.lax.axis_size(axis_name)
+else:
+    def axis_size(axis_name):
+        # the classic idiom: psum of a static 1 folds to the axis size
+        return _jax.lax.psum(1, axis_name)
+
+
+# pallas-TPU compiler params were renamed TPUCompilerParams ->
+# CompilerParams; alias the old spelling forward (same signature)
+try:
+    from jax.experimental.pallas import tpu as _pltpu
+    if not hasattr(_pltpu, "CompilerParams") \
+            and hasattr(_pltpu, "TPUCompilerParams"):
+        _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+except Exception:           # no pallas on this backend: kernels gate off
+    pass
+
+
+# -- memory spaces ------------------------------------------------------
+# Current jax exposes 'device'/'pinned_host' memory kinds on every
+# backend; older CPU backends expose a single 'unpinned_host' space and
+# reject both names.  Offload/streaming code asks these helpers instead
+# of hard-coding kind names, so on a single-memory backend host offload
+# degrades to a no-op (host and device memory coincide).
+
+import functools as _functools  # noqa: E402
+
+
+@_functools.lru_cache(maxsize=1)
+def memory_kinds():
+    """Memory kinds addressable by the default local device."""
+    try:
+        return frozenset(
+            m.kind for m in _jax.local_devices()[0].addressable_memories())
+    except Exception:
+        return frozenset()
+
+
+@_functools.lru_cache(maxsize=1)
+def default_memory_kind():
+    try:
+        return _jax.local_devices()[0].default_memory().kind
+    except Exception:
+        return "device"
+
+
+def is_compute_memory(kind) -> bool:
+    """True when ``kind`` names the backend's compute/default memory —
+    i.e. an array with this kind is NOT host-offloaded."""
+    return kind in (None, "device") or kind == default_memory_kind()
+
+
+def to_memory_kind(sharding, kind):
+    """``sharding.with_memory_kind(kind)`` where the backend supports
+    that space; the sharding unchanged where it does not."""
+    if kind in memory_kinds():
+        return sharding.with_memory_kind(kind)
+    return sharding
+
+
+def pin_cpu_devices(n: int) -> None:
+    """Provision ``n`` virtual CPU devices pre-init.  Current jax has a
+    config option; older jax only honors the XLA host-platform flag (an
+    env var read at first backend touch, so it must be set before)."""
+    import os
+    try:
+        _jax.config.update("jax_num_cpu_devices", int(n))
+    except AttributeError:      # "Unrecognized config option" pre-0.5
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={int(n)}"
+            ).strip()
+
+
+__all__ = ["shard_map", "axis_size", "memory_kinds",
+           "default_memory_kind", "is_compute_memory", "to_memory_kind",
+           "pin_cpu_devices"]

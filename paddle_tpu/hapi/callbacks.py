@@ -16,7 +16,7 @@ import numpy as np
 __all__ = [
     "Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
     "LRScheduler", "EarlyStopping", "ReduceLROnPlateau", "VisualDL",
-    "config_callbacks",
+    "MonitorCallback", "config_callbacks",
 ]
 
 
@@ -270,6 +270,57 @@ class ReduceLROnPlateau(Callback):
                         print(f"ReduceLROnPlateau: lr {old:.2e} -> {new:.2e}")
             self.cooldown_counter = self.cooldown
             self.wait = 0
+
+
+class MonitorCallback(Callback):
+    """Feeds the ``paddle_tpu.monitor`` registry from the fit loop:
+    per-step wall time (``train_step_seconds`` histogram, the span also
+    lands on the profiler timeline when one is recording), a running
+    ``train_samples_per_second`` gauge, the last ``train_loss`` gauge
+    and ``train_steps_total`` / ``train_samples_total`` counters.
+
+    The substrate every later perf PR measures against: run a fit with
+    this callback before and after, diff ``monitor.snapshot()``.
+    """
+
+    def __init__(self):
+        super().__init__()
+        from .. import monitor
+        self._step_s = monitor.histogram(
+            "train_step_seconds", "one train_batch wall time")
+        self._samples_per_s = monitor.gauge(
+            "train_samples_per_second", "throughput of the last step")
+        self._loss = monitor.gauge("train_loss", "last observed loss")
+        self._steps = monitor.counter("train_steps_total",
+                                      "train steps executed")
+        self._samples = monitor.counter("train_samples_total",
+                                        "samples consumed")
+        self._span = None
+
+    def on_train_batch_begin(self, step, logs=None):
+        from ..monitor import span
+        self._span = span("train/step", histogram=self._step_s)
+        self._span.__enter__()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._span is None:
+            return
+        self._span.__exit__(None, None, None)
+        dt = self._span.elapsed
+        self._span = None
+        logs = logs or {}
+        self._steps.inc()
+        bsz = logs.get("batch_size", 0)
+        if bsz:
+            self._samples.inc(bsz)
+            if dt > 0:
+                self._samples_per_s.set(bsz / dt)
+        loss = logs.get("loss")
+        if loss is not None:
+            try:
+                self._loss.set(float(np.asarray(loss).ravel()[0]))
+            except (TypeError, ValueError):
+                pass
 
 
 class VisualDL(Callback):

@@ -27,11 +27,32 @@ import time
 from typing import List, Optional
 
 import numpy as np
+from .. import monitor
 from ..ops.pallas.paged_attention import PagedKVCache
 
 __all__ = ["ContinuousBatchingEngine"]
 
 _PAD_SEQ = "__pad__"
+
+# engine telemetry (ISSUE 1): the serving-side numbers the ROADMAP's
+# "serve heavy traffic" goal is judged by
+_queue_depth = monitor.gauge(
+    "inference_queue_depth", "sequences waiting for admission")
+_active_seqs = monitor.gauge(
+    "inference_active_sequences", "sequences in the running decode batch")
+_batch_occupancy = monitor.histogram(
+    "inference_batch_occupancy", "active/max_batch fraction per decode "
+    "step", buckets=tuple(i / 8 for i in range(1, 9)))
+_decode_step_s = monitor.histogram(
+    "decode_step_seconds", "one continuous-batching decode step")
+_prefill_s = monitor.histogram(
+    "prefill_seconds", "one sequence's prefill")
+_tokens_total = monitor.counter(
+    "generated_tokens_total", "tokens produced by the decode loop")
+_ttft_s = monitor.histogram(
+    "time_to_first_token_seconds", "submit -> first sampled token")
+_gen_latency_s = monitor.histogram(
+    "generate_latency_seconds", "submit -> sequence retirement")
 
 
 class _Request:
@@ -119,6 +140,7 @@ class ContinuousBatchingEngine:
             if self._stop:
                 raise RuntimeError("engine stopped")
             self._queue.append(req)
+            _queue_depth.set(len(self._queue))
             self._cond.notify_all()
         return req
 
@@ -175,15 +197,18 @@ class ContinuousBatchingEngine:
             req.seq_id = self._next_seq
             self._next_seq += 1
             admitted.append(req)
+        _queue_depth.set(len(self._queue))
         return admitted
 
     def _prefill(self, req):
         # bucketed compiled prefill: one compile per power-of-two prompt
         # length, not one per distinct length
-        logits = self._decoder.prefill(self.cache, [req.seq_id],
-                                       req.prompt[None], bucket=True)
+        with monitor.span("engine/prefill", histogram=_prefill_s):
+            logits = self._decoder.prefill(self.cache, [req.seq_id],
+                                           req.prompt[None], bucket=True)
         req.next_token = self._pick(req, logits[0])
         req.first_token_at = time.perf_counter()
+        _ttft_s.observe(req.first_token_at - req.submitted_at)
 
     def _pick(self, req, logits_row) -> int:
         from .paged import sample_token
@@ -194,6 +219,7 @@ class ContinuousBatchingEngine:
         self.cache.free(req.seq_id)
         self._reserved_pages -= self._pages_for(req)
         req.finished_at = time.perf_counter()
+        _gen_latency_s.observe(req.finished_at - req.submitted_at)
         req.done.set()
 
     def _bucket(self, n: int) -> int:
@@ -220,15 +246,19 @@ class ContinuousBatchingEngine:
             self.cache.allocate(_PAD_SEQ, 1)
             self.cache.truncate(_PAD_SEQ, 0)
             seq_ids.extend([_PAD_SEQ] * npad)
+        _active_seqs.set(len(active))
+        _batch_occupancy.observe(len(active) / self.max_batch)
         try:
             # ONE compiled program per decode step for the whole running
             # batch (per-row positions, pools donated through the step)
-            logits_np = self._decoder.step(self.cache, seq_ids, tokens,
-                                           pos)
+            with monitor.span("engine/decode_step", histogram=_decode_step_s):
+                logits_np = self._decoder.step(self.cache, seq_ids, tokens,
+                                               pos)
         finally:
             if npad:
                 self.cache.free(_PAD_SEQ)
         self.steps += 1
+        _tokens_total.inc(len(active))
 
         still = []
         for i, r in enumerate(active):
@@ -240,6 +270,7 @@ class ContinuousBatchingEngine:
             r.next_token = self._pick(r, logits_np[i])
             still.append(r)
         self._active = still
+        _active_seqs.set(len(still))
 
     def _fail_all(self, exc, admitted):
         """Error out every in-flight request WITHOUT leaking pool
@@ -256,6 +287,8 @@ class ContinuousBatchingEngine:
                     self.cache.free(r.seq_id)
             self._reserved_pages = 1          # only the pad headroom
             self._active, self._queue = [], []
+            _active_seqs.set(0)
+            _queue_depth.set(0)
 
     def _loop(self):
         while True:

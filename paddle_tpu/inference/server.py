@@ -3,38 +3,63 @@ inference server / Paddle Serving's role — here a dependency-free
 stdlib implementation fronting the StableHLO Predictor).
 
 Endpoints (JSON; arrays as nested lists with dtype strings):
-  GET  /health          -> {"status": "ok", "model": prefix}
+  GET  /health          -> {"status": "ok", "model": prefix,
+                            "uptime_s": ..., "requests_total": ...}
   GET  /metadata        -> input/output names
+  GET  /metrics         -> Prometheus text exposition (paddle_tpu.monitor)
   POST /predict         -> {"inputs": {name: {"data": [...], "dtype": ...,
                             "shape": [...]}}} -> {"outputs": {...}}
 
 A PredictorPool serves concurrent requests; the ThreadingHTTPServer
-dispatches each request to a pool slot.
+dispatches each request to a pool slot.  Every request is measured into
+the process-wide metrics registry (``requests_total`` counter,
+``request_latency_seconds`` histogram, tagged by server and route).
 """
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
+from .. import monitor
 from . import Config, Predictor, PredictorPool
 
 __all__ = ["InferenceServer", "GenerationServer", "serve"]
 
 
-class _JsonHandler(BaseHTTPRequestHandler):
-    """Shared HTTP plumbing: quiet logs + JSON replies."""
+_requests_total = monitor.counter(
+    "requests_total", "HTTP requests served", ("server", "route"))
+_request_latency = monitor.histogram(
+    "request_latency_seconds", "HTTP request wall latency",
+    ("server", "route"))
 
-    def log_message(self, fmt, *args):   # quiet by default
-        pass
+
+class _JsonHandler(BaseHTTPRequestHandler):
+    """Shared HTTP plumbing: quiet logs (opt-in via access_log=True) +
+    JSON replies + per-route telemetry."""
+
+    server_kind = "http"     # overridden per server class
+
+    def log_message(self, fmt, *args):
+        if getattr(self, "_outer", None) is not None \
+                and self._outer._access_log:
+            BaseHTTPRequestHandler.log_message(self, fmt, *args)
 
     def _reply(self, code, payload):
         body = json.dumps(payload).encode()
+        self._reply_bytes(code, body, "application/json")
+
+    def _reply_text(self, code, text,
+                    content_type="text/plain; version=0.0.4"):
+        self._reply_bytes(code, text.encode(), content_type)
+
+    def _reply_bytes(self, code, body, content_type):
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -43,9 +68,38 @@ class _JsonHandler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0))
         return json.loads(self.rfile.read(n))
 
+    def _track(self, route):
+        """Count the request (registry + per-server cumulative count)
+        and return a latency span for the handling block."""
+        _requests_total.inc(server=self.server_kind, route=route)
+        self._outer._bump_requests()
+        return monitor.span(f"http/{self.server_kind}{route}",
+                            histogram=_request_latency,
+                            server=self.server_kind, route=route)
+
 
 class _ServerLifecycle:
-    """start/stop/context-manager block shared by both servers."""
+    """start/stop/context-manager + uptime/request accounting shared by
+    both servers."""
+
+    def _init_stats(self, access_log: bool):
+        self._access_log = bool(access_log)
+        self._started_at = time.monotonic()
+        self._requests_lock = threading.Lock()
+        self._requests_served = 0
+
+    def _bump_requests(self):
+        with self._requests_lock:
+            self._requests_served += 1
+
+    @property
+    def requests_served(self) -> int:
+        with self._requests_lock:
+            return self._requests_served
+
+    @property
+    def uptime_s(self) -> float:
+        return time.monotonic() - self._started_at
 
     def start(self):
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -79,7 +133,8 @@ class InferenceServer(_ServerLifecycle):
     """
 
     def __init__(self, model_prefix: str, host: str = "127.0.0.1",
-                 port: int = 0, pool_size: int = 1, device: str = ""):
+                 port: int = 0, pool_size: int = 1, device: str = "",
+                 access_log: bool = False):
         config = Config(model_prefix)
         if device == "cpu":
             config.disable_gpu()
@@ -91,18 +146,29 @@ class InferenceServer(_ServerLifecycle):
         self._pool_lock = threading.Lock()
         self._next = [0]
         self._size = pool_size
+        self._init_stats(access_log)
         outer = self
 
         class Handler(_JsonHandler):
+            server_kind = "inference"
+            _outer = outer
+
             def do_GET(self):
                 if self.path == "/health":
-                    self._reply(200, {"status": "ok",
-                                      "model": outer._prefix})
+                    with self._track("/health"):
+                        self._reply(200, {
+                            "status": "ok", "model": outer._prefix,
+                            "uptime_s": round(outer.uptime_s, 3),
+                            "requests_total": outer.requests_served})
                 elif self.path == "/metadata":
-                    p = outer._pool.retrieve(0)
-                    self._reply(200, {
-                        "inputs": p.get_input_names(),
-                        "outputs": p.get_output_names()})
+                    with self._track("/metadata"):
+                        p = outer._pool.retrieve(0)
+                        self._reply(200, {
+                            "inputs": p.get_input_names(),
+                            "outputs": p.get_output_names()})
+                elif self.path == "/metrics":
+                    with self._track("/metrics"):
+                        self._reply_text(200, monitor.prometheus_text())
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -110,11 +176,12 @@ class InferenceServer(_ServerLifecycle):
                 if self.path != "/predict":
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
-                try:
-                    out = outer._predict(self._read_json())
-                    self._reply(200, out)
-                except Exception as e:   # noqa: BLE001
-                    self._reply(400, {"error": str(e)})
+                with self._track("/predict"):
+                    try:
+                        out = outer._predict(self._read_json())
+                        self._reply(200, out)
+                    except Exception as e:   # noqa: BLE001
+                        self._reply(400, {"error": str(e)})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host = host
@@ -174,7 +241,7 @@ class GenerationServer(_ServerLifecycle):
 
     def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
                  total_pages: int = 512, page_size: int = 16,
-                 max_batch: int = 8):
+                 max_batch: int = 8, access_log: bool = False):
         from .continuous import ContinuousBatchingEngine
 
         self._engine = ContinuousBatchingEngine(
@@ -182,19 +249,29 @@ class GenerationServer(_ServerLifecycle):
             max_batch=max_batch)
         self._count_lock = threading.Lock()
         self._request_count = 0
+        self._init_stats(access_log)
         outer = self
 
         class Handler(_JsonHandler):
+            server_kind = "generation"
+            _outer = outer
+
             def do_GET(self):
                 if self.path == "/health":
-                    cache = outer._engine.cache
-                    self._reply(200, {
-                        "status": "ok",
-                        "free_pages": cache.free_pages,
-                        "total_pages": cache.total_pages,
-                        "page_size": cache.page_size,
-                        "active_sequences": len(outer._engine._active),
-                        "queued_sequences": len(outer._engine._queue)})
+                    with self._track("/health"):
+                        cache = outer._engine.cache
+                        self._reply(200, {
+                            "status": "ok",
+                            "uptime_s": round(outer.uptime_s, 3),
+                            "requests_total": outer.requests_served,
+                            "free_pages": cache.free_pages,
+                            "total_pages": cache.total_pages,
+                            "page_size": cache.page_size,
+                            "active_sequences": len(outer._engine._active),
+                            "queued_sequences": len(outer._engine._queue)})
+                elif self.path == "/metrics":
+                    with self._track("/metrics"):
+                        self._reply_text(200, monitor.prometheus_text())
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -202,6 +279,10 @@ class GenerationServer(_ServerLifecycle):
                 if self.path != "/generate":
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
+                with self._track("/generate"):
+                    self._do_generate()
+
+            def _do_generate(self):
                 try:
                     try:
                         req = self._read_json()

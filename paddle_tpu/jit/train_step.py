@@ -44,8 +44,9 @@ def _keep(arr):
 
 
 def _is_offloaded(sh):
+    from ..framework.jax_compat import is_compute_memory
     return sh is not None and \
-        getattr(sh, "memory_kind", None) not in (None, "device")
+        not is_compute_memory(getattr(sh, "memory_kind", None))
 
 
 def _pin(x, sh):
@@ -62,14 +63,19 @@ def _to_compute(x, sh):
     """Stream an offloaded operand into device memory for the update."""
     if x is None or not _is_offloaded(sh):
         return x
-    return jax.device_put(x, sh.with_memory_kind("device"))
+    return jax.device_put(x, _compat_device_kind(sh))
+
+
+def _compat_device_kind(sh):
+    from ..framework.jax_compat import to_memory_kind
+    return to_memory_kind(sh, "device")
 
 
 def _device_kind(sh):
     """The device-memory variant of a sharding (grads never offload —
     they are consumed immediately by the fused update)."""
     if _is_offloaded(sh):
-        return sh.with_memory_kind("device")
+        return _compat_device_kind(sh)
     return sh
 
 
@@ -142,7 +148,7 @@ class TrainStep:
             z = jnp.zeros_like(src)
             sh = _keep(src)
             if _is_offloaded(sh):
-                z = jax.device_put(z, sh.with_memory_kind("device"))
+                z = jax.device_put(z, _compat_device_kind(sh))
             return z
 
         self._grad_accum = [

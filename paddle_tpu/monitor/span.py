@@ -1,0 +1,65 @@
+"""Lightweight span tracing bridging the metrics registry and the
+profiler's host recorder.
+
+A ``span`` times a block once and fans the measurement out to both
+consumers: a Histogram observation (always, metrics are unconditional)
+and a profiler ``HostEvent`` (only while a Profiler has the recorder in
+a RECORD state — the push is a no-op otherwise, matching RecordEvent's
+contract in profiler/record.py).
+"""
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional
+
+from ..profiler.record import get_recorder
+from .registry import Histogram
+
+__all__ = ["span"]
+
+
+class span:
+    """``with span("collective/all_reduce", histogram=h, kind="all_reduce"):``
+
+    Times the block; observes elapsed seconds into ``histogram`` (with
+    the given labels) and records a host event named ``name`` for the
+    profiler timeline.  Usable as a decorator.  ``elapsed`` holds the
+    measured seconds after exit.
+    """
+
+    __slots__ = ("name", "histogram", "labels", "elapsed",
+                 "_t0", "_start_ns")
+
+    def __init__(self, name: str, histogram: Optional[Histogram] = None,
+                 **labels):
+        self.name = name
+        self.histogram = histogram
+        self.labels = labels
+        self.elapsed: Optional[float] = None
+        self._t0 = None
+        self._start_ns = None
+
+    def __enter__(self):
+        rec = get_recorder()
+        if rec.enabled:
+            self._start_ns = rec.now_ns()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        if self.histogram is not None:
+            self.histogram.observe(self.elapsed, **self.labels)
+        if self._start_ns is not None:
+            rec = get_recorder()
+            rec.push(self.name, self._start_ns, rec.now_ns())
+            self._start_ns = None
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with span(self.name, self.histogram, **self.labels):
+                return fn(*args, **kwargs)
+        return wrapper

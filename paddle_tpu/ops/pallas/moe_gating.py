@@ -33,6 +33,18 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash_attention import _ceil_to
 
 
+def _argmax_rows(x):
+    """Row-wise argmax as max + first-match index (reduce/compare/min
+    only — Mosaic has no argmax primitive on every supported jax)."""
+    E = x.shape[1]
+    m = jnp.max(x, axis=1, keepdims=True)
+    col = lax.broadcasted_iota(jnp.float32, x.shape, 1)
+    # float reduce: Mosaic only lowers float reductions; E is far below
+    # f32's exact-integer range
+    return jnp.min(jnp.where(x == m, col, float(E)),
+                   axis=1).astype(jnp.int32)
+
+
 def _round_kernel(logits_ref, fill_in_ref, eidx_ref, pos_ref, keep_ref,
                   w_ref, fill_out_ref, gsum_ref, fill_scr, gsum_scr, *,
                   round_k, capacity, n_tokens, block_t):
@@ -56,15 +68,17 @@ def _round_kernel(logits_ref, fill_in_ref, eidx_ref, pos_ref, keep_ref,
     # replay rounds 0..round_k-1 to mask their choices (deterministic)
     remaining = gates
     for _ in range(round_k):
-        prev = jnp.argmax(remaining, axis=1)
+        prev = _argmax_rows(remaining)
         oh = (lax.broadcasted_iota(jnp.int32, (block_t, E), 1)
               == prev[:, None]).astype(jnp.float32)
         remaining = remaining * (1.0 - oh)
 
-    idx = jnp.argmax(remaining, axis=1)              # (block_t,)
+    idx = _argmax_rows(remaining)                    # (block_t,)
+    # counts ride in f32 end to end (Mosaic lowers only float
+    # reductions); exact up to 2^24 assignments, far beyond any tile
     onehot = (lax.broadcasted_iota(jnp.int32, (block_t, E), 1)
-              == idx[:, None]).astype(jnp.int32)
-    onehot = onehot * valid.astype(jnp.int32)        # pad rows place none
+              == idx[:, None]).astype(jnp.float32)
+    onehot = onehot * valid.astype(jnp.float32)      # pad rows place none
     fill = fill_scr[0]                               # (E,) carried
     # within-tile exclusive prefix count as a strictly-lower-triangular
     # matmul (Mosaic has no cumsum primitive; this rides the MXU)
@@ -72,17 +86,18 @@ def _round_kernel(logits_ref, fill_in_ref, eidx_ref, pos_ref, keep_ref,
     c_i = lax.broadcasted_iota(jnp.int32, (block_t, block_t), 1)
     strict_tril = (c_i < r_i).astype(jnp.float32)
     prefix = lax.dot_general(
-        strict_tril, onehot.astype(jnp.float32),
+        strict_tril, onehot,
         (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(jnp.int32)
-    pos = jnp.sum((prefix + fill[None, :]) * onehot, axis=1)
+        preferred_element_type=jnp.float32)
+    pos = jnp.sum((prefix + fill[None, :].astype(jnp.float32)) * onehot,
+                  axis=1).astype(jnp.int32)
     within = (pos < capacity) & valid[:, 0]
-    gate_val = jnp.sum(gates * onehot.astype(jnp.float32), axis=1)
+    gate_val = jnp.sum(gates * onehot, axis=1)
     eidx_ref[0] = idx.astype(jnp.int32)
     pos_ref[0] = pos.astype(jnp.int32)
     keep_ref[0] = within.astype(jnp.int32)
     w_ref[0] = gate_val * within.astype(jnp.float32)
-    fill_scr[0] = fill + jnp.sum(onehot, axis=0)
+    fill_scr[0] = fill + jnp.sum(onehot, axis=0).astype(jnp.int32)
     if round_k == 0:
         # per-expert sum of gate probabilities over valid tokens — the
         # l_aux ingredient; only round 0's is consumed, so later rounds

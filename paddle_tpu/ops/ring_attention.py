@@ -20,7 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ..framework.jax_compat import shard_map, axis_size
 from jax.sharding import PartitionSpec as P
 
 from ..framework.dispatch import call_op
@@ -47,7 +47,7 @@ def ring_attention_fn(q, k, v, axis_name: str, causal: bool = False,
     """Per-shard body (call inside shard_map with seq sharded on axis_name)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    ring = lax.axis_size(axis_name)
+    ring = axis_size(axis_name)
     r = lax.axis_index(axis_name)
     sq = q.shape[2]
     perm = [(i, (i + 1) % ring) for i in range(ring)]
@@ -131,7 +131,7 @@ def zigzag_ring_attention_fn(q, k, v, axis_name: str,
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    ring = lax.axis_size(axis_name)
+    ring = axis_size(axis_name)
     r = lax.axis_index(axis_name)
     if q.shape[2] % 2 != 0:
         raise ValueError(

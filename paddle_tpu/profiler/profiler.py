@@ -46,6 +46,10 @@ def make_scheduler(*, closed: int, ready: int, record: int,
     repeated ``repeat`` times (0 = forever)."""
     if closed < 0 or ready < 0 or record <= 0:
         raise ValueError("closed/ready must be >=0 and record >=1")
+    if skip_first < 0:
+        raise ValueError("skip_first must be >= 0")
+    if repeat < 0:
+        raise ValueError("repeat must be >= 0 (0 = repeat forever)")
     span = closed + ready + record
 
     def scheduler(step: int) -> ProfilerState:

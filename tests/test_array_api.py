@@ -2,6 +2,7 @@
 exports: stacks/splits, predicates, numpy-alikes, in-place family, misc).
 After this surface, `paddle_tpu` has zero missing top-level exports vs the
 reference's python/paddle/__init__.py __all__."""
+import os
 import re
 
 import numpy as np
@@ -20,7 +21,13 @@ def t(a):
 rs = np.random.RandomState(0)
 
 
+_needs_reference = pytest.mark.skipif(
+    not os.path.isdir("/root/reference"),
+    reason="reference tree not mounted")
+
+
 class TestExportCompleteness:
+    @_needs_reference
     def test_no_missing_top_level_exports(self):
         ref = open("/root/reference/python/paddle/__init__.py").read()
         names = sorted(set(re.findall(r"^\s+'(\w+)',$", ref, re.M)))
@@ -316,6 +323,7 @@ class TestReviewRegressions2:
 
 
 class TestTensorMethodSurface:
+    @_needs_reference
     def test_no_missing_tensor_methods(self):
         t_ = t(np.array([1.0]))
         ref = open("/root/reference/python/paddle/tensor/"

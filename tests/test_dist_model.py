@@ -246,6 +246,9 @@ class TestDistMainProgram:
         x = paddle.to_tensor(np.zeros((8, 16), np.float32))
         dm(x, paddle.to_tensor(np.zeros((8, 16), np.float32)))
         txt = dm.dist_main_program()
-        assert "sdy.sharding" in txt          # real partitioning info
+        # real partitioning info, whichever partitioner this jax uses
+        # (Shardy annotates sdy.sharding, GSPMD mhlo.sharding)
+        assert "sdy.sharding" in txt or "mhlo.sharding" in txt
         assert "func.func" in txt             # actual program text
-        assert '"mp"' in txt                  # the mesh axis shows up
+        if "sdy.sharding" in txt:
+            assert '"mp"' in txt              # the mesh axis shows up

@@ -262,7 +262,7 @@ class TestRingAttention:
         import time
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from paddle_tpu.framework.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
         from paddle_tpu.ops.ring_attention import (
             ring_attention, ring_attention_fn, zigzag_ring_attention_fn,

@@ -97,6 +97,51 @@ class TestInferenceServer:
                 f"http://{srv.host}:{srv.port}/nope")
         assert e.value.code == 404
 
+    def test_health_reports_uptime_and_request_count(self, served_model):
+        _, srv = served_model
+        base = f"http://{srv.host}:{srv.port}"
+        h1 = json.loads(urllib.request.urlopen(base + "/health").read())
+        h2 = json.loads(urllib.request.urlopen(base + "/health").read())
+        assert h1["uptime_s"] >= 0 and h2["uptime_s"] >= h1["uptime_s"]
+        # the /health calls themselves count
+        assert h2["requests_total"] > h1["requests_total"] >= 1
+
+    def test_metrics_endpoint_roundtrip(self, served_model):
+        # a predict then a scrape: the exposition must be parseable and
+        # carry the acceptance metrics (requests_total counter +
+        # request_latency_seconds histogram)
+        import re
+        _, srv = served_model
+        base = f"http://{srv.host}:{srv.port}"
+        x = np.zeros((1, 4), np.float32)
+        _post(base + "/predict", {"inputs": {"input_0": {
+            "data": x.tolist(), "dtype": "float32"}}})
+        with urllib.request.urlopen(base + "/metrics") as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        line_re = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$')
+        for line in text.strip().splitlines():
+            assert line.startswith("#") or line_re.match(line), line
+        assert 'requests_total{server="inference",route="/predict"}' in text
+        assert 'request_latency_seconds_bucket{server="inference"' in text
+        assert "request_latency_seconds_count" in text
+        # scraping counts into the registry too: the counter must carry
+        # a /metrics series after this scrape
+        from paddle_tpu import monitor
+        assert monitor.get_registry().get("requests_total").value(
+            server="inference", route="/metrics") >= 1
+
+    def test_access_log_flag_controls_log_message(self, served_model,
+                                                  capsys):
+        # default server is quiet (access_log=False silences
+        # BaseHTTPRequestHandler's stderr logging)
+        _, srv = served_model
+        assert srv._access_log is False
+        urllib.request.urlopen(
+            f"http://{srv.host}:{srv.port}/health").read()
+        assert "GET /health" not in capsys.readouterr().err
+
 
 class TestGenerationServer:
     def test_generate_endpoint_matches_local(self):
@@ -135,6 +180,17 @@ class TestGenerationServer:
                     timeout=10) as resp:
                 health = json.loads(resp.read())
             assert health["free_pages"] == health["total_pages"] == 64
+            assert health["uptime_s"] >= 0
+            assert health["requests_total"] >= 1
+            # generation-side telemetry reached the shared registry
+            with urllib.request.urlopen(
+                    f"http://{srv.host}:{srv.port}/metrics",
+                    timeout=10) as resp:
+                text = resp.read().decode()
+            assert ('requests_total{server="generation",'
+                    'route="/generate"}') in text
+            assert "generated_tokens_total" in text
+            assert "decode_step_seconds_count" in text
 
     def test_bad_request_is_400(self):
         import json

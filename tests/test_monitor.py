@@ -1,0 +1,220 @@
+"""paddle_tpu.monitor — registry, spans and the instrumented hot paths
+(ISSUE 1 acceptance: train_step_seconds after a 3-step fit, per-kind
+collective histograms after one all_reduce)."""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu import monitor
+from paddle_tpu.monitor.registry import MetricRegistry
+
+
+class TestRegistry:
+    def test_counter_concurrent_increments(self):
+        reg = MetricRegistry()
+        c = reg.counter("t_concurrency_total", "x")
+        n_threads, per_thread = 8, 1000
+
+        def work():
+            for _ in range(per_thread):
+                c.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value() == n_threads * per_thread
+
+    def test_counter_rejects_negative(self):
+        c = MetricRegistry().counter("t_neg_total")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_set_inc_dec(self):
+        g = MetricRegistry().gauge("t_gauge", "x", ("k",))
+        g.set(5, k="a")
+        g.inc(2, k="a")
+        g.dec(3, k="a")
+        assert g.value(k="a") == 4
+        assert g.value(k="other") == 0
+
+    def test_label_mismatch_raises(self):
+        c = MetricRegistry().counter("t_lbl_total", "x", ("kind",))
+        with pytest.raises(ValueError):
+            c.inc()                      # missing label
+        with pytest.raises(ValueError):
+            c.inc(kind="x", extra="y")   # unknown label
+
+    def test_get_or_create_type_conflict_raises(self):
+        reg = MetricRegistry()
+        reg.counter("t_conflict", "x")
+        assert reg.counter("t_conflict") is reg.get("t_conflict")
+        with pytest.raises(ValueError):
+            reg.gauge("t_conflict")
+        with pytest.raises(ValueError):
+            reg.counter("t_conflict", label_names=("k",))
+
+    def test_histogram_bucket_boundaries(self):
+        # le buckets are upper-INCLUSIVE: an observation exactly on a
+        # bound lands in that bucket, one past it in the next
+        h = MetricRegistry().histogram("t_hist", "x", buckets=(1.0, 2.0, 4.0))
+        h.observe(1.0)       # le=1
+        h.observe(1.5)       # le=2
+        h.observe(2.0)       # le=2
+        h.observe(4.0001)    # +Inf only
+        assert h.cumulative_counts() == [1, 3, 3, 4]
+        s, c = h.sum_count()
+        assert c == 4 and s == pytest.approx(8.5001)
+
+    def test_snapshot_json_roundtrip(self):
+        reg = MetricRegistry()
+        reg.counter("t_snap_total", "x", ("k",)).inc(3, k="a")
+        reg.histogram("t_snap_seconds", buckets=(1.0,)).observe(0.5)
+        snap = json.loads(json.dumps(reg.snapshot()))
+        assert snap["t_snap_total"]["series"][0] == {
+            "labels": {"k": "a"}, "value": 3}
+        hs = snap["t_snap_seconds"]["series"][0]
+        assert hs["count"] == 1 and hs["buckets"]["+Inf"] == 1
+
+    def test_prometheus_text_format(self):
+        reg = MetricRegistry()
+        reg.counter("t_prom_total", "help text", ("k",)).inc(2, k='a"b\n')
+        reg.histogram("t_prom_seconds", "lat", buckets=(0.1,)).observe(0.05)
+        text = reg.prometheus_text()
+        assert "# TYPE t_prom_total counter" in text
+        assert 't_prom_total{k="a\\"b\\n"} 2' in text
+        assert 't_prom_seconds_bucket{le="0.1"} 1' in text
+        assert 't_prom_seconds_bucket{le="+Inf"} 1' in text
+        assert "t_prom_seconds_sum 0.05" in text
+        assert "t_prom_seconds_count 1" in text
+        # every line is exposition-shaped
+        for line in text.strip().splitlines():
+            assert line.startswith("#") or " " in line
+
+    def test_log_scale_default_buckets(self):
+        bk = monitor.DEFAULT_LATENCY_BUCKETS
+        ratios = {round(b / a, 6) for a, b in zip(bk, bk[1:])}
+        assert ratios == {2.0}           # fixed log scale
+
+    def test_dump_appends_jsonl(self, tmp_path):
+        monitor.counter("t_dump_total").inc()
+        path = str(tmp_path / "snap.jsonl")
+        monitor.dump(path)
+        monitor.dump(path)
+        lines = [json.loads(x)
+                 for x in open(path).read().splitlines()]
+        assert len(lines) == 2
+        assert lines[0]["snapshot"]["t_dump_total"]["series"][0]["value"] >= 1
+        assert "ts" in lines[0] and "iso" in lines[0]
+
+    def test_dump_on_exit_registers_once(self, tmp_path):
+        p = str(tmp_path / "exit.jsonl")
+        assert monitor.dump_on_exit(p) == p
+        assert monitor.dump_on_exit(p) == p
+        from paddle_tpu.monitor import registry as reg_mod
+        assert reg_mod._dump_paths.count(p) == 1
+        reg_mod._dump_paths.remove(p)    # don't write into tmp after teardown
+
+
+class TestSpan:
+    def test_span_observes_histogram(self):
+        h = MetricRegistry().histogram("t_span_seconds", buckets=(60.0,))
+        with monitor.span("test/span", histogram=h):
+            pass
+        _, c = h.sum_count()
+        assert c == 1
+        assert h.cumulative_counts() == [1, 1]
+
+    def test_span_feeds_profiler_recorder_when_recording(self):
+        from paddle_tpu.profiler.record import get_recorder
+        rec = get_recorder()
+        rec.enable(True)
+        try:
+            rec.collect()                # drain anything stale
+            with monitor.span("test/profiled"):
+                pass
+            names = [e.name for e in rec.collect()]
+        finally:
+            rec.enable(False)
+        assert "test/profiled" in names
+
+    def test_span_silent_when_not_recording(self):
+        from paddle_tpu.profiler.record import get_recorder
+        rec = get_recorder()
+        rec.collect()
+        with monitor.span("test/silent"):
+            pass
+        assert all(e.name != "test/silent" for e in rec.collect())
+
+
+class TestInstrumentedPaths:
+    def test_all_reduce_records_per_kind_histograms(self):
+        import paddle_tpu.distributed as dist
+        lat = monitor.get_registry().get("collective_latency_seconds")
+        calls = monitor.get_registry().get("collective_calls_total")
+        before_n = lat.sum_count(kind="all_reduce")[1]
+        before_c = calls.value(kind="all_reduce")
+        t = paddle.to_tensor(np.ones((8, 8), np.float32))
+        dist.all_reduce(t)
+        assert calls.value(kind="all_reduce") == before_c + 1
+        assert lat.sum_count(kind="all_reduce")[1] == before_n + 1
+        bts = monitor.get_registry().get("collective_bytes")
+        s, c = bts.sum_count(kind="all_reduce")
+        assert c >= 1 and s >= 8 * 8 * 4
+
+    def test_fit_with_monitor_callback_records_step_time(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m = paddle.Model(net)
+        m.prepare(optimizer=optim.Adam(parameters=net.parameters(),
+                                       learning_rate=1e-2),
+                  loss=nn.CrossEntropyLoss())
+        x = np.random.randn(24, 4).astype("float32")
+        y = (x.sum(1) > 0).astype("int64")
+        data = [(x[i], y[i]) for i in range(24)]
+
+        steps = monitor.get_registry().get("train_steps_total")
+        hist = monitor.get_registry().get("train_step_seconds")
+        before = steps.value() if steps else 0
+        cb = paddle.callbacks.MonitorCallback()
+        m.fit(data, batch_size=8, epochs=1, verbose=0, callbacks=[cb])
+
+        snap = monitor.snapshot()
+        series = snap["train_step_seconds"]["series"][0]
+        assert series["count"] >= 3                # 24/8 = 3 steps
+        assert series["sum"] > 0                   # non-zero observations
+        assert snap["train_steps_total"]["series"][0]["value"] == before + 3
+        assert snap["train_samples_total"]["series"][0]["value"] >= 24
+        assert snap["train_loss"]["series"], "loss gauge never set"
+        assert snap["train_samples_per_second"]["series"][0]["value"] > 0
+
+    def test_watchdog_heartbeat_and_inflight_gauges(self):
+        import time
+        from paddle_tpu.distributed.watchdog import CommTaskManager
+        mgr = CommTaskManager(scan_interval=0.02)
+        tid = mgr.begin("test_op", timeout=1e9)
+        mgr.start()
+        try:
+            time.sleep(0.1)
+            reg = monitor.get_registry()
+            assert reg.get("comm_tasks_in_flight").value() >= 1
+            assert reg.get(
+                "comm_watchdog_heartbeat_timestamp_seconds").value() > 0
+            assert reg.get("comm_oldest_task_age_seconds").value() > 0
+        finally:
+            mgr.end(tid)
+            mgr.stop()
+
+    def test_checkpoint_counters(self, tmp_path):
+        from paddle_tpu.distributed.fault_tolerance import save_checkpoint
+        reg = monitor.get_registry()
+        before = reg.get("checkpoints_saved_total").value()
+        save_checkpoint({"w": paddle.to_tensor([1.0])}, str(tmp_path), 7)
+        assert reg.get("checkpoints_saved_total").value() == before + 1
+        assert reg.get("checkpoint_last_step").value() == 7

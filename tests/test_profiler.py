@@ -69,6 +69,24 @@ class TestScheduler:
         with pytest.raises(ValueError):
             make_scheduler(closed=0, ready=0, record=0)
 
+    def test_negative_skip_first_raises(self):
+        with pytest.raises(ValueError):
+            make_scheduler(closed=1, ready=1, record=1, skip_first=-1)
+        with pytest.raises(ValueError):
+            make_scheduler(closed=1, ready=1, record=1, repeat=-1)
+
+    def test_repeat_boundary_returns_to_closed(self):
+        # after the final cycle the state machine must land in CLOSED
+        # and STAY there — not keep recording on later steps
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                               skip_first=2)
+        span = 1 + 1 + 2
+        end = 2 + 2 * span
+        # last step of the final cycle flushes
+        assert sched(end - 1) == ProfilerState.RECORD_AND_RETURN
+        for step in range(end, end + 3 * span):
+            assert sched(step) == ProfilerState.CLOSED, step
+
 
 class TestProfiler:
     def test_records_op_events(self):

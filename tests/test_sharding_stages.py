@@ -10,7 +10,14 @@ import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.optimizer as optim
 from paddle_tpu.distributed import group_sharded_parallel
+from paddle_tpu.framework.jax_compat import memory_kinds
 from paddle_tpu.jit import TrainStep
+
+# offload residency is only observable where the backend has a distinct
+# host memory space; on single-memory backends it degrades to a no-op
+_needs_pinned_host = pytest.mark.skipif(
+    "pinned_host" not in memory_kinds(),
+    reason="backend has a single memory space (no pinned_host)")
 
 D = 256
 
@@ -99,6 +106,7 @@ class TestZeroStages:
                 assert "sharding" in str(p._data.sharding.spec), \
                     p._data.sharding
 
+    @_needs_pinned_host
     def test_offload_places_states_in_host_memory(self):
         # VERDICT r3 item 8: offload=True must actually move optimizer
         # state (and masters) to host memory — shardings carry
@@ -146,6 +154,7 @@ class TestZeroStages:
             losses[off] = float(loss.numpy())
         np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
 
+    @_needs_pinned_host
     def test_offload_eager_step_path(self):
         # offload must not break the plain loss.backward(); opt.step()
         # flow — the eager path stages host state around the fused update
@@ -172,6 +181,7 @@ class TestZeroStages:
                  for a in acc.values()}
         assert kinds == {"pinned_host"}, kinds
 
+    @_needs_pinned_host
     def test_offload_with_accumulation_and_masters(self):
         import jax.numpy as jnp
         paddle.seed(0)

@@ -42,6 +42,33 @@ class TestOpBenchmark:
         assert op_benchmark.compare(base, part, threshold=0.05) == 1
 
 
+class TestMetricsSmoke:
+    def _load(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "metrics_smoke", os.path.join(REPO, "tools",
+                                          "metrics_smoke.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_exposition_parser_accepts_and_rejects(self):
+        ms = self._load()
+        good = ('# HELP a_total help\n# TYPE a_total counter\n'
+                'a_total{k="v"} 3\n'
+                'lat_bucket{le="+Inf"} 1\nlat_sum 0.5\nlat_count 1\n')
+        samples = ms.parse_exposition(good)
+        assert samples["a_total"] == 1 and samples["lat_bucket"] == 1
+        with pytest.raises(ValueError):
+            ms.parse_exposition("not a metric line at all\n")
+        with pytest.raises(ValueError):
+            ms.parse_exposition("a_total{k=unquoted} x\n")
+
+    def test_smoke_gate_passes(self):
+        # the full loop: server up -> generate -> scrape -> parse
+        assert self._load().main() == 0
+
+
 class TestCostModelFacade:
     def test_alias(self):
         import paddle_tpu as paddle

@@ -1,0 +1,90 @@
+"""Metrics-endpoint smoke gate (ISSUE 1 CI satellite).
+
+Starts a GenerationServer on a free port with a tiny LLaMA, issues one
+/generate request, scrapes GET /metrics and asserts the Prometheus
+exposition parses and carries the acceptance series (requests_total,
+request_latency_seconds).  Exit 0 = healthy, 1 = broken — the tier-1
+suite runs main() via tests/test_tools.py, and `python
+tools/metrics_smoke.py` is the standalone CI lane.
+"""
+from __future__ import annotations
+
+import json
+import re
+import sys
+import urllib.request
+
+_LINE_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$')
+
+
+def parse_exposition(text: str) -> dict:
+    """Validate Prometheus text format 0.0.4; returns {name: n_samples}.
+    Raises ValueError on any malformed line."""
+    samples = {}
+    for line in text.strip().splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            if not re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ",
+                            line):
+                raise ValueError(f"malformed comment line: {line!r}")
+            continue
+        m = _LINE_RE.match(line)
+        if not m:
+            raise ValueError(f"malformed sample line: {line!r}")
+        name = line.split("{")[0].split(" ")[0]
+        float(line.rsplit(" ", 1)[1])    # value must be numeric
+        samples[name] = samples.get(name, 0) + 1
+    return samples
+
+
+def main() -> int:
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import GenerationServer
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=2,
+                      num_key_value_heads=2, max_position_embeddings=64)
+    model = LlamaForCausalLM(cfg)
+    ids = np.random.default_rng(0).integers(0, 64, (1, 4)).astype("int32")
+
+    with GenerationServer(model, total_pages=32, page_size=8) as srv:
+        base = f"http://{srv.host}:{srv.port}"
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"input_ids": ids.tolist(),
+                             "max_new_tokens": 3}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        if out.get("new_tokens") != 3:
+            print(f"FAIL: generate returned {out}", file=sys.stderr)
+            return 1
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+            ctype = resp.headers.get("Content-Type", "")
+            text = resp.read().decode()
+
+    if not ctype.startswith("text/plain"):
+        print(f"FAIL: /metrics content-type {ctype!r}", file=sys.stderr)
+        return 1
+    try:
+        samples = parse_exposition(text)
+    except ValueError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    required = ("requests_total", "request_latency_seconds_bucket",
+                "request_latency_seconds_count", "generated_tokens_total")
+    missing = [name for name in required if name not in samples]
+    if missing:
+        print(f"FAIL: exposition missing {missing}", file=sys.stderr)
+        return 1
+    print(f"OK: /metrics parsed, {sum(samples.values())} samples across "
+          f"{len(samples)} series names")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,7 +23,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+
+from paddle_tpu.framework.jax_compat import pin_cpu_devices  # noqa: E402
+
+pin_cpu_devices(8)
 
 import numpy as np  # noqa: E402
 
