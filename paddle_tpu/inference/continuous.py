@@ -24,7 +24,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional
+from collections import deque
+from typing import Deque, List, Optional
 
 import numpy as np
 from .. import monitor
@@ -53,6 +54,20 @@ _ttft_s = monitor.histogram(
     "time_to_first_token_seconds", "submit -> first sampled token")
 _gen_latency_s = monitor.histogram(
     "generate_latency_seconds", "submit -> sequence retirement")
+# serving hot-path telemetry (ISSUE 2): prefix-cache effectiveness and
+# the on-device-sampling mode flag
+_prefix_lookups = monitor.counter(
+    "prefix_cache_lookups_total", "admissions that consulted the prefix "
+    "cache")
+_prefix_hits = monitor.counter(
+    "prefix_cache_hits_total", "admissions whose prompt shared a cached "
+    "page-aligned prefix")
+_prefix_hit_tokens = monitor.counter(
+    "prefix_cache_hit_tokens_total", "prompt tokens served from cached "
+    "prefix pages instead of being re-prefilled")
+_sampling_on_device_g = monitor.gauge(
+    "sampling_on_device", "1 when the engine samples inside the compiled "
+    "step (host transfer is (batch,) ids), 0 on the host-logits path")
 
 
 class _Request:
@@ -65,7 +80,9 @@ class _Request:
         self.eos_token_id = eos_token_id
         self.do_sample = bool(do_sample)
         self.temperature = float(temperature)
+        self.seed = int(seed) & 0xFFFFFFFF   # on-device threefry seed
         self.rng = np.random.default_rng(seed)
+        self.prefix_tokens = 0               # prompt tokens shared at admit
         self.generated: List[int] = []
         self.next_token: Optional[int] = None   # sampled, not yet decoded
         self.seq_id: Optional[int] = None
@@ -93,23 +110,38 @@ class ContinuousBatchingEngine:
 
     ``submit`` is thread-safe and non-blocking; ``generate`` is the
     blocking batch facade with PagedGenerator's signature.
+
+    Hot-path defaults (ISSUE 2): ``sample_on_device`` fuses greedy
+    argmax + temperature sampling into the compiled step, so each
+    decode step transfers (batch,) int32 ids instead of the full
+    (batch, vocab) logits; ``prefix_cache`` keeps retired prompts'
+    page-aligned prefix KV resident (refcounted, LRU-evicted under
+    pool pressure) so a request sharing a cached prefix maps those
+    pages read-only and prefills only its suffix.
     """
 
     def __init__(self, model, total_pages: int = 512, page_size: int = 16,
-                 max_batch: int = 8):
+                 max_batch: int = 8, sample_on_device: bool = True,
+                 prefix_cache: bool = True):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_position = int(model.config.max_position_embeddings)
+        self.sample_on_device = bool(sample_on_device)
+        self.prefix_cache = bool(prefix_cache)
+        _sampling_on_device_g.set(int(self.sample_on_device))
         self.cache = PagedKVCache.from_model(
             model, total_pages=total_pages, page_size=page_size)
         from .paged import JittedPagedDecoder
         self._decoder = JittedPagedDecoder(model)
         # one scratch sequence backs every padding row of every bucket;
-        # its single page is allocated only for the duration of a padded
-        # step (so an idle engine reports a fully reclaimed pool), but
-        # admission arithmetic always reserves 1 page for it
+        # its single page stays allocated WHILE sequences are active
+        # (the old allocate/truncate/free per padded step churned the
+        # free list under the pool lock) and is released whenever the
+        # engine goes idle, so an idle engine still reports a fully
+        # reclaimed pool; admission arithmetic always reserves 1 page
+        # for it either way
         self._reserved_pages = 1               # headroom for the pad page
-        self._queue: List[_Request] = []
+        self._queue: Deque[_Request] = deque()
         self._active: List[_Request] = []
         self._cond = threading.Condition()
         self._stop = False
@@ -184,29 +216,76 @@ class ContinuousBatchingEngine:
         """Under the lock: move queued requests to 'admitted' while slots
         and reserved pages allow, assigning seq ids and RESERVING their
         worst-case pages (prompt + full max_new_tokens) so decode-time
-        allocate() can never exhaust the pool.  Prefill itself runs
-        outside the lock — submit() must never wait on device work."""
+        allocate() can never exhaust the pool.  A prompt whose prefix is
+        already cached ACQUIRES the shared pages here (pinning them
+        against eviction) and reserves only what the pool must newly
+        provide: the un-shared pages plus whichever shared pages were
+        not already pinned by another live sharer — shared pages are
+        counted once across the engine, not once per sharer.  Prefill
+        itself runs outside the lock — submit() must never wait on
+        device work."""
         admitted = []
         while self._queue and len(self._active) + len(admitted) < self.max_batch:
             req = self._queue[0]
-            need = self._pages_for(req)
+            shared_tok, newly_pinned = (
+                self.cache.probe_prefix(req.prompt) if self.prefix_cache
+                else (0, 0))
+            need = (self._pages_for(req)
+                    - shared_tok // self.cache.page_size + newly_pinned)
             if self._reserved_pages + need > self.cache.total_pages:
                 break                     # wait for a retirement
-            self._queue.pop(0)
+            self._queue.popleft()
             self._reserved_pages += need
             req.seq_id = self._next_seq
             self._next_seq += 1
+            if shared_tok:
+                got = self.cache.acquire_prefix(req.seq_id, req.prompt)
+                assert got == shared_tok   # nothing ran between probe/acquire
+                req.prefix_tokens = got
             admitted.append(req)
         _queue_depth.set(len(self._queue))
         return admitted
 
+    def _sampling_for(self, reqs, ctrs):
+        """(seeds, ctrs, temps, flags) arrays for the fused on-device
+        sampler, padded to ``len(ctrs)`` rows (pad rows draw nothing:
+        flags False).  ``ctrs`` is each row's absolute token position —
+        the replay-stable per-draw counter."""
+        n = len(ctrs)
+        seeds = np.zeros(n, np.uint32)
+        temps = np.ones(n, np.float32)
+        flags = np.zeros(n, bool)
+        for i, r in enumerate(reqs):
+            seeds[i] = r.seed
+            temps[i] = max(r.temperature, 1e-6)
+            flags[i] = r.do_sample
+        return seeds, np.asarray(ctrs, np.int32), temps, flags
+
     def _prefill(self, req):
         # bucketed compiled prefill: one compile per power-of-two prompt
-        # length, not one per distinct length
+        # (or suffix) length, not one per distinct length
+        k = req.prefix_tokens
+        sampling = (self._sampling_for([req], [len(req.prompt)])
+                    if self.sample_on_device else None)
         with monitor.span("engine/prefill", histogram=_prefill_s):
-            logits = self._decoder.prefill(self.cache, [req.seq_id],
-                                           req.prompt[None], bucket=True)
-        req.next_token = self._pick(req, logits[0])
+            if k:
+                out = self._decoder.prefix_prefill(
+                    self.cache, [req.seq_id], req.prompt[None, k:],
+                    prefix_tokens=k, bucket=True, sampling=sampling)
+            else:
+                out = self._decoder.prefill(
+                    self.cache, [req.seq_id], req.prompt[None],
+                    bucket=True, sampling=sampling)
+        if self.prefix_cache:
+            _prefix_lookups.inc()
+            if k:
+                _prefix_hits.inc()
+                _prefix_hit_tokens.inc(k)
+            # retain this prompt's page-aligned prefixes for later
+            # sharers (idempotent for the pages it itself shared)
+            self.cache.register_prefix(req.seq_id, req.prompt)
+        req.next_token = (int(out[0]) if sampling is not None
+                          else self._pick(req, out[0]))
         req.first_token_at = time.perf_counter()
         _ttft_s.observe(req.first_token_at - req.submitted_at)
 
@@ -216,11 +295,17 @@ class ContinuousBatchingEngine:
                             req.rng)
 
     def _retire(self, req):
-        self.cache.free(req.seq_id)
-        self._reserved_pages -= self._pages_for(req)
+        """Release the request's pages and exactly the reservation its
+        retirement uncovers: the worst-case pages it never allocated,
+        plus each held page that stopped being pinned (a shared page
+        another live sharer still maps keeps its reservation — it
+        transfers to that sharer's accounting)."""
+        slack = (self._pages_for(req)
+                 - len(self.cache._seq_pages.get(req.seq_id, ())))
+        released = self.cache.free(req.seq_id)
+        self._reserved_pages -= slack + released
         req.finished_at = time.perf_counter()
         _gen_latency_s.observe(req.finished_at - req.submitted_at)
-        req.done.set()
 
     def _bucket(self, n: int) -> int:
         from .paged import next_pow2
@@ -241,52 +326,80 @@ class ContinuousBatchingEngine:
             tokens[i, 0] = r.next_token
             pos[i] = self.cache.length(r.seq_id)
             seq_ids.append(r.seq_id)       # decoder.step allocates pages
-        # pad rows: a scratch sequence rewrites its slot 0 every step
+        # pad rows: a scratch sequence rewrites its slot 0 every step;
+        # its page PERSISTS across steps (no allocate/free churn) and is
+        # released only when the engine drains
         if npad:
-            self.cache.allocate(_PAD_SEQ, 1)
+            # truncate FIRST: the pad length advanced once per pad row
+            # last step, and allocating against that stale length could
+            # demand a second page once max_batch > page_size — the
+            # scratch sequence must only ever hold its one headroom page
             self.cache.truncate(_PAD_SEQ, 0)
+            self.cache.allocate(_PAD_SEQ, 1)   # no-op while already held
             seq_ids.extend([_PAD_SEQ] * npad)
         _active_seqs.set(len(active))
         _batch_occupancy.observe(len(active) / self.max_batch)
-        try:
-            # ONE compiled program per decode step for the whole running
-            # batch (per-row positions, pools donated through the step)
-            with monitor.span("engine/decode_step", histogram=_decode_step_s):
-                logits_np = self._decoder.step(self.cache, seq_ids, tokens,
-                                               pos)
-        finally:
-            if npad:
-                self.cache.free(_PAD_SEQ)
+        # the gauge is process-global (last constructor wins), so the
+        # engine doing the decoding re-asserts its mode every step —
+        # a live server's /metrics stays truthful even after another
+        # engine (bench baseline, parity test) was built in-process
+        _sampling_on_device_g.set(int(self.sample_on_device))
+        on_device = self.sample_on_device
+        sampling = (self._sampling_for(active, pos + 1) if on_device
+                    else None)
+        # ONE compiled program per decode step for the whole running
+        # batch (per-row positions, pools donated through the step);
+        # with on-device sampling the result is (B,) token ids — the
+        # only per-step device->host transfer
+        with monitor.span("engine/decode_step", histogram=_decode_step_s):
+            out_np = self._decoder.step(self.cache, seq_ids, tokens,
+                                        pos, sampling=sampling)
         self.steps += 1
         _tokens_total.inc(len(active))
 
-        still = []
+        still, retired = [], []
         for i, r in enumerate(active):
             eos_hit = (r.eos_token_id is not None
                        and r.generated[-1] == r.eos_token_id)
             if eos_hit or len(r.generated) >= r.max_new_tokens:
                 self._retire(r)
+                retired.append(r)
                 continue
-            r.next_token = self._pick(r, logits_np[i])
+            r.next_token = (int(out_np[i]) if on_device
+                            else self._pick(r, out_np[i]))
             still.append(r)
         self._active = still
+        if not still:
+            # idle: the scratch page goes back too, so a drained engine
+            # reports a fully reclaimed pool — released BEFORE waking
+            # the retired requests' waiters, who may assert exactly that
+            self.cache.free(_PAD_SEQ)
         _active_seqs.set(len(still))
+        for r in retired:
+            r.done.set()
 
     def _fail_all(self, exc, admitted):
         """Error out every in-flight request WITHOUT leaking pool
         capacity: sequences that already own pages are freed and their
         reservations rolled back, so the engine stays usable."""
         with self._cond:
-            for r in self._active + admitted + self._queue:
+            for r in self._active + admitted + list(self._queue):
                 if r.done.is_set():
-                    continue     # already retired successfully this step
+                    continue
+                if r.finished_at is not None:
+                    # retired successfully earlier THIS step (its
+                    # done.set() is deferred to the end of _decode_step):
+                    # deliver the completed generation, don't error it
+                    r.done.set()
+                    continue
                 r.error = exc
                 r.done.set()
             for r in self._active + admitted:
                 if r.seq_id is not None:
                     self.cache.free(r.seq_id)
+            self.cache.free(_PAD_SEQ)
             self._reserved_pages = 1          # only the pad headroom
-            self._active, self._queue = [], []
+            self._active, self._queue = [], deque()
             _active_seqs.set(0)
             _queue_depth.set(0)
 
@@ -296,7 +409,8 @@ class ContinuousBatchingEngine:
                 while not self._stop and not self._queue and not self._active:
                     self._cond.wait(timeout=0.5)
                 if self._stop:
-                    for r in self._queue + self._active:
+                    self.cache.free(_PAD_SEQ)
+                    for r in list(self._queue) + self._active:
                         r.error = RuntimeError("engine stopped")
                         r.done.set()
                     return

@@ -16,14 +16,89 @@ is the follow-up that needs it).
 """
 from __future__ import annotations
 
+import math
 from typing import List, Optional, Sequence
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor, wrap_array
 from ..framework.tape import no_grad
+from ..ops.pallas.flash_attention import DEFAULT_MASK_VALUE
 from ..ops.pallas.paged_attention import PagedKVCache, paged_attention
+
+
+def fused_sample(logits, seeds, ctrs, temps, flags):
+    """On-device fused sampling tail for the compiled decode/prefill
+    programs: per row, greedy argmax AND a temperature categorical draw
+    (threefry key = fold_in(PRNGKey(seed), ctr) — the counter is the
+    token's absolute position, so a (seed, position) pair replays the
+    same draw), selected by ``flags``.  All inputs are traced; only the
+    (batch,) int32 token ids ever cross the host boundary.
+
+    logits (batch, vocab) f32; seeds (batch,) uint32; ctrs (batch,)
+    int32; temps (batch,) f32; flags (batch,) bool (True = sample).
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def draw(seed, ctr, row, temp):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), ctr)
+        return jax.random.categorical(key,
+                                      row / jnp.maximum(temp, 1e-6))
+
+    sampled = jax.vmap(draw)(seeds, ctrs, logits, temps).astype(jnp.int32)
+    return jnp.where(flags, sampled, greedy)
+
+
+def _prefix_suffix_attention(q, k_suf, v_suf, k_pages, v_pages, tables,
+                             prefix_lens):
+    """Prompt-SUFFIX attention for a sequence whose prefix KV is already
+    cached in pages: every suffix token attends to the whole gathered
+    prefix plus the suffix causally.  Dense masked attention (the
+    suffix is one bounded bucket per compile; a flash variant is a
+    later kernel optimization).
+
+    q (b, s, q_heads, d); k_suf/v_suf (b, s, kv_heads, d) post-rope;
+    k/v_pages (kv_heads, total, page, d); tables (b, P) int32 pointing
+    at the prefix pages; prefix_lens (b,) int32 page-aligned.
+    Returns (b, s, q_heads, d).
+    """
+    b, s, qh, d = q.shape
+    kvh = k_suf.shape[2]
+    group = qh // kvh
+    page = k_pages.shape[2]
+    t_pre = tables.shape[1] * page
+
+    def gather(pages):     # (kvh, b, P, page, d) -> (b, kvh, t_pre, d)
+        g = jnp.take(pages, tables, axis=1)
+        return g.transpose(1, 0, 2, 3, 4).reshape(b, kvh, t_pre, d)
+
+    k_all = jnp.concatenate(
+        [gather(k_pages).astype(q.dtype), jnp.swapaxes(k_suf, 1, 2)],
+        axis=2)                                   # (b, kvh, t_pre + s, d)
+    v_all = jnp.concatenate(
+        [gather(v_pages).astype(q.dtype), jnp.swapaxes(v_suf, 1, 2)],
+        axis=2)
+    if group != 1:
+        k_all = jnp.repeat(k_all, group, axis=1)
+        v_all = jnp.repeat(v_all, group, axis=1)
+    qt = jnp.swapaxes(q, 1, 2)                    # (b, qh, s, d)
+    scores = jnp.einsum("bhsd,bhtd->bhst", qt, k_all,
+                        preferred_element_type=jnp.float32) \
+        / math.sqrt(d)
+    t = jnp.arange(t_pre + s, dtype=jnp.int32)
+    # prefix cols: valid below the row's (page-aligned) prefix length;
+    # suffix cols: causal within the suffix (right-padded bucket pads
+    # sit after every real token, so causality masks them out)
+    valid_pre = (t[None, :] < prefix_lens[:, None])[:, None, None, :]
+    i = jnp.arange(s, dtype=jnp.int32)
+    valid_suf = ((t[None, :] >= t_pre)
+                 & (t[None, :] - t_pre <= i[:, None]))[None, None]
+    scores = jnp.where(valid_pre | valid_suf, scores, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhst,bhtd->bhsd", p.astype(v_all.dtype), v_all)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
 def next_pow2(n: int) -> int:
@@ -89,10 +164,16 @@ class _TracedPagedContext:
     (mode 'drop' is the .at[] default), so a right-padded bucketed
     prompt never writes garbage KV; attention is dense causal flash over
     the padded batch (pads sit to the RIGHT of every real token, so
-    causality keeps them out of real tokens' windows)."""
+    causality keeps them out of real tokens' windows).
+
+    Prefix-prefill mode (``prefill=True`` with ``prefix_lens`` set):
+    the batch's tokens are a prompt SUFFIX whose page-aligned prefix KV
+    already sits in the pages ``tables`` points at — suffix K/V scatter
+    into fresh pages exactly as in prefill, but attention runs over
+    [gathered prefix; suffix] so the cached tokens are visible."""
 
     def __init__(self, k_pages, v_pages, pg, sl, lens=None, tables=None,
-                 prefill=False):
+                 prefill=False, prefix_lens=None):
         self.k_pages = list(k_pages)
         self.v_pages = list(v_pages)
         self.pg = pg
@@ -100,6 +181,7 @@ class _TracedPagedContext:
         self.lens = lens                # POST-write lengths (decode)
         self.tables = tables
         self.prefill = prefill
+        self.prefix_lens = prefix_lens  # (b,) traced, prefix-prefill only
         self.layer_idx = 0
 
     def attend(self, q, k, v):
@@ -113,6 +195,10 @@ class _TracedPagedContext:
             kp = kp.at[:, self.pg, self.sl].set(ks.astype(kp.dtype))
             vp = vp.at[:, self.pg, self.sl].set(vs.astype(vp.dtype))
             self.k_pages[layer], self.v_pages[layer] = kp, vp
+            if self.prefix_lens is not None:
+                return wrap_array(_prefix_suffix_attention(
+                    q._data, k._data, v._data, kp, vp, self.tables,
+                    self.prefix_lens))
             from ..nn import functional as F
             out, _ = F.flash_attention(q, k, v, causal=True)
             return out
@@ -140,56 +226,139 @@ class JittedPagedDecoder:
         self.model = model
         self.params = model.parameters()
         self.max_position = int(model.config.max_position_embeddings)
-
-        def fn(param_arrays, tokens, pos, pg, sl, lens, tables,
-               k_pages, v_pages):
-            saved = [p._data for p in self.params]
-            try:
-                for p, a in zip(self.params, param_arrays):
-                    p._data = a
-                ctx = _TracedPagedContext(k_pages, v_pages, pg, sl, lens,
-                                          tables)
-                with no_grad():
-                    hidden = model.model(wrap_array(tokens), pos,
-                                         paged_ctx=ctx)
-                    logits = model._logits_of(hidden)
-                return (logits._data[:, -1].astype(jnp.float32),
-                        tuple(ctx.k_pages), tuple(ctx.v_pages))
-            finally:
-                for p, s in zip(self.params, saved):
-                    p._data = s
-
-        import jax
-        self._jitted = jax.jit(fn, donate_argnums=(7, 8))
-
-        def prefill_fn(param_arrays, ids, last_idx, pg, sl,
-                       k_pages, v_pages):
-            saved = [p._data for p in self.params]
-            try:
-                for p, a in zip(self.params, param_arrays):
-                    p._data = a
-                ctx = _TracedPagedContext(k_pages, v_pages, pg, sl,
-                                          prefill=True)
-                with no_grad():
-                    hidden = model.model(wrap_array(ids), 0,
-                                         paged_ctx=ctx)
-                    # per-row last REAL position (bucketed prompts are
-                    # right-padded past it)
-                    b = hidden.shape[0]
-                    last = hidden._data[jnp.arange(b),
-                                        last_idx.astype(jnp.int32)]
-                    logits = model._logits_of(wrap_array(last[:, None]))
-                return (logits._data[:, -1].astype(jnp.float32),
-                        tuple(ctx.k_pages), tuple(ctx.v_pages))
-            finally:
-                for p, s in zip(self.params, saved):
-                    p._data = s
-
-        self._jitted_prefill = jax.jit(prefill_fn, donate_argnums=(5, 6))
+        self._programs = {}              # (mode, sample) -> jitted fn
         self._jitted_multi = None        # built on first multi_step use
 
+    # -------------------------------------------------- compiled programs
+    def _swap_params(self, param_arrays):
+        saved = [p._data for p in self.params]
+        for p, a in zip(self.params, param_arrays):
+            p._data = a
+        return saved
+
+    def _program(self, mode: str, sample):
+        """Lazily build one compiled program per (mode, sample) pair.
+        ``sample`` is the static tail kind: "draw" ends in the full
+        fused_sample tail, "greedy" in a bare argmax (same (batch,)
+        int32 host transfer, none of the threefry/categorical compute —
+        all-greedy batches are the serving default), and False keeps
+        returning full last-token logits (the escape hatch the
+        eager-oracle parity tests diff against)."""
+        key = (mode, sample)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        model = self.model
+
+        def tail(logits, sampling):
+            if sample == "draw":
+                return fused_sample(logits, *sampling)
+            if sample == "greedy":
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return logits
+
+        def last_logits(hidden, last_idx):
+            # per-row last REAL position (bucketed prompts are
+            # right-padded past it)
+            b = hidden.shape[0]
+            last = hidden._data[jnp.arange(b), last_idx.astype(jnp.int32)]
+            logits = model._logits_of(wrap_array(last[:, None]))
+            return logits._data[:, -1].astype(jnp.float32)
+
+        if mode == "decode":
+            def fn(param_arrays, tokens, pos, pg, sl, lens, tables,
+                   sampling, k_pages, v_pages):
+                saved = self._swap_params(param_arrays)
+                try:
+                    ctx = _TracedPagedContext(k_pages, v_pages, pg, sl,
+                                              lens, tables)
+                    with no_grad():
+                        hidden = model.model(wrap_array(tokens), pos,
+                                             paged_ctx=ctx)
+                        logits = model._logits_of(hidden)
+                    return (tail(logits._data[:, -1].astype(jnp.float32),
+                                 sampling),
+                            tuple(ctx.k_pages), tuple(ctx.v_pages))
+                finally:
+                    for p, s in zip(self.params, saved):
+                        p._data = s
+
+            prog = jax.jit(fn, donate_argnums=(8, 9))
+        elif mode == "prefill":
+            def fn(param_arrays, ids, last_idx, pg, sl, sampling,
+                   k_pages, v_pages):
+                saved = self._swap_params(param_arrays)
+                try:
+                    ctx = _TracedPagedContext(k_pages, v_pages, pg, sl,
+                                              prefill=True)
+                    with no_grad():
+                        hidden = model.model(wrap_array(ids), 0,
+                                             paged_ctx=ctx)
+                        logits = last_logits(hidden, last_idx)
+                    return (tail(logits, sampling),
+                            tuple(ctx.k_pages), tuple(ctx.v_pages))
+                finally:
+                    for p, s in zip(self.params, saved):
+                        p._data = s
+
+            prog = jax.jit(fn, donate_argnums=(6, 7))
+        elif mode == "prefix":
+            def fn(param_arrays, ids, last_idx, pg, sl, ptabs,
+                   plens, sampling, k_pages, v_pages):
+                saved = self._swap_params(param_arrays)
+                try:
+                    ctx = _TracedPagedContext(k_pages, v_pages, pg, sl,
+                                              tables=ptabs, prefill=True,
+                                              prefix_lens=plens)
+                    with no_grad():
+                        # plens doubles as the per-row rope offset: the
+                        # suffix starts right after the cached prefix
+                        # (traced, so one compile serves every prefix
+                        # length at a given bucket shape)
+                        hidden = model.model(wrap_array(ids), plens,
+                                             paged_ctx=ctx)
+                        logits = last_logits(hidden, last_idx)
+                    return (tail(logits, sampling),
+                            tuple(ctx.k_pages), tuple(ctx.v_pages))
+                finally:
+                    for p, s in zip(self.params, saved):
+                        p._data = s
+
+            prog = jax.jit(fn, donate_argnums=(8, 9))
+        else:
+            raise ValueError(f"unknown program mode {mode!r}")
+        self._programs[key] = prog
+        return prog
+
+    @staticmethod
+    def _sampling_args(sampling):
+        if sampling is None:
+            return False, ()
+        seeds, ctrs, temps, flags = sampling
+        if not np.any(flags):
+            return "greedy", ()      # argmax-only tail, no RNG compute
+        return "draw", (jnp.asarray(np.asarray(seeds, np.uint32)),
+                        jnp.asarray(np.asarray(ctrs, np.int32)),
+                        jnp.asarray(np.asarray(temps, np.float32)),
+                        jnp.asarray(np.asarray(flags, bool)))
+
+    @staticmethod
+    def _pad_prefill_plan(cache, ids_np, pg, sl, b, s, s_b):
+        """Right-pad a bucketed prompt's ids and (page, slot) targets;
+        pad positions scatter to an out-of-bounds page (dropped)."""
+        pad = s_b - s
+        ids_np = np.pad(ids_np, ((0, 0), (0, pad)))
+        pg = np.concatenate(
+            [pg.reshape(b, s),
+             np.full((b, pad), cache.total_pages, np.int32)],
+            axis=1).reshape(-1)
+        sl = np.concatenate(
+            [sl.reshape(b, s), np.zeros((b, pad), np.int32)],
+            axis=1).reshape(-1)
+        return ids_np, pg, sl
+
     def prefill(self, cache: PagedKVCache, seq_ids, ids_np,
-                bucket: bool = False) -> np.ndarray:
+                bucket: bool = False, sampling=None) -> np.ndarray:
         """Prompt pass as ONE compiled program: embed + all layers
         (dense causal flash + paged KV writes) + last-token logits.
 
@@ -198,7 +367,10 @@ class JittedPagedDecoder:
         engine's per-request prefills compile once per bucket, not once
         per prompt length; pad positions scatter to an out-of-bounds
         page (dropped) and sit after every real token (causal-masked).
-        Returns last-real-token logits (batch, vocab) float32."""
+        Returns last-real-token logits (batch, vocab) float32 — or,
+        with ``sampling=(seeds, ctrs, temps, flags)``, the fused-sampled
+        first token ids (batch,) int32 (the logits never leave device).
+        """
         b, s = ids_np.shape
         if s > self.max_position:
             raise ValueError(
@@ -214,28 +386,79 @@ class JittedPagedDecoder:
             # 1000-position model must bucket to 1000, not 1024
             s_b = min(next_pow2(s), self.max_position)
         if s_b != s:
-            pad = s_b - s
-            ids_np = np.pad(ids_np, ((0, 0), (0, pad)))
-            pg = np.concatenate(
-                [pg.reshape(b, s),
-                 np.full((b, pad), cache.total_pages, np.int32)],
-                axis=1).reshape(-1)
-            sl = np.concatenate(
-                [sl.reshape(b, s), np.zeros((b, pad), np.int32)],
-                axis=1).reshape(-1)
+            ids_np, pg, sl = self._pad_prefill_plan(cache, ids_np, pg, sl,
+                                                    b, s, s_b)
         last_idx = np.full(b, s - 1, np.int32)
+        sample, s_args = self._sampling_args(sampling)
         try:
-            logits, k_pages, v_pages = self._jitted_prefill(
+            out, k_pages, v_pages = self._program("prefill", sample)(
                 [p._data for p in self.params],
                 jnp.asarray(ids_np.astype(np.int32)),
                 jnp.asarray(last_idx), jnp.asarray(pg), jnp.asarray(sl),
+                s_args, tuple(cache.k_pages), tuple(cache.v_pages))
+        except BaseException:
+            cache.reset_pools()
+            raise
+        cache.k_pages = list(k_pages)
+        cache.v_pages = list(v_pages)
+        return np.asarray(out)
+
+    def prefix_prefill(self, cache: PagedKVCache, seq_ids, ids_np,
+                       prefix_tokens: int, bucket: bool = True,
+                       sampling=None) -> np.ndarray:
+        """Suffix-only prompt pass for sequences whose first
+        ``prefix_tokens`` prompt tokens (page-aligned) are already
+        cached — the prefix-cache TTFT win: only the suffix runs
+        through the model, attending to the gathered prefix pages.
+
+        Every sequence must already hold its shared prefix pages at
+        length ``prefix_tokens`` (PagedKVCache.acquire_prefix).  ids_np
+        (batch, s) int32 is the UNCACHED prompt tail.  Returns logits
+        (batch, vocab) f32, or sampled ids (batch,) with ``sampling``.
+        """
+        b, s = ids_np.shape
+        k = int(prefix_tokens)
+        if k <= 0 or k % cache.page_size:
+            raise ValueError(
+                f"prefix_tokens must be a positive multiple of the page "
+                f"size ({cache.page_size}), got {k}")
+        if k + s > self.max_position:
+            raise ValueError(
+                f"prompt length {k + s} exceeds max_position_embeddings "
+                f"({self.max_position})")
+        for sid in seq_ids:
+            if cache.length(sid) != k:
+                raise ValueError(
+                    f"sequence {sid!r} is at length {cache.length(sid)}, "
+                    f"expected the shared prefix length {k}")
+            cache.allocate(sid, s)
+        pg, sl = cache.plan_write(seq_ids, s)
+        cache.advance(seq_ids, s)
+        s_b = min(next_pow2(s), self.max_position - k) if bucket else s
+        if s_b != s:
+            ids_np, pg, sl = self._pad_prefill_plan(cache, ids_np, pg, sl,
+                                                    b, s, s_b)
+        n_pre = k // cache.page_size
+        ptabs = np.zeros((b, next_pow2(n_pre)), np.int32)
+        for i, sid in enumerate(seq_ids):
+            ptabs[i, :n_pre] = cache._seq_pages[sid][:n_pre]
+        plens = np.full(b, k, np.int32)
+        last_idx = np.full(b, s - 1, np.int32)
+        sample, s_args = self._sampling_args(sampling)
+        try:
+            out, k_pages, v_pages = self._program("prefix", sample)(
+                [p._data for p in self.params],
+                jnp.asarray(ids_np.astype(np.int32)),
+                jnp.asarray(last_idx),
+                jnp.asarray(pg), jnp.asarray(sl), jnp.asarray(ptabs),
+                jnp.asarray(plens), s_args,
                 tuple(cache.k_pages), tuple(cache.v_pages))
         except BaseException:
             cache.reset_pools()
             raise
         cache.k_pages = list(k_pages)
         cache.v_pages = list(v_pages)
-        return np.asarray(logits)
+        return np.asarray(out)
 
     def _build_multi(self):
         """Jitted N-step GREEDY decode: lax.scan over the single-step
@@ -323,12 +546,16 @@ class JittedPagedDecoder:
         return np.asarray(toks).T                        # (batch, n)
 
     def step(self, cache: PagedKVCache, seq_ids, tokens_np,
-             positions_np) -> np.ndarray:
+             positions_np, sampling=None) -> np.ndarray:
         """One decode token for every sequence.  tokens_np (batch, 1)
         int32; positions_np (batch,) int32 — each row's current length.
         Allocates+advances cache bookkeeping host-side, runs the
         compiled step, writes the updated pools back.  Returns the last
-        logits (batch, vocab) float32 numpy."""
+        logits (batch, vocab) float32 numpy — or, with
+        ``sampling=(seeds, ctrs, temps, flags)``, the next token ids
+        (batch,) int32 sampled INSIDE the compiled step, so only 4
+        bytes/row cross the host boundary instead of the full vocab row
+        (the logits path stays as the parity/debug escape hatch)."""
         if int(positions_np.max()) + 1 > self.max_position:
             raise ValueError(
                 f"decode position {int(positions_np.max()) + 1} exceeds "
@@ -342,11 +569,12 @@ class JittedPagedDecoder:
         # page boundary, recompiling the whole decode program mid-serving
         needed = max(len(cache._seq_pages.get(s, ())) for s in seq_ids)
         tabs, lens = cache.page_table(seq_ids, max_pages=next_pow2(needed))
+        sample, s_args = self._sampling_args(sampling)
         try:
-            logits, k_pages, v_pages = self._jitted(
+            out, k_pages, v_pages = self._program("decode", sample)(
                 [p._data for p in self.params],
                 jnp.asarray(tokens_np), jnp.asarray(positions_np),
-                jnp.asarray(pg), jnp.asarray(sl), lens, tabs,
+                jnp.asarray(pg), jnp.asarray(sl), lens, tabs, s_args,
                 tuple(cache.k_pages), tuple(cache.v_pages))
         except BaseException:
             # the pools were DONATED: after a mid-step failure (e.g.
@@ -357,7 +585,7 @@ class JittedPagedDecoder:
             raise
         cache.k_pages = list(k_pages)
         cache.v_pages = list(v_pages)
-        return np.asarray(logits)
+        return np.asarray(out)
 
 
 def sample_token(logits_row, do_sample, temperature, rng) -> int:

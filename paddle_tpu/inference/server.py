@@ -233,7 +233,10 @@ class GenerationServer(_ServerLifecycle):
     concurrent requests decode together per step instead of queueing
     behind a server lock, and short generations retire without waiting
     for long ones.  Sampled requests draw a fresh per-request seed
-    unless the request pins one.
+    unless the request pins one.  The engine's hot-path knobs plumb
+    through: ``sample_on_device`` (fused in-step sampling) and
+    ``prefix_cache`` (shared-prompt-prefix KV reuse) — both on by
+    default.
 
     Error mapping: 400 = malformed request, 503 = pool/capacity
     exhaustion (retry later), 500 = unexpected server fault.
@@ -241,12 +244,14 @@ class GenerationServer(_ServerLifecycle):
 
     def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
                  total_pages: int = 512, page_size: int = 16,
-                 max_batch: int = 8, access_log: bool = False):
+                 max_batch: int = 8, sample_on_device: bool = True,
+                 prefix_cache: bool = True, access_log: bool = False):
         from .continuous import ContinuousBatchingEngine
 
         self._engine = ContinuousBatchingEngine(
             model, total_pages=total_pages, page_size=page_size,
-            max_batch=max_batch)
+            max_batch=max_batch, sample_on_device=sample_on_device,
+            prefix_cache=prefix_cache)
         self._count_lock = threading.Lock()
         self._request_count = 0
         self._init_stats(access_log)
@@ -267,6 +272,10 @@ class GenerationServer(_ServerLifecycle):
                             "free_pages": cache.free_pages,
                             "total_pages": cache.total_pages,
                             "page_size": cache.page_size,
+                            "cached_prefix_pages":
+                                cache.cached_prefix_pages,
+                            "sampling_on_device":
+                                outer._engine.sample_on_device,
                             "active_sequences": len(outer._engine._active),
                             "queued_sequences": len(outer._engine._queue)})
                 elif self.path == "/metrics":

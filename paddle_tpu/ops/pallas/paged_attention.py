@@ -25,8 +25,10 @@ reference's BlockTable scheduler; page data lives on device.
 from __future__ import annotations
 
 import functools
+import hashlib
 import math
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
@@ -185,12 +187,37 @@ def _scatter_pages(pool, pages, slots, vals):
     return pool.at[:, pages, slots].set(vals.astype(pool.dtype))
 
 
+class _PrefixEntry:
+    """One cached page-aligned prompt prefix: the pages holding its KV
+    plus the token count they cover.  The entry itself holds one index
+    ref on every page so the KV survives the registering sequence's
+    retirement (evictable under pool pressure, LRU order)."""
+
+    __slots__ = ("pages", "n_tokens")
+
+    def __init__(self, pages: List[int], n_tokens: int):
+        self.pages = pages
+        self.n_tokens = n_tokens
+
+
 class PagedKVCache:
     """Paged KV cache: device page pools per layer + host-side page-table
     bookkeeping (reference: the BlockTable management around
-    block_multihead_attention).
+    block_multihead_attention), with REFCOUNTED pages and a prefix index.
 
     Layout per layer: (kv_heads, total_pages, page_size, head_dim).
+
+    Pages carry two kinds of references: sequence refs (a live sequence
+    maps the page in its table) and index refs (a cached prompt prefix
+    retains the page for reuse).  A page returns to the free list only
+    when both drop to zero.  Pages are append-only, so a FULL page whose
+    tokens are a page-aligned prompt prefix can be shared read-only by
+    any request with the same prefix — the sharer maps the pages,
+    prefills only its suffix, and copy-on-writes nothing (the first
+    partially-filled page is never shared).  Index-retained pages with
+    no sequence ref are *evictable*: ``allocate`` reclaims them in LRU
+    order under pool pressure, so they count as available capacity
+    (``free_pages``).
     """
 
     @classmethod
@@ -220,8 +247,66 @@ class PagedKVCache:
         self._free: List[int] = list(range(total_pages))
         self._seq_pages: Dict[int, List[int]] = {}
         self._seq_len: Dict[int, int] = {}
+        # page -> refcount, split by holder kind: a page is PINNED while
+        # any sequence maps it, EVICTABLE while only the prefix index
+        # retains it, and free when neither does
+        self._seq_refs: Dict[int, int] = {}
+        self._idx_refs: Dict[int, int] = {}
+        # page-aligned prompt-prefix hash-chain key -> _PrefixEntry, in
+        # LRU order (oldest first; touched entries move to the end)
+        self._prefix_index: "OrderedDict[bytes, _PrefixEntry]" = \
+            OrderedDict()
+        self.prefix_evictions = 0           # entries dropped under pressure
 
     # ------------------------------------------------------- bookkeeping
+    def _decref_seq(self, page: int) -> bool:
+        """Drop one sequence ref; True if the page became unpinned."""
+        n = self._seq_refs[page] - 1
+        if n:
+            self._seq_refs[page] = n
+            return False
+        del self._seq_refs[page]
+        if page not in self._idx_refs:
+            self._free.append(page)
+        return True
+
+    def _decref_idx(self, page: int) -> None:
+        n = self._idx_refs[page] - 1
+        if n:
+            self._idx_refs[page] = n
+            return
+        del self._idx_refs[page]
+        if page not in self._seq_refs:
+            self._free.append(page)
+
+    def _evict_prefixes(self, n_pages: int) -> None:
+        """Drop prefix entries in LRU order until ``n_pages`` pages are
+        free (or nothing more is reclaimable).  Entries whose pages are
+        ALL pinned by live sequences are skipped — dropping them would
+        free nothing while losing a prefix an active sharer still
+        maps."""
+        for key in list(self._prefix_index):
+            if len(self._free) >= n_pages:
+                break
+            entry = self._prefix_index[key]
+            if all(p in self._seq_refs for p in entry.pages):
+                continue
+            del self._prefix_index[key]
+            self.prefix_evictions += 1
+            for p in entry.pages:
+                self._decref_idx(p)
+
+    def _pop_free_page(self) -> int:
+        if not self._free:
+            self._evict_prefixes(1)
+        if not self._free:
+            raise RuntimeError(
+                f"PagedKVCache out of pages "
+                f"({self.total_pages} x {self.page_size} tokens); "
+                "free() finished sequences or grow total_pages")
+        p = self._free.pop()
+        self._seq_refs[p] = 1
+        return p
     def allocate_batch_atomic(self, seq_ids, n_tokens: int) -> None:
         """Reserve pages for n_tokens MORE tokens on EVERY sequence, or
         none at all: a mid-batch exhaustion rolls back this call's
@@ -236,30 +321,37 @@ class PagedKVCache:
             for sid in seq_ids:
                 pages = self._seq_pages.get(sid, [])
                 while len(pages) > before[sid]:
-                    self._free.append(pages.pop())
+                    self._decref_seq(pages.pop())
             raise
 
     def allocate(self, seq_id: int, n_tokens: int) -> None:
-        """Reserve pages so the sequence can hold n_tokens MORE tokens."""
+        """Reserve pages so the sequence can hold n_tokens MORE tokens.
+        Under pool pressure, evictable prefix-cache pages are reclaimed
+        LRU-first before this raises."""
         pages = self._seq_pages.setdefault(seq_id, [])
         need_total = -(-(self._seq_len.get(seq_id, 0) + n_tokens)
                        // self.page_size)
         while len(pages) < need_total:
-            if not self._free:
-                raise RuntimeError(
-                    f"PagedKVCache out of pages "
-                    f"({self.total_pages} x {self.page_size} tokens); "
-                    "free() finished sequences or grow total_pages")
-            pages.append(self._free.pop())
+            pages.append(self._pop_free_page())
 
-    def free(self, seq_id: int) -> None:
-        self._free.extend(self._seq_pages.pop(seq_id, []))
+    def free(self, seq_id: int) -> int:
+        """Release the sequence's refs on its pages.  Pages still held
+        by another sharer or by the prefix index stay resident; returns
+        the number of pages that stopped being PINNED (newly free or
+        newly evictable) — the engine's reservation arithmetic uses it
+        to release exactly the capacity this retirement uncovers."""
+        released = 0
+        for p in self._seq_pages.pop(seq_id, []):
+            released += self._decref_seq(p)
         self._seq_len.pop(seq_id, None)
+        return released
 
     def reset_pools(self) -> None:
         """Reallocate zeroed page pools (same shapes/dtype).  For
         recovery after a failed donated-buffer step invalidated the old
-        pools: bookkeeping survives, cached K/V content does not."""
+        pools: bookkeeping survives, cached K/V content does not — so
+        the prefix index (whose hits would replay that lost content)
+        is dropped wholesale."""
         shape = (self.kv_heads, self.total_pages, self.page_size,
                  self.head_dim)
         dtype = self.k_pages[0].dtype if self.k_pages else jnp.float32
@@ -267,6 +359,111 @@ class PagedKVCache:
                         for _ in range(self.num_layers)]
         self.v_pages = [jnp.zeros(shape, dtype)
                         for _ in range(self.num_layers)]
+        while self._prefix_index:
+            _, entry = self._prefix_index.popitem(last=False)
+            for p in entry.pages:
+                self._decref_idx(p)
+
+    # ---------------------------------------------------- prefix caching
+    def _usable_prefix_tokens(self, tokens: np.ndarray) -> int:
+        """Longest page-aligned prefix a request with this prompt may
+        share: full pages only, and at least one prompt token must stay
+        un-shared so prefill still produces next-token logits."""
+        return (len(tokens) - 1) // self.page_size * self.page_size
+
+    def _prefix_keys(self, tokens: np.ndarray, n_pages: int) -> List[bytes]:
+        """Index key per page-aligned prefix, as an INCREMENTAL hash
+        chain (key_i = blake2b(key_{i-1} || page_i tokens)): hashing
+        every candidate prefix of a prompt is O(prompt), not
+        O(prompt^2/page_size) as rehashing each prefix from scratch
+        would be — probe_prefix runs under the engine's scheduler lock
+        on every admission attempt."""
+        keys, h = [], b""
+        ps = self.page_size
+        for i in range(n_pages):
+            h = hashlib.blake2b(h + tokens[i * ps:(i + 1) * ps].tobytes(),
+                                digest_size=16).digest()
+            keys.append(h)
+        return keys
+
+    def _lookup_prefix(self, tokens):
+        """(key, entry) for the LONGEST cached page-aligned prefix of
+        ``tokens``, or None — the single search both probe_prefix and
+        acquire_prefix use, so the engine's probe-then-acquire pair is
+        structurally guaranteed to find the same entry."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = self._usable_prefix_tokens(tokens)
+        keys = self._prefix_keys(tokens, n // self.page_size)
+        for key in reversed(keys):
+            entry = self._prefix_index.get(key)
+            if entry is not None:
+                return key, entry
+        return None
+
+    def probe_prefix(self, tokens) -> Tuple[int, int]:
+        """(shared_tokens, newly_pinned_pages) for the longest cached
+        prefix of ``tokens`` — WITHOUT acquiring it.  newly_pinned is
+        how many of the hit's pages have no sequence ref yet, i.e. how
+        much currently-reclaimable capacity an acquire would pin."""
+        hit = self._lookup_prefix(tokens)
+        if hit is None:
+            return 0, 0
+        _, entry = hit
+        newly = sum(1 for p in entry.pages if p not in self._seq_refs)
+        return entry.n_tokens, newly
+
+    def acquire_prefix(self, seq_id, tokens) -> int:
+        """Map the longest cached prefix of ``tokens`` into ``seq_id``
+        read-only: the sequence starts at the shared length with the
+        shared pages at the front of its table, each pinned by one
+        sequence ref.  Returns the shared token count (0 = miss).  The
+        sequence must be fresh (no pages yet)."""
+        assert seq_id not in self._seq_pages, "sequence already has pages"
+        hit = self._lookup_prefix(tokens)
+        if hit is None:
+            return 0
+        key, entry = hit
+        self._prefix_index.move_to_end(key)              # LRU touch
+        for p in entry.pages:
+            self._seq_refs[p] = self._seq_refs.get(p, 0) + 1
+        self._seq_pages[seq_id] = list(entry.pages)
+        self._seq_len[seq_id] = entry.n_tokens
+        return entry.n_tokens
+
+    def register_prefix(self, seq_id, tokens) -> int:
+        """After ``seq_id``'s prompt KV is written, retain every
+        page-aligned prefix of ``tokens`` in the index (one index ref
+        per page per entry) so later requests sharing the prefix can
+        skip its prefill.  Idempotent for already-cached prefixes.
+        Returns the number of NEW entries."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        pages = self._seq_pages.get(seq_id, [])
+        added = 0
+        n_pages = len(tokens) // self.page_size
+        for i, key in enumerate(self._prefix_keys(tokens, n_pages), 1):
+            if key in self._prefix_index:
+                self._prefix_index.move_to_end(key)
+                continue
+            held = pages[:i]
+            for p in held:
+                self._idx_refs[p] = self._idx_refs.get(p, 0) + 1
+            self._prefix_index[key] = _PrefixEntry(held,
+                                                   i * self.page_size)
+            added += 1
+        return added
+
+    @property
+    def pinned_pages(self) -> int:
+        """Pages currently mapped by at least one live sequence."""
+        return len(self._seq_refs)
+
+    @property
+    def cached_prefix_pages(self) -> int:
+        """Index-retained pages with no sequence ref (reclaimable).
+        Iterates a key SNAPSHOT: the /health handler thread reads this
+        while the engine thread mutates the refcount dicts."""
+        return sum(1 for p in list(self._idx_refs)
+                   if p not in self._seq_refs)
 
     def truncate(self, seq_id, length: int) -> None:
         """Roll a sequence's logical length back (pages stay allocated,
@@ -277,8 +474,11 @@ class PagedKVCache:
 
     @property
     def free_pages(self) -> int:
-        """Unallocated pages remaining in the pool."""
-        return len(self._free)
+        """Pool capacity available to new allocations: truly-free pages
+        plus evictable prefix-cache pages (reclaimed on demand) — so an
+        idle engine reports a fully reclaimed pool even while warm
+        prefixes stay cached."""
+        return len(self._free) + self.cached_prefix_pages
 
     def length(self, seq_id: int) -> int:
         return self._seq_len.get(seq_id, 0)

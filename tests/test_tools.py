@@ -69,6 +69,39 @@ class TestMetricsSmoke:
         assert self._load().main() == 0
 
 
+class TestServeBench:
+    def _load(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "serve_bench", os.path.join(REPO, "tools", "serve_bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_hist_quantile(self):
+        sb = self._load()
+        # cumulative {le: count}: 4 obs <= 0.1, 9 <= 0.5, 10 total
+        b = {"0.1": 4, "0.5": 9, "1.0": 10, "+Inf": 10}
+        assert sb.hist_quantile(b, 0.50) == 0.5
+        assert sb.hist_quantile(b, 0.25) == 0.1
+        assert sb.hist_quantile(b, 0.99) == 1.0
+        assert sb.hist_quantile({"+Inf": 0}, 0.5) is None
+
+    def test_smoke_gate_reports_prefix_hits(self, capsys):
+        # ISSUE 2 acceptance: the shared-prefix workload must show a
+        # nonzero prefix-cache hit rate, every number monitor-sourced
+        sb = self._load()
+        assert sb.main([]) == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert out["prefix_hit_rate"] > 0
+        assert out["prefix_hit_tokens"] > 0
+        assert out["tokens_per_sec"] > 0
+        assert out["ttft_p50_s"] is not None
+        assert out["ttft_p99_s"] >= out["ttft_p50_s"]
+        assert out["decode_steps"] > 0
+
+
 class TestCostModelFacade:
     def test_alias(self):
         import paddle_tpu as paddle

@@ -1,0 +1,199 @@
+"""Serving hot-path benchmark (ISSUE 2 CI satellite).
+
+Drives a ContinuousBatchingEngine with a mixed shared-prefix workload —
+one warm-up request seeds the prefix cache, then a wave of requests
+that share its system prefix interleaved with fully-unique prompts —
+and prints ONE JSON line with tokens/sec, TTFT p50/p99, decode-step
+p50, and the prefix-cache hit rate, every number read from
+``monitor.snapshot()`` deltas (the monitor registry is the single
+source of serving truth; no ad-hoc timers).
+
+``--baseline`` runs the same workload with ``sample_on_device=False,
+prefix_cache=False`` — diffing the two JSON lines is the before/after
+evidence for the hot-path PR.  Exit 0 = ran and (non-baseline) saw a
+nonzero prefix hit rate; 1 = broken.  tests/test_tools.py runs main()
+as a tier-1 gate, `python tools/serve_bench.py` is the standalone lane.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _hist_delta(before: dict, after: dict, name: str):
+    """(bucket_delta {le: count}, sum_delta, count_delta) for an
+    unlabeled histogram between two monitor.snapshot() dicts."""
+    def series(snap):
+        m = snap.get(name)
+        if not m or not m["series"]:
+            return {}, 0.0, 0
+        s = m["series"][0]
+        return s["buckets"], s["sum"], s["count"]
+
+    b0, s0, c0 = series(before)
+    b1, s1, c1 = series(after)
+    buckets = {le: c - b0.get(le, 0) for le, c in b1.items()}
+    return buckets, s1 - s0, c1 - c0
+
+
+def _counter_delta(before: dict, after: dict, name: str) -> float:
+    def val(snap):
+        m = snap.get(name)
+        return m["series"][0]["value"] if m and m["series"] else 0.0
+    return val(after) - val(before)
+
+
+def hist_quantile(buckets: dict, q: float):
+    """Prometheus-style histogram_quantile over CUMULATIVE {le: count}
+    deltas: the upper bound of the first bucket at or past the
+    quantile rank (None if the histogram saw nothing)."""
+    total = buckets.get("+Inf", 0)
+    if total <= 0:
+        return None
+    finite = sorted(((float(le), c) for le, c in buckets.items()
+                     if le != "+Inf"))
+    rank = q * total
+    for bound, cum in finite:
+        if cum >= rank:
+            return bound
+    return finite[-1][0] if finite else None
+
+
+def run_bench(model=None, sharers: int = 6, uniques: int = 3,
+              max_new_tokens: int = 8, system_tokens: int = 16,
+              vocab: int = 64, hidden: int = 32, do_sample: bool = False,
+              sample_on_device: bool = True,
+              prefix_cache: bool = True, seed: int = 0) -> dict:
+    """Run the mixed shared-prefix workload; return the metrics dict
+    (everything monitor-sourced).  The tiny default model keeps the CI
+    gate fast; ``--vocab``/``--hidden`` grow it so the host-boundary
+    cost the fused sampler removes is actually visible."""
+    import numpy as np
+    from paddle_tpu import monitor
+    from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+
+    if model is None:
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                          intermediate_size=2 * hidden,
+                          num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=128)
+        model = LlamaForCausalLM(cfg)
+
+    rng = np.random.default_rng(seed)
+    # the shared system prompt must cover full pages (page_size 8 below)
+    system = rng.integers(0, 64, (system_tokens,)).astype("int32")
+    # fixed lengths so the warm-up wave compiles the EXACT bucket shapes
+    # the measured wave runs (suffix bucket 8, cold-prompt bucket 32):
+    # the measured window then holds steady-state serving, not compiles
+    SUF, UNIQ = 5, 20
+
+    def shared_prompt():
+        return np.concatenate(
+            [system, rng.integers(0, 64, (SUF,))]).astype("int32")
+
+    def unique_prompt():
+        return rng.integers(0, 64, (UNIQ,)).astype("int32")
+
+    n_sub = [0]
+
+    def submit(eng, prompt):
+        n_sub[0] += 1
+        return eng.submit(prompt, max_new_tokens=max_new_tokens,
+                          do_sample=do_sample, temperature=0.8,
+                          seed=n_sub[0])
+
+    with ContinuousBatchingEngine(
+            model, total_pages=128, page_size=8, max_batch=4,
+            sample_on_device=sample_on_device,
+            prefix_cache=prefix_cache) as eng:
+        # unmeasured warm-up wave: compiles the cold-prefill, suffix
+        # (prefix-hit) prefill and every decode-batch bucket, and seeds
+        # the prefix cache with the system prompt
+        # (sequenced: the second sharer must be admitted AFTER the
+        # first's prefill registered the system prefix, or it misses
+        # and the suffix-prefill program stays uncompiled)
+        submit(eng, shared_prompt()).result(timeout=600)
+        warm = [submit(eng, p)
+                for p in (shared_prompt(), unique_prompt())]
+        for r in warm:
+            r.result(timeout=600)
+
+        before = monitor.snapshot()
+        reqs = []
+        for i in range(max(sharers, uniques)):
+            if i < sharers:
+                reqs.append(submit(eng, shared_prompt()))
+            if i < uniques:
+                reqs.append(submit(eng, unique_prompt()))
+        for r in reqs:
+            r.result(timeout=600)
+        after = monitor.snapshot()
+
+    dec_b, dec_sum, dec_n = _hist_delta(before, after,
+                                        "decode_step_seconds")
+    ttft_b, ttft_sum, ttft_n = _hist_delta(before, after,
+                                           "time_to_first_token_seconds")
+    pre_b, pre_sum, pre_n = _hist_delta(before, after, "prefill_seconds")
+    tokens = _counter_delta(before, after, "generated_tokens_total")
+    lookups = _counter_delta(before, after, "prefix_cache_lookups_total")
+    hits = _counter_delta(before, after, "prefix_cache_hits_total")
+    hit_tokens = _counter_delta(before, after,
+                                "prefix_cache_hit_tokens_total")
+    return {
+        "requests": len(reqs),
+        "sample_on_device": bool(sample_on_device),
+        "prefix_cache": bool(prefix_cache),
+        "tokens_per_sec": (tokens / dec_sum) if dec_sum > 0 else 0.0,
+        "generated_tokens": int(tokens),
+        "decode_steps": dec_n,
+        "decode_step_p50_s": hist_quantile(dec_b, 0.50),
+        "decode_step_mean_s": (dec_sum / dec_n) if dec_n else None,
+        "ttft_p50_s": hist_quantile(ttft_b, 0.50),
+        "ttft_p99_s": hist_quantile(ttft_b, 0.99),
+        "ttft_mean_s": (ttft_sum / ttft_n) if ttft_n else None,
+        # prefill alone (no queue wait): with prefix_cache on, a hit
+        # runs only its suffix — THE TTFT win, isolated
+        "prefill_p50_s": hist_quantile(pre_b, 0.50),
+        "prefill_mean_s": (pre_sum / pre_n) if pre_n else None,
+        "prefix_hit_rate": (hits / lookups) if lookups else 0.0,
+        "prefix_hit_tokens": int(hit_tokens),
+    }
+
+
+def _int_arg(argv, name, default):
+    return next((int(a.split("=", 1)[1]) for a in argv
+                 if a.startswith(f"--{name}=")), default)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    baseline = "--baseline" in argv
+    out = run_bench(sharers=_int_arg(argv, "sharers", 6),
+                    uniques=_int_arg(argv, "uniques", 3),
+                    system_tokens=_int_arg(argv, "system-tokens", 16),
+                    max_new_tokens=_int_arg(argv, "max-new-tokens", 8),
+                    vocab=_int_arg(argv, "vocab", 64),
+                    hidden=_int_arg(argv, "hidden", 32),
+                    do_sample="--sample" in argv,
+                    sample_on_device=not baseline,
+                    prefix_cache=not baseline)
+    print(json.dumps(out, sort_keys=True))
+    if out["generated_tokens"] <= 0 or out["decode_steps"] <= 0:
+        print("FAIL: bench decoded nothing", file=sys.stderr)
+        return 1
+    if not baseline and out["prefix_hit_rate"] <= 0:
+        print("FAIL: shared-prefix workload saw no prefix-cache hits",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
