@@ -84,6 +84,7 @@ from . import utils  # noqa: F401
 from .hapi import hub  # noqa: F401
 from .tensor import linalg  # noqa: F401 (paddle.linalg alias)
 from . import cost_model  # noqa: F401
+from . import analysis  # noqa: F401
 
 
 def disable_static():

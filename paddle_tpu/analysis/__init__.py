@@ -1,0 +1,41 @@
+"""paddle_tpu.analysis — static analysis for compiled TPU programs.
+
+Two tiers (the TPU-native analog of the reference's PIR inspection
+passes — programs are checked *before* they run):
+
+  * ``program_audit`` — trace any compiled surface (a callable for
+    ``jax.jit``, a ``to_static`` function, a ``static.Program``, the
+    serving engine's decode program) to its jaxpr and flag TPU hazards:
+    host callbacks, large host-bound outputs, baked-in constants, dtype
+    promotion creep, missed buffer donation, recompile hazards.
+  * ``lint`` — an AST sweep of the source tree for the patterns that
+    *produce* those hazards (host concretization under jit, Python RNG
+    under trace, ``list.pop(0)`` hot loops, scheduler-lock discipline),
+    ratcheted against ``tools/tpu_lint_baseline.json``.
+
+Usage::
+
+    from paddle_tpu import analysis
+    audit = analysis.audit_callable(step_fn, *example_args,
+                                    donate_argnums=(2,))
+    print(audit.report())
+    assert not audit.host_transfer_findings
+
+    audit = analysis.audit_engine(engine)       # serving decode program
+
+Runtime mirror: ``monitor.install_compile_hooks()`` counts actual XLA
+compiles (``jit_recompile_count`` / ``jit_compile_seconds``) so the
+auditor's recompile rules can be checked against what really happened.
+"""
+from .program_audit import (  # noqa: F401
+    Finding, ProgramAudit, audit_jaxpr, audit_callable, audit_engine,
+    audit_program, HOST_TRANSFER_RULES,
+)
+from . import lint  # noqa: F401
+from .lint import LintFinding, lint_paths, lint_source  # noqa: F401
+
+__all__ = [
+    "Finding", "ProgramAudit", "audit_jaxpr", "audit_callable",
+    "audit_engine", "audit_program", "HOST_TRANSFER_RULES",
+    "LintFinding", "lint_paths", "lint_source", "lint",
+]

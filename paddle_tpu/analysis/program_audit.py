@@ -1,0 +1,525 @@
+"""Tier-1 static analysis: audit compiled programs at the jaxpr level.
+
+The reference framework's PIR pass stack inspects static programs
+*before* they run; the TPU-native analog walks a traced jaxpr.  Every
+compiled surface in this tree — ``jax.jit`` callables, ``to_static``
+functions, ``static.Program`` replays, the serving engine's
+decode/prefill programs — reduces to one jaxpr, so one walker covers
+them all.  The hazards it flags are the ones that dominate TPU hot
+paths (T3/arxiv 2401.16677: host sync; Ragged Paged Attention/arxiv
+2604.15464: layout + transfer discipline):
+
+  * ``host-callback`` — a ``pure_callback``/``io_callback``/debug
+    callback inside the program: every step round-trips to Python.
+  * ``output-transfer`` — a large un-donated output buffer: it crosses
+    the device->host boundary every call (the PR 2 invariant: a decode
+    step should ship ``(batch,)`` ids, never ``(batch, vocab)`` logits).
+  * ``const-capture`` — a large constant baked into the program instead
+    of passed as an argument: re-uploaded per executable and a new
+    compile whenever its value changes.
+  * ``dtype-promotion`` — f32/f64 values materializing inside a program
+    whose working dtype should be narrower (bf16 creep in reverse).
+  * ``x64-creep`` — 64-bit avals inside the program (TPU pays double
+    bandwidth for them; they only appear with jax_enable_x64).
+  * ``missed-donation`` — a large input whose shape/dtype matches an
+    output but is not donated: XLA must keep both buffers live.
+  * ``weak-type`` / ``nonhashable-static`` — recompilation hazards at
+    the call boundary (each weak-typed Python scalar re-specializes;
+    a non-hashable static arg cannot hit the jit cache at all).
+
+Findings are structured (rule id, severity, path:line, fix hint),
+published to ``paddle_tpu.monitor`` so ``monitor.snapshot()`` carries
+the audit result next to the runtime counters it predicts
+(``jit_recompile_count`` is the runtime mirror of the recompile rules).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.tree_util as jtu
+
+__all__ = [
+    "Finding", "ProgramAudit", "audit_jaxpr", "audit_callable",
+    "audit_engine", "audit_program", "HOST_TRANSFER_RULES",
+]
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+# rules that mean "bytes cross the host boundary at run time" — the
+# engine decode program must report NONE of these on the sampled path
+HOST_TRANSFER_RULES = frozenset({"host-callback", "output-transfer"})
+
+# primitives that re-enter Python from inside the compiled program
+_CALLBACK_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "host_callback_call",
+})
+
+# default size gates (bytes); callers tune them per program intent
+DEFAULT_OUTPUT_TRANSFER_BYTES = 4096
+DEFAULT_CONST_BYTES = 1 << 20
+DEFAULT_DONATION_BYTES = 1 << 20
+_MAX_FINDINGS_PER_RULE = 20
+
+
+@dataclasses.dataclass
+class Finding:
+    """One structured audit finding (reference shape: a PIR pass
+    diagnostic — rule, location, severity, how to fix)."""
+
+    rule_id: str
+    severity: str
+    message: str
+    hint: str = ""
+    path: str = ""
+    line: int = 0
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}" if self.path else "<program>"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        loc = f" [{self.location}]" if self.path else ""
+        hint = f"  (fix: {self.hint})" if self.hint else ""
+        return f"{self.severity}: {self.rule_id}{loc} {self.message}{hint}"
+
+
+class ProgramAudit:
+    """The result of auditing one program: a named, queryable list of
+    findings plus the monitor publication hook."""
+
+    def __init__(self, name: str, findings: Sequence[Finding]):
+        self.name = name
+        self.findings = list(findings)
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == SEVERITY_ERROR]
+
+    @property
+    def host_transfer_findings(self) -> List[Finding]:
+        return [f for f in self.findings
+                if f.rule_id in HOST_TRANSFER_RULES]
+
+    def by_rule(self, rule_id: str) -> List[Finding]:
+        return [f for f in self.findings if f.rule_id == rule_id]
+
+    def to_dict(self) -> dict:
+        return {"program": self.name,
+                "findings": [f.to_dict() for f in self.findings]}
+
+    def report(self) -> str:
+        head = (f"program audit: {self.name} — "
+                f"{len(self.errors)} error(s), "
+                f"{len(self.findings) - len(self.errors)} warning(s)")
+        return "\n".join([head] + [f"  {f}" for f in self.findings])
+
+    def publish(self) -> None:
+        """Feed the findings into ``paddle_tpu.monitor`` so
+        ``monitor.snapshot()`` exports them next to runtime metrics."""
+        from .. import monitor
+        c = monitor.counter(
+            "audit_findings_total",
+            "program-auditor findings observed this process",
+            ("program", "rule_id", "severity"))
+        for f in self.findings:
+            c.inc(program=self.name, rule_id=f.rule_id,
+                  severity=f.severity)
+        monitor.gauge(
+            "audit_last_error_findings",
+            "error-severity findings of the most recent audit per program",
+            ("program",)).set(len(self.errors), program=self.name)
+
+    def __repr__(self) -> str:
+        return (f"<ProgramAudit {self.name!r} findings="
+                f"{len(self.findings)} errors={len(self.errors)}>")
+
+
+# ---------------------------------------------------------------- helpers
+def _aval_of(x) -> Optional[Any]:
+    aval = getattr(x, "aval", None)
+    if aval is not None:
+        return aval
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return x
+    return None
+
+
+def _nbytes(aval) -> int:
+    try:
+        size = int(np.prod(aval.shape, dtype=np.int64))
+        return size * np.dtype(aval.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def _shape_str(aval) -> str:
+    try:
+        return f"{np.dtype(aval.dtype).name}{list(aval.shape)}"
+    except Exception:
+        return repr(aval)
+
+
+def _eqn_location(eqn) -> Tuple[str, int]:
+    """Best-effort user path:line from an equation's source info."""
+    try:
+        from jax._src import source_info_util
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is not None:
+            return frame.file_name, int(frame.start_line)
+    except Exception:
+        pass
+    return "", 0
+
+
+def _walk_eqns(jaxpr) -> Iterable[Any]:
+    """Every equation in the jaxpr, recursing into call/control-flow
+    sub-jaxprs (pjit bodies, scan/while/cond branches)."""
+    from jax import core as jcore
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            for sub in _subjaxprs_of(val, jcore):
+                yield from _walk_eqns(sub)
+
+
+def _subjaxprs_of(val, jcore):
+    if isinstance(val, jcore.ClosedJaxpr):
+        return [val.jaxpr]
+    if isinstance(val, jcore.Jaxpr):
+        return [val]
+    if isinstance(val, (tuple, list)):
+        out = []
+        for v in val:
+            out.extend(_subjaxprs_of(v, jcore))
+        return out
+    return []
+
+
+def _np_dtype(dtype):
+    """np.dtype or None for extended dtypes (jax PRNG key avals)."""
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        return None
+
+
+def _is_wide_float(dtype) -> bool:
+    return _np_dtype(dtype) in (np.dtype(np.float32),
+                                np.dtype(np.float64))
+
+
+def _is_64bit(dtype) -> bool:
+    return _np_dtype(dtype) in (np.dtype(np.int64), np.dtype(np.uint64),
+                                np.dtype(np.float64))
+
+
+# ----------------------------------------------------------------- checks
+def _check_callbacks(jaxpr, findings: List[Finding]) -> None:
+    n = 0
+    for eqn in _walk_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in _CALLBACK_PRIMITIVES or "callback" in name:
+            path, line = _eqn_location(eqn)
+            n += 1
+            if n > _MAX_FINDINGS_PER_RULE:
+                break
+            findings.append(Finding(
+                "host-callback", SEVERITY_ERROR,
+                f"'{name}' re-enters Python inside the compiled program "
+                f"— a host round-trip on every execution",
+                hint="compute on device (lax/jnp) or hoist the callback "
+                     "out of the compiled region",
+                path=path, line=line))
+
+
+def _check_consts(closed, findings: List[Finding], const_bytes: int) -> None:
+    for c in closed.consts:
+        aval = _aval_of(c)
+        if aval is None:
+            continue
+        nb = _nbytes(aval)
+        if nb > const_bytes:
+            findings.append(Finding(
+                "const-capture", SEVERITY_WARNING,
+                f"captured constant {_shape_str(aval)} ({nb >> 10} KiB) is "
+                f"baked into the program",
+                hint="pass it as an argument: baked constants re-upload "
+                     "per executable and force a recompile when the value "
+                     "changes"))
+
+
+def _match_and_consume(pool: List[Tuple[Tuple, str]], aval) -> bool:
+    key = (tuple(aval.shape), str(aval.dtype))
+    for i, (k, _) in enumerate(pool):
+        if k == key:
+            pool.pop(i)
+            return True
+    return False
+
+
+def _check_outputs(closed, findings: List[Finding], donated_avals,
+                   output_transfer_bytes: int) -> List[Any]:
+    """Flag large outputs that are not aliased to a donated input; the
+    leftover (unmatched) outputs feed the donation check."""
+    pool = [((tuple(a.shape), str(a.dtype)), "") for a in donated_avals]
+    leftover = []
+    for var in closed.jaxpr.outvars:
+        aval = _aval_of(var)
+        if aval is None or getattr(aval, "shape", None) is None:
+            continue
+        if _match_and_consume(pool, aval):
+            continue                      # donated alias: stays on device
+        leftover.append(aval)
+        nb = _nbytes(aval)
+        if nb > output_transfer_bytes:
+            findings.append(Finding(
+                "output-transfer", SEVERITY_ERROR,
+                f"output {_shape_str(aval)} ({nb} B) crosses the "
+                f"device->host boundary every call",
+                hint="keep reductions/sampling on device and return "
+                     "per-row scalars or ids; donate state buffers so "
+                     "they alias in place"))
+    return leftover
+
+
+def _check_donation(closed, findings: List[Finding], donated_avals,
+                    leftover_out_avals, donation_bytes: int) -> None:
+    donated_keys = {(tuple(a.shape), str(a.dtype))
+                    for a in donated_avals}
+    out_pool = [((tuple(a.shape), str(a.dtype)), "")
+                for a in leftover_out_avals]
+    for var in closed.jaxpr.invars:
+        aval = _aval_of(var)
+        if aval is None:
+            continue
+        nb = _nbytes(aval)
+        if nb < donation_bytes:
+            continue
+        key = (tuple(aval.shape), str(aval.dtype))
+        if key in donated_keys:
+            continue                       # its twin is already donated
+        if _match_and_consume(out_pool, aval):
+            findings.append(Finding(
+                "missed-donation", SEVERITY_WARNING,
+                f"input {_shape_str(aval)} ({nb >> 20} MiB) matches an "
+                f"output but is not donated — XLA keeps both buffers live",
+                hint="pass donate_argnums for state carried through the "
+                     "step (KV pages, optimizer state)"))
+
+
+def _check_dtype_creep(jaxpr, findings: List[Finding],
+                       expect_dtype) -> None:
+    """Flag eqns that INTRODUCE a wide dtype (no wide input, wide
+    output) inside a program meant to run at a narrower working dtype;
+    with x64 enabled, 64-bit introductions are flagged unconditionally."""
+    check_f32 = expect_dtype is not None and np.dtype(expect_dtype) in (
+        np.dtype("bfloat16"), np.dtype(np.float16))
+    seen = set()
+    n_per_rule = {"f32": 0, "x64": 0}   # caps are per rule, not shared
+    for eqn in _walk_eqns(jaxpr):
+        in_wide = any(_is_wide_float(a.dtype)
+                      for v in eqn.invars
+                      if (a := _aval_of(v)) is not None
+                      and getattr(a, "dtype", None) is not None)
+        in_64 = any(_is_64bit(a.dtype)
+                    for v in eqn.invars
+                    if (a := _aval_of(v)) is not None
+                    and getattr(a, "dtype", None) is not None)
+        for var in eqn.outvars:
+            aval = _aval_of(var)
+            if aval is None or getattr(aval, "dtype", None) is None:
+                continue
+            path, line = _eqn_location(eqn)
+            if check_f32 and _is_wide_float(aval.dtype) and not in_wide:
+                key = ("f32", eqn.primitive.name, path, line)
+                if key in seen or n_per_rule["f32"] >= _MAX_FINDINGS_PER_RULE:
+                    continue
+                seen.add(key)
+                n_per_rule["f32"] += 1
+                findings.append(Finding(
+                    "dtype-promotion", SEVERITY_WARNING,
+                    f"'{eqn.primitive.name}' introduces "
+                    f"{np.dtype(aval.dtype).name} into a "
+                    f"{np.dtype(expect_dtype).name} program "
+                    f"({_shape_str(aval)})",
+                    hint="cast accumulations explicitly and keep "
+                         "activations at the working dtype; f32 creep "
+                         "doubles HBM traffic on TPU",
+                    path=path, line=line))
+            if _is_64bit(aval.dtype) and not in_64:
+                key = ("x64", eqn.primitive.name, path, line)
+                if key in seen or n_per_rule["x64"] >= _MAX_FINDINGS_PER_RULE:
+                    continue
+                seen.add(key)
+                n_per_rule["x64"] += 1
+                findings.append(Finding(
+                    "x64-creep", SEVERITY_WARNING,
+                    f"'{eqn.primitive.name}' produces 64-bit "
+                    f"{_shape_str(aval)} inside the program",
+                    hint="use 32-bit index/accumulator dtypes; TPU pays "
+                         "double bandwidth for 64-bit lanes",
+                    path=path, line=line))
+
+
+def _check_weak_types(example_leaves, findings: List[Finding]) -> None:
+    n = 0
+    for leaf in example_leaves:
+        aval = _aval_of(leaf)
+        weak = getattr(aval, "weak_type", False) or (
+            isinstance(leaf, (bool, int, float, complex)))
+        if weak:
+            n += 1
+    if n:
+        findings.append(Finding(
+            "weak-type", SEVERITY_WARNING,
+            f"{n} weak-typed (Python scalar) input(s) — each distinct "
+            f"Python type re-specializes the compile cache and can "
+            f"silently upcast",
+            hint="pass jnp/np arrays with explicit dtypes, or mark true "
+                 "configuration values static"))
+
+
+# ------------------------------------------------------------ public API
+def audit_jaxpr(closed, *, name: str = "<jaxpr>", donated_avals=(),
+                expect_dtype=None,
+                output_transfer_bytes: int = DEFAULT_OUTPUT_TRANSFER_BYTES,
+                const_bytes: int = DEFAULT_CONST_BYTES,
+                donation_bytes: int = DEFAULT_DONATION_BYTES,
+                example_leaves=(), publish: bool = True) -> ProgramAudit:
+    """Walk a ClosedJaxpr and return the structured audit."""
+    findings: List[Finding] = []
+    _check_callbacks(closed.jaxpr, findings)
+    _check_consts(closed, findings, const_bytes)
+    leftover = _check_outputs(closed, findings, donated_avals,
+                              output_transfer_bytes)
+    _check_donation(closed, findings, donated_avals, leftover,
+                    donation_bytes)
+    _check_dtype_creep(closed.jaxpr, findings, expect_dtype)
+    _check_weak_types(example_leaves, findings)
+    audit = ProgramAudit(name, findings)
+    if publish:
+        try:
+            audit.publish()
+        except Exception:
+            pass                      # telemetry must never fail an audit
+    return audit
+
+
+def audit_callable(fn, *example_args, donate_argnums=(), static_argnums=(),
+                   expect_dtype=None, name: Optional[str] = None,
+                   publish: bool = True, **limits) -> ProgramAudit:
+    """Trace ``fn`` on example args (arrays or ShapeDtypeStructs — no
+    device work happens) and audit the resulting jaxpr.  This is the
+    front door for auditing anything you would ``jax.jit``; pass the
+    same ``donate_argnums``/``static_argnums`` you pass jit so donation
+    and recompile checks see the real call contract."""
+    donate_argnums = (donate_argnums,) if isinstance(donate_argnums, int) \
+        else tuple(donate_argnums)
+    static_argnums = (static_argnums,) if isinstance(static_argnums, int) \
+        else tuple(static_argnums)
+    pre_findings: List[Finding] = []
+    for i in static_argnums:
+        try:
+            hash(example_args[i])
+        except TypeError:
+            pre_findings.append(Finding(
+                "nonhashable-static", SEVERITY_ERROR,
+                f"static arg {i} ({type(example_args[i]).__name__}) is "
+                f"not hashable — the jit cache cannot key on it",
+                hint="use tuples/frozen dataclasses for static "
+                     "configuration, never lists/dicts/arrays"))
+    if pre_findings:
+        # an unhashable static arg also breaks tracing — report the
+        # call-boundary finding on its own; jit would fail the same way
+        audit = ProgramAudit(name or getattr(fn, "__name__", "<fn>"),
+                             pre_findings)
+        if publish:
+            try:
+                audit.publish()
+            except Exception:
+                pass
+        return audit
+    closed = jax.make_jaxpr(fn, static_argnums=static_argnums)(
+        *example_args)
+    donated_avals = []
+    for i in donate_argnums:
+        for leaf in jtu.tree_leaves(example_args[i]):
+            aval = _aval_of(leaf)
+            if aval is not None:
+                donated_avals.append(aval)
+    example_leaves = [
+        leaf for i, a in enumerate(example_args)
+        if i not in static_argnums for leaf in jtu.tree_leaves(a)]
+    return audit_jaxpr(
+        closed, name=name or getattr(fn, "__name__", "<fn>"),
+        donated_avals=donated_avals, expect_dtype=expect_dtype,
+        example_leaves=example_leaves, publish=publish, **limits)
+
+
+def audit_engine(engine, mode: str = "decode", sample=None,
+                 per_row_budget: int = 64, publish: bool = True,
+                 **limits) -> ProgramAudit:
+    """Audit a ContinuousBatchingEngine's compiled decode program
+    without running it: rebuilds the exact traced function + donation
+    contract ``JittedPagedDecoder`` jits and traces it on abstract
+    inputs shaped like a full decode batch.
+
+    With the engine's default ``sample_on_device=True`` the program's
+    only non-donated output is the ``(batch,)`` int32 ids — the audit
+    must report zero host-transfer findings (PR 2's invariant, now
+    enforced).  ``per_row_budget`` is the allowed host-transfer bytes
+    per batch row (ids are 4; a logits row is vocab*4)."""
+    import jax.numpy as jnp
+    from ..inference.paged import next_pow2
+
+    if mode != "decode":
+        raise ValueError(f"audit_engine supports mode='decode', got "
+                         f"{mode!r}")
+    decoder = engine._decoder
+    cache = engine.cache
+    if sample is None:
+        sample = "greedy" if engine.sample_on_device else False
+    fn, donate = decoder.program_fn(mode, sample)
+    # the engine's decode buckets are min(next_pow2(active), max_batch),
+    # so max_batch IS the largest program shape serving ever compiles —
+    # audit that one, not its power-of-two round-up
+    B = engine.max_batch
+    W = next_pow2(max(1, -(-engine.max_position // cache.page_size)))
+    sds = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    params = [sds(tuple(p._data.shape), p._data.dtype)
+              for p in decoder.params]
+    if sample == "draw":
+        s_args = (sds((B,), jnp.uint32), sds((B,), i32),
+                  sds((B,), jnp.float32), sds((B,), jnp.bool_))
+    else:
+        s_args = ()
+    k_pages = tuple(sds(tuple(a.shape), a.dtype) for a in cache.k_pages)
+    v_pages = tuple(sds(tuple(a.shape), a.dtype) for a in cache.v_pages)
+    args = (params, sds((B, 1), i32), sds((B,), i32), sds((B,), i32),
+            sds((B,), i32), sds((B,), i32), sds((B, W), i32), s_args,
+            k_pages, v_pages)
+    limits.setdefault("output_transfer_bytes", B * per_row_budget)
+    return audit_callable(
+        fn, *args, donate_argnums=donate,
+        name=f"engine.decode[{'logits' if sample is False else sample}]",
+        publish=publish, **limits)
+
+
+def audit_program(program, feed, fetch_list=None, publish: bool = True,
+                  **limits) -> ProgramAudit:
+    """Audit a ``static.Program``: traces the recorded replay (captured
+    eager state surfaces as inputs, exactly as ``Executor.run`` compiles
+    it) and walks the jaxpr."""
+    closed, example_leaves = program.make_jaxpr(feed, fetch_list)
+    return audit_jaxpr(closed, name=f"static.Program[{len(program.ops)} ops]",
+                       example_leaves=example_leaves, publish=publish,
+                       **limits)

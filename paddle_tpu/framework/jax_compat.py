@@ -91,6 +91,19 @@ def to_memory_kind(sharding, kind):
     return sharding
 
 
+def register_compile_listener(callback) -> bool:
+    """Subscribe ``callback(event_name, duration_secs, **kw)`` to jax's
+    monitoring duration events (backend compiles fire one per XLA
+    compile on every supported jax).  Returns False on builds without
+    ``jax.monitoring`` — callers degrade to no compile telemetry."""
+    try:
+        from jax import monitoring as _monitoring
+        _monitoring.register_event_duration_secs_listener(callback)
+        return True
+    except Exception:
+        return False
+
+
 def pin_cpu_devices(n: int) -> None:
     """Provision ``n`` virtual CPU devices pre-init.  Current jax has a
     config option; older jax only honors the XLA host-platform flag (an
@@ -108,4 +121,4 @@ def pin_cpu_devices(n: int) -> None:
 
 __all__ = ["shard_map", "axis_size", "memory_kinds",
            "default_memory_kind", "is_compute_memory", "to_memory_kind",
-           "pin_cpu_devices"]
+           "register_compile_listener", "pin_cpu_devices"]

@@ -129,6 +129,10 @@ class ContinuousBatchingEngine:
         self.sample_on_device = bool(sample_on_device)
         self.prefix_cache = bool(prefix_cache)
         _sampling_on_device_g.set(int(self.sample_on_device))
+        # runtime mirror of the analysis auditor's recompile rules:
+        # every XLA compile the decode loop triggers shows up in
+        # jit_recompile_count (steady-state serving should sit at zero)
+        monitor.install_compile_hooks()
         self.cache = PagedKVCache.from_model(
             model, total_pages=total_pages, page_size=page_size)
         from .paged import JittedPagedDecoder
@@ -212,8 +216,11 @@ class ContinuousBatchingEngine:
         ps = self.cache.page_size
         return -(-(len(req.prompt) + req.max_new_tokens) // ps)
 
-    def _pop_admissible(self) -> List[_Request]:
-        """Under the lock: move queued requests to 'admitted' while slots
+    def _pop_admissible_locked(self) -> List[_Request]:
+        """Caller holds ``self._cond`` (the ``_locked`` suffix is the
+        lint-checked contract — tpu_lint's TPL004 exempts these helpers
+        and flags any other off-lock engine-state mutation).
+        Move queued requests to 'admitted' while slots
         and reserved pages allow, assigning seq ids and RESERVING their
         worst-case pages (prompt + full max_new_tokens) so decode-time
         allocate() can never exhaust the pool.  A prompt whose prefix is
@@ -294,12 +301,12 @@ class ContinuousBatchingEngine:
         return sample_token(logits_row, req.do_sample, req.temperature,
                             req.rng)
 
-    def _retire(self, req):
-        """Release the request's pages and exactly the reservation its
-        retirement uncovers: the worst-case pages it never allocated,
-        plus each held page that stopped being pinned (a shared page
-        another live sharer still maps keeps its reservation — it
-        transfers to that sharer's accounting)."""
+    def _retire_locked(self, req):
+        """Caller holds ``self._cond``.  Release the request's pages and
+        exactly the reservation its retirement uncovers: the worst-case
+        pages it never allocated, plus each held page that stopped being
+        pinned (a shared page another live sharer still maps keeps its
+        reservation — it transfers to that sharer's accounting)."""
         slack = (self._pages_for(req)
                  - len(self.cache._seq_pages.get(req.seq_id, ())))
         released = self.cache.free(req.seq_id)
@@ -354,26 +361,33 @@ class ContinuousBatchingEngine:
         with monitor.span("engine/decode_step", histogram=_decode_step_s):
             out_np = self._decoder.step(self.cache, seq_ids, tokens,
                                         pos, sampling=sampling)
-        self.steps += 1
         _tokens_total.inc(len(active))
 
+        # request-local state (r.*) is scheduler-thread-owned: decide
+        # retirements and sample next tokens OUTSIDE the lock, then take
+        # the lock for the shared-state transition (pages/reservations/
+        # active list) — the discipline tpu_lint TPL004 enforces
         still, retired = [], []
         for i, r in enumerate(active):
             eos_hit = (r.eos_token_id is not None
                        and r.generated[-1] == r.eos_token_id)
             if eos_hit or len(r.generated) >= r.max_new_tokens:
-                self._retire(r)
                 retired.append(r)
                 continue
             r.next_token = (int(out_np[i]) if on_device
                             else self._pick(r, out_np[i]))
             still.append(r)
-        self._active = still
-        if not still:
-            # idle: the scratch page goes back too, so a drained engine
-            # reports a fully reclaimed pool — released BEFORE waking
-            # the retired requests' waiters, who may assert exactly that
-            self.cache.free(_PAD_SEQ)
+        with self._cond:
+            self.steps += 1
+            for r in retired:
+                self._retire_locked(r)
+            self._active = still
+            if not still:
+                # idle: the scratch page goes back too, so a drained
+                # engine reports a fully reclaimed pool — released
+                # BEFORE waking the retired requests' waiters, who may
+                # assert exactly that
+                self.cache.free(_PAD_SEQ)
         _active_seqs.set(len(still))
         for r in retired:
             r.done.set()
@@ -414,7 +428,7 @@ class ContinuousBatchingEngine:
                         r.error = RuntimeError("engine stopped")
                         r.done.set()
                     return
-                admitted = self._pop_admissible()
+                admitted = self._pop_admissible_locked()
             try:
                 for req in admitted:           # device work: outside lock
                     self._prefill(req)

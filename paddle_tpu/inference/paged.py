@@ -222,11 +222,17 @@ class JittedPagedDecoder:
     (batch, pool-shape) signature and reuses the compile cache after.
     """
 
+    #: per-mode donated arg positions (the page pools) — shared between
+    #: the jit call and the analysis auditor so both see one contract
+    DONATE_ARGNUMS = {"decode": (8, 9), "prefill": (6, 7),
+                      "prefix": (8, 9)}
+
     def __init__(self, model):
         self.model = model
         self.params = model.parameters()
         self.max_position = int(model.config.max_position_embeddings)
         self._programs = {}              # (mode, sample) -> jitted fn
+        self._program_fns = {}           # (mode, sample) -> raw traced fn
         self._jitted_multi = None        # built on first multi_step use
 
     # -------------------------------------------------- compiled programs
@@ -283,7 +289,6 @@ class JittedPagedDecoder:
                     for p, s in zip(self.params, saved):
                         p._data = s
 
-            prog = jax.jit(fn, donate_argnums=(8, 9))
         elif mode == "prefill":
             def fn(param_arrays, ids, last_idx, pg, sl, sampling,
                    k_pages, v_pages):
@@ -301,7 +306,6 @@ class JittedPagedDecoder:
                     for p, s in zip(self.params, saved):
                         p._data = s
 
-            prog = jax.jit(fn, donate_argnums=(6, 7))
         elif mode == "prefix":
             def fn(param_arrays, ids, last_idx, pg, sl, ptabs,
                    plens, sampling, k_pages, v_pages):
@@ -324,11 +328,21 @@ class JittedPagedDecoder:
                     for p, s in zip(self.params, saved):
                         p._data = s
 
-            prog = jax.jit(fn, donate_argnums=(8, 9))
         else:
             raise ValueError(f"unknown program mode {mode!r}")
+        prog = jax.jit(fn, donate_argnums=self.DONATE_ARGNUMS[mode])
+        self._program_fns[key] = fn
         self._programs[key] = prog
         return prog
+
+    def program_fn(self, mode: str, sample):
+        """(raw traced fn, donate_argnums) for a program — the analysis
+        auditor's entry: ``jax.make_jaxpr`` over this fn with abstract
+        args sees exactly what the jitted program compiles, without
+        running anything (paddle_tpu.analysis.audit_engine)."""
+        self._program(mode, sample)
+        return self._program_fns[(mode, sample)], \
+            self.DONATE_ARGNUMS[mode]
 
     @staticmethod
     def _sampling_args(sampling):

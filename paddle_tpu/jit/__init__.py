@@ -119,6 +119,8 @@ class StaticFunction:
         return tensors
 
     def _build(self):
+        from .. import monitor
+        monitor.install_compile_hooks()   # jit_recompile_count telemetry
         self._param_tensors = self._collect_params()
 
         def traced(param_arrays, rng_key, args_leaves, treedef):
@@ -154,6 +156,7 @@ class StaticFunction:
             return tuple(arrays)
 
         self._jitted = jitted
+        self._traced = traced             # raw trace fn for .audit()
         self._out_tree_store = out_tree_store
 
     def __call__(self, *args, **kwargs):
@@ -220,6 +223,31 @@ class StaticFunction:
         if out_tree is not None:
             return jtu.tree_unflatten(out_tree, list(out))
         return out
+
+    def audit(self, *args, **kwargs):
+        """Static-analysis view of this function: traces it exactly as
+        the compiled path would (params hoisted to inputs, RNG keyed)
+        and runs the ``paddle_tpu.analysis`` program auditor over the
+        jaxpr.  Accepts the same example args a call would; no device
+        work happens and nothing is compiled."""
+        from .. import analysis
+        if self._jitted is None:
+            self._build()
+        leaves, treedef = jtu.tree_flatten((args, kwargs),
+                                           is_leaf=_is_tensor)
+        tensor_leaves = [l for l in leaves if _is_tensor(l)]
+        traced = self._traced
+
+        def fn(param_arrays, input_arrays, rng_key):
+            it = iter(input_arrays)
+            new_leaves = [next(it) if _is_tensor(l) else l for l in leaves]
+            arrays, _ = traced(param_arrays, rng_key, new_leaves, treedef)
+            return tuple(arrays)
+
+        return analysis.audit_callable(
+            fn, [p._data for p in self._param_tensors],
+            [t._data for t in tensor_leaves], jax.random.PRNGKey(0),
+            name=f"to_static:{getattr(self._orig_fn, '__qualname__', '<fn>')}")
 
     # paddle API surface
     @property

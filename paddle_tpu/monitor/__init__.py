@@ -29,10 +29,11 @@ from .registry import (  # noqa: F401
     dump, dump_on_exit, DEFAULT_LATENCY_BUCKETS, BYTES_BUCKETS,
 )
 from .span import span  # noqa: F401
+from .compile_hooks import install_compile_hooks  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry", "get_registry",
     "counter", "gauge", "histogram", "snapshot", "prometheus_text",
-    "dump", "dump_on_exit", "span",
+    "dump", "dump_on_exit", "span", "install_compile_hooks",
     "DEFAULT_LATENCY_BUCKETS", "BYTES_BUCKETS",
 ]

@@ -14,6 +14,7 @@ precision keeps fp32 master weights in the accumulator dict
 from __future__ import annotations
 
 import functools
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -668,7 +669,10 @@ class LBFGS(Optimizer):
         self.tol_change = tolerance_change
         self.history_size = history_size
         self.line_search_fn = line_search_fn
-        self._s, self._y = [], []      # curvature pairs
+        # curvature pairs: deque(maxlen) evicts the oldest pair in O(1)
+        # (tpu_lint TPL003 — list.pop(0) shifts the whole history)
+        self._s = deque(maxlen=history_size)
+        self._y = deque(maxlen=history_size)
         self._prev_flat_grad = None
 
     def _flat(self, arrs):
@@ -772,9 +776,6 @@ class LBFGS(Optimizer):
             if float(jnp.vdot(s, ygrad)) > 1e-10:
                 self._s.append(s)
                 self._y.append(ygrad)
-                if len(self._s) > self.history_size:
-                    self._s.pop(0)
-                    self._y.pop(0)
             if float(jnp.max(jnp.abs(g_new))) <= self.tol_grad:
                 break
             if float(jnp.max(jnp.abs(s))) <= self.tol_change:

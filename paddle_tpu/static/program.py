@@ -204,6 +204,71 @@ class Program:
                 env[vid] = arr
         return [env[i] for i in fetch_ids]
 
+    def _fetch_ids(self, fetch_list) -> List[int]:
+        ids = []
+        for f in fetch_list:
+            if isinstance(f, Variable):
+                ids.append(f.var_id)
+            elif isinstance(f, str):
+                ids.append(self.var(f).var_id)
+            else:
+                raise TypeError(f"fetch_list entries must be Variable or "
+                                f"name, got {type(f)}")
+        return ids
+
+    def make_jaxpr(self, feed=None, fetch_list=None):
+        """Trace the recorded replay to a ClosedJaxpr — no compile, no
+        device work; the ``paddle_tpu.analysis.audit_program`` entry.
+
+        ``feed`` maps names to arrays/Tensors/ShapeDtypeStructs; omitted
+        feeds fall back to their declared shapes (wildcard dims trace as
+        1, the same placeholder build-time inference used).  Default
+        ``fetch_list``: the last recorded op's outputs.  Returns
+        ``(closed_jaxpr, example_leaves)`` where the leaves are the feed
+        specs followed by the captured-state specs (captured parameters
+        surface as INPUTS, exactly as ``Executor.run`` compiles them)."""
+        feed = dict(feed or {})
+        unknown = set(feed) - set(self.feed_vars)
+        if unknown:
+            raise ValueError(
+                f"feed names {sorted(unknown)} are not declared in this "
+                f"Program (declared: {sorted(self.feed_vars)})")
+        if fetch_list is None:
+            if not self.ops:
+                raise ValueError("empty Program has nothing to trace")
+            fetch_ids = list(self.ops[-1].out_ids)
+        else:
+            fetch_ids = self._fetch_ids(fetch_list)
+        names = sorted(self.feed_vars)
+        specs = []
+        for name in names:
+            v = self.feed_vars[name]
+            arr = feed.get(name)
+            if arr is None:
+                specs.append(jax.ShapeDtypeStruct(
+                    tuple(1 if s < 0 else s for s in v.declared_shape),
+                    v._data.dtype))
+            else:
+                arr = arr._data if isinstance(arr, Tensor) else arr
+                specs.append(jax.ShapeDtypeStruct(tuple(arr.shape),
+                                                  arr.dtype))
+        cap = [jax.ShapeDtypeStruct(tuple(t._data.shape), t._data.dtype)
+               for t in self.captured]
+
+        def _replay_traced(feed_vals, captured_vals):
+            return self._replay(dict(zip(names, feed_vals)),
+                                captured_vals, fetch_ids)
+
+        closed = jax.make_jaxpr(_replay_traced)(specs, cap)
+        return closed, specs + cap
+
+    def audit(self, feed=None, fetch_list=None, **limits):
+        """Run the paddle_tpu.analysis program auditor over this
+        Program's replay (reference: running a PIR inspection pass over
+        a built static Program)."""
+        from ..analysis import audit_program
+        return audit_program(self, feed, fetch_list, **limits)
+
     def global_block(self):
         return self                      # minimal block facade
 
@@ -278,15 +343,7 @@ class Executor:
         if not program.ops and not fetch_list:
             return []                     # startup program: init is eager
 
-        fetch_ids = []
-        for f in fetch_list:
-            if isinstance(f, Variable):
-                fetch_ids.append(f.var_id)
-            elif isinstance(f, str):
-                fetch_ids.append(program.var(f).var_id)
-            else:
-                raise TypeError(f"fetch_list entries must be Variable or "
-                                f"name, got {type(f)}")
+        fetch_ids = program._fetch_ids(fetch_list)
 
         missing = set(program.feed_vars) - set(feed)
         if missing:

@@ -102,6 +102,86 @@ class TestServeBench:
         assert out["decode_steps"] > 0
 
 
+class TestTpuLintGate:
+    """ISSUE 3 CI satellite: the anti-pattern linter runs clean against
+    its checked-in baseline, inside the tier-1 CPU lane's time budget."""
+
+    def _load(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "tpu_lint", os.path.join(REPO, "tools", "tpu_lint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_gate_runs_clean_within_budget(self, capsys):
+        import time
+        tl = self._load()
+        t0 = time.monotonic()
+        rc = tl.main(["--baseline",
+                      os.path.join(REPO, "tools",
+                                   "tpu_lint_baseline.json")])
+        elapsed = time.monotonic() - t0
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "0 new" in out
+        assert elapsed < 10, f"lint gate took {elapsed:.1f}s (budget 10s)"
+
+    def test_gate_fails_on_new_finding(self, tmp_path, monkeypatch):
+        # plant a fresh anti-pattern in a copied tree: the ratchet must
+        # reject it against the same baseline
+        tl = self._load()
+        bad = tmp_path / "pkg" / "planted.py"
+        bad.parent.mkdir()
+        bad.write_text("def f(q):\n    q.pop(0)\n")
+        rc = tl.main(["--baseline",
+                      os.path.join(REPO, "tools",
+                                   "tpu_lint_baseline.json"),
+                      f"--root={tmp_path / 'pkg'}"])
+        assert rc == 1
+
+    def test_update_baseline_roundtrip(self, tmp_path):
+        tl = self._load()
+        bad = tmp_path / "pkg" / "planted.py"
+        bad.parent.mkdir()
+        bad.write_text("def f(q):\n    q.pop(0)\n")
+        base = tmp_path / "base.json"
+        assert tl.main([f"--root={tmp_path / 'pkg'}",
+                        "--update-baseline",
+                        f"--baseline={base}"]) == 0
+        doc = json.load(open(base))
+        assert len(doc["findings"]) == 1
+        # a placeholder justification is NOT an accepted finding: the
+        # gate refuses it until someone writes the reason down
+        assert tl.main([f"--root={tmp_path / 'pkg'}",
+                        f"--baseline={base}"]) == 1
+        doc["findings"][0]["justification"] = "test fixture queue"
+        base.write_text(json.dumps(doc))
+        assert tl.main([f"--root={tmp_path / 'pkg'}",
+                        f"--baseline={base}"]) == 0
+        # --update-baseline again must PRESERVE the justification
+        assert tl.main([f"--root={tmp_path / 'pkg'}",
+                        "--update-baseline",
+                        f"--baseline={base}"]) == 0
+        doc2 = json.load(open(base))
+        assert doc2["findings"][0]["justification"] == "test fixture queue"
+
+    def test_space_separated_root_is_not_silently_ignored(self, tmp_path):
+        # argparse must reject a bad invocation instead of linting the
+        # default tree and reporting a misleading "clean"
+        tl = self._load()
+        with pytest.raises(SystemExit):
+            tl.main(["--root", str(tmp_path), "--unknown-flag"])
+        # the supported space-separated form works
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "ok.py").write_text("x = 1\n")
+        assert tl.main(["--root", str(pkg),
+                        "--baseline",
+                        os.path.join(REPO, "tools",
+                                     "tpu_lint_baseline.json")]) == 0
+
+
 class TestCostModelFacade:
     def test_alias(self):
         import paddle_tpu as paddle

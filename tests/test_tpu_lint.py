@@ -1,0 +1,208 @@
+"""paddle_tpu.analysis.lint — TPU anti-pattern AST linter (ISSUE 3).
+
+Rule-by-rule detection on planted sources, the baseline ratchet
+semantics (line moves never churn, second instances still fail), and
+the repo-wide invariant that the shipped tree is clean against its
+checked-in baseline.
+"""
+import os
+import textwrap
+
+from paddle_tpu.analysis import lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(src):
+    return lint.lint_source(textwrap.dedent(src), "planted.py")
+
+
+class TestRules:
+    def test_concretization_under_jit_decorator(self):
+        found = _lint("""
+            import jax
+            @jax.jit
+            def f(x):
+                return float(x) + x.item()
+        """)
+        assert {f.rule_id for f in found} == {"TPL001"}
+        assert len(found) == 2 and all(f.severity == "error"
+                                       for f in found)
+
+    def test_jax_jit_call_idiom_marks_local_fn(self):
+        # the tree's own pattern: def fn(...): ...; jax.jit(fn, ...)
+        found = _lint("""
+            import jax, numpy as np
+            def fn(x):
+                return np.asarray(x)
+            prog = jax.jit(fn, donate_argnums=(0,))
+        """)
+        assert [f.rule_id for f in found] == ["TPL001"]
+
+    def test_functools_partial_jit_decorator(self):
+        found = _lint("""
+            import functools, jax
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def f(x, n):
+                return x.numpy()
+        """)
+        assert [f.rule_id for f in found] == ["TPL001"]
+
+    def test_to_static_decorator(self):
+        found = _lint("""
+            import paddle
+            @paddle.jit.to_static
+            def f(x):
+                return int(x)
+        """)
+        assert [f.rule_id for f in found] == ["TPL001"]
+
+    def test_static_int_and_len_are_exempt(self):
+        found = _lint("""
+            import jax
+            @jax.jit
+            def f(x):
+                n = int(len(x)) + int(4)
+                return x * n
+        """)
+        assert found == []
+
+    def test_eager_concretization_not_flagged(self):
+        # float()/np.asarray in plain host code is normal
+        found = _lint("""
+            import numpy as np
+            def host(x):
+                return float(np.asarray(x).sum())
+        """)
+        assert found == []
+
+    def test_rng_and_clock_under_jit(self):
+        found = _lint("""
+            import jax, random, time
+            import numpy as np
+            @jax.jit
+            def f(x):
+                return x * random.random() + np.random.rand() + time.time()
+        """)
+        assert [f.rule_id for f in found] == ["TPL002"] * 3
+
+    def test_pop_front_anywhere(self):
+        found = _lint("""
+            def drain(q):
+                while q:
+                    q.pop(0)
+        """)
+        assert [f.rule_id for f in found] == ["TPL003"]
+        assert "deque" in found[0].hint
+        # pop() / pop(-1) / dict-style pop(key) are fine
+        assert _lint("def g(q, d):\n    q.pop()\n    q.pop(-1)\n"
+                     "    d.pop('k')\n") == []
+
+    def test_lock_discipline(self):
+        found = _lint("""
+            class ContinuousBatchingEngine:
+                def __init__(self):
+                    self._active = []      # pre-thread: exempt
+                def _retire_locked(self, r):
+                    self._reserved_pages -= 1   # contract: lock held
+                def good(self):
+                    with self._cond:
+                        self._queue.append(1)
+                def bad(self):
+                    self._queue.append(1)
+                    self._active = []
+                    self.steps += 1
+        """)
+        assert all(f.rule_id == "TPL004" for f in found)
+        assert sorted(f.scope for f in found) == [
+            "ContinuousBatchingEngine.bad"] * 3
+
+    def test_lock_discipline_only_applies_to_configured_classes(self):
+        found = _lint("""
+            class SomethingElse:
+                def run(self):
+                    self._queue.append(1)
+        """)
+        assert found == []
+
+
+class TestBaseline:
+    SRC = """
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x)
+    """
+
+    def test_roundtrip_and_ratchet(self, tmp_path):
+        findings = _lint(self.SRC)
+        path = str(tmp_path / "baseline.json")
+        lint.save_baseline(path, findings)
+        baseline = lint.load_baseline(path)
+        assert all("justification" in e for e in baseline)
+        new, stale = lint.diff_against_baseline(findings, baseline)
+        assert new == [] and stale == []
+
+    def test_line_moves_do_not_churn(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        lint.save_baseline(path, _lint(self.SRC))
+        moved = "\n\n\n# comment pushes everything down\n" + \
+            textwrap.dedent(self.SRC)
+        new, stale = lint.diff_against_baseline(
+            lint.lint_source(moved, "planted.py"),
+            lint.load_baseline(path))
+        assert new == [] and stale == []
+
+    def test_second_instance_is_new(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        lint.save_baseline(path, _lint(self.SRC))
+        doubled = textwrap.dedent(self.SRC) + textwrap.dedent("""
+            @jax.jit
+            def g(x):
+                return float(x)
+        """)
+        new, _ = lint.diff_against_baseline(
+            lint.lint_source(doubled, "planted.py"),
+            lint.load_baseline(path))
+        assert len(new) == 1 and new[0].scope == "g"
+
+    def test_fixed_finding_goes_stale(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        lint.save_baseline(path, _lint(self.SRC))
+        new, stale = lint.diff_against_baseline(
+            [], lint.load_baseline(path))
+        assert new == [] and len(stale) == 1
+
+    def test_rewrite_preserves_filled_justifications(self, tmp_path):
+        import json
+        path = str(tmp_path / "baseline.json")
+        findings = _lint(self.SRC)
+        lint.save_baseline(path, findings)
+        doc = json.load(open(path))
+        assert lint.unjustified_entries(doc["findings"])
+        doc["findings"][0]["justification"] = "measured: trace-time only"
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        lint.save_baseline(path, findings)      # rewrite from findings
+        kept = json.load(open(path))["findings"][0]["justification"]
+        assert kept == "measured: trace-time only"
+        assert lint.unjustified_entries(
+            json.load(open(path))["findings"]) == []
+
+
+class TestTreeIsClean:
+    def test_paddle_tpu_tree_clean_against_committed_baseline(self):
+        findings = lint.lint_paths(os.path.join(REPO, "paddle_tpu"),
+                                   rel_to=REPO)
+        baseline = lint.load_baseline(
+            os.path.join(REPO, "tools", "tpu_lint_baseline.json"))
+        new, _ = lint.diff_against_baseline(findings, baseline)
+        assert new == [], "\n".join(str(f) for f in new)
+
+    def test_seed_antipatterns_stay_fixed(self):
+        # the ISSUE 3 satellite fixes, regression-locked: no pop(0)
+        # and no off-lock engine mutation anywhere in the tree
+        findings = lint.lint_paths(os.path.join(REPO, "paddle_tpu"),
+                                   rel_to=REPO)
+        assert [f for f in findings if f.rule_id == "TPL003"] == []
+        assert [f for f in findings if f.rule_id == "TPL004"] == []

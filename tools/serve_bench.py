@@ -75,6 +75,11 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
     from paddle_tpu import monitor
     from paddle_tpu.inference.continuous import ContinuousBatchingEngine
 
+    # compile telemetry (ISSUE 3): the measured window of a warm serving
+    # loop should show ZERO recompiles — a nonzero delta here means a
+    # bucket/shape leak the program auditor should be pointed at
+    monitor.install_compile_hooks()
+
     if model is None:
         import paddle_tpu as paddle
         from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
@@ -142,6 +147,8 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
                                            "time_to_first_token_seconds")
     pre_b, pre_sum, pre_n = _hist_delta(before, after, "prefill_seconds")
     tokens = _counter_delta(before, after, "generated_tokens_total")
+    _, compile_sum, compile_n = _hist_delta(before, after,
+                                            "jit_compile_seconds")
     lookups = _counter_delta(before, after, "prefix_cache_lookups_total")
     hits = _counter_delta(before, after, "prefix_cache_hits_total")
     hit_tokens = _counter_delta(before, after,
@@ -164,6 +171,10 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
         "prefill_mean_s": (pre_sum / pre_n) if pre_n else None,
         "prefix_hit_rate": (hits / lookups) if lookups else 0.0,
         "prefix_hit_tokens": int(hit_tokens),
+        # steady-state contract: the warm-up wave compiled every bucket,
+        # so the measured window should recompile nothing
+        "jit_recompiles": int(compile_n),
+        "jit_compile_seconds": compile_sum,
     }
 
 

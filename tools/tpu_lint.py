@@ -1,0 +1,113 @@
+"""TPU anti-pattern lint gate (ISSUE 3 CI satellite).
+
+Sweeps the ``paddle_tpu/`` tree with the AST linter
+(paddle_tpu/analysis/lint.py) and ratchets the result against the
+checked-in baseline: any finding NOT in the baseline fails the gate, so
+new anti-patterns (host concretization under jit, Python RNG under
+trace, ``list.pop(0)``, off-lock engine-state mutation) cannot land
+silently.  Baselined findings carry a one-line justification each —
+grandfathering is explicit, never implicit.
+
+Usage::
+
+    python tools/tpu_lint.py --baseline tools/tpu_lint_baseline.json
+    python tools/tpu_lint.py --update-baseline   # rewrite the ratchet
+    python tools/tpu_lint.py --json              # machine-readable dump
+
+Exit 0 = clean against the baseline; 1 = new findings (each printed
+with rule id, path:line, severity and fix hint).  The linter is loaded
+standalone (stdlib-only, no jax import) so the gate stays well inside
+the tier-1 lane's < 10 s budget; tests/test_tools.py runs main() next
+to metrics_smoke.
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "tpu_lint_baseline.json")
+DEFAULT_ROOT = os.path.join(REPO, "paddle_tpu")
+
+
+def _load_lint():
+    """Load the linter WITHOUT importing the paddle_tpu package (which
+    would pull in jax and blow the time budget)."""
+    path = os.path.join(REPO, "paddle_tpu", "analysis", "lint.py")
+    spec = importlib.util.spec_from_file_location("_tpu_lint_impl", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod    # dataclasses resolve via sys.modules
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="tpu_lint.py",
+        description="TPU anti-pattern lint gate (ratcheted baseline)")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="ratchet file (default: tools/"
+                             "tpu_lint_baseline.json)")
+    parser.add_argument("--root", default=DEFAULT_ROOT,
+                        help="tree to lint (default: paddle_tpu/)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                             "(existing justifications are preserved)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings dump")
+    args = parser.parse_args(sys.argv[1:] if argv is None else list(argv))
+
+    lint = _load_lint()
+    findings = lint.lint_paths(args.root, rel_to=REPO)
+    lint.publish(findings)          # no-op standalone, live in-process
+
+    if args.update_baseline:
+        lint.save_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}; "
+              f"fill in each TODO justification before committing (the "
+              f"gate rejects the placeholder)")
+        return 0
+
+    baseline = lint.load_baseline(args.baseline)
+    new, stale = lint.diff_against_baseline(findings, baseline)
+    unjustified = lint.unjustified_entries(baseline)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.to_dict() for f in new],
+            "stale_baseline": stale,
+            "unjustified_baseline": unjustified}, indent=2))
+    else:
+        for f in new:
+            print(f"NEW  {f}")
+            if f.hint:
+                print(f"     fix: {f.hint}")
+        for e in stale:
+            print(f"STALE baseline entry (fixed? remove it): "
+                  f"{e.get('rule_id')} {e.get('path')} "
+                  f"[{e.get('scope')}] {e.get('code')}")
+        for e in unjustified:
+            print(f"UNJUSTIFIED baseline entry: {e.get('rule_id')} "
+                  f"{e.get('path')} [{e.get('scope')}] {e.get('code')}")
+        print(f"tpu_lint: {len(findings)} finding(s) total, "
+              f"{len(baseline)} baselined, {len(new)} new, "
+              f"{len(stale)} stale, {len(unjustified)} unjustified")
+    if new:
+        print("FAIL: new lint findings — fix them or (with a one-line "
+              "justification) add them via --update-baseline",
+              file=sys.stderr)
+        return 1
+    if unjustified:
+        print("FAIL: baseline entries still carry the TODO placeholder "
+              "— grandfathering must be justified, never silent",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
