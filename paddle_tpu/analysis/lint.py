@@ -86,7 +86,7 @@ _MUTATOR_METHODS = {"append", "appendleft", "extend", "extendleft",
 LOCK_CLASSES: Dict[str, Tuple[str, frozenset]] = {
     "ContinuousBatchingEngine": ("_cond", frozenset({
         "_queue", "_active", "_reserved_pages", "_next_seq", "_stop",
-        "steps"})),
+        "_draining", "_admitting", "steps"})),
 }
 
 

@@ -15,6 +15,7 @@ import re
 import signal
 import sys
 import threading
+import warnings
 from typing import Callable, List, Optional
 
 from .. import monitor
@@ -30,6 +31,10 @@ _ckpts_saved_total = monitor.counter(
     "checkpoints_saved_total", "checkpoints written")
 _ckpt_last_step = monitor.gauge(
     "checkpoint_last_step", "step of the newest checkpoint written")
+_cb_errors_total = monitor.counter(
+    "preemption_callback_errors_total",
+    "preemption callbacks that raised (ISSUE 4: swallowed silently "
+    "before — a failed drain/checkpoint hook must be visible)")
 
 __all__ = [
     "PreemptionHandler", "save_checkpoint", "latest_checkpoint",
@@ -63,8 +68,15 @@ class PreemptionHandler:
         for cb in self._callbacks:
             try:
                 cb()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — one bad callback
+                # must not starve the rest, but neither may it vanish:
+                # count it and name the offender
+                _cb_errors_total.inc()
+                name = getattr(cb, "__qualname__",
+                               getattr(cb, "__name__", repr(cb)))
+                warnings.warn(
+                    f"preemption callback {name} raised {e!r}; "
+                    "continuing with remaining callbacks")
 
     def on_preemption(self, cb: Callable[[], None]) -> None:
         self._callbacks.append(cb)

@@ -77,6 +77,12 @@ class CommTaskManager:
         self._thread: Optional[threading.Thread] = None
         self._timeout_handler: Optional[Callable[[CommTask], None]] = None
         self._flagged: set = set()
+        # liveness probes (ISSUE 4): name -> (age_fn, timeout).  age_fn
+        # returns seconds the probed work has been in flight, or None
+        # while idle — a wedged serving decode step registers here so it
+        # fires the SAME timeout machinery as a hung collective
+        self._heartbeats: Dict[int, tuple] = {}
+        self._hb_flagged: set = set()
 
     @classmethod
     def instance(cls) -> "CommTaskManager":
@@ -120,6 +126,27 @@ class CommTaskManager:
         with self._lock:
             return list(self._tasks.values())
 
+    # ------------------------------------------------------- heartbeats
+    def register_heartbeat(self, name: str, age_fn: Callable[[], Optional[float]],
+                           timeout: Optional[float] = None) -> int:
+        """Register a liveness probe scanned alongside the comm tasks.
+        ``age_fn() -> seconds`` the probed work has been in flight (None
+        = idle, never flagged).  When the age exceeds ``timeout`` the
+        standard timeout machinery fires (``comm_timeouts_total``,
+        handler/warn/abort); the probe re-arms once it reports healthy
+        again.  Returns a handle for :meth:`unregister_heartbeat`."""
+        t = get_flag("comm_timeout_seconds") if timeout is None else timeout
+        with self._lock:
+            self._seq += 1
+            hid = self._seq
+            self._heartbeats[hid] = (name, age_fn, t)
+        return hid
+
+    def unregister_heartbeat(self, hid: int) -> None:
+        with self._lock:
+            self._heartbeats.pop(hid, None)
+            self._hb_flagged.discard(hid)
+
     def _scan_loop(self) -> None:
         while not self._stop.wait(self._scan_interval):
             now = time.monotonic()
@@ -132,6 +159,26 @@ class CommTaskManager:
                 _oldest_task_age.set(
                     max((now - t.started_at
                          for t in self._tasks.values()), default=0.0))
+                beats = list(self._heartbeats.items())
+            for hid, (name, age_fn, timeout) in beats:
+                try:
+                    age = age_fn()
+                except Exception:       # noqa: BLE001 — probe must not
+                    continue            # kill the watchdog thread
+                if age is not None and age > timeout:
+                    fire = False
+                    with self._lock:
+                        if hid not in self._hb_flagged \
+                                and hid in self._heartbeats:
+                            self._hb_flagged.add(hid)
+                            fire = True
+                    if fire:
+                        stale = CommTask(name, timeout)
+                        stale.started_at = now - age
+                        hung.append((None, stale))
+                else:
+                    with self._lock:
+                        self._hb_flagged.discard(hid)
             _heartbeat_ts.set(time.time())
             for tid, t in hung:
                 self._on_timeout(t)

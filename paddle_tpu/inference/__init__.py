@@ -405,8 +405,12 @@ from .paged import PagedGenerator  # noqa: E402,F401
 __all__ += ["paged", "PagedGenerator"]
 
 from . import continuous  # noqa: E402,F401  (continuous batching engine)
-from .continuous import ContinuousBatchingEngine  # noqa: E402,F401
-__all__ += ["continuous", "ContinuousBatchingEngine"]
+from .continuous import (  # noqa: E402,F401
+    ContinuousBatchingEngine, DeadlineExceeded, EngineDraining,
+    EngineSaturated, RequestCancelled,
+)
+__all__ += ["continuous", "ContinuousBatchingEngine", "EngineSaturated",
+            "EngineDraining", "DeadlineExceeded", "RequestCancelled"]
 
 from . import speculative  # noqa: E402,F401  (draft-verify decoding)
 from .speculative import SpeculativeGenerator  # noqa: E402,F401

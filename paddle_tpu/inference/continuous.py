@@ -19,6 +19,26 @@ lock; this engine admits each sequence independently:
 Batch shapes are bucketed to powers of two (padding rows ride on a
 scratch sequence that is truncated every step) so the decode step
 compiles once per bucket, not once per active-count.
+
+Resilience layer (ISSUE 4):
+
+  * request lifecycle — per-request deadlines (queue-wait +
+    total TTL), cooperative ``cancel()`` honored at admission and
+    between decode steps, and a bounded admission queue whose overflow
+    raises :class:`EngineSaturated` (HTTP 429 at the server);
+  * graceful drain — ``drain()`` stops new submissions, finishes
+    everything already submitted, then reclaims the pool and stops the
+    scheduler (``stop()`` stays the hard kill);
+  * failure isolation — a failing prefill errors only its request; a
+    failing decode step is retried once and then BISECTED (solo replay
+    at size 1) to eject exactly the poisoned sequence(s) while the rest
+    of the batch keeps decoding;
+  * stall detection — an engine heartbeat registered with the comm
+    watchdog (``step_timeout_s``) fires the same timeout machinery as
+    a hung collective when a device step wedges;
+  * deterministic fault injection — the ``paddle_tpu.testing.faults``
+    sites ``prefill`` / ``decode_step`` / ``page_alloc`` are consulted
+    at near-zero cost when no plan is installed.
 """
 from __future__ import annotations
 
@@ -30,10 +50,34 @@ from typing import Deque, List, Optional
 import numpy as np
 from .. import monitor
 from ..ops.pallas.paged_attention import PagedKVCache
+from ..testing import faults as _faults
 
-__all__ = ["ContinuousBatchingEngine"]
+__all__ = [
+    "ContinuousBatchingEngine", "EngineSaturated", "EngineDraining",
+    "DeadlineExceeded", "RequestCancelled",
+]
 
 _PAD_SEQ = "__pad__"
+
+
+class EngineSaturated(RuntimeError):
+    """The bounded admission queue is full — retryable later (the
+    GenerationServer maps this to HTTP 429 + Retry-After)."""
+
+
+class EngineDraining(RuntimeError):
+    """The engine is draining for graceful shutdown and accepts no new
+    submissions (HTTP 503; in-flight requests still complete)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's queue-wait or total TTL expired before completion
+    (HTTP 504); its pages/reservation were reclaimed."""
+
+
+class RequestCancelled(RuntimeError):
+    """The request was cooperatively cancelled via ``cancel()``."""
+
 
 # engine telemetry (ISSUE 1): the serving-side numbers the ROADMAP's
 # "serve heavy traffic" goal is judged by
@@ -68,13 +112,37 @@ _prefix_hit_tokens = monitor.counter(
 _sampling_on_device_g = monitor.gauge(
     "sampling_on_device", "1 when the engine samples inside the compiled "
     "step (host transfer is (batch,) ids), 0 on the host-logits path")
+# resilience telemetry (ISSUE 4): failure isolation + lifecycle + the
+# serving heartbeat the watchdog scans
+_decode_retries = monitor.counter(
+    "decode_retries_total", "decode-step re-executions after a failure "
+    "(one whole-batch retry, then one per bisection probe)")
+_quarantined = monitor.counter(
+    "quarantined_requests_total", "requests ejected by failure "
+    "isolation: failed prefill, or poisoned sequence identified by "
+    "decode-step bisection")
+_expired_total = monitor.counter(
+    "requests_expired_total", "requests retired by deadline expiry "
+    "(queue-wait or total TTL)")
+_cancelled_total = monitor.counter(
+    "requests_cancelled_total", "requests retired by cooperative "
+    "cancel()")
+_saturated_total = monitor.counter(
+    "engine_saturated_total", "submissions rejected because the bounded "
+    "admission queue was full")
+_last_step_ts = monitor.gauge(
+    "engine_last_step_timestamp_seconds", "unix time the engine last "
+    "completed a prefill or decode step — the serving heartbeat")
+_draining_g = monitor.gauge(
+    "engine_draining", "1 while the engine is draining for graceful "
+    "shutdown, else 0")
 
 
 class _Request:
     """One sequence's life in the engine."""
 
     def __init__(self, prompt, max_new_tokens, eos_token_id, do_sample,
-                 temperature, seed):
+                 temperature, seed, ttl_s=None, queue_timeout_s=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
@@ -91,14 +159,64 @@ class _Request:
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        # lifecycle (ISSUE 4): deadlines are absolute perf_counter
+        # instants; the scheduler reaps at admission and between steps
+        self.ttl_s = ttl_s
+        self.queue_timeout_s = queue_timeout_s
+        self.deadline = (None if ttl_s is None
+                         else self.submitted_at + float(ttl_s))
+        self.queue_deadline = (
+            None if queue_timeout_s is None
+            else self.submitted_at + float(queue_timeout_s))
+        self._cancel = threading.Event()
 
     @property
     def output_ids(self) -> np.ndarray:
         return np.concatenate(
             [self.prompt, np.asarray(self.generated, np.int32)])
 
-    def result(self, timeout=None) -> np.ndarray:
+    def cancel(self) -> bool:
+        """Cooperative cancel: honored before admission and between
+        decode steps (an in-flight compiled step finishes first).  The
+        request's pages and reservation are reclaimed when the
+        scheduler reaps it; waiters get :class:`RequestCancelled`.
+        Returns False if the request had already finished."""
+        already_done = self.done.is_set()
+        self._cancel.set()
+        return not already_done
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    def _lifecycle_error(self, now: float,
+                         queued: bool) -> Optional[BaseException]:
+        """The error this request should retire with right now, or
+        None while it is still live."""
+        if self._cancel.is_set():
+            return RequestCancelled("request cancelled")
+        if self.deadline is not None and now > self.deadline:
+            return DeadlineExceeded(
+                f"request exceeded its {float(self.ttl_s):.3f}s TTL")
+        if queued and self.queue_deadline is not None \
+                and now > self.queue_deadline:
+            return DeadlineExceeded(
+                f"request waited past its {float(self.queue_timeout_s):.3f}s "
+                "queue-wait deadline without being admitted")
+        return None
+
+    def result(self, timeout=None, cancel_on_timeout: bool = True
+               ) -> np.ndarray:
+        """Wait for the generation.  On timeout the request is
+        CANCELLED by default (``cancel_on_timeout=False`` keeps it
+        running) so an abandoned wait does not leave the sequence
+        decoding — and holding pool pages — forever."""
         if not self.done.wait(timeout):
+            if cancel_on_timeout:
+                self.cancel()
+                raise TimeoutError(
+                    "generation still running; request cancelled "
+                    "(pass cancel_on_timeout=False to keep it)")
             raise TimeoutError("generation still running")
         if self.error is not None:
             raise self.error
@@ -118,16 +236,30 @@ class ContinuousBatchingEngine:
     page-aligned prefix KV resident (refcounted, LRU-evicted under
     pool pressure) so a request sharing a cached prefix maps those
     pages read-only and prefills only its suffix.
+
+    Resilience knobs (ISSUE 4): ``max_queue`` bounds the admission
+    queue (overflow raises :class:`EngineSaturated`);
+    ``default_ttl_s`` / ``default_queue_timeout_s`` set engine-wide
+    deadlines each ``submit`` may override; ``step_timeout_s``
+    registers a heartbeat with the comm watchdog so a wedged device
+    step fires ``comm_timeouts_total`` like a hung collective.
     """
 
     def __init__(self, model, total_pages: int = 512, page_size: int = 16,
                  max_batch: int = 8, sample_on_device: bool = True,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, max_queue: int = 256,
+                 default_ttl_s: Optional[float] = None,
+                 default_queue_timeout_s: Optional[float] = None,
+                 step_timeout_s: Optional[float] = None):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_position = int(model.config.max_position_embeddings)
         self.sample_on_device = bool(sample_on_device)
         self.prefix_cache = bool(prefix_cache)
+        self.max_queue = int(max_queue)
+        self.default_ttl_s = default_ttl_s
+        self.default_queue_timeout_s = default_queue_timeout_s
+        self.step_timeout_s = step_timeout_s
         _sampling_on_device_g.set(int(self.sample_on_device))
         # runtime mirror of the analysis auditor's recompile rules:
         # every XLA compile the decode loop triggers shows up in
@@ -147,19 +279,41 @@ class ContinuousBatchingEngine:
         self._reserved_pages = 1               # headroom for the pad page
         self._queue: Deque[_Request] = deque()
         self._active: List[_Request] = []
+        # admitted-but-not-yet-active (mid-prefill) count: drain() must
+        # see these — they are neither queued nor active for a moment
+        self._admitting = 0
         self._cond = threading.Condition()
         self._stop = False
+        self._draining = False
         self._next_seq = 0
         self.steps = 0                          # decode steps executed
+        # stall detection (ISSUE 4): while a compiled step is in flight
+        # this holds its start instant; the watchdog heartbeat reports
+        # its age so a wedged step trips the comm timeout machinery
+        self._step_started_at: Optional[float] = None
+        self._hb_id: Optional[int] = None
+        if step_timeout_s is not None:
+            from ..distributed.watchdog import CommTaskManager
+            mgr = CommTaskManager.instance()
+            self._hb_id = mgr.register_heartbeat(
+                "engine/decode_step", self._step_age,
+                float(step_timeout_s))
+            mgr.start()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     # ------------------------------------------------------------- public
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None, do_sample: bool = False,
-               temperature: float = 1.0, seed: int = 0) -> _Request:
+               temperature: float = 1.0, seed: int = 0,
+               ttl_s: Optional[float] = None,
+               queue_timeout_s: Optional[float] = None) -> _Request:
         req = _Request(prompt, max_new_tokens, eos_token_id, do_sample,
-                       temperature, seed)
+                       temperature, seed,
+                       ttl_s=self.default_ttl_s if ttl_s is None else ttl_s,
+                       queue_timeout_s=(self.default_queue_timeout_s
+                                        if queue_timeout_s is None
+                                        else queue_timeout_s))
         total = len(req.prompt) + req.max_new_tokens
         if total > self.max_position:
             # past the rope table the gather would silently clamp and
@@ -173,8 +327,17 @@ class ContinuousBatchingEngine:
                 f"request needs {need} pages but the pool holds "
                 f"{self.cache.total_pages} total; grow total_pages")
         with self._cond:
+            if self._draining:
+                raise EngineDraining(
+                    "engine is draining or drained; not accepting new "
+                    "requests")
             if self._stop:
                 raise RuntimeError("engine stopped")
+            if len(self._queue) >= self.max_queue:
+                _saturated_total.inc()
+                raise EngineSaturated(
+                    f"admission queue is full ({self.max_queue} "
+                    "requests); retry later")
             self._queue.append(req)
             _queue_depth.set(len(self._queue))
             self._cond.notify_all()
@@ -183,14 +346,24 @@ class ContinuousBatchingEngine:
     def generate(self, input_ids, max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
-                 seed: int = 0):
+                 seed: int = 0, ttl_s: Optional[float] = None):
         """Blocking batch API (PagedGenerator-compatible): submits each
-        row as its own sequence and eos-pads rows to a common length."""
+        row as its own sequence and eos-pads rows to a common length.
+        If any row fails to submit or errors, the other rows are
+        CANCELLED so a rejected batch never leaves orphan sequences
+        decoding against the pool."""
         ids = np.asarray(input_ids, np.int32)
-        reqs = [self.submit(row, max_new_tokens, eos_token_id, do_sample,
-                            temperature, seed + i)
-                for i, row in enumerate(ids)]
-        rows = [r.result() for r in reqs]
+        reqs: List[_Request] = []
+        try:
+            for i, row in enumerate(ids):
+                reqs.append(self.submit(row, max_new_tokens, eos_token_id,
+                                        do_sample, temperature, seed + i,
+                                        ttl_s=ttl_s))
+            rows = [r.result() for r in reqs]
+        except BaseException:
+            for r in reqs:
+                r.cancel()
+            raise
         width = max(len(r) for r in rows)
         pad = 0 if eos_token_id is None else eos_token_id
         out = np.full((len(rows), width), pad, np.int32)
@@ -198,11 +371,50 @@ class ContinuousBatchingEngine:
             out[i, :len(r)] = r
         return out
 
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop accepting NEW submissions, let every
+        already-submitted request (queued and active) run to
+        completion, then stop the scheduler thread — the pool reclaims
+        to idle as the last sequence retires.  Returns True when fully
+        drained; False if ``timeout`` elapsed first (the engine keeps
+        draining — call again, or escalate to ``stop()``)."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + float(timeout))
+        with self._cond:
+            self._draining = True
+            _draining_g.set(1)
+            self._cond.notify_all()
+            while self._queue or self._active or self._admitting:
+                if self._stop:
+                    # a concurrent hard stop() preempted the drain: the
+                    # remaining requests were ERRORED, not completed —
+                    # never report that as a successful drain
+                    return False
+                wait = 0.5
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        return False
+                self._cond.wait(wait)
+        self.stop()
+        _draining_g.set(0)
+        return True
+
     def stop(self):
+        """Hard stop: errors whatever is still queued/active.  Use
+        :meth:`drain` for the graceful path."""
         with self._cond:
             self._stop = True
             self._cond.notify_all()
         self._thread.join(timeout=10)
+        if self._hb_id is not None:
+            from ..distributed.watchdog import CommTaskManager
+            CommTaskManager.instance().unregister_heartbeat(self._hb_id)
+            self._hb_id = None
 
     def __enter__(self):
         return self
@@ -212,9 +424,63 @@ class ContinuousBatchingEngine:
         return False
 
     # ---------------------------------------------------------- scheduler
+    def _step_age(self) -> Optional[float]:
+        """Watchdog heartbeat probe: seconds the current compiled step
+        has been in flight, or None while idle (never flagged)."""
+        t0 = self._step_started_at
+        return None if t0 is None else time.monotonic() - t0
+
     def _pages_for(self, req) -> int:
         ps = self.cache.page_size
         return -(-(len(req.prompt) + req.max_new_tokens) // ps)
+
+    def _reap_locked(self) -> List[_Request]:
+        """Caller holds ``self._cond``.  Retire queued and active
+        requests that were cancelled or whose deadline passed — their
+        pages and reservations are reclaimed here, so an abandoned
+        request can never hold pool capacity past its TTL.  Returns the
+        reaped requests; the caller sets their ``done`` events outside
+        the lock."""
+        now = time.perf_counter()
+        out: List[_Request] = []
+        if self._queue:
+            keep: Deque[_Request] = deque()
+            for r in self._queue:
+                err = r._lifecycle_error(now, queued=True)
+                if err is None:
+                    keep.append(r)
+                else:
+                    r.error = err
+                    self._count_lifecycle(err)
+                    out.append(r)
+            if len(keep) != len(self._queue):
+                self._queue = keep
+                _queue_depth.set(len(keep))
+        if self._active:
+            still: List[_Request] = []
+            for r in self._active:
+                err = r._lifecycle_error(now, queued=False)
+                if err is None:
+                    still.append(r)
+                else:
+                    r.error = err
+                    self._count_lifecycle(err)
+                    self._retire_locked(r)
+                    out.append(r)
+            self._active = still
+            if not still:
+                # everything reaped: the pad scratch page goes back too
+                self.cache.free(_PAD_SEQ)
+        if out:
+            self._cond.notify_all()
+        return out
+
+    @staticmethod
+    def _count_lifecycle(err: BaseException) -> None:
+        if isinstance(err, RequestCancelled):
+            _cancelled_total.inc()
+        else:
+            _expired_total.inc()
 
     def _pop_admissible_locked(self) -> List[_Request]:
         """Caller holds ``self._cond`` (the ``_locked`` suffix is the
@@ -274,15 +540,21 @@ class ContinuousBatchingEngine:
         k = req.prefix_tokens
         sampling = (self._sampling_for([req], [len(req.prompt)])
                     if self.sample_on_device else None)
-        with monitor.span("engine/prefill", histogram=_prefill_s):
-            if k:
-                out = self._decoder.prefix_prefill(
-                    self.cache, [req.seq_id], req.prompt[None, k:],
-                    prefix_tokens=k, bucket=True, sampling=sampling)
-            else:
-                out = self._decoder.prefill(
-                    self.cache, [req.seq_id], req.prompt[None],
-                    bucket=True, sampling=sampling)
+        self._step_started_at = time.monotonic()
+        try:
+            _faults.maybe_fire("prefill", seq_ids=[req.seq_id])
+            with monitor.span("engine/prefill", histogram=_prefill_s):
+                if k:
+                    out = self._decoder.prefix_prefill(
+                        self.cache, [req.seq_id], req.prompt[None, k:],
+                        prefix_tokens=k, bucket=True, sampling=sampling)
+                else:
+                    out = self._decoder.prefill(
+                        self.cache, [req.seq_id], req.prompt[None],
+                        bucket=True, sampling=sampling)
+        finally:
+            self._step_started_at = None
+        _last_step_ts.set(time.time())
         if self.prefix_cache:
             _prefix_lookups.inc()
             if k:
@@ -312,25 +584,31 @@ class ContinuousBatchingEngine:
         released = self.cache.free(req.seq_id)
         self._reserved_pages -= slack + released
         req.finished_at = time.perf_counter()
-        _gen_latency_s.observe(req.finished_at - req.submitted_at)
+        if req.error is None:
+            _gen_latency_s.observe(req.finished_at - req.submitted_at)
 
     def _bucket(self, n: int) -> int:
         from .paged import next_pow2
         return min(next_pow2(n), self.max_batch)
 
-    def _decode_step(self):
-        """One token for every active sequence, padded to a bucket."""
-        active = self._active
-        B = self._bucket(len(active))
-        npad = B - len(active)
-        # the new token enters the sequence now: record it first so its
-        # rope position (== current length) is read before the write
+    # ------------------------------------------------- decode + isolation
+    def _exec_step(self, reqs) -> List[np.ndarray]:
+        """Run ONE compiled decode step for ``reqs`` (all of, or a
+        bisected subset of, the active batch), padded to a bucket.
+        Tokens, positions and sampling counters are derived from
+        request/cache state — a rolled-back step therefore replays
+        IDENTICALLY (same threefry counters → same draws), which the
+        retry/bisect recovery depends on.  Returns one output row per
+        request (sampled token id, or the logits row)."""
+        B = self._bucket(len(reqs))
+        npad = B - len(reqs)
+        # the new token enters the sequence now: its rope position
+        # (== current length) is read before the write
         tokens = np.zeros((B, 1), np.int32)
         pos = np.zeros(B, np.int32)
         seq_ids = []
-        for i, r in enumerate(active):
-            r.generated.append(r.next_token)
-            tokens[i, 0] = r.next_token
+        for i, r in enumerate(reqs):
+            tokens[i, 0] = r.generated[-1]
             pos[i] = self.cache.length(r.seq_id)
             seq_ids.append(r.seq_id)       # decoder.step allocates pages
         # pad rows: a scratch sequence rewrites its slot 0 every step;
@@ -344,6 +622,87 @@ class ContinuousBatchingEngine:
             self.cache.truncate(_PAD_SEQ, 0)
             self.cache.allocate(_PAD_SEQ, 1)   # no-op while already held
             seq_ids.extend([_PAD_SEQ] * npad)
+        sampling = (self._sampling_for(reqs, pos + 1)
+                    if self.sample_on_device else None)
+        # ONE compiled program per step attempt for the whole subset
+        # (per-row positions, pools donated through the step); with
+        # on-device sampling the result is (B,) token ids — the only
+        # per-step device->host transfer
+        self._step_started_at = time.monotonic()
+        try:
+            _faults.maybe_fire("decode_step", seq_ids=seq_ids[:len(reqs)])
+            with monitor.span("engine/decode_step",
+                              histogram=_decode_step_s):
+                out_np = self._decoder.step(self.cache, seq_ids, tokens,
+                                            pos, sampling=sampling)
+        finally:
+            self._step_started_at = None
+        _last_step_ts.set(time.time())
+        return [out_np[i] for i in range(len(reqs))]
+
+    def _rollback_step(self, reqs, lens_before) -> None:
+        """Restore pre-step cache lengths after a failed attempt (the
+        decoder also rolls back its own advance; this covers faults
+        fired before the decoder ran).  Pages stay mapped — they are
+        inside the admission reservation and the replay rewrites their
+        slots."""
+        for r in reqs:
+            self.cache.truncate(r.seq_id, lens_before[r.seq_id])
+
+    def _step_isolated(self, reqs, lens_before):
+        """(survivors, rows, poisoned) for one logical decode step:
+        try the whole batch; on failure retry once (transient faults —
+        the common TPU case after a preemption blip), then bisect to
+        isolate the poisoned sequence(s) instead of erroring everyone
+        (the old ``_fail_all`` blast radius)."""
+        try:
+            return reqs, self._exec_step(reqs), []
+        except BaseException as e:  # noqa: BLE001 — classified below
+            self._rollback_step(reqs, lens_before)
+            _decode_retries.inc()
+            try:
+                return reqs, self._exec_step(reqs), []
+            except BaseException as e2:  # noqa: BLE001
+                self._rollback_step(reqs, lens_before)
+                return self._bisect_step(reqs, lens_before, e2)
+
+    def _bisect_step(self, reqs, lens_before, error):
+        """Deterministic fault isolation: halve the failing batch and
+        replay each half (solo replay at size 1).  Healthy halves
+        advance their token normally; a size-1 failure quarantines that
+        request with the error that killed it.  O(k·log n) extra step
+        attempts for k poisoned sequences in a batch of n."""
+        if len(reqs) == 1:
+            r = reqs[0]
+            r.error = error
+            _quarantined.inc()
+            return [], [], [r]
+        mid = (len(reqs) + 1) // 2
+        survivors, rows, poisoned = [], [], []
+        for half in (reqs[:mid], reqs[mid:]):
+            try:
+                _decode_retries.inc()
+                half_rows = self._exec_step(half)
+            except BaseException as e:  # noqa: BLE001
+                self._rollback_step(half, lens_before)
+                s, o, p = self._bisect_step(half, lens_before, e)
+                survivors.extend(s)
+                rows.extend(o)
+                poisoned.extend(p)
+            else:
+                survivors.extend(half)
+                rows.extend(half_rows)
+        return survivors, rows, poisoned
+
+    def _decode_step(self):
+        """One token for every active sequence, padded to a bucket;
+        failures are isolated per sequence (retry, then bisect) rather
+        than erroring the whole batch."""
+        active = self._active
+        lens_before = {r.seq_id: self.cache.length(r.seq_id)
+                       for r in active}
+        for r in active:
+            r.generated.append(r.next_token)
         _active_seqs.set(len(active))
         _batch_occupancy.observe(len(active) / self.max_batch)
         # the gauge is process-global (last constructor wins), so the
@@ -352,34 +711,30 @@ class ContinuousBatchingEngine:
         # engine (bench baseline, parity test) was built in-process
         _sampling_on_device_g.set(int(self.sample_on_device))
         on_device = self.sample_on_device
-        sampling = (self._sampling_for(active, pos + 1) if on_device
-                    else None)
-        # ONE compiled program per decode step for the whole running
-        # batch (per-row positions, pools donated through the step);
-        # with on-device sampling the result is (B,) token ids — the
-        # only per-step device->host transfer
-        with monitor.span("engine/decode_step", histogram=_decode_step_s):
-            out_np = self._decoder.step(self.cache, seq_ids, tokens,
-                                        pos, sampling=sampling)
-        _tokens_total.inc(len(active))
+        survivors, rows, poisoned = self._step_isolated(active, lens_before)
+        _tokens_total.inc(len(survivors))
 
         # request-local state (r.*) is scheduler-thread-owned: decide
         # retirements and sample next tokens OUTSIDE the lock, then take
         # the lock for the shared-state transition (pages/reservations/
         # active list) — the discipline tpu_lint TPL004 enforces
         still, retired = [], []
-        for i, r in enumerate(active):
+        for r, row in zip(survivors, rows):
             eos_hit = (r.eos_token_id is not None
                        and r.generated[-1] == r.eos_token_id)
             if eos_hit or len(r.generated) >= r.max_new_tokens:
                 retired.append(r)
                 continue
-            r.next_token = (int(out_np[i]) if on_device
-                            else self._pick(r, out_np[i]))
+            r.next_token = int(row) if on_device else self._pick(r, row)
             still.append(r)
+        for r in poisoned:
+            # the token recorded for this step never executed
+            r.generated.pop()
         with self._cond:
             self.steps += 1
             for r in retired:
+                self._retire_locked(r)
+            for r in poisoned:
                 self._retire_locked(r)
             self._active = still
             if not still:
@@ -388,14 +743,19 @@ class ContinuousBatchingEngine:
                 # BEFORE waking the retired requests' waiters, who may
                 # assert exactly that
                 self.cache.free(_PAD_SEQ)
+            self._cond.notify_all()        # drain() waits on this
         _active_seqs.set(len(still))
         for r in retired:
             r.done.set()
+        for r in poisoned:
+            r.done.set()
 
     def _fail_all(self, exc, admitted):
-        """Error out every in-flight request WITHOUT leaking pool
-        capacity: sequences that already own pages are freed and their
-        reservations rolled back, so the engine stays usable."""
+        """LAST-RESORT scheduler-fault handler (isolation failed or the
+        fault was outside any step): error out every in-flight request
+        WITHOUT leaking pool capacity — sequences that already own
+        pages are freed and their reservations rolled back, so the
+        engine stays usable."""
         with self._cond:
             for r in self._active + admitted + list(self._queue):
                 if r.done.is_set():
@@ -414,8 +774,10 @@ class ContinuousBatchingEngine:
             self.cache.free(_PAD_SEQ)
             self._reserved_pages = 1          # only the pad headroom
             self._active, self._queue = [], deque()
+            self._admitting = 0
             _active_seqs.set(0)
             _queue_depth.set(0)
+            self._cond.notify_all()
 
     def _loop(self):
         while True:
@@ -428,13 +790,34 @@ class ContinuousBatchingEngine:
                         r.error = RuntimeError("engine stopped")
                         r.done.set()
                     return
+                reaped = self._reap_locked()
                 admitted = self._pop_admissible_locked()
+                self._admitting = len(admitted)
+            for r in reaped:
+                r.done.set()
             try:
+                # prefill each admitted request with per-request
+                # isolation (ISSUE 4): a poisoned prompt errors only
+                # itself — its batchmates prefill and decode on
+                failed = []
                 for req in admitted:           # device work: outside lock
-                    self._prefill(req)
+                    try:
+                        self._prefill(req)
+                    except BaseException as e:  # noqa: BLE001
+                        req.error = e
+                        failed.append(req)
                 with self._cond:
-                    self._active.extend(admitted)
+                    for r in failed:
+                        self._retire_locked(r)
+                    self._active.extend(
+                        r for r in admitted if r.error is None)
                     admitted = []
+                    self._admitting = 0
+                    if failed:
+                        self._cond.notify_all()
+                for r in failed:
+                    _quarantined.inc()
+                    r.done.set()
                 if self._active:
                     self._decode_step()
             except BaseException as e:  # noqa: BLE001 — fail loudly, not hang

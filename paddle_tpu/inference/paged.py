@@ -345,6 +345,35 @@ class JittedPagedDecoder:
             self.DONATE_ARGNUMS[mode]
 
     @staticmethod
+    def _recover_pools(cache):
+        """After a failed compiled call, rebuild the page pools ONLY if
+        the donated buffers were actually consumed (dispatch reached
+        the device/runtime).  A host-side failure before dispatch — a
+        planning bug, an injected fault, a shape error — leaves them
+        valid, and keeping them preserves every OTHER sequence's cached
+        KV and the prefix index: the quarantine machinery (ISSUE 4)
+        depends on a poisoned request not zeroing its batchmates'
+        state."""
+        def dead(a):
+            fn = getattr(a, "is_deleted", None)
+            try:
+                return bool(fn()) if callable(fn) else False
+            except Exception:   # noqa: BLE001 — treat unknown as dead
+                return True
+        if any(dead(a) for a in list(cache.k_pages) + list(cache.v_pages)):
+            cache.reset_pools()
+
+    def _rollback_lengths(self, cache, seq_ids, before):
+        """Undo this call's ``advance`` after a failed compiled step so
+        the sequences sit at their pre-call lengths and the SAME step
+        can be retried (ISSUE 4 failure isolation: the engine's
+        retry/bisect replays depend on this).  Pages allocated for the
+        call stay mapped — they are within the admission reservation
+        and the retry rewrites their slots."""
+        for sid, n in zip(seq_ids, before):
+            cache.truncate(sid, n)
+
+    @staticmethod
     def _sampling_args(sampling):
         if sampling is None:
             return False, ()
@@ -390,6 +419,7 @@ class JittedPagedDecoder:
             raise ValueError(
                 f"prompt length {s} exceeds max_position_embeddings "
                 f"({self.max_position})")
+        before = [cache.length(sid) for sid in seq_ids]
         for sid in seq_ids:
             cache.allocate(sid, s)
         pg, sl = cache.plan_write(seq_ids, s)
@@ -411,7 +441,8 @@ class JittedPagedDecoder:
                 jnp.asarray(last_idx), jnp.asarray(pg), jnp.asarray(sl),
                 s_args, tuple(cache.k_pages), tuple(cache.v_pages))
         except BaseException:
-            cache.reset_pools()
+            self._recover_pools(cache)
+            self._rollback_lengths(cache, seq_ids, before)
             raise
         cache.k_pages = list(k_pages)
         cache.v_pages = list(v_pages)
@@ -440,11 +471,13 @@ class JittedPagedDecoder:
             raise ValueError(
                 f"prompt length {k + s} exceeds max_position_embeddings "
                 f"({self.max_position})")
+        before = []
         for sid in seq_ids:
             if cache.length(sid) != k:
                 raise ValueError(
                     f"sequence {sid!r} is at length {cache.length(sid)}, "
                     f"expected the shared prefix length {k}")
+            before.append(cache.length(sid))
             cache.allocate(sid, s)
         pg, sl = cache.plan_write(seq_ids, s)
         cache.advance(seq_ids, s)
@@ -468,7 +501,8 @@ class JittedPagedDecoder:
                 jnp.asarray(plens), s_args,
                 tuple(cache.k_pages), tuple(cache.v_pages))
         except BaseException:
-            cache.reset_pools()
+            self._recover_pools(cache)
+            self._rollback_lengths(cache, seq_ids, before)
             raise
         cache.k_pages = list(k_pages)
         cache.v_pages = list(v_pages)
@@ -574,6 +608,7 @@ class JittedPagedDecoder:
             raise ValueError(
                 f"decode position {int(positions_np.max()) + 1} exceeds "
                 f"max_position_embeddings ({self.max_position})")
+        before = [cache.length(sid) for sid in seq_ids]
         for sid in seq_ids:
             cache.allocate(sid, 1)
         pg, sl = cache.plan_write(seq_ids, 1)
@@ -593,9 +628,12 @@ class JittedPagedDecoder:
         except BaseException:
             # the pools were DONATED: after a mid-step failure (e.g.
             # device OOM) they may be invalidated — rebuild them so the
-            # cache object stays usable (sequence KV is lost; callers
-            # fail the affected requests anyway)
-            cache.reset_pools()
+            # cache object stays usable, and roll the lengths back so
+            # the engine's retry/bisect can replay the exact step
+            # (sequence KV content is lost only if the program actually
+            # ran; a pre-dispatch failure leaves it intact)
+            self._recover_pools(cache)
+            self._rollback_lengths(cache, seq_ids, before)
             raise
         cache.k_pages = list(k_pages)
         cache.v_pages = list(v_pages)

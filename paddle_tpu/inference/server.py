@@ -49,18 +49,20 @@ class _JsonHandler(BaseHTTPRequestHandler):
                 and self._outer._access_log:
             BaseHTTPRequestHandler.log_message(self, fmt, *args)
 
-    def _reply(self, code, payload):
+    def _reply(self, code, payload, headers=None):
         body = json.dumps(payload).encode()
-        self._reply_bytes(code, body, "application/json")
+        self._reply_bytes(code, body, "application/json", headers)
 
     def _reply_text(self, code, text,
                     content_type="text/plain; version=0.0.4"):
         self._reply_bytes(code, text.encode(), content_type)
 
-    def _reply_bytes(self, code, body, content_type):
+    def _reply_bytes(self, code, body, content_type, headers=None):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -236,24 +238,45 @@ class GenerationServer(_ServerLifecycle):
     unless the request pins one.  The engine's hot-path knobs plumb
     through: ``sample_on_device`` (fused in-step sampling) and
     ``prefix_cache`` (shared-prompt-prefix KV reuse) — both on by
-    default.
+    default; so do the resilience knobs ``max_queue`` /
+    ``default_ttl_s`` / ``step_timeout_s`` (ISSUE 4), and a request
+    body may set ``timeout_s`` as its own total TTL.
 
-    Error mapping: 400 = malformed request, 503 = pool/capacity
-    exhaustion (retry later), 500 = unexpected server fault.
+    Error mapping (the resilience HTTP contract):
+      400 = malformed request (bad JSON/shape, or prompt +
+            max_new_tokens past the model's rope table);
+      429 = admission queue full (``EngineSaturated``) — retry after
+            the ``Retry-After`` header;
+      503 = pool/capacity exhaustion or draining (retry elsewhere);
+      504 = the request's deadline (TTL / queue-wait) expired;
+      500 = unexpected server fault.
+
+    Graceful drain: ``begin_drain()`` (or SIGTERM via
+    ``attach_preemption``) stops new admissions — fresh /generate
+    requests get 503 with ``"draining": true`` while in-flight
+    generations run to completion; /health reports the drain state.
     """
 
     def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
                  total_pages: int = 512, page_size: int = 16,
                  max_batch: int = 8, sample_on_device: bool = True,
-                 prefix_cache: bool = True, access_log: bool = False):
-        from .continuous import ContinuousBatchingEngine
+                 prefix_cache: bool = True, access_log: bool = False,
+                 max_queue: int = 256,
+                 default_ttl_s: Optional[float] = None,
+                 step_timeout_s: Optional[float] = None):
+        from .continuous import (ContinuousBatchingEngine,
+                                 DeadlineExceeded, EngineDraining,
+                                 EngineSaturated)
+        from ..testing import faults as _faults
 
         self._engine = ContinuousBatchingEngine(
             model, total_pages=total_pages, page_size=page_size,
             max_batch=max_batch, sample_on_device=sample_on_device,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, max_queue=max_queue,
+            default_ttl_s=default_ttl_s, step_timeout_s=step_timeout_s)
         self._count_lock = threading.Lock()
         self._request_count = 0
+        self._drain_thread: Optional[threading.Thread] = None
         self._init_stats(access_log)
         outer = self
 
@@ -265,8 +288,10 @@ class GenerationServer(_ServerLifecycle):
                 if self.path == "/health":
                     with self._track("/health"):
                         cache = outer._engine.cache
+                        draining = outer._engine.draining
                         self._reply(200, {
-                            "status": "ok",
+                            "status": "draining" if draining else "ok",
+                            "draining": draining,
                             "uptime_s": round(outer.uptime_s, 3),
                             "requests_total": outer.requests_served,
                             "free_pages": cache.free_pages,
@@ -293,6 +318,7 @@ class GenerationServer(_ServerLifecycle):
 
             def _do_generate(self):
                 try:
+                    _faults.maybe_fire("http_handler")
                     try:
                         req = self._read_json()
                         if not isinstance(req, dict):
@@ -306,6 +332,8 @@ class GenerationServer(_ServerLifecycle):
                         eos = req.get("eos_token_id")
                         do_sample = bool(req.get("do_sample", False))
                         temperature = float(req.get("temperature", 1.0))
+                        ttl = req.get("timeout_s")
+                        ttl = None if ttl is None else float(ttl)
                         with outer._count_lock:
                             outer._request_count += 1
                             seed = int(req.get("seed",
@@ -318,15 +346,27 @@ class GenerationServer(_ServerLifecycle):
                         out = outer._engine.generate(
                             ids, max_new_tokens=max_new, eos_token_id=eos,
                             do_sample=do_sample, temperature=temperature,
-                            seed=seed)
+                            seed=seed, ttl_s=ttl)
                     except ValueError as e:      # request-shape problems
+                        # e.g. prompt + max_new_tokens past the rope
+                        # table: the CLIENT's request is wrong — 400,
+                        # never the retryable 503 (regression-locked in
+                        # tests/test_engine_faults.py)
                         self._reply(400, {"error": str(e)})
                         return
                     self._reply(200, {
                         "output_ids": out.tolist(),
                         "new_tokens": int(out.shape[1] - ids.shape[1])})
+                except EngineSaturated as e:
+                    # bounded-queue overflow: retryable, with a hint
+                    self._reply(429, {"error": str(e)},
+                                headers={"Retry-After": "1"})
+                except EngineDraining as e:
+                    self._reply(503, {"error": str(e), "draining": True})
+                except DeadlineExceeded as e:
+                    self._reply(504, {"error": str(e)})
                 except RuntimeError as e:
-                    # capacity (page-pool/queue) exhaustion: retryable
+                    # capacity (page-pool) exhaustion: retryable
                     self._reply(503, {"error": str(e)})
                 except Exception as e:   # noqa: BLE001 — server fault
                     self._reply(500, {"error": str(e)})
@@ -336,9 +376,52 @@ class GenerationServer(_ServerLifecycle):
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
+    # ------------------------------------------------- graceful shutdown
+    @property
+    def draining(self) -> bool:
+        return self._engine.draining
+
+    def begin_drain(self, timeout: Optional[float] = None) -> None:
+        """Start a graceful drain WITHOUT blocking (idempotent): the
+        engine stops admitting — new /generate requests get 503 with
+        ``"draining": true`` and /health flips to ``"draining"`` —
+        while every in-flight generation runs to completion.  The HTTP
+        listener stays up throughout so clients can still poll /health
+        and /metrics."""
+        if self._drain_thread is not None and self._drain_thread.is_alive():
+            return
+        self._drain_thread = threading.Thread(
+            target=self._engine.drain, kwargs={"timeout": timeout},
+            name="server-drain", daemon=True)
+        self._drain_thread.start()
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        """Block until a begin_drain() started earlier finishes;
+        True if it completed within ``timeout``."""
+        t = self._drain_thread
+        if t is None:
+            eng = self._engine
+            with eng._cond:
+                return not (eng._active or eng._queue or eng._admitting)
+        t.join(timeout)
+        return not t.is_alive()
+
+    def attach_preemption(self, handler,
+                          drain_timeout: Optional[float] = None) -> None:
+        """Wire a distributed.fault_tolerance.PreemptionHandler: on
+        SIGTERM (the TPU pod preemption notice) the server begins a
+        graceful drain — the resilience contract's 'finish what you
+        admitted, reject what you have not' shutdown."""
+        def drain_on_preemption():
+            self.begin_drain(timeout=drain_timeout)
+        handler.on_preemption(drain_on_preemption)
+
     def stop(self):
         super().stop()
         self._engine.stop()
+        if self._drain_thread is not None:
+            self._drain_thread.join(timeout=5)
+            self._drain_thread = None
 
 
 def serve(model_prefix: str, host: str = "127.0.0.1", port: int = 8000,

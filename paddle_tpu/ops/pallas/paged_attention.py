@@ -38,6 +38,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import DEFAULT_MASK_VALUE, _use_pallas
+from ...testing import faults as _faults
 
 
 # ------------------------------------------------------------------ kernel
@@ -297,6 +298,7 @@ class PagedKVCache:
                 self._decref_idx(p)
 
     def _pop_free_page(self) -> int:
+        _faults.maybe_fire("page_alloc")
         if not self._free:
             self._evict_prefixes(1)
         if not self._free:

@@ -1,0 +1,10 @@
+"""paddle_tpu.testing — deterministic test harnesses for the runtime.
+
+``faults`` is the seeded fault-injection plan the serving engine and
+HTTP server consult (ISSUE 4): chaos tests and ``tools/serve_bench.py
+--fault-plan`` drive failures through the SAME code paths production
+failures take, at near-zero cost when no plan is installed.
+"""
+from . import faults  # noqa: F401
+
+__all__ = ["faults"]

@@ -100,6 +100,46 @@ class TestServeBench:
         assert out["ttft_p50_s"] is not None
         assert out["ttft_p99_s"] >= out["ttft_p50_s"]
         assert out["decode_steps"] > 0
+        # ISSUE 4 satellite (ROADMAP telemetry finding): warm-up now
+        # covers EVERY decode-batch bucket, so the measured window of
+        # the warm serving loop is compile-free — and main() gates on it
+        assert out["jit_recompiles"] == 0
+        assert out["failed_requests"] == 0
+
+    def test_fault_plan_lane_recovers(self, capsys):
+        # ISSUE 4: --fault-plan injects failures into the measured
+        # wave; the gate passes only if the blast radius stays inside
+        # the plan and throughput survives
+        sb = self._load()
+        plan = json.dumps({"rules": [
+            {"site": "prefill", "nth": 3},
+            {"site": "decode_step", "nth": 5},
+        ]})
+        assert sb.main(["--sharers=4", "--uniques=2",
+                        f"--fault-plan={plan}"]) == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert out["failed_requests"] == 1       # only the prefill poison
+        assert out["quarantined_requests"] == 1
+        assert out["decode_retries"] >= 1        # transient absorbed
+        assert out["tokens_per_sec"] > 0
+        assert out["fault_plan"] is not None
+
+
+class TestChaosSmoke:
+    """ISSUE 4 CI satellite: the resilience counters the README
+    documents must exist in monitor.snapshot() after a chaos run."""
+
+    def _load(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "chaos_smoke", os.path.join(REPO, "tools", "chaos_smoke.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_gate_passes(self):
+        assert self._load().main() == 0
 
 
 class TestTpuLintGate:

@@ -66,14 +66,22 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
               max_new_tokens: int = 8, system_tokens: int = 16,
               vocab: int = 64, hidden: int = 32, do_sample: bool = False,
               sample_on_device: bool = True,
-              prefix_cache: bool = True, seed: int = 0) -> dict:
+              prefix_cache: bool = True, seed: int = 0,
+              fault_plan=None) -> dict:
     """Run the mixed shared-prefix workload; return the metrics dict
     (everything monitor-sourced).  The tiny default model keeps the CI
     gate fast; ``--vocab``/``--hidden`` grow it so the host-boundary
-    cost the fused sampler removes is actually visible."""
+    cost the fused sampler removes is actually visible.
+
+    ``fault_plan`` (ISSUE 4): a ``paddle_tpu.testing.faults`` plan
+    (dict/JSON/FaultPlan) installed for the MEASURED wave only — the
+    chaos lane proving throughput recovers after injected failures,
+    with the quarantine/retry counters quoted from the same
+    ``monitor.snapshot()`` deltas as everything else."""
     import numpy as np
     from paddle_tpu import monitor
     from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+    from paddle_tpu.testing import faults
 
     # compile telemetry (ISSUE 3): the measured window of a warm serving
     # loop should show ZERO recompiles — a nonzero delta here means a
@@ -114,31 +122,53 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
                           do_sample=do_sample, temperature=0.8,
                           seed=n_sub[0])
 
+    MAX_BATCH = 4
+    failed = 0
     with ContinuousBatchingEngine(
-            model, total_pages=128, page_size=8, max_batch=4,
+            model, total_pages=128, page_size=8, max_batch=MAX_BATCH,
             sample_on_device=sample_on_device,
             prefix_cache=prefix_cache) as eng:
-        # unmeasured warm-up wave: compiles the cold-prefill, suffix
-        # (prefix-hit) prefill and every decode-batch bucket, and seeds
-        # the prefix cache with the system prompt
-        # (sequenced: the second sharer must be admitted AFTER the
-        # first's prefill registered the system prefix, or it misses
-        # and the suffix-prefill program stays uncompiled)
+        # unmeasured warm-up: compiles the cold-prefill and suffix
+        # (prefix-hit) prefill and seeds the prefix cache with the
+        # system prompt (sequenced: the second sharer must be admitted
+        # AFTER the first's prefill registered the system prefix, or it
+        # misses and the suffix-prefill program stays uncompiled)
         submit(eng, shared_prompt()).result(timeout=600)
         warm = [submit(eng, p)
                 for p in (shared_prompt(), unique_prompt())]
         for r in warm:
             r.result(timeout=600)
+        # ... then a full-batch wave so EVERY decode-batch bucket
+        # (1, 2, ..., max_batch) is compiled before the window opens:
+        # the waves above covered buckets 1-2, this one reaches
+        # max_batch while its stragglers retire through the lower
+        # buckets again — the measured window must show ZERO compiles
+        # (the ROADMAP telemetry finding this closes)
+        wave = [submit(eng, shared_prompt() if i % 2 == 0
+                       else unique_prompt()) for i in range(MAX_BATCH)]
+        for r in wave:
+            r.result(timeout=600)
 
         before = monitor.snapshot()
-        reqs = []
-        for i in range(max(sharers, uniques)):
-            if i < sharers:
-                reqs.append(submit(eng, shared_prompt()))
-            if i < uniques:
-                reqs.append(submit(eng, unique_prompt()))
-        for r in reqs:
-            r.result(timeout=600)
+        if fault_plan is not None:
+            fault_plan = faults.install(fault_plan)
+        try:
+            reqs = []
+            for i in range(max(sharers, uniques)):
+                if i < sharers:
+                    reqs.append(submit(eng, shared_prompt()))
+                if i < uniques:
+                    reqs.append(submit(eng, unique_prompt()))
+            for r in reqs:
+                try:
+                    r.result(timeout=600)
+                except Exception:   # noqa: BLE001 — poisoned by the plan
+                    if fault_plan is None:
+                        raise       # no plan: a failure is a real bug
+                    failed += 1
+        finally:
+            if fault_plan is not None:
+                faults.clear()
         after = monitor.snapshot()
 
     dec_b, dec_sum, dec_n = _hist_delta(before, after,
@@ -155,8 +185,17 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
                                 "prefix_cache_hit_tokens_total")
     return {
         "requests": len(reqs),
+        "failed_requests": failed,
         "sample_on_device": bool(sample_on_device),
         "prefix_cache": bool(prefix_cache),
+        # resilience lane (ISSUE 4): zero on a clean run; under a fault
+        # plan the quarantine/retry machinery's footprint
+        "fault_plan": (None if fault_plan is None
+                       else fault_plan.snapshot()),
+        "decode_retries": int(_counter_delta(
+            before, after, "decode_retries_total")),
+        "quarantined_requests": int(_counter_delta(
+            before, after, "quarantined_requests_total")),
         "tokens_per_sec": (tokens / dec_sum) if dec_sum > 0 else 0.0,
         "generated_tokens": int(tokens),
         "decode_steps": dec_n,
@@ -183,9 +222,23 @@ def _int_arg(argv, name, default):
                  if a.startswith(f"--{name}=")), default)
 
 
+def _fault_plan_arg(argv):
+    """--fault-plan=<inline JSON or @path> -> FaultPlan or None."""
+    spec = next((a.split("=", 1)[1] for a in argv
+                 if a.startswith("--fault-plan=")), None)
+    if spec is None:
+        return None
+    from paddle_tpu.testing.faults import FaultPlan
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            spec = f.read()
+    return FaultPlan.from_json(spec)
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     baseline = "--baseline" in argv
+    plan = _fault_plan_arg(argv)
     out = run_bench(sharers=_int_arg(argv, "sharers", 6),
                     uniques=_int_arg(argv, "uniques", 3),
                     system_tokens=_int_arg(argv, "system-tokens", 16),
@@ -194,14 +247,42 @@ def main(argv=None) -> int:
                     hidden=_int_arg(argv, "hidden", 32),
                     do_sample="--sample" in argv,
                     sample_on_device=not baseline,
-                    prefix_cache=not baseline)
+                    prefix_cache=not baseline,
+                    fault_plan=plan)
     print(json.dumps(out, sort_keys=True))
     if out["generated_tokens"] <= 0 or out["decode_steps"] <= 0:
         print("FAIL: bench decoded nothing", file=sys.stderr)
         return 1
+    if plan is None and out["failed_requests"] != 0:
+        print(f"FAIL: {out['failed_requests']} request(s) failed with no "
+              "fault plan installed", file=sys.stderr)
+        return 1
+    if plan is not None:
+        # chaos lane: the blast radius must stay inside the plan — at
+        # most one failed request per injected error rule, and the
+        # workload still produced throughput after the failures
+        budget = plan.error_rule_count()
+        if out["failed_requests"] > budget:
+            print(f"FAIL: {out['failed_requests']} failed requests for "
+                  f"{budget} injected error fault(s) — isolation leaked",
+                  file=sys.stderr)
+            return 1
+        if out["tokens_per_sec"] <= 0:
+            print("FAIL: no surviving throughput after injected faults",
+                  file=sys.stderr)
+            return 1
+        return 0
     if not baseline and out["prefix_hit_rate"] <= 0:
         print("FAIL: shared-prefix workload saw no prefix-cache hits",
               file=sys.stderr)
+        return 1
+    if out["jit_recompiles"] != 0:
+        # ROADMAP telemetry finding (ISSUE 4 satellite): warm-up covers
+        # every decode-batch bucket, so the measured window of a warm
+        # serving loop must be compile-free
+        print(f"FAIL: measured window compiled "
+              f"{out['jit_recompiles']} program(s); warm-up missed a "
+              "bucket", file=sys.stderr)
         return 1
     return 0
 
