@@ -19,6 +19,13 @@ bad programs or wedge the serving hot path:
           class; helpers named ``*_locked`` assert they are called
           under the lock and are exempt, as is ``__init__`` which runs
           before the thread starts).
+  TPL005  per-step host sync inside a training loop — ``float()`` /
+          ``.item()`` / ``np.asarray()`` on step results executed
+          unconditionally in a loop over a loader/batch source (or in
+          a function such a loop body calls, one level deep)
+          serializes every step on a device round-trip.  Reads gated
+          behind an ``if`` (log/epoch boundaries) are the sanctioned
+          pattern and exempt.
 
 Scope detection is LEXICAL and per-file: a function counts as jitted
 when it is decorated with ``jax.jit``/``functools.partial(jax.jit,
@@ -68,6 +75,11 @@ RULES: Dict[str, Tuple[str, str, str]] = {
                "engine state mutated outside the scheduler lock",
                "mutate under `with self._cond:` or move the mutation "
                "into a *_locked helper only called under the lock"),
+    "TPL005": (SEVERITY_ERROR,
+               "per-step host sync inside a training loop",
+               "keep step results device-resident (async dispatch) and "
+               "force them only at log/epoch boundaries — gate the read "
+               "behind a boundary condition"),
 }
 
 _CONCRETIZE_BUILTINS = {"float", "int", "bool"}
@@ -295,11 +307,169 @@ class _Linter(ast.NodeVisitor):
             self._emit("TPL002", node, f"{dotted}()")
 
 
+# -------------------------------------------- TPL005: training-loop sync
+#: substrings a ``for`` loop's iterable source must mention to count as
+#: a training loop (``for step, batch in enumerate(loader)`` and its
+#: sampler/dataset variants)
+_LOOP_SOURCES = ("loader", "batch", "dataset", "train_data", "eval_data")
+_SYNC_BUILTINS = {"float"}
+_SYNC_METHODS = {"item", "numpy", "tolist"}
+
+
+def _scope_walk(node, scope, on_loop):
+    """Recursive walk tracking the qualified scope; calls ``on_loop``
+    for every For/While statement with its enclosing scope."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            _scope_walk(child, scope + [child.name], on_loop)
+        else:
+            if isinstance(child, (ast.For, ast.While)):
+                on_loop(child, scope)
+            _scope_walk(child, scope, on_loop)
+
+
+def _function_index(tree):
+    """bare name -> [(qualname, FunctionDef)] for the one-level
+    loop-callee expansion."""
+    by_bare: Dict[str, List] = {}
+
+    def visit(node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = scope + [child.name]
+                by_bare.setdefault(child.name, []).append(
+                    (".".join(q), child))
+                visit(child, q)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, scope + [child.name])
+            else:
+                visit(child, scope)
+    visit(tree, [])
+    return by_bare
+
+
+def _unconditional_syncs(body_nodes):
+    """(sync_calls, all_calls) executed on EVERY pass through
+    ``body_nodes``: the scan stops at ``If`` statements (boundary-gated
+    reads — the sanctioned log/epoch pattern) and at nested function
+    definitions (their call time is unknown)."""
+    syncs: List[Tuple[ast.Call, str]] = []
+    calls: List[ast.Call] = []
+
+    def scan(node):
+        if isinstance(node, ast.If):
+            # the TEST runs on every iteration (`if float(loss) > t:`
+            # is a per-step sync); only the gated body/orelse is the
+            # sanctioned boundary-read pattern
+            scan(node.test)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            func = node.func
+            dotted = _dotted(func)
+            if isinstance(func, ast.Name) \
+                    and func.id in _SYNC_BUILTINS and node.args:
+                arg = node.args[0]
+                static = isinstance(arg, ast.Constant) or (
+                    isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Name)
+                    and arg.func.id == "len")
+                if not static:
+                    syncs.append((node, f"{func.id}()"))
+            elif isinstance(func, ast.Attribute) \
+                    and func.attr in _SYNC_METHODS and not node.args:
+                syncs.append((node, f".{func.attr}()"))
+            elif dotted in _CONCRETIZE_CALLS:
+                syncs.append((node, f"{dotted}()"))
+            calls.append(node)
+        for child in ast.iter_child_nodes(node):
+            scan(child)
+
+    for n in body_nodes:
+        scan(n)
+    return syncs, calls
+
+
+def _lint_training_loops(tree, path: str,
+                         lines: Sequence[str]) -> List[LintFinding]:
+    """TPL005: host-sync idioms executed once per training-loop step —
+    lexically in the loop body, or in a locally-defined function the
+    body calls (``self.train_batch(x, y)`` one level deep)."""
+    findings: List[LintFinding] = []
+    by_bare = _function_index(tree)
+    visited = set()
+
+    def emit(node, scope, detail, loop_line):
+        severity, summary, hint = RULES["TPL005"]
+        try:
+            code = lines[node.lineno - 1].strip()
+        except Exception:
+            code = ""
+        findings.append(LintFinding(
+            rule_id="TPL005", severity=severity, path=path,
+            line=getattr(node, "lineno", 0), scope=scope, code=code,
+            message=f"{summary}: {detail} (loop at line {loop_line})",
+            hint=hint))
+
+    def callee_defs(call):
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self":
+            name = func.attr
+        else:
+            return []
+        return by_bare.get(name, [])
+
+    def _loop_source_names(loop):
+        """Dotted names that tie the loop to a data source: the For's
+        iterable expression, or — for the ``while True: batch =
+        next(loader_it)`` form — the arguments of ``next()`` calls in
+        a While's body."""
+        if isinstance(loop, ast.For):
+            exprs = [loop.iter]
+        else:
+            exprs = [a for n in ast.walk(loop)
+                     if isinstance(n, ast.Call)
+                     and isinstance(n.func, ast.Name)
+                     and n.func.id == "next"
+                     for a in n.args]
+        return [_dotted(n).lower() for e in exprs for n in ast.walk(e)
+                if isinstance(n, (ast.Name, ast.Attribute))]
+
+    def on_loop(loop, scope):
+        names = _loop_source_names(loop)
+        if not any(src in d for d in names for src in _LOOP_SOURCES):
+            return
+        body = list(loop.body) + list(loop.orelse)
+        syncs, calls = _unconditional_syncs(body)
+        for node, detail in syncs:
+            emit(node, ".".join(scope) or "<module>", detail, loop.lineno)
+        for call in calls:
+            for qual, fn_node in callee_defs(call):
+                if id(fn_node) in visited:
+                    continue
+                visited.add(id(fn_node))
+                inner_syncs, _ = _unconditional_syncs(fn_node.body)
+                for node, detail in inner_syncs:
+                    emit(node, qual, detail, loop.lineno)
+
+    _scope_walk(tree, [], on_loop)
+    return findings
+
+
 # ------------------------------------------------------------ tree sweep
 def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
     tree = ast.parse(source)
     linter = _Linter(path, source.splitlines(), _jitted_local_names(tree))
     linter.visit(tree)
+    linter.findings.extend(
+        _lint_training_loops(tree, path, source.splitlines()))
     return linter.findings
 
 

@@ -281,6 +281,14 @@ class MonitorCallback(Callback):
 
     The substrate every later perf PR measures against: run a fit with
     this callback before and after, diff ``monitor.snapshot()``.
+
+    Sync-free contract (ISSUE 5): the fit loop hands a DEFERRED loss per
+    step; this callback must NOT force it per batch (that read would
+    re-serialize the loop on the device round-trip and turn
+    ``train_step_seconds`` into a sync-time measurement).  The last
+    pending loss is forced into the ``train_loss`` gauge only at epoch/
+    train boundaries, so the per-step span measures dispatch + device
+    pipeline time.
     """
 
     def __init__(self):
@@ -296,6 +304,7 @@ class MonitorCallback(Callback):
         self._samples = monitor.counter("train_samples_total",
                                         "samples consumed")
         self._span = None
+        self._pending_loss = None
 
     def on_train_batch_begin(self, step, logs=None):
         from ..monitor import span
@@ -317,10 +326,22 @@ class MonitorCallback(Callback):
                 self._samples_per_s.set(bsz / dt)
         loss = logs.get("loss")
         if loss is not None:
-            try:
-                self._loss.set(float(np.asarray(loss).ravel()[0]))
-            except (TypeError, ValueError):
-                pass
+            self._pending_loss = loss        # deferred: forced at epoch end
+
+    def _flush_loss(self):
+        loss, self._pending_loss = self._pending_loss, None
+        if loss is None:
+            return
+        try:
+            self._loss.set(float(np.asarray(loss).ravel()[0]))
+        except (TypeError, ValueError):
+            pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._flush_loss()
+
+    def on_train_end(self, logs=None):
+        self._flush_loss()
 
 
 class VisualDL(Callback):

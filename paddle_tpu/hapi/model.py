@@ -16,6 +16,88 @@ from ..io import DataLoader, Dataset
 from ..metric import Metric
 
 
+class DeferredScalar:
+    """A device-resident scalar whose host read is DEFERRED.
+
+    The sync-free fit loop (ISSUE 5) hands these to callbacks instead of
+    calling ``float(loss.item())`` per step: jax's async dispatch keeps
+    the device computing behind the Python loop, and the value is only
+    fetched when a consumer actually reads it (``float()`` /
+    ``np.asarray()`` / ``item()``) — which the stock callbacks do only
+    at log/epoch boundaries.  ``fit`` forces each epoch's losses in
+    bulk at the epoch boundary, so ``history['loss']`` still holds
+    plain floats when fit returns."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value):
+        self._value = value
+
+    def item(self) -> float:
+        return float(np.asarray(self._value).ravel()[0])
+
+    __float__ = item
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.asarray(self._value)
+        return a if dtype is None else a.astype(dtype)
+
+    def __format__(self, spec):
+        return format(self.item(), spec)
+
+    def __repr__(self):
+        return f"DeferredScalar({self.item()!r})"
+
+    # the pre-ISSUE-5 contract handed callbacks a plain float; numeric
+    # use keeps working (each op FORCES the value — callbacks that do
+    # per-step arithmetic opt back into the sync they pay for)
+    def __add__(self, other):
+        return self.item() + other
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self.item() - other
+
+    def __rsub__(self, other):
+        return other - self.item()
+
+    def __mul__(self, other):
+        return self.item() * other
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self.item() / other
+
+    def __rtruediv__(self, other):
+        return other / self.item()
+
+    def __neg__(self):
+        return -self.item()
+
+    def __lt__(self, other):
+        return self.item() < other
+
+    def __le__(self, other):
+        return self.item() <= other
+
+    def __gt__(self, other):
+        return self.item() > other
+
+    def __ge__(self, other):
+        return self.item() >= other
+
+    def __eq__(self, other):
+        return self.item() == other
+
+    def __ne__(self, other):
+        return self.item() != other
+
+    def __hash__(self):
+        return hash(self.item())
+
+
 class Model:
     """reference: paddle.Model (hapi/model.py)."""
 
@@ -61,7 +143,11 @@ class Model:
             self._optimizer.step()
             self._optimizer.clear_grad()
         metrics = self._update_metrics(outputs, labels)
-        return ([float(loss.item())], metrics) if metrics else [float(loss.item())]
+        # device-resident loss: no per-step host sync (the seed's
+        # float(loss.item()) here serialized every fit-loop step on the
+        # device round-trip — tpu_lint TPL005 now guards this path)
+        lazy = DeferredScalar(loss._data)
+        return ([lazy], metrics) if metrics else [lazy]
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -73,7 +159,7 @@ class Model:
         if self._loss is not None and labels:
             losses = self._loss(outputs, *labels)
             loss = losses if isinstance(losses, Tensor) else losses[0]
-            result.append(float(loss.item()))
+            result.append(DeferredScalar(loss._data))
         self._update_metrics(outputs, labels)
         return result
 
@@ -112,6 +198,7 @@ class Model:
                 m.reset()
             cbks.on_epoch_begin(epoch)
             logs = None        # only this epoch's last-batch logs
+            epoch_start = len(history["loss"])
             for step, batch in enumerate(loader):
                 if isinstance(batch, (list, tuple)) and len(batch) >= 2:
                     x, y = batch[0], batch[1]
@@ -131,6 +218,14 @@ class Model:
                 cbks.on_train_batch_end(step, logs)
                 if num_iters is not None and it >= num_iters:
                     break
+            # epoch boundary: force this epoch's device-resident losses
+            # ONCE — jax async dispatch has been computing behind the
+            # loop; a per-step read would re-serialize every step on the
+            # device round-trip
+            history["loss"][epoch_start:] = [
+                float(v) for v in history["loss"][epoch_start:]]
+            if logs is not None and logs.get("loss") is not None:
+                logs["loss"] = float(logs["loss"])
             cbks.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 eval_res = self.evaluate(eval_data, batch_size=batch_size,
@@ -170,7 +265,9 @@ class Model:
             cbks.on_eval_batch_end(step, {"loss": res[0] if res else None})
         result = {}
         if losses:
-            result["loss"] = [float(np.mean(losses))]
+            # eval boundary: the per-batch losses stayed device-resident
+            # through the loop; one bulk force here
+            result["loss"] = [float(np.mean([float(v) for v in losses]))]
         for m in self._metrics:
             name = m.name()
             result[name if isinstance(name, str) else name[0]] = m.accumulate()

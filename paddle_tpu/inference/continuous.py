@@ -42,6 +42,7 @@ Resilience layer (ISSUE 4):
 """
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import deque
@@ -54,7 +55,7 @@ from ..testing import faults as _faults
 
 __all__ = [
     "ContinuousBatchingEngine", "EngineSaturated", "EngineDraining",
-    "DeadlineExceeded", "RequestCancelled",
+    "DeadlineExceeded", "RequestCancelled", "retry_after_seconds",
 ]
 
 _PAD_SEQ = "__pad__"
@@ -136,6 +137,35 @@ _last_step_ts = monitor.gauge(
 _draining_g = monitor.gauge(
     "engine_draining", "1 while the engine is draining for graceful "
     "shutdown, else 0")
+_drain_rejected = monitor.counter(
+    "drain_rejected_requests_total", "queued-but-unadmitted requests "
+    "failed fast by drain(reject_queued=True)")
+
+
+def _decode_p50_seconds() -> Optional[float]:
+    """p50 of the process-wide ``decode_step_seconds`` histogram
+    (prometheus-style upper bucket bound), or None before the engine
+    has decoded anything."""
+    counts = _decode_step_s.cumulative_counts()
+    total = counts[-1]
+    if total <= 0:
+        return None
+    rank = 0.5 * total
+    for bound, cum in zip(_decode_step_s.buckets, counts):
+        if cum >= rank:
+            return bound
+    return _decode_step_s.buckets[-1]
+
+
+def retry_after_seconds(queue_depth: int,
+                        decode_p50_s: Optional[float]) -> int:
+    """Retry-After for a saturated engine: the backlog's estimated
+    service time — queue depth x measured decode-step p50 — clamped to
+    [1, 30] seconds (ROADMAP PR 4 follow-up c: replaces the constant
+    1s).  Falls back to 1s before any step has been measured."""
+    if not queue_depth or not decode_p50_s or decode_p50_s <= 0:
+        return 1
+    return int(min(30.0, max(1.0, math.ceil(queue_depth * decode_p50_s))))
 
 
 class _Request:
@@ -375,19 +405,47 @@ class ContinuousBatchingEngine:
     def draining(self) -> bool:
         return self._draining
 
-    def drain(self, timeout: Optional[float] = None) -> bool:
+    def retry_after_hint(self) -> int:
+        """Seconds a 429'd client should wait before retrying: the
+        current queue backlog x the measured decode-step p50 from the
+        monitor, clamped to [1, 30]."""
+        with self._cond:
+            depth = len(self._queue)
+        return retry_after_seconds(depth, _decode_p50_seconds())
+
+    def drain(self, timeout: Optional[float] = None,
+              reject_queued: bool = False) -> bool:
         """Graceful shutdown: stop accepting NEW submissions, let every
         already-submitted request (queued and active) run to
         completion, then stop the scheduler thread — the pool reclaims
         to idle as the last sequence retires.  Returns True when fully
         drained; False if ``timeout`` elapsed first (the engine keeps
-        draining — call again, or escalate to ``stop()``)."""
+        draining — call again, or escalate to ``stop()``).
+
+        ``reject_queued=True`` is the hard-preemption fast path
+        (ROADMAP PR 4 follow-up b): queued-but-unadmitted requests fail
+        fast with :class:`EngineDraining` — they hold no pages, so
+        rejection is free — while admitted work still runs to
+        completion within the (shorter) deadline."""
         deadline = (None if timeout is None
                     else time.monotonic() + float(timeout))
+        rejected: List[_Request] = []
         with self._cond:
             self._draining = True
             _draining_g.set(1)
+            if reject_queued and self._queue:
+                while self._queue:
+                    r = self._queue.popleft()
+                    r.error = EngineDraining(
+                        "engine draining: request rejected before "
+                        "admission (reject_queued fast path)")
+                    rejected.append(r)
+                _queue_depth.set(0)
+                _drain_rejected.inc(len(rejected))
             self._cond.notify_all()
+        for r in rejected:
+            r.done.set()
+        with self._cond:
             while self._queue or self._active or self._admitting:
                 if self._stop:
                     # a concurrent hard stop() preempted the drain: the

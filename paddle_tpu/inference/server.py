@@ -358,9 +358,13 @@ class GenerationServer(_ServerLifecycle):
                         "output_ids": out.tolist(),
                         "new_tokens": int(out.shape[1] - ids.shape[1])})
                 except EngineSaturated as e:
-                    # bounded-queue overflow: retryable, with a hint
-                    self._reply(429, {"error": str(e)},
-                                headers={"Retry-After": "1"})
+                    # bounded-queue overflow: retryable — the hint is
+                    # the backlog's estimated service time (queue depth
+                    # x measured decode-step p50, clamped to [1, 30]s),
+                    # not a constant
+                    self._reply(429, {"error": str(e)}, headers={
+                        "Retry-After":
+                            str(outer._engine.retry_after_hint())})
                 except EngineDraining as e:
                     self._reply(503, {"error": str(e), "draining": True})
                 except DeadlineExceeded as e:
@@ -381,17 +385,21 @@ class GenerationServer(_ServerLifecycle):
     def draining(self) -> bool:
         return self._engine.draining
 
-    def begin_drain(self, timeout: Optional[float] = None) -> None:
+    def begin_drain(self, timeout: Optional[float] = None,
+                    reject_queued: bool = False) -> None:
         """Start a graceful drain WITHOUT blocking (idempotent): the
         engine stops admitting — new /generate requests get 503 with
         ``"draining": true`` and /health flips to ``"draining"`` —
         while every in-flight generation runs to completion.  The HTTP
         listener stays up throughout so clients can still poll /health
-        and /metrics."""
+        and /metrics.  ``reject_queued=True`` is the hard-preemption
+        fast path: queued-but-unadmitted requests fail immediately
+        instead of being completed first."""
         if self._drain_thread is not None and self._drain_thread.is_alive():
             return
         self._drain_thread = threading.Thread(
-            target=self._engine.drain, kwargs={"timeout": timeout},
+            target=self._engine.drain,
+            kwargs={"timeout": timeout, "reject_queued": reject_queued},
             name="server-drain", daemon=True)
         self._drain_thread.start()
 

@@ -16,12 +16,21 @@ import itertools
 import math
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
 
-from ..framework.tensor import Tensor, to_tensor
+from .. import monitor
+from ..framework.tensor import Tensor, to_tensor, wrap_array
 from ..framework import random as _random
+
+# input-pipeline telemetry (ISSUE 5): how long the consumer (training
+# loop) sat blocked waiting for the next batch — the number the device
+# prefetch stage exists to drive toward zero
+_input_wait_s = monitor.histogram(
+    "input_wait_seconds", "time the DataLoader consumer spent blocked "
+    "waiting for the next batch")
 
 
 class Dataset:
@@ -282,34 +291,131 @@ def default_collate_fn(batch):
 
 
 class _PrefetchIter:
-    """Background-thread prefetcher (host-side pipeline overlap)."""
+    """Background-thread prefetcher (host-side pipeline overlap), with an
+    optional DEVICE stage (ISSUE 5): when ``device_fn`` is given, a
+    second thread applies it (``jax.device_put`` honoring an optional
+    sharding) to each host batch and double-buffers the result in its
+    own bounded queue — the next batch's h2d transfer is issued from the
+    prefetch pipeline and overlaps the current step's compute, instead
+    of serializing on the consumer thread.
 
-    def __init__(self, producer, depth):
-        self._q = queue.Queue(maxsize=depth)
-        self._done = object()
-        self._exc = None
+    ``close()`` (also triggered by exhaustion, producer error, and GC)
+    shuts every pipeline thread down without leaks, even when the
+    consumer abandons the iterator mid-epoch with full queues — all
+    queue puts poll a stop event instead of blocking forever."""
 
-        def run():
+    _POLL_S = 0.1
+
+    def __init__(self, producer, depth, device_fn=None, device_depth=2):
+        # the thread closures must capture ONLY these locals, never
+        # ``self``: a thread frame holding the iterator would keep it
+        # reachable forever, so __del__ (the abandon-path shutdown)
+        # could never fire and the threads would leak
+        done = self._done = object()
+        stop = self._stop = threading.Event()
+        exc_box = self._exc_box = [None]
+        poll = self._POLL_S
+        host_q = queue.Queue(maxsize=depth)
+        self._q = host_q
+        self.threads: List[threading.Thread] = []
+
+        def put(q, item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=poll)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
             try:
                 for item in producer:
-                    self._q.put(item)
+                    if not put(host_q, item):
+                        return
             except BaseException as e:  # propagate into consumer
-                self._exc = e
+                if exc_box[0] is None:
+                    exc_box[0] = e
             finally:
-                self._q.put(self._done)
-        self._thread = threading.Thread(target=run, daemon=True)
-        self._thread.start()
+                put(host_q, done)
+
+        self.threads.append(threading.Thread(
+            target=produce, name="dataloader-prefetch", daemon=True))
+        if device_fn is not None:
+            dev_q = queue.Queue(maxsize=max(device_depth, 1))
+            self._q = dev_q
+
+            def stage():
+                try:
+                    while not stop.is_set():
+                        try:
+                            item = host_q.get(timeout=poll)
+                        except queue.Empty:
+                            continue
+                        if item is done or \
+                                not put(dev_q, device_fn(item)):
+                            return
+                except BaseException as e:
+                    if exc_box[0] is None:
+                        exc_box[0] = e
+                finally:
+                    put(dev_q, done)
+
+            self.threads.append(threading.Thread(
+                target=stage, name="dataloader-device-stage", daemon=True))
+        for t in self.threads:
+            t.start()
+
+    @property
+    def _exc(self):
+        return self._exc_box[0]
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self._q.get()
+        if self._stop.is_set():
+            raise StopIteration
+        t0 = time.perf_counter()
+        while True:
+            try:
+                item = self._q.get(timeout=self._POLL_S)
+                break
+            except queue.Empty:
+                if not self._stop.is_set() and \
+                        any(t.is_alive() for t in self.threads):
+                    continue
+                # the threads are gone (or we were closed): anything
+                # they enqueued is already visible — drain before
+                # declaring exhaustion, or the epoch's tail batches
+                # would be silently dropped
+                try:
+                    item = self._q.get_nowait()
+                    break
+                except queue.Empty:
+                    _input_wait_s.observe(time.perf_counter() - t0)
+                    self.close()
+                    if self._exc is not None:
+                        raise self._exc
+                    raise StopIteration
+        _input_wait_s.observe(time.perf_counter() - t0)
         if item is self._done:
+            self.close()
             if self._exc is not None:
                 raise self._exc
             raise StopIteration
         return item
+
+    def close(self):
+        """Stop the pipeline threads (idempotent; safe mid-epoch — the
+        threads observe the stop event at their next queue poll)."""
+        self._stop.set()
+        for t in self.threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5)
+
+    def __del__(self):
+        self._stop.set()
 
 
 class DataLoader:
@@ -318,6 +424,16 @@ class DataLoader:
     num_workers>0 uses multiprocessing workers feeding an index queue
     (reference: io/dataloader/worker.py); prefetch_factor batches are staged
     ahead on a background thread either way.
+
+    Device prefetch (ISSUE 5): ``device_prefetch=True`` adds a device
+    stage to the prefetch pipeline — each batch's ``jax.device_put`` is
+    issued from a pipeline thread (honoring ``device_sharding``, e.g. a
+    dp-mesh NamedSharding) and double-buffered ``device_prefetch_depth``
+    deep, so the next batch's h2d transfer overlaps the current step's
+    compute instead of paying on the consumer thread.  Defaults on when
+    a ``device_sharding`` is given.  The staged batches are bit-identical
+    to an eager ``device_put`` of the host batch (regression-locked in
+    tests/test_dataloader_prefetch.py).
     """
 
     def __init__(self, dataset, feed_list=None, places=None,
@@ -325,7 +441,8 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, device_prefetch=None,
+                 device_sharding=None, device_prefetch_depth=2):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -333,6 +450,11 @@ class DataLoader:
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self.persistent_workers = persistent_workers
+        self.device_sharding = device_sharding
+        self.device_prefetch = (device_sharding is not None
+                                if device_prefetch is None
+                                else bool(device_prefetch))
+        self.device_prefetch_depth = max(int(device_prefetch_depth), 1)
         self._payload = None
         self._pool = None
         self._iterable = isinstance(dataset, IterableDataset)
@@ -431,8 +553,36 @@ class DataLoader:
         if pool is not None:
             pool.shutdown()
 
+    def _device_stage_fn(self):
+        """The device stage run on the prefetch pipeline thread: one
+        ``jax.device_put`` per array leaf, honoring an optional
+        sharding (dp meshes shard the global batch here, off the
+        consumer thread)."""
+        import jax
+        sharding = self.device_sharding
+
+        def put(arr):
+            return (jax.device_put(arr, sharding)
+                    if sharding is not None else jax.device_put(arr))
+
+        def stage(obj):
+            if isinstance(obj, Tensor):
+                return wrap_array(put(obj._data))
+            if isinstance(obj, np.ndarray):
+                return wrap_array(put(obj))
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(stage(o) for o in obj)
+            if isinstance(obj, dict):
+                return {k: stage(v) for k, v in obj.items()}
+            return obj
+        return stage
+
     def __iter__(self):
-        return _PrefetchIter(self._produce(), self.prefetch_factor)
+        return _PrefetchIter(
+            self._produce(), self.prefetch_factor,
+            device_fn=self._device_stage_fn() if self.device_prefetch
+            else None,
+            device_depth=self.device_prefetch_depth)
 
 
 class _WorkerPool:

@@ -28,8 +28,16 @@ import jax
 import jax.numpy as jnp
 import jax.tree_util as jtu
 
+from .. import monitor
 from ..framework.tape import no_grad
 from ..framework.tensor import Tensor, wrap_array
+
+# training-hot-path telemetry (ISSUE 5): elements of the first input
+# leaf consumed per step — for (batch, seq) token-id inputs this IS the
+# token count tools/train_bench.py quotes as train_tokens_total
+_train_tokens = monitor.counter(
+    "train_tokens_total", "elements of the first TrainStep input leaf "
+    "consumed (== tokens for (batch, seq) token-id inputs)")
 
 
 def _to_array(x):
@@ -157,10 +165,53 @@ class TrainStep:
             if self.accumulate_steps > 1 else []
         self._micro_step = 0
         self._compiled = None
+        self._compiled_scan = None
+        self._scan_fn = None
         self._last_loss = None
 
     # ------------------------------------------------------------------ build
-    def _build(self):
+    def _compute_placements(self):
+        """Record every operand's home placement ONCE (params, optimizer
+        state, masters, gradients) — shared by the single-step program
+        and the K-step fused scan so their pinning cannot diverge."""
+        param_shardings = [_keep(a) for a in self._arrays]
+        state_shardings = {k: [_keep(a) for a in v]
+                           for k, v in self._states.items()}
+        master_shardings = [_keep(m) for m in self._masters]
+        # ZeRO offload mode: on TPU the host-resident state stays
+        # pinned_host ACROSS the program boundary (streamed in/out inside
+        # the compiled step — overlappable transfers).  Other backends
+        # (CPU tests) can't compile mixed-memory donated programs, so the
+        # state is staged eagerly around the call instead — the same
+        # semantics the reference's cpu_offload staging has
+        # (group_sharded_stage3.py:85); host==device memory there anyway.
+        offloaded = (any(_is_offloaded(s)
+                         for v in state_shardings.values() for s in v)
+                     or any(_is_offloaded(s) for s in master_shardings))
+        self._offload_boundary = offloaded and \
+            jax.default_backend() != "tpu"
+        if self._offload_boundary:
+            self._state_homes = (state_shardings, master_shardings)
+            state_shardings = {k: [_device_kind(s) for s in v]
+                               for k, v in state_shardings.items()}
+            master_shardings = [_device_kind(s) for s in master_shardings]
+        else:
+            self._state_homes = None
+        # grad placement follows the param's sharded state (or master) —
+        # the gradient's consumer
+        grad_shardings = []
+        for i in range(len(self._arrays)):
+            sh = next((state_shardings[k][i] for k in self._states
+                       if state_shardings[k][i] is not None), None)
+            grad_shardings.append(_device_kind(sh or master_shardings[i]))
+        self._placements = (param_shardings, state_shardings,
+                            master_shardings, grad_shardings)
+
+    def _make_inner(self):
+        """The pure single-micro-step function (forward + loss + backward
+        + conditional optimizer apply).  ONE definition serves both the
+        single-step jit and the body of the K-step ``lax.scan`` — the
+        fused path cannot drift numerically from the escape hatch."""
         model = self.model
         loss_fn = self.loss_fn
         opt = self.optimizer
@@ -168,6 +219,8 @@ class TrainStep:
         frozen_params = self._frozen_params
         update_fn = self._update_fn
         grad_clip = opt._grad_clip
+        (param_shardings, state_shardings, master_shardings,
+         grad_shardings) = self._placements
 
         if self.amp_level and self.amp_level != "O0":
             from .. import amp
@@ -289,38 +342,12 @@ class TrainStep:
             return (loss, outs, new_arrays, new_states, new_masters,
                     new_accum)
 
-        param_shardings = [_keep(a) for a in self._arrays]
-        state_shardings = {k: [_keep(a) for a in v]
-                           for k, v in self._states.items()}
-        master_shardings = [_keep(m) for m in self._masters]
-        # ZeRO offload mode: on TPU the host-resident state stays
-        # pinned_host ACROSS the program boundary (streamed in/out inside
-        # the compiled step — overlappable transfers).  Other backends
-        # (CPU tests) can't compile mixed-memory donated programs, so the
-        # state is staged eagerly around the call instead — the same
-        # semantics the reference's cpu_offload staging has
-        # (group_sharded_stage3.py:85); host==device memory there anyway.
-        offloaded = (any(_is_offloaded(s)
-                         for v in state_shardings.values() for s in v)
-                     or any(_is_offloaded(s) for s in master_shardings))
-        self._offload_boundary = offloaded and \
-            jax.default_backend() != "tpu"
-        if self._offload_boundary:
-            self._state_homes = (state_shardings, master_shardings)
-            state_shardings = {k: [_device_kind(s) for s in v]
-                               for k, v in state_shardings.items()}
-            master_shardings = [_device_kind(s) for s in master_shardings]
-        else:
-            self._state_homes = None
-        # grad placement follows the param's sharded state (or master) —
-        # the gradient's consumer
-        grad_shardings = []
-        for i in range(len(self._arrays)):
-            sh = next((state_shardings[k][i] for k in self._states
-                       if state_shardings[k][i] is not None), None)
-            grad_shardings.append(_device_kind(sh or master_shardings[i]))
+        return pure_step
 
-        self._compiled = jax.jit(pure_step, donate_argnums=(0, 1, 2, 3),
+    def _build(self):
+        self._compute_placements()
+        self._inner = self._make_inner()
+        self._compiled = jax.jit(self._inner, donate_argnums=(0, 1, 2, 3),
                                  static_argnums=(10,))
 
     # ------------------------------------------------------------------- call
@@ -405,9 +432,290 @@ class TrainStep:
             frozen, lr, stepno, jnp.asarray(apply_now), in_leaves,
             label_leaves, treedefs)
         self._stage_out()
+        if in_leaves:
+            _train_tokens.inc(in_leaves[0].size)
         self._last_outputs = [wrap_array(o) for o in outs]
         self._last_loss = wrap_array(loss)
         return self._last_loss
+
+    # ------------------------------------------------------- K-step fusion
+    def _sched(self):
+        """The optimizer's LRScheduler instance, or None for a plain
+        float learning rate."""
+        from ..optimizer.lr import LRScheduler
+        lr = self.optimizer._learning_rate
+        return lr if isinstance(lr, LRScheduler) else None
+
+    def _sched_fingerprint(self):
+        """Identity + hyperparameters of the current schedule, NESTED
+        schedules included (LinearWarmup wraps another LRScheduler).
+        The traced fn closes over the hyperparams as Python constants,
+        so the cache (and the compiled scan) must be invalidated not
+        just when the schedule OBJECT is swapped but also when it (or
+        its inner schedule) is mutated in place — e.g. a checkpoint
+        restore through ``Optimizer.set_state_dict`` rewriting
+        ``base_lr``/``gamma`` on the same object.  ``last_epoch``/
+        ``last_lr`` are excluded: they advance every step and are
+        operands, not baked constants."""
+        from ..optimizer.lr import LRScheduler
+
+        def fp(sched):
+            hyper = tuple(sorted(
+                (k, repr(v)) for k, v in sched.state_dict().items()
+                if k not in ("last_epoch", "last_lr")))
+            nested = tuple(sorted(
+                (k, fp(v)) for k, v in vars(sched).items()
+                if isinstance(v, LRScheduler)))
+            return (id(sched), hyper, nested)
+
+        sched = self._sched()
+        return None if sched is None else fp(sched)
+
+    def _traced_sched_fn(self):
+        """Memoized traced LR schedule (``step -> f32``), validated by
+        abstract tracing; None when the schedule concretizes — the
+        auto-detected signal to take the single-step escape hatch."""
+        key = self._sched_fingerprint()
+        cached = getattr(self, "_sched_fn_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        fn = None
+        get = getattr(self.optimizer, "_traced_schedule", None)
+        cand = get() if get is not None else None
+        if cand is not None:
+            try:
+                jax.eval_shape(
+                    lambda s: jnp.asarray(cand(s), jnp.float32),
+                    jax.ShapeDtypeStruct((), jnp.int32))
+                fn = cand
+            except Exception:   # noqa: BLE001 — untraceable schedule
+                fn = None
+        self._sched_fn_cache = (key, fn)
+        return fn
+
+    @property
+    def fused_supported(self) -> bool:
+        """True when ``run_steps`` compiles ONE lax.scan dispatch for
+        all k micro-steps (constant lr, or a schedule whose
+        ``traced_lr`` validated); False means the schedule cannot be
+        traced and run_steps falls back to k single-step dispatches."""
+        if self._sched() is None:
+            return True
+        return self._traced_sched_fn() is not None
+
+    def _build_scan(self):
+        if self._compiled is None:
+            self._build()
+        inner = self._inner
+        K = self.accumulate_steps
+        sched_fn = self._traced_sched_fn()
+
+        def scan_steps(arrays, states, masters, accum, frozen, micro0,
+                       g0, sched0, lr_op, lr_factor, in_stacks,
+                       label_stacks, treedefs):
+            k = (in_stacks if in_stacks else label_stacks)[0].shape[0]
+
+            def body(carry, xs):
+                arrays, states, masters, accum = carry
+                i, in_leaves, label_leaves = xs
+                micro = micro0 + i + 1
+                apply_flag = (micro % K) == 0
+                # the schedule step counter advances once per MICRO
+                # step (the hapi per-batch LRScheduler-callback
+                # cadence); the optimizer step counter (adam bias
+                # correction) once per APPLIED update
+                stepno = (g0 + micro // K - micro0 // K).astype(jnp.int32)
+                if sched_fn is None:
+                    lr = lr_op
+                else:
+                    lr = jnp.asarray(sched_fn(sched0 + i),
+                                     jnp.float32) * lr_factor
+                loss, _outs, arrays, states, masters, accum = inner(
+                    arrays, states, masters, accum, frozen, lr, stepno,
+                    apply_flag, list(in_leaves), list(label_leaves),
+                    treedefs)
+                return (arrays, states, masters, accum), loss
+
+            (arrays, states, masters, accum), losses = jax.lax.scan(
+                body, (arrays, states, masters, accum),
+                (jnp.arange(k, dtype=jnp.int32), tuple(in_stacks),
+                 tuple(label_stacks)))
+            return losses, arrays, states, masters, accum
+
+        self._scan_fn = scan_steps
+        # rebuild if the schedule is swapped OR mutated in place
+        self._scan_sched = self._sched_fingerprint()
+        self._compiled_scan = jax.jit(
+            scan_steps, donate_argnums=(0, 1, 2, 3), static_argnums=(12,))
+
+    def _fused_batch_stacks(self, batches):
+        """Flatten every ``(inputs, labels)`` pair exactly the way
+        ``__call__`` does and stack the leaves on a leading k axis —
+        shared by run_steps and audit_fused so their signatures cannot
+        diverge."""
+        per_in, per_label = [], []
+        treedefs = frozen = None
+        for item in batches:
+            if not (isinstance(item, (tuple, list)) and len(item) == 2):
+                raise ValueError(
+                    "run_steps takes a sequence of (inputs, labels) "
+                    "pairs, each shaped as __call__ accepts")
+            in_leaves, label_leaves, td, frozen = self._prepare_args(
+                item[0], item[1])
+            if treedefs is None:
+                treedefs = td
+            elif td != treedefs:
+                raise ValueError(
+                    "all run_steps batches must share one input/label "
+                    "structure")
+            per_in.append(in_leaves)
+            per_label.append(label_leaves)
+        in_stacks = [jnp.stack([s[j] for s in per_in])
+                     for j in range(len(per_in[0]))]
+        label_stacks = [jnp.stack([s[j] for s in per_label])
+                        for j in range(len(per_label[0]))]
+        return in_stacks, label_stacks, treedefs, frozen
+
+    def _fused_scalars(self):
+        """The traced bookkeeping scalars of one fused dispatch (all
+        operands, never baked in — their change per call must not
+        recompile)."""
+        opt = self.optimizer
+        sched = self._sched()
+        return (jnp.asarray(self._micro_step, jnp.int32),
+                jnp.asarray(opt._global_step, jnp.int32),
+                jnp.asarray(0 if sched is None else sched.last_epoch,
+                            jnp.int32),
+                jnp.asarray(opt.get_lr(), jnp.float32),
+                jnp.asarray(opt._lr_factor, jnp.float32))
+
+    def run_steps(self, batches, k=None):
+        """K micro-steps in ONE device dispatch: a ``lax.scan`` over the
+        stacked batches, donation threaded through the scan carry, the
+        learning rate and step number computed INSIDE the program from
+        the traced schedule.  Semantically equivalent to::
+
+            for inputs, labels in batches:
+                loss_i = step(inputs, labels)
+                schedule.step()          # if the lr is an LRScheduler
+
+        (an LRScheduler advances once per micro-step — the cadence
+        hapi's per-batch LRScheduler callback drives).  Returns the
+        per-step losses as a device-resident ``(k,)`` Tensor; nothing
+        syncs to the host unless the caller reads it.
+
+        ``batches`` is a sequence of ``(inputs, labels)`` pairs, each as
+        ``__call__`` accepts, all sharing one structure/shape/dtype.
+        Escape hatch (auto-detected, ``fused_supported`` False): a
+        schedule whose lr cannot be traced runs the same loop as k
+        single-step dispatches.
+
+        Schedule hyperparameter changes (object swap OR in-place
+        mutation, nested schedules included) rebuild the fused program
+        automatically.  The fused lr is computed functionally from the
+        schedule's CURRENT hyperparams; after a partial in-place edit,
+        refresh the host cache too (``sched.step(sched.last_epoch)``)
+        or the single-step path will read the stale ``last_lr`` for one
+        step — a full checkpoint restore carries a consistent
+        ``last_lr`` and needs no refresh."""
+        batches = list(batches)
+        if k is None:
+            k = len(batches)
+        if k != len(batches) or k < 1:
+            raise ValueError(
+                f"k ({k}) must equal the number of batches "
+                f"({len(batches)}) and be >= 1")
+        sched = self._sched()
+        if not self.fused_supported:
+            losses = []
+            for inputs, labels in batches:
+                losses.append(self(inputs, labels)._data)
+                if sched is not None:
+                    sched.step()
+            return wrap_array(jnp.stack(losses))
+        if self._compiled_scan is None or \
+                self._scan_sched != self._sched_fingerprint():
+            self._build_scan()
+        in_stacks, label_stacks, treedefs, frozen = \
+            self._fused_batch_stacks(batches)
+        scalars = self._fused_scalars()
+        states, masters = self._stage_in()
+        (losses, self._arrays, self._states, self._masters,
+         self._grad_accum) = self._compiled_scan(
+            self._arrays, states, masters, self._grad_accum, frozen,
+            *scalars, in_stacks, label_stacks, treedefs)
+        self._stage_out()
+        if in_stacks:
+            _train_tokens.inc(in_stacks[0].size)
+        # host bookkeeping mirrors what the in-program schedule already
+        # computed: micro/global step counters and the scheduler state
+        K = self.accumulate_steps
+        micro0 = self._micro_step
+        self._micro_step += k
+        self.optimizer._global_step += (micro0 + k) // K - micro0 // K
+        if sched is not None:
+            for _ in range(k):
+                sched.step()
+        self._last_outputs = []
+        self._last_loss = wrap_array(losses[k - 1])
+        return wrap_array(losses)
+
+    def audit_fused(self, batches, **limits):
+        """``analysis.audit_callable`` on the fused K-step program:
+        traces the EXACT operand list and donation contract run_steps
+        executes (params/optimizer state as abstract avals — no device
+        work, nothing materialized) and returns the ProgramAudit.  The
+        certification lane tools/train_bench.py gates on: no host
+        callbacks, donation intact, no f32 creep."""
+        from ..analysis import audit_callable
+        if not self.fused_supported:
+            raise ValueError(
+                "the LR schedule is not traceable — run_steps uses the "
+                "single-step escape hatch and there is no fused program "
+                "to audit")
+        if self._compiled_scan is None or \
+                self._scan_sched != self._sched_fingerprint():
+            self._build_scan()
+        # abstract stacking: only the FIRST batch's leaf shapes/dtypes
+        # are read and a leading k axis prepended — no jnp.stack, no
+        # device allocation for the k real batches
+        batches = list(batches)
+        k = len(batches)
+        first = batches[0]
+        if not (isinstance(first, (tuple, list)) and len(first) == 2):
+            raise ValueError(
+                "audit_fused takes the same (inputs, labels) pairs as "
+                "run_steps")
+        in_leaves, label_leaves, treedefs, _frozen = self._prepare_args(
+            first[0], first[1])
+        in_stacks = [jax.ShapeDtypeStruct((k,) + tuple(a.shape), a.dtype)
+                     for a in in_leaves]
+        label_stacks = [jax.ShapeDtypeStruct((k,) + tuple(a.shape),
+                                             a.dtype)
+                        for a in label_leaves]
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                        sharding=_keep(a))
+
+        def staged_sds(a):
+            if a is None:
+                return None
+            return jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=_device_kind(_keep(a)))
+
+        arrays = [staged_sds(a) for a in self._arrays]
+        states = {s: [staged_sds(a) for a in v]
+                  for s, v in self._states.items()}
+        masters = [staged_sds(m) for m in self._masters]
+        accum = [staged_sds(a) for a in self._grad_accum]
+        frozen = [sds(p._data) for p in self._frozen_params]
+        scalars = tuple(sds(x) for x in self._fused_scalars())
+        return audit_callable(
+            self._scan_fn, arrays, states, masters, accum, frozen,
+            *scalars, in_stacks, label_stacks, treedefs,
+            donate_argnums=(0, 1, 2, 3), static_argnums=(12,),
+            name="TrainStep.run_steps", **limits)
 
     # -------------------------------------------------------------- analysis
     def _lower(self, in_leaves, label_leaves, treedefs, as_avals=False):

@@ -34,6 +34,19 @@ class LRScheduler:
     def get_lr(self) -> float:
         raise NotImplementedError
 
+    def traced_lr(self):
+        """``fn(step) -> f32 lr`` computable INSIDE a jax-traced program
+        (``step`` is a traced int32 playing ``last_epoch``'s role), or
+        None when this schedule cannot be traced (stateful / metric- or
+        callback-driven schedules).  The K-step fused train path
+        (``jit.TrainStep.run_steps``) moves the per-step host
+        ``get_lr()`` read into the compiled ``lax.scan`` body through
+        this hook; a None return is the auto-detected signal to fall
+        back to one dispatch per step.  Implementations must mirror
+        ``get_lr`` exactly (same formula, f32) so the fused and
+        single-step trajectories stay bit-comparable."""
+        return None
+
     def state_dict(self):
         return {k: v for k, v in self.__dict__.items()
                 if isinstance(v, (int, float, bool, str, list, tuple))}
@@ -56,6 +69,16 @@ class NoamDecay(LRScheduler):
         return (self.base_lr * self.d_model ** -0.5 *
                 min(step ** -0.5, step * self.warmup_steps ** -1.5))
 
+    def traced_lr(self):
+        import jax.numpy as jnp
+        base, d, w = self.base_lr, self.d_model, self.warmup_steps
+
+        def fn(step):
+            s = jnp.maximum(step, 1).astype(jnp.float32)
+            return jnp.float32(base * d ** -0.5) * \
+                jnp.minimum(s ** -0.5, s * w ** -1.5)
+        return fn
+
 
 class PiecewiseDecay(LRScheduler):
     def __init__(self, boundaries, values, last_epoch=-1, verbose=False):
@@ -69,6 +92,15 @@ class PiecewiseDecay(LRScheduler):
                 return self.values[i]
         return self.values[len(self.boundaries)]
 
+    def traced_lr(self):
+        import jax.numpy as jnp
+        bounds = jnp.asarray(self.boundaries, jnp.int32)
+        values = jnp.asarray(self.values, jnp.float32)
+
+        def fn(step):
+            return values[jnp.searchsorted(bounds, step, side="right")]
+        return fn
+
 
 class NaturalExpDecay(LRScheduler):
     def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
@@ -78,6 +110,15 @@ class NaturalExpDecay(LRScheduler):
     def get_lr(self):
         return self.base_lr * math.exp(-self.gamma * self.last_epoch)
 
+    def traced_lr(self):
+        import jax.numpy as jnp
+        base, gamma = self.base_lr, self.gamma
+
+        def fn(step):
+            return jnp.float32(base) * jnp.exp(
+                jnp.float32(-gamma) * step.astype(jnp.float32))
+        return fn
+
 
 class InverseTimeDecay(LRScheduler):
     def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
@@ -86,6 +127,15 @@ class InverseTimeDecay(LRScheduler):
 
     def get_lr(self):
         return self.base_lr / (1 + self.gamma * self.last_epoch)
+
+    def traced_lr(self):
+        import jax.numpy as jnp
+        base, gamma = self.base_lr, self.gamma
+
+        def fn(step):
+            return jnp.float32(base) / (
+                1.0 + jnp.float32(gamma) * step.astype(jnp.float32))
+        return fn
 
 
 class PolynomialDecay(LRScheduler):
@@ -108,6 +158,23 @@ class PolynomialDecay(LRScheduler):
         return ((self.base_lr - self.end_lr) *
                 (1 - step / decay_steps) ** self.power + self.end_lr)
 
+    def traced_lr(self):
+        import jax.numpy as jnp
+        base, end, power = self.base_lr, self.end_lr, self.power
+        ds, cycle = self.decay_steps, self.cycle
+
+        def fn(step):
+            s = step.astype(jnp.float32)
+            if cycle:
+                div = jnp.maximum(jnp.ceil(s / ds), 1.0)
+                eff_ds = ds * div
+            else:
+                s = jnp.minimum(s, float(ds))
+                eff_ds = jnp.float32(ds)
+            return (jnp.float32(base - end) *
+                    (1.0 - s / eff_ds) ** power + jnp.float32(end))
+        return fn
+
 
 class LinearWarmup(LRScheduler):
     def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
@@ -127,6 +194,25 @@ class LinearWarmup(LRScheduler):
             return self.lr()
         return self.lr
 
+    def traced_lr(self):
+        import jax.numpy as jnp
+        if isinstance(self.lr, LRScheduler):
+            inner = self.lr.traced_lr()
+            if inner is None:
+                return None
+        else:
+            lr_after = float(self.lr)
+            inner = None
+        warm, start, end = self.warmup_steps, self.start_lr, self.end_lr
+
+        def fn(step):
+            s = step.astype(jnp.float32)
+            ramp = jnp.float32(end - start) * s / warm + jnp.float32(start)
+            after = (inner(step - warm) if inner is not None
+                     else jnp.float32(lr_after))
+            return jnp.where(step < warm, ramp, after)
+        return fn
+
 
 class ExponentialDecay(LRScheduler):
     def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
@@ -135,6 +221,15 @@ class ExponentialDecay(LRScheduler):
 
     def get_lr(self):
         return self.base_lr * self.gamma ** self.last_epoch
+
+    def traced_lr(self):
+        import jax.numpy as jnp
+        base, gamma = self.base_lr, self.gamma
+
+        def fn(step):
+            return jnp.float32(base) * \
+                jnp.float32(gamma) ** step.astype(jnp.float32)
+        return fn
 
 
 class MultiStepDecay(LRScheduler):
@@ -148,6 +243,17 @@ class MultiStepDecay(LRScheduler):
         n = sum(1 for m in self.milestones if m <= self.last_epoch)
         return self.base_lr * self.gamma ** n
 
+    def traced_lr(self):
+        import jax.numpy as jnp
+        base, gamma = self.base_lr, self.gamma
+        miles = jnp.asarray(sorted(self.milestones), jnp.int32)
+
+        def fn(step):
+            n = jnp.searchsorted(miles, step, side="right")
+            return jnp.float32(base) * \
+                jnp.float32(gamma) ** n.astype(jnp.float32)
+        return fn
+
 
 class StepDecay(LRScheduler):
     def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1,
@@ -159,6 +265,15 @@ class StepDecay(LRScheduler):
     def get_lr(self):
         return self.base_lr * self.gamma ** (self.last_epoch // self.step_size)
 
+    def traced_lr(self):
+        import jax.numpy as jnp
+        base, gamma, size = self.base_lr, self.gamma, self.step_size
+
+        def fn(step):
+            return jnp.float32(base) * \
+                jnp.float32(gamma) ** (step // size).astype(jnp.float32)
+        return fn
+
 
 class LambdaDecay(LRScheduler):
     def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
@@ -167,6 +282,19 @@ class LambdaDecay(LRScheduler):
 
     def get_lr(self):
         return self.base_lr * self.lr_lambda(self.last_epoch)
+
+    def traced_lr(self):
+        # best effort: works when lr_lambda is jnp-traceable (pure
+        # arithmetic on its argument); TrainStep validates the returned
+        # fn with eval_shape and falls back to single-step dispatch if
+        # the lambda concretizes
+        import jax.numpy as jnp
+        base, lam = self.base_lr, self.lr_lambda
+
+        def fn(step):
+            return jnp.float32(base) * \
+                jnp.asarray(lam(step), jnp.float32)
+        return fn
 
 
 class MultiplicativeDecay(LRScheduler):
@@ -191,6 +319,16 @@ class CosineAnnealingDecay(LRScheduler):
     def get_lr(self):
         return self.eta_min + (self.base_lr - self.eta_min) * (
             1 + math.cos(math.pi * self.last_epoch / self.T_max)) / 2
+
+    def traced_lr(self):
+        import jax.numpy as jnp
+        base, eta, t_max = self.base_lr, self.eta_min, self.T_max
+
+        def fn(step):
+            s = step.astype(jnp.float32)
+            return jnp.float32(eta) + jnp.float32(base - eta) * (
+                1.0 + jnp.cos(jnp.float32(math.pi) * s / t_max)) / 2.0
+        return fn
 
 
 class CosineAnnealingWarmRestarts(LRScheduler):
@@ -345,3 +483,14 @@ class LinearLR(LRScheduler):
         factor = self.start_factor + (
             self.end_factor - self.start_factor) * t / self.total_steps
         return self.base_lr * factor
+
+    def traced_lr(self):
+        import jax.numpy as jnp
+        base, total = self.base_lr, self.total_steps
+        f0, f1 = self.start_factor, self.end_factor
+
+        def fn(step):
+            t = jnp.minimum(step, total).astype(jnp.float32)
+            return jnp.float32(base) * (
+                jnp.float32(f0) + jnp.float32(f1 - f0) * t / total)
+        return fn

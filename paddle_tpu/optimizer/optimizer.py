@@ -94,6 +94,16 @@ class Optimizer:
     def set_lr_scheduler(self, scheduler: LRScheduler):
         self._learning_rate = scheduler
 
+    def _traced_schedule(self):
+        """The LR schedule as an in-program function ``step -> f32 lr``
+        (BEFORE the ``_lr_factor`` multiplier), or None when the lr is a
+        plain float or the schedule is untraceable — the auto-detection
+        ``jit.TrainStep.run_steps`` uses to choose between computing the
+        lr inside the fused ``lax.scan`` and one dispatch per step."""
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate.traced_lr()
+        return None
+
     # ------------------------------------------------------------ state mgmt
     def _ensure_state(self, params: List[Parameter]):
         for slot in self._state_slots:

@@ -241,6 +241,31 @@ class TestDrain:
             assert eng.drain(timeout=300) is True
             assert len(r.result(timeout=1)) == 36
 
+    def test_drain_reject_queued_fails_fast_keeps_admitted(self, model):
+        # ROADMAP PR 4 follow-up (b): the hard-preemption fast path —
+        # queued-but-unadmitted requests error immediately with
+        # EngineDraining while the admitted request finishes its full
+        # budget
+        from paddle_tpu.inference.continuous import EngineDraining
+        rng = np.random.default_rng(21)
+        plan = faults.FaultPlan([
+            {"site": "decode_step", "kind": "delay", "delay_s": 0.01}])
+        before = counter_value("drain_rejected_requests_total")
+        with faults.installed(plan):
+            eng = make_engine(model, max_batch=1)
+            r1 = eng.submit(rng.integers(0, 64, (4,)), max_new_tokens=24)
+            wait_for(lambda: r1.seq_id is not None, msg="r1 admission")
+            queued = [eng.submit(rng.integers(0, 64, (4,)),
+                                 max_new_tokens=4) for _ in range(2)]
+            assert eng.drain(timeout=300, reject_queued=True)
+            for q in queued:                  # failed fast, never admitted
+                with pytest.raises(EngineDraining):
+                    q.result(timeout=1)
+                assert q.seq_id is None
+            assert len(r1.result(timeout=1)) == 28   # full budget
+        assert counter_value("drain_rejected_requests_total") == before + 2
+        assert eng.cache.free_pages == 64             # pool reclaimed
+
 
 class TestQuarantine:
     def test_poisoned_prefill_errors_only_that_request(self, model):
@@ -533,6 +558,12 @@ class TestServerErrorMapping:
                           "max_new_tokens": 4})
                 assert code == 429
                 assert "Retry-After" in headers
+                # ROADMAP PR 4 follow-up (c): derived from queue depth
+                # x measured decode-step p50, clamped to [1, 30] —
+                # never the old constant string with no basis
+                assert 1 <= int(headers["Retry-After"]) <= 30
+                assert (int(headers["Retry-After"])
+                        == srv._engine.retry_after_hint())
                 t1.join(timeout=300)
                 t2.join(timeout=300)
         assert all(code == 200 for code, _, _ in results)
@@ -561,3 +592,34 @@ class TestServerErrorMapping:
                     srv, {"input_ids": [[1, 2]], "max_new_tokens": 2})
             assert code == 500
             assert "injected fault" in body["error"]
+
+
+class TestRetryAfterDerivation:
+    """ROADMAP PR 4 follow-up (c): Retry-After = queue depth x measured
+    decode-step p50, clamped to [1, 30] seconds."""
+
+    def test_clamps_and_formula(self):
+        from paddle_tpu.inference.continuous import retry_after_seconds
+        assert retry_after_seconds(0, 0.5) == 1          # empty queue
+        assert retry_after_seconds(5, None) == 1         # nothing measured
+        assert retry_after_seconds(3, 0.001) == 1        # floor clamp
+        assert retry_after_seconds(10, 0.5) == 5         # ceil(10 x 0.5)
+        assert retry_after_seconds(7, 0.33) == 3         # ceil(2.31)
+        assert retry_after_seconds(1000, 0.5) == 30      # ceiling clamp
+
+    def test_engine_hint_uses_live_queue_depth(self, model):
+        rng = np.random.default_rng(23)
+        plan = faults.FaultPlan([
+            {"site": "decode_step", "kind": "delay", "delay_s": 0.01}])
+        with faults.installed(plan):
+            with make_engine(model, max_batch=1, max_queue=8) as eng:
+                assert eng.retry_after_hint() >= 1       # idle: floor
+                r1 = eng.submit(rng.integers(0, 64, (4,)),
+                                max_new_tokens=16)
+                wait_for(lambda: r1.seq_id is not None, msg="admission")
+                qs = [eng.submit(rng.integers(0, 64, (4,)),
+                                 max_new_tokens=2) for _ in range(3)]
+                hint = eng.retry_after_hint()
+                assert 1 <= hint <= 30
+                for r in (r1, *qs):
+                    r.cancel()

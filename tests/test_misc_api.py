@@ -260,3 +260,69 @@ class TestUtilsSubmodules:
         import paddle_tpu.utils as u
         assert u.run_check()
         assert "successfully" in capsys.readouterr().out
+
+
+class TestSyncFreeFitLoop:
+    """ISSUE 5: train_batch/fit never force a per-step host sync — the
+    loss reaches callbacks as a DeferredScalar, forced at boundaries."""
+
+    def _model(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m = paddle.Model(net)
+        m.prepare(optimizer=optim.Adam(parameters=net.parameters(),
+                                       learning_rate=1e-2),
+                  loss=nn.CrossEntropyLoss())
+        return m
+
+    def _data(self, n=16):
+        x = np.random.randn(n, 4).astype("float32")
+        y = (x.sum(1) > 0).astype("int64")
+        return [(x[i], y[i]) for i in range(n)]
+
+    def test_train_batch_returns_deferred_scalar(self):
+        from paddle_tpu.hapi.model import DeferredScalar
+        m = self._model()
+        x = np.random.randn(8, 4).astype("float32")
+        y = np.random.randint(0, 2, (8,)).astype("int64")
+        res = m.train_batch(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert isinstance(res[0], DeferredScalar)
+        v = float(res[0])                    # forcing works and is finite
+        assert np.isfinite(v)
+        assert np.asarray(res[0]).shape == ()
+
+    def test_callbacks_see_lazy_loss_history_gets_floats(self):
+        from paddle_tpu.hapi.model import DeferredScalar
+        seen = []
+
+        class Spy(paddle.callbacks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.append(logs["loss"])
+
+        m = self._model()
+        hist = m.fit(self._data(), batch_size=8, epochs=1, verbose=0,
+                     callbacks=[Spy()])
+        # per-step logs stay deferred; history is forced at epoch end
+        assert all(isinstance(v, DeferredScalar) for v in seen)
+        assert all(isinstance(v, float) for v in hist["loss"])
+        assert len(hist["loss"]) == 2
+
+    def test_eval_batch_is_deferred_and_evaluate_aggregates(self):
+        from paddle_tpu.hapi.model import DeferredScalar
+        m = self._model()
+        x = np.random.randn(8, 4).astype("float32")
+        y = np.random.randint(0, 2, (8,)).astype("int64")
+        res = m.eval_batch(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert isinstance(res[0], DeferredScalar)
+        out = m.evaluate(self._data(8), batch_size=8, verbose=0)
+        assert isinstance(out["loss"][0], float)
+
+    def test_deferred_scalar_keeps_float_arithmetic_contract(self):
+        from paddle_tpu.hapi.model import DeferredScalar
+        v = DeferredScalar(np.float32(2.5))
+        assert v + 1 == 3.5 and 1 + v == 3.5
+        assert v - 0.5 == 2.0 and 5 - v == 2.5
+        assert v * 2 == 5.0 and v / 2 == 1.25 and 5 / v == 2.0
+        assert -v == -2.5
+        assert v < 3 and v <= 2.5 and v > 2 and v >= 2.5
+        assert v == 2.5 and v != 2.4
+        assert sum([v, v]) == 5.0               # the common callback use

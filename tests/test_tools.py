@@ -126,6 +126,45 @@ class TestServeBench:
         assert out["fault_plan"] is not None
 
 
+class TestTrainBench:
+    """ISSUE 5 CI satellite: the training hot-path lane must run a tiny
+    config, emit one parseable JSON line with every acceptance gate
+    green — fused-vs-single-step loss parity, certified fused program
+    (audit), compile-free measured windows, TPL005-clean fit loop."""
+
+    def _load(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "train_bench", os.path.join(REPO, "tools", "train_bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_hist_quantile(self):
+        tb = self._load()
+        b = {"0.1": 4, "0.5": 9, "1.0": 10, "+Inf": 10}
+        assert tb.hist_quantile(b, 0.50) == 0.5
+        assert tb.hist_quantile({"+Inf": 0}, 0.5) is None
+
+    def test_smoke_gate_passes(self, capsys):
+        tb = self._load()
+        assert tb.main([]) == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = json.loads(line)
+        # acceptance criteria, quoted from the one JSON line
+        assert out["parity_ok"] and out["parity_max_abs_diff"] < 5e-4
+        assert out["audit_error_findings"] == 0
+        assert out["jit_recompiles"] == 0
+        assert out["tpl005_hapi_findings"] == 0
+        assert out["fused_steps"] == out["k"] * 4
+        assert out["fused_steps_per_sec"] > 0
+        assert out["single_step_p50_s"] is not None
+        assert out["fused_step_p50_s"] is not None
+        assert out["train_tokens"] == out["fused_steps"] * \
+            out["batch"] * out["seq"]
+        assert out["input_waits"] > 0        # device prefetch measured
+
+
 class TestChaosSmoke:
     """ISSUE 4 CI satellite: the resilience counters the README
     documents must exist in monitor.snapshot() after a chaos run."""

@@ -206,3 +206,94 @@ class TestTreeIsClean:
                                    rel_to=REPO)
         assert [f for f in findings if f.rule_id == "TPL003"] == []
         assert [f for f in findings if f.rule_id == "TPL004"] == []
+
+
+class TestTrainingLoopSyncRule:
+    """TPL005 (ISSUE 5 satellite): per-step host syncs in training
+    loops — the idiom the sync-free fit loop deleted from the seed."""
+
+    def test_seed_fit_loop_shape_is_flagged(self):
+        # the exact seed shape: fit's loop calls train_batch, which
+        # forced float(loss.item()) every step (one-level expansion)
+        found = _lint("""
+            class Model:
+                def train_batch(self, inputs, labels):
+                    loss = self._loss(self._forward(*inputs), *labels)
+                    loss.backward()
+                    return [float(loss.item())]
+
+                def fit(self, train_data, epochs=1):
+                    loader = train_data
+                    for step, batch in enumerate(loader):
+                        result = self.train_batch(batch[0], batch[1])
+        """)
+        tpl5 = [f for f in found if f.rule_id == "TPL005"]
+        assert len(tpl5) == 2                  # float() and .item()
+        assert all(f.scope == "Model.train_batch" for f in tpl5)
+
+    def test_direct_loop_body_sync_flagged(self):
+        found = _lint("""
+            import numpy as np
+            def run(loader, step):
+                for batch in loader:
+                    v = np.asarray(step(batch))
+        """)
+        assert [f.rule_id for f in found] == ["TPL005"]
+
+    def test_boundary_gated_read_is_exempt(self):
+        # forcing only at log boundaries is the sanctioned pattern
+        found = _lint("""
+            def run(loader, step, log_freq=10):
+                for i, batch in enumerate(loader):
+                    loss = step(batch)
+                    if i % log_freq == 0:
+                        print(float(loss))
+        """)
+        assert found == []
+
+    def test_non_training_loops_not_flagged(self):
+        found = _lint("""
+            def show(logs):
+                for k, v in logs.items():
+                    print(float(v))
+        """)
+        assert found == []
+
+    def test_static_reads_in_loop_exempt(self):
+        found = _lint("""
+            def run(loader):
+                for batch in loader:
+                    n = float(len(batch)) + float(1)
+        """)
+        assert found == []
+
+    def test_fit_loop_fix_holds_tree_wide(self):
+        # the ISSUE 5 acceptance bar: the sync-free fit loop left
+        # paddle_tpu/hapi/ (and the whole tree, per the committed
+        # baseline) TPL005-clean
+        findings = lint.lint_paths(os.path.join(REPO, "paddle_tpu",
+                                                "hapi"), rel_to=REPO)
+        assert [f for f in findings if f.rule_id == "TPL005"] == []
+
+    def test_sync_in_if_test_is_flagged(self):
+        # the condition itself runs every step: `if float(loss) > t:`
+        # is a per-step sync even though its BODY is gated
+        found = _lint("""
+            def run(loader, step):
+                for batch in loader:
+                    loss = step(batch)
+                    if float(loss) > 10:
+                        break
+        """)
+        assert [f.rule_id for f in found] == ["TPL005"]
+
+    def test_while_next_loader_loop_is_flagged(self):
+        # the ISSUE names for/while bodies: the `while True:
+        # batch = next(loader_it)` form is the same training loop
+        found = _lint("""
+            def run(loader_it, step):
+                while True:
+                    batch = next(loader_it)
+                    v = float(step(batch))
+        """)
+        assert [f.rule_id for f in found] == ["TPL005"]
