@@ -97,8 +97,8 @@ _MUTATOR_METHODS = {"append", "appendleft", "extend", "extendleft",
 #: entries as new scheduler-shaped classes land.
 LOCK_CLASSES: Dict[str, Tuple[str, frozenset]] = {
     "ContinuousBatchingEngine": ("_cond", frozenset({
-        "_queue", "_active", "_reserved_pages", "_next_seq", "_stop",
-        "_draining", "_admitting", "steps"})),
+        "_queue", "_active", "_reserved_pages", "_reserved_draft_pages",
+        "_next_seq", "_stop", "_draining", "_admitting", "steps"})),
 }
 
 

@@ -467,22 +467,30 @@ def audit_callable(fn, *example_args, donate_argnums=(), static_argnums=(),
 def audit_engine(engine, mode: str = "decode", sample=None,
                  per_row_budget: int = 64, publish: bool = True,
                  **limits) -> ProgramAudit:
-    """Audit a ContinuousBatchingEngine's compiled decode program
-    without running it: rebuilds the exact traced function + donation
-    contract ``JittedPagedDecoder`` jits and traces it on abstract
-    inputs shaped like a full decode batch.
+    """Audit a ContinuousBatchingEngine's compiled decode or
+    speculative-verify program without running it: rebuilds the exact
+    traced function + donation contract ``JittedPagedDecoder`` jits and
+    traces it on abstract inputs shaped like a full decode batch.
 
     With the engine's default ``sample_on_device=True`` the program's
-    only non-donated output is the ``(batch,)`` int32 ids — the audit
-    must report zero host-transfer findings (PR 2's invariant, now
-    enforced).  ``per_row_budget`` is the allowed host-transfer bytes
-    per batch row (ids are 4; a logits row is vocab*4)."""
+    only non-donated outputs are the ``(batch,)`` int32 ids (decode) —
+    plus the ``(batch,)`` int32 accept counts for ``mode="verify"`` —
+    so the audit must report zero host-transfer findings (PR 2's
+    invariant, extended to the speculative hot path).  The verify audit
+    also proves no ``[B, k]``-shaped draft block was baked in as a
+    constant (the block rides as a traced argument) and that BOTH page
+    pools stay donated.  ``per_row_budget`` is the allowed
+    host-transfer bytes per batch row (ids are 4; ids + accept are 8; a
+    logits row is vocab*4)."""
     import jax.numpy as jnp
     from ..inference.paged import next_pow2
 
-    if mode != "decode":
-        raise ValueError(f"audit_engine supports mode='decode', got "
-                         f"{mode!r}")
+    if mode not in ("decode", "verify"):
+        raise ValueError(f"audit_engine supports mode='decode' or "
+                         f"'verify', got {mode!r}")
+    if mode == "verify" and not getattr(engine, "_spec", False):
+        raise ValueError("mode='verify' needs an engine built with a "
+                         "draft_model")
     decoder = engine._decoder
     cache = engine.cache
     if sample is None:
@@ -497,20 +505,31 @@ def audit_engine(engine, mode: str = "decode", sample=None,
     i32 = jnp.int32
     params = [sds(tuple(p._data.shape), p._data.dtype)
               for p in decoder.params]
-    if sample == "draw":
-        s_args = (sds((B,), jnp.uint32), sds((B,), i32),
-                  sds((B,), jnp.float32), sds((B,), jnp.bool_))
-    else:
-        s_args = ()
     k_pages = tuple(sds(tuple(a.shape), a.dtype) for a in cache.k_pages)
     v_pages = tuple(sds(tuple(a.shape), a.dtype) for a in cache.v_pages)
-    args = (params, sds((B, 1), i32), sds((B,), i32), sds((B,), i32),
-            sds((B,), i32), sds((B,), i32), sds((B, W), i32), s_args,
-            k_pages, v_pages)
+    if mode == "verify":
+        S = engine.spec_k + 1
+        if sample == "draw":
+            s_args = (sds((B,), jnp.uint32), sds((B,), jnp.float32),
+                      sds((B,), jnp.bool_))
+        else:
+            s_args = ()
+        args = (params, sds((B, S), i32), sds((B,), i32),
+                sds((B * S,), i32), sds((B * S,), i32), sds((B,), i32),
+                sds((B, W), i32), s_args, k_pages, v_pages)
+    else:
+        if sample == "draw":
+            s_args = (sds((B,), jnp.uint32), sds((B,), i32),
+                      sds((B,), jnp.float32), sds((B,), jnp.bool_))
+        else:
+            s_args = ()
+        args = (params, sds((B, 1), i32), sds((B,), i32), sds((B,), i32),
+                sds((B,), i32), sds((B,), i32), sds((B, W), i32), s_args,
+                k_pages, v_pages)
     limits.setdefault("output_transfer_bytes", B * per_row_budget)
     return audit_callable(
         fn, *args, donate_argnums=donate,
-        name=f"engine.decode[{'logits' if sample is False else sample}]",
+        name=f"engine.{mode}[{'logits' if sample is False else sample}]",
         publish=publish, **limits)
 
 

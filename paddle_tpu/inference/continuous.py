@@ -39,13 +39,32 @@ Resilience layer (ISSUE 4):
   * deterministic fault injection — the ``paddle_tpu.testing.faults``
     sites ``prefill`` / ``decode_step`` / ``page_alloc`` are consulted
     at near-zero cost when no plan is installed.
+
+Speculative decoding (ISSUE 6):
+
+  * pass ``draft_model`` and the engine decodes speculatively: the
+    draft proposes ``spec_tokens`` greedy tokens per active sequence in
+    ONE compiled scan over its OWN PagedKVCache (pages allocated/freed
+    in lockstep with the target's), then the target scores the whole
+    ``[B, k+1]`` block in ONE compiled verify dispatch — accept lengths
+    and the bonus token are computed on device, so the host boundary
+    stays ``(batch,)`` ids + ``(batch,)`` accept counts;
+  * greedy speculative decoding is EXACT (bit-identical tokens to
+    target-only greedy, whatever the draft proposes); sampled requests
+    ride along unaccelerated (their draft slots never match, so they
+    advance exactly one fused-sampled token per step);
+  * rejected suffixes roll back via page-granular length truncation on
+    BOTH caches (pages stay mapped inside the admission reservation);
+    draft-side failures DOWNGRADE the affected requests to plain decode
+    instead of quarantining them — speculation is an optimization, so
+    a broken draft must never fail a request.
 """
 from __future__ import annotations
 
 import math
 import threading
 import time
-from collections import deque
+from collections import deque, namedtuple
 from typing import Deque, List, Optional
 
 import numpy as np
@@ -140,6 +159,33 @@ _draining_g = monitor.gauge(
 _drain_rejected = monitor.counter(
     "drain_rejected_requests_total", "queued-but-unadmitted requests "
     "failed fast by drain(reject_queued=True)")
+# speculative-decoding telemetry (ISSUE 6): acceptance economics and the
+# draft cache's capacity footprint
+_spec_proposed = monitor.counter(
+    "spec_proposed_tokens_total", "draft tokens proposed to the "
+    "compiled verify step")
+_spec_accepted = monitor.counter(
+    "spec_accepted_tokens_total", "proposed draft tokens the target "
+    "verified and accepted")
+_spec_accept_len = monitor.histogram(
+    "spec_accept_len", "accepted draft tokens per sequence per verify "
+    "step", buckets=tuple(float(i) for i in range(9)) + (12.0, 16.0,
+                                                        24.0, 32.0))
+_spec_rollback = monitor.counter(
+    "spec_rollback_total", "per-sequence verify outcomes that rejected "
+    "a draft suffix (partial multi-token rollback on both caches)")
+_spec_draft_pages = monitor.gauge(
+    "spec_draft_pages", "pages pinned in the draft model's KV pool — "
+    "the speculative mode's capacity cost")
+_spec_draft_failures = monitor.counter(
+    "spec_draft_failures_total", "draft-side prefill/propose failures "
+    "that downgraded requests to plain decode")
+
+#: one request's share of a speculative verify step: the bonus token
+#: (ids or the logits-row escape hatch), the device-computed accept
+#: length, and the draft tokens the host already knows (so accepted
+#: token VALUES never cross the host boundary a second time)
+_SpecRow = namedtuple("_SpecRow", ("out", "accept", "drafts"))
 
 
 def _decode_p50_seconds() -> Optional[float]:
@@ -181,6 +227,10 @@ class _Request:
         self.seed = int(seed) & 0xFFFFFFFF   # on-device threefry seed
         self.rng = np.random.default_rng(seed)
         self.prefix_tokens = 0               # prompt tokens shared at admit
+        # speculative decoding (ISSUE 6): set by the engine at submit;
+        # _draft_reserved tracks whether draft-pool reservation is held
+        self.use_draft = False
+        self._draft_reserved = False
         self.generated: List[int] = []
         self.next_token: Optional[int] = None   # sampled, not yet decoded
         self.seq_id: Optional[int] = None
@@ -273,6 +323,13 @@ class ContinuousBatchingEngine:
     deadlines each ``submit`` may override; ``step_timeout_s``
     registers a heartbeat with the comm watchdog so a wedged device
     step fires ``comm_timeouts_total`` like a hung collective.
+
+    Speculative decoding (ISSUE 6): ``draft_model`` enables it —
+    ``spec_tokens`` draft proposals per sequence per step are verified
+    by ONE compiled multi-token target dispatch (exact for greedy).
+    Requests opt out per-call (``submit(draft=False)``); the draft
+    holds its own page pool (``draft_total_pages``, default the
+    target's size) whose pages move in lockstep with the target's.
     """
 
     def __init__(self, model, total_pages: int = 512, page_size: int = 16,
@@ -280,7 +337,9 @@ class ContinuousBatchingEngine:
                  prefix_cache: bool = True, max_queue: int = 256,
                  default_ttl_s: Optional[float] = None,
                  default_queue_timeout_s: Optional[float] = None,
-                 step_timeout_s: Optional[float] = None):
+                 step_timeout_s: Optional[float] = None,
+                 draft_model=None, spec_tokens: int = 4,
+                 draft_total_pages: Optional[int] = None):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_position = int(model.config.max_position_embeddings)
@@ -299,14 +358,45 @@ class ContinuousBatchingEngine:
             model, total_pages=total_pages, page_size=page_size)
         from .paged import JittedPagedDecoder
         self._decoder = JittedPagedDecoder(model)
+        # speculative decoding (ISSUE 6): the draft gets its own
+        # decoder + page pool; proposals/verification share the target's
+        # bucketing so steady-state serving stays compile-free
+        self.draft_model = draft_model
+        self.spec_k = int(spec_tokens)
+        if draft_model is not None:
+            if self.spec_k < 1:
+                raise ValueError("spec_tokens must be >= 1")
+            if (int(draft_model.config.vocab_size)
+                    != int(model.config.vocab_size)):
+                raise ValueError(
+                    "draft and target models must share a vocabulary "
+                    f"({draft_model.config.vocab_size} vs "
+                    f"{model.config.vocab_size})")
+            self._draft_decoder = JittedPagedDecoder(draft_model)
+            self.draft_cache = PagedKVCache.from_model(
+                draft_model,
+                total_pages=(total_pages if draft_total_pages is None
+                             else draft_total_pages),
+                page_size=page_size)
+            self._draft_max_position = int(
+                draft_model.config.max_position_embeddings)
+        else:
+            self._draft_decoder = None
+            self.draft_cache = None
+            self._draft_max_position = 0
         # one scratch sequence backs every padding row of every bucket;
-        # its single page stays allocated WHILE sequences are active
+        # its page(s) stay allocated WHILE sequences are active
         # (the old allocate/truncate/free per padded step churned the
-        # free list under the pool lock) and is released whenever the
+        # free list under the pool lock) and are released whenever the
         # engine goes idle, so an idle engine still reports a fully
-        # reclaimed pool; admission arithmetic always reserves 1 page
-        # for it either way
-        self._reserved_pages = 1               # headroom for the pad page
+        # reclaimed pool; admission arithmetic always reserves the pad
+        # headroom either way.  A speculative pad row rewrites
+        # spec_tokens + 1 slots per verify step, so its headroom grows
+        # with k.
+        pad_tokens = (self.spec_k + 1) if draft_model is not None else 1
+        self._pad_pages = max(1, -(-pad_tokens // int(page_size)))
+        self._reserved_pages = self._pad_pages
+        self._reserved_draft_pages = self._pad_pages
         self._queue: Deque[_Request] = deque()
         self._active: List[_Request] = []
         # admitted-but-not-yet-active (mid-prefill) count: drain() must
@@ -333,11 +423,21 @@ class ContinuousBatchingEngine:
         self._thread.start()
 
     # ------------------------------------------------------------- public
+    @property
+    def _spec(self) -> bool:
+        return self.draft_model is not None
+
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None, do_sample: bool = False,
                temperature: float = 1.0, seed: int = 0,
                ttl_s: Optional[float] = None,
-               queue_timeout_s: Optional[float] = None) -> _Request:
+               queue_timeout_s: Optional[float] = None,
+               draft: Optional[bool] = None) -> _Request:
+        """``draft``: speculative-decoding opt-in for this request.
+        ``None`` (default) speculates whenever the engine has a draft
+        model and the request is greedy; ``False`` opts out; ``True``
+        demands it (ValueError if the engine has no draft model or the
+        request cannot speculate)."""
         req = _Request(prompt, max_new_tokens, eos_token_id, do_sample,
                        temperature, seed,
                        ttl_s=self.default_ttl_s if ttl_s is None else ttl_s,
@@ -345,17 +445,53 @@ class ContinuousBatchingEngine:
                                         if queue_timeout_s is None
                                         else queue_timeout_s))
         total = len(req.prompt) + req.max_new_tokens
-        if total > self.max_position:
+        # a verify step writes spec_k + 1 positions before rolling back,
+        # so the rope table must cover the overhang for EVERY request a
+        # speculative engine serves (opt-out rows ride in the same block)
+        overhang = self.spec_k if self._spec else 0
+        if total + overhang > self.max_position:
             # past the rope table the gather would silently clamp and
             # reuse the last angles (the scalar path raises; so do we)
             raise ValueError(
-                f"prompt + max_new_tokens = {total} exceeds the model's "
-                f"max_position_embeddings ({self.max_position})")
+                f"prompt + max_new_tokens = {total} "
+                + (f"+ speculative overhang {overhang} " if overhang
+                   else "")
+                + f"exceeds the model's max_position_embeddings "
+                f"({self.max_position})")
+        if draft and not self._spec:
+            raise ValueError(
+                "draft=True but the engine was built without a "
+                "draft_model")
+        use = self._spec and (draft is None or bool(draft))
+        if use and req.do_sample:
+            # acceptance-by-argmax is only exact for greedy rows;
+            # sampled rows ride along unaccelerated instead of drawing
+            # from the wrong distribution
+            if draft:
+                raise ValueError(
+                    "speculative decoding is greedy-exact only; "
+                    "draft=True cannot be combined with do_sample")
+            use = False
+        if use and total + self.spec_k > self._draft_max_position:
+            if draft:
+                raise ValueError(
+                    f"prompt + max_new_tokens + speculative overhang = "
+                    f"{total + self.spec_k} exceeds the DRAFT model's "
+                    f"max_position_embeddings "
+                    f"({self._draft_max_position})")
+            use = False
+        req.use_draft = use
         need = self._pages_for(req)
-        if need > self.cache.total_pages - 1:
+        if need > self.cache.total_pages - self._pad_pages:
             raise RuntimeError(
                 f"request needs {need} pages but the pool holds "
                 f"{self.cache.total_pages} total; grow total_pages")
+        if req.use_draft and need > self.draft_cache.total_pages \
+                - self._pad_pages:
+            raise RuntimeError(
+                f"request needs {need} draft-cache pages but the draft "
+                f"pool holds {self.draft_cache.total_pages} total; grow "
+                "draft_total_pages")
         with self._cond:
             if self._draining:
                 raise EngineDraining(
@@ -376,7 +512,8 @@ class ContinuousBatchingEngine:
     def generate(self, input_ids, max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
-                 seed: int = 0, ttl_s: Optional[float] = None):
+                 seed: int = 0, ttl_s: Optional[float] = None,
+                 draft: Optional[bool] = None):
         """Blocking batch API (PagedGenerator-compatible): submits each
         row as its own sequence and eos-pads rows to a common length.
         If any row fails to submit or errors, the other rows are
@@ -388,7 +525,7 @@ class ContinuousBatchingEngine:
             for i, row in enumerate(ids):
                 reqs.append(self.submit(row, max_new_tokens, eos_token_id,
                                         do_sample, temperature, seed + i,
-                                        ttl_s=ttl_s))
+                                        ttl_s=ttl_s, draft=draft))
             rows = [r.result() for r in reqs]
         except BaseException:
             for r in reqs:
@@ -490,7 +627,23 @@ class ContinuousBatchingEngine:
 
     def _pages_for(self, req) -> int:
         ps = self.cache.page_size
-        return -(-(len(req.prompt) + req.max_new_tokens) // ps)
+        total = len(req.prompt) + req.max_new_tokens
+        if self._spec:
+            # a verify step writes spec_k + 1 tokens from length
+            # <= prompt + max_new - 1 before rolling back, so the
+            # worst-case footprint carries a spec_k-token overhang (the
+            # draft pool's propose scan peaks at the same bound)
+            total += self.spec_k
+        return -(-total // ps)
+
+    def _free_pads_locked(self) -> None:
+        """Caller holds ``self._cond`` (or the engine is single-threaded
+        at the call site).  Release the pad scratch page(s) on every
+        pool so an idle engine reports fully reclaimed capacity."""
+        self.cache.free(_PAD_SEQ)
+        if self._spec:
+            self.draft_cache.free(_PAD_SEQ)
+            _spec_draft_pages.set(self.draft_cache.pinned_pages)
 
     def _reap_locked(self) -> List[_Request]:
         """Caller holds ``self._cond``.  Retire queued and active
@@ -528,7 +681,7 @@ class ContinuousBatchingEngine:
             self._active = still
             if not still:
                 # everything reaped: the pad scratch page goes back too
-                self.cache.free(_PAD_SEQ)
+                self._free_pads_locked()
         if out:
             self._cond.notify_all()
         return out
@@ -565,8 +718,18 @@ class ContinuousBatchingEngine:
                     - shared_tok // self.cache.page_size + newly_pinned)
             if self._reserved_pages + need > self.cache.total_pages:
                 break                     # wait for a retirement
+            # the draft pool reserves the full worst case too (no
+            # prefix sharing there — the draft always prefills whole
+            # prompts); both pools must fit or neither is reserved
+            dneed = self._pages_for(req) if req.use_draft else 0
+            if dneed and self._reserved_draft_pages + dneed \
+                    > self.draft_cache.total_pages:
+                break
             self._queue.popleft()
             self._reserved_pages += need
+            if dneed:
+                self._reserved_draft_pages += dneed
+                req._draft_reserved = True
             req.seq_id = self._next_seq
             self._next_seq += 1
             if shared_tok:
@@ -621,6 +784,22 @@ class ContinuousBatchingEngine:
             # retain this prompt's page-aligned prefixes for later
             # sharers (idempotent for the pages it itself shared)
             self.cache.register_prefix(req.seq_id, req.prompt)
+        if req.use_draft:
+            # the draft ingests the WHOLE prompt (no prefix sharing in
+            # its pool) so its cache sits at the same length as the
+            # target's — the lockstep invariant every propose/verify
+            # round preserves.  The greedy-tail sampling keeps the
+            # transfer at (1,) ids; the value is discarded.
+            try:
+                self._draft_decoder.prefill(
+                    self.draft_cache, [req.seq_id], req.prompt[None],
+                    bucket=True,
+                    sampling=(np.zeros(1, np.uint32),
+                              np.zeros(1, np.int32),
+                              np.ones(1, np.float32),
+                              np.zeros(1, bool)))
+            except BaseException:  # noqa: BLE001 — degrade, don't fail
+                self._downgrade_draft([req])
         req.next_token = (int(out[0]) if sampling is not None
                           else self._pick(req, out[0]))
         req.first_token_at = time.perf_counter()
@@ -630,6 +809,31 @@ class ContinuousBatchingEngine:
         from .paged import sample_token
         return sample_token(logits_row, req.do_sample, req.temperature,
                             req.rng)
+
+    def _release_draft_locked(self, req) -> None:
+        """Caller holds ``self._cond``.  Free the request's draft-cache
+        pages and return exactly the reservation they covered (the
+        draft pool has no prefix index, so every freed page is truly
+        free).  Idempotent via the per-request flag — downgrade and
+        retirement may both reach here."""
+        if not req._draft_reserved:
+            return
+        slack = (self._pages_for(req)
+                 - len(self.draft_cache._seq_pages.get(req.seq_id, ())))
+        released = self.draft_cache.free(req.seq_id)
+        self._reserved_draft_pages -= slack + released
+        req._draft_reserved = False
+
+    def _downgrade_draft(self, reqs) -> None:
+        """Speculation is an optimization: after a draft-side failure
+        the affected requests keep decoding on the plain path instead
+        of being quarantined.  Sticky for the request's lifetime (a
+        desynced draft cache cannot rejoin lockstep mid-stream)."""
+        _spec_draft_failures.inc(len(list(reqs)))
+        with self._cond:
+            for r in reqs:
+                r.use_draft = False
+                self._release_draft_locked(r)
 
     def _retire_locked(self, req):
         """Caller holds ``self._cond``.  Release the request's pages and
@@ -641,6 +845,7 @@ class ContinuousBatchingEngine:
                  - len(self.cache._seq_pages.get(req.seq_id, ())))
         released = self.cache.free(req.seq_id)
         self._reserved_pages -= slack + released
+        self._release_draft_locked(req)
         req.finished_at = time.perf_counter()
         if req.error is None:
             _gen_latency_s.observe(req.finished_at - req.submitted_at)
@@ -650,6 +855,111 @@ class ContinuousBatchingEngine:
         return min(next_pow2(n), self.max_batch)
 
     # ------------------------------------------------- decode + isolation
+    def _spec_sampling_for(self, reqs, n: int):
+        """(seeds, temps, flags) arrays for the verify program's fused
+        bonus-token tail, padded to ``n`` rows — ``_sampling_for``
+        minus the host-side counters: the draw position is
+        pos + accept + 1, computed on device, so plain and speculative
+        draws replay identically by construction."""
+        seeds, _, temps, flags = self._sampling_for(
+            reqs, np.zeros(n, np.int32))
+        return seeds, temps, flags
+
+    def _exec_spec_step(self, reqs) -> List[_SpecRow]:
+        """One SPECULATIVE decode step for ``reqs``: the draft proposes
+        ``spec_k`` greedy tokens per opted-in row in ONE compiled scan
+        dispatch (plus one write-only step so its cache covers the last
+        proposal), then the target verifies the whole ``[B, k+1]``
+        block in ONE compiled dispatch — per-row accept lengths and the
+        bonus token computed on device.  Rows that opted out (or whose
+        draft just failed) ride along with unmatched draft slots: they
+        advance exactly one token, exactly as a plain step would.
+
+        Replays identically after a rollback (greedy draft + the same
+        threefry counters), which the retry/bisect recovery depends on.
+        Partial rollback happens HERE: both caches truncate to each
+        row's verified length pos + accept + 1 before returning."""
+        k = self.spec_k
+        B = self._bucket(len(reqs))
+        npad = B - len(reqs)
+        drafts = np.full((len(reqs), k), -1, np.int32)  # -1 never matches
+        d_idx = [i for i, r in enumerate(reqs) if r.use_draft]
+        self._step_started_at = time.monotonic()
+        try:
+            _faults.maybe_fire("decode_step",
+                               seq_ids=[r.seq_id for r in reqs])
+            with monitor.span("engine/decode_step",
+                              histogram=_decode_step_s):
+                if d_idx:
+                    Bd = self._bucket(len(d_idx))
+                    d_seqs = [reqs[i].seq_id for i in d_idx]
+                    d_tok = np.array(
+                        [reqs[i].generated[-1] for i in d_idx], np.int32)
+                    d_pos = np.array(
+                        [self.draft_cache.length(s) for s in d_seqs],
+                        np.int32)
+                    if Bd > len(d_idx):
+                        self.draft_cache.truncate(_PAD_SEQ, 0)
+                        pad_n = Bd - len(d_idx)
+                        d_seqs += [_PAD_SEQ] * pad_n
+                        d_tok = np.concatenate(
+                            [d_tok, np.zeros(pad_n, np.int32)])
+                        d_pos = np.concatenate(
+                            [d_pos, np.zeros(pad_n, np.int32)])
+                    try:
+                        prop = self._draft_decoder.multi_step(
+                            self.draft_cache, d_seqs, d_tok, d_pos, k + 1)
+                    except BaseException:  # noqa: BLE001 — degrade
+                        # a draft failure must never fail the batch:
+                        # those rows decode plain from here on (their
+                        # draft cache cannot rejoin lockstep)
+                        self._downgrade_draft([reqs[i] for i in d_idx])
+                        d_idx = []
+                    else:
+                        for j, i in enumerate(d_idx):
+                            drafts[i] = prop[j, :k]
+                block = np.zeros((B, k + 1), np.int32)
+                pos = np.zeros(B, np.int32)
+                seq_ids = []
+                for i, r in enumerate(reqs):
+                    block[i, 0] = r.generated[-1]
+                    block[i, 1:] = drafts[i]
+                    pos[i] = self.cache.length(r.seq_id)
+                    seq_ids.append(r.seq_id)
+                if npad:
+                    self.cache.truncate(_PAD_SEQ, 0)
+                    seq_ids.extend([_PAD_SEQ] * npad)
+                sampling = (self._spec_sampling_for(reqs, B)
+                            if self.sample_on_device else None)
+                out, accept = self._decoder.verify(
+                    self.cache, seq_ids, block, pos, sampling=sampling)
+        finally:
+            self._step_started_at = None
+        _last_step_ts.set(time.time())
+        rows: List[_SpecRow] = []
+        for i, r in enumerate(reqs):
+            a = int(accept[i])
+            new_len = int(pos[i]) + a + 1
+            # page-granular partial rollback: rejected positions'
+            # lengths unwind on BOTH caches; their pages stay mapped
+            # (inside the admission reservation) and their slots are
+            # simply rewritten by later steps
+            self.cache.truncate(r.seq_id, new_len)
+            if r.use_draft:
+                self.draft_cache.truncate(r.seq_id, new_len)
+            rows.append(_SpecRow(out[i], a, drafts[i]))
+        if d_idx:
+            _spec_proposed.inc(k * len(d_idx))
+            _spec_accepted.inc(sum(int(accept[i]) for i in d_idx))
+            rejected = 0
+            for i in d_idx:
+                _spec_accept_len.observe(int(accept[i]))
+                rejected += int(accept[i]) < k
+            if rejected:
+                _spec_rollback.inc(rejected)
+        _spec_draft_pages.set(self.draft_cache.pinned_pages)
+        return rows
+
     def _exec_step(self, reqs) -> List[np.ndarray]:
         """Run ONE compiled decode step for ``reqs`` (all of, or a
         bisected subset of, the active batch), padded to a bucket.
@@ -657,7 +967,12 @@ class ContinuousBatchingEngine:
         request/cache state — a rolled-back step therefore replays
         IDENTICALLY (same threefry counters → same draws), which the
         retry/bisect recovery depends on.  Returns one output row per
-        request (sampled token id, or the logits row)."""
+        request (sampled token id, or the logits row).  With a draft
+        model and at least one opted-in row the step runs SPECULATIVELY
+        (one propose scan + one verify dispatch, multiple tokens per
+        row) and the rows are :class:`_SpecRow`."""
+        if self._spec and any(r.use_draft for r in reqs):
+            return self._exec_spec_step(reqs)
         B = self._bucket(len(reqs))
         npad = B - len(reqs)
         # the new token enters the sequence now: its rope position
@@ -703,9 +1018,13 @@ class ContinuousBatchingEngine:
         decoder also rolls back its own advance; this covers faults
         fired before the decoder ran).  Pages stay mapped — they are
         inside the admission reservation and the replay rewrites their
-        slots."""
+        slots.  Speculative steps unwind the DRAFT cache too (the
+        propose scan may have advanced it before the verify failed)."""
         for r in reqs:
-            self.cache.truncate(r.seq_id, lens_before[r.seq_id])
+            tgt, dft = lens_before[r.seq_id]
+            self.cache.truncate(r.seq_id, tgt)
+            if dft is not None and self._spec:
+                self.draft_cache.truncate(r.seq_id, dft)
 
     def _step_isolated(self, reqs, lens_before):
         """(survivors, rows, poisoned) for one logical decode step:
@@ -757,8 +1076,11 @@ class ContinuousBatchingEngine:
         failures are isolated per sequence (retry, then bisect) rather
         than erroring the whole batch."""
         active = self._active
-        lens_before = {r.seq_id: self.cache.length(r.seq_id)
-                       for r in active}
+        lens_before = {
+            r.seq_id: (self.cache.length(r.seq_id),
+                       (self.draft_cache.length(r.seq_id)
+                        if self._spec and r.use_draft else None))
+            for r in active}
         for r in active:
             r.generated.append(r.next_token)
         _active_seqs.set(len(active))
@@ -777,14 +1099,38 @@ class ContinuousBatchingEngine:
         # the lock for the shared-state transition (pages/reservations/
         # active list) — the discipline tpu_lint TPL004 enforces
         still, retired = [], []
+        accepted_emitted = 0
         for r, row in zip(survivors, rows):
             eos_hit = (r.eos_token_id is not None
                        and r.generated[-1] == r.eos_token_id)
             if eos_hit or len(r.generated) >= r.max_new_tokens:
                 retired.append(r)
                 continue
-            r.next_token = int(row) if on_device else self._pick(r, row)
+            if isinstance(row, _SpecRow):
+                # consume the accepted draft tokens SEQUENTIALLY, with
+                # the same eos/budget checks the plain path applies one
+                # step at a time — so speculative output is, token for
+                # token, what target-only greedy would have emitted
+                done = False
+                for t in row.drafts[:row.accept]:
+                    r.generated.append(int(t))
+                    accepted_emitted += 1
+                    if (r.eos_token_id is not None
+                            and int(t) == r.eos_token_id) \
+                            or len(r.generated) >= r.max_new_tokens:
+                        done = True
+                        break
+                if done:
+                    retired.append(r)
+                    continue
+                out_row = row.out
+            else:
+                out_row = row
+            r.next_token = (int(out_row) if on_device
+                            else self._pick(r, out_row))
             still.append(r)
+        if accepted_emitted:
+            _tokens_total.inc(accepted_emitted)
         for r in poisoned:
             # the token recorded for this step never executed
             r.generated.pop()
@@ -800,7 +1146,7 @@ class ContinuousBatchingEngine:
                 # engine reports a fully reclaimed pool — released
                 # BEFORE waking the retired requests' waiters, who may
                 # assert exactly that
-                self.cache.free(_PAD_SEQ)
+                self._free_pads_locked()
             self._cond.notify_all()        # drain() waits on this
         _active_seqs.set(len(still))
         for r in retired:
@@ -829,8 +1175,12 @@ class ContinuousBatchingEngine:
             for r in self._active + admitted:
                 if r.seq_id is not None:
                     self.cache.free(r.seq_id)
-            self.cache.free(_PAD_SEQ)
-            self._reserved_pages = 1          # only the pad headroom
+                    if self._spec:
+                        self.draft_cache.free(r.seq_id)
+                    r._draft_reserved = False
+            self._free_pads_locked()
+            self._reserved_pages = self._pad_pages   # only pad headroom
+            self._reserved_draft_pages = self._pad_pages
             self._active, self._queue = [], deque()
             self._admitting = 0
             _active_seqs.set(0)
@@ -843,7 +1193,7 @@ class ContinuousBatchingEngine:
                 while not self._stop and not self._queue and not self._active:
                     self._cond.wait(timeout=0.5)
                 if self._stop:
-                    self.cache.free(_PAD_SEQ)
+                    self._free_pads_locked()
                     for r in list(self._queue) + self._active:
                         r.error = RuntimeError("engine stopped")
                         r.done.set()

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from ..framework.tensor import Tensor, wrap_array
 from ..framework.tape import no_grad
 from ..ops.pallas.flash_attention import DEFAULT_MASK_VALUE
-from ..ops.pallas.paged_attention import PagedKVCache, paged_attention
+from ..ops.pallas.paged_attention import (PagedKVCache, paged_attention,
+                                          paged_attention_multi)
 
 
 def fused_sample(logits, seeds, ctrs, temps, flags):
@@ -202,14 +203,22 @@ class _TracedPagedContext:
             from ..nn import functional as F
             out, _ = F.flash_attention(q, k, v, causal=True)
             return out
-        ks = jnp.swapaxes(k._data[:, 0], 0, 1)      # (kvh, batch, d)
-        vs = jnp.swapaxes(v._data[:, 0], 0, 1)
+        # decode / verify: s tokens per row scatter flat (s == 1 is the
+        # classic decode step; s > 1 is the speculative verify block)
+        b, s = k.shape[0], k.shape[1]
+        kvh, d = k.shape[2], k.shape[3]
+        ks = jnp.swapaxes(k._data.reshape(b * s, kvh, d), 0, 1)
+        vs = jnp.swapaxes(v._data.reshape(b * s, kvh, d), 0, 1)
         kp = kp.at[:, self.pg, self.sl].set(ks.astype(kp.dtype))
         vp = vp.at[:, self.pg, self.sl].set(vs.astype(vp.dtype))
         self.k_pages[layer], self.v_pages[layer] = kp, vp
-        out = paged_attention(q._data[:, 0], kp, vp, self.lens,
-                              self.tables)
-        return wrap_array(out[:, None])
+        if s == 1:
+            out = paged_attention(q._data[:, 0], kp, vp, self.lens,
+                                  self.tables)
+            return wrap_array(out[:, None])
+        out = paged_attention_multi(q._data, kp, vp, self.lens,
+                                    self.tables)
+        return wrap_array(out)
 
 
 class JittedPagedDecoder:
@@ -225,7 +234,7 @@ class JittedPagedDecoder:
     #: per-mode donated arg positions (the page pools) — shared between
     #: the jit call and the analysis auditor so both see one contract
     DONATE_ARGNUMS = {"decode": (8, 9), "prefill": (6, 7),
-                      "prefix": (8, 9)}
+                      "prefix": (8, 9), "verify": (8, 9)}
 
     def __init__(self, model):
         self.model = model
@@ -324,6 +333,54 @@ class JittedPagedDecoder:
                         logits = last_logits(hidden, last_idx)
                     return (tail(logits, sampling),
                             tuple(ctx.k_pages), tuple(ctx.v_pages))
+                finally:
+                    for p, s in zip(self.params, saved):
+                        p._data = s
+
+        elif mode == "verify":
+            def fn(param_arrays, block, pos, pg, sl, lens, tables,
+                   sampling, k_pages, v_pages):
+                """Speculative-decoding verify: ONE compiled dispatch
+                scores the whole (B, S) block — S = 1 fed token + k
+                draft proposals — against paged KV + the in-flight
+                block suffix (ragged multi-query attention), computes
+                per-row ACCEPT LENGTHS on device, and fuses the bonus
+                token's sampling, so the host boundary stays (batch,)
+                ids + (batch,) accept counts whatever k is."""
+                saved = self._swap_params(param_arrays)
+                try:
+                    ctx = _TracedPagedContext(k_pages, v_pages, pg, sl,
+                                              lens, tables)
+                    with no_grad():
+                        hidden = model.model(wrap_array(block), pos,
+                                             paged_ctx=ctx)
+                        logits = model._logits_of(hidden)
+                    lg = logits._data.astype(jnp.float32)   # (B, S, V)
+                    # targets[b, s] = the target's own next token after
+                    # block[b, :s+1] — the greedy-exactness oracle
+                    targets = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                    match = (block[:, 1:] == targets[:, :-1]) \
+                        .astype(jnp.int32)
+                    accept = jnp.sum(jnp.cumprod(match, axis=1),
+                                     axis=1).astype(jnp.int32)  # (B,)
+                    pools = (tuple(ctx.k_pages), tuple(ctx.v_pages))
+                    if sample == "greedy":
+                        ids = jnp.take_along_axis(
+                            targets, accept[:, None], axis=1)[:, 0]
+                        return ids, accept, *pools
+                    bonus = jnp.take_along_axis(
+                        lg, accept[:, None, None], axis=1)[:, 0]
+                    if sample == "draw":
+                        seeds, temps, flags = sampling
+                        # the bonus token's absolute position — sampled
+                        # rows ride with accept == 0 (host feeds them
+                        # unmatched draft slots), so this replays the
+                        # plain decode path's (seed, position) draw
+                        ctrs = pos + accept + 1
+                        ids = fused_sample(bonus, seeds, ctrs, temps,
+                                           flags)
+                        return ids, accept, *pools
+                    return bonus, accept, *pools   # logits escape hatch
                 finally:
                     for p, s in zip(self.params, saved):
                         p._data = s
@@ -508,6 +565,74 @@ class JittedPagedDecoder:
         cache.v_pages = list(v_pages)
         return np.asarray(out)
 
+    @staticmethod
+    def _verify_sampling_args(sampling):
+        """Verify-tail variant of ``_sampling_args``: no host-side
+        counters — the bonus draw's position is ``pos + accept + 1``,
+        computed IN-PROGRAM from the device-side accept length."""
+        if sampling is None:
+            return False, ()
+        seeds, temps, flags = sampling
+        if not np.any(flags):
+            return "greedy", ()
+        return "draw", (jnp.asarray(np.asarray(seeds, np.uint32)),
+                        jnp.asarray(np.asarray(temps, np.float32)),
+                        jnp.asarray(np.asarray(flags, bool)))
+
+    def verify(self, cache: PagedKVCache, seq_ids, block_np,
+               positions_np, sampling=None):
+        """Speculative verify: score a (batch, S) token block — each
+        row's last fed token followed by S-1 draft proposals — in ONE
+        compiled multi-token step over the paged cache, replacing S-1
+        bandwidth-bound decode dispatches with one compute-dense pass.
+
+        block_np (batch, S) int32; positions_np (batch,) int32 — each
+        row's current length (the block's first rope position).  All S
+        positions' KV are written and the lengths advance by S; the
+        CALLER rolls back to the verified length with
+        ``cache.truncate(sid, pos + accept + 1)`` (the page-granular
+        partial rollback — pages stay mapped inside the admission
+        reservation, rejected slots are simply rewritten later).
+
+        Returns ``(out, accept)``: ``accept`` (batch,) int32 counts the
+        leading draft tokens the target reproduced; ``out`` is the
+        bonus token ids (batch,) int32 under fused sampling, or the
+        bonus position's logits row (batch, vocab) f32 on the
+        ``sampling=None`` escape hatch.  With ``sampling=(seeds,
+        temps, flags)`` sampled rows draw at position pos+accept+1 with
+        the same (seed, position) threefry key the plain decode path
+        uses."""
+        b, s = block_np.shape
+        if int(positions_np.max()) + s > self.max_position:
+            raise ValueError(
+                f"verify through position {int(positions_np.max()) + s} "
+                f"exceeds max_position_embeddings ({self.max_position})")
+        before = [cache.length(sid) for sid in seq_ids]
+        # all-or-nothing: mid-batch exhaustion must not strand rows
+        cache.allocate_batch_atomic(seq_ids, s)
+        pg, sl = cache.plan_write(seq_ids, s)
+        cache.advance(seq_ids, s)
+        needed = max(len(cache._seq_pages.get(sid, ()))
+                     for sid in seq_ids)
+        tabs, lens = cache.page_table(seq_ids,
+                                      max_pages=next_pow2(needed))
+        sample, s_args = self._verify_sampling_args(sampling)
+        try:
+            out, accept, k_pages, v_pages = self._program(
+                "verify", sample)(
+                [p._data for p in self.params],
+                jnp.asarray(block_np.astype(np.int32)),
+                jnp.asarray(positions_np.astype(np.int32)),
+                jnp.asarray(pg), jnp.asarray(sl), lens, tabs, s_args,
+                tuple(cache.k_pages), tuple(cache.v_pages))
+        except BaseException:
+            self._recover_pools(cache)
+            self._rollback_lengths(cache, seq_ids, before)
+            raise
+        cache.k_pages = list(k_pages)
+        cache.v_pages = list(v_pages)
+        return np.asarray(out), np.asarray(accept)
+
     def _build_multi(self):
         """Jitted N-step GREEDY decode: lax.scan over the single-step
         body with the page pools as carry — N tokens per host dispatch
@@ -565,6 +690,7 @@ class JittedPagedDecoder:
                 f"max_position_embeddings ({self.max_position})")
         if self._jitted_multi is None:
             self._jitted_multi = self._build_multi()
+        before = [cache.length(sid) for sid in seq_ids]
         # all-or-nothing: a mid-batch exhaustion must not leave earlier
         # rows hoarding a chunk's worth of pages the fallback then starves on
         cache.allocate_batch_atomic(seq_ids, n_steps)
@@ -587,7 +713,13 @@ class JittedPagedDecoder:
                 jnp.asarray(pos_steps), tabs,
                 tuple(cache.k_pages), tuple(cache.v_pages))
         except BaseException:
-            cache.reset_pools()
+            # same contract as step()/verify(): rebuild the donated
+            # pools only if they were actually consumed, and roll the
+            # lengths back so the exact chunk can be replayed — a
+            # host-side fault must not zero batchmates' KV (the engine's
+            # speculative draft cache rides on this)
+            self._recover_pools(cache)
+            self._rollback_lengths(cache, seq_ids, before)
             raise
         cache.k_pages = list(k_pages)
         cache.v_pages = list(v_pages)
@@ -739,8 +871,8 @@ class PagedGenerator:
                             np.full(b, pos, np.int32), n)
                     except RuntimeError as e:
                         if "out of pages" not in str(e):
-                            raise   # device failure: pools were reset —
-                            # continuing would decode an empty cache
+                            raise   # device failure: lengths rolled
+                            # back, but the chunk's KV content is gone
                         break       # pool pressure: per-token continuation
                     pieces.append(chunk[:, :remaining])
                     if done is not None:

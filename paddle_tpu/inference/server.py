@@ -227,7 +227,7 @@ class GenerationServer(_ServerLifecycle):
 
     POST /generate  {"input_ids": [[...], ...], "max_new_tokens": N,
                      "eos_token_id": id?, "do_sample": bool?,
-                     "temperature": float?}
+                     "temperature": float?, "draft": bool?}
         -> {"output_ids": [[...], ...], "new_tokens": N}
 
     Requests are CONTINUOUSLY BATCHED: every row of every in-flight HTTP
@@ -241,6 +241,14 @@ class GenerationServer(_ServerLifecycle):
     default; so do the resilience knobs ``max_queue`` /
     ``default_ttl_s`` / ``step_timeout_s`` (ISSUE 4), and a request
     body may set ``timeout_s`` as its own total TTL.
+
+    Speculative decoding (ISSUE 6): construct with ``draft_model`` and
+    greedy requests decode speculatively (``spec_tokens`` draft
+    proposals per step, bit-exact vs target-only greedy); a request
+    body may set ``"draft": false`` to opt out, or ``true`` to demand
+    it (400 if the server has no draft model).  ``/health`` reports
+    the draft pool; acceptance counters land in ``/metrics``
+    (``spec_*`` series).
 
     Error mapping (the resilience HTTP contract):
       400 = malformed request (bad JSON/shape, or prompt +
@@ -263,7 +271,9 @@ class GenerationServer(_ServerLifecycle):
                  prefix_cache: bool = True, access_log: bool = False,
                  max_queue: int = 256,
                  default_ttl_s: Optional[float] = None,
-                 step_timeout_s: Optional[float] = None):
+                 step_timeout_s: Optional[float] = None,
+                 draft_model=None, spec_tokens: int = 4,
+                 draft_total_pages: Optional[int] = None):
         from .continuous import (ContinuousBatchingEngine,
                                  DeadlineExceeded, EngineDraining,
                                  EngineSaturated)
@@ -273,7 +283,9 @@ class GenerationServer(_ServerLifecycle):
             model, total_pages=total_pages, page_size=page_size,
             max_batch=max_batch, sample_on_device=sample_on_device,
             prefix_cache=prefix_cache, max_queue=max_queue,
-            default_ttl_s=default_ttl_s, step_timeout_s=step_timeout_s)
+            default_ttl_s=default_ttl_s, step_timeout_s=step_timeout_s,
+            draft_model=draft_model, spec_tokens=spec_tokens,
+            draft_total_pages=draft_total_pages)
         self._count_lock = threading.Lock()
         self._request_count = 0
         self._drain_thread: Optional[threading.Thread] = None
@@ -289,7 +301,7 @@ class GenerationServer(_ServerLifecycle):
                     with self._track("/health"):
                         cache = outer._engine.cache
                         draining = outer._engine.draining
-                        self._reply(200, {
+                        payload = {
                             "status": "draining" if draining else "ok",
                             "draining": draining,
                             "uptime_s": round(outer.uptime_s, 3),
@@ -302,7 +314,18 @@ class GenerationServer(_ServerLifecycle):
                             "sampling_on_device":
                                 outer._engine.sample_on_device,
                             "active_sequences": len(outer._engine._active),
-                            "queued_sequences": len(outer._engine._queue)})
+                            "queued_sequences": len(outer._engine._queue),
+                            "speculative": outer._engine._spec}
+                        if outer._engine._spec:
+                            dc = outer._engine.draft_cache
+                            # capacity accounting must include the
+                            # draft cache (ISSUE 6 monitor satellite)
+                            payload.update({
+                                "spec_tokens": outer._engine.spec_k,
+                                "draft_free_pages": dc.free_pages,
+                                "draft_total_pages": dc.total_pages,
+                                "draft_pinned_pages": dc.pinned_pages})
+                        self._reply(200, payload)
                 elif self.path == "/metrics":
                     with self._track("/metrics"):
                         self._reply_text(200, monitor.prometheus_text())
@@ -334,6 +357,8 @@ class GenerationServer(_ServerLifecycle):
                         temperature = float(req.get("temperature", 1.0))
                         ttl = req.get("timeout_s")
                         ttl = None if ttl is None else float(ttl)
+                        draft = req.get("draft")
+                        draft = None if draft is None else bool(draft)
                         with outer._count_lock:
                             outer._request_count += 1
                             seed = int(req.get("seed",
@@ -346,7 +371,7 @@ class GenerationServer(_ServerLifecycle):
                         out = outer._engine.generate(
                             ids, max_new_tokens=max_new, eos_token_id=eos,
                             do_sample=do_sample, temperature=temperature,
-                            seed=seed, ttl_s=ttl)
+                            seed=seed, ttl_s=ttl, draft=draft)
                     except ValueError as e:      # request-shape problems
                         # e.g. prompt + max_new_tokens past the rope
                         # table: the CLIENT's request is wrong — 400,

@@ -16,8 +16,11 @@ prefix, and the first disagreement emits the target's own argmax).  The
 equivalence test in tests/test_speculative.py asserts that.
 
 KV caches are plain per-layer (k, v) concat caches (the eager
-LlamaModel cache path); rejected speculative suffixes are rolled back by
-slicing the cache on the sequence axis.
+LlamaModel cache path); rejected speculative suffixes are rolled back
+by :class:`_RollbackKV` — the pre-round cache stays alive as the base
+and only the appended block's ACCEPTED prefix is sliced out, so a
+rollback costs O(accepted tokens), never an O(T) full-cache rebuild
+(regression-locked in tests/test_speculative.py).
 """
 from __future__ import annotations
 
@@ -29,15 +32,60 @@ import jax.numpy as jnp
 
 from ..framework.tape import no_grad
 from ..framework.tensor import wrap_array
+from .. import tensor as _T
 
 
 from ..models.llama import empty_kv_caches as _empty_caches
 
 
-def _trim_caches(caches, length: int):
-    """Roll back every layer's (k, v) cache to ``length`` positions —
-    how rejected speculative tokens are undone."""
-    return [(k[:, :length], v[:, :length]) for k, v in caches]
+class _RollbackKV:
+    """Concat-KV cache with O(appended) rollback.
+
+    The materialized per-layer caches fed to the last forward stay
+    alive as ``base``; a speculative round's outcome is absorbed by
+    slicing ONLY the new block's accepted prefix into ``tail`` — never
+    by re-slicing the full [T]-length cache (the old ``_trim_caches``
+    rebuilt every layer's whole cache every round).  ``feed()`` merges
+    base+tail once, immediately before the next forward — where a
+    same-size concat (the model's own cache append) happens anyway, so
+    the merge adds no asymptotic cost while the rollback itself drops
+    from O(T) to O(accepted)."""
+
+    __slots__ = ("base", "tail")
+
+    def __init__(self, caches):
+        self.base = caches          # list[(k, v)], k/v (1, T, kvh, d)
+        self.tail = None
+
+    @property
+    def length(self) -> int:
+        n = int(self.base[0][0].shape[1])
+        if self.tail is not None:
+            n += int(self.tail[0][0].shape[1])
+        return n
+
+    def feed(self):
+        """Materialized per-layer caches for the next model() call
+        (merges any pending tail into the base, one concat per layer)."""
+        if self.tail is not None:
+            self.base = [
+                (_T.concat([bk, tk], axis=1), _T.concat([bv, tv], axis=1))
+                for (bk, bv), (tk, tv) in zip(self.base, self.tail)]
+            self.tail = None
+        return self.base
+
+    def absorb(self, full_caches, keep: int) -> None:
+        """Record a round's outcome: ``full_caches`` is what the model
+        returned (the fed base plus the appended block); keep the first
+        ``keep`` positions.  The base is untouched — identity-preserved,
+        the no-copy regression lock — and only [base_len:keep) is
+        sliced out of the block, O(keep - base_len) per layer."""
+        assert self.tail is None, "absorb() must follow a feed()"
+        base_len = int(self.base[0][0].shape[1])
+        if keep <= base_len:
+            return
+        self.tail = [(k[:, base_len:keep], v[:, base_len:keep])
+                     for k, v in full_caches]
 
 
 class SpeculativeGenerator:
@@ -82,13 +130,18 @@ class SpeculativeGenerator:
         t0 = _time.perf_counter()
         proposed = accepted = rounds = 0
         with no_grad():
-            tgt_cache = _empty_caches(self.target, 1)
-            dft_cache = _empty_caches(self.draft, 1)
             x = wrap_array(jnp.asarray(ids, jnp.int32))
             # prefill both models on the prompt
-            h, tgt_cache = self.target.model(x, 0, tgt_cache)
+            h, caches = self.target.model(x, 0,
+                                          _empty_caches(self.target, 1))
+            tgt = _RollbackKV(caches)
             nxt = int(self._argmax(self._logits(self.target, h[:, -1:]))[0])
-            _, dft_cache = self.draft.model(x, 0, dft_cache)
+            _, caches = self.draft.model(x, 0,
+                                         _empty_caches(self.draft, 1))
+            dft = _RollbackKV(caches)
+            # expose the live cache state for the rollback regression
+            # tests (identity of the base across a rejected round)
+            self._tgt_kv, self._dft_kv = tgt, dft
             out = list(ids[0]) + [nxt]
             # invariant: caches cover out[:-1]; out[-1] is unverified input
             while len(out) - ids.shape[1] < max_new_tokens:
@@ -100,28 +153,33 @@ class SpeculativeGenerator:
                 k = min(self.k, budget)
                 # the draft cache can trail L (an all-accepted round
                 # produces its last token without ever feeding it);
-                # ingest the gap in one forward before proposing
-                dft_len = int(dft_cache[0][0].shape[1])
+                # ingest the gap in one forward before proposing — gap
+                # tokens are VERIFIED, so the filled cache becomes the
+                # round's rollback base
+                dfeed = dft.feed()
+                dft_len = int(dfeed[0][0].shape[1])
                 if dft_len < L:
                     fill = wrap_array(jnp.asarray(
                         [out[dft_len:L]], jnp.int32))
-                    _, dft_cache = self.draft.model(fill, dft_len,
-                                                    dft_cache)
+                    _, dfeed = self.draft.model(fill, dft_len, dfeed)
+                    dft.base = dfeed
                 # ---- draft proposes k tokens autoregressively --------
                 draft_tokens = []
                 cur = out[-1]
+                dwork = dfeed
                 for _ in range(k):
                     step = wrap_array(jnp.asarray([[cur]], jnp.int32))
-                    dh, dft_cache = self.draft.model(
-                        step, L + len(draft_tokens), dft_cache)
+                    dh, dwork = self.draft.model(
+                        step, L + len(draft_tokens), dwork)
                     cur = int(self._argmax(
                         self._logits(self.draft, dh))[0])
                     draft_tokens.append(cur)
                 proposed += k
                 # ---- target verifies in ONE forward over k+1 tokens --
                 block = np.asarray([[out[-1]] + draft_tokens], np.int32)
-                th, tgt_cache = self.target.model(
-                    wrap_array(jnp.asarray(block)), L, tgt_cache)
+                tfeed = tgt.feed()
+                th, tfull = self.target.model(
+                    wrap_array(jnp.asarray(block)), L, tfeed)
                 tlogits = self._logits(self.target, th)
                 targets = np.asarray(jnp.argmax(
                     tlogits._data[0].astype(jnp.float32), axis=-1))
@@ -133,10 +191,12 @@ class SpeculativeGenerator:
                 emitted = draft_tokens[:n_ok] + [int(targets[n_ok])] \
                     if n_ok < k else draft_tokens + [int(targets[k])]
                 out.extend(emitted)
-                # ---- roll back both caches to the verified length ----
+                # ---- O(accepted) rollback: keep the fed base alive and
+                # slice only the accepted prefix out of the new block —
+                # rejected suffixes simply never enter the cache ----
                 new_len = len(out) - 1
-                tgt_cache = _trim_caches(tgt_cache, new_len)
-                dft_cache = _trim_caches(dft_cache, new_len)
+                tgt.absorb(tfull, new_len)
+                dft.absorb(dwork, min(new_len, L + k))
                 if eos_token_id is not None and eos_token_id in emitted:
                     cut = emitted.index(eos_token_id)
                     out = out[:len(out) - len(emitted) + cut + 1]

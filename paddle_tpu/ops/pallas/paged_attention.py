@@ -43,7 +43,15 @@ from ...testing import faults as _faults
 
 # ------------------------------------------------------------------ kernel
 def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, page_size):
+                   m_scr, l_scr, acc_scr, *, scale, page_size,
+                   n_query=1, group=1):
+    """Online-softmax paged attention for ``n_query`` query tokens per
+    sequence.  ``n_query == 1`` is the classic decode step; n_query > 1
+    is the RAGGED MULTI-QUERY verify path (speculative decoding): the
+    block's tokens are already scattered into the pages, ``lens`` counts
+    them, and query ``s`` of the block attends causally to
+    ``cols < length - (n_query - 1 - s)`` — per-row, per-query limits,
+    so variable accept lengths cost masking, not padding."""
     b = pl.program_id(0)
     p = pl.program_id(2)
     n_pages = pl.num_programs(2)
@@ -59,13 +67,18 @@ def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(valid)
     def _compute():
-        q = q_ref[0, 0]                         # (group, d)
+        q = q_ref[0, 0]                         # (n_query*group, d)
         k = k_ref[0, 0]                         # (page_size, d)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         cols = p * page_size + lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        s = jnp.where(cols < length, s, DEFAULT_MASK_VALUE)
+        # row r serves query position r // group of the block; its
+        # causal window ends (n_query - 1 - qpos) tokens short of the
+        # full length (the later block tokens it must not see)
+        qpos = lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+        limit = length - (n_query - 1 - qpos)
+        s = jnp.where(cols < limit, s, DEFAULT_MASK_VALUE)
 
         m_prev = m_scr[:, :1]
         m_next = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -87,50 +100,65 @@ def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _decode_pallas(q, k_pages, v_pages, lengths, page_tables, scale,
-                   interpret=False):
-    batch, q_heads, d = q.shape
+                   interpret=False, n_query=1):
+    """``q`` is (batch, q_heads, d) for n_query == 1, else
+    (batch, n_query, q_heads, d)."""
+    if n_query == 1:
+        batch, q_heads, d = q.shape
+    else:
+        batch, _nq, q_heads, d = q.shape
     kv_heads, _tot, page_size, _d = k_pages.shape
     group = q_heads // kv_heads
     max_pages = page_tables.shape[1]
+    rows = n_query * group
 
     # (batch, q_heads, d) -> (batch, kv_heads, group, d): the kv-head
     # group rides as its own FULL axis so the q block's trailing dims
     # (group, d) match the array dims exactly — Mosaic requires trailing
     # block dims divisible by (8, 128) or spanning the whole axis, and
-    # group (e.g. 3) satisfies neither as a partial slice of q_heads
-    q4 = q.reshape(batch, kv_heads, group, d)
+    # group (e.g. 3) satisfies neither as a partial slice of q_heads.
+    # Multi-query folds the query axis in as well (row = s*group + g).
+    if n_query == 1:
+        q4 = q.reshape(batch, kv_heads, group, d)
+    else:
+        q4 = q.reshape(batch, n_query, kv_heads, group, d) \
+             .transpose(0, 2, 1, 3, 4).reshape(batch, kv_heads, rows, d)
 
     kernel = functools.partial(_decode_kernel, scale=scale,
-                               page_size=page_size)
+                               page_size=page_size, n_query=n_query,
+                               group=group)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,          # lengths, page_tables
         grid=(batch, kv_heads, max_pages),
         in_specs=[
-            pl.BlockSpec((1, 1, group, d),
+            pl.BlockSpec((1, 1, rows, d),
                          lambda b, h, p, lens, tabs: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, page_size, d),
                          lambda b, h, p, lens, tabs: (h, tabs[b, p], 0, 0)),
             pl.BlockSpec((1, 1, page_size, d),
                          lambda b, h, p, lens, tabs: (h, tabs[b, p], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, group, d),
+        out_specs=pl.BlockSpec((1, 1, rows, d),
                                lambda b, h, p, lens, tabs: (b, h, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((batch, kv_heads, group, d),
+        out_shape=jax.ShapeDtypeStruct((batch, kv_heads, rows, d),
                                        q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(lengths, page_tables, q4, k_pages, v_pages)
-    return out.reshape(batch, q_heads, d)
+    if n_query == 1:
+        return out.reshape(batch, q_heads, d)
+    return out.reshape(batch, kv_heads, n_query, group, d) \
+        .transpose(0, 2, 1, 3, 4).reshape(batch, n_query, q_heads, d)
 
 
 def _decode_xla(q, k_pages, v_pages, lengths, page_tables, scale):
@@ -159,6 +187,39 @@ def _decode_xla(q, k_pages, v_pages, lengths, page_tables, scale):
     return jnp.einsum("bhk,bhkd->bhd", p.astype(v.dtype), v).astype(q.dtype)
 
 
+def _multi_xla(q, k_pages, v_pages, lengths, page_tables, scale):
+    """Gather + dense masked multi-query attention (CPU fallback /
+    correctness reference for the ragged verify path)."""
+    batch, n_query, q_heads, d = q.shape
+    kv_heads, _tot, page_size, _d = k_pages.shape
+    group = q_heads // kv_heads
+    max_tokens = page_tables.shape[1] * page_size
+
+    def gather(pages):
+        g = jnp.take(pages, page_tables, axis=1)
+        return g.transpose(1, 0, 2, 3, 4).reshape(
+            batch, kv_heads, max_tokens, d)
+
+    k = gather(k_pages)
+    v = gather(v_pages)
+    if group != 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    qt = q.transpose(0, 2, 1, 3)                  # (b, qh, nq, d)
+    s = jnp.einsum("bhsd,bhtd->bhst", qt, k,
+                   preferred_element_type=jnp.float32) * scale
+    cols = jnp.arange(max_tokens, dtype=jnp.int32)[None, None, None, :]
+    # query s of the block sees cols < length - (n_query - 1 - s): the
+    # per-row, per-query ragged causal limit
+    qpos = jnp.arange(n_query, dtype=jnp.int32)[None, None, :, None]
+    limit = (lengths[:, None, None, None]
+             - (n_query - 1 - qpos)).astype(jnp.int32)
+    s = jnp.where(cols < limit, s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhst,bhtd->bhsd", p.astype(v.dtype), v)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
 def paged_attention(q, k_pages, v_pages, lengths, page_tables, scale=None,
                     interpret=False):
     """Decode-step attention over a paged KV cache.
@@ -175,6 +236,36 @@ def paged_attention(q, k_pages, v_pages, lengths, page_tables, scale=None,
         return _decode_pallas(q, k_pages, v_pages, lengths, page_tables,
                               scale, interpret=interpret)
     return _decode_xla(q, k_pages, v_pages, lengths, page_tables, scale)
+
+
+def paged_attention_multi(q, k_pages, v_pages, lengths, page_tables,
+                          scale=None, interpret=False):
+    """Ragged MULTI-QUERY decode attention: ``n_query`` new tokens per
+    sequence in one pass — the speculative-decoding verify step's
+    attention ("Ragged Paged Attention" shape: [B, k] queries against
+    paged KV + the in-flight block suffix).
+
+    q:           (batch, n_query, q_heads, head_dim) — the verify block,
+                 whose K/V are ALREADY scattered into the pages
+    lengths:     (batch,) int32 — valid cached tokens per sequence
+                 INCLUDING the whole block; query ``s`` attends
+                 causally to ``cols < length - (n_query - 1 - s)``
+    page_tables: (batch, max_pages_per_seq) int32
+
+    Returns (batch, n_query, q_heads, head_dim).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if q.shape[1] == 1:
+        out = paged_attention(q[:, 0], k_pages, v_pages, lengths,
+                              page_tables, scale=scale,
+                              interpret=interpret)
+        return out[:, None]
+    if _use_pallas() or interpret:
+        return _decode_pallas(q, k_pages, v_pages, lengths, page_tables,
+                              scale, interpret=interpret,
+                              n_query=q.shape[1])
+    return _multi_xla(q, k_pages, v_pages, lengths, page_tables, scale)
 
 
 # ------------------------------------------------------------- page cache

@@ -154,6 +154,46 @@ class TestEngineDecodeAudit:
             assert not audit.by_rule("missed-donation"), audit.report()
 
 
+class TestEngineVerifyAudit:
+    """ISSUE 6 CI satellite: the speculative verify program is certified
+    transfer-free (ids + accept counts only), donation-intact on BOTH
+    page pools, and free of baked [B, k]-shaped host constants — the
+    draft block must ride as a traced argument, never a const."""
+
+    def _spec_engine(self):
+        from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+        return ContinuousBatchingEngine(
+            _tiny_model(), total_pages=32, page_size=8, max_batch=4,
+            draft_model=_tiny_model(), spec_tokens=3)
+
+    def test_verify_is_transfer_free_and_bakes_no_block(self):
+        with self._spec_engine() as eng:
+            audit = analysis.audit_engine(eng, mode="verify")
+            assert audit.host_transfer_findings == [], audit.report()
+            # no [B, k]-shaped (or any other) host constant baked in
+            assert not audit.by_rule("const-capture"), audit.report()
+            # the fused-draw variant keeps the same contract
+            draw = analysis.audit_engine(eng, mode="verify",
+                                         sample="draw")
+            assert draw.host_transfer_findings == [], draw.report()
+            assert not draw.by_rule("const-capture"), draw.report()
+
+    def test_verify_keeps_both_pools_donated(self):
+        with self._spec_engine() as eng:
+            pool_bytes = int(np.prod(eng.cache.k_pages[0].shape)) * 4
+            audit = analysis.audit_engine(eng, mode="verify",
+                                          donation_bytes=pool_bytes)
+            assert not audit.by_rule("missed-donation"), audit.report()
+            assert not audit.by_rule("output-transfer"), audit.report()
+
+    def test_verify_mode_requires_draft_engine(self):
+        from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+        with ContinuousBatchingEngine(_tiny_model(), total_pages=32,
+                                      page_size=8) as eng:
+            with pytest.raises(ValueError, match="draft_model"):
+                analysis.audit_engine(eng, mode="verify")
+
+
 class TestStaticProgramAudit:
     def test_program_audit_clean_math(self):
         prog = paddle.static.Program()

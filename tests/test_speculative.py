@@ -94,3 +94,51 @@ class TestSpeculativeMoeTarget:
         got = SpeculativeGenerator(target, draft, 3).generate(
             x, max_new_tokens=12)
         np.testing.assert_array_equal(ref, got)
+
+
+class TestRollbackNeverCopiesFullCache:
+    """ISSUE 6 satellite: rejected speculative suffixes roll back by
+    slicing only the APPENDED block — the pre-round cache survives by
+    identity, never as a fresh O(T) copy (the old _trim_caches rebuilt
+    every layer's full cache every round)."""
+
+    def test_absorb_preserves_base_identity_and_slices_only_tail(self):
+        import jax.numpy as jnp
+        from paddle_tpu.framework.tensor import wrap_array
+        from paddle_tpu.inference.speculative import _RollbackKV
+
+        T, k, accepted = 10, 4, 2
+        base = [(wrap_array(jnp.zeros((1, T, 2, 8))),
+                 wrap_array(jnp.zeros((1, T, 2, 8))))]
+        kv = _RollbackKV(base)
+        fed = kv.feed()
+        assert fed is base and fed[0][0] is base[0][0]   # no-op merge
+        full = [(wrap_array(jnp.ones((1, T + k + 1, 2, 8))),
+                 wrap_array(jnp.ones((1, T + k + 1, 2, 8))))]
+        kv.absorb(full, T + accepted + 1)
+        # the base was NOT rebuilt: same objects, untouched
+        assert kv.base is base and kv.base[0][0] is base[0][0]
+        # only the accepted prefix of the block was sliced out
+        assert int(kv.tail[0][0].shape[1]) == accepted + 1
+        assert kv.length == T + accepted + 1
+        merged = kv.feed()
+        assert int(merged[0][0].shape[1]) == T + accepted + 1
+        assert kv.tail is None
+
+    def test_generator_rollback_keeps_base_alive_across_rounds(self):
+        """After a full generate() with a rejecting draft, the live
+        cache state must show base+tail structure (identity-preserving
+        absorb ran) and output stays exact."""
+        target, draft = _model(2, 5), _model(2, 77)
+        x = _prompt(n=6, seed=5)
+        ref = np.asarray(target.generate(x, max_new_tokens=10))
+        gen = SpeculativeGenerator(target, draft,
+                                   num_speculative_tokens=3)
+        got = gen.generate(x, max_new_tokens=10)
+        np.testing.assert_array_equal(ref, got)
+        assert gen.last_stats["accepted"] < gen.last_stats["proposed"], \
+            "draft never rejected — rollback path unexercised"
+        # the generator exposes its rollback caches; a completed run
+        # leaves them consistent with the emitted length
+        covered = gen._tgt_kv.length
+        assert covered == got.shape[1] - 1 or covered == got.shape[1]

@@ -106,6 +106,26 @@ class TestServeBench:
         assert out["jit_recompiles"] == 0
         assert out["failed_requests"] == 0
 
+    def test_speculative_lane_gate(self, capsys):
+        # ISSUE 6 CI satellite: the spec lane (tiny clone draft + the
+        # target, CPU backend) must accept ~everything, beat the plain
+        # engine's max_batch-tokens-per-step ceiling, and stay
+        # compile-free in the measured window — main() gates on all
+        # three
+        sb = self._load()
+        assert sb.main(["--draft", "--spec-k=2",
+                        "--sharers=3", "--uniques=2"]) == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert out["speculative"] is True
+        assert out["spec_proposed_tokens"] > 0
+        assert out["spec_accept_rate"] >= 0.7      # clone draft
+        assert out["spec_accepted_tokens"] <= out["spec_proposed_tokens"]
+        assert out["tokens_per_step"] > out["max_batch"]
+        assert out["spec_accept_len_mean"] is not None
+        assert out["jit_recompiles"] == 0
+        assert out["failed_requests"] == 0
+
     def test_fault_plan_lane_recovers(self, capsys):
         # ISSUE 4: --fault-plan injects failures into the measured
         # wave; the gate passes only if the blast radius stays inside

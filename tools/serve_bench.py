@@ -13,6 +13,16 @@ prefix_cache=False`` — diffing the two JSON lines is the before/after
 evidence for the hot-path PR.  Exit 0 = ran and (non-baseline) saw a
 nonzero prefix hit rate; 1 = broken.  tests/test_tools.py runs main()
 as a tier-1 gate, `python tools/serve_bench.py` is the standalone lane.
+
+Speculative lane (ISSUE 6): ``--draft`` serves the same workload
+through the engine's speculative path — the draft is a CLONE of the
+target degraded by ``--draft-noise=<sigma>`` weight noise, so the
+acceptance rate is a turnable knob (0.0 = perfect draft, accept ~1.0).
+``--sweep`` emits one JSON line per noise level plus a no-draft
+baseline, turning accept-rate vs tokens/sec vs TTFT into a curve; all
+numbers are monitor.snapshot() deltas (``spec_*`` counters + the
+``spec_accept_len`` histogram) and the measured window still gates
+``jit_recompiles == 0``.
 """
 from __future__ import annotations
 
@@ -67,7 +77,8 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
               vocab: int = 64, hidden: int = 32, do_sample: bool = False,
               sample_on_device: bool = True,
               prefix_cache: bool = True, seed: int = 0,
-              fault_plan=None) -> dict:
+              fault_plan=None, draft: bool = False, spec_k: int = 3,
+              draft_noise: float = 0.0, draft_model=None) -> dict:
     """Run the mixed shared-prefix workload; return the metrics dict
     (everything monitor-sourced).  The tiny default model keeps the CI
     gate fast; ``--vocab``/``--hidden`` grow it so the host-boundary
@@ -77,7 +88,12 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
     (dict/JSON/FaultPlan) installed for the MEASURED wave only — the
     chaos lane proving throughput recovers after injected failures,
     with the quarantine/retry counters quoted from the same
-    ``monitor.snapshot()`` deltas as everything else."""
+    ``monitor.snapshot()`` deltas as everything else.
+
+    ``draft`` (ISSUE 6): speculative lane — the draft model is a clone
+    of the target with ``draft_noise``-sigma Gaussian weight noise, so
+    acceptance degrades continuously from ~1.0 at noise 0 (callers may
+    pass an explicit ``draft_model`` instead)."""
     import numpy as np
     from paddle_tpu import monitor
     from paddle_tpu.inference.continuous import ContinuousBatchingEngine
@@ -88,16 +104,40 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
     # bucket/shape leak the program auditor should be pointed at
     monitor.install_compile_hooks()
 
+    draft_built = False
     if model is None:
         import paddle_tpu as paddle
         from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-        paddle.seed(0)
-        cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
-                          intermediate_size=2 * hidden,
-                          num_hidden_layers=2,
-                          num_attention_heads=4, num_key_value_heads=2,
-                          max_position_embeddings=128)
-        model = LlamaForCausalLM(cfg)
+
+        def build():
+            paddle.seed(0)
+            cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                              intermediate_size=2 * hidden,
+                              num_hidden_layers=2,
+                              num_attention_heads=4, num_key_value_heads=2,
+                              max_position_embeddings=128)
+            return LlamaForCausalLM(cfg)
+
+        model = build()
+        if draft and draft_model is None:
+            draft_model = build()        # same seed -> identical weights
+            draft_built = True
+            if draft_noise:
+                # degrade ONLY the bench-built clone — a caller-supplied
+                # draft_model is never mutated
+                import jax.numpy as jnp
+                nrng = np.random.default_rng(1234)
+                for p in draft_model.parameters():
+                    a = p._data
+                    p._data = a + jnp.asarray(
+                        nrng.normal(0.0, draft_noise, a.shape), a.dtype)
+    if draft and draft_model is None:
+        raise ValueError("--draft with an explicit model needs an "
+                         "explicit draft_model too")
+    if draft and draft_noise and not draft_built:
+        raise ValueError("draft_noise only degrades the bench-built "
+                         "clone; pre-degrade an explicit draft_model "
+                         "yourself")
 
     rng = np.random.default_rng(seed)
     # the shared system prompt must cover full pages (page_size 8 below)
@@ -127,7 +167,9 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
     with ContinuousBatchingEngine(
             model, total_pages=128, page_size=8, max_batch=MAX_BATCH,
             sample_on_device=sample_on_device,
-            prefix_cache=prefix_cache) as eng:
+            prefix_cache=prefix_cache,
+            draft_model=draft_model if draft else None,
+            spec_tokens=spec_k) as eng:
         # unmeasured warm-up: compiles the cold-prefill and suffix
         # (prefix-hit) prefill and seeds the prefix cache with the
         # system prompt (sequenced: the second sharer must be admitted
@@ -183,7 +225,25 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
     hits = _counter_delta(before, after, "prefix_cache_hits_total")
     hit_tokens = _counter_delta(before, after,
                                 "prefix_cache_hit_tokens_total")
+    sp = _counter_delta(before, after, "spec_proposed_tokens_total")
+    sa = _counter_delta(before, after, "spec_accepted_tokens_total")
+    sr = _counter_delta(before, after, "spec_rollback_total")
+    _, al_sum, al_n = _hist_delta(before, after, "spec_accept_len")
     return {
+        # speculative lane (ISSUE 6): acceptance economics of the
+        # measured window; tokens_per_step is the structural win — a
+        # plain engine cannot exceed max_batch (one token per row per
+        # compiled step), speculation can
+        "max_batch": MAX_BATCH,
+        "speculative": bool(draft),
+        "spec_k": int(spec_k) if draft else None,
+        "draft_noise": float(draft_noise) if draft else None,
+        "spec_proposed_tokens": int(sp),
+        "spec_accepted_tokens": int(sa),
+        "spec_accept_rate": (sa / sp) if sp else None,
+        "spec_accept_len_mean": (al_sum / al_n) if al_n else None,
+        "spec_rollbacks": int(sr),
+        "tokens_per_step": (tokens / dec_n) if dec_n else None,
         "requests": len(reqs),
         "failed_requests": failed,
         "sample_on_device": bool(sample_on_device),
@@ -222,6 +282,11 @@ def _int_arg(argv, name, default):
                  if a.startswith(f"--{name}=")), default)
 
 
+def _float_arg(argv, name, default):
+    return next((float(a.split("=", 1)[1]) for a in argv
+                 if a.startswith(f"--{name}=")), default)
+
+
 def _fault_plan_arg(argv):
     """--fault-plan=<inline JSON or @path> -> FaultPlan or None."""
     spec = next((a.split("=", 1)[1] for a in argv
@@ -239,17 +304,62 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     baseline = "--baseline" in argv
     plan = _fault_plan_arg(argv)
-    out = run_bench(sharers=_int_arg(argv, "sharers", 6),
-                    uniques=_int_arg(argv, "uniques", 3),
-                    system_tokens=_int_arg(argv, "system-tokens", 16),
-                    max_new_tokens=_int_arg(argv, "max-new-tokens", 8),
-                    vocab=_int_arg(argv, "vocab", 64),
-                    hidden=_int_arg(argv, "hidden", 32),
-                    do_sample="--sample" in argv,
-                    sample_on_device=not baseline,
-                    prefix_cache=not baseline,
-                    fault_plan=plan)
+    kw = dict(sharers=_int_arg(argv, "sharers", 6),
+              uniques=_int_arg(argv, "uniques", 3),
+              system_tokens=_int_arg(argv, "system-tokens", 16),
+              max_new_tokens=_int_arg(argv, "max-new-tokens", 8),
+              vocab=_int_arg(argv, "vocab", 64),
+              hidden=_int_arg(argv, "hidden", 32),
+              do_sample="--sample" in argv,
+              sample_on_device=not baseline,
+              prefix_cache=not baseline,
+              fault_plan=plan)
+    spec_k = _int_arg(argv, "spec-k", 3)
+    if "--sweep" in argv:
+        # acceptance-rate sweep: a no-draft baseline line, then the
+        # speculative lane at increasing draft degradation — the
+        # accept-rate/tokens-per-sec/TTFT curve in raw JSON lines.
+        # An explicit --draft-noise joins the ladder rather than being
+        # silently ignored.
+        base = run_bench(**kw)
+        print(json.dumps(base, sort_keys=True))
+        ok = base["generated_tokens"] > 0
+        levels = sorted({0.0, 0.03, 0.1, 0.5,
+                         _float_arg(argv, "draft-noise", 0.0)})
+        for noise in levels:
+            out = run_bench(draft=True, spec_k=spec_k,
+                            draft_noise=noise, **kw)
+            out["baseline_tokens_per_sec"] = base["tokens_per_sec"]
+            out["baseline_ttft_p50_s"] = base["ttft_p50_s"]
+            print(json.dumps(out, sort_keys=True))
+            ok = ok and out["generated_tokens"] > 0 \
+                and out["jit_recompiles"] == 0
+            if noise == 0.0:
+                # a perfect draft must accept ~everything and beat the
+                # plain engine's hard ceiling of max_batch tokens per
+                # compiled decode step
+                ok = ok and out["spec_accept_rate"] is not None \
+                    and out["spec_accept_rate"] >= 0.7 \
+                    and out["tokens_per_step"] > out["max_batch"]
+        return 0 if ok else 1
+    out = run_bench(draft="--draft" in argv, spec_k=spec_k,
+                    draft_noise=_float_arg(argv, "draft-noise", 0.0),
+                    **kw)
     print(json.dumps(out, sort_keys=True))
+    if "--draft" in argv and plan is None:
+        if not out["spec_proposed_tokens"]:
+            print("FAIL: speculative lane proposed nothing",
+                  file=sys.stderr)
+            return 1
+        if _float_arg(argv, "draft-noise", 0.0) == 0.0 \
+                and (out["spec_accept_rate"] < 0.7
+                     or out["tokens_per_step"] <= out["max_batch"]):
+            print(f"FAIL: clone draft accept rate "
+                  f"{out['spec_accept_rate']:.3f} / "
+                  f"{out['tokens_per_step']:.2f} tokens per step — the "
+                  "verify step is not converting acceptance into "
+                  "multi-token advances", file=sys.stderr)
+            return 1
     if out["generated_tokens"] <= 0 or out["decode_steps"] <= 0:
         print("FAIL: bench decoded nothing", file=sys.stderr)
         return 1
