@@ -97,8 +97,14 @@ _MUTATOR_METHODS = {"append", "appendleft", "extend", "extendleft",
 #: entries as new scheduler-shaped classes land.
 LOCK_CLASSES: Dict[str, Tuple[str, frozenset]] = {
     "ContinuousBatchingEngine": ("_cond", frozenset({
-        "_queue", "_active", "_reserved_pages", "_reserved_draft_pages",
-        "_next_seq", "_stop", "_draining", "_admitting", "steps"})),
+        "_active", "_reserved_pages", "_reserved_draft_pages",
+        "_next_seq", "_stop", "_draining", "steps",
+        # heterogeneous-workload scheduler state (ISSUE 7): the
+        # admission queues (WorkloadScheduler has no lock of its own —
+        # every mutation must happen under the engine's _cond) and the
+        # mid-prefill lists the drain/reap/preemption paths walk
+        # (these replaced the pre-PR-7 _queue/_admitting attributes)
+        "_sched", "_prefilling", "_preempted"})),
 }
 
 

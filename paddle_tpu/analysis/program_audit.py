@@ -479,15 +479,20 @@ def audit_engine(engine, mode: str = "decode", sample=None,
     invariant, extended to the speculative hot path).  The verify audit
     also proves no ``[B, k]``-shaped draft block was baked in as a
     constant (the block rides as a traced argument) and that BOTH page
-    pools stay donated.  ``per_row_budget`` is the allowed
-    host-transfer bytes per batch row (ids are 4; ids + accept are 8; a
-    logits row is vocab*4)."""
+    pools stay donated.  ``mode="chunk"`` audits the CHUNKED-PREFILL
+    continuation program (ISSUE 7; shared with the prefix-cache suffix
+    path): one chunk's token bucket rides as a traced argument with the
+    context length/table traced alongside, so the audit proves the
+    chunk loop is transfer-free with donation intact — interleaving
+    chunk sizes can never smuggle a host sync into the serving loop.
+    ``per_row_budget`` is the allowed host-transfer bytes per batch row
+    (ids are 4; ids + accept are 8; a logits row is vocab*4)."""
     import jax.numpy as jnp
     from ..inference.paged import next_pow2
 
-    if mode not in ("decode", "verify"):
-        raise ValueError(f"audit_engine supports mode='decode' or "
-                         f"'verify', got {mode!r}")
+    if mode not in ("decode", "verify", "chunk"):
+        raise ValueError(f"audit_engine supports mode='decode', "
+                         f"'verify' or 'chunk', got {mode!r}")
     if mode == "verify" and not getattr(engine, "_spec", False):
         raise ValueError("mode='verify' needs an engine built with a "
                          "draft_model")
@@ -495,7 +500,11 @@ def audit_engine(engine, mode: str = "decode", sample=None,
     cache = engine.cache
     if sample is None:
         sample = "greedy" if engine.sample_on_device else False
-    fn, donate = decoder.program_fn(mode, sample)
+    # the chunk continuation compiles the "prefix" program (the context
+    # length is traced, so prefix-hit suffixes and mid-prompt chunks
+    # share one compiled program per bucket shape)
+    fn, donate = decoder.program_fn(
+        "prefix" if mode == "chunk" else mode, sample)
     # the engine's decode buckets are min(next_pow2(active), max_batch),
     # so max_batch IS the largest program shape serving ever compiles —
     # audit that one, not its power-of-two round-up
@@ -507,7 +516,22 @@ def audit_engine(engine, mode: str = "decode", sample=None,
               for p in decoder.params]
     k_pages = tuple(sds(tuple(a.shape), a.dtype) for a in cache.k_pages)
     v_pages = tuple(sds(tuple(a.shape), a.dtype) for a in cache.v_pages)
-    if mode == "verify":
+    if mode == "chunk":
+        # the engine dispatches chunks per request (batch 1) at the
+        # configured chunk bucket; fn signature: (params, ids,
+        # last_idx, pg, sl, ptabs, plens, sampling, pools)
+        B = 1
+        S = next_pow2(int(engine.prefill_chunk_tokens or 64))
+        if sample == "draw":
+            s_args = (sds((B,), jnp.uint32), sds((B,), i32),
+                      sds((B,), jnp.float32), sds((B,), jnp.bool_))
+        else:
+            s_args = ()
+        args = (params, sds((B, S), i32), sds((B,), i32),
+                sds((B * S,), i32), sds((B * S,), i32),
+                sds((B, W), i32), sds((B,), i32), s_args,
+                k_pages, v_pages)
+    elif mode == "verify":
         S = engine.spec_k + 1
         if sample == "draw":
             s_args = (sds((B,), jnp.uint32), sds((B,), jnp.float32),

@@ -412,6 +412,12 @@ from .continuous import (  # noqa: E402,F401
 __all__ += ["continuous", "ContinuousBatchingEngine", "EngineSaturated",
             "EngineDraining", "DeadlineExceeded", "RequestCancelled"]
 
+from . import scheduler  # noqa: E402,F401  (workload scheduling)
+from .scheduler import (  # noqa: E402,F401
+    DEFAULT_CLASSES, PriorityClass, WorkloadScheduler)
+__all__ += ["scheduler", "PriorityClass", "WorkloadScheduler",
+            "DEFAULT_CLASSES"]
+
 from . import speculative  # noqa: E402,F401  (draft-verify decoding)
 from .speculative import SpeculativeGenerator  # noqa: E402,F401
 __all__ += ["speculative", "SpeculativeGenerator"]

@@ -58,23 +58,51 @@ Speculative decoding (ISSUE 6):
     draft-side failures DOWNGRADE the affected requests to plain decode
     instead of quarantining them — speculation is an optimization, so
     a broken draft must never fail a request.
+
+Heterogeneous workloads (ISSUE 7):
+
+  * admission and step composition are delegated to a
+    :class:`~paddle_tpu.inference.scheduler.WorkloadScheduler` —
+    ``submit(priority=..., tenant=...)`` routes into per-class,
+    per-tenant bounded queues served by weighted deficit-round-robin
+    (see scheduler.py for the policy contract);
+  * **chunked prefill** — with ``prefill_chunk_tokens`` set, each
+    engine iteration runs at most ~one chunk budget of prefill before
+    the decode step, so a long prompt can no longer stall every
+    interactive sequence's next token behind a monolithic prefill;
+    chunk boundaries are position-derived (never timing-derived), KV
+    pages fill incrementally through the SAME compiled context-prefill
+    program the prefix cache uses, and greedy output is bit-identical
+    to unchunked prefill (prefix-cache acquire still happens once, at
+    admission);
+  * **preemption** — a preemptible class's mid-prefill request can be
+    PAUSED (slot handed to more urgent traffic) and later resumed: it
+    keeps its seq id, its written pages and its reservation, and
+    continues from the next chunk — it never re-prefills;
+  * per-class SLO series (queue-wait / TTFT / TPOT histograms,
+    admission / preemption / chunk counters) land in ``monitor``
+    labeled ``cls=<class>``; ``/health`` reports queue depths and the
+    active policy knobs.
 """
 from __future__ import annotations
 
 import math
 import threading
 import time
-from collections import deque, namedtuple
-from typing import Deque, List, Optional
+from collections import namedtuple
+from typing import List, Optional
 
 import numpy as np
 from .. import monitor
 from ..ops.pallas.paged_attention import PagedKVCache
 from ..testing import faults as _faults
+from .scheduler import (DEFAULT_CLASS, PriorityClass, QueueFull,
+                        WorkloadScheduler)
 
 __all__ = [
     "ContinuousBatchingEngine", "EngineSaturated", "EngineDraining",
     "DeadlineExceeded", "RequestCancelled", "retry_after_seconds",
+    "PriorityClass", "WorkloadScheduler",
 ]
 
 _PAD_SEQ = "__pad__"
@@ -218,7 +246,8 @@ class _Request:
     """One sequence's life in the engine."""
 
     def __init__(self, prompt, max_new_tokens, eos_token_id, do_sample,
-                 temperature, seed, ttl_s=None, queue_timeout_s=None):
+                 temperature, seed, ttl_s=None, queue_timeout_s=None,
+                 priority=None, tenant="default"):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
@@ -227,6 +256,16 @@ class _Request:
         self.seed = int(seed) & 0xFFFFFFFF   # on-device threefry seed
         self.rng = np.random.default_rng(seed)
         self.prefix_tokens = 0               # prompt tokens shared at admit
+        # heterogeneous-workload scheduling (ISSUE 7): the class/tenant
+        # the scheduler queues this request under, and the chunked
+        # prefill cursor (prompt tokens already resident in the cache —
+        # a preempted request resumes from here, never re-prefills)
+        self.priority = priority             # normalized by the scheduler
+        self.tenant = str(tenant)
+        self.prefill_pos = 0
+        self.chunks_done = 0
+        self.admitted_at: Optional[float] = None
+        self._admit_plan = None          # (need, shared_tok) fit-check stash
         # speculative decoding (ISSUE 6): set by the engine at submit;
         # _draft_reserved tracks whether draft-pool reservation is held
         self.use_draft = False
@@ -317,8 +356,10 @@ class ContinuousBatchingEngine:
     pool pressure) so a request sharing a cached prefix maps those
     pages read-only and prefills only its suffix.
 
-    Resilience knobs (ISSUE 4): ``max_queue`` bounds the admission
-    queue (overflow raises :class:`EngineSaturated`);
+    Resilience knobs (ISSUE 4): ``max_queue`` bounds EACH scheduling
+    class's admission queue (overflow raises :class:`EngineSaturated`
+    naming the class; per-class overrides via
+    ``PriorityClass.max_queue``);
     ``default_ttl_s`` / ``default_queue_timeout_s`` set engine-wide
     deadlines each ``submit`` may override; ``step_timeout_s``
     registers a heartbeat with the comm watchdog so a wedged device
@@ -330,6 +371,13 @@ class ContinuousBatchingEngine:
     Requests opt out per-call (``submit(draft=False)``); the draft
     holds its own page pool (``draft_total_pages``, default the
     target's size) whose pages move in lockstep with the target's.
+
+    Workload scheduling (ISSUE 7): ``prefill_chunk_tokens`` caps
+    per-iteration prefill so long prompts interleave with decode;
+    ``scheduler_classes`` / ``default_class`` configure the priority
+    taxonomy (``submit(priority=..., tenant=...)``);
+    ``min_table_pages`` pins compiled page-table widths so
+    mixed-length serving stays recompile-free.
     """
 
     def __init__(self, model, total_pages: int = 512, page_size: int = 16,
@@ -339,7 +387,11 @@ class ContinuousBatchingEngine:
                  default_queue_timeout_s: Optional[float] = None,
                  step_timeout_s: Optional[float] = None,
                  draft_model=None, spec_tokens: int = 4,
-                 draft_total_pages: Optional[int] = None):
+                 draft_total_pages: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 scheduler_classes=None,
+                 default_class: str = DEFAULT_CLASS,
+                 min_table_pages: int = 1):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_position = int(model.config.max_position_embeddings)
@@ -349,6 +401,14 @@ class ContinuousBatchingEngine:
         self.default_ttl_s = default_ttl_s
         self.default_queue_timeout_s = default_queue_timeout_s
         self.step_timeout_s = step_timeout_s
+        # heterogeneous-workload knobs (ISSUE 7): the per-step prefill
+        # token budget (None = monolithic prefill, the historical
+        # behavior) and the class taxonomy admission is scheduled under
+        if prefill_chunk_tokens is not None \
+                and int(prefill_chunk_tokens) < 1:
+            raise ValueError("prefill_chunk_tokens must be >= 1 or None")
+        self.prefill_chunk_tokens = (None if prefill_chunk_tokens is None
+                                     else int(prefill_chunk_tokens))
         _sampling_on_device_g.set(int(self.sample_on_device))
         # runtime mirror of the analysis auditor's recompile rules:
         # every XLA compile the decode loop triggers shows up in
@@ -357,7 +417,8 @@ class ContinuousBatchingEngine:
         self.cache = PagedKVCache.from_model(
             model, total_pages=total_pages, page_size=page_size)
         from .paged import JittedPagedDecoder
-        self._decoder = JittedPagedDecoder(model)
+        self._decoder = JittedPagedDecoder(
+            model, min_table_pages=min_table_pages)
         # speculative decoding (ISSUE 6): the draft gets its own
         # decoder + page pool; proposals/verification share the target's
         # bucketing so steady-state serving stays compile-free
@@ -372,7 +433,8 @@ class ContinuousBatchingEngine:
                     "draft and target models must share a vocabulary "
                     f"({draft_model.config.vocab_size} vs "
                     f"{model.config.vocab_size})")
-            self._draft_decoder = JittedPagedDecoder(draft_model)
+            self._draft_decoder = JittedPagedDecoder(
+                draft_model, min_table_pages=min_table_pages)
             self.draft_cache = PagedKVCache.from_model(
                 draft_model,
                 total_pages=(total_pages if draft_total_pages is None
@@ -397,11 +459,17 @@ class ContinuousBatchingEngine:
         self._pad_pages = max(1, -(-pad_tokens // int(page_size)))
         self._reserved_pages = self._pad_pages
         self._reserved_draft_pages = self._pad_pages
-        self._queue: Deque[_Request] = deque()
+        # admission queues live in the workload scheduler (per-class,
+        # per-tenant DRR); the engine owns two mid-prefill lists the
+        # drain/reap/fail paths must see: _prefilling (admitted, chunk
+        # cursor advancing) and _preempted (paused mid-prefill, pages
+        # kept, waiting for a slot to resume)
+        self._sched = WorkloadScheduler(
+            classes=scheduler_classes, max_queue=self.max_queue,
+            default_class=default_class)
         self._active: List[_Request] = []
-        # admitted-but-not-yet-active (mid-prefill) count: drain() must
-        # see these — they are neither queued nor active for a moment
-        self._admitting = 0
+        self._prefilling: List[_Request] = []
+        self._preempted: List[_Request] = []
         self._cond = threading.Condition()
         self._stop = False
         self._draining = False
@@ -432,18 +500,29 @@ class ContinuousBatchingEngine:
                temperature: float = 1.0, seed: int = 0,
                ttl_s: Optional[float] = None,
                queue_timeout_s: Optional[float] = None,
-               draft: Optional[bool] = None) -> _Request:
+               draft: Optional[bool] = None,
+               priority: Optional[str] = None,
+               tenant: str = "default") -> _Request:
         """``draft``: speculative-decoding opt-in for this request.
         ``None`` (default) speculates whenever the engine has a draft
         model and the request is greedy; ``False`` opts out; ``True``
         demands it (ValueError if the engine has no draft model or the
-        request cannot speculate)."""
+        request cannot speculate).
+
+        ``priority`` names a scheduling class (``None`` -> the engine's
+        default class; unknown names raise ValueError — a client
+        mistake, not a capacity problem); ``tenant`` is a free-form
+        tenant id fair-queued within the class."""
+        # validate the class BEFORE any capacity checks: an unknown
+        # class must 400, never 429/503
+        pclass = self._sched.resolve(priority)
         req = _Request(prompt, max_new_tokens, eos_token_id, do_sample,
                        temperature, seed,
                        ttl_s=self.default_ttl_s if ttl_s is None else ttl_s,
                        queue_timeout_s=(self.default_queue_timeout_s
                                         if queue_timeout_s is None
-                                        else queue_timeout_s))
+                                        else queue_timeout_s),
+                       priority=pclass.name, tenant=tenant)
         total = len(req.prompt) + req.max_new_tokens
         # a verify step writes spec_k + 1 positions before rolling back,
         # so the rope table must cover the overhang for EVERY request a
@@ -499,13 +578,14 @@ class ContinuousBatchingEngine:
                     "requests")
             if self._stop:
                 raise RuntimeError("engine stopped")
-            if len(self._queue) >= self.max_queue:
+            try:
+                self._sched.push(req)
+            except QueueFull as e:
                 _saturated_total.inc()
-                raise EngineSaturated(
-                    f"admission queue is full ({self.max_queue} "
-                    "requests); retry later")
-            self._queue.append(req)
-            _queue_depth.set(len(self._queue))
+                err = EngineSaturated(str(e))
+                err.priority_class = e.priority_class
+                raise err from None
+            _queue_depth.set(len(self._sched))
             self._cond.notify_all()
         return req
 
@@ -513,7 +593,9 @@ class ContinuousBatchingEngine:
                  eos_token_id: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
                  seed: int = 0, ttl_s: Optional[float] = None,
-                 draft: Optional[bool] = None):
+                 draft: Optional[bool] = None,
+                 priority: Optional[str] = None,
+                 tenant: str = "default"):
         """Blocking batch API (PagedGenerator-compatible): submits each
         row as its own sequence and eos-pads rows to a common length.
         If any row fails to submit or errors, the other rows are
@@ -525,7 +607,8 @@ class ContinuousBatchingEngine:
             for i, row in enumerate(ids):
                 reqs.append(self.submit(row, max_new_tokens, eos_token_id,
                                         do_sample, temperature, seed + i,
-                                        ttl_s=ttl_s, draft=draft))
+                                        ttl_s=ttl_s, draft=draft,
+                                        priority=priority, tenant=tenant))
             rows = [r.result() for r in reqs]
         except BaseException:
             for r in reqs:
@@ -542,13 +625,33 @@ class ContinuousBatchingEngine:
     def draining(self) -> bool:
         return self._draining
 
-    def retry_after_hint(self) -> int:
+    def retry_after_hint(self, priority: Optional[str] = None) -> int:
         """Seconds a 429'd client should wait before retrying: the
-        current queue backlog x the measured decode-step p50 from the
-        monitor, clamped to [1, 30]."""
+        backlog x the measured decode-step p50 from the monitor,
+        clamped to [1, 30].  With ``priority`` the backlog is the
+        REQUESTING CLASS's queue depth (an interactive client behind an
+        empty interactive queue is told 1s even while the batch queue
+        is deep), otherwise the global depth."""
         with self._cond:
-            depth = len(self._queue)
+            if priority is not None \
+                    and priority in {c.name for c in self._sched.classes}:
+                depth = self._sched.depth(priority)
+            else:
+                depth = len(self._sched)
         return retry_after_seconds(depth, _decode_p50_seconds())
+
+    def scheduler_info(self) -> dict:
+        """JSON-able scheduling state for ``/health``: the active
+        policy knobs and per-class/per-tenant queue depths."""
+        with self._cond:
+            return {
+                "prefill_chunk_tokens": self.prefill_chunk_tokens,
+                "default_class": self._sched.default_class,
+                "classes": self._sched.policy(),
+                "tenants_queued": self._sched.tenant_depths(),
+                "prefilling": len(self._prefilling),
+                "preempted": len(self._preempted),
+            }
 
     def drain(self, timeout: Optional[float] = None,
               reject_queued: bool = False) -> bool:
@@ -570,20 +673,20 @@ class ContinuousBatchingEngine:
         with self._cond:
             self._draining = True
             _draining_g.set(1)
-            if reject_queued and self._queue:
-                while self._queue:
-                    r = self._queue.popleft()
+            if reject_queued and len(self._sched):
+                rejected = self._sched.pop_all()
+                for r in rejected:
                     r.error = EngineDraining(
                         "engine draining: request rejected before "
                         "admission (reject_queued fast path)")
-                    rejected.append(r)
                 _queue_depth.set(0)
                 _drain_rejected.inc(len(rejected))
             self._cond.notify_all()
         for r in rejected:
             r.done.set()
         with self._cond:
-            while self._queue or self._active or self._admitting:
+            while len(self._sched) or self._active or self._prefilling \
+                    or self._preempted:
                 if self._stop:
                     # a concurrent hard stop() preempted the drain: the
                     # remaining requests were ERRORED, not completed —
@@ -654,19 +757,30 @@ class ContinuousBatchingEngine:
         the lock."""
         now = time.perf_counter()
         out: List[_Request] = []
-        if self._queue:
-            keep: Deque[_Request] = deque()
-            for r in self._queue:
-                err = r._lifecycle_error(now, queued=True)
+        for r in self._sched.reap(now):
+            r.error = r._lifecycle_error(now, queued=True)
+            self._count_lifecycle(r.error)
+            out.append(r)
+        if out:
+            _queue_depth.set(len(self._sched))
+        # mid-prefill requests (chunking spans iterations) and paused
+        # preempted requests hold pages: reap them too, so a cancelled
+        # or expired request never parks capacity in either list
+        for lst_name in ("_prefilling", "_preempted"):
+            lst = getattr(self, lst_name)
+            if not lst:
+                continue
+            keep: List[_Request] = []
+            for r in lst:
+                err = r._lifecycle_error(now, queued=False)
                 if err is None:
                     keep.append(r)
                 else:
                     r.error = err
                     self._count_lifecycle(err)
+                    self._retire_locked(r)
                     out.append(r)
-            if len(keep) != len(self._queue):
-                self._queue = keep
-                _queue_depth.set(len(keep))
+            setattr(self, lst_name, keep)
         if self._active:
             still: List[_Request] = []
             for r in self._active:
@@ -693,52 +807,181 @@ class ContinuousBatchingEngine:
         else:
             _expired_total.inc()
 
-    def _pop_admissible_locked(self) -> List[_Request]:
-        """Caller holds ``self._cond`` (the ``_locked`` suffix is the
-        lint-checked contract — tpu_lint's TPL004 exempts these helpers
-        and flags any other off-lock engine-state mutation).
-        Move queued requests to 'admitted' while slots
-        and reserved pages allow, assigning seq ids and RESERVING their
-        worst-case pages (prompt + full max_new_tokens) so decode-time
-        allocate() can never exhaust the pool.  A prompt whose prefix is
-        already cached ACQUIRES the shared pages here (pinning them
-        against eviction) and reserves only what the pool must newly
-        provide: the un-shared pages plus whichever shared pages were
-        not already pinned by another live sharer — shared pages are
-        counted once across the engine, not once per sharer.  Prefill
-        itself runs outside the lock — submit() must never wait on
-        device work."""
-        admitted = []
-        while self._queue and len(self._active) + len(admitted) < self.max_batch:
-            req = self._queue[0]
-            shared_tok, newly_pinned = (
-                self.cache.probe_prefix(req.prompt) if self.prefix_cache
-                else (0, 0))
-            need = (self._pages_for(req)
-                    - shared_tok // self.cache.page_size + newly_pinned)
-            if self._reserved_pages + need > self.cache.total_pages:
-                break                     # wait for a retirement
-            # the draft pool reserves the full worst case too (no
-            # prefix sharing there — the draft always prefills whole
-            # prompts); both pools must fit or neither is reserved
-            dneed = self._pages_for(req) if req.use_draft else 0
-            if dneed and self._reserved_draft_pages + dneed \
-                    > self.draft_cache.total_pages:
+    def _admission_cost_locked(self, req) -> Optional[int]:
+        """Caller holds ``self._cond``.  PURE fit check: the pages this
+        request's admission would newly reserve (its DRR cost), or None
+        when it does not fit right now.  A prompt whose prefix is
+        already cached reserves only what the pool must newly provide:
+        the un-shared pages plus whichever shared pages were not
+        already pinned by another live sharer — shared pages are
+        counted once across the engine, not once per sharer."""
+        shared_tok, newly_pinned = (
+            self.cache.probe_prefix(req.prompt) if self.prefix_cache
+            else (0, 0))
+        need = (self._pages_for(req)
+                - shared_tok // self.cache.page_size + newly_pinned)
+        if self._reserved_pages + need > self.cache.total_pages:
+            return None
+        # the draft pool reserves the full worst case too (no prefix
+        # sharing there — the draft always prefills whole prompts);
+        # both pools must fit or neither is reserved
+        dneed = self._pages_for(req) if req.use_draft else 0
+        if dneed and self._reserved_draft_pages + dneed \
+                > self.draft_cache.total_pages:
+            return None
+        # stash the plan for _finalize_admission_locked: nothing can
+        # mutate pool state between this check and the commit (same
+        # lock hold), so the winner's prefix hash walk is not repeated
+        req._admit_plan = (need, shared_tok)
+        return max(1, need)
+
+    def _finalize_admission_locked(self, req) -> None:
+        """Caller holds ``self._cond``.  Commit an admission the cost
+        check just approved: RESERVE worst-case pages (prompt + full
+        max_new_tokens) so decode-time allocate() can never exhaust the
+        pool, assign the seq id, and ACQUIRE any cached prefix (pinning
+        the shared pages against eviction).  Prefill itself runs
+        outside the lock — submit() must never wait on device work."""
+        need, shared_tok = req._admit_plan
+        req._admit_plan = None
+        self._reserved_pages += need
+        if req.use_draft:
+            self._reserved_draft_pages += self._pages_for(req)
+            req._draft_reserved = True
+        req.seq_id = self._next_seq
+        self._next_seq += 1
+        if shared_tok:
+            got = self.cache.acquire_prefix(req.seq_id, req.prompt)
+            assert got == shared_tok   # nothing ran between probe/acquire
+            req.prefix_tokens = got
+        req.prefill_pos = req.prefix_tokens
+        req.admitted_at = time.perf_counter()
+        self._sched.note_admitted(req, req.admitted_at)
+
+    def _best_preempted_locked(self) -> Optional[_Request]:
+        """Caller holds ``self._cond``.  The paused request that should
+        resume first: most urgent class, then preemption order."""
+        if not self._preempted:
+            return None
+        return min(self._preempted,
+                   key=lambda r: (self._sched.class_of(r).rank,
+                                  self._preempted.index(r)))
+
+    def _preemption_victim_locked(self, rank: int) -> Optional[_Request]:
+        """Caller holds ``self._cond``.  The mid-prefill request to
+        pause so a rank-``rank`` request can take its slot: the LEAST
+        urgent preemptible prefilling request strictly outranked by the
+        waiter, preferring the least prefill progress (cheapest pause)."""
+        victims = [r for r in self._prefilling
+                   if self._sched.class_of(r).preemptible
+                   and self._sched.class_of(r).rank > rank]
+        if not victims:
+            return None
+        return max(victims,
+                   key=lambda r: (self._sched.class_of(r).rank,
+                                  -r.prefill_pos))
+
+    def _admit_locked(self) -> None:
+        """Caller holds ``self._cond``.  Fill free slots from (a) paused
+        preempted requests — they resume for free, their pages are
+        already reserved — and (b) the workload scheduler's queues in
+        weighted-DRR order; when every slot is held and a MORE URGENT
+        class is waiting, pause a preemptible mid-prefill request and
+        hand its slot over (the tentpole preemption path: the victim
+        keeps seq id, pages and reservation, and resumes later).
+        Under SUSTAINED higher-priority load a preemptible request
+        stays paused (that is the priority contract) while holding its
+        reservation — bound the pause with a request TTL if that
+        matters; the ROADMAP carries resume-aging as a follow-up."""
+        pending_rank = None     # rank a preemption just freed a slot for
+        while True:
+            slots = (self.max_batch - len(self._active)
+                     - len(self._prefilling))
+            qrank = self._sched.min_waiting_rank()
+            pre = self._best_preempted_locked()
+            if slots <= 0:
+                if qrank is None:
+                    break
+                victim = self._preemption_victim_locked(qrank)
+                head = self._sched.peek_urgent()
+                if victim is None or head is None \
+                        or self._admission_cost_locked(head) is None:
+                    break
+                self._prefilling.remove(victim)
+                self._preempted.append(victim)
+                self._sched.note_preempted(victim)
+                pending_rank = qrank
+                continue
+            if pending_rank is None and pre is not None and (
+                    qrank is None
+                    or self._sched.class_of(pre).rank <= qrank):
+                self._preempted.remove(pre)
+                self._prefilling.append(pre)
+                self._sched.note_resumed(pre)
+                continue
+            # a slot bought with a preemption belongs to the rank it
+            # was preempted for: a less urgent class's banked DRR
+            # deficit must not snatch it (that would pause one batch
+            # prefill just to start another)
+            req = self._sched.pop_next(self._admission_cost_locked,
+                                       max_rank=pending_rank)
+            pending_rank = None
+            if req is None:
+                if pre is not None:
+                    self._preempted.remove(pre)
+                    self._prefilling.append(pre)
+                    self._sched.note_resumed(pre)
+                    continue
                 break
-            self._queue.popleft()
-            self._reserved_pages += need
-            if dneed:
-                self._reserved_draft_pages += dneed
-                req._draft_reserved = True
-            req.seq_id = self._next_seq
-            self._next_seq += 1
-            if shared_tok:
-                got = self.cache.acquire_prefix(req.seq_id, req.prompt)
-                assert got == shared_tok   # nothing ran between probe/acquire
-                req.prefix_tokens = got
-            admitted.append(req)
-        _queue_depth.set(len(self._queue))
-        return admitted
+            self._finalize_admission_locked(req)
+            self._prefilling.append(req)
+        _queue_depth.set(len(self._sched))
+
+    def _plan_chunks_locked(self) -> List:
+        """Caller holds ``self._cond``.  (request, n_tokens) prefill
+        work for THIS iteration: most urgent classes first, bounded by
+        the per-step chunk budget.  A request's chunk is never split to
+        fit leftover budget — chunk sizes are position-derived (full
+        ``prefill_chunk_tokens`` or the prompt's tail), so the compiled
+        bucket shapes a workload needs are deterministic, never
+        timing-dependent.  Requests whose chunk the budget gave to a
+        MORE URGENT class are counted as deferred (the soft half of
+        preemption; the slot pause above is the hard half — same-class
+        queueing is not a deferral)."""
+        if not self._prefilling:
+            return []
+        order = sorted(
+            range(len(self._prefilling)),
+            key=lambda i: (self._sched.class_of(
+                self._prefilling[i]).rank, i))
+        chunk = self.prefill_chunk_tokens
+        plan: List = []
+        budget = chunk if chunk is not None else None
+        best_served_rank: Optional[int] = None
+        for i in order:
+            req = self._prefilling[i]
+            remaining = len(req.prompt) - req.prefill_pos
+            if remaining <= 0:     # defensive: completion moves it out
+                continue
+            if budget is None:
+                plan.append((req, remaining))
+                continue
+            if budget <= 0:
+                # the deferral metric means PRIORITY pressure: count it
+                # only when the budget actually went to a more urgent
+                # class, not when same-class peers simply queued up
+                rank = self._sched.class_of(req).rank
+                if best_served_rank is not None \
+                        and rank > best_served_rank:
+                    self._sched.note_chunk_deferred(req)
+                continue
+            n = min(remaining, chunk)
+            plan.append((req, n))
+            rank = self._sched.class_of(req).rank
+            if best_served_rank is None or rank < best_served_rank:
+                best_served_rank = rank
+            budget -= n
+        return plan
 
     def _sampling_for(self, reqs, ctrs):
         """(seeds, ctrs, temps, flags) arrays for the fused on-device
@@ -755,41 +998,73 @@ class ContinuousBatchingEngine:
             flags[i] = r.do_sample
         return seeds, np.asarray(ctrs, np.int32), temps, flags
 
-    def _prefill(self, req):
-        # bucketed compiled prefill: one compile per power-of-two prompt
-        # (or suffix) length, not one per distinct length
-        k = req.prefix_tokens
-        sampling = (self._sampling_for([req], [len(req.prompt)])
-                    if self.sample_on_device else None)
+    def _prefill_chunk(self, req, n: int) -> bool:
+        """Ingest the next ``n`` prompt tokens for ``req`` in ONE
+        compiled dispatch (bucketed: one compile per power-of-two chunk
+        length, not one per distinct length).  Returns True when the
+        prompt is fully resident — only then is the first token sampled
+        (with the SAME (seed, position) counter as a monolithic
+        prefill, so chunked and preempted prefill are greedy- and
+        sample-replay-identical to the unchunked path).
+
+        Intermediate chunks run the fused-sampling program in its
+        argmax-only tail — the per-chunk host transfer stays (1,) ids
+        whose value is discarded."""
+        k = req.prefill_pos
+        total = len(req.prompt)
+        n = min(n, total - k)
+        last = (k + n == total)
+        if not self.sample_on_device:
+            sampling = None
+        elif last:
+            sampling = self._sampling_for([req], [total])
+        else:
+            sampling = (np.zeros(1, np.uint32), np.zeros(1, np.int32),
+                        np.ones(1, np.float32), np.zeros(1, bool))
         self._step_started_at = time.monotonic()
         try:
-            _faults.maybe_fire("prefill", seq_ids=[req.seq_id])
+            if req.chunks_done == 0:
+                # per-sequence site, once — chunking must not change
+                # existing fault plans' semantics
+                _faults.maybe_fire("prefill", seq_ids=[req.seq_id])
+            _faults.maybe_fire("prefill_chunk", seq_ids=[req.seq_id])
             with monitor.span("engine/prefill", histogram=_prefill_s):
+                ids = req.prompt[None, k:k + n]
                 if k:
-                    out = self._decoder.prefix_prefill(
-                        self.cache, [req.seq_id], req.prompt[None, k:],
-                        prefix_tokens=k, bucket=True, sampling=sampling)
+                    out = self._decoder.chunk_prefill(
+                        self.cache, [req.seq_id], ids,
+                        context_tokens=k, bucket=True, sampling=sampling)
                 else:
                     out = self._decoder.prefill(
-                        self.cache, [req.seq_id], req.prompt[None],
+                        self.cache, [req.seq_id], ids,
                         bucket=True, sampling=sampling)
         finally:
             self._step_started_at = None
         _last_step_ts.set(time.time())
+        req.prefill_pos = k + n
+        req.chunks_done += 1
+        self._sched.note_chunk(req)
+        if not last:
+            return False
+        # ---- prompt fully resident: finish what monolithic prefill did
         if self.prefix_cache:
             _prefix_lookups.inc()
-            if k:
+            if req.prefix_tokens:
                 _prefix_hits.inc()
-                _prefix_hit_tokens.inc(k)
+                _prefix_hit_tokens.inc(req.prefix_tokens)
             # retain this prompt's page-aligned prefixes for later
-            # sharers (idempotent for the pages it itself shared)
+            # sharers (idempotent for the pages it itself shared);
+            # chunk-written pages carry identical KV, so chunked
+            # prompts seed the prefix cache exactly like monolithic ones
             self.cache.register_prefix(req.seq_id, req.prompt)
         if req.use_draft:
             # the draft ingests the WHOLE prompt (no prefix sharing in
             # its pool) so its cache sits at the same length as the
             # target's — the lockstep invariant every propose/verify
-            # round preserves.  The greedy-tail sampling keeps the
-            # transfer at (1,) ids; the value is discarded.
+            # round preserves.  Deferred to prefill COMPLETION under
+            # chunking: a preempted target resumes without ever having
+            # touched the draft pool.  The greedy-tail sampling keeps
+            # the transfer at (1,) ids; the value is discarded.
             try:
                 self._draft_decoder.prefill(
                     self.draft_cache, [req.seq_id], req.prompt[None],
@@ -803,7 +1078,44 @@ class ContinuousBatchingEngine:
         req.next_token = (int(out[0]) if sampling is not None
                           else self._pick(req, out[0]))
         req.first_token_at = time.perf_counter()
-        _ttft_s.observe(req.first_token_at - req.submitted_at)
+        ttft = req.first_token_at - req.submitted_at
+        _ttft_s.observe(ttft)
+        self._sched.note_first_token(req, ttft)
+        return True
+
+    def _run_chunks(self, plan) -> None:
+        """Execute one iteration's prefill chunk plan (device work —
+        called WITHOUT the lock).  A failing chunk quarantines exactly
+        its request: the decoder already rolled the failed dispatch
+        back, retirement reclaims the pages every EARLIER chunk wrote,
+        and batchmates/other tenants are untouched (host-side faults
+        leave the donated pools valid — see _recover_pools)."""
+        completed: List[_Request] = []
+        failed: List[_Request] = []
+        for req, n in plan:
+            if req.cancelled:
+                continue               # the next reap retires it
+            try:
+                if self._prefill_chunk(req, n):
+                    completed.append(req)
+            except BaseException as e:  # noqa: BLE001 — quarantine one
+                req.error = e
+                failed.append(req)
+        if not completed and not failed:
+            return
+        with self._cond:
+            for r in failed:
+                if r in self._prefilling:
+                    self._prefilling.remove(r)
+                self._retire_locked(r)
+            for r in completed:
+                if r in self._prefilling:
+                    self._prefilling.remove(r)
+                    self._active.append(r)
+            self._cond.notify_all()
+        for r in failed:
+            _quarantined.inc()
+            r.done.set()
 
     def _pick(self, req, logits_row) -> int:
         from .paged import sample_token
@@ -849,6 +1161,7 @@ class ContinuousBatchingEngine:
         req.finished_at = time.perf_counter()
         if req.error is None:
             _gen_latency_s.observe(req.finished_at - req.submitted_at)
+        self._sched.note_retired(req)   # per-class TPOT (no-op on error)
 
     def _bucket(self, n: int) -> int:
         from .paged import next_pow2
@@ -1154,14 +1467,16 @@ class ContinuousBatchingEngine:
         for r in poisoned:
             r.done.set()
 
-    def _fail_all(self, exc, admitted):
+    def _fail_all(self, exc):
         """LAST-RESORT scheduler-fault handler (isolation failed or the
         fault was outside any step): error out every in-flight request
         WITHOUT leaking pool capacity — sequences that already own
         pages are freed and their reservations rolled back, so the
         engine stays usable."""
         with self._cond:
-            for r in self._active + admitted + list(self._queue):
+            queued = self._sched.pop_all()
+            holders = self._active + self._prefilling + self._preempted
+            for r in holders + queued:
                 if r.done.is_set():
                     continue
                 if r.finished_at is not None:
@@ -1172,7 +1487,7 @@ class ContinuousBatchingEngine:
                     continue
                 r.error = exc
                 r.done.set()
-            for r in self._active + admitted:
+            for r in holders:
                 if r.seq_id is not None:
                     self.cache.free(r.seq_id)
                     if self._spec:
@@ -1181,8 +1496,9 @@ class ContinuousBatchingEngine:
             self._free_pads_locked()
             self._reserved_pages = self._pad_pages   # only pad headroom
             self._reserved_draft_pages = self._pad_pages
-            self._active, self._queue = [], deque()
-            self._admitting = 0
+            self._active = []
+            self._prefilling = []
+            self._preempted = []
             _active_seqs.set(0)
             _queue_depth.set(0)
             self._cond.notify_all()
@@ -1190,43 +1506,42 @@ class ContinuousBatchingEngine:
     def _loop(self):
         while True:
             with self._cond:
-                while not self._stop and not self._queue and not self._active:
+                while not self._stop and not len(self._sched) \
+                        and not self._active and not self._prefilling \
+                        and not self._preempted:
                     self._cond.wait(timeout=0.5)
                 if self._stop:
                     self._free_pads_locked()
-                    for r in list(self._queue) + self._active:
+                    stopped = (self._sched.pop_all() + self._prefilling
+                               + self._preempted + self._active)
+                    self._prefilling = []
+                    self._preempted = []
+                    self._active = []
+                    for r in stopped:
                         r.error = RuntimeError("engine stopped")
                         r.done.set()
                     return
-                reaped = self._reap_locked()
-                admitted = self._pop_admissible_locked()
-                self._admitting = len(admitted)
+            try:
+                with self._cond:
+                    reaped = self._reap_locked()
+                    self._admit_locked()
+                    plan = self._plan_chunks_locked()
+            except BaseException as e:  # noqa: BLE001 — scheduler fault
+                # a bug in admission/reaping must fail the in-flight
+                # requests LOUDLY, never kill this thread silently and
+                # leave every waiter blocked on a dead engine
+                self._fail_all(e)
+                continue
             for r in reaped:
                 r.done.set()
             try:
-                # prefill each admitted request with per-request
-                # isolation (ISSUE 4): a poisoned prompt errors only
-                # itself — its batchmates prefill and decode on
-                failed = []
-                for req in admitted:           # device work: outside lock
-                    try:
-                        self._prefill(req)
-                    except BaseException as e:  # noqa: BLE001
-                        req.error = e
-                        failed.append(req)
-                with self._cond:
-                    for r in failed:
-                        self._retire_locked(r)
-                    self._active.extend(
-                        r for r in admitted if r.error is None)
-                    admitted = []
-                    self._admitting = 0
-                    if failed:
-                        self._cond.notify_all()
-                for r in failed:
-                    _quarantined.inc()
-                    r.done.set()
+                # one iteration = at most ~a chunk budget of prefill,
+                # then ONE decode step for everything active: chunked
+                # prefill interleaves with decode instead of stalling
+                # it (ISSUE 7); per-chunk failures quarantine only
+                # their own request (ISSUE 4 discipline carried over)
+                self._run_chunks(plan)         # device work: outside lock
                 if self._active:
                     self._decode_step()
             except BaseException as e:  # noqa: BLE001 — fail loudly, not hang
-                self._fail_all(e, admitted)
+                self._fail_all(e)

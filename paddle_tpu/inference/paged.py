@@ -236,10 +236,19 @@ class JittedPagedDecoder:
     DONATE_ARGNUMS = {"decode": (8, 9), "prefill": (6, 7),
                       "prefix": (8, 9), "verify": (8, 9)}
 
-    def __init__(self, model):
+    def __init__(self, model, min_table_pages: int = 1):
         self.model = model
         self.params = model.parameters()
         self.max_position = int(model.config.max_position_embeddings)
+        # page-table width floor: with the default 1 the table width is
+        # next_pow2(longest sequence's pages), which recompiles the
+        # decode/verify/chunk programs every time the running batch
+        # crosses a width bucket; pinning it at the pool's worst case
+        # (ceil(max_position / page_size) rounded up) trades a bounded
+        # amount of gather work for a FIXED program signature — the
+        # scenario-matrix serving lane runs mixed short/long traffic
+        # compile-free this way
+        self.min_table_pages = max(1, int(min_table_pages))
         self._programs = {}              # (mode, sample) -> jitted fn
         self._program_fns = {}           # (mode, sample) -> raw traced fn
         self._jitted_multi = None        # built on first multi_step use
@@ -518,12 +527,37 @@ class JittedPagedDecoder:
         (batch, s) int32 is the UNCACHED prompt tail.  Returns logits
         (batch, vocab) f32, or sampled ids (batch,) with ``sampling``.
         """
-        b, s = ids_np.shape
         k = int(prefix_tokens)
         if k <= 0 or k % cache.page_size:
             raise ValueError(
                 f"prefix_tokens must be a positive multiple of the page "
                 f"size ({cache.page_size}), got {k}")
+        return self._context_prefill(cache, seq_ids, ids_np, k, bucket,
+                                     sampling)
+
+    def chunk_prefill(self, cache: PagedKVCache, seq_ids, ids_np,
+                      context_tokens: int, bucket: bool = True,
+                      sampling=None) -> np.ndarray:
+        """Chunked-prefill continuation (ISSUE 7): ingest the next
+        ``ids_np`` (batch, s) slice of a prompt whose first
+        ``context_tokens`` tokens are already in the cache, at ANY
+        length — unlike :meth:`prefix_prefill` the context need not be
+        page-aligned, because the sequence OWNS its pages (a partially
+        filled page is never shared; the chunk's first tokens simply
+        fill its remaining slots).  Same compiled program as the
+        prefix path (the context length is traced), so interleaving
+        chunk sizes never multiplies program count."""
+        k = int(context_tokens)
+        if k <= 0:
+            raise ValueError(
+                f"context_tokens must be positive, got {k} (use "
+                "prefill() for a fresh sequence)")
+        return self._context_prefill(cache, seq_ids, ids_np, k, bucket,
+                                     sampling)
+
+    def _context_prefill(self, cache: PagedKVCache, seq_ids, ids_np,
+                         k: int, bucket: bool, sampling) -> np.ndarray:
+        b, s = ids_np.shape
         if k + s > self.max_position:
             raise ValueError(
                 f"prompt length {k + s} exceeds max_position_embeddings "
@@ -533,7 +567,7 @@ class JittedPagedDecoder:
             if cache.length(sid) != k:
                 raise ValueError(
                     f"sequence {sid!r} is at length {cache.length(sid)}, "
-                    f"expected the shared prefix length {k}")
+                    f"expected the cached context length {k}")
             before.append(cache.length(sid))
             cache.allocate(sid, s)
         pg, sl = cache.plan_write(seq_ids, s)
@@ -542,8 +576,12 @@ class JittedPagedDecoder:
         if s_b != s:
             ids_np, pg, sl = self._pad_prefill_plan(cache, ids_np, pg, sl,
                                                     b, s, s_b)
-        n_pre = k // cache.page_size
-        ptabs = np.zeros((b, next_pow2(n_pre)), np.int32)
+        # the context may end mid-page (chunked prefill): gather the
+        # partial page too — attention masks cols past k, and this
+        # chunk's own tokens reach themselves through the suffix path
+        n_pre = -(-k // cache.page_size)
+        ptabs = np.zeros(
+            (b, max(next_pow2(n_pre), self.min_table_pages)), np.int32)
         for i, sid in enumerate(seq_ids):
             ptabs[i, :n_pre] = cache._seq_pages[sid][:n_pre]
         plens = np.full(b, k, np.int32)
@@ -614,8 +652,9 @@ class JittedPagedDecoder:
         cache.advance(seq_ids, s)
         needed = max(len(cache._seq_pages.get(sid, ()))
                      for sid in seq_ids)
-        tabs, lens = cache.page_table(seq_ids,
-                                      max_pages=next_pow2(needed))
+        tabs, lens = cache.page_table(
+            seq_ids, max_pages=max(next_pow2(needed),
+                                   self.min_table_pages))
         sample, s_args = self._verify_sampling_args(sampling)
         try:
             out, accept, k_pages, v_pages = self._program(
@@ -704,7 +743,9 @@ class JittedPagedDecoder:
         # table covers the FINAL length (pages reserved above); per-step
         # attention masks by lens = pos + 1, so later slots stay unseen
         needed = max(len(cache._seq_pages.get(s, ())) for s in seq_ids)
-        tabs, _ = cache.page_table(seq_ids, max_pages=next_pow2(needed))
+        tabs, _ = cache.page_table(
+            seq_ids, max_pages=max(next_pow2(needed),
+                                   self.min_table_pages))
         try:
             toks, k_pages, v_pages = self._jitted_multi(
                 [p._data for p in self.params],
@@ -748,8 +789,11 @@ class JittedPagedDecoder:
         # bucket the page-table width to a power of two: an exact width
         # would change shape every time the longest sequence crosses a
         # page boundary, recompiling the whole decode program mid-serving
+        # (min_table_pages pins the floor for fully stable signatures)
         needed = max(len(cache._seq_pages.get(s, ())) for s in seq_ids)
-        tabs, lens = cache.page_table(seq_ids, max_pages=next_pow2(needed))
+        tabs, lens = cache.page_table(
+            seq_ids, max_pages=max(next_pow2(needed),
+                                   self.min_table_pages))
         sample, s_args = self._sampling_args(sampling)
         try:
             out, k_pages, v_pages = self._program("decode", sample)(

@@ -1,0 +1,433 @@
+"""Heterogeneous-workload scheduler (ISSUE 7).
+
+Admission policy + per-class SLO accounting for the continuous-batching
+engine.  The engine was FIFO with one implicit tenant: a single long
+prompt stalled every interactive decode step behind a monolithic
+prefill.  This module supplies the three scheduling pillars the engine
+delegates to:
+
+  * **priority classes** — requests carry a class
+    (``interactive`` > ``standard`` > ``batch`` by default); classes
+    have weights (admission share) and a ``preemptible`` flag (the
+    engine may pause a preemptible request's CHUNKED prefill to hand
+    its slot to more urgent traffic — the paused request keeps its
+    pages and resumes, it never re-prefills);
+  * **weighted-fair queueing** — admission order is deficit-round-robin
+    at two levels: across classes (deficit replenished by class
+    weight, cost charged in reserved pages, highest accumulated
+    deficit served first so long-run service share tracks the weights
+    while no class starves) and, within a class, across per-tenant
+    FIFO queues (equal-quantum DRR, so one tenant's burst cannot
+    monopolize its class);
+  * **bounded per-class queues** — each class has its own admission
+    queue bound; overflow raises :class:`QueueFull` naming the class,
+    which the engine maps to :class:`EngineSaturated` and the HTTP
+    server to 429 with a class-aware ``Retry-After`` (derived from the
+    *requesting class's* backlog, not the global queue).
+
+Concurrency contract: a ``WorkloadScheduler`` owns NO lock — every
+method is called with the engine's ``_cond`` held (the same discipline
+tpu_lint TPL004 enforces on the engine's own state).  All mutation
+happens on the engine scheduler thread or under that lock.
+
+SLO observability: per-class histograms (queue wait, TTFT, TPOT) and
+counters (admissions, rejections, preemptions, prefill chunks,
+deferrals) land in the process-wide monitor registry, labeled
+``cls=<class>``, surfaced via ``/metrics`` and summarized in
+``/health``.
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
+
+from .. import monitor
+
+__all__ = [
+    "PriorityClass", "WorkloadScheduler", "QueueFull",
+    "DEFAULT_CLASSES", "DEFAULT_CLASS",
+]
+
+
+@dataclass(frozen=True)
+class PriorityClass:
+    """One scheduling class.  ``rank`` orders urgency (lower = more
+    urgent: chunk budget and slot preemption both favor lower ranks);
+    ``weight`` is the class's admission share under weighted DRR;
+    ``preemptible`` marks classes whose chunked prefill the engine may
+    pause for lower-rank traffic; ``max_queue`` overrides the
+    scheduler-wide per-class queue bound."""
+
+    name: str
+    rank: int
+    weight: int = 1
+    preemptible: bool = False
+    max_queue: Optional[int] = None
+
+
+#: the default class taxonomy: chat-style traffic outranks everything,
+#: offline/batch work is preemptible and gets the smallest share
+DEFAULT_CLASSES: Tuple[PriorityClass, ...] = (
+    PriorityClass("interactive", rank=0, weight=8),
+    PriorityClass("standard", rank=1, weight=4),
+    PriorityClass("batch", rank=2, weight=1, preemptible=True),
+)
+DEFAULT_CLASS = "standard"
+
+#: deficit accumulation cap, in quanta: an idle-then-bursty class may
+#: bank at most this many rounds of credit (classic DRR zeroes credit
+#: on empty; the cap bounds it instead so a re-appearing class cannot
+#: monopolize admission with stale credit)
+_DEFICIT_CAP_ROUNDS = 16
+
+# per-class SLO telemetry (ISSUE 7): the scenario-matrix lane and the
+# /metrics surface read exactly these series
+_queue_wait_s = monitor.histogram(
+    "sched_queue_wait_seconds", "submit -> admission, per class",
+    ("cls",))
+_ttft_s = monitor.histogram(
+    "sched_ttft_seconds", "submit -> first sampled token, per class",
+    ("cls",))
+_tpot_s = monitor.histogram(
+    "sched_tpot_seconds", "mean seconds per output token after the "
+    "first, observed at retirement, per class", ("cls",))
+_queue_depth_g = monitor.gauge(
+    "sched_queue_depth", "requests waiting for admission, per class",
+    ("cls",))
+_admitted_total = monitor.counter(
+    "sched_admitted_total", "requests admitted, per class", ("cls",))
+_rejected_total = monitor.counter(
+    "sched_rejected_total", "submissions rejected by the class's "
+    "bounded queue, per class", ("cls",))
+_preempted_total = monitor.counter(
+    "sched_preemptions_total", "preemptible prefills paused so a more "
+    "urgent class could take the slot, per (preempted) class", ("cls",))
+_resumed_total = monitor.counter(
+    "sched_resumed_total", "preempted prefills resumed (pages kept, "
+    "never re-prefilled), per class", ("cls",))
+_chunks_total = monitor.counter(
+    "sched_prefill_chunks_total", "prefill chunks executed, per class",
+    ("cls",))
+_deferrals_total = monitor.counter(
+    "sched_chunk_deferrals_total", "prefill chunks deferred because a "
+    "step's chunk budget went to more urgent classes, per class",
+    ("cls",))
+
+
+class QueueFull(RuntimeError):
+    """A class's bounded admission queue overflowed.  The engine maps
+    this to :class:`EngineSaturated`; ``priority_class`` names the
+    class whose backlog the 429 ``Retry-After`` must be derived from."""
+
+    def __init__(self, priority_class: str, depth: int, bound: int):
+        super().__init__(
+            f"admission queue for class {priority_class!r} is full "
+            f"({depth}/{bound} requests); retry later")
+        self.priority_class = priority_class
+        self.depth = depth
+        self.bound = bound
+
+
+class _TenantQueue:
+    __slots__ = ("queue", "deficit")
+
+    def __init__(self):
+        self.queue: Deque = deque()
+        self.deficit = 0.0
+
+
+class _ClassState:
+    __slots__ = ("spec", "tenants", "deficit", "depth")
+
+    def __init__(self, spec: PriorityClass):
+        self.spec = spec
+        # insertion-ordered so tenant DRR visits are deterministic
+        self.tenants: "OrderedDict[str, _TenantQueue]" = OrderedDict()
+        self.deficit = 0.0
+        self.depth = 0
+
+
+class WorkloadScheduler:
+    """Per-class, per-tenant admission queues + weighted-DRR selection.
+
+    NOT thread-safe by itself: the owning engine calls every method
+    with its scheduler lock held (see module docstring).
+    """
+
+    def __init__(self, classes: Optional[Sequence[PriorityClass]] = None,
+                 max_queue: int = 256,
+                 default_class: str = DEFAULT_CLASS):
+        specs = tuple(classes) if classes is not None else DEFAULT_CLASSES
+        if not specs:
+            raise ValueError("at least one PriorityClass is required")
+        names = [c.name for c in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate class names in {names}")
+        self._classes: Dict[str, _ClassState] = {
+            c.name: _ClassState(c) for c in specs}
+        self._by_rank: List[_ClassState] = sorted(
+            self._classes.values(), key=lambda cs: (cs.spec.rank,
+                                                    cs.spec.name))
+        self.max_queue = int(max_queue)
+        if default_class not in self._classes:
+            raise ValueError(
+                f"default_class {default_class!r} is not one of {names}")
+        self.default_class = default_class
+        for name in self._classes:
+            _queue_depth_g.set(0, cls=name)
+
+    # ----------------------------------------------------------- lookup
+    def resolve(self, name: Optional[str]) -> PriorityClass:
+        """The class for a submitted ``priority`` (None -> default).
+        ValueError for unknown names — the server maps it to 400: an
+        unknown class is the client's mistake, never a retryable."""
+        if name is None:
+            name = self.default_class
+        cs = self._classes.get(name)
+        if cs is None:
+            raise ValueError(
+                f"unknown priority class {name!r}; classes are "
+                f"{sorted(self._classes)}")
+        return cs.spec
+
+    def class_of(self, req) -> PriorityClass:
+        return self._classes[req.priority].spec
+
+    @property
+    def classes(self) -> Tuple[PriorityClass, ...]:
+        return tuple(cs.spec for cs in self._by_rank)
+
+    def __len__(self) -> int:
+        return sum(cs.depth for cs in self._by_rank)
+
+    def depth(self, priority: Optional[str] = None) -> int:
+        """Queued requests in one class (or overall with None)."""
+        if priority is None:
+            return len(self)
+        cs = self._classes.get(priority)
+        return 0 if cs is None else cs.depth
+
+    def depths(self) -> Dict[str, int]:
+        return {cs.spec.name: cs.depth for cs in self._by_rank}
+
+    def tenant_depths(self) -> Dict[str, Dict[str, int]]:
+        return {cs.spec.name: {t: len(tq.queue)
+                               for t, tq in cs.tenants.items()
+                               if tq.queue}
+                for cs in self._by_rank}
+
+    def policy(self) -> dict:
+        """JSON-able policy knobs + live depths for ``/health``."""
+        return {cs.spec.name: {
+            "rank": cs.spec.rank,
+            "weight": cs.spec.weight,
+            "preemptible": cs.spec.preemptible,
+            "max_queue": (self.max_queue if cs.spec.max_queue is None
+                          else cs.spec.max_queue),
+            "queued": cs.depth,
+        } for cs in self._by_rank}
+
+    # ------------------------------------------------------------ queues
+    def push(self, req) -> None:
+        """Enqueue onto the request's (class, tenant) queue.  Raises
+        :class:`QueueFull` when the class's bounded queue is full."""
+        cs = self._classes[self.resolve(req.priority).name]
+        req.priority = cs.spec.name          # normalize None -> default
+        bound = (self.max_queue if cs.spec.max_queue is None
+                 else cs.spec.max_queue)
+        if cs.depth >= bound:
+            _rejected_total.inc(cls=cs.spec.name)
+            raise QueueFull(cs.spec.name, cs.depth, bound)
+        tq = cs.tenants.get(req.tenant)
+        if tq is None:
+            tq = cs.tenants[req.tenant] = _TenantQueue()
+        tq.queue.append(req)
+        cs.depth += 1
+        _queue_depth_g.set(cs.depth, cls=cs.spec.name)
+
+    def _set_depth(self, cs: _ClassState, delta: int) -> None:
+        cs.depth += delta
+        _queue_depth_g.set(cs.depth, cls=cs.spec.name)
+        if cs.depth == 0:
+            # classic DRR: an emptied queue forfeits leftover credit —
+            # and its tenant entries go too, so the per-tenant map can
+            # never grow without bound on client-supplied tenant ids
+            cs.deficit = 0.0
+            cs.tenants.clear()
+
+    @staticmethod
+    def _prune_tenants(cs: _ClassState) -> None:
+        """Drop emptied tenant queues (forfeiting their DRR credit,
+        the classic rule) so the tenant map is bounded by the LIVE
+        tenant count, not by every tenant string ever submitted."""
+        for name in [n for n, tq in cs.tenants.items() if not tq.queue]:
+            del cs.tenants[name]
+
+    def min_waiting_rank(self) -> Optional[int]:
+        """Rank of the most urgent nonempty class, or None when idle —
+        the engine's slot-preemption trigger reads this."""
+        for cs in self._by_rank:
+            if cs.depth:
+                return cs.spec.rank
+        return None
+
+    def peek_urgent(self):
+        """A head request of the most urgent nonempty class (first
+        nonempty tenant queue), without popping — the engine uses it
+        for a pages-fit check before paying for a slot preemption."""
+        for cs in self._by_rank:
+            if not cs.depth:
+                continue
+            for tq in cs.tenants.values():
+                if tq.queue:
+                    return tq.queue[0]
+        return None
+
+    def pop_all(self) -> List:
+        """Remove and return every queued request (drain-reject /
+        fail-all paths)."""
+        out: List = []
+        for cs in self._by_rank:
+            for tq in cs.tenants.values():
+                out.extend(tq.queue)
+                tq.queue.clear()
+            if cs.depth:
+                self._set_depth(cs, -cs.depth)
+        return out
+
+    def reap(self, now: float) -> List:
+        """Remove queued requests whose lifecycle ended (cancel /
+        deadline) and return them — the engine counts and wakes them."""
+        out: List = []
+        for cs in self._by_rank:
+            removed = 0
+            for tq in cs.tenants.values():
+                if not tq.queue:
+                    continue
+                keep: Deque = deque()
+                for r in tq.queue:
+                    if r._lifecycle_error(now, queued=True) is None:
+                        keep.append(r)
+                    else:
+                        out.append(r)
+                        removed += 1
+                tq.queue = keep
+            if removed:
+                self._prune_tenants(cs)
+                self._set_depth(cs, -removed)
+        return out
+
+    # --------------------------------------------------------- selection
+    def _tenant_candidate(self, cs: _ClassState, can_admit):
+        """(tenant, tenant_queue, req, cost) for this class under
+        tenant-level DRR: among tenants whose HEAD fits right now,
+        serve the highest deficit (replenishing equal quanta until
+        someone affords).  Heads are never skipped within a tenant
+        queue — FIFO per tenant is part of the fairness contract."""
+        heads = []
+        for tname, tq in cs.tenants.items():
+            if not tq.queue:
+                continue
+            cost = can_admit(tq.queue[0])
+            if cost is not None:
+                heads.append((tname, tq, tq.queue[0], float(cost)))
+        if not heads:
+            return None
+        # equal replenish quantum per tenant (weights are a CLASS
+        # concept); the service charge below is what makes shares fair
+        quantum = max(1.0, min(h[3] for h in heads))
+        cap = _DEFICIT_CAP_ROUNDS * max(h[3] for h in heads)
+        while True:
+            afford = [h for h in heads if h[1].deficit >= h[3]]
+            if afford:
+                return max(afford, key=lambda h: h[1].deficit)
+            for _, tq, _, _ in heads:
+                tq.deficit = min(tq.deficit + quantum, cap)
+
+    def pop_next(self, can_admit: Callable,
+                 max_rank: Optional[int] = None) -> Optional[object]:
+        """Pop the next request to admit, or None if nothing admissible.
+
+        ``can_admit(req) -> Optional[cost]`` must be PURE: it returns
+        the admission cost (reserved pages) when the request fits the
+        engine's capacity right now, else None.  Selection is weighted
+        DRR across classes (deficit += weight per replenish round;
+        highest-deficit affordable class served, rank breaking ties so
+        urgency wins among equals), then tenant DRR within the class.
+        Deficits are charged in cost units, so service share tracks
+        weight x pages, not request count.
+
+        ``max_rank`` restricts candidates to classes at that rank or
+        more urgent — the engine passes the rank it just PREEMPTED a
+        victim for, so a slot paid for with a preemption can never be
+        consumed by a less urgent class's banked deficit."""
+        candidates = []
+        for cs in self._by_rank:
+            if not cs.depth:
+                continue
+            if max_rank is not None and cs.spec.rank > max_rank:
+                continue
+            found = self._tenant_candidate(cs, can_admit)
+            if found is not None:
+                candidates.append((cs,) + found)
+        if not candidates:
+            return None
+        # the cap banks at most _DEFICIT_CAP_ROUNDS rounds of weight,
+        # but must still reach the costliest head: costs are PAGES,
+        # weights are quanta — a lone low-weight class with a large
+        # request must become affordable, not spin the loop forever
+        cap = max(_DEFICIT_CAP_ROUNDS
+                  * max(c[0].spec.weight for c in candidates),
+                  max(c[4] for c in candidates))
+        while True:
+            afford = [c for c in candidates if c[0].deficit >= c[4]]
+            if afford:
+                cs, tname, tq, req, cost = min(
+                    afford, key=lambda c: (-c[0].deficit, c[0].spec.rank))
+                break
+            for cs, _, _, _, _ in candidates:
+                cs.deficit = min(cs.deficit + cs.spec.weight, cap)
+        popped = tq.queue.popleft()
+        assert popped is req
+        cs.deficit -= cost
+        tq.deficit -= cost
+        self._prune_tenants(cs)
+        self._set_depth(cs, -1)
+        return req
+
+    # ------------------------------------------------------ SLO accounting
+    def note_admitted(self, req, now: Optional[float] = None) -> None:
+        now = time.perf_counter() if now is None else now
+        _admitted_total.inc(cls=req.priority)
+        _queue_wait_s.observe(max(0.0, now - req.submitted_at),
+                              cls=req.priority)
+
+    def note_first_token(self, req, ttft_s: float) -> None:
+        _ttft_s.observe(ttft_s, cls=req.priority)
+
+    def note_retired(self, req) -> None:
+        """Observe TPOT at retirement: mean seconds per output token
+        after the first (decode steady-state latency, the SLO
+        complement of TTFT)."""
+        if req.error is not None or req.first_token_at is None \
+                or req.finished_at is None:
+            return
+        n = len(req.generated)
+        if n > 1:
+            _tpot_s.observe(
+                (req.finished_at - req.first_token_at) / (n - 1),
+                cls=req.priority)
+
+    def note_preempted(self, req) -> None:
+        _preempted_total.inc(cls=req.priority)
+
+    def note_resumed(self, req) -> None:
+        _resumed_total.inc(cls=req.priority)
+
+    def note_chunk(self, req) -> None:
+        _chunks_total.inc(cls=req.priority)
+
+    def note_chunk_deferred(self, req) -> None:
+        _deferrals_total.inc(cls=req.priority)

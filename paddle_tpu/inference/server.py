@@ -263,6 +263,16 @@ class GenerationServer(_ServerLifecycle):
     ``attach_preemption``) stops new admissions — fresh /generate
     requests get 503 with ``"draining": true`` while in-flight
     generations run to completion; /health reports the drain state.
+
+    Scheduling & multi-tenancy (ISSUE 7): a request body may set
+    ``"priority"`` (scheduling class: ``interactive`` / ``standard`` /
+    ``batch`` by default; unknown -> 400) and ``"tenant"`` (fair-queued
+    within the class).  ``prefill_chunk_tokens`` caps per-step prefill
+    so long prompts interleave with decode instead of stalling it;
+    ``min_table_pages`` pins the compiled programs' page-table width
+    for recompile-free mixed-length serving.  429 responses carry a
+    class-aware ``Retry-After``; ``/health`` reports per-class queue
+    depths and the active policy knobs under ``"scheduler"``.
     """
 
     def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
@@ -273,7 +283,10 @@ class GenerationServer(_ServerLifecycle):
                  default_ttl_s: Optional[float] = None,
                  step_timeout_s: Optional[float] = None,
                  draft_model=None, spec_tokens: int = 4,
-                 draft_total_pages: Optional[int] = None):
+                 draft_total_pages: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 scheduler_classes=None,
+                 min_table_pages: int = 1):
         from .continuous import (ContinuousBatchingEngine,
                                  DeadlineExceeded, EngineDraining,
                                  EngineSaturated)
@@ -285,7 +298,10 @@ class GenerationServer(_ServerLifecycle):
             prefix_cache=prefix_cache, max_queue=max_queue,
             default_ttl_s=default_ttl_s, step_timeout_s=step_timeout_s,
             draft_model=draft_model, spec_tokens=spec_tokens,
-            draft_total_pages=draft_total_pages)
+            draft_total_pages=draft_total_pages,
+            prefill_chunk_tokens=prefill_chunk_tokens,
+            scheduler_classes=scheduler_classes,
+            min_table_pages=min_table_pages)
         self._count_lock = threading.Lock()
         self._request_count = 0
         self._drain_thread: Optional[threading.Thread] = None
@@ -314,7 +330,13 @@ class GenerationServer(_ServerLifecycle):
                             "sampling_on_device":
                                 outer._engine.sample_on_device,
                             "active_sequences": len(outer._engine._active),
-                            "queued_sequences": len(outer._engine._queue),
+                            "queued_sequences": len(outer._engine._sched),
+                            # scheduling & multi-tenancy (ISSUE 7):
+                            # per-class queue depths + the active
+                            # policy knobs, so an operator can read
+                            # the WFQ/chunking configuration off a
+                            # live replica
+                            "scheduler": outer._engine.scheduler_info(),
                             "speculative": outer._engine._spec}
                         if outer._engine._spec:
                             dc = outer._engine.draft_cache
@@ -359,6 +381,10 @@ class GenerationServer(_ServerLifecycle):
                         ttl = None if ttl is None else float(ttl)
                         draft = req.get("draft")
                         draft = None if draft is None else bool(draft)
+                        priority = req.get("priority")
+                        priority = (None if priority is None
+                                    else str(priority))
+                        tenant = str(req.get("tenant", "default"))
                         with outer._count_lock:
                             outer._request_count += 1
                             seed = int(req.get("seed",
@@ -371,7 +397,8 @@ class GenerationServer(_ServerLifecycle):
                         out = outer._engine.generate(
                             ids, max_new_tokens=max_new, eos_token_id=eos,
                             do_sample=do_sample, temperature=temperature,
-                            seed=seed, ttl_s=ttl, draft=draft)
+                            seed=seed, ttl_s=ttl, draft=draft,
+                            priority=priority, tenant=tenant)
                     except ValueError as e:      # request-shape problems
                         # e.g. prompt + max_new_tokens past the rope
                         # table: the CLIENT's request is wrong — 400,
@@ -384,12 +411,15 @@ class GenerationServer(_ServerLifecycle):
                         "new_tokens": int(out.shape[1] - ids.shape[1])})
                 except EngineSaturated as e:
                     # bounded-queue overflow: retryable — the hint is
-                    # the backlog's estimated service time (queue depth
-                    # x measured decode-step p50, clamped to [1, 30]s),
-                    # not a constant
+                    # the REQUESTING CLASS's backlog's estimated
+                    # service time (its queue depth x measured
+                    # decode-step p50, clamped to [1, 30]s): a chat
+                    # client is never told to back off for the batch
+                    # queue's sins
+                    cls = getattr(e, "priority_class", None) or priority
                     self._reply(429, {"error": str(e)}, headers={
                         "Retry-After":
-                            str(outer._engine.retry_after_hint())})
+                            str(outer._engine.retry_after_hint(cls))})
                 except EngineDraining as e:
                     self._reply(503, {"error": str(e), "draining": True})
                 except DeadlineExceeded as e:
@@ -435,7 +465,8 @@ class GenerationServer(_ServerLifecycle):
         if t is None:
             eng = self._engine
             with eng._cond:
-                return not (eng._active or eng._queue or eng._admitting)
+                return not (eng._active or len(eng._sched)
+                            or eng._prefilling or eng._preempted)
         t.join(timeout)
         return not t.is_alive()
 

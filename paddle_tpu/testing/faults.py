@@ -11,6 +11,11 @@ step) or sleeps (simulating a wedged step, for stall-detection tests).
 Sites (the names the runtime fires):
 
   ``prefill``       once per sequence prefill, ``seq_ids=[seq_id]``
+                    (fired on the FIRST chunk when prefill is chunked,
+                    so plans written against it keep their semantics)
+  ``prefill_chunk`` once per chunked-prefill dispatch,
+                    ``seq_ids=[seq_id]`` — combine with ``nth`` to
+                    poison a specific chunk of a specific sequence
   ``decode_step``   once per compiled decode-step attempt, with the
                     stepped batch's ``seq_ids`` (retry and bisect
                     attempts fire again — a *sticky* seq-targeted rule
@@ -51,7 +56,8 @@ __all__ = [
     "install", "clear", "active", "maybe_fire", "installed",
 ]
 
-SITES = ("prefill", "decode_step", "page_alloc", "http_handler")
+SITES = ("prefill", "prefill_chunk", "decode_step", "page_alloc",
+         "http_handler")
 
 
 class FaultError(Exception):

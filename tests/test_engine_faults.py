@@ -551,7 +551,7 @@ class TestServerErrorMapping:
                          msg="first request active")
                 t2 = threading.Thread(target=client, args=(srv, 4))
                 t2.start()
-                wait_for(lambda: len(srv._engine._queue) == 1,
+                wait_for(lambda: len(srv._engine._sched) == 1,
                          msg="second request queued")
                 code, body, headers = self._post(
                     srv, {"input_ids": [[5, 6, 7]],
@@ -564,6 +564,59 @@ class TestServerErrorMapping:
                 assert 1 <= int(headers["Retry-After"]) <= 30
                 assert (int(headers["Retry-After"])
                         == srv._engine.retry_after_hint())
+                t1.join(timeout=300)
+                t2.join(timeout=300)
+        assert all(code == 200 for code, _, _ in results)
+
+    def test_retry_after_is_class_aware(self, model):
+        """ISSUE 7 satellite: the 429 hint derives from the REQUESTING
+        class's queue depth x step p50 — a deep batch backlog must not
+        inflate what an interactive client is told, and the header must
+        match the engine's per-class hint."""
+        from paddle_tpu.inference import GenerationServer
+
+        rng = np.random.default_rng(24)
+        plan = faults.FaultPlan([
+            {"site": "decode_step", "kind": "delay", "delay_s": 0.03}])
+        results = []
+
+        def client(srv, max_new, priority):
+            results.append(self._post(
+                srv, {"input_ids": rng.integers(0, 64, (1, 4)).tolist(),
+                      "max_new_tokens": max_new, "priority": priority},
+                timeout=300))
+
+        with faults.installed(plan):
+            with GenerationServer(model, total_pages=64, page_size=8,
+                                  max_batch=1, max_queue=1) as srv:
+                t1 = threading.Thread(target=client,
+                                      args=(srv, 32, "batch"))
+                t1.start()
+                wait_for(lambda: len(srv._engine._active) == 1,
+                         msg="first request active")
+                t2 = threading.Thread(target=client,
+                                      args=(srv, 4, "batch"))
+                t2.start()
+                wait_for(lambda: srv._engine._sched.depth("batch") == 1,
+                         msg="batch queue full")
+                code, body, headers = self._post(
+                    srv, {"input_ids": [[5, 6, 7]], "max_new_tokens": 4,
+                          "priority": "batch"})
+                assert code == 429
+                assert "batch" in body["error"]
+                assert 1 <= int(headers["Retry-After"]) <= 30
+                # derived from the BATCH queue, and equal to the
+                # engine's own per-class hint
+                assert (int(headers["Retry-After"])
+                        == srv._engine.retry_after_hint("batch"))
+                # the interactive queue is empty: its hint is the floor
+                assert srv._engine.retry_after_hint("interactive") == 1
+                # ... and an interactive submission still ADMITS (its
+                # class queue has room even while batch is saturated)
+                code, body, _ = self._post(
+                    srv, {"input_ids": [[1, 2, 3]], "max_new_tokens": 2,
+                          "priority": "interactive"}, timeout=300)
+                assert code == 200
                 t1.join(timeout=300)
                 t2.join(timeout=300)
         assert all(code == 200 for code, _, _ in results)

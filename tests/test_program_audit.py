@@ -194,6 +194,36 @@ class TestEngineVerifyAudit:
                 analysis.audit_engine(eng, mode="verify")
 
 
+class TestEngineChunkAudit:
+    """ISSUE 7 CI satellite: the chunked-prefill continuation program
+    (shared with the prefix-cache suffix path) is certified
+    transfer-free with donation intact — interleaving prefill chunks
+    with decode must never smuggle a host sync or a dropped donation
+    into the serving loop."""
+
+    def test_chunk_program_transfer_free_donation_intact(self):
+        from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+        with ContinuousBatchingEngine(_tiny_model(), total_pages=32,
+                                      page_size=8, max_batch=4,
+                                      prefill_chunk_tokens=8) as eng:
+            audit = analysis.audit_engine(eng, mode="chunk")
+            assert audit.host_transfer_findings == [], audit.report()
+            assert not audit.by_rule("missed-donation"), audit.report()
+            # the fused-draw tail (sampled final chunk) keeps the
+            # same contract
+            draw = analysis.audit_engine(eng, mode="chunk",
+                                         sample="draw")
+            assert draw.host_transfer_findings == [], draw.report()
+            assert not draw.by_rule("missed-donation"), draw.report()
+
+    def test_unknown_mode_rejected(self):
+        from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+        with ContinuousBatchingEngine(_tiny_model(), total_pages=32,
+                                      page_size=8) as eng:
+            with pytest.raises(ValueError, match="chunk"):
+                analysis.audit_engine(eng, mode="prefill")
+
+
 class TestStaticProgramAudit:
     def test_program_audit_clean_math(self):
         prog = paddle.static.Program()

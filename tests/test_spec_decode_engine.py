@@ -201,7 +201,7 @@ class TestSpecScheduling:
             deadline = time.monotonic() + 10
             while time.monotonic() < deadline:
                 with eng._cond:
-                    idle = not eng._active and not eng._queue
+                    idle = not eng._active and not len(eng._sched)
                 if idle and eng.draft_cache.free_pages \
                         == eng.draft_cache.total_pages:
                     break

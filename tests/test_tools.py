@@ -126,6 +126,47 @@ class TestServeBench:
         assert out["jit_recompiles"] == 0
         assert out["failed_requests"] == 0
 
+    def test_scenario_matrix_lane_gate(self, capsys):
+        # ISSUE 7 CI satellite: the heterogeneous-workload lane must
+        # emit one JSON line per class plus a summary, with chat-class
+        # TTFT under the long-prompt flood within 2x of its no-flood
+        # baseline, the FIFO stall demonstrated, zero recompiles in
+        # every measured window, the chunked-prefill program audited
+        # clean, and batch-class preemption actually exercised
+        sb = self._load()
+        # flood == max_batch saturates every slot so interactive
+        # admission must go through slot preemption (gated below)
+        assert sb.main(["--scenario-matrix", "--flood=4", "--chat=4",
+                        "--rag=2"]) == 0
+        lines = [json.loads(x) for x in
+                 capsys.readouterr().out.strip().splitlines()]
+        per_class = {x["class"]: x for x in lines
+                     if x.get("lane") == "scenario-matrix"}
+        assert set(per_class) == {"interactive", "standard", "batch"}
+        for c, row in per_class.items():
+            assert row["admitted"] >= 1, c
+            assert row["ttft_p50_s"] is not None, c
+            assert row["ttft_p99_s"] >= row["ttft_p50_s"], c
+            assert row["tpot_mean_s"] is not None, c
+            assert row["queue_wait_mean_s"] is not None, c
+        assert per_class["batch"]["prefill_chunks"] > \
+            per_class["batch"]["requests"]     # long prompts chunked
+        summary = next(x for x in lines
+                       if x.get("lane") == "scenario-matrix-summary")
+        assert summary["jit_recompiles"] == 0
+        assert summary["audit_error_findings"] == 0
+        assert summary["batch_preemptions"] >= 1
+        assert summary["chat_ttft_p50_flood_chunked_s"] <= \
+            2.0 * summary["chat_ttft_p50_no_flood_s"] or \
+            summary["chat_ttft_mean_flood_chunked_s"] <= \
+            2.0 * summary["chat_ttft_mean_no_flood_s"]
+        # the stall the subsystem removes: same flood, scheduler off
+        # -> chat at least 2x worse on p50 or mean
+        assert summary["chat_ttft_p50_flood_fifo_s"] > \
+            2.0 * summary["chat_ttft_p50_flood_chunked_s"] or \
+            summary["chat_ttft_mean_flood_fifo_s"] > \
+            2.0 * summary["chat_ttft_mean_flood_chunked_s"]
+
     def test_fault_plan_lane_recovers(self, capsys):
         # ISSUE 4: --fault-plan injects failures into the measured
         # wave; the gate passes only if the blast radius stays inside

@@ -107,9 +107,9 @@ class TestRules:
                     self._reserved_pages -= 1   # contract: lock held
                 def good(self):
                     with self._cond:
-                        self._queue.append(1)
+                        self._prefilling.append(1)
                 def bad(self):
-                    self._queue.append(1)
+                    self._prefilling.append(1)
                     self._active = []
                     self.steps += 1
         """)

@@ -29,12 +29,34 @@ REQUIRED_SERIES = (
     "preemption_callback_errors_total",
 )
 
+#: scheduler series (ISSUE 7, README "Scheduling & multi-tenancy") —
+#: per-class labeled; the chunked preemption scenario below must
+#: populate each
+SCHEDULER_SERIES = (
+    "sched_admitted_total",
+    "sched_preemptions_total",
+    "sched_resumed_total",
+    "sched_prefill_chunks_total",
+    "sched_queue_depth",
+    "sched_queue_wait_seconds",
+    "sched_ttft_seconds",
+)
+
 
 def _value(snap: dict, name: str):
     m = snap.get(name)
     if not m or not m["series"]:
         return None
     return m["series"][0]["value"]
+
+
+def _series_total(snap: dict, name: str):
+    """Sum across a metric's labeled series (counter/gauge values, or
+    histogram observation counts); None when the series never fired."""
+    m = snap.get(name)
+    if not m or not m["series"]:
+        return None
+    return sum(s.get("value", s.get("count", 0)) for s in m["series"])
 
 
 def run_chaos() -> dict:
@@ -96,6 +118,30 @@ def run_chaos() -> dict:
         saturated = True
     drained = eng.drain(timeout=300) and r1.done.is_set() and saturated
 
+    # heterogeneous-workload scenario (ISSUE 7): a chunk-delayed
+    # batch-class prefill is preempted by an interactive request, then
+    # resumes — touches every scheduler series the README documents
+    plan2 = faults.FaultPlan([
+        {"site": "prefill_chunk", "seq_id": 0, "kind": "delay",
+         "delay_s": 0.05}])
+    preempted_ok = False
+    with faults.installed(plan2):
+        with ContinuousBatchingEngine(model, total_pages=64, page_size=8,
+                                      max_batch=1,
+                                      prefill_chunk_tokens=4) as eng:
+            rb = eng.submit(rng.integers(0, 64, (16,)), max_new_tokens=4,
+                            priority="batch", tenant="offline")
+            t0 = _time.monotonic()
+            while rb.prefill_pos == 0 and _time.monotonic() - t0 < 120:
+                _time.sleep(0.005)
+            ri = eng.submit(rng.integers(0, 64, (4,)), max_new_tokens=4,
+                            priority="interactive", tenant="chat")
+            ri.result(timeout=600)
+            rb.result(timeout=600)
+            preempted_ok = (ri.finished_at is not None
+                            and rb.finished_at is not None
+                            and ri.finished_at < rb.finished_at)
+
     # a failing preemption callback must be counted, not swallowed
     handler = PreemptionHandler(signals=())
 
@@ -109,20 +155,34 @@ def run_chaos() -> dict:
 
     snap = monitor.snapshot()
     out = {name: _value(snap, name) for name in REQUIRED_SERIES}
+    for name in SCHEDULER_SERIES:
+        out[name] = _series_total(snap, name)
     out["_poisoned_errors"] = errors
     out["_pool_clean"] = pool_clean
     out["_drained"] = drained
+    out["_preempted_ok"] = preempted_ok
     return out
 
 
 def main() -> int:
     out = run_chaos()
-    missing = [n for n in REQUIRED_SERIES if out.get(n) is None]
+    missing = [n for n in REQUIRED_SERIES + SCHEDULER_SERIES
+               if out.get(n) is None]
     if missing:
-        print(f"FAIL: monitor.snapshot() missing resilience series "
-              f"{missing}", file=sys.stderr)
+        print(f"FAIL: monitor.snapshot() missing resilience/scheduler "
+              f"series {missing}", file=sys.stderr)
         return 1
     checks = [
+        ("interactive preempted the batch prefill and both finished",
+         out["_preempted_ok"]),
+        ("sched_preemptions_total counted the slot pause",
+         out["sched_preemptions_total"] >= 1),
+        ("sched_resumed_total counted the resume",
+         out["sched_resumed_total"] >= 1),
+        ("sched_prefill_chunks_total counted chunked prefill",
+         out["sched_prefill_chunks_total"] >= 4),
+        ("sched_admitted_total counted admissions",
+         out["sched_admitted_total"] >= 2),
         ("exactly the 2 poisoned requests errored",
          out["_poisoned_errors"] == 2),
         ("pool fully reclaimed after quarantine", out["_pool_clean"]),

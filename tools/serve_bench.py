@@ -23,6 +23,18 @@ baseline, turning accept-rate vs tokens/sec vs TTFT into a curve; all
 numbers are monitor.snapshot() deltas (``spec_*`` counters + the
 ``spec_accept_len`` histogram) and the measured window still gates
 ``jit_recompiles == 0``.
+
+Scenario-matrix lane (ISSUE 7): ``--scenario-matrix`` serves the
+three-way mixed workload — chat (short, latency-bound, interactive
+class), RAG (long shared-prefix prompt, standard class) and
+offline-batch (8x-chunk long prompts, preemptible batch class) —
+through the heterogeneous-workload scheduler, emitting one JSON line
+per class (TTFT p50/p99, TPOT, queue wait, preemptions — all labeled
+monitor deltas) plus a summary line gating: chat TTFT under the
+long-prompt flood within 2x of its no-flood baseline (the unchunked
+FIFO run is printed alongside to show the stall chunking removes),
+``jit_recompiles == 0`` in every measured window, the chunked-prefill
+program audited transfer-free, and batch-class preemption exercised.
 """
 from __future__ import annotations
 
@@ -33,14 +45,25 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _hist_delta(before: dict, after: dict, name: str):
-    """(bucket_delta {le: count}, sum_delta, count_delta) for an
-    unlabeled histogram between two monitor.snapshot() dicts."""
+def _find_series(snap: dict, name: str, labels):
+    m = snap.get(name)
+    if not m:
+        return None
+    for s in m["series"]:
+        if labels is None or s.get("labels", {}) == labels:
+            return s
+    return None
+
+
+def _hist_delta(before: dict, after: dict, name: str, labels=None):
+    """(bucket_delta {le: count}, sum_delta, count_delta) for a
+    histogram between two monitor.snapshot() dicts.  ``labels`` picks
+    one labeled series (e.g. ``{"cls": "interactive"}`` for the
+    per-class SLO histograms); None takes the first/only series."""
     def series(snap):
-        m = snap.get(name)
-        if not m or not m["series"]:
+        s = _find_series(snap, name, labels)
+        if s is None:
             return {}, 0.0, 0
-        s = m["series"][0]
         return s["buckets"], s["sum"], s["count"]
 
     b0, s0, c0 = series(before)
@@ -49,10 +72,11 @@ def _hist_delta(before: dict, after: dict, name: str):
     return buckets, s1 - s0, c1 - c0
 
 
-def _counter_delta(before: dict, after: dict, name: str) -> float:
+def _counter_delta(before: dict, after: dict, name: str,
+                   labels=None) -> float:
     def val(snap):
-        m = snap.get(name)
-        return m["series"][0]["value"] if m and m["series"] else 0.0
+        s = _find_series(snap, name, labels)
+        return s["value"] if s else 0.0
     return val(after) - val(before)
 
 
@@ -277,6 +301,271 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
     }
 
 
+# --------------------------------------------------------------------
+# scenario-matrix lane (ISSUE 7): chat + RAG + offline-batch mixed
+# workload through the heterogeneous-workload scheduler
+# --------------------------------------------------------------------
+
+SCENARIO_CLASSES = ("interactive", "standard", "batch")
+
+
+def _p50(vals):
+    vals = sorted(vals)
+    return vals[len(vals) // 2] if vals else None
+
+
+def _build_tiny_model(vocab=64, hidden=32):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                      intermediate_size=2 * hidden, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return LlamaForCausalLM(cfg)
+
+
+def run_scenario_lane(model=None, chunk_tokens=16, use_classes=True,
+                      flood_n=4, rag_n=2, chat_n=6, seed=0) -> dict:
+    """One scenario-matrix serving run: ``flood_n`` long-prompt
+    (96-token, 8x chunk) offline-batch requests, ``rag_n`` shared-
+    system-prefix RAG requests, and ``chat_n`` short interactive
+    requests submitted BEHIND the flood — the exact pattern that
+    stalls a FIFO engine.  A flood of ``max_batch`` (4) requests
+    saturates every slot, so interactive admission must exercise SLOT
+    PREEMPTION, not just the chunk budget.  ``chunk_tokens=None`` disables chunking and
+    ``use_classes=False`` submits everything default-class: together
+    they are the unchunked-FIFO baseline the ROADMAP item measures
+    against.
+
+    Chat-class TTFT is taken per request (submit -> first token, the
+    same instants the monitor histograms observe) so the three lanes
+    compare exactly; per-class SLO series come from labeled
+    ``monitor.snapshot()`` deltas.  The measured window must be
+    compile-free: the warm pass covers every decode bucket and every
+    chunk/prefix program shape the (position-derived, never
+    timing-derived) chunk plan can produce."""
+    import numpy as np
+    from paddle_tpu import analysis, monitor
+    from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+
+    monitor.install_compile_hooks()
+    if model is None:
+        model = _build_tiny_model()
+    rng = np.random.default_rng(seed)
+    system = rng.integers(0, 64, (32,)).astype("int32")
+
+    def cls(name):
+        return name if use_classes else None
+
+    with ContinuousBatchingEngine(
+            model, total_pages=192, page_size=8, max_batch=4,
+            prefill_chunk_tokens=chunk_tokens,
+            min_table_pages=16, max_queue=64) as eng:
+        n_sub = [0]
+
+        def submit(prompt, max_new, priority, tenant):
+            n_sub[0] += 1
+            # the FIFO baseline collapses tenants too: one class + one
+            # tenant = strict submission order, the stall scenario
+            return eng.submit(prompt, max_new_tokens=max_new,
+                              priority=cls(priority),
+                              tenant=tenant if use_classes else "default",
+                              seed=n_sub[0])
+
+        def chat_req(i):
+            return submit(rng.integers(0, 64, (6,)).astype("int32"), 8,
+                          "interactive", f"chat{i % 2}")
+
+        def rag_req():
+            p = np.concatenate(
+                [system, rng.integers(0, 64, (5,))]).astype("int32")
+            return submit(p, 6, "standard", "rag")
+
+        def flood_req():
+            return submit(rng.integers(0, 64, (96,)).astype("int32"), 6,
+                          "batch", "offline")
+
+        def wave():
+            import time as _time
+            batch_reqs = [flood_req() for _ in range(flood_n)]
+            # the flood must be ADMITTED (slots held, prefill running)
+            # before interactive traffic arrives — that is the stall
+            # scenario, and what forces the chunked lane through slot
+            # preemption rather than mere admission ordering
+            deadline = _time.monotonic() + 120
+            while _time.monotonic() < deadline and not all(
+                    r.seq_id is not None for r in batch_reqs):
+                _time.sleep(0.002)
+            reqs = {
+                "batch": batch_reqs,
+                "standard": [rag_req() for _ in range(rag_n)],
+                "interactive": [chat_req(i) for i in range(chat_n)],
+            }
+            for rs in reqs.values():
+                for r in rs:
+                    r.result(timeout=600)
+            return reqs
+
+        # warm pass: decode buckets 1/2/4 explicitly, then a SEQUENCED
+        # rag request (its prefill must register the system prefix
+        # before any other rag admits, or the prefix-HIT suffix
+        # program stays uncompiled until the measured window), then
+        # the full mix (cold + prefix-hit chunk shapes)
+        chat_req(0).result(timeout=600)
+        for r in [chat_req(i) for i in range(2)]:
+            r.result(timeout=600)
+        for r in [chat_req(i) for i in range(4)]:
+            r.result(timeout=600)
+        if rag_n:
+            rag_req().result(timeout=600)
+        wave()
+
+        before = monitor.snapshot()
+        reqs = wave()
+        after = monitor.snapshot()
+        audit_errors = None
+        if chunk_tokens:
+            audit = analysis.audit_engine(eng, mode="chunk",
+                                          publish=False)
+            audit_errors = sum(1 for f in audit.findings
+                               if f.severity == "error")
+
+    chat_ttfts = [r.first_token_at - r.submitted_at
+                  for r in reqs["interactive"]
+                  if r.first_token_at is not None]
+    _, compile_sum, compile_n = _hist_delta(before, after,
+                                            "jit_compile_seconds")
+    per_class = {}
+    if use_classes:
+        for c in SCENARIO_CLASSES:
+            lb = {"cls": c}
+            tb, ts, tn = _hist_delta(before, after,
+                                     "sched_ttft_seconds", lb)
+            qb, qs, qn = _hist_delta(before, after,
+                                     "sched_queue_wait_seconds", lb)
+            pb, ps, pn = _hist_delta(before, after,
+                                     "sched_tpot_seconds", lb)
+            per_class[c] = {
+                "lane": "scenario-matrix", "class": c,
+                "requests": len(reqs.get(c, ())),
+                "ttft_p50_s": hist_quantile(tb, 0.50),
+                "ttft_p99_s": hist_quantile(tb, 0.99),
+                "ttft_mean_s": (ts / tn) if tn else None,
+                "queue_wait_p50_s": hist_quantile(qb, 0.50),
+                "queue_wait_mean_s": (qs / qn) if qn else None,
+                "tpot_mean_s": (ps / pn) if pn else None,
+                "admitted": int(_counter_delta(
+                    before, after, "sched_admitted_total", lb)),
+                "preemptions": int(_counter_delta(
+                    before, after, "sched_preemptions_total", lb)),
+                "chunk_deferrals": int(_counter_delta(
+                    before, after, "sched_chunk_deferrals_total", lb)),
+                "prefill_chunks": int(_counter_delta(
+                    before, after, "sched_prefill_chunks_total", lb)),
+            }
+    return {
+        "lane": "scenario-matrix",
+        "chunk_tokens": chunk_tokens,
+        "classes": bool(use_classes),
+        "flood": flood_n, "rag": rag_n, "chat": chat_n,
+        "chat_ttft_p50_s": _p50(chat_ttfts),
+        "chat_ttft_mean_s": (sum(chat_ttfts) / len(chat_ttfts)
+                             if chat_ttfts else None),
+        "jit_recompiles": int(compile_n),
+        "jit_compile_seconds": compile_sum,
+        "audit_error_findings": audit_errors,
+        "per_class": per_class,
+    }
+
+
+def run_scenario_matrix(argv) -> int:
+    """The ``--scenario-matrix`` lane: three runs of the same mixed
+    workload — (1) chunked+classes without the flood (the chat-class
+    no-flood TTFT baseline), (2) chunked+classes with the flood (one
+    JSON line per class), (3) unchunked FIFO with the flood (the stall
+    the scheduler exists to prevent).  Gates: chat TTFT under flood
+    within 2x of its no-flood baseline (p50, with the exact mean as
+    the quantization-free backstop); the FIFO baseline demonstrably
+    stalled; zero recompiles in every measured window; the chunked-
+    prefill program audited transfer-free; batch-class preemption
+    actually exercised."""
+    chunk = _int_arg(argv, "chunk-tokens", 16)
+    flood_n = _int_arg(argv, "flood", 4)
+    rag_n = _int_arg(argv, "rag", 2)
+    chat_n = _int_arg(argv, "chat", 6)
+    model = _build_tiny_model(vocab=_int_arg(argv, "vocab", 64),
+                              hidden=_int_arg(argv, "hidden", 32))
+    alone = run_scenario_lane(model, chunk_tokens=chunk, flood_n=0,
+                              rag_n=rag_n, chat_n=chat_n)
+    mixed = run_scenario_lane(model, chunk_tokens=chunk, flood_n=flood_n,
+                              rag_n=rag_n, chat_n=chat_n)
+    fifo = run_scenario_lane(model, chunk_tokens=None, use_classes=False,
+                             flood_n=flood_n, rag_n=rag_n, chat_n=chat_n)
+    for c in SCENARIO_CLASSES:
+        if c in mixed["per_class"]:
+            print(json.dumps(mixed["per_class"][c], sort_keys=True))
+    preemptions = (mixed["per_class"]["batch"]["preemptions"]
+                   + mixed["per_class"]["batch"]["chunk_deferrals"])
+    summary = {
+        "lane": "scenario-matrix-summary",
+        "chunk_tokens": chunk,
+        "chat_ttft_p50_no_flood_s": alone["chat_ttft_p50_s"],
+        "chat_ttft_p50_flood_chunked_s": mixed["chat_ttft_p50_s"],
+        "chat_ttft_p50_flood_fifo_s": fifo["chat_ttft_p50_s"],
+        "chat_ttft_mean_no_flood_s": alone["chat_ttft_mean_s"],
+        "chat_ttft_mean_flood_chunked_s": mixed["chat_ttft_mean_s"],
+        "chat_ttft_mean_flood_fifo_s": fifo["chat_ttft_mean_s"],
+        "batch_preemptions": preemptions,
+        "audit_error_findings": mixed["audit_error_findings"],
+        "jit_recompiles": (alone["jit_recompiles"]
+                           + mixed["jit_recompiles"]
+                           + fifo["jit_recompiles"]),
+    }
+    print(json.dumps(summary, sort_keys=True))
+    if not all((alone["chat_ttft_p50_s"], mixed["chat_ttft_p50_s"],
+                fifo["chat_ttft_p50_s"])):
+        print("FAIL: a lane produced no chat TTFT samples — the "
+              "scenario matrix needs --chat >= 1", file=sys.stderr)
+        return 1
+    ok = True
+    p50_ratio = mixed["chat_ttft_p50_s"] / alone["chat_ttft_p50_s"]
+    mean_ratio = mixed["chat_ttft_mean_s"] / alone["chat_ttft_mean_s"]
+    if not (p50_ratio <= 2.0 or mean_ratio <= 2.0):
+        print(f"FAIL: chat TTFT under flood is {p50_ratio:.2f}x p50 / "
+              f"{mean_ratio:.2f}x mean of the no-flood baseline "
+              "(acceptance bound: 2x)", file=sys.stderr)
+        ok = False
+    # the stall comparison holds the LOAD fixed (same flood) and flips
+    # the scheduler: unchunked FIFO must be at least 2x worse for chat
+    # than the chunked/classed lane on either statistic
+    if not (fifo["chat_ttft_p50_s"] > 2.0 * mixed["chat_ttft_p50_s"]
+            or fifo["chat_ttft_mean_s"]
+            > 2.0 * mixed["chat_ttft_mean_s"]):
+        print("FAIL: the unchunked FIFO baseline did not stall "
+              f"(p50 {fifo['chat_ttft_p50_s']} vs chunked "
+              f"{mixed['chat_ttft_p50_s']}) — the scenario is not "
+              "exercising the problem", file=sys.stderr)
+        ok = False
+    if summary["jit_recompiles"] != 0:
+        print(f"FAIL: {summary['jit_recompiles']} recompile(s) inside "
+              "measured windows; a warm-up pass missed a program shape",
+              file=sys.stderr)
+        ok = False
+    if mixed["audit_error_findings"] != 0:
+        print(f"FAIL: chunked-prefill program audit found "
+              f"{mixed['audit_error_findings']} error finding(s)",
+              file=sys.stderr)
+        ok = False
+    if preemptions <= 0:
+        print("FAIL: the flood never preempted/deferred batch-class "
+              "prefill — the priority machinery did not engage",
+              file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
 def _int_arg(argv, name, default):
     return next((int(a.split("=", 1)[1]) for a in argv
                  if a.startswith(f"--{name}=")), default)
@@ -302,6 +591,11 @@ def _fault_plan_arg(argv):
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if "--scenario-matrix" in argv:
+        # heterogeneous-workload lane (ISSUE 7): chat + RAG + offline
+        # batch through the scheduler, one JSON line per class plus a
+        # summary gating chat TTFT under a long-prompt flood
+        return run_scenario_matrix(argv)
     baseline = "--baseline" in argv
     plan = _fault_plan_arg(argv)
     kw = dict(sharers=_int_arg(argv, "sharers", 6),
