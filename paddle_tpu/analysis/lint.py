@@ -104,7 +104,11 @@ LOCK_CLASSES: Dict[str, Tuple[str, frozenset]] = {
         # every mutation must happen under the engine's _cond) and the
         # mid-prefill lists the drain/reap/preemption paths walk
         # (these replaced the pre-PR-7 _queue/_admitting attributes)
-        "_sched", "_prefilling", "_preempted"})),
+        "_sched", "_prefilling", "_preempted",
+        # crash consistency (ISSUE 8): the snapshot() quiesce barrier —
+        # the loop thread and snapshotting threads hand off through
+        # these under _cond
+        "_stepping", "_snap_waiters"})),
 }
 
 

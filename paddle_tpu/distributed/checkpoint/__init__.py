@@ -34,6 +34,11 @@ from ..auto_parallel.placement import Shard, Replicate
 from ..auto_parallel.process_mesh import ProcessMesh
 from ..env import get_rank
 
+# pending async-save writer threads.  Guarded by _async_lock (ISSUE 8
+# satellite): concurrent save_state_dict(async_save=True) and
+# wait_async_save() calls used to race the bare list's append/clear,
+# losing joins — and a writer-thread exception vanished entirely.
+_async_lock = threading.Lock()
 _async_tasks = []
 
 
@@ -158,17 +163,48 @@ def save_state_dict(state_dict: Dict[str, Tensor], path: str,
             pickle.dump(shards, f, protocol=4)
 
     if async_save:
-        th = threading.Thread(target=_write, daemon=True)
+        def _write_capturing():
+            try:
+                _write()
+            except BaseException as e:  # noqa: BLE001 — surfaced by
+                th._ckpt_exc = e        # wait_async_save, never lost
+
+        th = threading.Thread(target=_write_capturing, daemon=True)
+        th._ckpt_exc = None
+        # start BEFORE registering: a concurrent wait_async_save that
+        # pops the list must only ever see started (joinable) threads —
+        # a save that has not returned yet is not awaitable anyway
         th.start()
-        _async_tasks.append(th)
+        with _async_lock:
+            _async_tasks.append(th)
     else:
         _write()
 
 
 def wait_async_save():
-    for th in _async_tasks:
+    """Join every pending async save.  A writer thread's exception is
+    re-raised here (the first one, after ALL pending writes finished)
+    instead of being silently dropped with the thread — a failed
+    checkpoint write must never look like a durable checkpoint.
+
+    Concurrent callers each block until every write pending at their
+    entry has finished (the list is snapshotted, joined, and only then
+    pruned — a second caller never sees an empty list while writers
+    are still in flight); each writer's exception is consumed by
+    exactly one caller (whoever wins the prune)."""
+    with _async_lock:
+        tasks = list(_async_tasks)
+    for th in tasks:
         th.join()
-    _async_tasks.clear()
+    errors = []
+    with _async_lock:
+        for th in tasks:
+            if th in _async_tasks:
+                _async_tasks.remove(th)
+                if th._ckpt_exc is not None:
+                    errors.append(th._ckpt_exc)
+    if errors:
+        raise errors[0]
 
 
 class _ShardReader:

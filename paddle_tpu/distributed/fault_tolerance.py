@@ -15,6 +15,7 @@ import re
 import signal
 import sys
 import threading
+import time
 import warnings
 from typing import Callable, List, Optional
 
@@ -94,11 +95,36 @@ class PreemptionHandler:
 
 _CKPT_RE = re.compile(r"step_(\d+)$")
 
+#: a reader's ``step_N.inuse`` marker older than this is considered
+#: leaked (the reading process crashed mid-load) and no longer blocks
+#: pruning
+_INUSE_STALE_S = 3600.0
+
+
+def _inuse_path(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, f"step_{step}.inuse")
+
+
+def _step_in_use(ckpt_dir: str, step: int) -> bool:
+    """True while a concurrent ``load_checkpoint`` holds a fresh
+    ``.inuse`` marker on this step (ISSUE 8 satellite: the prune loop
+    used to delete a checkpoint another process was mid-read on)."""
+    try:
+        age = time.time() - os.path.getmtime(_inuse_path(ckpt_dir, step))
+    except OSError:
+        return False
+    return age < _INUSE_STALE_S
+
 
 def save_checkpoint(state_dict: dict, ckpt_dir: str, step: int,
                     keep_last_n: int = 3) -> str:
     """Atomic checkpoint write: save to tmp, rename, prune old
-    (reference: paddle.save + dist checkpoint's async/atomic discipline)."""
+    (reference: paddle.save + dist checkpoint's async/atomic discipline).
+
+    Pruning never removes a step a concurrent :func:`load_checkpoint`
+    is mid-read on: the reader leaves a ``step_N.inuse`` marker for the
+    duration of the load (stale markers — a reader that crashed — stop
+    blocking after an hour)."""
     from ..framework.io import save as _save
     os.makedirs(ckpt_dir, exist_ok=True)
     final = os.path.join(ckpt_dir, f"step_{step}")
@@ -107,10 +133,13 @@ def save_checkpoint(state_dict: dict, ckpt_dir: str, step: int,
     os.replace(tmp, final)
     _ckpts_saved_total.inc()
     _ckpt_last_step.set(step)
-    # prune (always keep at least the checkpoint just written)
+    # prune (always keep at least the checkpoint just written, and
+    # skip any step a concurrent reader has marked in use)
     keep = max(keep_last_n, 1)
     ckpts = sorted(_list_checkpoints(ckpt_dir))
     for s in ckpts[:-keep]:
+        if _step_in_use(ckpt_dir, s):
+            continue
         try:
             os.remove(os.path.join(ckpt_dir, f"step_{s}"))
         except OSError:
@@ -135,13 +164,39 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
 
 
 def load_checkpoint(ckpt_dir: str):
-    """(state_dict, step) of the newest checkpoint, or (None, 0)."""
+    """(state_dict, step) of the newest checkpoint, or (None, 0).
+
+    The resolved step is marked ``.inuse`` for the duration of the
+    read so a concurrent :func:`save_checkpoint`'s prune loop skips
+    it (ISSUE 8 satellite).  Marker creation and the prune's
+    check-then-remove are not atomic against each other, so the
+    narrow remaining window is closed by a bounded retry: if the
+    resolved file vanishes under us, re-resolve — the writer that
+    pruned it has by definition just produced a NEWER checkpoint."""
     from ..framework.io import load as _load
-    path = latest_checkpoint(ckpt_dir)
-    if path is None:
-        return None, 0
-    step = int(_CKPT_RE.search(os.path.basename(path)).group(1))
-    return _load(path), step
+    last_err = None
+    for _ in range(3):
+        path = latest_checkpoint(ckpt_dir)
+        if path is None:
+            return None, 0
+        step = int(_CKPT_RE.search(os.path.basename(path)).group(1))
+        marker = _inuse_path(ckpt_dir, step)
+        try:
+            with open(marker, "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            marker = None      # read-only dir: best effort, load anyway
+        try:
+            return _load(path), step
+        except FileNotFoundError as e:
+            last_err = e       # pruned mid-read: re-resolve and retry
+        finally:
+            if marker is not None:
+                try:
+                    os.remove(marker)
+                except OSError:
+                    pass
+    raise last_err
 
 
 def run_with_resume(train_loop: Callable, ckpt_dir: str,

@@ -128,18 +128,23 @@ class CommTaskManager:
 
     # ------------------------------------------------------- heartbeats
     def register_heartbeat(self, name: str, age_fn: Callable[[], Optional[float]],
-                           timeout: Optional[float] = None) -> int:
+                           timeout: Optional[float] = None,
+                           on_timeout: Optional[Callable[[], None]] = None
+                           ) -> int:
         """Register a liveness probe scanned alongside the comm tasks.
         ``age_fn() -> seconds`` the probed work has been in flight (None
         = idle, never flagged).  When the age exceeds ``timeout`` the
         standard timeout machinery fires (``comm_timeouts_total``,
-        handler/warn/abort); the probe re-arms once it reports healthy
+        handler/warn/abort) AND, if given, ``on_timeout()`` is invoked
+        from the watchdog thread (ISSUE 8: the serving engine hooks its
+        wedged-step restart here — the probe owner gets to REACT, not
+        just be counted).  The probe re-arms once it reports healthy
         again.  Returns a handle for :meth:`unregister_heartbeat`."""
         t = get_flag("comm_timeout_seconds") if timeout is None else timeout
         with self._lock:
             self._seq += 1
             hid = self._seq
-            self._heartbeats[hid] = (name, age_fn, t)
+            self._heartbeats[hid] = (name, age_fn, t, on_timeout)
         return hid
 
     def unregister_heartbeat(self, hid: int) -> None:
@@ -160,7 +165,7 @@ class CommTaskManager:
                     max((now - t.started_at
                          for t in self._tasks.values()), default=0.0))
                 beats = list(self._heartbeats.items())
-            for hid, (name, age_fn, timeout) in beats:
+            for hid, (name, age_fn, timeout, on_timeout) in beats:
                 try:
                     age = age_fn()
                 except Exception:       # noqa: BLE001 — probe must not
@@ -176,6 +181,12 @@ class CommTaskManager:
                         stale = CommTask(name, timeout)
                         stale.started_at = now - age
                         hung.append((None, stale))
+                        if on_timeout is not None:
+                            try:
+                                on_timeout()
+                            except Exception:   # noqa: BLE001 — a
+                                pass            # reactor bug must not
+                                                # kill the watchdog
                 else:
                     with self._lock:
                         self._hb_flagged.discard(hid)

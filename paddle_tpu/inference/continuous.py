@@ -83,6 +83,41 @@ Heterogeneous workloads (ISSUE 7):
     admission / preemption / chunk counters) land in ``monitor``
     labeled ``cls=<class>``; ``/health`` reports queue depths and the
     active policy knobs.
+
+Crash-consistent serving (ISSUE 8):
+
+  * ONE replay primitive — a sequence's KV state is reconstructed by
+    re-prefilling ``prompt + generated-so-far`` through the existing
+    (chunked) context-prefill program.  Bit-exact for greedy AND
+    sampled rows: the fused sampler's counter is ``(seed, absolute
+    position)``, so a replayed draw is the original draw — and the
+    already-transferred ``next_token`` is host state that survives any
+    device-side loss, so the continuation is token-for-token what the
+    uninterrupted run would have produced;
+  * **device-failure recovery** — after a REAL donated-buffer loss the
+    decoder rebuilds the pools zeroed (``PagedKVCache.generation``
+    bumps); the engine detects the bump across any failed step/chunk,
+    replays EVERY survivor (active, mid-prefill and preempted; draft
+    pool in lockstep; prefix-cache entries re-registered with page
+    refcounts restored) and only then retries/bisects — so quarantine
+    ejects exactly the poisoned row for device-side failures too, not
+    just host-side ones;
+  * **watchdog-driven restart** — when the ``step_timeout_s``
+    heartbeat fires, the watchdog's ``on_timeout`` callback flags the
+    in-flight step as wedged; the engine then performs a BOUNDED
+    rebuild (reset pools + survivor replay + one retry, after which
+    the normal retry/bisect ladder bounds further attempts) instead of
+    only incrementing ``comm_timeouts_total``;
+  * **snapshot/restore** — ``snapshot()`` quiesces at a step boundary
+    and serializes every in-flight request (prompt, generated ids,
+    pending next token, seed, class/tenant, draft opt-in, remaining
+    TTL) to a JSON-able journal; ``restore()`` resubmits each entry
+    through the replay primitive (admission-path mode: the chunked
+    prefill ingests ``prompt + generated`` instead of the prompt), so
+    a restarted process resumes mid-stream requests exactly;
+  * telemetry: ``survivor_replays_total`` / ``engine_rebuilds_total``
+    counters, the ``engine_recovery_seconds`` histogram (serving MTTR)
+    and ``snapshot_requests_total``.
 """
 from __future__ import annotations
 
@@ -108,6 +143,15 @@ __all__ = [
 _PAD_SEQ = "__pad__"
 
 
+def _null_sampling(n: int = 1):
+    """Fused-sampling args whose rows draw nothing (flags all False):
+    the argmax-only program tail for dispatches whose sampled value is
+    discarded — intermediate prefill chunks, draft prompt ingestion,
+    and KV replay."""
+    return (np.zeros(n, np.uint32), np.zeros(n, np.int32),
+            np.ones(n, np.float32), np.zeros(n, bool))
+
+
 class EngineSaturated(RuntimeError):
     """The bounded admission queue is full — retryable later (the
     GenerationServer maps this to HTTP 429 + Retry-After)."""
@@ -125,6 +169,15 @@ class DeadlineExceeded(RuntimeError):
 
 class RequestCancelled(RuntimeError):
     """The request was cooperatively cancelled via ``cancel()``."""
+
+
+class _EngineWedged(RuntimeError):
+    """Internal (ISSUE 8): the comm watchdog flagged the in-flight
+    compiled step as wedged (heartbeat age exceeded
+    ``step_timeout_s``).  The engine treats the step's results as
+    suspect: pools are rebuilt, survivors replayed, and the step
+    retried — the normal retry/bisect ladder bounds a persistent
+    wedge."""
 
 
 # engine telemetry (ISSUE 1): the serving-side numbers the ROADMAP's
@@ -208,6 +261,23 @@ _spec_draft_pages = monitor.gauge(
 _spec_draft_failures = monitor.counter(
     "spec_draft_failures_total", "draft-side prefill/propose failures "
     "that downgraded requests to plain decode")
+# crash-consistency telemetry (ISSUE 8): the recovery machinery's
+# footprint — replays per survivor, rebuild events, and the MTTR
+# histogram the serve_bench recovery lane quotes
+_survivor_replays = monitor.counter(
+    "survivor_replays_total", "sequences whose KV was reconstructed by "
+    "replay (re-prefill of prompt + generated-so-far) after a "
+    "donated-buffer loss or watchdog-driven rebuild")
+_rebuilds_total = monitor.counter(
+    "engine_rebuilds_total", "pool-rebuild recovery events the engine "
+    "absorbed: device-side donated-buffer losses plus watchdog-flagged "
+    "wedged steps")
+_recovery_s = monitor.histogram(
+    "engine_recovery_seconds", "one recovery event end to end: pool "
+    "rebuild + every survivor's KV replay (the serving MTTR)")
+_snapshot_reqs = monitor.counter(
+    "snapshot_requests_total", "in-flight requests serialized by "
+    "engine.snapshot()")
 
 #: one request's share of a speculative verify step: the bonus token
 #: (ids or the logits-row escape hatch), the device-computed accept
@@ -266,6 +336,15 @@ class _Request:
         self.chunks_done = 0
         self.admitted_at: Optional[float] = None
         self._admit_plan = None          # (need, shared_tok) fit-check stash
+        # crash consistency (ISSUE 8): a restored request carries the
+        # full prompt + generated token sequence its prefill must make
+        # KV-resident (the replay primitive's admission-path mode);
+        # preempted_at/paused_total bound a paused prefill's page
+        # reservation (paused_total accumulates across preempt/resume
+        # cycles so re-preemption cannot reset the aging clock)
+        self.replay_tokens: Optional[np.ndarray] = None
+        self.preempted_at: Optional[float] = None
+        self.paused_total = 0.0
         # speculative decoding (ISSUE 6): set by the engine at submit;
         # _draft_reserved tracks whether draft-pool reservation is held
         self.use_draft = False
@@ -293,6 +372,16 @@ class _Request:
     def output_ids(self) -> np.ndarray:
         return np.concatenate(
             [self.prompt, np.asarray(self.generated, np.int32)])
+
+    @property
+    def prefill_target(self) -> np.ndarray:
+        """The tokens that must be KV-resident before this request can
+        decode: the prompt — or, for a restored request, prompt +
+        generated-so-far (the replay primitive's admission-path mode:
+        the SAME chunked context-prefill program ingests the longer
+        sequence, ISSUE 8)."""
+        return (self.prompt if self.replay_tokens is None
+                else self.replay_tokens)
 
     def cancel(self) -> bool:
         """Cooperative cancel: honored before admission and between
@@ -378,6 +467,14 @@ class ContinuousBatchingEngine:
     taxonomy (``submit(priority=..., tenant=...)``);
     ``min_table_pages`` pins compiled page-table widths so
     mixed-length serving stays recompile-free.
+
+    Crash consistency (ISSUE 8): a REAL donated-buffer loss or a
+    watchdog-flagged wedged step triggers a pool rebuild + bit-exact
+    survivor KV replay (see the module docstring);
+    :meth:`snapshot` / :meth:`restore` journal and resume in-flight
+    requests across a process restart; ``preempt_resume_ttl_s`` bounds
+    how long a preempted prefill may hold its page reservation (aging
+    boost at half the TTL, reaped with pages reclaimed past it).
     """
 
     def __init__(self, model, total_pages: int = 512, page_size: int = 16,
@@ -391,7 +488,8 @@ class ContinuousBatchingEngine:
                  prefill_chunk_tokens: Optional[int] = None,
                  scheduler_classes=None,
                  default_class: str = DEFAULT_CLASS,
-                 min_table_pages: int = 1):
+                 min_table_pages: int = 1,
+                 preempt_resume_ttl_s: Optional[float] = None):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_position = int(model.config.max_position_embeddings)
@@ -409,6 +507,14 @@ class ContinuousBatchingEngine:
             raise ValueError("prefill_chunk_tokens must be >= 1 or None")
         self.prefill_chunk_tokens = (None if prefill_chunk_tokens is None
                                      else int(prefill_chunk_tokens))
+        # resume-TTL for preempted prefills (ISSUE 8 satellite): a
+        # paused request holds its page reservation at most this long —
+        # past HALF the TTL an aging boost forces its resume ahead of
+        # any queued class; past the full TTL it is reaped with pages
+        # reclaimed (None keeps the historical unbounded behavior)
+        self.preempt_resume_ttl_s = (
+            None if preempt_resume_ttl_s is None
+            else float(preempt_resume_ttl_s))
         _sampling_on_device_g.set(int(self.sample_on_device))
         # runtime mirror of the analysis auditor's recompile rules:
         # every XLA compile the decode loop triggers shows up in
@@ -475,6 +581,17 @@ class ContinuousBatchingEngine:
         self._draining = False
         self._next_seq = 0
         self.steps = 0                          # decode steps executed
+        # crash consistency (ISSUE 8): the summed pool generation the
+        # engine last reconciled (a mismatch after a failed step means
+        # a donated-buffer loss zeroed survivor KV — replay required);
+        # _wedged is set from the WATCHDOG thread when the heartbeat
+        # fires, consumed at the next step boundary; _stepping/_
+        # snap_waiters implement the snapshot() quiesce barrier
+        self._pool_gen = self.cache.generation + (
+            self.draft_cache.generation if self._spec else 0)
+        self._wedged = threading.Event()
+        self._stepping = False
+        self._snap_waiters = 0
         # stall detection (ISSUE 4): while a compiled step is in flight
         # this holds its start instant; the watchdog heartbeat reports
         # its age so a wedged step trips the comm timeout machinery
@@ -485,7 +602,7 @@ class ContinuousBatchingEngine:
             mgr = CommTaskManager.instance()
             self._hb_id = mgr.register_heartbeat(
                 "engine/decode_step", self._step_age,
-                float(step_timeout_s))
+                float(step_timeout_s), on_timeout=self._wedged.set)
             mgr.start()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -502,7 +619,8 @@ class ContinuousBatchingEngine:
                queue_timeout_s: Optional[float] = None,
                draft: Optional[bool] = None,
                priority: Optional[str] = None,
-               tenant: str = "default") -> _Request:
+               tenant: str = "default",
+               _restore: Optional[dict] = None) -> _Request:
         """``draft``: speculative-decoding opt-in for this request.
         ``None`` (default) speculates whenever the engine has a draft
         model and the request is greedy; ``False`` opts out; ``True``
@@ -523,6 +641,37 @@ class ContinuousBatchingEngine:
                                         if queue_timeout_s is None
                                         else queue_timeout_s),
                        priority=pclass.name, tenant=tenant)
+        if _restore is not None:
+            # snapshot restore (ISSUE 8): preload the journaled
+            # generation state BEFORE the request becomes visible to
+            # the scheduler thread — admission then prefills
+            # prompt + generated through the replay primitive and the
+            # journaled next token continues the stream exactly
+            gen = [int(t) for t in _restore.get("generated", ())]
+            if gen:
+                req.generated = gen
+                req.replay_tokens = np.concatenate(
+                    [req.prompt, np.asarray(gen, np.int32)])
+            # the journaled pending token is kept even with NO
+            # generated tokens yet (snapshot cut between prefill
+            # completion and the first decode step) — on the
+            # host-logits path re-deriving it would draw from a fresh
+            # RNG and break the journaled-next-token exactness
+            nt = _restore.get("next_token")
+            req.next_token = None if nt is None else int(nt)
+            # deadlines come from the JOURNAL verbatim: a journaled
+            # None means the original request had no (remaining)
+            # deadline — it must NOT pick up this engine's defaults,
+            # or a restore storm would reap the very streams the
+            # journal exists to save
+            ttl = _restore.get("ttl_remaining_s")
+            req.ttl_s = ttl
+            req.deadline = (None if ttl is None
+                            else req.submitted_at + float(ttl))
+            qt = _restore.get("queue_timeout_remaining_s")
+            req.queue_timeout_s = qt
+            req.queue_deadline = (None if qt is None
+                                  else req.submitted_at + float(qt))
         total = len(req.prompt) + req.max_new_tokens
         # a verify step writes spec_k + 1 positions before rolling back,
         # so the rope table must cover the overhang for EVERY request a
@@ -653,6 +802,132 @@ class ContinuousBatchingEngine:
                 "preempted": len(self._preempted),
             }
 
+    # ------------------------------------------------- snapshot/restore
+    def snapshot(self) -> dict:
+        """Serialize every in-flight request to a JSON-able journal
+        (ISSUE 8 tentpole, consumer 3).  Quiesces first: waits for the
+        in-flight chunk/decode batch to finish so (generated,
+        next_token) is a consistent between-steps cut — the journal's
+        ``next_token`` is the already-transferred host-side sample, so
+        a restore continues each stream token-for-token.  Safe to call
+        while draining (SIGTERM snapshot-then-drain) or on an idle
+        engine (empty journal)."""
+        with self._cond:
+            self._snap_waiters += 1
+            try:
+                while self._stepping and not self._stop:
+                    self._cond.wait(0.1)
+                # under the lock: only shallow snapshots of the mutable
+                # state (generated grows once the loop resumes; prompt
+                # is written once at submit).  The O(total tokens) JSON
+                # conversion below runs with the lock RELEASED so a
+                # deep journal never stalls submission or the loop
+                now = time.perf_counter()
+                # in-flight streams FIRST: restore() resubmits in
+                # journal order, so if the journal saturates the
+                # restoring engine's bounded queues it is never-started
+                # queued work that gets dropped — not the mid-stream
+                # generations the journal exists to save
+                cuts = [(r, r.prompt, list(r.generated), r.next_token)
+                        for r in (list(self._active)
+                                  + list(self._prefilling)
+                                  + list(self._preempted)
+                                  + self._sched.pending())
+                        if not r.done.is_set() and not r.cancelled]
+            finally:
+                self._snap_waiters -= 1
+                self._cond.notify_all()
+        entries = []
+        for r, prompt, generated, next_token in cuts:
+            entries.append({
+                "prompt": [int(t) for t in prompt],
+                "generated": [int(t) for t in generated],
+                "next_token": (None if next_token is None
+                               else int(next_token)),
+                "max_new_tokens": r.max_new_tokens,
+                "eos_token_id": (None if r.eos_token_id is None
+                                 else int(r.eos_token_id)),
+                "do_sample": r.do_sample,
+                "temperature": r.temperature,
+                "seed": r.seed,
+                "priority": r.priority,
+                "tenant": r.tenant,
+                "draft": bool(r.use_draft),
+                "ttl_remaining_s": (
+                    None if r.deadline is None
+                    else max(1e-3, r.deadline - now)),
+                # a request that was ALREADY admitted satisfied its
+                # queue-wait contract — re-imposing the (likely spent)
+                # deadline on the restore queue would reap exactly the
+                # long-running streams the journal exists to save
+                "queue_timeout_remaining_s": (
+                    None if r.queue_deadline is None
+                    or r.admitted_at is not None
+                    else max(1e-3, r.queue_deadline - now)),
+            })
+        _snapshot_reqs.inc(len(entries))
+        return {"version": 1, "requests": entries}
+
+    def restore(self, snapshot: dict, strict: bool = True
+                ) -> List[_Request]:
+        """Resubmit a :meth:`snapshot` journal onto THIS engine.  Each
+        entry flows through normal admission; entries with generated
+        tokens carry them as ``replay_tokens`` so the chunked
+        context-prefill program reconstructs their KV bit-exactly and
+        the journaled next token continues the stream (ISSUE 8).
+        ``strict=False`` skips entries the engine rejects (unknown
+        class, full queue) with a warning instead of raising — the
+        restarted-server path, where one unplaceable request must not
+        abort the whole resume.  Returns the new request handles.
+
+        Exactness caveat: sampled (``do_sample``) rows resume
+        bit-exactly on the default on-device sampler, whose draws are
+        keyed by (seed, absolute position).  On the
+        ``sample_on_device=False`` host-logits escape hatch a sampled
+        row's host RNG stream position is not journaled — its already-
+        generated tokens and journaled next token are exact, but
+        draws after that come from a freshly seeded RNG (greedy rows
+        are exact on both paths)."""
+        import warnings
+        out: List[_Request] = []
+        for e in snapshot.get("requests", ()):
+            try:
+                out.append(self.submit(
+                    np.asarray(e["prompt"], np.int32),
+                    max_new_tokens=int(e.get("max_new_tokens", 32)),
+                    eos_token_id=e.get("eos_token_id"),
+                    do_sample=bool(e.get("do_sample", False)),
+                    temperature=float(e.get("temperature", 1.0)),
+                    seed=int(e.get("seed", 0)),
+                    # deadlines are taken verbatim from the journal by
+                    # the _restore branch (incl. "no deadline"), never
+                    # from this engine's defaults
+                    # None lets the restored engine speculate when IT
+                    # can (a journal from a drafted engine restores
+                    # cleanly onto a draft-free one); False preserves
+                    # an explicit opt-out
+                    draft=None if e.get("draft") else False,
+                    priority=e.get("priority"),
+                    tenant=e.get("tenant", "default"),
+                    _restore=e))
+            except BaseException as exc:  # noqa: BLE001 — per-entry
+                if strict:
+                    raise
+                warnings.warn(
+                    f"snapshot restore skipped one request: {exc!r}")
+        return out
+
+    def stop_admissions(self) -> None:
+        """Synchronously flip the draining flag (``drain()`` sets it
+        again, idempotently).  The server's SIGTERM path calls this
+        BEFORE taking the crash-floor snapshot: ``begin_drain`` only
+        spawns the drain thread, so without this a request admitted in
+        the spawn-to-flag window would be journal-invisible (ISSUE 8)."""
+        with self._cond:
+            self._draining = True
+            _draining_g.set(1)
+            self._cond.notify_all()
+
     def drain(self, timeout: Optional[float] = None,
               reject_queued: bool = False) -> bool:
         """Graceful shutdown: stop accepting NEW submissions, let every
@@ -773,6 +1048,12 @@ class ContinuousBatchingEngine:
             keep: List[_Request] = []
             for r in lst:
                 err = r._lifecycle_error(now, queued=False)
+                if err is None and lst_name == "_preempted":
+                    # resume-TTL (ISSUE 8 satellite): a paused prefill
+                    # may hold its page reservation at most
+                    # preempt_resume_ttl_s — past that it is reaped
+                    # with pages reclaimed, never parked forever
+                    err = self._preempt_expired_error(r, now)
                 if err is None:
                     keep.append(r)
                 else:
@@ -806,6 +1087,44 @@ class ContinuousBatchingEngine:
             _cancelled_total.inc()
         else:
             _expired_total.inc()
+
+    @staticmethod
+    def _pause_age(r, now: Optional[float] = None) -> float:
+        """Total time this request has spent preempted — the CURRENT
+        pause plus every earlier preempt/resume cycle, so thrashing
+        re-preemption can never reset the aging/reap clock."""
+        age = r.paused_total
+        if r.preempted_at is not None:
+            age += (time.perf_counter() if now is None else now) \
+                - r.preempted_at
+        return age
+
+    def _preempt_expired_error(self, r,
+                               now: float) -> Optional[BaseException]:
+        """Caller holds ``self._cond``.  The reap error for a preempted
+        prefill that exhausted its resume TTL, or None while it may
+        still be resumed (or no TTL is configured)."""
+        ttl = self.preempt_resume_ttl_s
+        if ttl is None or self._pause_age(r, now) <= ttl:
+            return None
+        self._sched.note_preempt_expired(r)
+        return DeadlineExceeded(
+            f"preempted prefill spent more than its {ttl:.3f}s resume "
+            "TTL paused without a slot freeing up")
+
+    def _preempt_rank_locked(self, r) -> int:
+        """Caller holds ``self._cond``.  A request's EFFECTIVE rank
+        for preemption decisions: its class rank — or, once it has
+        spent half the resume TTL paused, an aging boost (rank -1)
+        that outranks every queued class, so a slot that frees is
+        forced to the aged request (and, symmetrically, an aged
+        resumed prefill can no longer be picked as a preemption
+        victim) instead of fresh urgent traffic starving it all the
+        way to the reap bound."""
+        ttl = self.preempt_resume_ttl_s
+        if ttl is not None and self._pause_age(r) >= 0.5 * ttl:
+            return -1
+        return self._sched.class_of(r).rank
 
     def _admission_cost_locked(self, req) -> Optional[int]:
         """Caller holds ``self._cond``.  PURE fit check: the pages this
@@ -860,26 +1179,41 @@ class ContinuousBatchingEngine:
 
     def _best_preempted_locked(self) -> Optional[_Request]:
         """Caller holds ``self._cond``.  The paused request that should
-        resume first: most urgent class, then preemption order."""
+        resume first: most urgent EFFECTIVE class (aging boost
+        included), then preemption order."""
         if not self._preempted:
             return None
         return min(self._preempted,
-                   key=lambda r: (self._sched.class_of(r).rank,
+                   key=lambda r: (self._preempt_rank_locked(r),
                                   self._preempted.index(r)))
 
     def _preemption_victim_locked(self, rank: int) -> Optional[_Request]:
         """Caller holds ``self._cond``.  The mid-prefill request to
         pause so a rank-``rank`` request can take its slot: the LEAST
         urgent preemptible prefilling request strictly outranked by the
-        waiter, preferring the least prefill progress (cheapest pause)."""
+        waiter, preferring the least prefill progress (cheapest pause).
+        EFFECTIVE rank, so an aging-boosted resumed prefill is immune
+        to re-preemption — a forced resume must stick."""
         victims = [r for r in self._prefilling
                    if self._sched.class_of(r).preemptible
-                   and self._sched.class_of(r).rank > rank]
+                   and self._preempt_rank_locked(r) > rank]
         if not victims:
             return None
         return max(victims,
                    key=lambda r: (self._sched.class_of(r).rank,
                                   -r.prefill_pos))
+
+    def _resume_locked(self, pre) -> None:
+        """Caller holds ``self._cond``.  Un-pause a preempted prefill:
+        its pause time banks into ``paused_total`` (the aging/reap
+        clock survives the resume) and chunking continues from
+        ``prefill_pos`` — it never re-prefills."""
+        self._preempted.remove(pre)
+        if pre.preempted_at is not None:
+            pre.paused_total += time.perf_counter() - pre.preempted_at
+            pre.preempted_at = None
+        self._prefilling.append(pre)
+        self._sched.note_resumed(pre)
 
     def _admit_locked(self) -> None:
         """Caller holds ``self._cond``.  Fill free slots from (a) paused
@@ -908,16 +1242,15 @@ class ContinuousBatchingEngine:
                         or self._admission_cost_locked(head) is None:
                     break
                 self._prefilling.remove(victim)
+                victim.preempted_at = time.perf_counter()
                 self._preempted.append(victim)
                 self._sched.note_preempted(victim)
                 pending_rank = qrank
                 continue
             if pending_rank is None and pre is not None and (
                     qrank is None
-                    or self._sched.class_of(pre).rank <= qrank):
-                self._preempted.remove(pre)
-                self._prefilling.append(pre)
-                self._sched.note_resumed(pre)
+                    or self._preempt_rank_locked(pre) <= qrank):
+                self._resume_locked(pre)
                 continue
             # a slot bought with a preemption belongs to the rank it
             # was preempted for: a less urgent class's banked DRR
@@ -928,9 +1261,7 @@ class ContinuousBatchingEngine:
             pending_rank = None
             if req is None:
                 if pre is not None:
-                    self._preempted.remove(pre)
-                    self._prefilling.append(pre)
-                    self._sched.note_resumed(pre)
+                    self._resume_locked(pre)
                     continue
                 break
             self._finalize_admission_locked(req)
@@ -960,7 +1291,7 @@ class ContinuousBatchingEngine:
         best_served_rank: Optional[int] = None
         for i in order:
             req = self._prefilling[i]
-            remaining = len(req.prompt) - req.prefill_pos
+            remaining = len(req.prefill_target) - req.prefill_pos
             if remaining <= 0:     # defensive: completion moves it out
                 continue
             if budget is None:
@@ -998,20 +1329,41 @@ class ContinuousBatchingEngine:
             flags[i] = r.do_sample
         return seeds, np.asarray(ctrs, np.int32), temps, flags
 
+    def _ingest(self, decoder, cache, sid, tokens, k: int, n: int,
+                sampling):
+        """ONE bucketed prompt-ingest dispatch — tokens[k:k+n] into
+        ``sid``'s pages, via fresh prefill at k == 0 or the traced
+        context-prefill continuation otherwise.  THE single dispatch
+        choice both the serving prefill path (:meth:`_prefill_chunk`)
+        and the replay primitive (:meth:`_replay_kv`) ride, so the
+        replay's bit-exactness contract can never drift from the path
+        it replays."""
+        ids = tokens[None, k:k + n]
+        if k:
+            return decoder.chunk_prefill(cache, [sid], ids,
+                                         context_tokens=k, bucket=True,
+                                         sampling=sampling)
+        return decoder.prefill(cache, [sid], ids, bucket=True,
+                               sampling=sampling)
+
     def _prefill_chunk(self, req, n: int) -> bool:
-        """Ingest the next ``n`` prompt tokens for ``req`` in ONE
-        compiled dispatch (bucketed: one compile per power-of-two chunk
-        length, not one per distinct length).  Returns True when the
-        prompt is fully resident — only then is the first token sampled
-        (with the SAME (seed, position) counter as a monolithic
-        prefill, so chunked and preempted prefill are greedy- and
-        sample-replay-identical to the unchunked path).
+        """Ingest the next ``n`` tokens of ``req``'s prefill target in
+        ONE compiled dispatch (bucketed: one compile per power-of-two
+        chunk length, not one per distinct length).  The target is the
+        prompt — or, for a restored request, prompt + generated-so-far:
+        the replay primitive's admission-path mode (ISSUE 8) rides the
+        SAME program.  Returns True when the target is fully resident —
+        only then is the next token sampled (with the SAME (seed,
+        absolute position) counter as a monolithic prefill, so chunked,
+        preempted and replayed prefill are greedy- and sample-replay-
+        identical to the unchunked path).
 
         Intermediate chunks run the fused-sampling program in its
         argmax-only tail — the per-chunk host transfer stays (1,) ids
         whose value is discarded."""
+        target = req.prefill_target
         k = req.prefill_pos
-        total = len(req.prompt)
+        total = len(target)
         n = min(n, total - k)
         last = (k + n == total)
         if not self.sample_on_device:
@@ -1019,8 +1371,8 @@ class ContinuousBatchingEngine:
         elif last:
             sampling = self._sampling_for([req], [total])
         else:
-            sampling = (np.zeros(1, np.uint32), np.zeros(1, np.int32),
-                        np.ones(1, np.float32), np.zeros(1, bool))
+            sampling = _null_sampling()
+        self._wedged.clear()      # only THIS dispatch may flag itself
         self._step_started_at = time.monotonic()
         try:
             if req.chunks_done == 0:
@@ -1029,24 +1381,25 @@ class ContinuousBatchingEngine:
                 _faults.maybe_fire("prefill", seq_ids=[req.seq_id])
             _faults.maybe_fire("prefill_chunk", seq_ids=[req.seq_id])
             with monitor.span("engine/prefill", histogram=_prefill_s):
-                ids = req.prompt[None, k:k + n]
-                if k:
-                    out = self._decoder.chunk_prefill(
-                        self.cache, [req.seq_id], ids,
-                        context_tokens=k, bucket=True, sampling=sampling)
-                else:
-                    out = self._decoder.prefill(
-                        self.cache, [req.seq_id], ids,
-                        bucket=True, sampling=sampling)
+                out = self._ingest(self._decoder, self.cache, req.seq_id,
+                                   target, k, n, sampling)
         finally:
             self._step_started_at = None
         _last_step_ts.set(time.time())
+        if self._wedged.is_set():
+            # the watchdog flagged this dispatch as wedged: its writes
+            # are suspect — roll the cache back to the chunk's start so
+            # the caller's rebuild + replay + retry is exact
+            self._wedged.clear()
+            self.cache.truncate(req.seq_id, k)
+            raise _EngineWedged(
+                "prefill chunk exceeded the watchdog heartbeat timeout")
         req.prefill_pos = k + n
         req.chunks_done += 1
         self._sched.note_chunk(req)
         if not last:
             return False
-        # ---- prompt fully resident: finish what monolithic prefill did
+        # ---- target fully resident: finish what monolithic prefill did
         if self.prefix_cache:
             _prefix_lookups.inc()
             if req.prefix_tokens:
@@ -1058,7 +1411,7 @@ class ContinuousBatchingEngine:
             # prompts seed the prefix cache exactly like monolithic ones
             self.cache.register_prefix(req.seq_id, req.prompt)
         if req.use_draft:
-            # the draft ingests the WHOLE prompt (no prefix sharing in
+            # the draft ingests the WHOLE target (no prefix sharing in
             # its pool) so its cache sits at the same length as the
             # target's — the lockstep invariant every propose/verify
             # round preserves.  Deferred to prefill COMPLETION under
@@ -1067,16 +1420,17 @@ class ContinuousBatchingEngine:
             # the transfer at (1,) ids; the value is discarded.
             try:
                 self._draft_decoder.prefill(
-                    self.draft_cache, [req.seq_id], req.prompt[None],
-                    bucket=True,
-                    sampling=(np.zeros(1, np.uint32),
-                              np.zeros(1, np.int32),
-                              np.ones(1, np.float32),
-                              np.zeros(1, bool)))
+                    self.draft_cache, [req.seq_id], target[None],
+                    bucket=True, sampling=_null_sampling())
             except BaseException:  # noqa: BLE001 — degrade, don't fail
                 self._downgrade_draft([req])
-        req.next_token = (int(out[0]) if sampling is not None
-                          else self._pick(req, out[0]))
+        if req.next_token is None:
+            # a restored request keeps its journaled next token (the
+            # replayed final draw equals it by the counter contract);
+            # sampled rows on the host-logits path must ALSO keep it —
+            # re-picking would burn a host RNG draw
+            req.next_token = (int(out[0]) if sampling is not None
+                              else self._pick(req, out[0]))
         req.first_token_at = time.perf_counter()
         ttft = req.first_token_at - req.submitted_at
         _ttft_s.observe(ttft)
@@ -1093,14 +1447,40 @@ class ContinuousBatchingEngine:
         completed: List[_Request] = []
         failed: List[_Request] = []
         for req, n in plan:
-            if req.cancelled:
-                continue               # the next reap retires it
+            if req.cancelled or req.done.is_set():
+                # cancelled: the next reap retires it; done: a replay
+                # failure during an earlier chunk's recovery already
+                # quarantined it
+                continue
             try:
                 if self._prefill_chunk(req, n):
                     completed.append(req)
+            except _EngineWedged as e:
+                # watchdog-flagged wedge mid-prefill: bounded rebuild
+                # (pools reset, every survivor's KV replayed — this
+                # request's earlier chunks included) then ONE retry of
+                # the same chunk; a second failure quarantines as usual
+                self._after_step_failure(e)
+                if req.done.is_set():
+                    # its OWN replay failed during the rebuild: already
+                    # quarantined and retired — retrying would write
+                    # into pages nothing will ever free
+                    continue
+                try:
+                    if self._prefill_chunk(req, n):
+                        completed.append(req)
+                except BaseException as e2:  # noqa: BLE001
+                    req.error = e2
+                    failed.append(req)
+                    self._after_step_failure(e2, exclude=(req,))
             except BaseException as e:  # noqa: BLE001 — quarantine one
                 req.error = e
                 failed.append(req)
+                # a REAL donated-buffer loss in this chunk zeroed every
+                # OTHER sequence's KV too: detect the pool rebuild and
+                # replay the survivors before the next dispatch runs
+                # over zeroed pools (no-op for host-side faults)
+                self._after_step_failure(e, exclude=(req,))
         if not completed and not failed:
             return
         with self._cond:
@@ -1167,6 +1547,172 @@ class ContinuousBatchingEngine:
         from .paged import next_pow2
         return min(next_pow2(n), self.max_batch)
 
+    # ------------------------------------------- crash recovery (ISSUE 8)
+    def _pools_rebuilt(self) -> bool:
+        """True exactly once per pool-rebuild event: compares the
+        caches' ``generation`` counters (bumped by ``reset_pools``
+        after a consumed donated buffer) against the last value the
+        engine reconciled.  Scheduler-thread only."""
+        g = self.cache.generation + (
+            self.draft_cache.generation if self._spec else 0)
+        if g == self._pool_gen:
+            return False
+        self._pool_gen = g
+        return True
+
+    def _replay_kv(self, req) -> None:
+        """THE replay primitive (ISSUE 8 tentpole): reconstruct one
+        sequence's KV state by re-prefilling its token sequence —
+        ``prompt + generated-so-far``, up to the CURRENT logical cache
+        length — through the existing (chunked) context-prefill
+        program, into the pages the sequence already maps (same
+        (page, slot) plan, so shared prefix pages are rewritten with
+        identical content whichever sharer replays first).
+
+        Bit-exact by construction: prompt/generated are host state, the
+        weights are unchanged, and the fused sampler draws by (seed,
+        absolute position) — so the KV a replayed chunk writes is the
+        KV the original prefill/decode wrote.  The pending
+        ``next_token`` is host state too and is NOT resampled; replay
+        outputs are discarded (argmax-only tail).  The draft cache is
+        re-prefilled to its own length so the lockstep invariant
+        survives the rebuild."""
+        sid = req.seq_id
+        upto = self.cache.length(sid)
+        dlen = (self.draft_cache.length(sid)
+                if self._spec and req.use_draft else 0)
+        if upto <= 0 and dlen <= 0:
+            return                     # nothing resident yet
+        sampling = _null_sampling() if self.sample_on_device else None
+        if upto > 0:
+            tokens = req.output_ids[:upto]
+            self.cache.truncate(sid, 0)
+            chunk = self.prefill_chunk_tokens or upto
+            k = 0
+            while k < upto:
+                n = min(chunk, upto - k)
+                # the heartbeat must age during replay dispatches too:
+                # a recovery that wedges on the still-sick device has
+                # to be as visible to the watchdog as the step that
+                # triggered it (the stale flag is cleared at the next
+                # step's start, so a slow replay never condemns it)
+                self._step_started_at = time.monotonic()
+                try:
+                    self._ingest(self._decoder, self.cache, sid, tokens,
+                                 k, n, sampling)
+                finally:
+                    self._step_started_at = None
+                k += n
+            if self.prefix_cache and upto >= len(req.prompt):
+                # re-seed the prefix index the pool rebuild dropped:
+                # the entry's page refcounts come back with it
+                self.cache.register_prefix(sid, req.prompt)
+        if dlen > 0:
+            # the draft pool rides in lockstep — rebuild its KV to its
+            # own pre-loss length from the same host-side tokens
+            self.draft_cache.truncate(sid, 0)
+            self._step_started_at = time.monotonic()
+            try:
+                self._draft_decoder.prefill(
+                    self.draft_cache, [sid], req.output_ids[None, :dlen],
+                    bucket=True, sampling=sampling)
+            finally:
+                self._step_started_at = None
+        _survivor_replays.inc()
+
+    def _replay_survivors(self, exclude=()) -> List[_Request]:
+        """Device-failure recovery (ISSUE 8 consumer 1): replay every
+        live sequence — active, mid-prefill and preempted — to its
+        current logical length after a pool rebuild zeroed the device
+        KV.  ``exclude`` names requests about to be quarantined (their
+        replay would be wasted work).  Scheduler-thread only: the three
+        lists are stable while the loop thread is here.
+
+        A replay that ITSELF fails (the device fault is pinned to that
+        sequence) marks the request with the error and returns it for
+        quarantine — one unreconstructible row must never fail the
+        engine; if the failed replay consumed the pools again, the
+        whole pass restarts so earlier survivors are re-replayed over
+        the fresh pools (bounded: every restart removes a row)."""
+        skip = {id(r) for r in exclude}
+        failed: List[_Request] = []
+        while True:
+            restart = False
+            for r in self._active + self._prefilling + self._preempted:
+                # r.error covers rows an EARLIER recovery in this same
+                # step already condemned (their done event is only set
+                # at step end) — never re-replay a quarantined row
+                if id(r) in skip or r.seq_id is None \
+                        or r.done.is_set() or r.error is not None:
+                    continue
+                try:
+                    self._replay_kv(r)
+                except BaseException as e:  # noqa: BLE001 — per-row
+                    r.error = e
+                    skip.add(id(r))
+                    failed.append(r)
+                    if self._pools_rebuilt():
+                        restart = True
+                        break
+            if not restart:
+                break
+        return failed
+
+    def _after_step_failure(self, error=None, exclude=(),
+                            in_step: bool = False) -> List[_Request]:
+        """Recovery hook run after ANY failed (or wedged) step/chunk
+        was rolled back: a wedge rebuilds the pools outright
+        (consumer 2 — the watchdog-driven restart); then, if the pools
+        were rebuilt by anyone (here, or the decoder after a REAL
+        donated-buffer loss), every survivor's KV is replayed before
+        the caller retries — so a retry/bisect never decodes over
+        zeroed pages and quarantine stays per-request for device-side
+        failures too.
+
+        Requests whose own replay failed are quarantined: with
+        ``in_step`` the ones in the active batch are RETURNED (the
+        step caller must drop them from its retry and treat them as
+        poisoned — they carry an un-executed token to pop); everything
+        else is retired here."""
+        if isinstance(error, _EngineWedged):
+            self.cache.reset_pools()
+            if self._spec:
+                self.draft_cache.reset_pools()
+        if not self._pools_rebuilt():
+            return []
+        _rebuilds_total.inc()
+        with monitor.span("engine/recovery", histogram=_recovery_s):
+            failed = self._replay_survivors(exclude=exclude)
+        if not failed:
+            return []
+        caller_owned = ([r for r in failed if r in self._active]
+                        if in_step else [])
+        eject = [r for r in failed if r not in caller_owned]
+        if eject:
+            with self._cond:
+                for r in eject:
+                    for lst_name in ("_active", "_prefilling",
+                                     "_preempted"):
+                        lst = getattr(self, lst_name)
+                        if r in lst:
+                            lst.remove(r)
+                    self._retire_locked(r)
+                self._cond.notify_all()
+            for r in eject:
+                _quarantined.inc()
+                r.done.set()
+        return caller_owned
+
+    def _check_wedged(self) -> None:
+        """Consume the watchdog's wedge flag: raised as a step failure
+        so the retry/bisect ladder (plus ``_after_step_failure``'s
+        rebuild) handles it like any other suspect step."""
+        if self._wedged.is_set():
+            self._wedged.clear()
+            raise _EngineWedged(
+                "decode step exceeded the watchdog heartbeat timeout; "
+                "treating its results as suspect")
+
     # ------------------------------------------------- decode + isolation
     def _spec_sampling_for(self, reqs, n: int):
         """(seeds, temps, flags) arrays for the verify program's fused
@@ -1197,9 +1743,15 @@ class ContinuousBatchingEngine:
         npad = B - len(reqs)
         drafts = np.full((len(reqs), k), -1, np.int32)  # -1 never matches
         d_idx = [i for i, r in enumerate(reqs) if r.use_draft]
+        # a flag raised against an EARLIER dispatch (one that errored
+        # before its own _check_wedged, or a slow replay) must not
+        # condemn this fresh step to a needless rebuild
+        self._wedged.clear()
         self._step_started_at = time.monotonic()
         try:
             _faults.maybe_fire("decode_step",
+                               seq_ids=[r.seq_id for r in reqs])
+            _faults.maybe_fire("engine_wedge",
                                seq_ids=[r.seq_id for r in reqs])
             with monitor.span("engine/decode_step",
                               histogram=_decode_step_s):
@@ -1246,6 +1798,7 @@ class ContinuousBatchingEngine:
                             if self.sample_on_device else None)
                 out, accept = self._decoder.verify(
                     self.cache, seq_ids, block, pos, sampling=sampling)
+                self._check_wedged()
         finally:
             self._step_started_at = None
         _last_step_ts.set(time.time())
@@ -1313,14 +1866,19 @@ class ContinuousBatchingEngine:
         # ONE compiled program per step attempt for the whole subset
         # (per-row positions, pools donated through the step); with
         # on-device sampling the result is (B,) token ids — the only
-        # per-step device->host transfer
+        # per-step device->host transfer.  A wedge flag raised against
+        # an earlier dispatch is stale here — drop it
+        self._wedged.clear()
         self._step_started_at = time.monotonic()
         try:
             _faults.maybe_fire("decode_step", seq_ids=seq_ids[:len(reqs)])
+            _faults.maybe_fire("engine_wedge",
+                               seq_ids=seq_ids[:len(reqs)])
             with monitor.span("engine/decode_step",
                               histogram=_decode_step_s):
                 out_np = self._decoder.step(self.cache, seq_ids, tokens,
                                             pos, sampling=sampling)
+                self._check_wedged()
         finally:
             self._step_started_at = None
         _last_step_ts.set(time.time())
@@ -1349,12 +1907,44 @@ class ContinuousBatchingEngine:
             return reqs, self._exec_step(reqs), []
         except BaseException as e:  # noqa: BLE001 — classified below
             self._rollback_step(reqs, lens_before)
+            # ISSUE 8: a REAL donated-buffer loss (or a watchdog-
+            # flagged wedge) zeroed every sequence's KV — replay the
+            # survivors so the retry below replays the step EXACTLY
+            # instead of decoding over zeroed pages.  A row whose OWN
+            # replay failed is dropped from the retry and quarantined.
+            live, poisoned = self._split_replay_dead(
+                reqs, self._after_step_failure(e, in_step=True))
             _decode_retries.inc()
+            if not live:
+                return [], [], poisoned
             try:
-                return reqs, self._exec_step(reqs), []
+                return live, self._exec_step(live), poisoned
             except BaseException as e2:  # noqa: BLE001
-                self._rollback_step(reqs, lens_before)
-                return self._bisect_step(reqs, lens_before, e2)
+                self._rollback_step(live, lens_before)
+                live, dead2 = self._split_replay_dead(
+                    live, self._after_step_failure(e2, in_step=True))
+                poisoned += dead2
+                if not live:
+                    return [], [], poisoned
+                s, o, p = self._bisect_step(live, lens_before, e2)
+                return s, o, p + poisoned
+
+    @staticmethod
+    def _split_replay_dead(reqs, dead):
+        """(live, quarantined) partition of ``reqs`` around the
+        replay-failure set ``dead`` — each dead row counts as a
+        quarantine (its error was set by the failed replay)."""
+        if not dead:
+            return list(reqs), []
+        dead_ids = {id(r) for r in dead}
+        live, out = [], []
+        for r in reqs:
+            if id(r) in dead_ids:
+                _quarantined.inc()
+                out.append(r)
+            else:
+                live.append(r)
+        return live, out
 
     def _bisect_step(self, reqs, lens_before, error):
         """Deterministic fault isolation: halve the failing batch and
@@ -1370,15 +1960,28 @@ class ContinuousBatchingEngine:
         mid = (len(reqs) + 1) // 2
         survivors, rows, poisoned = [], [], []
         for half in (reqs[:mid], reqs[mid:]):
+            # a row whose KV replay failed during a SIBLING subset's
+            # recovery carries its error already — never step it again
+            # (the _decode_step sweep retires it)
+            half = [r for r in half if r.error is None]
+            if not half:
+                continue
             try:
                 _decode_retries.inc()
                 half_rows = self._exec_step(half)
             except BaseException as e:  # noqa: BLE001
                 self._rollback_step(half, lens_before)
-                s, o, p = self._bisect_step(half, lens_before, e)
-                survivors.extend(s)
-                rows.extend(o)
-                poisoned.extend(p)
+                # a device-side failure in THIS half also zeroed the
+                # other half's (possibly already-advanced) KV: replay
+                # everyone to their current lengths before probing on
+                live, dead = self._split_replay_dead(
+                    half, self._after_step_failure(e, in_step=True))
+                poisoned.extend(dead)
+                if live:
+                    s, o, p = self._bisect_step(live, lens_before, e)
+                    survivors.extend(s)
+                    rows.extend(o)
+                    poisoned.extend(p)
             else:
                 survivors.extend(half)
                 rows.extend(half_rows)
@@ -1405,6 +2008,32 @@ class ContinuousBatchingEngine:
         _sampling_on_device_g.set(int(self.sample_on_device))
         on_device = self.sample_on_device
         survivors, rows, poisoned = self._step_isolated(active, lens_before)
+        # ISSUE 8 replay-failure sweep: a row whose KV replay failed
+        # during recovery carries its error.  The failing subset's own
+        # dead rows are already in `poisoned`; one that died OUTSIDE
+        # that scope — its bisect half had already succeeded, or was
+        # still pending — must be ejected HERE, never left decoding
+        # over a half-reconstructed cache.  Executed-token rows retire
+        # without the pop; un-stepped rows join the poisoned path.
+        dead_done: List[_Request] = []
+        if any(r.error is not None for r in survivors):
+            pairs = list(zip(survivors, rows))
+            survivors, rows = [], []
+            for r, row in pairs:
+                if r.error is not None:
+                    _quarantined.inc()
+                    dead_done.append(r)
+                else:
+                    survivors.append(r)
+                    rows.append(row)
+        accounted = ({id(r) for r in survivors}
+                     | {id(r) for r in poisoned}
+                     | {id(r) for r in dead_done})
+        for r in active:
+            if id(r) not in accounted and not r.done.is_set() \
+                    and r.error is not None:
+                _quarantined.inc()
+                poisoned.append(r)
         _tokens_total.inc(len(survivors))
 
         # request-local state (r.*) is scheduler-thread-owned: decide
@@ -1453,6 +2082,8 @@ class ContinuousBatchingEngine:
                 self._retire_locked(r)
             for r in poisoned:
                 self._retire_locked(r)
+            for r in dead_done:
+                self._retire_locked(r)
             self._active = still
             if not still:
                 # idle: the scratch page goes back too, so a drained
@@ -1465,6 +2096,8 @@ class ContinuousBatchingEngine:
         for r in retired:
             r.done.set()
         for r in poisoned:
+            r.done.set()
+        for r in dead_done:
             r.done.set()
 
     def _fail_all(self, exc):
@@ -1526,6 +2159,13 @@ class ContinuousBatchingEngine:
                     reaped = self._reap_locked()
                     self._admit_locked()
                     plan = self._plan_chunks_locked()
+                    # snapshot barrier (ISSUE 8): a waiting snapshot()
+                    # reads its consistent between-steps cut before the
+                    # next device batch opens (the wait releases the
+                    # lock; nothing below mutates what was planned)
+                    while self._snap_waiters and not self._stop:
+                        self._cond.wait(0.1)
+                    self._stepping = bool(plan) or bool(self._active)
             except BaseException as e:  # noqa: BLE001 — scheduler fault
                 # a bug in admission/reaping must fail the in-flight
                 # requests LOUDLY, never kill this thread silently and
@@ -1545,3 +2185,8 @@ class ContinuousBatchingEngine:
                     self._decode_step()
             except BaseException as e:  # noqa: BLE001 — fail loudly, not hang
                 self._fail_all(e)
+            finally:
+                if self._stepping:
+                    with self._cond:
+                        self._stepping = False
+                        self._cond.notify_all()

@@ -28,6 +28,29 @@ from ..framework.tape import no_grad
 from ..ops.pallas.flash_attention import DEFAULT_MASK_VALUE
 from ..ops.pallas.paged_attention import (PagedKVCache, paged_attention,
                                           paged_attention_multi)
+from ..testing import faults as _faults
+
+
+def _maybe_lose_buffers(cache: PagedKVCache, seq_ids) -> None:
+    """The ``buffer_loss`` device-fault site (ISSUE 8): when a rule
+    fires here, DELETE the cache's pool buffers before re-raising, so
+    the caller's ``_recover_pools`` sees consumed donated buffers and
+    rebuilds the pools zeroed — the exact failure mode of a real
+    device-side step fault, reproducible on CPU CI.  No plan installed
+    = one ``is None`` check."""
+    if _faults.active() is None:
+        return
+    try:
+        _faults.maybe_fire("buffer_loss", seq_ids=seq_ids)
+    except BaseException:
+        for a in list(cache.k_pages) + list(cache.v_pages):
+            fn = getattr(a, "delete", None)
+            if callable(fn):
+                try:
+                    fn()
+                except Exception:   # noqa: BLE001 — already unusable
+                    pass
+        raise
 
 
 def fused_sample(logits, seeds, ctrs, temps, flags):
@@ -501,6 +524,7 @@ class JittedPagedDecoder:
         last_idx = np.full(b, s - 1, np.int32)
         sample, s_args = self._sampling_args(sampling)
         try:
+            _maybe_lose_buffers(cache, seq_ids)
             out, k_pages, v_pages = self._program("prefill", sample)(
                 [p._data for p in self.params],
                 jnp.asarray(ids_np.astype(np.int32)),
@@ -588,6 +612,7 @@ class JittedPagedDecoder:
         last_idx = np.full(b, s - 1, np.int32)
         sample, s_args = self._sampling_args(sampling)
         try:
+            _maybe_lose_buffers(cache, seq_ids)
             out, k_pages, v_pages = self._program("prefix", sample)(
                 [p._data for p in self.params],
                 jnp.asarray(ids_np.astype(np.int32)),
@@ -657,6 +682,7 @@ class JittedPagedDecoder:
                                    self.min_table_pages))
         sample, s_args = self._verify_sampling_args(sampling)
         try:
+            _maybe_lose_buffers(cache, seq_ids)
             out, accept, k_pages, v_pages = self._program(
                 "verify", sample)(
                 [p._data for p in self.params],
@@ -747,6 +773,7 @@ class JittedPagedDecoder:
             seq_ids, max_pages=max(next_pow2(needed),
                                    self.min_table_pages))
         try:
+            _maybe_lose_buffers(cache, seq_ids)
             toks, k_pages, v_pages = self._jitted_multi(
                 [p._data for p in self.params],
                 jnp.asarray(tokens_np.astype(np.int32)),
@@ -796,6 +823,7 @@ class JittedPagedDecoder:
                                    self.min_table_pages))
         sample, s_args = self._sampling_args(sampling)
         try:
+            _maybe_lose_buffers(cache, seq_ids)
             out, k_pages, v_pages = self._program("decode", sample)(
                 [p._data for p in self.params],
                 jnp.asarray(tokens_np), jnp.asarray(positions_np),

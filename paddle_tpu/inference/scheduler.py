@@ -114,6 +114,11 @@ _deferrals_total = monitor.counter(
     "sched_chunk_deferrals_total", "prefill chunks deferred because a "
     "step's chunk budget went to more urgent classes, per class",
     ("cls",))
+_preempt_expired_total = monitor.counter(
+    "sched_preempt_expired_total", "preempted prefills reaped because "
+    "they held their page reservation past the resume TTL without a "
+    "slot freeing up (ISSUE 8: the reservation bound), per class",
+    ("cls",))
 
 
 class QueueFull(RuntimeError):
@@ -285,6 +290,17 @@ class WorkloadScheduler:
                     return tq.queue[0]
         return None
 
+    def pending(self) -> List:
+        """Every queued request WITHOUT popping, most urgent class
+        first (FIFO within each tenant queue) — the engine's
+        ``snapshot()`` serializes these alongside the in-flight lists
+        (ISSUE 8)."""
+        out: List = []
+        for cs in self._by_rank:
+            for tq in cs.tenants.values():
+                out.extend(tq.queue)
+        return out
+
     def pop_all(self) -> List:
         """Remove and return every queued request (drain-reject /
         fail-all paths)."""
@@ -431,3 +447,6 @@ class WorkloadScheduler:
 
     def note_chunk_deferred(self, req) -> None:
         _deferrals_total.inc(cls=req.priority)
+
+    def note_preempt_expired(self, req) -> None:
+        _preempt_expired_total.inc(cls=req.priority)

@@ -18,8 +18,10 @@ the process-wide metrics registry (``requests_total`` counter,
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
+import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -273,6 +275,18 @@ class GenerationServer(_ServerLifecycle):
     for recompile-free mixed-length serving.  429 responses carry a
     class-aware ``Retry-After``; ``/health`` reports per-class queue
     depths and the active policy knobs under ``"scheduler"``.
+
+    Crash consistency (ISSUE 8): with ``snapshot_path`` set, SIGTERM
+    (via ``attach_preemption``) first journals every in-flight request
+    — ``engine.snapshot()`` written atomically to the path — and THEN
+    begins the graceful drain; a restarted server finding the journal
+    consumes it (renamed to ``<path>.restored`` so a crash loop cannot
+    double-resume) and resubmits each request through the engine's
+    replay primitive, so mid-stream generations continue bit-exactly
+    in the new process.  ``save_snapshot()`` is also callable directly
+    (an operator checkpoint before risky maintenance).  ``/health``
+    reports ``snapshot_path`` and the restored-request count when the
+    knob is set.
     """
 
     def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
@@ -286,7 +300,9 @@ class GenerationServer(_ServerLifecycle):
                  draft_total_pages: Optional[int] = None,
                  prefill_chunk_tokens: Optional[int] = None,
                  scheduler_classes=None,
-                 min_table_pages: int = 1):
+                 min_table_pages: int = 1,
+                 snapshot_path: Optional[str] = None,
+                 preempt_resume_ttl_s: Optional[float] = None):
         from .continuous import (ContinuousBatchingEngine,
                                  DeadlineExceeded, EngineDraining,
                                  EngineSaturated)
@@ -301,10 +317,14 @@ class GenerationServer(_ServerLifecycle):
             draft_total_pages=draft_total_pages,
             prefill_chunk_tokens=prefill_chunk_tokens,
             scheduler_classes=scheduler_classes,
-            min_table_pages=min_table_pages)
+            min_table_pages=min_table_pages,
+            preempt_resume_ttl_s=preempt_resume_ttl_s)
         self._count_lock = threading.Lock()
         self._request_count = 0
         self._drain_thread: Optional[threading.Thread] = None
+        self._drain_result: Optional[bool] = None
+        self._snapshot_path = snapshot_path
+        self._restored_requests = 0
         self._init_stats(access_log)
         outer = self
 
@@ -338,6 +358,11 @@ class GenerationServer(_ServerLifecycle):
                             # live replica
                             "scheduler": outer._engine.scheduler_info(),
                             "speculative": outer._engine._spec}
+                        if outer._snapshot_path:
+                            payload.update({
+                                "snapshot_path": outer._snapshot_path,
+                                "restored_requests":
+                                    outer._restored_requests})
                         if outer._engine._spec:
                             dc = outer._engine.draft_cache
                             # capacity accounting must include the
@@ -434,6 +459,51 @@ class GenerationServer(_ServerLifecycle):
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        # crash consistency (ISSUE 8): consume a predecessor's journal
+        # AFTER the listener socket bound (a bind failure — e.g. the
+        # predecessor still releasing the port — must not have eaten
+        # the journal) but before serve_forever starts: restored
+        # requests are decoding by the time the first request arrives
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._restored_requests = self._restore_snapshot(snapshot_path)
+
+    # ----------------------------------------------- snapshot (ISSUE 8)
+    def _restore_snapshot(self, path: str) -> int:
+        """Consume a predecessor's journal: rename first (a crash
+        mid-restore must not double-resume), then resubmit every entry
+        through the engine's replay primitive — per-entry failures are
+        warned about, never fatal (strict=False)."""
+        consumed = path + ".restored"
+        try:
+            os.replace(path, consumed)
+            with open(consumed) as f:
+                snap = json.load(f)
+        except (OSError, ValueError) as e:
+            warnings.warn(f"snapshot restore skipped: {e!r}")
+            return 0
+        try:
+            return len(self._engine.restore(snap, strict=False))
+        except Exception as e:  # noqa: BLE001 — a malformed journal
+            # (valid JSON, wrong shape) must degrade to an empty
+            # resume, never keep the server from starting
+            warnings.warn(f"snapshot restore failed: {e!r}")
+            return 0
+
+    def save_snapshot(self, path: Optional[str] = None) -> int:
+        """Journal every in-flight request to ``path`` (default: the
+        configured ``snapshot_path``) atomically; returns the request
+        count.  The engine quiesces at a step boundary first, so the
+        journal is a consistent cut a restarted process resumes
+        bit-exactly."""
+        path = path or self._snapshot_path
+        if not path:
+            raise ValueError("no snapshot_path configured")
+        snap = self._engine.snapshot()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, path)
+        return len(snap["requests"])
 
     # ------------------------------------------------- graceful shutdown
     @property
@@ -452,10 +522,14 @@ class GenerationServer(_ServerLifecycle):
         instead of being completed first."""
         if self._drain_thread is not None and self._drain_thread.is_alive():
             return
+        self._drain_result = None
+
+        def _drain():
+            self._drain_result = self._engine.drain(
+                timeout=timeout, reject_queued=reject_queued)
+
         self._drain_thread = threading.Thread(
-            target=self._engine.drain,
-            kwargs={"timeout": timeout, "reject_queued": reject_queued},
-            name="server-drain", daemon=True)
+            target=_drain, name="server-drain", daemon=True)
         self._drain_thread.start()
 
     def wait_drained(self, timeout: Optional[float] = None) -> bool:
@@ -475,9 +549,47 @@ class GenerationServer(_ServerLifecycle):
         """Wire a distributed.fault_tolerance.PreemptionHandler: on
         SIGTERM (the TPU pod preemption notice) the server begins a
         graceful drain — the resilience contract's 'finish what you
-        admitted, reject what you have not' shutdown."""
+        admitted, reject what you have not' shutdown.  With
+        ``snapshot_path`` configured the drain is bracketed by
+        snapshots (ISSUE 8): one taken IMMEDIATELY (the crash floor —
+        if the grace period ends mid-drain, everything in flight is
+        journaled) and one refreshed when the drain settles, so
+        requests the drain DID finish are dropped from the journal and
+        never re-executed by the relaunched process; whatever the
+        drain window was too short to finish resumes exactly."""
         def drain_on_preemption():
+            # stop admissions SYNCHRONOUSLY first: begin_drain only
+            # spawns the drain thread, and a request admitted before
+            # that thread flips the flag would be journal-invisible
+            # and lost if the grace period ends mid-drain
+            self._engine.stop_admissions()
             self.begin_drain(timeout=drain_timeout)
+            if self._snapshot_path:
+                try:
+                    self.save_snapshot()
+                except Exception as e:   # noqa: BLE001 — the drain
+                    # must still happen even if the journal write fails
+                    warnings.warn(f"pre-drain snapshot failed: {e!r}")
+                def _refresh():
+                    # shrink the journal ONLY after a drain that
+                    # actually COMPLETED its requests — a timed-out
+                    # drain or a hard stop() (which ERRORS the
+                    # remainder) must keep the crash-floor journal, or
+                    # the relaunch would resume nothing.  The wait is
+                    # unbounded: the drain thread itself terminates at
+                    # ITS deadline, and racing it with the same
+                    # timeout would skip the refresh for a drain that
+                    # finished right at the wire
+                    if self.wait_drained(None) and self._drain_result:
+                        try:
+                            self.save_snapshot()
+                        except Exception as e:  # noqa: BLE001 — keep
+                            # the crash-floor journal rather than none
+                            warnings.warn(
+                                f"post-drain snapshot refresh failed: "
+                                f"{e!r}")
+                threading.Thread(target=_refresh, daemon=True,
+                                 name="snapshot-refresh").start()
         handler.on_preemption(drain_on_preemption)
 
     def stop(self):

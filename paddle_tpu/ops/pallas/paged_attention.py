@@ -349,6 +349,11 @@ class PagedKVCache:
         self._prefix_index: "OrderedDict[bytes, _PrefixEntry]" = \
             OrderedDict()
         self.prefix_evictions = 0           # entries dropped under pressure
+        # crash consistency (ISSUE 8): bumped every time reset_pools
+        # rebuilds the device pools zeroed — the engine compares it
+        # across a failed step to tell a host-side fault (KV intact)
+        # from a REAL donated-buffer loss (survivors need replay)
+        self.generation = 0
 
     # ------------------------------------------------------- bookkeeping
     def _decref_seq(self, page: int) -> bool:
@@ -444,7 +449,9 @@ class PagedKVCache:
         recovery after a failed donated-buffer step invalidated the old
         pools: bookkeeping survives, cached K/V content does not — so
         the prefix index (whose hits would replay that lost content)
-        is dropped wholesale."""
+        is dropped wholesale.  ``generation`` is bumped so the engine
+        can see the loss and replay every survivor's KV (ISSUE 8)."""
+        self.generation += 1
         shape = (self.kv_heads, self.total_pages, self.page_size,
                  self.head_dim)
         dtype = self.k_pages[0].dtype if self.k_pages else jnp.float32

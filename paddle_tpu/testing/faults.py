@@ -22,6 +22,18 @@ Sites (the names the runtime fires):
                     keeps failing until the sequence is quarantined)
   ``page_alloc``    once per page taken from the pool free list
   ``http_handler``  once per POST /generate before engine submission
+  ``buffer_loss``   device-fault site (ISSUE 8): fired inside every
+                    compiled paged-decoder call; when it fires the
+                    decoder DELETES the donated page-pool buffers
+                    before the error propagates, so ``_recover_pools``
+                    rebuilds them zeroed exactly as a real device-side
+                    step failure would — the engine must then replay
+                    every survivor's KV
+  ``engine_wedge``  device-fault site (ISSUE 8): fired inside the
+                    engine's decode-step window; a ``delay`` rule here
+                    emulates a wedged compiled call long enough for
+                    the watchdog heartbeat to fire and trigger the
+                    bounded rebuild + survivor-replay restart path
 
 Rule dict fields (JSON-friendly — ``tools/serve_bench.py
 --fault-plan`` takes exactly this as a JSON document):
@@ -57,7 +69,7 @@ __all__ = [
 ]
 
 SITES = ("prefill", "prefill_chunk", "decode_step", "page_alloc",
-         "http_handler")
+         "http_handler", "buffer_loss", "engine_wedge")
 
 
 class FaultError(Exception):

@@ -186,6 +186,25 @@ class TestServeBench:
         assert out["tokens_per_sec"] > 0
         assert out["fault_plan"] is not None
 
+    def test_recovery_lane_emits_mttr(self, capsys):
+        # ISSUE 8: a buffer_loss rule makes the chaos lane a RECOVERY
+        # lane — the gate additionally requires survivor replay +
+        # rebuild counts and an engine_recovery_seconds (MTTR) sample,
+        # with zero failed requests (a transient loss costs nobody)
+        sb = self._load()
+        plan = json.dumps({"rules": [{"site": "buffer_loss",
+                                      "nth": 12}]})
+        assert sb.main(["--sharers=4", "--uniques=2",
+                        f"--fault-plan={plan}"]) == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert out["survivor_replays"] >= 1
+        assert out["engine_rebuilds"] >= 1
+        assert out["recovery_events"] >= 1
+        assert out["mttr_p50_s"] is not None
+        assert out["failed_requests"] == 0
+        assert out["tokens_per_sec"] > 0
+
 
 class TestTrainBench:
     """ISSUE 5 CI satellite: the training hot-path lane must run a tiny

@@ -1,13 +1,20 @@
-"""Resilience-counter smoke gate (ISSUE 4 CI satellite).
+"""Resilience-counter smoke gate (ISSUE 4 CI satellite; ISSUE 8
+crash-consistency scenarios).
 
 Runs a tiny chaos scenario end to end — a fault plan injecting one
 prefill exception and one sticky decode-step poison into a mixed
 engine workload, one failing preemption callback, and a graceful
 drain — then asserts every resilience series the README documents
 actually exists in ``monitor.snapshot()`` with the values the scenario
-implies, and that the pool drained to fully reclaimed.  Exit 0 =
-healthy, 1 = broken; tests/test_tools.py runs main() in the tier-1
-lane, `python tools/chaos_smoke.py` is the standalone CI lane.
+implies, and that the pool drained to fully reclaimed.  The ISSUE 8
+lanes add (a) a REAL donated-buffer loss mid-decode on a 4-row batch —
+every survivor must complete bit-identically to a fault-free run with
+``survivor_replays_total``/``engine_rebuilds_total`` counted and an
+``engine_recovery_seconds`` MTTR sample — and (b) a snapshot→restore
+round trip across a fresh engine resuming mid-stream requests
+bit-exactly.  Exit 0 = healthy, 1 = broken; tests/test_tools.py runs
+main() in the tier-1 lane, `python tools/chaos_smoke.py` is the
+standalone CI lane.
 """
 from __future__ import annotations
 
@@ -27,6 +34,11 @@ REQUIRED_SERIES = (
     "engine_last_step_timestamp_seconds",
     "engine_draining",
     "preemption_callback_errors_total",
+    # crash consistency (ISSUE 8)
+    "survivor_replays_total",
+    "engine_rebuilds_total",
+    "engine_recovery_seconds",
+    "snapshot_requests_total",
 )
 
 #: scheduler series (ISSUE 7, README "Scheduling & multi-tenancy") —
@@ -47,7 +59,8 @@ def _value(snap: dict, name: str):
     m = snap.get(name)
     if not m or not m["series"]:
         return None
-    return m["series"][0]["value"]
+    s = m["series"][0]
+    return s.get("value", s.get("count"))   # counter/gauge, histogram
 
 
 def _series_total(snap: dict, name: str):
@@ -142,6 +155,62 @@ def run_chaos() -> dict:
                             and rb.finished_at is not None
                             and ri.finished_at < rb.finished_at)
 
+    # crash consistency (ISSUE 8a): a REAL donated-buffer loss
+    # mid-decode on a full 4-row batch — the pools rebuild zeroed,
+    # every survivor's KV replays, and all four outputs must be
+    # bit-identical to a fault-free run of the same prompts
+    loss_prompts = [rng.integers(0, 64, (5,)) for _ in range(4)]
+    with ContinuousBatchingEngine(model, total_pages=64, page_size=8,
+                                  max_batch=4) as eng:
+        loss_refs = [eng.submit(p, max_new_tokens=6).result(timeout=600)
+                     for p in loss_prompts]
+    plan_loss = faults.FaultPlan([{"site": "buffer_loss", "nth": 8}])
+    with faults.installed(plan_loss):
+        with ContinuousBatchingEngine(model, total_pages=64, page_size=8,
+                                      max_batch=4) as eng:
+            reqs4 = [eng.submit(p, max_new_tokens=6)
+                     for p in loss_prompts]
+            got = [r.result(timeout=600) for r in reqs4]
+    buffer_loss_exact = all(
+        np.array_equal(g, e) for g, e in zip(got, loss_refs))
+    buffer_loss_fired = any(s["fires"] for s in plan_loss.snapshot())
+
+    # crash consistency (ISSUE 8b): snapshot mid-stream, restore onto
+    # a FRESH engine, outputs bit-identical to an uninterrupted run
+    snap_prompts = [rng.integers(0, 64, (5,)) for _ in range(2)]
+    with ContinuousBatchingEngine(model, total_pages=64, page_size=8,
+                                  max_batch=4) as eng:
+        snap_refs = [eng.submit(p, max_new_tokens=8).result(timeout=600)
+                     for p in snap_prompts]
+    engA = ContinuousBatchingEngine(model, total_pages=64, page_size=8,
+                                    max_batch=4)
+    try:
+        # slow the decode so the 5ms poll below cannot miss the
+        # mid-stream window on a fast machine (the journal itself is
+        # timing-free); installed() + try/finally keep the plan and
+        # the engine thread from leaking into later lanes on failure
+        with faults.installed(faults.FaultPlan(
+                [{"site": "decode_step", "kind": "delay",
+                  "delay_s": 0.01}])):
+            live = [engA.submit(p, max_new_tokens=8)
+                    for p in snap_prompts]
+            t0 = _time.monotonic()
+            while _time.monotonic() - t0 < 120 and not all(
+                    len(r.generated) >= 2 for r in live):
+                _time.sleep(0.005)
+            journal = engA.snapshot()
+    finally:
+        engA.stop()                   # the "crashed" process
+    with ContinuousBatchingEngine(model, total_pages=64, page_size=8,
+                                  max_batch=4) as engB:
+        resumed = engB.restore(journal)
+        got = [r.result(timeout=600) for r in resumed]
+    restore_exact = (len(journal["requests"]) == 2
+                     and all(len(e["generated"]) >= 2
+                             for e in journal["requests"])
+                     and all(np.array_equal(g, e)
+                             for g, e in zip(got, snap_refs)))
+
     # a failing preemption callback must be counted, not swallowed
     handler = PreemptionHandler(signals=())
 
@@ -161,6 +230,9 @@ def run_chaos() -> dict:
     out["_pool_clean"] = pool_clean
     out["_drained"] = drained
     out["_preempted_ok"] = preempted_ok
+    out["_buffer_loss_fired"] = buffer_loss_fired
+    out["_buffer_loss_exact"] = buffer_loss_exact
+    out["_restore_exact"] = restore_exact
     return out
 
 
@@ -195,6 +267,19 @@ def main() -> int:
          out["preemption_callback_errors_total"] >= 1),
         ("engine heartbeat advanced",
          out["engine_last_step_timestamp_seconds"] > 0),
+        ("buffer_loss fault actually fired", out["_buffer_loss_fired"]),
+        ("survivors bit-identical after donated-buffer loss",
+         out["_buffer_loss_exact"]),
+        ("survivor_replays_total counted the replays",
+         out["survivor_replays_total"] >= 4),
+        ("engine_rebuilds_total counted the pool rebuild",
+         out["engine_rebuilds_total"] >= 1),
+        ("engine_recovery_seconds observed an MTTR sample",
+         out["engine_recovery_seconds"] >= 1),
+        ("snapshot->restore resumed mid-stream requests bit-exactly",
+         out["_restore_exact"]),
+        ("snapshot_requests_total counted the journal entries",
+         out["snapshot_requests_total"] >= 2),
     ]
     bad = [name for name, ok in checks if not ok]
     if bad:

@@ -24,6 +24,14 @@ numbers are monitor.snapshot() deltas (``spec_*`` counters + the
 ``spec_accept_len`` histogram) and the measured window still gates
 ``jit_recompiles == 0``.
 
+Recovery lane (ISSUE 8): a ``--fault-plan`` containing ``buffer_loss``
+or ``engine_wedge`` rules exercises crash-consistent recovery — the
+JSON line carries ``survivor_replays`` / ``engine_rebuilds`` and the
+MTTR (``engine_recovery_seconds`` p50/mean), and the gate requires the
+recovery machinery to have engaged with every survivor completing
+(failed requests within the injected-error budget; recompiles inside
+the declared rebuild window are exempt from the steady-state gate).
+
 Scenario-matrix lane (ISSUE 7): ``--scenario-matrix`` serves the
 three-way mixed workload — chat (short, latency-bound, interactive
 class), RAG (long shared-prefix prompt, standard class) and
@@ -38,6 +46,7 @@ program audited transfer-free, and batch-class preemption exercised.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -128,6 +137,34 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
     # bucket/shape leak the program auditor should be pointed at
     monitor.install_compile_hooks()
 
+    # a plan with engine_wedge rules needs the watchdog ARMED: the
+    # wedge path only exists through the step_timeout_s heartbeat (use
+    # delay_s comfortably above the 0.25s threshold in such plans)
+    if fault_plan is not None and not isinstance(fault_plan,
+                                                 faults.FaultPlan):
+        fault_plan = faults.FaultPlan.from_json(fault_plan)
+    wedge_plan = fault_plan is not None and any(
+        r.site == "engine_wedge" for r in fault_plan.rules)
+    step_timeout_s = 0.25 if wedge_plan else None
+
+    @contextlib.contextmanager
+    def _fast_watchdog_scan():
+        """Temporarily speed the (process-wide) watchdog scan so the
+        wedge lane's heartbeat fires within the bench's time scale —
+        restored on every exit path, since test_tools runs this lane
+        in-process alongside timing-sensitive suites."""
+        if not wedge_plan:
+            yield
+            return
+        from paddle_tpu.distributed.watchdog import CommTaskManager
+        mgr = CommTaskManager.instance()
+        prev = mgr._scan_interval
+        mgr._scan_interval = 0.05
+        try:
+            yield
+        finally:
+            mgr._scan_interval = prev
+
     draft_built = False
     if model is None:
         import paddle_tpu as paddle
@@ -188,12 +225,12 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
 
     MAX_BATCH = 4
     failed = 0
-    with ContinuousBatchingEngine(
+    with _fast_watchdog_scan(), ContinuousBatchingEngine(
             model, total_pages=128, page_size=8, max_batch=MAX_BATCH,
             sample_on_device=sample_on_device,
             prefix_cache=prefix_cache,
             draft_model=draft_model if draft else None,
-            spec_tokens=spec_k) as eng:
+            spec_tokens=spec_k, step_timeout_s=step_timeout_s) as eng:
         # unmeasured warm-up: compiles the cold-prefill and suffix
         # (prefix-hit) prefill and seeds the prefix cache with the
         # system prompt (sequenced: the second sharer must be admitted
@@ -253,6 +290,12 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
     sa = _counter_delta(before, after, "spec_accepted_tokens_total")
     sr = _counter_delta(before, after, "spec_rollback_total")
     _, al_sum, al_n = _hist_delta(before, after, "spec_accept_len")
+    # recovery lane (ISSUE 8): the crash-consistency machinery's
+    # footprint in the measured window — replay/rebuild counts and the
+    # MTTR (engine_recovery_seconds p50, one observation per recovery
+    # event covering pool rebuild + every survivor's replay)
+    rec_b, rec_sum, rec_n = _hist_delta(before, after,
+                                        "engine_recovery_seconds")
     return {
         # speculative lane (ISSUE 6): acceptance economics of the
         # measured window; tokens_per_step is the structural win — a
@@ -280,6 +323,13 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
             before, after, "decode_retries_total")),
         "quarantined_requests": int(_counter_delta(
             before, after, "quarantined_requests_total")),
+        "survivor_replays": int(_counter_delta(
+            before, after, "survivor_replays_total")),
+        "engine_rebuilds": int(_counter_delta(
+            before, after, "engine_rebuilds_total")),
+        "recovery_events": rec_n,
+        "mttr_p50_s": hist_quantile(rec_b, 0.50),
+        "mttr_mean_s": (rec_sum / rec_n) if rec_n else None,
         "tokens_per_sec": (tokens / dec_sum) if dec_sum > 0 else 0.0,
         "generated_tokens": int(tokens),
         "decode_steps": dec_n,
@@ -675,6 +725,30 @@ def main(argv=None) -> int:
             print("FAIL: no surviving throughput after injected faults",
                   file=sys.stderr)
             return 1
+        # recovery lane (ISSUE 8): a device-fault plan (buffer_loss /
+        # engine_wedge rules) must show the recovery machinery ENGAGED
+        # — survivors replayed, a rebuild counted, and an MTTR sample
+        # in engine_recovery_seconds — with EVERY survivor completing
+        # (failed_requests stays within the error budget above; a
+        # transient buffer loss costs zero failures)
+        device_rules = [r for r in plan.rules
+                        if r.site in ("buffer_loss", "engine_wedge")]
+        if device_rules:
+            if all(r._fires == 0 for r in device_rules):
+                print("FAIL: the plan's device-fault rules never fired "
+                      "— the recovery lane measured nothing (lower nth "
+                      "or grow the workload)", file=sys.stderr)
+                return 1
+            if out["survivor_replays"] <= 0 \
+                    or out["engine_rebuilds"] <= 0:
+                print("FAIL: device-fault plan fired but no survivor "
+                      "replay/rebuild was counted — recovery did not "
+                      "engage", file=sys.stderr)
+                return 1
+            if out["mttr_p50_s"] is None:
+                print("FAIL: recovery ran but engine_recovery_seconds "
+                      "saw no sample — MTTR unmeasured", file=sys.stderr)
+                return 1
         return 0
     if not baseline and out["prefix_hit_rate"] <= 0:
         print("FAIL: shared-prefix workload saw no prefix-cache hits",
