@@ -315,16 +315,49 @@ def _check_donation(closed, findings: List[Finding], donated_avals,
                      "step (KV pages, optimizer state)"))
 
 
+#: Source files whose eqns are the quantizer implementation itself —
+#: the dynamic-quant absmax chain runs f32 and the s32 accumulator
+#: converts to f32 without an int8 invar, so the int8-input test alone
+#: misses them.  Kept to the quantizer modules proper: the attention /
+#: serving files are NOT listed (their dequant math carries int8
+#: inputs), so model-code f32 creep stays visible.
+_QUANTIZER_SOURCES = ("/ops/pallas/quant_matmul.py",
+                      "/paddle_tpu/quantization/")
+
+
+def _in_quantizer_source(path: str) -> bool:
+    return any(m in path.replace("\\", "/") for m in _QUANTIZER_SOURCES)
+
+
 def _check_dtype_creep(jaxpr, findings: List[Finding],
-                       expect_dtype) -> None:
+                       expect_dtype, quantized: bool = False) -> None:
     """Flag eqns that INTRODUCE a wide dtype (no wide input, wide
     output) inside a program meant to run at a narrower working dtype;
-    with x64 enabled, 64-bit introductions are flagged unconditionally."""
+    with x64 enabled, 64-bit introductions are flagged unconditionally.
+
+    ``quantized`` (ISSUE 9): in a QUANTIZED program an eqn whose
+    inputs include an INT8 array is the dequant/accumulator math —
+    int8 -> f32 casts and s32-accumulated dots are the POINT of the
+    int8 format (the accumulation must be wider than the storage), so
+    they are exempt from the f32-introduction rule; so are eqns
+    LOCATED in the quantizer implementation itself (the dynamic-quant
+    absmax runs f32 and the s32 accumulator converts to f32 — neither
+    carries an int8 input, but both are the format's sanctioned math,
+    and flagging them would eat the per-rule cap and bury a real f32
+    leak in model code).  The exemption is scoped to quantized audits
+    and never covers the x64 rule: 64-bit lanes are unintended
+    whatever the storage format."""
     check_f32 = expect_dtype is not None and np.dtype(expect_dtype) in (
         np.dtype("bfloat16"), np.dtype(np.float16))
+    int8 = np.dtype(np.int8)
     seen = set()
     n_per_rule = {"f32": 0, "x64": 0}   # caps are per rule, not shared
     for eqn in _walk_eqns(jaxpr):
+        int8_in = quantized and any(
+            _np_dtype(a.dtype) == int8
+            for v in eqn.invars
+            if (a := _aval_of(v)) is not None
+            and getattr(a, "dtype", None) is not None)
         in_wide = any(_is_wide_float(a.dtype)
                       for v in eqn.invars
                       if (a := _aval_of(v)) is not None
@@ -338,7 +371,10 @@ def _check_dtype_creep(jaxpr, findings: List[Finding],
             if aval is None or getattr(aval, "dtype", None) is None:
                 continue
             path, line = _eqn_location(eqn)
-            if check_f32 and _is_wide_float(aval.dtype) and not in_wide:
+            if check_f32 and _is_wide_float(aval.dtype) and not in_wide \
+                    and not int8_in \
+                    and not (quantized and path
+                             and _in_quantizer_source(path)):
                 key = ("f32", eqn.primitive.name, path, line)
                 if key in seen or n_per_rule["f32"] >= _MAX_FINDINGS_PER_RULE:
                     continue
@@ -369,6 +405,48 @@ def _check_dtype_creep(jaxpr, findings: List[Finding],
                     path=path, line=line))
 
 
+def _check_quant_consts(closed, findings: List[Finding],
+                        scale_lens=None) -> None:
+    """Quantized-program certification (ISSUE 9): quantization scales
+    must ride as TRACED arguments — a scale baked into the program as a
+    constant re-uploads per executable and forces a recompile whenever
+    the calibration changes (defeating the one-program-any-calibration
+    contract).  Flags captured f32 consts shaped like scales: 1-D
+    vectors (per-out-channel weight scales) or 4-D pools with a
+    trailing singleton (per-slot KV scale pools).  Rope tables (2-D)
+    and scalar epsilons pass.  ``scale_lens`` — the program's actual
+    1-D scale-vector lengths (``audit_engine`` derives them from the
+    decoder's weight-scale operands) — restricts the 1-D rule to those
+    lengths, so legitimate 1-D f32 tables (alibi slopes, an inv_freq
+    vector) of other sizes can't false-positive; without it any 1-D
+    f32 vector is treated as suspect."""
+    n = 0
+    for c in closed.consts:
+        aval = _aval_of(c)
+        if aval is None:
+            continue
+        dt = _np_dtype(getattr(aval, "dtype", None))
+        if dt != np.dtype(np.float32):
+            continue
+        shape = tuple(getattr(aval, "shape", ()) or ())
+        looks_like_scale = (
+            (len(shape) == 1 and shape[0] > 1
+             and (scale_lens is None or shape[0] in scale_lens))
+            or (len(shape) == 4 and shape[-1] == 1))
+        if looks_like_scale:
+            n += 1
+            if n > _MAX_FINDINGS_PER_RULE:
+                break
+            findings.append(Finding(
+                "quant-scale-const", SEVERITY_ERROR,
+                f"captured f32 constant {_shape_str(aval)} looks like a "
+                f"quantization scale baked into the program",
+                hint="pass weight scales / KV scale pools as traced "
+                     "arguments (JittedPagedDecoder threads them "
+                     "through every program); a baked scale pins the "
+                     "executable to one calibration"))
+
+
 def _check_weak_types(example_leaves, findings: List[Finding]) -> None:
     n = 0
     for leaf in example_leaves:
@@ -393,8 +471,13 @@ def audit_jaxpr(closed, *, name: str = "<jaxpr>", donated_avals=(),
                 output_transfer_bytes: int = DEFAULT_OUTPUT_TRANSFER_BYTES,
                 const_bytes: int = DEFAULT_CONST_BYTES,
                 donation_bytes: int = DEFAULT_DONATION_BYTES,
-                example_leaves=(), publish: bool = True) -> ProgramAudit:
-    """Walk a ClosedJaxpr and return the structured audit."""
+                example_leaves=(), publish: bool = True,
+                quantized: bool = False,
+                scale_lens=None) -> ProgramAudit:
+    """Walk a ClosedJaxpr and return the structured audit.
+    ``quantized`` adds the scale-const certification (ISSUE 9);
+    ``scale_lens`` narrows its 1-D rule to the program's actual
+    scale-vector lengths (see ``_check_quant_consts``)."""
     findings: List[Finding] = []
     _check_callbacks(closed.jaxpr, findings)
     _check_consts(closed, findings, const_bytes)
@@ -402,7 +485,10 @@ def audit_jaxpr(closed, *, name: str = "<jaxpr>", donated_avals=(),
                               output_transfer_bytes)
     _check_donation(closed, findings, donated_avals, leftover,
                     donation_bytes)
-    _check_dtype_creep(closed.jaxpr, findings, expect_dtype)
+    _check_dtype_creep(closed.jaxpr, findings, expect_dtype,
+                       quantized=quantized)
+    if quantized:
+        _check_quant_consts(closed, findings, scale_lens=scale_lens)
     _check_weak_types(example_leaves, findings)
     audit = ProgramAudit(name, findings)
     if publish:
@@ -415,7 +501,8 @@ def audit_jaxpr(closed, *, name: str = "<jaxpr>", donated_avals=(),
 
 def audit_callable(fn, *example_args, donate_argnums=(), static_argnums=(),
                    expect_dtype=None, name: Optional[str] = None,
-                   publish: bool = True, **limits) -> ProgramAudit:
+                   publish: bool = True, quantized: bool = False,
+                   scale_lens=None, **limits) -> ProgramAudit:
     """Trace ``fn`` on example args (arrays or ShapeDtypeStructs — no
     device work happens) and audit the resulting jaxpr.  This is the
     front door for auditing anything you would ``jax.jit``; pass the
@@ -461,7 +548,8 @@ def audit_callable(fn, *example_args, donate_argnums=(), static_argnums=(),
     return audit_jaxpr(
         closed, name=name or getattr(fn, "__name__", "<fn>"),
         donated_avals=donated_avals, expect_dtype=expect_dtype,
-        example_leaves=example_leaves, publish=publish, **limits)
+        example_leaves=example_leaves, publish=publish,
+        quantized=quantized, scale_lens=scale_lens, **limits)
 
 
 def audit_engine(engine, mode: str = "decode", sample=None,
@@ -479,7 +567,11 @@ def audit_engine(engine, mode: str = "decode", sample=None,
     invariant, extended to the speculative hot path).  The verify audit
     also proves no ``[B, k]``-shaped draft block was baked in as a
     constant (the block rides as a traced argument) and that BOTH page
-    pools stay donated.  ``mode="chunk"`` audits the CHUNKED-PREFILL
+    pools stay donated.  A QUANTIZED engine (ISSUE 9: ``quantize``
+    and/or ``kv_quant``) is certified further: donation intact on the
+    int8 page AND scale pools, int8->accumulator casts exempt from the
+    dtype-creep rule, and no scale baked in as a const
+    (``quant-scale-const``).  ``mode="chunk"`` audits the CHUNKED-PREFILL
     continuation program (ISSUE 7; shared with the prefix-cache suffix
     path): one chunk's token bucket rides as a traced argument with the
     context length/table traced alongside, so the audit proves the
@@ -512,14 +604,31 @@ def audit_engine(engine, mode: str = "decode", sample=None,
     W = next_pow2(max(1, -(-engine.max_position // cache.page_size)))
     sds = jax.ShapeDtypeStruct
     i32 = jnp.int32
-    params = [sds(tuple(p._data.shape), p._data.dtype)
-              for p in decoder.params]
+    params = [sds(tuple(a.shape), a.dtype)
+              for a in decoder._param_arrays()]
     k_pages = tuple(sds(tuple(a.shape), a.dtype) for a in cache.k_pages)
     v_pages = tuple(sds(tuple(a.shape), a.dtype) for a in cache.v_pages)
+    # quantized serving (ISSUE 9): the scale pools and per-channel
+    # weight scales ride as traced operands — empty tuples otherwise,
+    # exactly the call contract the decoder jits
+    k_scales = tuple(sds(tuple(a.shape), a.dtype)
+                     for a in cache.k_scales)
+    v_scales = tuple(sds(tuple(a.shape), a.dtype)
+                     for a in cache.v_scales)
+    wscales = tuple(sds(tuple(s.shape), s.dtype)
+                    for s in decoder._wscale_args())
+    pools = (k_pages, v_pages, k_scales, v_scales, wscales)
+    quantized = bool(getattr(engine, "quantize", None)
+                     or getattr(engine, "kv_quant", None))
+    # the 1-D baked-scale rule keys on the program's ACTUAL weight-
+    # scale lengths so legitimate 1-D f32 tables of other sizes
+    # (alibi slopes, inv_freq) can't false-positive the certification
+    scale_lens = frozenset(
+        s.shape[0] for s in wscales if len(s.shape) == 1)
     if mode == "chunk":
         # the engine dispatches chunks per request (batch 1) at the
         # configured chunk bucket; fn signature: (params, ids,
-        # last_idx, pg, sl, ptabs, plens, sampling, pools)
+        # last_idx, pg, sl, ptabs, plens, sampling, pools, wscales)
         B = 1
         S = next_pow2(int(engine.prefill_chunk_tokens or 64))
         if sample == "draw":
@@ -529,8 +638,7 @@ def audit_engine(engine, mode: str = "decode", sample=None,
             s_args = ()
         args = (params, sds((B, S), i32), sds((B,), i32),
                 sds((B * S,), i32), sds((B * S,), i32),
-                sds((B, W), i32), sds((B,), i32), s_args,
-                k_pages, v_pages)
+                sds((B, W), i32), sds((B,), i32), s_args, *pools)
     elif mode == "verify":
         S = engine.spec_k + 1
         if sample == "draw":
@@ -540,7 +648,7 @@ def audit_engine(engine, mode: str = "decode", sample=None,
             s_args = ()
         args = (params, sds((B, S), i32), sds((B,), i32),
                 sds((B * S,), i32), sds((B * S,), i32), sds((B,), i32),
-                sds((B, W), i32), s_args, k_pages, v_pages)
+                sds((B, W), i32), s_args, *pools)
     else:
         if sample == "draw":
             s_args = (sds((B,), jnp.uint32), sds((B,), i32),
@@ -549,12 +657,13 @@ def audit_engine(engine, mode: str = "decode", sample=None,
             s_args = ()
         args = (params, sds((B, 1), i32), sds((B,), i32), sds((B,), i32),
                 sds((B,), i32), sds((B,), i32), sds((B, W), i32), s_args,
-                k_pages, v_pages)
+                *pools)
     limits.setdefault("output_transfer_bytes", B * per_row_budget)
     return audit_callable(
         fn, *args, donate_argnums=donate,
         name=f"engine.{mode}[{'logits' if sample is False else sample}]",
-        publish=publish, **limits)
+        publish=publish, quantized=quantized, scale_lens=scale_lens,
+        **limits)
 
 
 def audit_program(program, feed, fetch_list=None, publish: bool = True,

@@ -127,6 +127,7 @@ import time
 from collections import namedtuple
 from typing import List, Optional
 
+import jax
 import numpy as np
 from .. import monitor
 from ..ops.pallas.paged_attention import PagedKVCache
@@ -278,6 +279,24 @@ _recovery_s = monitor.histogram(
 _snapshot_reqs = monitor.counter(
     "snapshot_requests_total", "in-flight requests serialized by "
     "engine.snapshot()")
+# quantized-serving telemetry (ISSUE 9): the capacity lever's footprint
+_quant_enabled_g = monitor.gauge(
+    "quant_enabled", "1 when the engine's compiled programs run "
+    "quantized weights (w8/w8a8), else 0")
+_kv_quant_enabled_g = monitor.gauge(
+    "kv_quant_enabled", "1 when the PagedKVCache stores int8 pages "
+    "with per-slot scale pools, else 0")
+_kv_quant_pool_bytes_g = monitor.gauge(
+    "kv_quant_pool_bytes", "resident bytes of the KV data pages "
+    "(int8 mode stores a quarter of f32 / half of bf16)")
+_kv_quant_scale_bytes_g = monitor.gauge(
+    "kv_quant_scale_bytes", "resident bytes of the int8 mode's "
+    "per-slot scale pools (0 at full precision)")
+# batched survivor replay (ISSUE 9 satellite): dispatch economics —
+# fewer compiled dispatches per recovery event is the MTTR lever
+_replay_dispatches = monitor.counter(
+    "replay_dispatches_total", "compiled dispatches issued by survivor-"
+    "KV replay (batched replay amortizes many survivors per dispatch)")
 
 #: one request's share of a speculative verify step: the bonus token
 #: (ids or the logits-row escape hatch), the device-computed accept
@@ -489,7 +508,10 @@ class ContinuousBatchingEngine:
                  scheduler_classes=None,
                  default_class: str = DEFAULT_CLASS,
                  min_table_pages: int = 1,
-                 preempt_resume_ttl_s: Optional[float] = None):
+                 preempt_resume_ttl_s: Optional[float] = None,
+                 quantize: Optional[str] = None,
+                 kv_quant: Optional[str] = None,
+                 replay_batch: Optional[bool] = None):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_position = int(model.config.max_position_embeddings)
@@ -520,11 +542,40 @@ class ContinuousBatchingEngine:
         # every XLA compile the decode loop triggers shows up in
         # jit_recompile_count (steady-state serving should sit at zero)
         monitor.install_compile_hooks()
+        # quantized serving (ISSUE 9): ``quantize`` runs the compiled
+        # programs' Linears int8 (w8 weight-only / w8a8 dynamic);
+        # ``kv_quant="int8"`` stores KV pages int8 with per-slot scale
+        # pools — at equal pool bytes that roughly 4x's (f32) or 2x's
+        # (bf16) the pages, i.e. the concurrent sequences one chip
+        # admits.  Both knobs apply to the TARGET model; a draft model
+        # stays full-precision (its pool is small and its accuracy
+        # directly sets the acceptance rate).
+        if kv_quant not in (None, "int8"):
+            raise ValueError(
+                f"kv_quant must be None or 'int8', got {kv_quant!r}")
+        self.quantize = quantize
+        self.kv_quant = kv_quant
+        # batched survivor replay (ISSUE 9 satellite) is verified
+        # bit-exact on CPU; on TPU its k == 0 round runs a different
+        # attention kernel than the original prefill and the
+        # accumulation order has NOT been re-verified (ROADMAP capture-
+        # window item), so the unset default keeps the ISSUE 8
+        # bit-exact recovery contract: batched everywhere but TPU.
+        # Explicit True/False overrides either way.
+        if replay_batch is None:
+            replay_batch = jax.default_backend() != "tpu"
+        self.replay_batch = bool(replay_batch)
         self.cache = PagedKVCache.from_model(
-            model, total_pages=total_pages, page_size=page_size)
+            model, total_pages=total_pages, page_size=page_size,
+            kv_dtype=kv_quant)
         from .paged import JittedPagedDecoder
         self._decoder = JittedPagedDecoder(
-            model, min_table_pages=min_table_pages)
+            model, min_table_pages=min_table_pages, quantize=quantize)
+        _quant_enabled_g.set(int(quantize is not None))
+        _kv_quant_enabled_g.set(int(kv_quant is not None))
+        _kv_quant_pool_bytes_g.set(self.cache.kv_pool_bytes)
+        _kv_quant_scale_bytes_g.set(self.cache.kv_scale_bytes)
+        _replay_dispatches.inc(0)       # materialize the series
         # speculative decoding (ISSUE 6): the draft gets its own
         # decoder + page pool; proposals/verification share the target's
         # bucketing so steady-state serving stays compile-free
@@ -1560,7 +1611,7 @@ class ContinuousBatchingEngine:
         self._pool_gen = g
         return True
 
-    def _replay_kv(self, req) -> None:
+    def _replay_kv(self, req, upto=None, dlen=None) -> None:
         """THE replay primitive (ISSUE 8 tentpole): reconstruct one
         sequence's KV state by re-prefilling its token sequence —
         ``prompt + generated-so-far``, up to the CURRENT logical cache
@@ -1576,11 +1627,18 @@ class ContinuousBatchingEngine:
         ``next_token`` is host state too and is NOT resampled; replay
         outputs are discarded (argmax-only tail).  The draft cache is
         re-prefilled to its own length so the lockstep invariant
-        survives the rebuild."""
+        survives the rebuild.
+
+        ``upto``/``dlen`` override the replay targets — the batched
+        path records them before truncating anything, so its per-row
+        fallback can still replay a row a failed batched attempt left
+        at a partial length."""
         sid = req.seq_id
-        upto = self.cache.length(sid)
-        dlen = (self.draft_cache.length(sid)
-                if self._spec and req.use_draft else 0)
+        if upto is None:
+            upto = self.cache.length(sid)
+        if dlen is None:
+            dlen = (self.draft_cache.length(sid)
+                    if self._spec and req.use_draft else 0)
         if upto <= 0 and dlen <= 0:
             return                     # nothing resident yet
         sampling = _null_sampling() if self.sample_on_device else None
@@ -1598,6 +1656,7 @@ class ContinuousBatchingEngine:
                 # step's start, so a slow replay never condemns it)
                 self._step_started_at = time.monotonic()
                 try:
+                    _replay_dispatches.inc()
                     self._ingest(self._decoder, self.cache, sid, tokens,
                                  k, n, sampling)
                 finally:
@@ -1613,12 +1672,88 @@ class ContinuousBatchingEngine:
             self.draft_cache.truncate(sid, 0)
             self._step_started_at = time.monotonic()
             try:
+                _replay_dispatches.inc()
                 self._draft_decoder.prefill(
                     self.draft_cache, [sid], req.output_ids[None, :dlen],
                     bucket=True, sampling=sampling)
             finally:
                 self._step_started_at = None
         _survivor_replays.inc()
+
+    def _replay_kv_batch(self, rows, targets) -> None:
+        """Batched survivor replay (ISSUE 9 satellite, ROADMAP crash-
+        consistency follow-up (c)): reconstruct MANY survivors' KV in
+        lockstep chunk rounds — each round ingests up to a chunk budget
+        per row for up to ``max_batch`` rows in ONE compiled dispatch
+        through the decoder's batched context-prefill program (per-row
+        context lengths are traced, so mixed-progress rows share the
+        dispatch).  For continuation chunks (k > 0) this is the SAME
+        traced "prefix" program the per-row path compiles — only the
+        dispatch count changes, which is the MTTR lever on
+        many-survivor pools.  Caveat carried with the TPU capture
+        window: a row's FIRST chunk originally ingested through the
+        "prefill" program (flash attention), while the batched k == 0
+        round runs the prefix program's dense masked attention — on
+        CPU both lower to identical XLA math (tier-1 locks the
+        bit-exactness), on real TPU the two kernels' accumulation
+        orders may differ in ulps, so hardware replay exactness must
+        be re-verified there (``replay_batch=False`` restores the
+        per-row path, whose k == 0 chunk uses the original prefill
+        program).
+
+        ``targets`` maps ``id(req)`` to the (upto, dlen) lengths
+        recorded BEFORE any truncation; any failure propagates to the
+        caller, which falls back to per-row replay for exact
+        quarantine isolation."""
+        def collect(cache, which):
+            out = []
+            for r in rows:
+                upto = targets[id(r)][which]
+                if upto > 0:
+                    out.append((r, r.output_ids[:upto], upto))
+                    cache.truncate(r.seq_id, 0)
+            return out
+
+        def rounds(decoder, cache, work, chunk):
+            """ONE lockstep-round loop for both pools: up to max_batch
+            rows per batched dispatch, each ingesting up to a chunk
+            budget, dropping out as it reaches its target length."""
+            cursor = {id(r): 0 for r, _, _ in work}
+            pending = list(work)
+            while pending:
+                batch = pending[:self.max_batch]
+                sids = [r.seq_id for r, _, _ in batch]
+                ks = [cursor[id(r)] for r, _, _ in batch]
+                slices = [toks[k:k + min(chunk or upto, upto - k)]
+                          for (r, toks, upto), k in zip(batch, ks)]
+                self._step_started_at = time.monotonic()
+                try:
+                    _replay_dispatches.inc()
+                    decoder.batch_context_prefill(
+                        cache, sids, slices, ks,
+                        sampling=(_null_sampling(len(sids))
+                                  if self.sample_on_device else None))
+                finally:
+                    self._step_started_at = None
+                for (r, toks, upto), sl in zip(batch, slices):
+                    cursor[id(r)] += len(sl)
+                pending = [(r, toks, upto) for r, toks, upto in pending
+                           if cursor[id(r)] < upto]
+
+        chunk = self.prefill_chunk_tokens
+        work = collect(self.cache, 0)
+        rounds(self._decoder, self.cache, work, chunk)
+        for r, toks, upto in work:
+            if self.prefix_cache and upto >= len(r.prompt):
+                self.cache.register_prefix(r.seq_id, r.prompt)
+        # draft pools ride in lockstep: batched rounds over the draft
+        # decoder's batched program (context starts at 0 — the draft
+        # always holds whole prompts)
+        dwork = collect(self.draft_cache, 1) if self._spec else []
+        if dwork:
+            rounds(self._draft_decoder, self.draft_cache, dwork, chunk)
+        done = {id(r) for r, _, _ in work} | {id(r) for r, _, _ in dwork}
+        _survivor_replays.inc(len(done))
 
     def _replay_survivors(self, exclude=()) -> List[_Request]:
         """Device-failure recovery (ISSUE 8 consumer 1): replay every
@@ -1633,20 +1768,49 @@ class ContinuousBatchingEngine:
         quarantine — one unreconstructible row must never fail the
         engine; if the failed replay consumed the pools again, the
         whole pass restarts so earlier survivors are re-replayed over
-        the fresh pools (bounded: every restart removes a row)."""
+        the fresh pools (bounded: every restart removes a row).
+
+        With ``replay_batch`` (the default everywhere but TPU, where
+        the batched round's kernel swap is not yet hardware-verified
+        bit-exact) survivors replay in
+        BATCHED lockstep rounds — many rows per compiled dispatch
+        (ISSUE 9 satellite; the MTTR lever).  A failed batched dispatch
+        cannot name the poisoned row, so it falls back to the per-row
+        pass, which preserves exact quarantine isolation."""
         skip = {id(r) for r in exclude}
         failed: List[_Request] = []
+
+        def eligible():
+            return [r for r in (self._active + self._prefilling
+                                + self._preempted)
+                    # r.error covers rows an EARLIER recovery in this
+                    # same step already condemned (their done event is
+                    # only set at step end) — never re-replay one
+                    if id(r) not in skip and r.seq_id is not None
+                    and not r.done.is_set() and r.error is None]
+
+        # replay targets recorded BEFORE any truncation: the batched
+        # path's per-row fallback must know the full lengths even after
+        # a mid-round failure left a row partially re-ingested
+        targets = {id(r): (self.cache.length(r.seq_id),
+                           (self.draft_cache.length(r.seq_id)
+                            if self._spec and r.use_draft else 0))
+                   for r in eligible()}
+        batched = self.replay_batch
         while True:
             restart = False
-            for r in self._active + self._prefilling + self._preempted:
-                # r.error covers rows an EARLIER recovery in this same
-                # step already condemned (their done event is only set
-                # at step end) — never re-replay a quarantined row
-                if id(r) in skip or r.seq_id is None \
-                        or r.done.is_set() or r.error is not None:
-                    continue
+            rows = eligible()
+            if batched and len(rows) > 1:
                 try:
-                    self._replay_kv(r)
+                    self._replay_kv_batch(rows, targets)
+                    break
+                except BaseException:  # noqa: BLE001 — isolate per row
+                    batched = False
+                    self._pools_rebuilt()   # reconcile a mid-batch loss
+                    continue
+            for r in rows:
+                try:
+                    self._replay_kv(r, *targets[id(r)])
                 except BaseException as e:  # noqa: BLE001 — per-row
                     r.error = e
                     skip.add(id(r))

@@ -26,8 +26,10 @@ import jax.numpy as jnp
 from ..framework.tensor import Tensor, wrap_array
 from ..framework.tape import no_grad
 from ..ops.pallas.flash_attention import DEFAULT_MASK_VALUE
-from ..ops.pallas.paged_attention import (PagedKVCache, paged_attention,
-                                          paged_attention_multi)
+from ..ops.pallas.paged_attention import (PagedKVCache, _gather_dequant,
+                                          dequantize_kv, paged_attention,
+                                          paged_attention_multi,
+                                          quantize_kv)
 from ..testing import faults as _faults
 
 
@@ -43,7 +45,7 @@ def _maybe_lose_buffers(cache: PagedKVCache, seq_ids) -> None:
     try:
         _faults.maybe_fire("buffer_loss", seq_ids=seq_ids)
     except BaseException:
-        for a in list(cache.k_pages) + list(cache.v_pages):
+        for a in cache._device_pools():
             fn = getattr(a, "delete", None)
             if callable(fn):
                 try:
@@ -51,6 +53,18 @@ def _maybe_lose_buffers(cache: PagedKVCache, seq_ids) -> None:
                 except Exception:   # noqa: BLE001 — already unusable
                     pass
         raise
+
+
+def _fake_quant_kv(x):
+    """Round-trip (quantize -> dequantize) a float K/V block through the
+    int8 KV representation WITHOUT storing it: the values prefill
+    attention consumes are then bit-identical to what the pages hold,
+    so chunked prefill, preemption-resume, survivor replay and
+    snapshot-restore stay exact in the int8 mode — a prefill that
+    attended the exact in-flight suffix while decode later read the
+    quantized pages would break every replay contract."""
+    q, s = quantize_kv(x)
+    return dequantize_kv(q, s, x.dtype)
 
 
 def fused_sample(logits, seeds, ctrs, temps, flags):
@@ -76,7 +90,7 @@ def fused_sample(logits, seeds, ctrs, temps, flags):
 
 
 def _prefix_suffix_attention(q, k_suf, v_suf, k_pages, v_pages, tables,
-                             prefix_lens):
+                             prefix_lens, k_scales=None, v_scales=None):
     """Prompt-SUFFIX attention for a sequence whose prefix KV is already
     cached in pages: every suffix token attends to the whole gathered
     prefix plus the suffix causally.  Dense masked attention (the
@@ -86,7 +100,9 @@ def _prefix_suffix_attention(q, k_suf, v_suf, k_pages, v_pages, tables,
     q (b, s, q_heads, d); k_suf/v_suf (b, s, kv_heads, d) post-rope;
     k/v_pages (kv_heads, total, page, d); tables (b, P) int32 pointing
     at the prefix pages; prefix_lens (b,) int32 page-aligned.
-    Returns (b, s, q_heads, d).
+    ``k/v_scales`` (kv_heads, total, page, 1) mark int8 pages (ISSUE 9:
+    dequant fused into the gather; the SUFFIX k/v must already be
+    round-tripped by the caller).  Returns (b, s, q_heads, d).
     """
     b, s, qh, d = q.shape
     kvh = k_suf.shape[2]
@@ -94,15 +110,17 @@ def _prefix_suffix_attention(q, k_suf, v_suf, k_pages, v_pages, tables,
     page = k_pages.shape[2]
     t_pre = tables.shape[1] * page
 
-    def gather(pages):     # (kvh, b, P, page, d) -> (b, kvh, t_pre, d)
-        g = jnp.take(pages, tables, axis=1)
-        return g.transpose(1, 0, 2, 3, 4).reshape(b, kvh, t_pre, d)
+    def gather(pages, scales):
+        # the ONE gather+dequant helper the decode/multi fallbacks use
+        # — prefix-path and decode-path dequant can never drift
+        return _gather_dequant(pages, scales, tables, b, kvh, t_pre, d,
+                               q.dtype)
 
     k_all = jnp.concatenate(
-        [gather(k_pages).astype(q.dtype), jnp.swapaxes(k_suf, 1, 2)],
+        [gather(k_pages, k_scales), jnp.swapaxes(k_suf, 1, 2)],
         axis=2)                                   # (b, kvh, t_pre + s, d)
     v_all = jnp.concatenate(
-        [gather(v_pages).astype(q.dtype), jnp.swapaxes(v_suf, 1, 2)],
+        [gather(v_pages, v_scales), jnp.swapaxes(v_suf, 1, 2)],
         axis=2)
     if group != 1:
         k_all = jnp.repeat(k_all, group, axis=1)
@@ -162,7 +180,13 @@ class _PagedContext:
         cache.write_batch(layer, self.seq_ids, k._data, v._data)
         if self.prefill:
             # fresh sequences: the cache holds exactly this prompt, so
-            # dense causal attention over the batch is equivalent
+            # dense causal attention over the batch is equivalent; in
+            # the int8 mode the attended values must be the ROUND-
+            # TRIPPED ones the pages hold, or later decode steps (which
+            # read quantized pages) would diverge from this prefill
+            if cache.kv_quant:
+                k = wrap_array(_fake_quant_kv(k._data))
+                v = wrap_array(_fake_quant_kv(v._data))
             from ..nn import functional as F
             out, _ = F.flash_attention(q, k, v, causal=True)
             return out
@@ -171,8 +195,11 @@ class _PagedContext:
             # length advances when the LAST layer writes; earlier layers
             # must already count the token they just wrote
             lens = lens + k.shape[1]
-        out = paged_attention(q._data[:, 0], cache.k_pages[layer],
-                              cache.v_pages[layer], lens, tab)
+        out = paged_attention(
+            q._data[:, 0], cache.k_pages[layer], cache.v_pages[layer],
+            lens, tab,
+            k_scales=(cache.k_scales[layer] if cache.kv_quant else None),
+            v_scales=(cache.v_scales[layer] if cache.kv_quant else None))
         return wrap_array(out[:, None])      # (batch, 1, q_heads, d)
 
 
@@ -197,9 +224,15 @@ class _TracedPagedContext:
     [gathered prefix; suffix] so the cached tokens are visible."""
 
     def __init__(self, k_pages, v_pages, pg, sl, lens=None, tables=None,
-                 prefill=False, prefix_lens=None):
+                 prefill=False, prefix_lens=None, k_scales=None,
+                 v_scales=None):
         self.k_pages = list(k_pages)
         self.v_pages = list(v_pages)
+        # int8 KV mode (ISSUE 9): parallel per-slot scale pools carried
+        # through the program exactly like the data pools (donated at
+        # the jit boundary); empty/None means full-precision storage
+        self.k_scales = list(k_scales) if k_scales else None
+        self.v_scales = list(v_scales) if v_scales else None
         self.pg = pg
         self.sl = sl
         self.lens = lens                # POST-write lengths (decode)
@@ -208,39 +241,68 @@ class _TracedPagedContext:
         self.prefix_lens = prefix_lens  # (b,) traced, prefix-prefill only
         self.layer_idx = 0
 
+    def _scatter(self, layer, ks, vs):
+        """One layer's append: ``ks``/``vs`` (kvh, tokens, d) float.
+        In the int8 mode quantization is FUSED into the scatter (per
+        slot, per head) and the scale pools scatter alongside; returns
+        the values attention must consume — the round-tripped ones, so
+        every consumer sees exactly what the pages hold."""
+        kp, vp = self.k_pages[layer], self.v_pages[layer]
+        if self.k_scales is not None:
+            k8, ksc = quantize_kv(ks)
+            v8, vsc = quantize_kv(vs)
+            self.k_scales[layer] = \
+                self.k_scales[layer].at[:, self.pg, self.sl].set(ksc)
+            self.v_scales[layer] = \
+                self.v_scales[layer].at[:, self.pg, self.sl].set(vsc)
+            self.k_pages[layer] = kp.at[:, self.pg, self.sl].set(k8)
+            self.v_pages[layer] = vp.at[:, self.pg, self.sl].set(v8)
+            return (dequantize_kv(k8, ksc, ks.dtype),
+                    dequantize_kv(v8, vsc, vs.dtype))
+        self.k_pages[layer] = \
+            kp.at[:, self.pg, self.sl].set(ks.astype(kp.dtype))
+        self.v_pages[layer] = \
+            vp.at[:, self.pg, self.sl].set(vs.astype(vp.dtype))
+        return ks, vs
+
+    def _layer_scales(self, layer):
+        if self.k_scales is None:
+            return None, None
+        return self.k_scales[layer], self.v_scales[layer]
+
     def attend(self, q, k, v):
         layer = self.layer_idx
-        kp, vp = self.k_pages[layer], self.v_pages[layer]
-        if self.prefill:
-            b, s = k.shape[0], k.shape[1]
-            kvh, d = k.shape[2], k.shape[3]
-            ks = jnp.swapaxes(k._data.reshape(b * s, kvh, d), 0, 1)
-            vs = jnp.swapaxes(v._data.reshape(b * s, kvh, d), 0, 1)
-            kp = kp.at[:, self.pg, self.sl].set(ks.astype(kp.dtype))
-            vp = vp.at[:, self.pg, self.sl].set(vs.astype(vp.dtype))
-            self.k_pages[layer], self.v_pages[layer] = kp, vp
-            if self.prefix_lens is not None:
-                return wrap_array(_prefix_suffix_attention(
-                    q._data, k._data, v._data, kp, vp, self.tables,
-                    self.prefix_lens))
-            from ..nn import functional as F
-            out, _ = F.flash_attention(q, k, v, causal=True)
-            return out
-        # decode / verify: s tokens per row scatter flat (s == 1 is the
-        # classic decode step; s > 1 is the speculative verify block)
         b, s = k.shape[0], k.shape[1]
         kvh, d = k.shape[2], k.shape[3]
         ks = jnp.swapaxes(k._data.reshape(b * s, kvh, d), 0, 1)
         vs = jnp.swapaxes(v._data.reshape(b * s, kvh, d), 0, 1)
-        kp = kp.at[:, self.pg, self.sl].set(ks.astype(kp.dtype))
-        vp = vp.at[:, self.pg, self.sl].set(vs.astype(vp.dtype))
-        self.k_pages[layer], self.v_pages[layer] = kp, vp
+        ks_att, vs_att = self._scatter(layer, ks, vs)
+        ksc, vsc = self._layer_scales(layer)
+        kp, vp = self.k_pages[layer], self.v_pages[layer]
+        if self.prefill:
+            # the suffix attends its own (round-tripped, in the int8
+            # mode) values — identical to the page contents, so chunked
+            # prefill and replay reproduce decode-written KV exactly
+            k_att = jnp.swapaxes(ks_att, 0, 1).reshape(b, s, kvh, d)
+            v_att = jnp.swapaxes(vs_att, 0, 1).reshape(b, s, kvh, d)
+            if self.prefix_lens is not None:
+                return wrap_array(_prefix_suffix_attention(
+                    q._data, k_att, v_att, kp, vp, self.tables,
+                    self.prefix_lens, k_scales=ksc, v_scales=vsc))
+            from ..nn import functional as F
+            out, _ = F.flash_attention(q, wrap_array(k_att),
+                                       wrap_array(v_att), causal=True)
+            return out
+        # decode / verify: s tokens per row scatter flat (s == 1 is the
+        # classic decode step; s > 1 is the speculative verify block)
         if s == 1:
             out = paged_attention(q._data[:, 0], kp, vp, self.lens,
-                                  self.tables)
+                                  self.tables, k_scales=ksc,
+                                  v_scales=vsc)
             return wrap_array(out[:, None])
         out = paged_attention_multi(q._data, kp, vp, self.lens,
-                                    self.tables)
+                                    self.tables, k_scales=ksc,
+                                    v_scales=vsc)
         return wrap_array(out)
 
 
@@ -252,17 +314,50 @@ class JittedPagedDecoder:
 
     Shared by PagedGenerator and ContinuousBatchingEngine; retraces per
     (batch, pool-shape) signature and reuses the compile cache after.
+
+    Quantized serving (ISSUE 9): ``quantize="w8"`` swaps every Linear
+    projection's weight for a per-out-channel int8 twin inside the
+    compiled programs (the streaming weight-only kernel;
+    ``quantization.serving`` calibrates the scales through the PTQ
+    observers); ``"w8a8"`` adds dynamic per-token activation
+    quantization in-program.  The scales ride as TRACED arguments —
+    never baked consts — so one compiled program serves any
+    calibration.  An int8 cache (``PagedKVCache(kv_dtype="int8")``)
+    composes orthogonally: its scale pools are donated through every
+    program beside the data pools.
     """
 
-    #: per-mode donated arg positions (the page pools) — shared between
-    #: the jit call and the analysis auditor so both see one contract
-    DONATE_ARGNUMS = {"decode": (8, 9), "prefill": (6, 7),
-                      "prefix": (8, 9), "verify": (8, 9)}
+    #: per-mode donated arg positions (page pools + scale pools) —
+    #: shared between the jit call and the analysis auditor so both
+    #: see one contract.  The scale-pool slots hold empty tuples (no
+    #: leaves) for full-precision caches.
+    DONATE_ARGNUMS = {"decode": (8, 9, 10, 11), "prefill": (6, 7, 8, 9),
+                      "prefix": (8, 9, 10, 11), "verify": (8, 9, 10, 11)}
 
-    def __init__(self, model, min_table_pages: int = 1):
+    def __init__(self, model, min_table_pages: int = 1,
+                 quantize: Optional[str] = None):
+        from ..quantization.serving import SERVING_QUANT_MODES
+        if quantize not in SERVING_QUANT_MODES:
+            raise ValueError(
+                f"quantize must be one of {SERVING_QUANT_MODES}, got "
+                f"{quantize!r}")
         self.model = model
         self.params = model.parameters()
         self.max_position = int(model.config.max_position_embeddings)
+        self.quantize = quantize
+        if quantize is not None:
+            from ..quantization.serving import quantize_linear_weights
+            self._quant = quantize_linear_weights(model)
+            by_id = {id(layer.weight): qi
+                     for qi, (layer, _, _) in enumerate(self._quant)}
+            # param-list position -> quant entry, so _param_arrays can
+            # substitute the int8 twins in place
+            self._quant_idx = {i: by_id[id(p)]
+                               for i, p in enumerate(self.params)
+                               if id(p) in by_id}
+        else:
+            self._quant = []
+            self._quant_idx = {}
         # page-table width floor: with the default 1 the table width is
         # next_pow2(longest sequence's pages), which recompiles the
         # decode/verify/chunk programs every time the running batch
@@ -277,11 +372,54 @@ class JittedPagedDecoder:
         self._jitted_multi = None        # built on first multi_step use
 
     # -------------------------------------------------- compiled programs
-    def _swap_params(self, param_arrays):
+    def _param_arrays(self):
+        """The param operands a program call ships: the model's arrays,
+        with quantized Linears' weights replaced by their int8 twins —
+        half (vs bf16) or a quarter (vs f32) of the weight HBM traffic
+        the decode step streams."""
+        if not self.quantize:
+            return [p._data for p in self.params]
+        return [self._quant[self._quant_idx[i]][1]
+                if i in self._quant_idx else p._data
+                for i, p in enumerate(self.params)]
+
+    def _wscale_args(self):
+        """Per-out-channel weight scales as one traced tuple operand
+        (empty when unquantized)."""
+        return tuple(s for _, _, s in self._quant)
+
+    def _pool_args(self, cache):
+        """(k_pages, v_pages, k_scales, v_scales) operand tuples — the
+        scale tuples are empty for full-precision caches, so one
+        program signature covers both storage modes."""
+        return (tuple(cache.k_pages), tuple(cache.v_pages),
+                tuple(cache.k_scales), tuple(cache.v_scales))
+
+    @staticmethod
+    def _store_pools(cache, k_pages, v_pages, k_scales, v_scales):
+        cache.k_pages = list(k_pages)
+        cache.v_pages = list(v_pages)
+        if cache.kv_quant:
+            cache.k_scales = list(k_scales)
+            cache.v_scales = list(v_scales)
+
+    def _swap_params(self, param_arrays, wscales=()):
         saved = [p._data for p in self.params]
         for p, a in zip(self.params, param_arrays):
             p._data = a
+        if wscales:
+            # arm the Linear hook: mode + TRACED scale per layer —
+            # cleared by _restore_params so nothing leaks outside the
+            # program trace
+            for (layer, _, _), s in zip(self._quant, wscales):
+                layer._serving_quant = (self.quantize, s)
         return saved
+
+    def _restore_params(self, saved):
+        for p, s in zip(self.params, saved):
+            p._data = s
+        for layer, _, _ in self._quant:
+            layer._serving_quant = None
 
     def _program(self, mode: str, sample):
         """Lazily build one compiled program per (mode, sample) pair.
@@ -312,49 +450,58 @@ class JittedPagedDecoder:
             logits = model._logits_of(wrap_array(last[:, None]))
             return logits._data[:, -1].astype(jnp.float32)
 
+        def ctx_pools(ctx):
+            return (tuple(ctx.k_pages), tuple(ctx.v_pages),
+                    tuple(ctx.k_scales or ()), tuple(ctx.v_scales or ()))
+
         if mode == "decode":
             def fn(param_arrays, tokens, pos, pg, sl, lens, tables,
-                   sampling, k_pages, v_pages):
-                saved = self._swap_params(param_arrays)
+                   sampling, k_pages, v_pages, k_scales, v_scales,
+                   wscales):
+                saved = self._swap_params(param_arrays, wscales)
                 try:
                     ctx = _TracedPagedContext(k_pages, v_pages, pg, sl,
-                                              lens, tables)
+                                              lens, tables,
+                                              k_scales=k_scales,
+                                              v_scales=v_scales)
                     with no_grad():
                         hidden = model.model(wrap_array(tokens), pos,
                                              paged_ctx=ctx)
                         logits = model._logits_of(hidden)
                     return (tail(logits._data[:, -1].astype(jnp.float32),
                                  sampling),
-                            tuple(ctx.k_pages), tuple(ctx.v_pages))
+                            *ctx_pools(ctx))
                 finally:
-                    for p, s in zip(self.params, saved):
-                        p._data = s
+                    self._restore_params(saved)
 
         elif mode == "prefill":
             def fn(param_arrays, ids, last_idx, pg, sl, sampling,
-                   k_pages, v_pages):
-                saved = self._swap_params(param_arrays)
+                   k_pages, v_pages, k_scales, v_scales, wscales):
+                saved = self._swap_params(param_arrays, wscales)
                 try:
                     ctx = _TracedPagedContext(k_pages, v_pages, pg, sl,
-                                              prefill=True)
+                                              prefill=True,
+                                              k_scales=k_scales,
+                                              v_scales=v_scales)
                     with no_grad():
                         hidden = model.model(wrap_array(ids), 0,
                                              paged_ctx=ctx)
                         logits = last_logits(hidden, last_idx)
-                    return (tail(logits, sampling),
-                            tuple(ctx.k_pages), tuple(ctx.v_pages))
+                    return (tail(logits, sampling), *ctx_pools(ctx))
                 finally:
-                    for p, s in zip(self.params, saved):
-                        p._data = s
+                    self._restore_params(saved)
 
         elif mode == "prefix":
             def fn(param_arrays, ids, last_idx, pg, sl, ptabs,
-                   plens, sampling, k_pages, v_pages):
-                saved = self._swap_params(param_arrays)
+                   plens, sampling, k_pages, v_pages, k_scales,
+                   v_scales, wscales):
+                saved = self._swap_params(param_arrays, wscales)
                 try:
                     ctx = _TracedPagedContext(k_pages, v_pages, pg, sl,
                                               tables=ptabs, prefill=True,
-                                              prefix_lens=plens)
+                                              prefix_lens=plens,
+                                              k_scales=k_scales,
+                                              v_scales=v_scales)
                     with no_grad():
                         # plens doubles as the per-row rope offset: the
                         # suffix starts right after the cached prefix
@@ -363,15 +510,14 @@ class JittedPagedDecoder:
                         hidden = model.model(wrap_array(ids), plens,
                                              paged_ctx=ctx)
                         logits = last_logits(hidden, last_idx)
-                    return (tail(logits, sampling),
-                            tuple(ctx.k_pages), tuple(ctx.v_pages))
+                    return (tail(logits, sampling), *ctx_pools(ctx))
                 finally:
-                    for p, s in zip(self.params, saved):
-                        p._data = s
+                    self._restore_params(saved)
 
         elif mode == "verify":
             def fn(param_arrays, block, pos, pg, sl, lens, tables,
-                   sampling, k_pages, v_pages):
+                   sampling, k_pages, v_pages, k_scales, v_scales,
+                   wscales):
                 """Speculative-decoding verify: ONE compiled dispatch
                 scores the whole (B, S) block — S = 1 fed token + k
                 draft proposals — against paged KV + the in-flight
@@ -379,10 +525,12 @@ class JittedPagedDecoder:
                 per-row ACCEPT LENGTHS on device, and fuses the bonus
                 token's sampling, so the host boundary stays (batch,)
                 ids + (batch,) accept counts whatever k is."""
-                saved = self._swap_params(param_arrays)
+                saved = self._swap_params(param_arrays, wscales)
                 try:
                     ctx = _TracedPagedContext(k_pages, v_pages, pg, sl,
-                                              lens, tables)
+                                              lens, tables,
+                                              k_scales=k_scales,
+                                              v_scales=v_scales)
                     with no_grad():
                         hidden = model.model(wrap_array(block), pos,
                                              paged_ctx=ctx)
@@ -395,7 +543,7 @@ class JittedPagedDecoder:
                         .astype(jnp.int32)
                     accept = jnp.sum(jnp.cumprod(match, axis=1),
                                      axis=1).astype(jnp.int32)  # (B,)
-                    pools = (tuple(ctx.k_pages), tuple(ctx.v_pages))
+                    pools = ctx_pools(ctx)
                     if sample == "greedy":
                         ids = jnp.take_along_axis(
                             targets, accept[:, None], axis=1)[:, 0]
@@ -414,8 +562,7 @@ class JittedPagedDecoder:
                         return ids, accept, *pools
                     return bonus, accept, *pools   # logits escape hatch
                 finally:
-                    for p, s in zip(self.params, saved):
-                        p._data = s
+                    self._restore_params(saved)
 
         else:
             raise ValueError(f"unknown program mode {mode!r}")
@@ -449,7 +596,7 @@ class JittedPagedDecoder:
                 return bool(fn()) if callable(fn) else False
             except Exception:   # noqa: BLE001 — treat unknown as dead
                 return True
-        if any(dead(a) for a in list(cache.k_pages) + list(cache.v_pages)):
+        if any(dead(a) for a in cache._device_pools()):
             cache.reset_pools()
 
     def _rollback_lengths(self, cache, seq_ids, before):
@@ -525,17 +672,16 @@ class JittedPagedDecoder:
         sample, s_args = self._sampling_args(sampling)
         try:
             _maybe_lose_buffers(cache, seq_ids)
-            out, k_pages, v_pages = self._program("prefill", sample)(
-                [p._data for p in self.params],
+            out, *pools = self._program("prefill", sample)(
+                self._param_arrays(),
                 jnp.asarray(ids_np.astype(np.int32)),
                 jnp.asarray(last_idx), jnp.asarray(pg), jnp.asarray(sl),
-                s_args, tuple(cache.k_pages), tuple(cache.v_pages))
+                s_args, *self._pool_args(cache), self._wscale_args())
         except BaseException:
             self._recover_pools(cache)
             self._rollback_lengths(cache, seq_ids, before)
             raise
-        cache.k_pages = list(k_pages)
-        cache.v_pages = list(v_pages)
+        self._store_pools(cache, *pools)
         return np.asarray(out)
 
     def prefix_prefill(self, cache: PagedKVCache, seq_ids, ids_np,
@@ -611,22 +757,126 @@ class JittedPagedDecoder:
         plens = np.full(b, k, np.int32)
         last_idx = np.full(b, s - 1, np.int32)
         sample, s_args = self._sampling_args(sampling)
+        return self._dispatch_prefix(
+            cache, seq_ids, before, sample, s_args,
+            ids_np.astype(np.int32), last_idx, pg, sl, ptabs, plens)
+
+    def _dispatch_prefix(self, cache, seq_ids, before, sample, s_args,
+                         ids, last_idx, pg, sl, ptabs, plens):
+        """The "prefix" program's dispatch + failure-recovery contract,
+        shared by the uniform-context and batched (per-row ``ks``)
+        prefill paths: on ANY failure the donated pools are recovered
+        and the advanced lengths roll back to ``before`` — one
+        implementation, so the recovery semantics can never drift
+        between the two builders."""
         try:
             _maybe_lose_buffers(cache, seq_ids)
-            out, k_pages, v_pages = self._program("prefix", sample)(
-                [p._data for p in self.params],
-                jnp.asarray(ids_np.astype(np.int32)),
+            out, *pools = self._program("prefix", sample)(
+                self._param_arrays(), jnp.asarray(ids),
                 jnp.asarray(last_idx),
                 jnp.asarray(pg), jnp.asarray(sl), jnp.asarray(ptabs),
                 jnp.asarray(plens), s_args,
-                tuple(cache.k_pages), tuple(cache.v_pages))
+                *self._pool_args(cache), self._wscale_args())
         except BaseException:
             self._recover_pools(cache)
             self._rollback_lengths(cache, seq_ids, before)
             raise
-        cache.k_pages = list(k_pages)
-        cache.v_pages = list(v_pages)
+        self._store_pools(cache, *pools)
         return np.asarray(out)
+
+    def batch_context_prefill(self, cache: PagedKVCache, seq_ids, rows,
+                              ks, sampling=None) -> np.ndarray:
+        """Batched context-prefill continuation (ISSUE 9 satellite:
+        batched survivor replay): ingest ``rows[i]`` (a 1-D int32 token
+        slice) for ``seq_ids[i]`` whose cached context length is
+        ``ks[i]`` — ONE compiled dispatch for the whole batch, through
+        the SAME traced "prefix" program the chunked/prefix prefill
+        paths compile (context lengths and rope offsets are per-row
+        TRACED values, so mixed-progress rows batch together).
+
+        Rows right-pad to a power-of-two bucket (pad positions scatter
+        to the dropped out-of-bounds page and are causality/last_idx-
+        masked); ``ks[i] == 0`` rows ride the same program — a zero
+        prefix length masks every prefix column, making the dispatch a
+        fresh prefill for that row.  Returns the last-real-token output
+        per row (ids under ``sampling``, logits otherwise)."""
+        b = len(seq_ids)
+        ns = [len(r) for r in rows]
+        if b == 0 or min(ns) < 1:
+            raise ValueError("every row needs at least one token")
+        before = []
+        for sid, k, n in zip(seq_ids, ks, ns):
+            if cache.length(sid) != int(k):
+                raise ValueError(
+                    f"sequence {sid!r} is at length {cache.length(sid)}, "
+                    f"expected the cached context length {k}")
+            if int(k) + n > self.max_position:
+                raise ValueError(
+                    f"context {k} + chunk {n} exceeds "
+                    f"max_position_embeddings ({self.max_position})")
+            before.append(int(k))
+            cache.allocate(sid, n)
+        # never pad past the rope table when the bucket round-up is
+        # what crosses it: clamp the bucket by the deepest context,
+        # the SAME ``min(next_pow2(s), max_position - k)`` discipline
+        # as _context_prefill — falling all the way back to the raw
+        # max(ns) would trace a fresh prefix program per distinct
+        # chunk length on the MTTR-critical recovery path.  With MIXED
+        # context lengths a shallow-context row can still force
+        # s_b > max_position - k for a DEEPER row (each row alone
+        # validated k + n <= max_position) — that row's pad positions
+        # gather CLAMPED rope angles, which is safe by construction:
+        # pad K/V scatters to the dropped out-of-bounds page, pad
+        # columns are causality-masked, and pad rows' outputs are
+        # discarded (last_idx picks the real last token) — but nothing
+        # downstream may ever start reading pad-position outputs.
+        s_b = max(max(ns),
+                  min(next_pow2(max(ns)),
+                      self.max_position - max(int(k) for k in ks)))
+        # the BATCH dimension buckets too (the decode path's
+        # discipline): recovery waves of 3 and 4 survivors must share
+        # one compiled (b, s_b, W) shape, not trace a fresh prefix
+        # program per distinct survivor count on the MTTR-critical
+        # path.  Pad rows have no sequence: their scatters drop on the
+        # out-of-bounds page, plens 0 masks every prefix column, and
+        # their outputs are sliced off before returning.
+        b_b = next_pow2(b)
+        ids = np.zeros((b_b, s_b), np.int32)
+        pg = np.full((b_b, s_b), cache.total_pages, np.int32)  # drop
+        sl = np.zeros((b_b, s_b), np.int32)
+        for i, (sid, row, n) in enumerate(zip(seq_ids, rows, ns)):
+            ids[i, :n] = np.asarray(row, np.int32)
+            rpg, rsl = cache.plan_write([sid], n)
+            pg[i, :n] = rpg
+            sl[i, :n] = rsl
+            cache.advance([sid], n)
+        n_pre = max(1, max(-(-int(k) // cache.page_size) for k in ks))
+        W = max(next_pow2(n_pre), self.min_table_pages)
+        ptabs = np.zeros((b_b, W), np.int32)
+        for i, (sid, k) in enumerate(zip(seq_ids, ks)):
+            npg = -(-int(k) // cache.page_size)
+            ptabs[i, :npg] = cache._seq_pages[sid][:npg]
+        plens = np.zeros(b_b, np.int32)
+        plens[:b] = np.asarray(ks, np.int32)
+        last_idx = np.zeros(b_b, np.int32)
+        last_idx[:b] = np.asarray([n - 1 for n in ns], np.int32)
+        if sampling is not None and b_b != b:
+            seeds, ctrs, temps, flags = sampling
+            pad = b_b - b
+            sampling = (
+                np.concatenate([np.asarray(seeds, np.uint32),
+                                np.zeros(pad, np.uint32)]),
+                np.concatenate([np.asarray(ctrs, np.int32),
+                                np.zeros(pad, np.int32)]),
+                np.concatenate([np.asarray(temps, np.float32),
+                                np.ones(pad, np.float32)]),
+                np.concatenate([np.asarray(flags, bool),
+                                np.zeros(pad, bool)]))
+        sample, s_args = self._sampling_args(sampling)
+        out = self._dispatch_prefix(
+            cache, seq_ids, before, sample, s_args,
+            ids, last_idx, pg.reshape(-1), sl.reshape(-1), ptabs, plens)
+        return out[:b]
 
     @staticmethod
     def _verify_sampling_args(sampling):
@@ -683,19 +933,18 @@ class JittedPagedDecoder:
         sample, s_args = self._verify_sampling_args(sampling)
         try:
             _maybe_lose_buffers(cache, seq_ids)
-            out, accept, k_pages, v_pages = self._program(
+            out, accept, *pools = self._program(
                 "verify", sample)(
-                [p._data for p in self.params],
+                self._param_arrays(),
                 jnp.asarray(block_np.astype(np.int32)),
                 jnp.asarray(positions_np.astype(np.int32)),
                 jnp.asarray(pg), jnp.asarray(sl), lens, tabs, s_args,
-                tuple(cache.k_pages), tuple(cache.v_pages))
+                *self._pool_args(cache), self._wscale_args())
         except BaseException:
             self._recover_pools(cache)
             self._rollback_lengths(cache, seq_ids, before)
             raise
-        cache.k_pages = list(k_pages)
-        cache.v_pages = list(v_pages)
+        self._store_pools(cache, *pools)
         return np.asarray(out), np.asarray(accept)
 
     def _build_multi(self):
@@ -709,17 +958,16 @@ class JittedPagedDecoder:
         from jax import lax
 
         def multi_fn(param_arrays, tokens0, pg_steps, sl_steps, pos_steps,
-                     tables, k_pages, v_pages):
-            saved = [p._data for p in self.params]
+                     tables, k_pages, v_pages, k_scales, v_scales,
+                     wscales):
+            saved = self._swap_params(param_arrays, wscales)
             try:
-                for p, a in zip(self.params, param_arrays):
-                    p._data = a
-
                 def body(carry, xs):
-                    toks, kp, vp = carry
+                    toks, kp, vp, ksc, vsc = carry
                     pg, sl, pos = xs
-                    ctx = _TracedPagedContext(list(kp), list(vp), pg, sl,
-                                              pos + 1, tables)
+                    ctx = _TracedPagedContext(
+                        list(kp), list(vp), pg, sl, pos + 1, tables,
+                        k_scales=ksc, v_scales=vsc)
                     with no_grad():
                         hidden = self.model.model(
                             wrap_array(toks[:, None]), pos, paged_ctx=ctx)
@@ -727,18 +975,21 @@ class JittedPagedDecoder:
                     nxt = jnp.argmax(
                         logits._data[:, -1].astype(jnp.float32),
                         axis=-1).astype(jnp.int32)
-                    return ((nxt, tuple(ctx.k_pages), tuple(ctx.v_pages)),
+                    return ((nxt, tuple(ctx.k_pages), tuple(ctx.v_pages),
+                             tuple(ctx.k_scales or ()),
+                             tuple(ctx.v_scales or ())),
                             nxt)
 
-                (last, kp, vp), toks = lax.scan(
-                    body, (tokens0, tuple(k_pages), tuple(v_pages)),
+                (last, kp, vp, ksc, vsc), toks = lax.scan(
+                    body,
+                    (tokens0, tuple(k_pages), tuple(v_pages),
+                     tuple(k_scales), tuple(v_scales)),
                     (pg_steps, sl_steps, pos_steps))
-                return toks, kp, vp
+                return toks, kp, vp, ksc, vsc
             finally:
-                for p, s in zip(self.params, saved):
-                    p._data = s
+                self._restore_params(saved)
 
-        return jax.jit(multi_fn, donate_argnums=(6, 7))
+        return jax.jit(multi_fn, donate_argnums=(6, 7, 8, 9))
 
     def multi_step(self, cache: PagedKVCache, seq_ids, tokens_np,
                    positions_np, n_steps: int) -> np.ndarray:
@@ -774,12 +1025,12 @@ class JittedPagedDecoder:
                                    self.min_table_pages))
         try:
             _maybe_lose_buffers(cache, seq_ids)
-            toks, k_pages, v_pages = self._jitted_multi(
-                [p._data for p in self.params],
+            toks, *pools = self._jitted_multi(
+                self._param_arrays(),
                 jnp.asarray(tokens_np.astype(np.int32)),
                 jnp.asarray(pg_steps), jnp.asarray(sl_steps),
                 jnp.asarray(pos_steps), tabs,
-                tuple(cache.k_pages), tuple(cache.v_pages))
+                *self._pool_args(cache), self._wscale_args())
         except BaseException:
             # same contract as step()/verify(): rebuild the donated
             # pools only if they were actually consumed, and roll the
@@ -789,8 +1040,7 @@ class JittedPagedDecoder:
             self._recover_pools(cache)
             self._rollback_lengths(cache, seq_ids, before)
             raise
-        cache.k_pages = list(k_pages)
-        cache.v_pages = list(v_pages)
+        self._store_pools(cache, *pools)
         return np.asarray(toks).T                        # (batch, n)
 
     def step(self, cache: PagedKVCache, seq_ids, tokens_np,
@@ -824,11 +1074,11 @@ class JittedPagedDecoder:
         sample, s_args = self._sampling_args(sampling)
         try:
             _maybe_lose_buffers(cache, seq_ids)
-            out, k_pages, v_pages = self._program("decode", sample)(
-                [p._data for p in self.params],
+            out, *pools = self._program("decode", sample)(
+                self._param_arrays(),
                 jnp.asarray(tokens_np), jnp.asarray(positions_np),
                 jnp.asarray(pg), jnp.asarray(sl), lens, tabs, s_args,
-                tuple(cache.k_pages), tuple(cache.v_pages))
+                *self._pool_args(cache), self._wscale_args())
         except BaseException:
             # the pools were DONATED: after a mid-step failure (e.g.
             # device OOM) they may be invalidated — rebuild them so the
@@ -839,8 +1089,7 @@ class JittedPagedDecoder:
             self._recover_pools(cache)
             self._rollback_lengths(cache, seq_ids, before)
             raise
-        cache.k_pages = list(k_pages)
-        cache.v_pages = list(v_pages)
+        self._store_pools(cache, *pools)
         return np.asarray(out)
 
 
@@ -865,12 +1114,15 @@ class PagedGenerator:
         out_ids = gen.generate(input_ids, max_new_tokens=64)
     """
 
-    def __init__(self, model, total_pages: int = 256, page_size: int = 16):
+    def __init__(self, model, total_pages: int = 256, page_size: int = 16,
+                 quantize: Optional[str] = None,
+                 kv_dtype: Optional[str] = None):
         self.model = model
         self._next_seq = 0
         self.cache = PagedKVCache.from_model(
-            model, total_pages=total_pages, page_size=page_size)
-        self._decoder = JittedPagedDecoder(model)
+            model, total_pages=total_pages, page_size=page_size,
+            kv_dtype=kv_dtype)
+        self._decoder = JittedPagedDecoder(model, quantize=quantize)
         # per-phase wall times of the last generate() call, so callers
         # (bench, schedulers) can split prefill from steady-state decode
         # without a second subtraction run

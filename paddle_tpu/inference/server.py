@@ -276,6 +276,14 @@ class GenerationServer(_ServerLifecycle):
     class-aware ``Retry-After``; ``/health`` reports per-class queue
     depths and the active policy knobs under ``"scheduler"``.
 
+    Quantized serving (ISSUE 9): ``quantize="w8"|"w8a8"`` runs the
+    compiled decode/prefill/chunk/verify programs with int8 weights
+    (scales traced, calibrated through the PTQ observers);
+    ``kv_quant="int8"`` stores KV pages int8 with fused
+    quantize-on-append / dequant-in-kernel — roughly 4x (f32) or 2x
+    (bf16) the concurrent sequences per pool byte.  ``/health``
+    reports both modes plus resident KV byte accounting.
+
     Crash consistency (ISSUE 8): with ``snapshot_path`` set, SIGTERM
     (via ``attach_preemption``) first journals every in-flight request
     — ``engine.snapshot()`` written atomically to the path — and THEN
@@ -302,7 +310,10 @@ class GenerationServer(_ServerLifecycle):
                  scheduler_classes=None,
                  min_table_pages: int = 1,
                  snapshot_path: Optional[str] = None,
-                 preempt_resume_ttl_s: Optional[float] = None):
+                 preempt_resume_ttl_s: Optional[float] = None,
+                 quantize: Optional[str] = None,
+                 kv_quant: Optional[str] = None,
+                 replay_batch: Optional[bool] = None):
         from .continuous import (ContinuousBatchingEngine,
                                  DeadlineExceeded, EngineDraining,
                                  EngineSaturated)
@@ -318,7 +329,9 @@ class GenerationServer(_ServerLifecycle):
             prefill_chunk_tokens=prefill_chunk_tokens,
             scheduler_classes=scheduler_classes,
             min_table_pages=min_table_pages,
-            preempt_resume_ttl_s=preempt_resume_ttl_s)
+            preempt_resume_ttl_s=preempt_resume_ttl_s,
+            quantize=quantize, kv_quant=kv_quant,
+            replay_batch=replay_batch)
         self._count_lock = threading.Lock()
         self._request_count = 0
         self._drain_thread: Optional[threading.Thread] = None
@@ -357,6 +370,14 @@ class GenerationServer(_ServerLifecycle):
                             # the WFQ/chunking configuration off a
                             # live replica
                             "scheduler": outer._engine.scheduler_info(),
+                            # quantized serving (ISSUE 9): the modes an
+                            # operator reads off a live replica, plus
+                            # the resident-KV byte accounting capacity
+                            # planning needs
+                            "quantize": outer._engine.quantize,
+                            "kv_quant": outer._engine.kv_quant,
+                            "kv_pool_bytes": cache.kv_pool_bytes,
+                            "kv_scale_bytes": cache.kv_scale_bytes,
                             "speculative": outer._engine._spec}
                         if outer._snapshot_path:
                             payload.update({

@@ -27,6 +27,14 @@ class Linear(Layer):
             (out_features,), attr=bias_attr, is_bias=True)
 
     def forward(self, x):
+        q = getattr(self, "_serving_quant", None)
+        if q is not None:
+            # quantized-serving trace (ISSUE 9): the paged decoder
+            # swapped an int8 weight into this layer and carries the
+            # per-out-channel scale as a traced value in q — only ever
+            # set inside its compiled programs, cleared on exit
+            from ...ops.pallas.quant_matmul import quant_linear_forward
+            return quant_linear_forward(self, x, q)
         return F.linear(x, self.weight, self.bias)
 
     def extra_repr(self):

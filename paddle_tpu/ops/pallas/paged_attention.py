@@ -41,17 +41,53 @@ from .flash_attention import DEFAULT_MASK_VALUE, _use_pallas
 from ...testing import faults as _faults
 
 
+# -------------------------------------------------------- int8 KV quant
+def quantize_kv(x):
+    """Symmetric int8 quantization for KV appends (ISSUE 9): per-token,
+    per-head absmax over the head_dim axis.  x (..., d) float ->
+    (q int8 (..., d), scale f32 (..., 1)).  Scales are per-SLOT because
+    pages are append-only: a per-page scale would have to grow when a
+    later token's absmax exceeds the page's, silently corrupting the
+    already-stored int8 values of earlier tokens.
+
+    ONE symmetric-int8 rule for the whole tree: this delegates to
+    ``quant_matmul.dynamic_act_quant`` so the engine's round-trip
+    exactness contracts can never drift between the KV and activation
+    quantizers."""
+    from .quant_matmul import dynamic_act_quant
+    return dynamic_act_quant(x)
+
+
+def dequantize_kv(q, scale, dtype):
+    """Invert :func:`quantize_kv`: int8 values x broadcast f32 scales,
+    cast back to the cache's compute ``dtype``.  The ONE dequant rule
+    every consumer shares — the paged-attention gathers, the traced
+    scatter's returned values, and prefill's round-trip fake-quant —
+    so 'attention sees exactly what the pages hold' can never drift
+    between sites."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
 # ------------------------------------------------------------------ kernel
-def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, page_size,
-                   n_query=1, group=1):
+def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, *rest,
+                   scale, page_size, n_query=1, group=1,
+                   quantized=False):
     """Online-softmax paged attention for ``n_query`` query tokens per
     sequence.  ``n_query == 1`` is the classic decode step; n_query > 1
     is the RAGGED MULTI-QUERY verify path (speculative decoding): the
     block's tokens are already scattered into the pages, ``lens`` counts
     them, and query ``s`` of the block attends causally to
     ``cols < length - (n_query - 1 - s)`` — per-row, per-query limits,
-    so variable accept lengths cost masking, not padding."""
+    so variable accept lengths cost masking, not padding.
+
+    ``quantized`` (ISSUE 9): the K/V page blocks arrive as INT8 with
+    per-slot f32 scale blocks riding alongside — dequantization happens
+    here in VMEM right before the MXU dots, so full-precision KV never
+    round-trips HBM (the whole point of the int8 storage mode)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     b = pl.program_id(0)
     p = pl.program_id(2)
     n_pages = pl.num_programs(2)
@@ -69,6 +105,14 @@ def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, o_ref,
     def _compute():
         q = q_ref[0, 0]                         # (n_query*group, d)
         k = k_ref[0, 0]                         # (page_size, d)
+        if quantized:
+            # per-slot dequant in VMEM: int8 page * (page_size, 1)
+            # scale, ROUNDED through the compute dtype — the same
+            # dequantize_kv rule every other consumer applies, so a
+            # bf16 model's decode sees bit-identical K/V to what
+            # prefill's fake-quant round-trip and the XLA gathers
+            # produced (the exactness invariant)
+            k = (k.astype(jnp.float32) * ks_ref[0, 0]).astype(q.dtype)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         cols = p * page_size + lax.broadcasted_iota(
@@ -87,9 +131,16 @@ def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[:] = jnp.broadcast_to(
             alpha * l_scr[:, :1] + jnp.sum(pexp, axis=1, keepdims=True),
             l_scr.shape)
+        if quantized:
+            # same rounding rule as k above, then the SAME dot the
+            # full-precision path runs on its pages
+            v = (v_ref[0, 0].astype(jnp.float32)
+                 * vs_ref[0, 0]).astype(q.dtype)
+        else:
+            v = v_ref[0, 0]
         acc_scr[:] = acc_scr[:] * alpha + lax.dot_general(
-            pexp.astype(v_ref.dtype), v_ref[0, 0],
-            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_next, m_scr.shape)
 
     @pl.when(p == n_pages - 1)
@@ -100,9 +151,11 @@ def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _decode_pallas(q, k_pages, v_pages, lengths, page_tables, scale,
-                   interpret=False, n_query=1):
+                   interpret=False, n_query=1, k_scales=None,
+                   v_scales=None):
     """``q`` is (batch, q_heads, d) for n_query == 1, else
-    (batch, n_query, q_heads, d)."""
+    (batch, n_query, q_heads, d).  ``k_scales``/``v_scales``
+    (kv_heads, total_pages, page_size, 1) f32 mark the int8 KV mode."""
     if n_query == 1:
         batch, q_heads, d = q.shape
     else:
@@ -124,20 +177,33 @@ def _decode_pallas(q, k_pages, v_pages, lengths, page_tables, scale,
         q4 = q.reshape(batch, n_query, kv_heads, group, d) \
              .transpose(0, 2, 1, 3, 4).reshape(batch, kv_heads, rows, d)
 
+    quantized = k_scales is not None
     kernel = functools.partial(_decode_kernel, scale=scale,
                                page_size=page_size, n_query=n_query,
-                               group=group)
+                               group=group, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, 1, rows, d),
+                     lambda b, h, p, lens, tabs: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, page_size, d),
+                     lambda b, h, p, lens, tabs: (h, tabs[b, p], 0, 0)),
+        pl.BlockSpec((1, 1, page_size, d),
+                     lambda b, h, p, lens, tabs: (h, tabs[b, p], 0, 0)),
+    ]
+    inputs = [lengths, page_tables, q4, k_pages, v_pages]
+    if quantized:
+        # the per-slot scale blocks pipeline through the SAME
+        # table-indexed DMA as their pages
+        in_specs += [
+            pl.BlockSpec((1, 1, page_size, 1),
+                         lambda b, h, p, lens, tabs: (h, tabs[b, p], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, 1),
+                         lambda b, h, p, lens, tabs: (h, tabs[b, p], 0, 0)),
+        ]
+        inputs += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,          # lengths, page_tables
         grid=(batch, kv_heads, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, 1, rows, d),
-                         lambda b, h, p, lens, tabs: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d),
-                         lambda b, h, p, lens, tabs: (h, tabs[b, p], 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d),
-                         lambda b, h, p, lens, tabs: (h, tabs[b, p], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, rows, d),
                                lambda b, h, p, lens, tabs: (b, h, 0, 0)),
         scratch_shapes=[
@@ -154,28 +220,43 @@ def _decode_pallas(q, k_pages, v_pages, lengths, page_tables, scale,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(lengths, page_tables, q4, k_pages, v_pages)
+    )(*inputs)
     if n_query == 1:
         return out.reshape(batch, q_heads, d)
     return out.reshape(batch, kv_heads, n_query, group, d) \
         .transpose(0, 2, 1, 3, 4).reshape(batch, n_query, q_heads, d)
 
 
-def _decode_xla(q, k_pages, v_pages, lengths, page_tables, scale):
+def _gather_dequant(pages, scales, page_tables, batch, kv_heads,
+                    max_tokens, last, out_dtype):
+    """Gather table-indexed pages to (batch, kv_heads, T, last); with
+    ``scales`` (the int8 KV mode) dequantize per slot right after the
+    gather — the XLA-fallback twin of the kernel's in-VMEM dequant."""
+    def g(pool, width):
+        got = jnp.take(pool, page_tables, axis=1)
+        return got.transpose(1, 0, 2, 3, 4).reshape(
+            batch, kv_heads, max_tokens, width)
+
+    out = g(pages, last)
+    if scales is not None:
+        return dequantize_kv(out, g(scales, 1), out_dtype)
+    return out.astype(out_dtype)
+
+
+def _decode_xla(q, k_pages, v_pages, lengths, page_tables, scale,
+                k_scales=None, v_scales=None):
     """Gather + dense masked attention (CPU fallback / correctness ref)."""
     batch, q_heads, d = q.shape
     kv_heads, _tot, page_size, _d = k_pages.shape
     group = q_heads // kv_heads
     max_tokens = page_tables.shape[1] * page_size
 
-    # (kv_heads, batch, max_pages, page_size, d) -> (batch, kv_heads, T, d)
-    def gather(pages):
-        g = jnp.take(pages, page_tables, axis=1)
-        return g.transpose(1, 0, 2, 3, 4).reshape(
-            batch, kv_heads, max_tokens, d)
+    def gather(pages, scales):
+        return _gather_dequant(pages, scales, page_tables, batch,
+                               kv_heads, max_tokens, d, q.dtype)
 
-    k = gather(k_pages)
-    v = gather(v_pages)
+    k = gather(k_pages, k_scales)
+    v = gather(v_pages, v_scales)
     if group != 1:
         k = jnp.repeat(k, group, axis=1)
         v = jnp.repeat(v, group, axis=1)
@@ -187,7 +268,8 @@ def _decode_xla(q, k_pages, v_pages, lengths, page_tables, scale):
     return jnp.einsum("bhk,bhkd->bhd", p.astype(v.dtype), v).astype(q.dtype)
 
 
-def _multi_xla(q, k_pages, v_pages, lengths, page_tables, scale):
+def _multi_xla(q, k_pages, v_pages, lengths, page_tables, scale,
+               k_scales=None, v_scales=None):
     """Gather + dense masked multi-query attention (CPU fallback /
     correctness reference for the ragged verify path)."""
     batch, n_query, q_heads, d = q.shape
@@ -195,13 +277,12 @@ def _multi_xla(q, k_pages, v_pages, lengths, page_tables, scale):
     group = q_heads // kv_heads
     max_tokens = page_tables.shape[1] * page_size
 
-    def gather(pages):
-        g = jnp.take(pages, page_tables, axis=1)
-        return g.transpose(1, 0, 2, 3, 4).reshape(
-            batch, kv_heads, max_tokens, d)
+    def gather(pages, scales):
+        return _gather_dequant(pages, scales, page_tables, batch,
+                               kv_heads, max_tokens, d, q.dtype)
 
-    k = gather(k_pages)
-    v = gather(v_pages)
+    k = gather(k_pages, k_scales)
+    v = gather(v_pages, v_scales)
     if group != 1:
         k = jnp.repeat(k, group, axis=1)
         v = jnp.repeat(v, group, axis=1)
@@ -221,7 +302,7 @@ def _multi_xla(q, k_pages, v_pages, lengths, page_tables, scale):
 
 
 def paged_attention(q, k_pages, v_pages, lengths, page_tables, scale=None,
-                    interpret=False):
+                    interpret=False, k_scales=None, v_scales=None):
     """Decode-step attention over a paged KV cache.
 
     q:           (batch, q_heads, head_dim) — ONE new token per sequence
@@ -229,17 +310,24 @@ def paged_attention(q, k_pages, v_pages, lengths, page_tables, scale=None,
     lengths:     (batch,) int32 — valid cached tokens per sequence
                  (including the current token, already written to pages)
     page_tables: (batch, max_pages_per_seq) int32
+    k/v_scales:  (kv_heads, total_pages, page_size, 1) f32 — present
+                 when the pages store INT8 KV (ISSUE 9): dequant is
+                 fused into the kernel (or the gather on the XLA path),
+                 so full-precision KV never round-trips HBM.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if _use_pallas() or interpret:
         return _decode_pallas(q, k_pages, v_pages, lengths, page_tables,
-                              scale, interpret=interpret)
-    return _decode_xla(q, k_pages, v_pages, lengths, page_tables, scale)
+                              scale, interpret=interpret,
+                              k_scales=k_scales, v_scales=v_scales)
+    return _decode_xla(q, k_pages, v_pages, lengths, page_tables, scale,
+                       k_scales=k_scales, v_scales=v_scales)
 
 
 def paged_attention_multi(q, k_pages, v_pages, lengths, page_tables,
-                          scale=None, interpret=False):
+                          scale=None, interpret=False, k_scales=None,
+                          v_scales=None):
     """Ragged MULTI-QUERY decode attention: ``n_query`` new tokens per
     sequence in one pass — the speculative-decoding verify step's
     attention ("Ragged Paged Attention" shape: [B, k] queries against
@@ -259,13 +347,16 @@ def paged_attention_multi(q, k_pages, v_pages, lengths, page_tables,
     if q.shape[1] == 1:
         out = paged_attention(q[:, 0], k_pages, v_pages, lengths,
                               page_tables, scale=scale,
-                              interpret=interpret)
+                              interpret=interpret, k_scales=k_scales,
+                              v_scales=v_scales)
         return out[:, None]
     if _use_pallas() or interpret:
         return _decode_pallas(q, k_pages, v_pages, lengths, page_tables,
                               scale, interpret=interpret,
-                              n_query=q.shape[1])
-    return _multi_xla(q, k_pages, v_pages, lengths, page_tables, scale)
+                              n_query=q.shape[1], k_scales=k_scales,
+                              v_scales=v_scales)
+    return _multi_xla(q, k_pages, v_pages, lengths, page_tables, scale,
+                      k_scales=k_scales, v_scales=v_scales)
 
 
 # ------------------------------------------------------------- page cache
@@ -314,28 +405,49 @@ class PagedKVCache:
 
     @classmethod
     def from_model(cls, model, total_pages: int = 256,
-                   page_size: int = 16) -> "PagedKVCache":
+                   page_size: int = 16,
+                   kv_dtype: Optional[str] = None) -> "PagedKVCache":
         """Cache sized for a causal-LM model's config (single wiring
-        point shared by PagedGenerator and ContinuousBatchingEngine)."""
+        point shared by PagedGenerator and ContinuousBatchingEngine).
+        ``kv_dtype="int8"`` selects the quantized storage mode."""
         c = model.config
         return cls(
             num_layers=c.num_hidden_layers,
             kv_heads=c.num_key_value_heads,
             head_dim=c.hidden_size // c.num_attention_heads,
             total_pages=total_pages, page_size=page_size,
-            dtype=model.model.embed_tokens.weight._data.dtype)
+            dtype=model.model.embed_tokens.weight._data.dtype,
+            kv_dtype=kv_dtype)
 
     def __init__(self, num_layers: int, kv_heads: int, head_dim: int,
                  total_pages: int = 256, page_size: int = 16,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, kv_dtype: Optional[str] = None):
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
         self.num_layers = num_layers
         self.kv_heads = kv_heads
         self.head_dim = head_dim
         self.page_size = page_size
         self.total_pages = total_pages
+        # int8 KV mode (ISSUE 9): pages store int8 values with a
+        # parallel per-slot scale pool; ``compute_dtype`` is what the
+        # attention kernels dequantize toward (the model's dtype)
+        self.kv_quant = kv_dtype == "int8"
+        self.compute_dtype = dtype
+        store = jnp.int8 if self.kv_quant else dtype
         shape = (kv_heads, total_pages, page_size, head_dim)
-        self.k_pages = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
-        self.v_pages = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        sshape = (kv_heads, total_pages, page_size, 1)
+        self.k_pages = [jnp.zeros(shape, store) for _ in range(num_layers)]
+        self.v_pages = [jnp.zeros(shape, store) for _ in range(num_layers)]
+        if self.kv_quant:
+            self.k_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(num_layers)]
+            self.v_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(num_layers)]
+        else:
+            self.k_scales = []
+            self.v_scales = []
         self._free: List[int] = list(range(total_pages))
         self._seq_pages: Dict[int, List[int]] = {}
         self._seq_len: Dict[int, int] = {}
@@ -454,11 +566,20 @@ class PagedKVCache:
         self.generation += 1
         shape = (self.kv_heads, self.total_pages, self.page_size,
                  self.head_dim)
-        dtype = self.k_pages[0].dtype if self.k_pages else jnp.float32
+        dtype = jnp.int8 if self.kv_quant else self.compute_dtype
         self.k_pages = [jnp.zeros(shape, dtype)
                         for _ in range(self.num_layers)]
         self.v_pages = [jnp.zeros(shape, dtype)
                         for _ in range(self.num_layers)]
+        if self.kv_quant:
+            # the scale pools are part of the KV state: a rebuild zeroes
+            # them too, and the survivor replay re-registers each page's
+            # scales alongside its int8 values
+            sshape = (self.kv_heads, self.total_pages, self.page_size, 1)
+            self.k_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(self.num_layers)]
+            self.v_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(self.num_layers)]
         while self._prefix_index:
             _, entry = self._prefix_index.popitem(last=False)
             for p in entry.pages:
@@ -552,6 +673,27 @@ class PagedKVCache:
             added += 1
         return added
 
+    def _device_pools(self):
+        """Every device buffer backing the cache — data pages plus (in
+        the int8 mode) the parallel scale pools.  The buffer-loss fault
+        site deletes these; ``_recover_pools`` probes them for
+        deadness."""
+        return (list(self.k_pages) + list(self.v_pages)
+                + list(self.k_scales) + list(self.v_scales))
+
+    @property
+    def kv_pool_bytes(self) -> int:
+        """Resident bytes of the KV data pages across all layers."""
+        return sum(int(a.size) * a.dtype.itemsize
+                   for a in list(self.k_pages) + list(self.v_pages))
+
+    @property
+    def kv_scale_bytes(self) -> int:
+        """Resident bytes of the int8 mode's scale pools (0 when the
+        cache stores full-precision KV)."""
+        return sum(int(a.size) * a.dtype.itemsize
+                   for a in list(self.k_scales) + list(self.v_scales))
+
     @property
     def pinned_pages(self) -> int:
         """Pages currently mapped by at least one live sequence."""
@@ -638,11 +780,22 @@ class PagedKVCache:
         pg = jnp.asarray(pages_flat)
         sl = jnp.asarray(slots_flat)
         # (b, n, kvh, d) -> (kvh, b*n, d) to line up with pool[:, pg, sl]
-        kv_flat = (jnp.reshape(k_new, (b * n,) + k_new.shape[2:]),
-                   jnp.reshape(v_new, (b * n,) + v_new.shape[2:]))
-        self.k_pages[layer] = _scatter_pages(
-            self.k_pages[layer], pg, sl, jnp.swapaxes(kv_flat[0], 0, 1))
-        self.v_pages[layer] = _scatter_pages(
-            self.v_pages[layer], pg, sl, jnp.swapaxes(kv_flat[1], 0, 1))
+        ks = jnp.swapaxes(
+            jnp.reshape(k_new, (b * n,) + k_new.shape[2:]), 0, 1)
+        vs = jnp.swapaxes(
+            jnp.reshape(v_new, (b * n,) + v_new.shape[2:]), 0, 1)
+        if self.kv_quant:
+            # quantize fused into the append (eager twin of the traced
+            # context's in-program scatter)
+            ks, ksc = quantize_kv(ks)
+            vs, vsc = quantize_kv(vs)
+            self.k_scales[layer] = _scatter_pages(
+                self.k_scales[layer], pg, sl, ksc)
+            self.v_scales[layer] = _scatter_pages(
+                self.v_scales[layer], pg, sl, vsc)
+        self.k_pages[layer] = _scatter_pages(self.k_pages[layer], pg, sl,
+                                             ks)
+        self.v_pages[layer] = _scatter_pages(self.v_pages[layer], pg, sl,
+                                             vs)
         if layer == self.num_layers - 1:
             self.advance(seq_ids, n)

@@ -1,8 +1,11 @@
-"""Int8 weight-only matmul Pallas kernel: y = x @ (w_int8 * scale).
+"""Int8 quantized matmuls for serving: weight-only (w8) and w8a8.
 
 Reference capability: the weight-only-quantized linear the reference
 serves LLMs with (paddle/phi/kernels/fusion/gpu/fused_weight_only_linear
-family behind python/paddle/nn/quant/quantized_linear.py).
+family behind python/paddle/nn/quant/quantized_linear.py), plus the
+dynamic-per-token w8a8 path (llm_int8-style: activations quantized
+in-program with per-row absmax scales, int8 x int8 accumulated in s32
+on the MXU, dequantized once by row_scale x col_scale).
 
 Why a kernel instead of XLA's fusion: decode-time linear layers are HBM-
 bandwidth-bound, and the weight is the traffic.  This kernel streams the
@@ -111,24 +114,27 @@ def weight_only_matmul(x, w_q, scale):
     return _wo_impl(x, w_q, scale)
 
 
+def _tuned_dispatch(op, x, w_q, xla_fn, pallas_fn):
+    """Measured policy, never assumed (the autotune discipline): the
+    int8 kernels' bandwidth win is shape-dependent — tiny K/N tiles can
+    lose to XLA's fusion — so the winner per (op, shapes, dtype) is
+    timed once and cached per device.  ONE select-and-dispatch for all
+    quantized matmuls, so the tuning key format and default can never
+    drift between them."""
+    from .. import autotune as _autotune
+    key = f"{op}:{tuple(x.shape)}:{tuple(w_q.shape)}:{x.dtype}"
+    impl = _autotune.select(key, x, {"xla": xla_fn, "pallas": pallas_fn},
+                            default="pallas")
+    return xla_fn() if impl == "xla" else pallas_fn()
+
+
 def _wo_impl(x, w_q, scale):
     if not _use_pallas():
         return weight_only_matmul_xla(x, w_q, scale)
-    # measured policy, never assumed (the autotune discipline): the
-    # kernel's bandwidth win is shape-dependent — tiny K/N tiles can
-    # lose to XLA's fusion — so the winner per shape is timed once and
-    # cached per device
-    from .. import autotune as _autotune
-    key = (f"weight_only_matmul:{tuple(x.shape)}:{tuple(w_q.shape)}:"
-           f"{x.dtype}")
-    impl = _autotune.select(
-        key, x,
-        {"xla": lambda: weight_only_matmul_xla(x, w_q, scale),
-         "pallas": lambda: weight_only_matmul_pallas(x, w_q, scale)},
-        default="pallas")
-    if impl == "xla":
-        return weight_only_matmul_xla(x, w_q, scale)
-    return weight_only_matmul_pallas(x, w_q, scale)
+    return _tuned_dispatch(
+        "weight_only_matmul", x, w_q,
+        lambda: weight_only_matmul_xla(x, w_q, scale),
+        lambda: weight_only_matmul_pallas(x, w_q, scale))
 
 
 def _wo_fwd(x, w_q, scale):
@@ -147,3 +153,129 @@ def _wo_bwd(res, dy):
 
 
 weight_only_matmul.defvjp(_wo_fwd, _wo_bwd)
+
+
+# ------------------------------------------------------------------ w8a8
+def dynamic_act_quant(x):
+    """Symmetric dynamic int8 quantization over the LAST axis:
+    x (..., K) float -> (x_q int8 (..., K), scale f32 (..., 1)) with
+    scale = absmax / 127.  A row of zeros quantizes to zeros with a
+    tiny positive scale, so dequantization is exactly zero.  THE one
+    int8 rule in the tree — activations here, KV slots via
+    ``paged_attention.quantize_kv``'s delegation."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _w8a8_kernel(x_ref, w_ref, xs_ref, ws_ref, o_ref, acc_ref, *,
+                 k_steps):
+    """One (bm, bn) tile of x_q @ w_q with s32 accumulation; the row
+    and column scales apply once on the final K step (they factor out
+    of the contraction, like the weight-only kernel's scale)."""
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(pl.program_id(2) == k_steps - 1)
+    def _flush():
+        o_ref[...] = (acc_ref[...].astype(jnp.float32)
+                      * xs_ref[...].astype(jnp.float32)
+                      * ws_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def w8a8_matmul_pallas(x_q, x_scale, w_q, scale, out_dtype,
+                       block_m=128, block_n=128, block_k=512,
+                       interpret=None):
+    """x_q: [M, K] int8; x_scale: [M, 1] f32; w_q: [K, N] int8;
+    scale: [N] f32 -> [M, N] out_dtype."""
+    if interpret is None:
+        interpret = _INTERPRET
+    M, K = x_q.shape
+    N = w_q.shape[1]
+    bm = min(block_m, _ceil_to(M, 8))
+    bn = min(block_n, _ceil_to(N, 128))
+    bk = min(block_k, _ceil_to(K, 128))
+    Mp, Kp, Np = _ceil_to(M, bm), _ceil_to(K, bk), _ceil_to(N, bn)
+    if (Mp, Kp) != (M, K):
+        x_q = jnp.pad(x_q, ((0, Mp - M), (0, Kp - K)))
+    if Mp != M:
+        x_scale = jnp.pad(x_scale, ((0, Mp - M), (0, 0)))
+    if (Kp, Np) != (K, N):
+        w_q = jnp.pad(w_q, ((0, Kp - K), (0, Np - N)))
+    if Np != N:
+        scale = jnp.pad(scale, (0, Np - N))
+    s2 = scale.reshape(1, Np)
+
+    out = pl.pallas_call(
+        functools.partial(_w8a8_kernel, k_steps=Kp // bk),
+        grid=(Mp // bm, Np // bn, Kp // bk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((bm, 1), lambda i, j, k: (i, 0)),
+            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((Mp, Np), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.int32)],
+        interpret=interpret,
+    )(x_q, w_q, x_scale, s2)
+    return out[:M, :N]
+
+
+def w8a8_matmul_xla(x_q, x_scale, w_q, scale, out_dtype):
+    """XLA fallback / numerics oracle: s8 x s8 dot with s32
+    accumulation, dequantized by row_scale x col_scale."""
+    acc = jax.lax.dot_general(x_q, w_q, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * x_scale
+            * scale.astype(jnp.float32)[None, :]).astype(out_dtype)
+
+
+def w8a8_matmul(x, w_q, scale):
+    """y = dequant(quant(x) @ w_q): dynamic per-token activation
+    quantization fused in front of the int8 matmul.  x [M, K] float;
+    w_q [K, N] int8; scale [N] f32 (per-out-channel weight scales).
+    Returns [M, N] in x.dtype."""
+    x_q, x_scale = dynamic_act_quant(x)
+    if not _use_pallas():
+        return w8a8_matmul_xla(x_q, x_scale, w_q, scale, x.dtype)
+    return _tuned_dispatch(
+        "w8a8_matmul", x, w_q,
+        lambda: w8a8_matmul_xla(x_q, x_scale, w_q, scale, x.dtype),
+        lambda: w8a8_matmul_pallas(x_q, x_scale, w_q, scale, x.dtype))
+
+
+# --------------------------------------------------- serving linear hook
+def quant_linear_forward(layer, x, q):
+    """The quantized forward a ``nn.Linear`` runs while a serving
+    program traces with quantization enabled (ISSUE 9 tentpole):
+    ``layer.weight._data`` holds the int8 weight the decoder swapped in
+    and ``q = (mode, scale_tracer)`` carries the per-out-channel scale
+    as a TRACED value — never a baked const, so one compiled program
+    serves any calibration.  ``mode`` picks weight-only ("w8", the
+    int8-streaming kernel) or dynamic-per-token "w8a8"."""
+    from ...framework.dispatch import call_op
+    mode, scale = q
+    w_q = layer.weight._data
+    bias = layer.bias
+
+    def fn(xd):
+        x2 = xd.reshape(-1, xd.shape[-1])
+        if mode == "w8a8":
+            out = w8a8_matmul(x2, w_q, scale)
+        else:
+            out = weight_only_matmul(x2, w_q, scale)
+        return out.reshape(tuple(xd.shape[:-1]) + (w_q.shape[1],))
+
+    out = call_op(f"serving_quant_linear_{mode}", fn, (x,), {})
+    if bias is not None:
+        out = out + bias
+    return out

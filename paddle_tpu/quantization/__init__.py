@@ -21,10 +21,14 @@ from .quanters import (  # noqa: F401
 )
 from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401
+from .serving import (  # noqa: F401
+    SERVING_QUANT_MODES, iter_quant_linears, quantize_linear_weights,
+)
 
 __all__ = [
     "QuantConfig", "BaseQuanter", "BaseObserver", "quanter", "QAT", "PTQ",
     "AbsmaxObserver", "PerChannelAbsmaxObserver", "HistObserver",
     "KLObserver", "FakeQuanterWithAbsMaxObserver",
-    "FakeQuanterChannelWiseAbsMax",
+    "FakeQuanterChannelWiseAbsMax", "SERVING_QUANT_MODES",
+    "iter_quant_linears", "quantize_linear_weights",
 ]

@@ -1,0 +1,66 @@
+"""Serving-side PTQ entry point (ISSUE 9 tentpole): calibrate a causal
+LM's projection weights to int8 for the compiled decode/prefill hot
+path.
+
+The scales come from the SAME observer machinery the offline PTQ flow
+uses (:class:`~paddle_tpu.quantization.observers
+.PerChannelAbsmaxObserverLayer` — reference: PerChannelAbsmaxQuantizer),
+so a model calibrated through :class:`~paddle_tpu.quantization.ptq.PTQ`
+and a model quantized directly here land on identical scales.  Weights
+are symmetric per-out-channel int8 (the layout
+``weight_only_matmul``/``w8a8_matmul`` consume: q [in, out] int8,
+scale [out] f32); activations (the "a8" half of w8a8) are quantized
+DYNAMICALLY per token inside the compiled program
+(``ops.pallas.quant_matmul.dynamic_act_quant``) and need no offline
+calibration.
+
+Only the decoder-layer projections and the lm_head quantize: embedding
+tables are gathered (not matmul'd) and norm weights are 1-D — both stay
+at the model dtype.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["SERVING_QUANT_MODES", "iter_quant_linears",
+           "quantize_linear_weights"]
+
+#: weight modes the serving path understands (None = full precision)
+SERVING_QUANT_MODES = (None, "w8", "w8a8")
+
+
+def iter_quant_linears(model):
+    """Yield ``(name, layer)`` for every Linear whose weight the
+    serving path quantizes: 2-D weights reached through the model's
+    sublayer tree, skipping embeddings/norms (no matmul / 1-D)."""
+    from ..nn.layer.common import Linear
+    for name, layer in model.named_sublayers():
+        if isinstance(layer, Linear) and layer.weight is not None \
+                and len(layer.weight.shape) == 2:
+            yield name, layer
+
+
+def quantize_linear_weights(model) -> List[Tuple[object, object, object]]:
+    """Per-layer ``(layer, w_q, scale)`` for every quantizable Linear:
+    ``w_q`` int8 [in, out] on device, ``scale`` f32 [out] — symmetric
+    per-out-channel absmax via the PTQ observer.  The model's own
+    weights are untouched (the decoder swaps ``w_q`` in only inside its
+    compiled programs)."""
+    from .observers import PerChannelAbsmaxObserverLayer
+
+    out = []
+    for _name, layer in iter_quant_linears(model):
+        obs = PerChannelAbsmaxObserverLayer(layer, quant_bits=8,
+                                            quant_axis=1)
+        obs.forward(layer.weight)
+        absmax = np.asarray(obs.scales().numpy(),
+                            np.float32).reshape(-1)
+        scale = np.maximum(absmax, 1e-30) / 127.0
+        w = np.asarray(layer.weight._data, np.float32)
+        w_q = np.clip(np.round(w / scale[None, :]), -127, 127) \
+            .astype(np.int8)
+        out.append((layer, jnp.asarray(w_q), jnp.asarray(scale)))
+    return out

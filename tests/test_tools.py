@@ -205,6 +205,55 @@ class TestServeBench:
         assert out["failed_requests"] == 0
         assert out["tokens_per_sec"] > 0
 
+    def test_recovery_lane_batched_replay_cuts_dispatches(self, capsys):
+        # ISSUE 9 satellite (ROADMAP crash-consistency follow-up (c)):
+        # batched survivor replay must reconstruct the same survivors
+        # in FEWER compiled dispatches than the per-row path — the
+        # deterministic half of the MTTR-drop claim (wall-clock p50 is
+        # quoted in the JSON but not gated on shared CI hardware)
+        sb = self._load()
+        plan = json.dumps({"rules": [{"site": "buffer_loss",
+                                      "nth": 12}]})
+        argv = ["--sharers=4", "--uniques=2", f"--fault-plan={plan}"]
+        # explicit opt-in: the engine's unset default resolves to
+        # per-row on TPU (batched replay not yet hardware-verified
+        # bit-exact there) and this gate tests the batched machinery
+        assert sb.main(argv + ["--replay-batch"]) == 0
+        batched = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert sb.main(argv + ["--no-replay-batch"]) == 0
+        perrow = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert batched["replay_batch"] is True
+        assert perrow["replay_batch"] is False
+        assert batched["survivor_replays"] == perrow["survivor_replays"] \
+            >= 2
+        assert 0 < batched["replay_dispatches"] \
+            < perrow["replay_dispatches"]
+
+    def test_quant_lane_gate(self, capsys):
+        # ISSUE 9 acceptance: the int8-KV + w8 lane must admit >= 1.8x
+        # the baseline's concurrent sequences at EQUAL page-pool bytes,
+        # match greedy outputs exactly on the logits-parity path, and
+        # stay compile-free in both measured windows
+        sb = self._load()
+        assert sb.main(["--quant"]) == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert out["lane"] == "quant"
+        assert out["capacity_ratio"] >= 1.8
+        assert abs(out["pool_bytes_quant"] - out["pool_bytes_base"]) \
+            <= out["pool_bytes_base"] * 0.01     # equal-byte pools
+        assert out["greedy_exact"] is True
+        assert out["parity_matches"] == out["parity_requests"]
+        assert out["logits_max_abs_diff"] < 0.05
+        assert out["jit_recompiles"] == 0
+        # wall-clock throughput is gated by the lane only on TPU
+        # (tps_floor 1.0 there, off on CPU where the ratio is noise-
+        # dominated emulation); asserting a ratio here would gate a
+        # timing number on shared CI hardware
+        assert out["tokens_per_sec_quant"] > 0
+
 
 class TestTrainBench:
     """ISSUE 5 CI satellite: the training hot-path lane must run a tiny

@@ -39,6 +39,12 @@ REQUIRED_SERIES = (
     "engine_rebuilds_total",
     "engine_recovery_seconds",
     "snapshot_requests_total",
+    # quantized serving + batched replay (ISSUE 9)
+    "quant_enabled",
+    "kv_quant_enabled",
+    "kv_quant_pool_bytes",
+    "kv_quant_scale_bytes",
+    "replay_dispatches_total",
 )
 
 #: scheduler series (ISSUE 7, README "Scheduling & multi-tenancy") —
@@ -175,6 +181,41 @@ def run_chaos() -> dict:
         np.array_equal(g, e) for g, e in zip(got, loss_refs))
     buffer_loss_fired = any(s["fires"] for s in plan_loss.snapshot())
 
+    # quantized serving (ISSUE 9): the same donated-buffer loss on an
+    # int8-KV + w8 engine — the BATCHED survivor replay must rewrite
+    # the int8 pages AND their scale pools bit-identically (scales
+    # re-register with the pages), with fewer compiled dispatches than
+    # survivors (the batching win)
+    def run_quant(fault_plan=None):
+        import contextlib
+        ctx = (faults.installed(fault_plan) if fault_plan is not None
+               else contextlib.nullcontext())
+        # replay_batch explicit: this scenario gates the BATCHED
+        # machinery (dispatch_d < replays_d), which the engine's unset
+        # default disables on TPU; running it there exercises — and is
+        # the hardware check for — the ROADMAP bit-exactness item
+        with ctx, ContinuousBatchingEngine(
+                model, total_pages=64, page_size=8, max_batch=4,
+                quantize="w8", kv_quant="int8",
+                replay_batch=True) as eng:
+            reqs = [eng.submit(p, max_new_tokens=6) for p in loss_prompts]
+            return [r.result(timeout=600) for r in reqs]
+
+    quant_refs = run_quant()
+    snap0 = monitor.snapshot()
+    plan_qloss = faults.FaultPlan([{"site": "buffer_loss", "nth": 10}])
+    quant_got = run_quant(plan_qloss)
+    snap1 = monitor.snapshot()
+    quant_loss_exact = (
+        any(s["fires"] for s in plan_qloss.snapshot())
+        and all(np.array_equal(g, e)
+                for g, e in zip(quant_got, quant_refs)))
+    replays_d = (_value(snap1, "survivor_replays_total")
+                 - _value(snap0, "survivor_replays_total"))
+    dispatch_d = (_value(snap1, "replay_dispatches_total")
+                  - _value(snap0, "replay_dispatches_total"))
+    batched_replay_won = replays_d >= 2 and 0 < dispatch_d < replays_d
+
     # crash consistency (ISSUE 8b): snapshot mid-stream, restore onto
     # a FRESH engine, outputs bit-identical to an uninterrupted run
     snap_prompts = [rng.integers(0, 64, (5,)) for _ in range(2)]
@@ -233,6 +274,8 @@ def run_chaos() -> dict:
     out["_buffer_loss_fired"] = buffer_loss_fired
     out["_buffer_loss_exact"] = buffer_loss_exact
     out["_restore_exact"] = restore_exact
+    out["_quant_loss_exact"] = quant_loss_exact
+    out["_batched_replay_won"] = batched_replay_won
     return out
 
 
@@ -280,6 +323,10 @@ def main() -> int:
          out["_restore_exact"]),
         ("snapshot_requests_total counted the journal entries",
          out["snapshot_requests_total"] >= 2),
+        ("int8-KV survivors bit-identical after loss (scales "
+         "re-registered with the pages)", out["_quant_loss_exact"]),
+        ("batched replay amortized survivors per dispatch",
+         out["_batched_replay_won"]),
     ]
     bad = [name for name, ok in checks if not ok]
     if bad:
