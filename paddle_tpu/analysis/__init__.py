@@ -29,13 +29,22 @@ auditor's recompile rules can be checked against what really happened.
 """
 from .program_audit import (  # noqa: F401
     Finding, ProgramAudit, audit_jaxpr, audit_callable, audit_engine,
-    audit_program, HOST_TRANSFER_RULES,
+    audit_program, engine_program_spec, HOST_TRANSFER_RULES,
 )
 from . import lint  # noqa: F401
 from .lint import LintFinding, lint_paths, lint_source  # noqa: F401
+from . import cost  # noqa: F401
+from .cost import (  # noqa: F401
+    CostEstimate, estimate_jaxpr, estimate_callable, estimate_engine,
+    peak_flops, record_mfu, publish_engine_cost,
+)
 
 __all__ = [
     "Finding", "ProgramAudit", "audit_jaxpr", "audit_callable",
-    "audit_engine", "audit_program", "HOST_TRANSFER_RULES",
+    "audit_engine", "audit_program", "engine_program_spec",
+    "HOST_TRANSFER_RULES",
     "LintFinding", "lint_paths", "lint_source", "lint",
+    "cost", "CostEstimate", "estimate_jaxpr", "estimate_callable",
+    "estimate_engine", "peak_flops", "record_mfu",
+    "publish_engine_cost",
 ]

@@ -43,7 +43,8 @@ import jax.tree_util as jtu
 
 __all__ = [
     "Finding", "ProgramAudit", "audit_jaxpr", "audit_callable",
-    "audit_engine", "audit_program", "HOST_TRANSFER_RULES",
+    "audit_engine", "audit_program", "engine_program_spec",
+    "HOST_TRANSFER_RULES",
 ]
 
 SEVERITY_ERROR = "error"
@@ -552,38 +553,21 @@ def audit_callable(fn, *example_args, donate_argnums=(), static_argnums=(),
         quantized=quantized, scale_lens=scale_lens, **limits)
 
 
-def audit_engine(engine, mode: str = "decode", sample=None,
-                 per_row_budget: int = 64, publish: bool = True,
-                 **limits) -> ProgramAudit:
-    """Audit a ContinuousBatchingEngine's compiled decode or
-    speculative-verify program without running it: rebuilds the exact
-    traced function + donation contract ``JittedPagedDecoder`` jits and
-    traces it on abstract inputs shaped like a full decode batch.
+def engine_program_spec(engine, mode: str = "decode", sample=None):
+    """Rebuild a ContinuousBatchingEngine program's EXACT traced
+    function + abstract example args + donation contract, without
+    running anything — the shared tracing plumbing under
+    :func:`audit_engine` (hazard rules) and ``analysis.cost``'s
+    FLOPs/HBM estimator (ISSUE 10), so both see one call contract.
 
-    With the engine's default ``sample_on_device=True`` the program's
-    only non-donated outputs are the ``(batch,)`` int32 ids (decode) —
-    plus the ``(batch,)`` int32 accept counts for ``mode="verify"`` —
-    so the audit must report zero host-transfer findings (PR 2's
-    invariant, extended to the speculative hot path).  The verify audit
-    also proves no ``[B, k]``-shaped draft block was baked in as a
-    constant (the block rides as a traced argument) and that BOTH page
-    pools stay donated.  A QUANTIZED engine (ISSUE 9: ``quantize``
-    and/or ``kv_quant``) is certified further: donation intact on the
-    int8 page AND scale pools, int8->accumulator casts exempt from the
-    dtype-creep rule, and no scale baked in as a const
-    (``quant-scale-const``).  ``mode="chunk"`` audits the CHUNKED-PREFILL
-    continuation program (ISSUE 7; shared with the prefix-cache suffix
-    path): one chunk's token bucket rides as a traced argument with the
-    context length/table traced alongside, so the audit proves the
-    chunk loop is transfer-free with donation intact — interleaving
-    chunk sizes can never smuggle a host sync into the serving loop.
-    ``per_row_budget`` is the allowed host-transfer bytes per batch row
-    (ids are 4; ids + accept are 8; a logits row is vocab*4)."""
+    Returns ``(fn, donate_argnums, example_args, meta)`` where ``meta``
+    carries ``name`` / ``batch`` (the program's row count) /
+    ``quantized`` / ``scale_lens``."""
     import jax.numpy as jnp
     from ..inference.paged import next_pow2
 
     if mode not in ("decode", "verify", "chunk"):
-        raise ValueError(f"audit_engine supports mode='decode', "
+        raise ValueError(f"engine programs are mode='decode', "
                          f"'verify' or 'chunk', got {mode!r}")
     if mode == "verify" and not getattr(engine, "_spec", False):
         raise ValueError("mode='verify' needs an engine built with a "
@@ -658,12 +642,51 @@ def audit_engine(engine, mode: str = "decode", sample=None,
         args = (params, sds((B, 1), i32), sds((B,), i32), sds((B,), i32),
                 sds((B,), i32), sds((B,), i32), sds((B, W), i32), s_args,
                 *pools)
-    limits.setdefault("output_transfer_bytes", B * per_row_budget)
+    meta = {
+        "name": f"engine.{mode}"
+                f"[{'logits' if sample is False else sample}]",
+        "batch": B,
+        "quantized": quantized,
+        "scale_lens": scale_lens,
+    }
+    return fn, donate, args, meta
+
+
+def audit_engine(engine, mode: str = "decode", sample=None,
+                 per_row_budget: int = 64, publish: bool = True,
+                 **limits) -> ProgramAudit:
+    """Audit a ContinuousBatchingEngine's compiled decode or
+    speculative-verify program without running it: rebuilds the exact
+    traced function + donation contract ``JittedPagedDecoder`` jits and
+    traces it on abstract inputs shaped like a full decode batch
+    (:func:`engine_program_spec` is the shared rebuild).
+
+    With the engine's default ``sample_on_device=True`` the program's
+    only non-donated outputs are the ``(batch,)`` int32 ids (decode) —
+    plus the ``(batch,)`` int32 accept counts for ``mode="verify"`` —
+    so the audit must report zero host-transfer findings (PR 2's
+    invariant, extended to the speculative hot path).  The verify audit
+    also proves no ``[B, k]``-shaped draft block was baked in as a
+    constant (the block rides as a traced argument) and that BOTH page
+    pools stay donated.  A QUANTIZED engine (ISSUE 9: ``quantize``
+    and/or ``kv_quant``) is certified further: donation intact on the
+    int8 page AND scale pools, int8->accumulator casts exempt from the
+    dtype-creep rule, and no scale baked in as a const
+    (``quant-scale-const``).  ``mode="chunk"`` audits the CHUNKED-PREFILL
+    continuation program (ISSUE 7; shared with the prefix-cache suffix
+    path): one chunk's token bucket rides as a traced argument with the
+    context length/table traced alongside, so the audit proves the
+    chunk loop is transfer-free with donation intact — interleaving
+    chunk sizes can never smuggle a host sync into the serving loop.
+    ``per_row_budget`` is the allowed host-transfer bytes per batch row
+    (ids are 4; ids + accept are 8; a logits row is vocab*4)."""
+    fn, donate, args, meta = engine_program_spec(engine, mode, sample)
+    limits.setdefault("output_transfer_bytes",
+                      meta["batch"] * per_row_budget)
     return audit_callable(
-        fn, *args, donate_argnums=donate,
-        name=f"engine.{mode}[{'logits' if sample is False else sample}]",
-        publish=publish, quantized=quantized, scale_lens=scale_lens,
-        **limits)
+        fn, *args, donate_argnums=donate, name=meta["name"],
+        publish=publish, quantized=meta["quantized"],
+        scale_lens=meta["scale_lens"], **limits)
 
 
 def audit_program(program, feed, fetch_list=None, publish: bool = True,

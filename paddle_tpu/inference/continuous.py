@@ -124,12 +124,14 @@ from __future__ import annotations
 import math
 import threading
 import time
-from collections import namedtuple
+import uuid
+from collections import OrderedDict, namedtuple
 from typing import List, Optional
 
 import jax
 import numpy as np
 from .. import monitor
+from ..monitor.trace import get_tracer as _get_tracer
 from ..ops.pallas.paged_attention import PagedKVCache
 from ..testing import faults as _faults
 from .scheduler import (DEFAULT_CLASS, PriorityClass, QueueFull,
@@ -298,6 +300,23 @@ _replay_dispatches = monitor.counter(
     "replay_dispatches_total", "compiled dispatches issued by survivor-"
     "KV replay (batched replay amortizes many survivors per dispatch)")
 
+# request-level tracing (ISSUE 10): the process-wide trace buffer —
+# OFF outside a monitor.start_capture() window, when every probe below
+# is a single attribute read (the decode hot path must not notice it)
+_tracer = _get_tracer()
+
+
+def _note_quarantine(req) -> None:
+    """Count a quarantine AND stamp it on the request's trace timeline
+    (the chaos gate asserts a quarantined request's timeline carries
+    the event) — one helper so the counter and the trace can't drift
+    across the many ejection sites."""
+    _quarantined.inc()
+    _tracer.request_event(
+        getattr(req, "request_id", None), "quarantine",
+        error=(type(req.error).__name__ if req.error is not None
+               else None))
+
 #: one request's share of a speculative verify step: the bonus token
 #: (ids or the logits-row escape hatch), the device-computed accept
 #: length, and the draft tokens the host already knows (so accepted
@@ -336,7 +355,14 @@ class _Request:
 
     def __init__(self, prompt, max_new_tokens, eos_token_id, do_sample,
                  temperature, seed, ttl_s=None, queue_timeout_s=None,
-                 priority=None, tenant="default"):
+                 priority=None, tenant="default", request_id=None):
+        # request-id continuity (ISSUE 10 satellite + ROADMAP crash
+        # follow-up (a)): a stable, client-visible id — caller-supplied
+        # or server-assigned — that survives snapshot/restore, keys the
+        # bounded result cache (GET /result/<id> re-attach after a
+        # restart) and names this request's trace timeline
+        self.request_id = (str(request_id) if request_id
+                           else f"req-{uuid.uuid4().hex[:16]}")
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
@@ -494,6 +520,16 @@ class ContinuousBatchingEngine:
     requests across a process restart; ``preempt_resume_ttl_s`` bounds
     how long a preempted prefill may hold its page reservation (aging
     boost at half the TTL, reaped with pages reclaimed past it).
+
+    Observability (ISSUE 10): every request carries a stable
+    ``request_id`` (``submit(request_id=...)`` or server-assigned,
+    preserved across snapshot/restore) keying a bounded result cache
+    (:meth:`result_for` — the ``GET /result/<id>`` re-attach surface)
+    and, inside a ``monitor.start_capture()`` window, a per-request
+    event timeline + per-engine-step records exported as chrome-trace
+    JSON by ``monitor.export_chrome_trace()``.  Outside a window every
+    trace probe is one attribute read — the decode hot path does not
+    notice it.
     """
 
     def __init__(self, model, total_pages: int = 512, page_size: int = 16,
@@ -511,7 +547,8 @@ class ContinuousBatchingEngine:
                  preempt_resume_ttl_s: Optional[float] = None,
                  quantize: Optional[str] = None,
                  kv_quant: Optional[str] = None,
-                 replay_batch: Optional[bool] = None):
+                 replay_batch: Optional[bool] = None,
+                 result_cache_size: int = 256):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_position = int(model.config.max_position_embeddings)
@@ -627,6 +664,12 @@ class ContinuousBatchingEngine:
         self._active: List[_Request] = []
         self._prefilling: List[_Request] = []
         self._preempted: List[_Request] = []
+        # request-id continuity (ISSUE 10 satellite): finished requests'
+        # outputs/errors, keyed by request_id, bounded FIFO — a client
+        # that lost its HTTP stream (timeout, server restart) re-attaches
+        # via result_for() / GET /result/<id>
+        self.result_cache_size = max(0, int(result_cache_size))
+        self._results: "OrderedDict[str, dict]" = OrderedDict()
         self._cond = threading.Condition()
         self._stop = False
         self._draining = False
@@ -640,6 +683,9 @@ class ContinuousBatchingEngine:
         # snap_waiters implement the snapshot() quiesce barrier
         self._pool_gen = self.cache.generation + (
             self.draft_cache.generation if self._spec else 0)
+        # trace support (ISSUE 10): the last executed step's speculative
+        # economics, read by the step-ring record (scheduler-thread only)
+        self._last_spec = (0, 0)
         self._wedged = threading.Event()
         self._stepping = False
         self._snap_waiters = 0
@@ -671,6 +717,7 @@ class ContinuousBatchingEngine:
                draft: Optional[bool] = None,
                priority: Optional[str] = None,
                tenant: str = "default",
+               request_id: Optional[str] = None,
                _restore: Optional[dict] = None) -> _Request:
         """``draft``: speculative-decoding opt-in for this request.
         ``None`` (default) speculates whenever the engine has a draft
@@ -681,7 +728,12 @@ class ContinuousBatchingEngine:
         ``priority`` names a scheduling class (``None`` -> the engine's
         default class; unknown names raise ValueError — a client
         mistake, not a capacity problem); ``tenant`` is a free-form
-        tenant id fair-queued within the class."""
+        tenant id fair-queued within the class.
+
+        ``request_id`` (ISSUE 10): a stable client-visible id — the
+        handle for ``result_for()`` re-attach and the request's trace
+        timeline; auto-assigned (``req-<hex>``) when omitted, carried
+        verbatim across snapshot/restore."""
         # validate the class BEFORE any capacity checks: an unknown
         # class must 400, never 429/503
         pclass = self._sched.resolve(priority)
@@ -691,7 +743,8 @@ class ContinuousBatchingEngine:
                        queue_timeout_s=(self.default_queue_timeout_s
                                         if queue_timeout_s is None
                                         else queue_timeout_s),
-                       priority=pclass.name, tenant=tenant)
+                       priority=pclass.name, tenant=tenant,
+                       request_id=request_id)
         if _restore is not None:
             # snapshot restore (ISSUE 8): preload the journaled
             # generation state BEFORE the request becomes visible to
@@ -778,6 +831,19 @@ class ContinuousBatchingEngine:
                     "requests")
             if self._stop:
                 raise RuntimeError("engine stopped")
+            if request_id is not None:
+                # a pinned id may be REUSED after the original request
+                # finished (deliberate resubmit overwrites the result
+                # cache) but never while it is live: admitting a second
+                # stream under the same id would interleave two
+                # lifecycles in one trace timeline and make
+                # /result/<id> race whichever finished last
+                live = (self._active + self._prefilling
+                        + self._preempted + self._sched.pending())
+                if any(r.request_id == req.request_id for r in live):
+                    raise ValueError(
+                        f"request_id {req.request_id!r} is already "
+                        "live; poll GET /result/<id> or pick a new id")
             try:
                 self._sched.push(req)
             except QueueFull as e:
@@ -786,6 +852,10 @@ class ContinuousBatchingEngine:
                 err.priority_class = e.priority_class
                 raise err from None
             _queue_depth.set(len(self._sched))
+            _tracer.request_event(
+                req.request_id, "enqueue", cls=req.priority,
+                tenant=req.tenant, prompt_tokens=len(req.prompt),
+                restored=bool(_restore is not None))
             self._cond.notify_all()
         return req
 
@@ -795,20 +865,49 @@ class ContinuousBatchingEngine:
                  seed: int = 0, ttl_s: Optional[float] = None,
                  draft: Optional[bool] = None,
                  priority: Optional[str] = None,
-                 tenant: str = "default"):
+                 tenant: str = "default",
+                 request_id: Optional[str] = None):
         """Blocking batch API (PagedGenerator-compatible): submits each
         row as its own sequence and eos-pads rows to a common length.
         If any row fails to submit or errors, the other rows are
         CANCELLED so a rejected batch never leaves orphan sequences
         decoding against the pool."""
+        out, _reqs = self.generate_with_requests(
+            input_ids, max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id, do_sample=do_sample,
+            temperature=temperature, seed=seed, ttl_s=ttl_s, draft=draft,
+            priority=priority, tenant=tenant, request_id=request_id)
+        return out
+
+    def generate_with_requests(self, input_ids, max_new_tokens: int = 32,
+                               eos_token_id: Optional[int] = None,
+                               do_sample: bool = False,
+                               temperature: float = 1.0,
+                               seed: int = 0,
+                               ttl_s: Optional[float] = None,
+                               draft: Optional[bool] = None,
+                               priority: Optional[str] = None,
+                               tenant: str = "default",
+                               request_id: Optional[str] = None):
+        """:meth:`generate` returning ``(output_ids, requests)`` so the
+        HTTP server can hand the per-row ``request_id``s back to the
+        client (ISSUE 10: a multi-row body's id seeds per-row ids as
+        ``<id>/<row>``)."""
         ids = np.asarray(input_ids, np.int32)
+
+        def rid(i: int) -> Optional[str]:
+            if request_id is None:
+                return None
+            return request_id if len(ids) == 1 else f"{request_id}/{i}"
+
         reqs: List[_Request] = []
         try:
             for i, row in enumerate(ids):
                 reqs.append(self.submit(row, max_new_tokens, eos_token_id,
                                         do_sample, temperature, seed + i,
                                         ttl_s=ttl_s, draft=draft,
-                                        priority=priority, tenant=tenant))
+                                        priority=priority, tenant=tenant,
+                                        request_id=rid(i)))
             rows = [r.result() for r in reqs]
         except BaseException:
             for r in reqs:
@@ -819,7 +918,7 @@ class ContinuousBatchingEngine:
         out = np.full((len(rows), width), pad, np.int32)
         for i, r in enumerate(rows):
             out[i, :len(r)] = r
-        return out
+        return out, reqs
 
     @property
     def draining(self) -> bool:
@@ -839,6 +938,44 @@ class ContinuousBatchingEngine:
             else:
                 depth = len(self._sched)
         return retry_after_seconds(depth, _decode_p50_seconds())
+
+    # ---------------------------------------- request-id surface (ISSUE 10)
+    def _cache_result_locked(self, req) -> None:
+        """Caller holds ``self._cond``.  Record a finished request's
+        outcome in the bounded result cache so a detached client can
+        re-attach by id (``GET /result/<id>``) — including after a
+        snapshot/restore, where the journaled id is carried verbatim."""
+        if not self.result_cache_size:
+            return
+        if req.error is None:
+            entry = {"request_id": req.request_id, "status": "done",
+                     "output_ids": [int(t) for t in req.output_ids],
+                     "new_tokens": len(req.generated)}
+        else:
+            entry = {"request_id": req.request_id, "status": "error",
+                     "error": str(req.error),
+                     "error_type": type(req.error).__name__}
+        self._results[req.request_id] = entry
+        self._results.move_to_end(req.request_id)
+        while len(self._results) > self.result_cache_size:
+            self._results.popitem(last=False)
+
+    def result_for(self, request_id: str) -> Optional[dict]:
+        """The cached outcome for ``request_id`` — ``status`` is
+        ``done`` (with ``output_ids``) or ``error`` once finished,
+        ``pending`` while queued/decoding, None for an id this engine
+        has never seen (or one evicted from the bounded cache)."""
+        with self._cond:
+            hit = self._results.get(request_id)
+            if hit is not None:
+                return dict(hit)
+            live = (self._active + self._prefilling + self._preempted
+                    + self._sched.pending())
+            for r in live:
+                if r.request_id == request_id:
+                    return {"request_id": request_id, "status": "pending",
+                            "generated_tokens": len(r.generated)}
+        return None
 
     def scheduler_info(self) -> dict:
         """JSON-able scheduling state for ``/health``: the active
@@ -891,6 +1028,10 @@ class ContinuousBatchingEngine:
         entries = []
         for r, prompt, generated, next_token in cuts:
             entries.append({
+                # the stable client-visible id survives the restart —
+                # a client holding it re-attaches via GET /result/<id>
+                # on the restored process (ISSUE 10)
+                "request_id": r.request_id,
                 "prompt": [int(t) for t in prompt],
                 "generated": [int(t) for t in generated],
                 "next_token": (None if next_token is None
@@ -960,6 +1101,7 @@ class ContinuousBatchingEngine:
                     draft=None if e.get("draft") else False,
                     priority=e.get("priority"),
                     tenant=e.get("tenant", "default"),
+                    request_id=e.get("request_id"),
                     _restore=e))
             except BaseException as exc:  # noqa: BLE001 — per-entry
                 if strict:
@@ -1005,6 +1147,7 @@ class ContinuousBatchingEngine:
                     r.error = EngineDraining(
                         "engine draining: request rejected before "
                         "admission (reject_queued fast path)")
+                    self._cache_result_locked(r)
                 _queue_depth.set(0)
                 _drain_rejected.inc(len(rejected))
             self._cond.notify_all()
@@ -1085,7 +1228,9 @@ class ContinuousBatchingEngine:
         out: List[_Request] = []
         for r in self._sched.reap(now):
             r.error = r._lifecycle_error(now, queued=True)
-            self._count_lifecycle(r.error)
+            self._count_lifecycle(r)
+            self._cache_result_locked(r)
+            _tracer.request_event(r.request_id, "retire", ok=False)
             out.append(r)
         if out:
             _queue_depth.set(len(self._sched))
@@ -1109,7 +1254,7 @@ class ContinuousBatchingEngine:
                     keep.append(r)
                 else:
                     r.error = err
-                    self._count_lifecycle(err)
+                    self._count_lifecycle(r)
                     self._retire_locked(r)
                     out.append(r)
             setattr(self, lst_name, keep)
@@ -1121,7 +1266,7 @@ class ContinuousBatchingEngine:
                     still.append(r)
                 else:
                     r.error = err
-                    self._count_lifecycle(err)
+                    self._count_lifecycle(r)
                     self._retire_locked(r)
                     out.append(r)
             self._active = still
@@ -1133,11 +1278,13 @@ class ContinuousBatchingEngine:
         return out
 
     @staticmethod
-    def _count_lifecycle(err: BaseException) -> None:
-        if isinstance(err, RequestCancelled):
+    def _count_lifecycle(req) -> None:
+        if isinstance(req.error, RequestCancelled):
             _cancelled_total.inc()
+            _tracer.request_event(req.request_id, "cancel")
         else:
             _expired_total.inc()
+            _tracer.request_event(req.request_id, "expire")
 
     @staticmethod
     def _pause_age(r, now: Optional[float] = None) -> float:
@@ -1227,6 +1374,10 @@ class ContinuousBatchingEngine:
         req.prefill_pos = req.prefix_tokens
         req.admitted_at = time.perf_counter()
         self._sched.note_admitted(req, req.admitted_at)
+        _tracer.request_event(
+            req.request_id, "admitted", cls=req.priority,
+            seq_id=req.seq_id, prefix_tokens=req.prefix_tokens,
+            queue_wait_s=round(req.admitted_at - req.submitted_at, 6))
 
     def _best_preempted_locked(self) -> Optional[_Request]:
         """Caller holds ``self._cond``.  The paused request that should
@@ -1265,6 +1416,9 @@ class ContinuousBatchingEngine:
             pre.preempted_at = None
         self._prefilling.append(pre)
         self._sched.note_resumed(pre)
+        _tracer.request_event(pre.request_id, "resume",
+                              prefill_pos=pre.prefill_pos,
+                              paused_s=round(pre.paused_total, 6))
 
     def _admit_locked(self) -> None:
         """Caller holds ``self._cond``.  Fill free slots from (a) paused
@@ -1296,6 +1450,9 @@ class ContinuousBatchingEngine:
                 victim.preempted_at = time.perf_counter()
                 self._preempted.append(victim)
                 self._sched.note_preempted(victim)
+                _tracer.request_event(
+                    victim.request_id, "preempt", for_rank=qrank,
+                    prefill_pos=victim.prefill_pos)
                 pending_rank = qrank
                 continue
             if pending_rank is None and pre is not None and (
@@ -1425,6 +1582,7 @@ class ContinuousBatchingEngine:
             sampling = _null_sampling()
         self._wedged.clear()      # only THIS dispatch may flag itself
         self._step_started_at = time.monotonic()
+        t_tr = _tracer.now_ns() if _tracer.enabled else 0
         try:
             if req.chunks_done == 0:
                 # per-sequence site, once — chunking must not change
@@ -1448,6 +1606,18 @@ class ContinuousBatchingEngine:
         req.prefill_pos = k + n
         req.chunks_done += 1
         self._sched.note_chunk(req)
+        if _tracer.enabled and t_tr:
+            # one step-track entry per chunk dispatch + the request's
+            # own timeline entry — flow-linked in the chrome export
+            # (t_tr == 0 means the window opened MID-dispatch: skip the
+            # slice rather than emit one starting at clock zero)
+            _tracer.step_record(
+                "prefill_chunk", self.steps, t_tr, _tracer.now_ns(),
+                request=req.request_id, tokens=n, pos=k,
+                cls=req.priority)
+            _tracer.request_event(req.request_id, "prefill_chunk",
+                                  tokens=n, pos=k,
+                                  chunk=req.chunks_done)
         if not last:
             return False
         # ---- target fully resident: finish what monolithic prefill did
@@ -1486,6 +1656,8 @@ class ContinuousBatchingEngine:
         ttft = req.first_token_at - req.submitted_at
         _ttft_s.observe(ttft)
         self._sched.note_first_token(req, ttft)
+        _tracer.request_event(req.request_id, "first_token",
+                              ttft_s=round(ttft, 6))
         return True
 
     def _run_chunks(self, plan) -> None:
@@ -1538,6 +1710,10 @@ class ContinuousBatchingEngine:
             for r in failed:
                 if r in self._prefilling:
                     self._prefilling.remove(r)
+                # quarantine BEFORE retire so the timeline's terminal
+                # event matches the decode-path ejection sites
+                # (consumers classify an ended request by last event)
+                _note_quarantine(r)
                 self._retire_locked(r)
             for r in completed:
                 if r in self._prefilling:
@@ -1545,7 +1721,6 @@ class ContinuousBatchingEngine:
                     self._active.append(r)
             self._cond.notify_all()
         for r in failed:
-            _quarantined.inc()
             r.done.set()
 
     def _pick(self, req, logits_row) -> int:
@@ -1593,6 +1768,11 @@ class ContinuousBatchingEngine:
         if req.error is None:
             _gen_latency_s.observe(req.finished_at - req.submitted_at)
         self._sched.note_retired(req)   # per-class TPOT (no-op on error)
+        self._cache_result_locked(req)
+        _tracer.request_event(
+            req.request_id, "retire", ok=req.error is None,
+            generated=len(req.generated),
+            latency_s=round(req.finished_at - req.submitted_at, 6))
 
     def _bucket(self, n: int) -> int:
         from .paged import next_pow2
@@ -1679,6 +1859,8 @@ class ContinuousBatchingEngine:
             finally:
                 self._step_started_at = None
         _survivor_replays.inc()
+        _tracer.request_event(req.request_id, "replay",
+                              tokens=int(upto), draft_tokens=int(dlen))
 
     def _replay_kv_batch(self, rows, targets) -> None:
         """Batched survivor replay (ISSUE 9 satellite, ROADMAP crash-
@@ -1754,6 +1936,16 @@ class ContinuousBatchingEngine:
             rounds(self._draft_decoder, self.draft_cache, dwork, chunk)
         done = {id(r) for r, _, _ in work} | {id(r) for r, _, _ in dwork}
         _survivor_replays.inc(len(done))
+        if _tracer.enabled:
+            seen = set()
+            for r, _, _ in work + dwork:
+                if id(r) in seen:
+                    continue
+                seen.add(id(r))
+                _tracer.request_event(
+                    r.request_id, "replay", batched=True,
+                    tokens=int(targets[id(r)][0]),
+                    draft_tokens=int(targets[id(r)][1]))
 
     def _replay_survivors(self, exclude=()) -> List[_Request]:
         """Device-failure recovery (ISSUE 8 consumer 1): replay every
@@ -1845,8 +2037,14 @@ class ContinuousBatchingEngine:
         if not self._pools_rebuilt():
             return []
         _rebuilds_total.inc()
+        t_tr = _tracer.now_ns() if _tracer.enabled else 0
         with monitor.span("engine/recovery", histogram=_recovery_s):
             failed = self._replay_survivors(exclude=exclude)
+        if _tracer.enabled and t_tr:
+            _tracer.step_record(
+                "recovery", self.steps, t_tr, _tracer.now_ns(),
+                wedged=isinstance(error, _EngineWedged),
+                replay_failed=len(failed))
         if not failed:
             return []
         caller_owned = ([r for r in failed if r in self._active]
@@ -1860,10 +2058,12 @@ class ContinuousBatchingEngine:
                         lst = getattr(self, lst_name)
                         if r in lst:
                             lst.remove(r)
+                    # quarantine BEFORE retire: terminal timeline event
+                    # stays 'retire' at every ejection site
+                    _note_quarantine(r)
                     self._retire_locked(r)
                 self._cond.notify_all()
             for r in eject:
-                _quarantined.inc()
                 r.done.set()
         return caller_owned
 
@@ -1978,6 +2178,8 @@ class ContinuousBatchingEngine:
             if r.use_draft:
                 self.draft_cache.truncate(r.seq_id, new_len)
             rows.append(_SpecRow(out[i], a, drafts[i]))
+        self._last_spec = (k * len(d_idx),
+                           sum(int(accept[i]) for i in d_idx))
         if d_idx:
             _spec_proposed.inc(k * len(d_idx))
             _spec_accepted.inc(sum(int(accept[i]) for i in d_idx))
@@ -1993,6 +2195,7 @@ class ContinuousBatchingEngine:
     def _exec_step(self, reqs) -> List[np.ndarray]:
         """Run ONE compiled decode step for ``reqs`` (all of, or a
         bisected subset of, the active batch), padded to a bucket.
+        Resets ``_last_spec`` — a plain step proposes nothing.
         Tokens, positions and sampling counters are derived from
         request/cache state — a rolled-back step therefore replays
         IDENTICALLY (same threefry counters → same draws), which the
@@ -2003,6 +2206,7 @@ class ContinuousBatchingEngine:
         row) and the rows are :class:`_SpecRow`."""
         if self._spec and any(r.use_draft for r in reqs):
             return self._exec_spec_step(reqs)
+        self._last_spec = (0, 0)
         B = self._bucket(len(reqs))
         npad = B - len(reqs)
         # the new token enters the sequence now: its rope position
@@ -2104,7 +2308,7 @@ class ContinuousBatchingEngine:
         live, out = [], []
         for r in reqs:
             if id(r) in dead_ids:
-                _quarantined.inc()
+                _note_quarantine(r)
                 out.append(r)
             else:
                 live.append(r)
@@ -2119,7 +2323,7 @@ class ContinuousBatchingEngine:
         if len(reqs) == 1:
             r = reqs[0]
             r.error = error
-            _quarantined.inc()
+            _note_quarantine(r)
             return [], [], [r]
         mid = (len(reqs) + 1) // 2
         survivors, rows, poisoned = [], [], []
@@ -2171,7 +2375,22 @@ class ContinuousBatchingEngine:
         # engine (bench baseline, parity test) was built in-process
         _sampling_on_device_g.set(int(self.sample_on_device))
         on_device = self.sample_on_device
+        t_tr = _tracer.now_ns() if _tracer.enabled else 0
         survivors, rows, poisoned = self._step_isolated(active, lens_before)
+        if _tracer.enabled and t_tr:
+            # the engine-step ring (ISSUE 10): batch composition per
+            # class + spec economics + the dispatch wall time (retries
+            # and bisection probes included — that IS this step's cost;
+            # t_tr == 0 = window opened mid-dispatch, skip the slice)
+            comp: dict = {}
+            for r in active:
+                comp[r.priority] = comp.get(r.priority, 0) + 1
+            prop, acc = self._last_spec
+            _tracer.step_record(
+                "decode", self.steps, t_tr, _tracer.now_ns(),
+                batch=len(active), classes=comp, spec_proposed=prop,
+                spec_accepted=acc, poisoned=len(poisoned),
+                requests=[r.request_id for r in active])
         # ISSUE 8 replay-failure sweep: a row whose KV replay failed
         # during recovery carries its error.  The failing subset's own
         # dead rows are already in `poisoned`; one that died OUTSIDE
@@ -2185,7 +2404,7 @@ class ContinuousBatchingEngine:
             survivors, rows = [], []
             for r, row in pairs:
                 if r.error is not None:
-                    _quarantined.inc()
+                    _note_quarantine(r)
                     dead_done.append(r)
                 else:
                     survivors.append(r)
@@ -2196,7 +2415,7 @@ class ContinuousBatchingEngine:
         for r in active:
             if id(r) not in accounted and not r.done.is_set() \
                     and r.error is not None:
-                _quarantined.inc()
+                _note_quarantine(r)
                 poisoned.append(r)
         _tokens_total.inc(len(survivors))
 
@@ -2207,6 +2426,14 @@ class ContinuousBatchingEngine:
         still, retired = [], []
         accepted_emitted = 0
         for r, row in zip(survivors, rows):
+            if _tracer.enabled:
+                if isinstance(row, _SpecRow):
+                    _tracer.request_event(
+                        r.request_id, "verify_step", step=self.steps,
+                        accept=int(row.accept))
+                else:
+                    _tracer.request_event(r.request_id, "decode_step",
+                                          step=self.steps)
             eos_hit = (r.eos_token_id is not None
                        and r.generated[-1] == r.eos_token_id)
             if eos_hit or len(r.generated) >= r.max_new_tokens:
@@ -2283,6 +2510,7 @@ class ContinuousBatchingEngine:
                     r.done.set()
                     continue
                 r.error = exc
+                self._cache_result_locked(r)
                 r.done.set()
             for r in holders:
                 if r.seq_id is not None:
@@ -2316,6 +2544,7 @@ class ContinuousBatchingEngine:
                     self._active = []
                     for r in stopped:
                         r.error = RuntimeError("engine stopped")
+                        self._cache_result_locked(r)
                         r.done.set()
                     return
             try:

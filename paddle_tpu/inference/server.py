@@ -173,6 +173,9 @@ class InferenceServer(_ServerLifecycle):
                 elif self.path == "/metrics":
                     with self._track("/metrics"):
                         self._reply_text(200, monitor.prometheus_text())
+                elif self.path == "/debug/trace":
+                    with self._track("/debug/trace"):
+                        self._reply(200, monitor.export_chrome_trace())
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -295,6 +298,19 @@ class GenerationServer(_ServerLifecycle):
     (an operator checkpoint before risky maintenance).  ``/health``
     reports ``snapshot_path`` and the restored-request count when the
     knob is set.
+
+    Observability (ISSUE 10): a request body may pin ``"request_id"``
+    (multi-row bodies get ``<id>/<row>`` per row); the reply always
+    carries ``"request_ids"``, and ``GET /result/<id>`` re-attaches to
+    a finished (200) or in-flight (202) generation — including after a
+    snapshot/restore restart, where journaled ids are preserved.
+    ``POST /debug/trace/start`` / ``/debug/trace/stop`` bracket a
+    capture window; ``GET /debug/trace`` exports it as chrome-trace
+    JSON (engine-step track + per-request flow events + profiler host
+    spans) and ``GET /debug/requests/<id>`` returns one request's raw
+    event timeline.  ``GET /debug/cost`` runs the analytical cost model
+    over the decode program and publishes ``program_flops_total`` /
+    ``program_hbm_bytes`` / ``mfu`` to ``/metrics``.
     """
 
     def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
@@ -397,10 +413,71 @@ class GenerationServer(_ServerLifecycle):
                 elif self.path == "/metrics":
                     with self._track("/metrics"):
                         self._reply_text(200, monitor.prometheus_text())
+                elif self.path == "/debug/trace":
+                    # the capture buffer as chrome-trace JSON — load it
+                    # in Perfetto (ISSUE 10; tools/trace_capture.py is
+                    # the CLI driver of start -> load -> stop -> GET)
+                    with self._track("/debug/trace"):
+                        self._reply(200, monitor.export_chrome_trace())
+                elif self.path.startswith("/debug/requests/"):
+                    # one request's event timeline by its stable id
+                    # (route label is collapsed so ids can't explode
+                    # the metrics cardinality)
+                    with self._track("/debug/requests"):
+                        rid = self.path[len("/debug/requests/"):]
+                        tl = monitor.request_timeline(rid)
+                        if tl is None:
+                            self._reply(404, {
+                                "error": f"no timeline for request "
+                                         f"{rid!r} (tracing off, or "
+                                         "evicted from the bounded "
+                                         "buffer)"})
+                        else:
+                            self._reply(200, tl)
+                elif self.path == "/debug/cost":
+                    # analytical decode-program cost + process-lifetime
+                    # MFU, published to /metrics as a side effect
+                    # (program_flops_total / program_hbm_bytes / mfu)
+                    with self._track("/debug/cost"):
+                        try:
+                            from ..analysis.cost import \
+                                publish_engine_cost
+                            self._reply(200,
+                                        publish_engine_cost(outer._engine))
+                        except Exception as e:  # noqa: BLE001
+                            self._reply(500, {"error": str(e)})
+                elif self.path.startswith("/result/"):
+                    # request-id re-attach (ISSUE 10 satellite): a
+                    # client that lost its stream — timeout, server
+                    # restart — polls the bounded result cache; a
+                    # restored request keeps its journaled id, so the
+                    # SAME id works across the restart
+                    with self._track("/result"):
+                        rid = self.path[len("/result/"):]
+                        res = outer._engine.result_for(rid)
+                        if res is None:
+                            self._reply(404, {
+                                "error": f"unknown request id {rid!r} "
+                                         "(never seen, or evicted from "
+                                         "the bounded result cache)"})
+                        elif res.get("status") == "pending":
+                            self._reply(202, res)
+                        else:
+                            self._reply(200, res)
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
+                if self.path == "/debug/trace/start":
+                    with self._track("/debug/trace/start"):
+                        monitor.start_capture()
+                        self._reply(200, {"capturing": True})
+                    return
+                if self.path == "/debug/trace/stop":
+                    with self._track("/debug/trace/stop"):
+                        monitor.stop_capture()
+                        self._reply(200, {"capturing": False})
+                    return
                 if self.path != "/generate":
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
@@ -431,6 +508,9 @@ class GenerationServer(_ServerLifecycle):
                         priority = (None if priority is None
                                     else str(priority))
                         tenant = str(req.get("tenant", "default"))
+                        request_id = req.get("request_id")
+                        request_id = (None if request_id is None
+                                      else str(request_id))
                         with outer._count_lock:
                             outer._request_count += 1
                             seed = int(req.get("seed",
@@ -440,11 +520,12 @@ class GenerationServer(_ServerLifecycle):
                         self._reply(400, {"error": str(e)})
                         return
                     try:
-                        out = outer._engine.generate(
+                        out, rows = outer._engine.generate_with_requests(
                             ids, max_new_tokens=max_new, eos_token_id=eos,
                             do_sample=do_sample, temperature=temperature,
                             seed=seed, ttl_s=ttl, draft=draft,
-                            priority=priority, tenant=tenant)
+                            priority=priority, tenant=tenant,
+                            request_id=request_id)
                     except ValueError as e:      # request-shape problems
                         # e.g. prompt + max_new_tokens past the rope
                         # table: the CLIENT's request is wrong — 400,
@@ -454,7 +535,10 @@ class GenerationServer(_ServerLifecycle):
                         return
                     self._reply(200, {
                         "output_ids": out.tolist(),
-                        "new_tokens": int(out.shape[1] - ids.shape[1])})
+                        "new_tokens": int(out.shape[1] - ids.shape[1]),
+                        # the stable per-row ids (ISSUE 10): the
+                        # /result/<id> and /debug/requests/<id> handles
+                        "request_ids": [r.request_id for r in rows]})
                 except EngineSaturated as e:
                     # bounded-queue overflow: retryable — the hint is
                     # the REQUESTING CLASS's backlog's estimated

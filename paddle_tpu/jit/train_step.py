@@ -660,14 +660,14 @@ class TrainStep:
         self._last_loss = wrap_array(losses[k - 1])
         return wrap_array(losses)
 
-    def audit_fused(self, batches, **limits):
-        """``analysis.audit_callable`` on the fused K-step program:
-        traces the EXACT operand list and donation contract run_steps
-        executes (params/optimizer state as abstract avals — no device
-        work, nothing materialized) and returns the ProgramAudit.  The
-        certification lane tools/train_bench.py gates on: no host
-        callbacks, donation intact, no f32 creep."""
-        from ..analysis import audit_callable
+    def fused_program_spec(self, batches):
+        """The fused K-step program's EXACT traced function + abstract
+        operand list — the shared tracing spec under :meth:`audit_fused`
+        (hazard rules) and ``analysis.cost``'s FLOPs/HBM estimator
+        (ISSUE 10: the train-lane MFU numerator), so both see the one
+        call contract ``run_steps`` executes.  Returns ``(fn, args,
+        donate_argnums, static_argnums)``; params/optimizer state ride
+        as abstract avals — no device work, nothing materialized."""
         if not self.fused_supported:
             raise ValueError(
                 "the LR schedule is not traceable — run_steps uses the "
@@ -684,8 +684,8 @@ class TrainStep:
         first = batches[0]
         if not (isinstance(first, (tuple, list)) and len(first) == 2):
             raise ValueError(
-                "audit_fused takes the same (inputs, labels) pairs as "
-                "run_steps")
+                "fused_program_spec takes the same (inputs, labels) "
+                "pairs as run_steps")
         in_leaves, label_leaves, treedefs, _frozen = self._prepare_args(
             first[0], first[1])
         in_stacks = [jax.ShapeDtypeStruct((k,) + tuple(a.shape), a.dtype)
@@ -711,10 +711,20 @@ class TrainStep:
         accum = [staged_sds(a) for a in self._grad_accum]
         frozen = [sds(p._data) for p in self._frozen_params]
         scalars = tuple(sds(x) for x in self._fused_scalars())
+        args = (arrays, states, masters, accum, frozen, *scalars,
+                in_stacks, label_stacks, treedefs)
+        return self._scan_fn, args, (0, 1, 2, 3), (12,)
+
+    def audit_fused(self, batches, **limits):
+        """``analysis.audit_callable`` on the fused K-step program:
+        traces the EXACT operand list and donation contract run_steps
+        executes (:meth:`fused_program_spec`) and returns the
+        ProgramAudit.  The certification lane tools/train_bench.py
+        gates on: no host callbacks, donation intact, no f32 creep."""
+        from ..analysis import audit_callable
+        fn, args, donate, static = self.fused_program_spec(batches)
         return audit_callable(
-            self._scan_fn, arrays, states, masters, accum, frozen,
-            *scalars, in_stacks, label_stacks, treedefs,
-            donate_argnums=(0, 1, 2, 3), static_argnums=(12,),
+            fn, *args, donate_argnums=donate, static_argnums=static,
             name="TrainStep.run_steps", **limits)
 
     # -------------------------------------------------------------- analysis

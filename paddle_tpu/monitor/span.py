@@ -58,8 +58,19 @@ class span:
         return False
 
     def __call__(self, fn):
+        """Decorator form.  Each call times through a fresh inner span
+        (the decorator instance's config — name/histogram/labels —
+        is resolved ONCE, here) and the measurement is copied back to
+        THIS instance's ``elapsed``, so tests can read the decorator
+        they hold instead of losing the inner span (the old form
+        silently dropped it).  Per-call inner spans keep re-entrant
+        and concurrent calls from clobbering each other's timers."""
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            with span(self.name, self.histogram, **self.labels):
-                return fn(*args, **kwargs)
+            inner = span(self.name, self.histogram, **self.labels)
+            try:
+                with inner:
+                    return fn(*args, **kwargs)
+            finally:
+                self.elapsed = inner.elapsed
         return wrapper

@@ -1,0 +1,179 @@
+"""Analytical cost model (ISSUE 10): FLOPs oracles vs hand-counted
+tiny programs, int8 width accounting, control-flow multipliers, the
+engine program estimate, and the MFU plumbing."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.analysis import cost
+
+
+class TestFlopsOracles:
+    def test_matmul_hand_count(self):
+        # (4,8) @ (8,16): 2*M*K*N = 2*4*8*16 = 1024 FLOPs; bytes =
+        # (4*8 + 8*16 + 4*16) * 4 = 896 at f32
+        def mm(a, b):
+            return a @ b
+
+        est = cost.estimate_callable(
+            mm, jnp.zeros((4, 8), jnp.float32),
+            jnp.zeros((8, 16), jnp.float32))
+        f, b = est.by_primitive["dot_general"]
+        assert f == 2 * 4 * 8 * 16
+        assert b == (4 * 8 + 8 * 16 + 4 * 16) * 4
+
+    def test_batched_dot_hand_count(self):
+        # batch dims count once: (3,4,8) @ (3,8,5) = 2*3*4*8*5
+        def bmm(a, b):
+            return jnp.einsum("bik,bkj->bij", a, b)
+
+        est = cost.estimate_callable(
+            bmm, jnp.zeros((3, 4, 8), jnp.float32),
+            jnp.zeros((3, 8, 5), jnp.float32))
+        f, _ = est.by_primitive["dot_general"]
+        assert f == 2 * 3 * 4 * 8 * 5
+
+    def test_tiny_attention_hand_count(self):
+        # QK^T (2*s*s*d) + AV (2*s*s*d) with s=4, d=8: dot FLOPs 512
+        s, d = 4, 8
+
+        def attn(q, k, v):
+            a = jax.nn.softmax(q @ k.T / np.sqrt(d), axis=-1)
+            return a @ v
+
+        est = cost.estimate_callable(
+            attn, jnp.zeros((s, d), jnp.float32),
+            jnp.zeros((s, d), jnp.float32),
+            jnp.zeros((s, d), jnp.float32))
+        f, _ = est.by_primitive["dot_general"]
+        assert f == 2 * s * s * d + 2 * s * s * d
+
+    def test_conv_hand_count(self):
+        # NCHW (1,3,8,8) * OIHW (4,3,3,3), SAME: out (1,4,8,8);
+        # 2 * out_size * Cin * Kh * Kw = 2*256*3*9
+        def conv(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w, (1, 1), "SAME",
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+        est = cost.estimate_callable(
+            conv, jnp.zeros((1, 3, 8, 8), jnp.float32),
+            jnp.zeros((4, 3, 3, 3), jnp.float32))
+        f, _ = est.by_primitive["conv_general_dilated"]
+        assert f == 2 * (1 * 4 * 8 * 8) * 3 * 9
+
+    def test_scan_multiplies_by_trip_count(self):
+        def scanned(a, b):
+            def body(c, _):
+                return c @ b, ()
+            out, _ = jax.lax.scan(body, a, None, length=5)
+            return out
+
+        est = cost.estimate_callable(
+            scanned, jnp.zeros((4, 8), jnp.float32),
+            jnp.zeros((8, 8), jnp.float32))
+        assert est.by_primitive["dot_general"][0] == 5 * 2 * 4 * 8 * 8
+
+    def test_gather_scatter_are_movement_not_flops(self):
+        def g(x, idx):
+            return x[idx]
+
+        est = cost.estimate_callable(
+            g, jnp.zeros((16, 8), jnp.float32),
+            jnp.zeros((4,), jnp.int32))
+        for prim in ("gather", "dynamic_slice"):
+            if prim in est.by_primitive:
+                assert est.by_primitive[prim][0] == 0
+                assert est.by_primitive[prim][1] > 0
+
+    def test_int8_ops_costed_at_their_width(self):
+        # same shapes, same FLOPs — int8 operands are 1/4 the bytes
+        def mm8(a, b):
+            return jax.lax.dot_general(
+                a, b, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+
+        def mmf(a, b):
+            return a @ b
+
+        e8 = cost.estimate_callable(
+            mm8, jnp.zeros((8, 8), jnp.int8), jnp.zeros((8, 8), jnp.int8))
+        ef = cost.estimate_callable(
+            mmf, jnp.zeros((8, 8), jnp.float32),
+            jnp.zeros((8, 8), jnp.float32))
+        f8 = e8.by_primitive["dot_general"]
+        ff = ef.by_primitive["dot_general"]
+        assert f8[0] == ff[0]
+        # int8 in, int32 accumulator out: (64+64)*1 + 64*4 vs (3*64)*4
+        assert f8[1] == (64 + 64) * 1 + 64 * 4
+        assert ff[1] == 3 * 64 * 4
+
+
+class TestEngineEstimate:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference.continuous import \
+            ContinuousBatchingEngine
+
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=1,
+                          num_attention_heads=2, num_key_value_heads=2,
+                          max_position_embeddings=64)
+        model = LlamaForCausalLM(cfg)
+        eng = ContinuousBatchingEngine(model, total_pages=32, page_size=8,
+                                       max_batch=4)
+        yield eng
+        eng.stop()
+
+    def test_decode_program_estimate_and_gauges(self, engine):
+        est = cost.estimate_engine(engine, mode="decode")
+        assert est.flops > 0 and est.hbm_bytes > 0
+        # a transformer decode step is dot-dominated
+        assert est.by_primitive["dot_general"][0] > 0
+        snap = monitor.snapshot()
+        series = {s["labels"]["program"]: s["value"]
+                  for s in snap["program_flops_total"]["series"]}
+        assert series[est.name] == est.flops
+
+    def test_publish_engine_cost_sets_mfu(self, engine):
+        out = cost.publish_engine_cost(engine)
+        assert out["program_flops"] > 0
+        assert out["flops_per_token"] == pytest.approx(
+            out["program_flops"] / engine.max_batch)
+        snap = monitor.snapshot()
+        assert "mfu" in snap
+
+    def test_estimate_traces_without_compiling(self, engine):
+        monitor.install_compile_hooks()
+        before = monitor.snapshot()
+        cost.estimate_engine(engine, mode="decode")
+        after = monitor.snapshot()
+
+        def compiles(s):
+            m = s.get("jit_compile_seconds")
+            return m["series"][0]["count"] if m and m["series"] else 0
+        assert compiles(after) == compiles(before)
+
+
+class TestMfuPlumbing:
+    def test_peak_flops_env_override(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_PEAK_FLOPS", "2.5e13")
+        assert cost.peak_flops() == 2.5e13
+
+    def test_peak_flops_cpu_nominal(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_PEAK_FLOPS", raising=False)
+        if jax.default_backend() != "tpu":
+            assert cost.peak_flops() == cost.DEFAULT_PEAK_FLOPS
+
+    def test_record_mfu_gauge(self):
+        v = cost.record_mfu(5e11, 1.0, peak=1e12)
+        assert v == pytest.approx(0.5)
+        snap = monitor.snapshot()
+        assert snap["mfu"]["series"][0]["value"] == pytest.approx(0.5)
+        assert cost.record_mfu(1.0, 0.0, peak=1e12) is None

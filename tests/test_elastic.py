@@ -218,7 +218,15 @@ print("RESULT:", r)
 
 # Trainer for the coordinated-restart test: resumes the step counter from
 # its checkpoint file, trains to TOTAL steps, and on generation 0 rank 1
-# dies mid-training (simulated hardware fault).
+# dies mid-training (simulated hardware fault).  Rank 0's generation-0
+# run must NOT finish by step count: on a fast machine it could complete
+# all TOTAL steps before its controller observes rank 1's death, leaving
+# generation 1 nothing to do (rank0.json would finish with gen=0 and the
+# resume assertions flake).  So rank 0 stalls one step short of the end
+# and waits for the controller's coordinated teardown (SIGTERM) — the
+# gen-0 run is ended by the CONTROLLER's restart observation, never by
+# the trainer racing it, and generation >= 1 always resumes with real
+# work left (the deterministic fix for the pre-existing timing flake).
 _COORD_TRAINER = r"""
 import json, os, sys, time
 ckpt_dir, total = sys.argv[1], int(sys.argv[2])
@@ -237,6 +245,17 @@ for step in range(start, total):
     print(f"gen={gen} step={step}", file=log, flush=True)
     if rank == 1 and gen == 0 and step == 2:
         os._exit(17)                       # mid-training fault
+    if rank == 0 and gen == 0 and step == total - 2:
+        # survive until the controller's coordinated teardown — but
+        # BOUNDED: if the controller never observes rank 1's death
+        # (the regression this test exists to catch), fail fast with
+        # a diagnostic instead of hanging the suite
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            time.sleep(0.05)
+        print("gen-0 rank 0 never torn down by the controller",
+              file=sys.stderr)
+        sys.exit(3)
 """
 
 

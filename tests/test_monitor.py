@@ -152,6 +152,46 @@ class TestSpan:
             pass
         assert all(e.name != "test/silent" for e in rec.collect())
 
+    def test_span_decorator_exposes_elapsed_and_labels(self):
+        # ISSUE 10 satellite regression: the decorator form used to
+        # time through a throwaway inner span — the instance you held
+        # never saw `elapsed`.  Now each call reuses THIS instance's
+        # config and copies the measurement back.
+        h = MetricRegistry().histogram("t_span_dec_seconds",
+                                       label_names=("stage",),
+                                       buckets=(60.0,))
+        sp = monitor.span("test/decorated", histogram=h, stage="io")
+
+        @sp
+        def work(x):
+            return x * 2
+
+        assert sp.elapsed is None
+        assert work(21) == 42
+        first = sp.elapsed
+        assert first is not None and first >= 0
+        _, c = h.sum_count(stage="io")
+        assert c == 1                       # labels applied per call
+        assert work(1) == 2
+        assert sp.elapsed is not None       # refreshed on every call
+        _, c = h.sum_count(stage="io")
+        assert c == 2
+
+    def test_span_decorator_propagates_exception_and_still_times(self):
+        h = MetricRegistry().histogram("t_span_dec_err_seconds",
+                                       buckets=(60.0,))
+        sp = monitor.span("test/decorated_err", histogram=h)
+
+        @sp
+        def boom():
+            raise ValueError("x")
+
+        with pytest.raises(ValueError):
+            boom()
+        assert sp.elapsed is not None
+        _, c = h.sum_count()
+        assert c == 1
+
 
 class TestInstrumentedPaths:
     def test_all_reduce_records_per_kind_histograms(self):

@@ -310,6 +310,42 @@ class TestChaosSmoke:
         assert self._load().main() == 0
 
 
+class TestTraceCapture:
+    """ISSUE 10 tentpole gate: the self-contained trace-capture demo —
+    tiny chunked engine server, capture window over the HTTP surface,
+    schema-validated chrome-trace JSON with engine-step + request
+    tracks + flow events."""
+
+    def _load(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "trace_capture", os.path.join(REPO, "tools",
+                                          "trace_capture.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_demo_lane(self, tmp_path, capsys):
+        tc = self._load()
+        out = str(tmp_path / "trace.json")
+        assert tc.main(["--demo", f"--out={out}"]) == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        summary = json.loads(line)
+        assert summary["schema_problems"] == []
+        assert summary["engine_steps"] > 0
+        assert summary["request_tracks"] >= 2
+        assert summary["flow_events"] > 0
+        # the pinned chunked request's raw timeline rides along
+        kinds = [e["kind"]
+                 for e in summary["request_timeline"]["events"]]
+        assert kinds.count("prefill_chunk") >= 2
+        assert kinds[-1] == "retire"
+        with open(out) as f:
+            payload = json.load(f)
+        from paddle_tpu.monitor import validate_chrome_trace
+        assert validate_chrome_trace(payload) == []
+
+
 class TestTpuLintGate:
     """ISSUE 3 CI satellite: the anti-pattern linter runs clean against
     its checked-in baseline, inside the tier-1 CPU lane's time budget."""

@@ -45,6 +45,13 @@ REQUIRED_SERIES = (
     "kv_quant_pool_bytes",
     "kv_quant_scale_bytes",
     "replay_dispatches_total",
+    # request tracing + cost/MFU accounting (ISSUE 10)
+    "trace_captures_total",
+    "trace_events_total",
+    "trace_dropped_events_total",
+    "mfu",
+    "program_flops_total",
+    "program_hbm_bytes",
 )
 
 #: scheduler series (ISSUE 7, README "Scheduling & multi-tenancy") —
@@ -96,25 +103,46 @@ def run_chaos() -> dict:
     rng = np.random.default_rng(0)
 
     # one poisoned prefill (2nd admission) + one poisoned sequence
-    # (sticky decode fault on seq 3) in a 5-request workload
+    # (sticky decode fault on seq 3) in a 5-request workload — run
+    # inside a trace capture window (ISSUE 10): a quarantined request's
+    # timeline must record the quarantine event, so a post-mortem can
+    # see WHICH request the isolation machinery ejected and when
     plan = faults.FaultPlan([
         {"site": "prefill", "nth": 2},
         {"site": "decode_step", "seq_id": 3, "kind": "error"},
     ])
     errors = 0
-    with faults.installed(plan):
-        with ContinuousBatchingEngine(model, total_pages=64, page_size=8,
-                                      max_batch=4) as eng:
-            reqs = [eng.submit(rng.integers(0, 64, (4,)), max_new_tokens=6,
-                               ttl_s=300.0)
-                    for _ in range(5)]
-            for r in reqs:
-                try:
-                    r.result(timeout=600)
-                except faults.FaultError:
-                    errors += 1
-            pool_clean = (eng.cache.free_pages == 64
-                          and eng._reserved_pages == 1)
+    monitor.start_capture()
+    try:
+        with faults.installed(plan):
+            with ContinuousBatchingEngine(model, total_pages=64,
+                                          page_size=8,
+                                          max_batch=4) as eng:
+                reqs = [eng.submit(rng.integers(0, 64, (4,)),
+                                   max_new_tokens=6, ttl_s=300.0)
+                        for _ in range(5)]
+                for r in reqs:
+                    try:
+                        r.result(timeout=600)
+                    except faults.FaultError:
+                        errors += 1
+                pool_clean = (eng.cache.free_pages == 64
+                              and eng._reserved_pages == 1)
+                # cost/MFU accounting over the live engine: publishes
+                # mfu + program_flops_total + program_hbm_bytes, the
+                # series the existence gate requires
+                from paddle_tpu.analysis import cost as _cost
+                _cost.publish_engine_cost(eng)
+    finally:
+        monitor.stop_capture()
+    quarantine_traced = True
+    for r in reqs:
+        if r.error is None:
+            continue
+        tl = monitor.request_timeline(r.request_id)
+        kinds = [] if tl is None else [e["kind"] for e in tl["events"]]
+        if "quarantine" not in kinds:
+            quarantine_traced = False
 
     # lifecycle + drain path: a worker request, a cancelled request, an
     # expired request and a saturated submission, then a graceful drain
@@ -268,6 +296,7 @@ def run_chaos() -> dict:
     for name in SCHEDULER_SERIES:
         out[name] = _series_total(snap, name)
     out["_poisoned_errors"] = errors
+    out["_quarantine_traced"] = quarantine_traced
     out["_pool_clean"] = pool_clean
     out["_drained"] = drained
     out["_preempted_ok"] = preempted_ok
@@ -300,6 +329,11 @@ def main() -> int:
          out["sched_admitted_total"] >= 2),
         ("exactly the 2 poisoned requests errored",
          out["_poisoned_errors"] == 2),
+        ("quarantined requests' trace timelines record the quarantine "
+         "event", out["_quarantine_traced"]),
+        ("trace capture recorded events", out["trace_events_total"] >= 1),
+        ("cost analyzer published program FLOPs",
+         out["program_flops_total"] > 0),
         ("pool fully reclaimed after quarantine", out["_pool_clean"]),
         ("drain completed", out["_drained"]),
         ("quarantined_requests_total counted both poisons",

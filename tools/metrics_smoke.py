@@ -1,18 +1,28 @@
-"""Metrics-endpoint smoke gate (ISSUE 1 CI satellite).
+"""Metrics-endpoint smoke gate (ISSUE 1 CI satellite; ISSUE 10
+observability surface).
 
-Starts a GenerationServer on a free port with a tiny LLaMA, issues one
-/generate request, scrapes GET /metrics and asserts the Prometheus
-exposition parses and carries the acceptance series (requests_total,
-request_latency_seconds).  Exit 0 = healthy, 1 = broken — the tier-1
-suite runs main() via tests/test_tools.py, and `python
-tools/metrics_smoke.py` is the standalone CI lane.
+Starts a GenerationServer on a free port with a tiny LLaMA, brackets
+one /generate request in a trace capture window (POST
+/debug/trace/start|stop), downloads GET /debug/trace (must be a
+non-empty chrome trace), re-attaches to the request via GET
+/result/<id> and GET /debug/requests/<id>, runs the analytical cost
+model via GET /debug/cost, then scrapes GET /metrics and asserts the
+Prometheus exposition parses and carries the acceptance series —
+requests_total / request_latency_seconds / generated_tokens_total plus
+the ISSUE 10 series (mfu, program_flops_total, program_hbm_bytes,
+trace_captures_total, trace_events_total).  Exit 0 = healthy, 1 =
+broken — the tier-1 suite runs main() via tests/test_tools.py, and
+`python tools/metrics_smoke.py` is the standalone CI lane.
 """
 from __future__ import annotations
 
 import json
+import os
 import re
 import sys
 import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _LINE_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$')
 
@@ -51,17 +61,60 @@ def main() -> int:
     model = LlamaForCausalLM(cfg)
     ids = np.random.default_rng(0).integers(0, 64, (1, 4)).astype("int32")
 
-    with GenerationServer(model, total_pages=32, page_size=8) as srv:
-        base = f"http://{srv.host}:{srv.port}"
+    def post(url, body=None):
         req = urllib.request.Request(
-            base + "/generate",
-            data=json.dumps({"input_ids": ids.tolist(),
-                             "max_new_tokens": 3}).encode(),
+            url, data=json.dumps(body or {}).encode(),
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=120) as resp:
-            out = json.loads(resp.read())
+            return json.loads(resp.read())
+
+    with GenerationServer(model, total_pages=32, page_size=8) as srv:
+        base = f"http://{srv.host}:{srv.port}"
+        # the ISSUE 10 observability surface: the generate request runs
+        # inside a trace capture window, and the whole capture workflow
+        # rides the SAME HTTP endpoints an operator would use
+        post(base + "/debug/trace/start")
+        out = post(base + "/generate", {"input_ids": ids.tolist(),
+                                        "max_new_tokens": 3,
+                                        "request_id": "smoke-1"})
+        post(base + "/debug/trace/stop")
         if out.get("new_tokens") != 3:
             print(f"FAIL: generate returned {out}", file=sys.stderr)
+            return 1
+        if out.get("request_ids") != ["smoke-1"]:
+            print(f"FAIL: /generate did not echo the pinned request id: "
+                  f"{out.get('request_ids')}", file=sys.stderr)
+            return 1
+        with urllib.request.urlopen(base + "/debug/trace",
+                                    timeout=30) as resp:
+            trace = json.loads(resp.read())
+        if not trace.get("traceEvents"):
+            print("FAIL: /debug/trace returned an empty capture",
+                  file=sys.stderr)
+            return 1
+        with urllib.request.urlopen(base + "/result/smoke-1",
+                                    timeout=30) as resp:
+            res = json.loads(resp.read())
+        if res.get("status") != "done" \
+                or res.get("output_ids") != out["output_ids"][0]:
+            print(f"FAIL: /result/<id> re-attach mismatch: {res}",
+                  file=sys.stderr)
+            return 1
+        with urllib.request.urlopen(base + "/debug/requests/smoke-1",
+                                    timeout=30) as resp:
+            tl = json.loads(resp.read())
+        kinds = [e["kind"] for e in tl.get("events", ())]
+        if "enqueue" not in kinds or "retire" not in kinds:
+            print(f"FAIL: request timeline incomplete: {kinds}",
+                  file=sys.stderr)
+            return 1
+        # cost analyzer over the live engine -> publishes mfu +
+        # program_* gauges the exposition gate below requires
+        with urllib.request.urlopen(base + "/debug/cost",
+                                    timeout=120) as resp:
+            cost = json.loads(resp.read())
+        if not cost.get("program_flops", 0) > 0:
+            print(f"FAIL: /debug/cost returned {cost}", file=sys.stderr)
             return 1
         with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
             ctype = resp.headers.get("Content-Type", "")
@@ -76,7 +129,10 @@ def main() -> int:
         print(f"FAIL: {e}", file=sys.stderr)
         return 1
     required = ("requests_total", "request_latency_seconds_bucket",
-                "request_latency_seconds_count", "generated_tokens_total")
+                "request_latency_seconds_count", "generated_tokens_total",
+                # ISSUE 10: trace + cost/MFU series must be scrapeable
+                "mfu", "program_flops_total", "program_hbm_bytes",
+                "trace_captures_total", "trace_events_total")
     missing = [name for name in required if name not in samples]
     if missing:
         print(f"FAIL: exposition missing {missing}", file=sys.stderr)

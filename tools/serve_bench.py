@@ -290,6 +290,14 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
             if fault_plan is not None:
                 faults.clear()
         after = monitor.snapshot()
+        # cost/MFU accounting (ISSUE 10): price the decode program the
+        # window actually dispatched — a jaxpr trace, no compile, run
+        # AFTER the measured window closes so the recompile gate is
+        # untouched.  flops / max_batch is the per-token cost; the
+        # window's achieved FLOP/s over the configured peak is the MFU
+        # every future BENCH round quotes for free.
+        from paddle_tpu.analysis import cost as _cost
+        cost_est = _cost.estimate_engine(eng, mode="decode")
 
     dec_b, dec_sum, dec_n = _hist_delta(before, after,
                                         "decode_step_seconds")
@@ -313,6 +321,10 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
     # event covering pool rebuild + every survivor's replay)
     rec_b, rec_sum, rec_n = _hist_delta(before, after,
                                         "engine_recovery_seconds")
+    flops_per_token = cost_est.flops / MAX_BATCH
+    peak = _cost.peak_flops()
+    mfu = (_cost.record_mfu(tokens * flops_per_token, dec_sum, peak=peak)
+           if dec_sum > 0 else None)
     return {
         # speculative lane (ISSUE 6): acceptance economics of the
         # measured window; tokens_per_step is the structural win — a
@@ -372,6 +384,14 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
         # so the measured window should recompile nothing
         "jit_recompiles": int(compile_n),
         "jit_compile_seconds": compile_sum,
+        # cost/MFU accounting (ISSUE 10): analytical decode-program
+        # cost (jaxpr walk; int8 ops at their width) + the window's MFU
+        # — the automated source of the ROADMAP's MFU ladder
+        "program_flops": cost_est.flops,
+        "program_hbm_bytes": cost_est.hbm_bytes,
+        "flops_per_token": flops_per_token,
+        "peak_flops": peak,
+        "mfu": mfu,
     }
 
 
@@ -958,6 +978,12 @@ def main(argv=None) -> int:
     if not baseline and out["prefix_hit_rate"] <= 0:
         print("FAIL: shared-prefix workload saw no prefix-cache hits",
               file=sys.stderr)
+        return 1
+    if out["program_flops"] <= 0 or out["mfu"] is None:
+        # ISSUE 10 acceptance: every serve_bench line must carry the
+        # cost-analyzer numbers so BENCH rounds get the MFU ladder free
+        print("FAIL: cost analyzer produced no program FLOPs / MFU for "
+              "the measured window", file=sys.stderr)
         return 1
     if out["jit_recompiles"] != 0:
         # ROADMAP telemetry finding (ISSUE 4 satellite): warm-up covers

@@ -1,0 +1,172 @@
+"""Trace-capture CLI (ISSUE 10 tentpole): drive a serving trace window
+and save Perfetto-loadable chrome-trace JSON.
+
+Against a live GenerationServer::
+
+    python tools/trace_capture.py --url=http://host:port --seconds=5 \
+        --out=trace.json [--request=<id>]
+
+opens the capture window over HTTP (``POST /debug/trace/start``),
+sleeps the requested wall time while real traffic flows, closes it
+(``POST /debug/trace/stop``), downloads ``GET /debug/trace``, validates
+it against the trace-event schema and writes it to ``--out``.  With
+``--request=<id>`` the request's raw event timeline
+(``GET /debug/requests/<id>``) is printed too.
+
+Self-contained demo (CI lane; no server needed)::
+
+    python tools/trace_capture.py --demo --out=trace.json
+
+builds a tiny chunked-prefill engine server in-process, captures a
+short mixed workload through the SAME HTTP surface, and validates +
+writes the trace — one JSON summary line either way.  Exit 0 = a valid
+trace with engine-step and request events; 1 = broken.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _post(url: str, body=None) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def _get(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def capture(base: str, seconds: float, out_path: str,
+            request_id=None, load=None) -> dict:
+    """start -> (optional load/sleep) -> stop -> download -> validate.
+    ``load`` is an optional zero-arg callable run inside the window
+    (the demo's traffic generator); without one the window just sleeps
+    ``seconds`` while the live server's own traffic flows."""
+    from paddle_tpu.monitor import validate_chrome_trace
+
+    _post(base + "/debug/trace/start")
+    try:
+        if load is not None:
+            load()
+        else:
+            time.sleep(seconds)
+    finally:
+        _post(base + "/debug/trace/stop")
+    payload = _get(base + "/debug/trace")
+    problems = validate_chrome_trace(payload)
+    events = payload.get("traceEvents", [])
+    kinds = {}
+    for e in events:
+        kinds[e.get("ph")] = kinds.get(e.get("ph"), 0) + 1
+    summary = {
+        "lane": "trace-capture",
+        "url": base,
+        "out": out_path,
+        "events": len(events),
+        "phases": kinds,
+        "engine_steps": sum(1 for e in events
+                            if e.get("pid") == 1 and e.get("ph") == "X"),
+        "request_tracks": sum(1 for e in events
+                              if e.get("pid") == 2 and e.get("ph") == "B"),
+        "flow_events": sum(1 for e in events if e.get("ph") in ("s", "f")),
+        "host_spans": sum(1 for e in events
+                          if e.get("pid") == 3 and e.get("ph") == "X"),
+        "schema_problems": problems,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(payload, f)
+    if request_id:
+        # a missing timeline (id evicted from the bounded table, or
+        # never traced in this window) must not discard the trace the
+        # operator just captured — report it in the summary instead
+        try:
+            summary["request_timeline"] = _get(
+                base + f"/debug/requests/{request_id}")
+        except urllib.error.HTTPError as e:
+            summary["request_timeline"] = {
+                "request_id": request_id, "error": f"HTTP {e.code}"}
+    return summary
+
+
+def run_demo(out_path: str) -> dict:
+    """The self-contained lane: tiny chunked engine server, a mixed
+    wave of requests (chunked prefill + multi-row batch) through the
+    HTTP surface, captured and validated."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import GenerationServer
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=2,
+                      num_key_value_heads=2, max_position_embeddings=64)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    with GenerationServer(model, total_pages=64, page_size=8,
+                          max_batch=4, prefill_chunk_tokens=4) as srv:
+        base = f"http://{srv.host}:{srv.port}"
+
+        def load():
+            # a long chunked prompt with a pinned id + a 2-row batch
+            _post(base + "/generate",
+                  {"input_ids": [rng.integers(0, 64, 12).tolist()],
+                   "max_new_tokens": 4, "request_id": "demo-long"})
+            _post(base + "/generate",
+                  {"input_ids": rng.integers(0, 64, (2, 5)).tolist(),
+                   "max_new_tokens": 3, "request_id": "demo-batch"})
+
+        summary = capture(base, 0.0, out_path, request_id="demo-long",
+                          load=load)
+    summary["lane"] = "trace-capture-demo"
+    return summary
+
+
+def _arg(argv, name, default=None):
+    return next((a.split("=", 1)[1] for a in argv
+                 if a.startswith(f"--{name}=")), default)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    out_path = _arg(argv, "out", "trace.json")
+    if "--demo" in argv:
+        summary = run_demo(out_path)
+    else:
+        base = _arg(argv, "url")
+        if not base:
+            print("usage: trace_capture.py --url=http://host:port "
+                  "[--seconds=5] [--out=trace.json] [--request=<id>] "
+                  "| --demo [--out=trace.json]", file=sys.stderr)
+            return 2
+        summary = capture(base.rstrip("/"),
+                          float(_arg(argv, "seconds", "5")),
+                          out_path, request_id=_arg(argv, "request"))
+    print(json.dumps(summary, sort_keys=True))
+    if summary["schema_problems"]:
+        print(f"FAIL: trace failed schema validation: "
+              f"{summary['schema_problems']}", file=sys.stderr)
+        return 1
+    if summary["engine_steps"] <= 0 or summary["request_tracks"] <= 0 \
+            or summary["flow_events"] <= 0:
+        print("FAIL: trace is missing the engine-step track, request "
+              "tracks or flow events — nothing captured in the window",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,6 +140,15 @@ def run_bench(k: int = 4, dispatches: int = 4, single_steps: int = 8,
     audit = s_fused.audit_fused(par_batches)
     audit_errors = [f for f in audit.findings if f.severity == "error"]
 
+    # ---- cost/MFU accounting (ISSUE 10): price the SAME fused program
+    # the audit certified (fused_program_spec is the shared trace spec)
+    # — FLOPs per K-step dispatch feeds the train-lane MFU below
+    from paddle_tpu.analysis import cost as _cost
+    fn, cargs, _donate, cstatic = s_fused.fused_program_spec(par_batches)
+    cost_est = _cost.estimate_callable(fn, *cargs, static_argnums=cstatic,
+                                       name="TrainStep.run_steps",
+                                       publish=True)
+
     # ---- BEFORE: single-step dispatch + per-step forced host sync
     bench_step, _ = _build(vocab, hidden, layers, seed=1)
     warm = par_batches[0]
@@ -187,6 +196,14 @@ def run_bench(k: int = 4, dispatches: int = 4, single_steps: int = 8,
 
     single_sps = single_steps / single_wall
     fused_sps = n_fused_steps / fused_wall
+    # MFU over the fused measured window: analytical FLOPs actually
+    # dispatched (per-K-step program cost x dispatches) over peak x wall
+    # — the automated MFU ladder source (ISSUE 10; the ROADMAP's
+    # "report the MFU ladder every round" instruction)
+    dispatches_run = n_fused_steps // k if k else 0
+    peak = _cost.peak_flops()
+    mfu = _cost.record_mfu(cost_est.flops * dispatches_run, fused_wall,
+                           peak=peak)
     return {
         "k": k,
         "batch": batch,
@@ -209,6 +226,11 @@ def run_bench(k: int = 4, dispatches: int = 4, single_steps: int = 8,
         "input_wait_p50_s": hist_quantile(iw_b, 0.50),
         "input_wait_sum_s": iw_sum,
         "input_waits": iw_n,
+        # cost/MFU accounting (ISSUE 10)
+        "program_flops": cost_est.flops,
+        "program_hbm_bytes": cost_est.hbm_bytes,
+        "peak_flops": peak,
+        "mfu": mfu,
         # acceptance gates
         "parity_max_abs_diff": parity_diff,
         "parity_ok": parity_ok,
@@ -253,6 +275,11 @@ def main(argv=None) -> int:
         return 1
     if out["fused_steps_per_sec"] <= 0 or out["train_tokens"] <= 0:
         print("FAIL: fused window measured nothing", file=sys.stderr)
+        return 1
+    if out["program_flops"] <= 0 or out["mfu"] is None:
+        # ISSUE 10 acceptance: the train lane carries the MFU ladder
+        print("FAIL: cost analyzer produced no program FLOPs / MFU",
+              file=sys.stderr)
         return 1
     return 0
 
