@@ -1,6 +1,6 @@
 """paddle_tpu.analysis — static analysis for compiled TPU programs.
 
-Two tiers (the TPU-native analog of the reference's PIR inspection
+Three tiers (the TPU-native analog of the reference's PIR inspection
 passes — programs are checked *before* they run):
 
   * ``program_audit`` — trace any compiled surface (a callable for
@@ -10,8 +10,16 @@ passes — programs are checked *before* they run):
     promotion creep, missed buffer donation, recompile hazards.
   * ``lint`` — an AST sweep of the source tree for the patterns that
     *produce* those hazards (host concretization under jit, Python RNG
-    under trace, ``list.pop(0)`` hot loops, scheduler-lock discipline),
+    under trace, ``list.pop(0)`` hot loops, scheduler-lock discipline,
+    eager collectives inside compiled regions),
     ratcheted against ``tools/tpu_lint_baseline.json``.
+  * ``spmd`` — the distributed audit (ISSUE 11): collective extraction
+    + ICI pricing (jaxpr eqns for shard_map programs, optimized-HLO
+    scan for GSPMD-partitioned ones), a static peak-HBM live-buffer
+    estimate honoring donation, and sharding hazard rules
+    (replicated large params, implicit reshards, per-scan-iteration
+    collectives, unsharded KV pools).  ``analysis.cost`` (FLOPs/MFU)
+    rides alongside as the compute half of the roofline.
 
 Usage::
 
@@ -38,6 +46,13 @@ from .cost import (  # noqa: F401
     CostEstimate, estimate_jaxpr, estimate_callable, estimate_engine,
     peak_flops, record_mfu, publish_engine_cost,
 )
+from . import spmd  # noqa: F401
+from .spmd import (  # noqa: F401
+    CollectiveCost, SpmdAudit, audit_spmd_callable, audit_spmd_engine,
+    audit_spmd_fused, audit_spmd_jaxpr, collectives_from_jaxpr,
+    collectives_from_hlo_text, estimate_peak_hbm, link_bandwidth,
+    price_collective,
+)
 
 __all__ = [
     "Finding", "ProgramAudit", "audit_jaxpr", "audit_callable",
@@ -47,4 +62,8 @@ __all__ = [
     "cost", "CostEstimate", "estimate_jaxpr", "estimate_callable",
     "estimate_engine", "peak_flops", "record_mfu",
     "publish_engine_cost",
+    "spmd", "CollectiveCost", "SpmdAudit", "audit_spmd_callable",
+    "audit_spmd_engine", "audit_spmd_fused", "audit_spmd_jaxpr",
+    "collectives_from_jaxpr", "collectives_from_hlo_text",
+    "estimate_peak_hbm", "link_bandwidth", "price_collective",
 ]

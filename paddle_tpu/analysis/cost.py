@@ -130,6 +130,10 @@ class CostEstimate:
 
 
 # ---------------------------------------------------------------- pricing
+def _closed_of(j, jcore):
+    return j.jaxpr if isinstance(j, jcore.ClosedJaxpr) else j
+
+
 def _avals(vars_):
     out = []
     for v in vars_:
@@ -204,20 +208,17 @@ def _jaxpr_cost(jaxpr, by_prim: Dict[str, Tuple[float, float]],
     flops = 0.0
     nbytes = 0.0
 
-    def _closed(j):
-        return j.jaxpr if isinstance(j, jcore.ClosedJaxpr) else j
-
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         if name == "scan":
-            body = _closed(eqn.params["jaxpr"])
+            body = _closed_of(eqn.params["jaxpr"], jcore)
             trips = float(eqn.params.get("length", 1) or 1)
             f, b = _jaxpr_cost(body, by_prim, scale * trips)
             flops += f
             nbytes += b
             continue
         if name == "cond":
-            branches = [_closed(br)
+            branches = [_closed_of(br, jcore)
                         for br in eqn.params.get("branches", ())]
             if branches:
                 costs = []
@@ -231,12 +232,37 @@ def _jaxpr_cost(jaxpr, by_prim: Dict[str, Tuple[float, float]],
                 flops += f * scale
                 nbytes += b * scale
                 continue
+        if name in ("remat2", "remat", "checkpoint"):
+            # remat bodies (ISSUE 11 satellite): the differentiated
+            # remat eqn carries BOTH the recompute forward and the
+            # backward in one sub-jaxpr — price it fully, or remat'd
+            # training programs are underpriced by the whole recompute
+            # (FLOPs and HBM both)
+            f, b = _jaxpr_cost(_closed_of(eqn.params["jaxpr"], jcore),
+                               by_prim, scale)
+            flops += f
+            nbytes += b
+            continue
+        if name.startswith("custom_vjp_call") or \
+                name.startswith("custom_jvp_call"):
+            # custom-derivative wrappers: ONLY the traced primal body
+            # (fun_jaxpr/call_jaxpr) is priced — the fwd/bwd entries in
+            # params are thunks, not jaxprs, and blindly walking every
+            # param would double-count when a version materializes both
+            key = next((k for k in ("fun_jaxpr", "call_jaxpr", "jaxpr")
+                        if k in eqn.params), None)
+            if key is not None:
+                f, b = _jaxpr_cost(_closed_of(eqn.params[key], jcore),
+                                   by_prim, scale)
+                flops += f
+                nbytes += b
+                continue
         subs = []
         for val in eqn.params.values():
             subs.extend(_subjaxprs_of(val, jcore))
         if subs:
-            # pjit / while / custom_jvp / remat / pallas_call bodies:
-            # each sub-jaxpr priced once (a while's unknown trip count
+            # pjit / while / shard_map / pallas_call bodies: each
+            # sub-jaxpr priced once (a while's unknown trip count
             # is deliberately floored at 1 — documented underestimate)
             for sub in subs:
                 f, b = _jaxpr_cost(sub, by_prim, scale)
@@ -333,9 +359,25 @@ def publish_engine_cost(engine, mode: str = "decode",
     engine's decode program, publish the ``program_*`` gauges, and
     derive a process-lifetime MFU from the monitor's own counters
     (``generated_tokens_total`` × per-token FLOPs over the summed
-    ``decode_step_seconds``).  Returns the JSON-able summary."""
+    ``decode_step_seconds``).  Returns the JSON-able summary; the
+    ``spmd`` group (ISSUE 11) carries the tier-3 distributed audit —
+    static peak HBM, priced collective bytes/ICI seconds, hazard
+    count — and publishes ``program_peak_hbm_bytes`` /
+    ``collective_bytes_total`` / ``ici_time_seconds`` alongside.
+    The endpoint stays cheap: ONE jaxpr trace serves both tiers (the
+    spmd audit carries its CostEstimate), and the HLO tier is off
+    (``compiled=False``) — a meshed deployment wanting GSPMD
+    collectives runs ``analysis.audit_spmd_engine(engine)`` offline."""
     from .. import monitor
-    est = estimate_engine(engine, mode=mode, publish=True)
+    from .spmd import audit_spmd_engine
+    try:
+        sa = audit_spmd_engine(engine, mode=mode, compiled=False,
+                               publish=True)
+        est = sa.cost
+        est.publish()
+    except Exception:   # noqa: BLE001 — tier 3 never breaks /debug
+        sa = None
+        est = estimate_engine(engine, mode=mode, publish=True)
     flops_per_token = est.flops / max(1, engine.max_batch)
     reg = monitor.get_registry()
     tokens_m = reg.get("generated_tokens_total")
@@ -345,7 +387,7 @@ def publish_engine_cost(engine, mode: str = "decode",
     pk = peak_flops() if peak is None else float(peak)
     mfu = record_mfu(tokens * flops_per_token, dec_sum, peak=pk) \
         if dec_sum > 0 else record_mfu(0.0, 1.0, peak=pk)
-    return {
+    out = {
         "program": est.name,
         "program_flops": est.flops,
         "program_hbm_bytes": est.hbm_bytes,
@@ -356,3 +398,17 @@ def publish_engine_cost(engine, mode: str = "decode",
         "peak_flops": pk,
         "mfu": mfu,
     }
+    if sa is not None:
+        out["spmd"] = {
+            "peak_hbm_bytes": sa.peak_hbm_bytes,
+            "collective_bytes_total": sa.collective_bytes_total,
+            "ici_time_seconds": sa.ici_time_seconds,
+            "comm_compute_ratio": sa.comm_compute_ratio,
+            "comm_bound": sa.comm_bound,
+            "mesh_axes": sa.mesh_axes,
+            "collectives": len(sa.collectives),
+            "findings": len(sa.findings),
+        }
+    else:
+        out["spmd"] = {"error": "spmd audit unavailable"}
+    return out

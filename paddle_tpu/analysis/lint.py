@@ -26,6 +26,15 @@ bad programs or wedge the serving hot path:
           serializes every step on a device round-trip.  Reads gated
           behind an ``if`` (log/epoch boundaries) are the sanctioned
           pattern and exempt.
+  TPL006  eager collective wrapper inside a compiled/scanned region —
+          the ``distributed/collective.py`` APIs (``dist.all_reduce``
+          and friends) dispatch their own shard_map program per call
+          and sync host-side state (groups, monitor counters); traced
+          under ``jit``/``to_static`` or inside a ``lax.scan`` body
+          they either fail to trace or smuggle a host round-trip into
+          the compiled program.  Compiled regions must use the traced
+          psum-family primitives (``jax.lax.psum`` / ``all_gather`` /
+          ... under ``shard_map``) — which are exempt.
 
 Scope detection is LEXICAL and per-file: a function counts as jitted
 when it is decorated with ``jax.jit``/``functools.partial(jax.jit,
@@ -80,6 +89,12 @@ RULES: Dict[str, Tuple[str, str, str]] = {
                "keep step results device-resident (async dispatch) and "
                "force them only at log/epoch boundaries — gate the read "
                "behind a boundary condition"),
+    "TPL006": (SEVERITY_ERROR,
+               "eager collective wrapper inside a compiled/scanned "
+               "region",
+               "use the traced primitive (jax.lax.psum / all_gather / "
+               "psum_scatter under shard_map) inside compiled code, or "
+               "hoist the eager collective out of the jit/scan region"),
 }
 
 _CONCRETIZE_BUILTINS = {"float", "int", "bool"}
@@ -91,6 +106,19 @@ _TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic"}
 _MUTATOR_METHODS = {"append", "appendleft", "extend", "extendleft",
                     "pop", "popleft", "remove", "clear", "insert", "add",
                     "discard", "update", "setdefault"}
+
+#: the eager collective API surface (distributed/collective.py): each
+#: wrapper dispatches its own shard_map program and touches host-side
+#: group/monitor state per call — never traceable (TPL006)
+_EAGER_COLLECTIVES = {
+    "all_reduce", "all_gather", "all_gather_object", "reduce_scatter",
+    "broadcast", "reduce", "scatter", "all_to_all", "alltoall",
+    "send", "recv", "isend", "irecv", "barrier",
+}
+#: dotted-call bases that unambiguously name the eager API (a bare
+#: `reduce(...)` only counts when the file imports it from the
+#: distributed package — see _eager_collective_imports)
+_EAGER_COLLECTIVE_BASES = ("dist", "collective", "distributed")
 
 #: lock-discipline configuration: class name -> (lock attr, guarded attrs).
 #: Today this covers the continuous-batching engine (ISSUE 3); add
@@ -174,15 +202,91 @@ def _jitted_local_names(tree) -> Set[str]:
     return names
 
 
+_LAX_LOOPS = ("scan", "while_loop", "fori_loop")
+
+
+def _lax_loop_imports(tree) -> Dict[str, str]:
+    """alias -> canonical lax-loop name for ``from jax.lax import
+    scan``-style bindings — the only case a BARE loop call counts
+    (mirrors _eager_collective_imports: a local ``table.scan`` or a
+    user-defined ``scan`` helper must not mark its callback as traced
+    code)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        module = node.module or ""
+        if module != "jax.lax" and not module.endswith(".lax"):
+            continue
+        for alias in node.names:
+            if alias.name in _LAX_LOOPS:
+                out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def _scanned_local_names(tree) -> Set[str]:
+    """Function names the file passes as a ``jax.lax`` loop body — the
+    ``lax.scan(body, ...)`` / ``lax.while_loop(cond, body, ...)`` /
+    ``lax.fori_loop(lo, hi, body, ...)`` idiom.  Their bodies trace
+    exactly like jitted code (TPL006)."""
+    lax_imports = _lax_loop_imports(tree)
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        canonical = lax_imports.get(dotted)
+        if canonical is None:
+            canonical = next(
+                (nm for nm in _LAX_LOOPS
+                 if dotted == f"lax.{nm}"
+                 or dotted.endswith(f".lax.{nm}")), None)
+        if canonical == "scan":
+            args = node.args[:1]
+        elif canonical == "while_loop":
+            args = node.args[:2]
+        elif canonical == "fori_loop":
+            args = node.args[2:3]
+        else:
+            continue
+        for a in args:
+            if isinstance(a, ast.Name):
+                names.add(a.id)
+    return names
+
+
+def _eager_collective_imports(tree) -> Set[str]:
+    """Bare names this file imports FROM the distributed package that
+    shadow an eager collective (``from paddle_tpu.distributed import
+    all_reduce``) — the only case a bare call counts for TPL006."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        module = node.module or ""
+        if "distributed" not in module and \
+                not module.endswith("collective"):
+            continue
+        for alias in node.names:
+            if alias.name in _EAGER_COLLECTIVES:
+                names.add(alias.asname or alias.name)
+    return names
+
+
 class _Linter(ast.NodeVisitor):
     def __init__(self, path: str, source_lines: Sequence[str],
-                 jitted_names: Set[str]):
+                 jitted_names: Set[str],
+                 scanned_names: Set[str] = frozenset(),
+                 collective_imports: Set[str] = frozenset()):
         self.path = path
         self.lines = source_lines
         self.jitted_names = jitted_names
+        self.scanned_names = scanned_names
+        self.collective_imports = collective_imports
         self.findings: List[LintFinding] = []
         self.scope: List[str] = []
         self.jit_depth = 0
+        self.scan_depth = 0
         self.class_stack: List[str] = []
         self.lock_depth = 0
 
@@ -213,12 +317,15 @@ class _Linter(ast.NodeVisitor):
     def _visit_function(self, node):
         jitted = (any(_decorator_marks_jit(d) for d in node.decorator_list)
                   or node.name in self.jitted_names)
+        scanned = node.name in self.scanned_names
         self.scope.append(node.name)
         self.jit_depth += 1 if jitted else 0
+        self.scan_depth += 1 if scanned else 0
         saved_lock = self.lock_depth
         self.lock_depth = 0           # lock scopes never span functions
         self.generic_visit(node)
         self.lock_depth = saved_lock
+        self.scan_depth -= 1 if scanned else 0
         self.jit_depth -= 1 if jitted else 0
         self.scope.pop()
 
@@ -293,7 +400,29 @@ class _Linter(ast.NodeVisitor):
 
         if self.jit_depth > 0:
             self._check_jit_scope_call(node, func, dotted)
+        if self.jit_depth > 0 or self.scan_depth > 0:
+            self._check_eager_collective(node, func, dotted)
         self.generic_visit(node)
+
+    def _check_eager_collective(self, node, func, dotted):
+        """TPL006: an eager distributed/collective.py wrapper in traced
+        code.  jax.lax primitives (the sanctioned in-program form) are
+        exempt; bare names only count when the file imported them from
+        the distributed package."""
+        if dotted.startswith("jax.") or ".lax." in dotted \
+                or dotted.startswith("lax."):
+            return
+        if isinstance(func, ast.Attribute):
+            if func.attr not in _EAGER_COLLECTIVES:
+                return
+            base = _dotted(func.value)
+            base_tail = base.rsplit(".", 1)[-1]
+            if base_tail not in _EAGER_COLLECTIVE_BASES:
+                return
+            self._emit("TPL006", node, f"{dotted}()")
+        elif isinstance(func, ast.Name) \
+                and func.id in self.collective_imports:
+            self._emit("TPL006", node, f"{func.id}()")
 
     def _check_jit_scope_call(self, node, func, dotted):
         # TPL001: builtins that force concretization (constant / len()
@@ -476,7 +605,9 @@ def _lint_training_loops(tree, path: str,
 # ------------------------------------------------------------ tree sweep
 def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
     tree = ast.parse(source)
-    linter = _Linter(path, source.splitlines(), _jitted_local_names(tree))
+    linter = _Linter(path, source.splitlines(), _jitted_local_names(tree),
+                     _scanned_local_names(tree),
+                     _eager_collective_imports(tree))
     linter.visit(tree)
     linter.findings.extend(
         _lint_training_loops(tree, path, source.splitlines()))

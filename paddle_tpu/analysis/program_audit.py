@@ -99,6 +99,9 @@ class ProgramAudit:
     def __init__(self, name: str, findings: Sequence[Finding]):
         self.name = name
         self.findings = list(findings)
+        #: the tier-3 distributed audit (analysis.spmd), attached by
+        #: audit_engine / TrainStep.audit_fused when a mesh is present
+        self.spmd = None
 
     @property
     def errors(self) -> List[Finding]:
@@ -588,19 +591,27 @@ def engine_program_spec(engine, mode: str = "decode", sample=None):
     W = next_pow2(max(1, -(-engine.max_position // cache.page_size)))
     sds = jax.ShapeDtypeStruct
     i32 = jnp.int32
-    params = [sds(tuple(a.shape), a.dtype)
-              for a in decoder._param_arrays()]
-    k_pages = tuple(sds(tuple(a.shape), a.dtype) for a in cache.k_pages)
-    v_pages = tuple(sds(tuple(a.shape), a.dtype) for a in cache.v_pages)
+    def _named_sharding(a):
+        # carried so the SPMD tier (ISSUE 11) can see the program's
+        # real placements: mesh-presence detection and the replicated-
+        # param / unsharded-pool rules key on these (make_jaxpr and
+        # the tier-1 rules ignore the field)
+        from jax.sharding import NamedSharding
+        sh = getattr(a, "sharding", None)
+        return sh if isinstance(sh, NamedSharding) else None
+
+    def sds_of(a):
+        return sds(tuple(a.shape), a.dtype, sharding=_named_sharding(a))
+
+    params = [sds_of(a) for a in decoder._param_arrays()]
+    k_pages = tuple(sds_of(a) for a in cache.k_pages)
+    v_pages = tuple(sds_of(a) for a in cache.v_pages)
     # quantized serving (ISSUE 9): the scale pools and per-channel
     # weight scales ride as traced operands — empty tuples otherwise,
     # exactly the call contract the decoder jits
-    k_scales = tuple(sds(tuple(a.shape), a.dtype)
-                     for a in cache.k_scales)
-    v_scales = tuple(sds(tuple(a.shape), a.dtype)
-                     for a in cache.v_scales)
-    wscales = tuple(sds(tuple(s.shape), s.dtype)
-                    for s in decoder._wscale_args())
+    k_scales = tuple(sds_of(a) for a in cache.k_scales)
+    v_scales = tuple(sds_of(a) for a in cache.v_scales)
+    wscales = tuple(sds_of(s) for s in decoder._wscale_args())
     pools = (k_pages, v_pages, k_scales, v_scales, wscales)
     quantized = bool(getattr(engine, "quantize", None)
                      or getattr(engine, "kv_quant", None))
@@ -679,14 +690,30 @@ def audit_engine(engine, mode: str = "decode", sample=None,
     chunk loop is transfer-free with donation intact — interleaving
     chunk sizes can never smuggle a host sync into the serving loop.
     ``per_row_budget`` is the allowed host-transfer bytes per batch row
-    (ids are 4; ids + accept are 8; a logits row is vocab*4)."""
+    (ids are 4; ids + accept are 8; a logits row is vocab*4).
+
+    When the program's operands carry NamedShardings over a >1 mesh,
+    the tier-3 SPMD audit (``analysis.spmd``) runs automatically: its
+    sharding-hazard findings merge into this audit and the full
+    distributed audit rides on ``audit.spmd``."""
     fn, donate, args, meta = engine_program_spec(engine, mode, sample)
     limits.setdefault("output_transfer_bytes",
                       meta["batch"] * per_row_budget)
-    return audit_callable(
+    audit = audit_callable(
         fn, *args, donate_argnums=donate, name=meta["name"],
         publish=publish, quantized=meta["quantized"],
         scale_lens=meta["scale_lens"], **limits)
+    try:
+        import math as _math
+        from .spmd import audit_spmd_engine, mesh_axes_of_args
+        axes = mesh_axes_of_args(jtu.tree_leaves(tuple(args)))
+        if _math.prod(axes.values() or [1]) > 1:
+            audit.spmd = audit_spmd_engine(engine, mode=mode,
+                                           sample=sample, publish=publish)
+            audit.findings.extend(audit.spmd.findings)
+    except Exception:   # noqa: BLE001 — tier 3 must never fail tier 1
+        pass
+    return audit
 
 
 def audit_program(program, feed, fetch_list=None, publish: bool = True,

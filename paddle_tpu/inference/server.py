@@ -310,7 +310,11 @@ class GenerationServer(_ServerLifecycle):
     spans) and ``GET /debug/requests/<id>`` returns one request's raw
     event timeline.  ``GET /debug/cost`` runs the analytical cost model
     over the decode program and publishes ``program_flops_total`` /
-    ``program_hbm_bytes`` / ``mfu`` to ``/metrics``.
+    ``program_hbm_bytes`` / ``mfu`` to ``/metrics``; its ``spmd``
+    group (ISSUE 11) adds the tier-3 distributed audit — static peak
+    HBM, priced collective bytes and analytic ICI seconds, sharding
+    hazard count — publishing ``program_peak_hbm_bytes`` /
+    ``collective_bytes_total`` / ``ici_time_seconds`` alongside.
     """
 
     def __init__(self, model, host: str = "127.0.0.1", port: int = 0,

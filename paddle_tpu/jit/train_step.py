@@ -720,12 +720,56 @@ class TrainStep:
         traces the EXACT operand list and donation contract run_steps
         executes (:meth:`fused_program_spec`) and returns the
         ProgramAudit.  The certification lane tools/train_bench.py
-        gates on: no host callbacks, donation intact, no f32 creep."""
+        gates on: no host callbacks, donation intact, no f32 creep.
+
+        When the step's operands carry NamedShardings over a >1 mesh
+        (DataParallel / sharded optimizer state), the tier-3 SPMD
+        audit (``analysis.spmd``) runs automatically: gradient-sync
+        collectives are named and priced (the HLO tier sees the
+        GSPMD-inserted all-reduces no jaxpr walk can), its hazard
+        findings merge into this audit, and the full distributed audit
+        rides on ``audit.spmd``."""
         from ..analysis import audit_callable
         fn, args, donate, static = self.fused_program_spec(batches)
-        return audit_callable(
+        audit = audit_callable(
             fn, *args, donate_argnums=donate, static_argnums=static,
             name="TrainStep.run_steps", **limits)
+        try:
+            import math as _math
+            from ..analysis.spmd import (audit_spmd_fused,
+                                         mesh_axes_of_args)
+            axes = mesh_axes_of_args(jtu.tree_leaves(tuple(
+                a for i, a in enumerate(args) if i not in static)))
+            if _math.prod(axes.values() or [1]) > 1:
+                audit.spmd = audit_spmd_fused(
+                    self, batches, publish=limits.get("publish", True))
+                audit.findings.extend(audit.spmd.findings)
+        except Exception:   # noqa: BLE001 — tier 3 must never fail tier 1
+            pass
+        return audit
+
+    def static_peak_hbm(self, inputs, labels=()) -> float:
+        """Static peak-HBM estimate of the single-step program
+        (``analysis.spmd.estimate_peak_hbm``: a buffer-lifetime walk
+        honoring the step's donation contract) — the memory-gate
+        pre-verdict ``bench.py`` quotes next to the measured
+        ``planned_peak_bytes``, available from a trace alone: no
+        compile, no device execution, so a gate-rejecting config costs
+        milliseconds instead of a failed run."""
+        import jax.numpy as jnp
+        from ..analysis.spmd import estimate_peak_hbm
+        in_leaves, label_leaves, treedefs, frozen = self._prepare_args(
+            inputs, labels)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        stepno = jnp.asarray(self.optimizer._global_step + 1, jnp.int32)
+        closed = jax.make_jaxpr(self._inner, static_argnums=(10,))(
+            self._arrays, self._states, self._masters, self._grad_accum,
+            frozen, lr, stepno, jnp.asarray(True), in_leaves,
+            label_leaves, treedefs)
+        donated = [a for tree in (self._arrays, self._states,
+                                  self._masters, self._grad_accum)
+                   for a in jtu.tree_leaves(tree)]
+        return estimate_peak_hbm(closed, donated_avals=donated)
 
     # -------------------------------------------------------------- analysis
     def _lower(self, in_leaves, label_leaves, treedefs, as_avals=False):

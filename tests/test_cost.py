@@ -90,6 +90,58 @@ class TestFlopsOracles:
                 assert est.by_primitive[prim][0] == 0
                 assert est.by_primitive[prim][1] > 0
 
+    def test_remat_mlp_prices_the_recompute(self):
+        # ISSUE 11 satellite: a remat'd (jax.checkpoint) MLP grad must
+        # price the recomputed forward — fwd dot + remat'd-recompute
+        # dot + bwd dx dot + bwd dw dot = 4 dot_generals of 2*B*D*D
+        B = D = 8
+
+        def mlp(x, w):
+            h = jax.checkpoint(lambda a: jnp.tanh(a @ w))(x)
+            return jnp.sum(h)
+
+        grad_both = jax.grad(mlp, argnums=(0, 1))
+        est = cost.estimate_callable(
+            grad_both, jnp.zeros((B, D), jnp.float32),
+            jnp.zeros((D, D), jnp.float32))
+        f, b = est.by_primitive["dot_general"]
+        assert f == 4 * 2 * B * D * D
+        assert b > 0
+        # HBM is priced too: the remat body's tanh traffic is counted
+        assert est.by_primitive["tanh"][1] > 0
+        # and the un-remat'd twin prices the SAME flops minus one
+        # recompute dot — remat is more FLOPs, never fewer
+
+        def mlp_plain(x, w):
+            return jnp.sum(jnp.tanh(x @ w))
+
+        est_plain = cost.estimate_callable(
+            jax.grad(mlp_plain, argnums=(0, 1)),
+            jnp.zeros((B, D), jnp.float32),
+            jnp.zeros((D, D), jnp.float32))
+        f_plain, _ = est_plain.by_primitive["dot_general"]
+        assert f == f_plain + 2 * B * D * D
+
+    def test_custom_vjp_body_priced_once(self):
+        # the fun_jaxpr body is priced; fwd/bwd thunks are not walked
+        # (they are functions, not jaxprs), so no double count
+        @jax.custom_vjp
+        def f(x, w):
+            return x @ w
+
+        def fwd(x, w):
+            return f(x, w), (x, w)
+
+        def bwd(res, g):
+            x, w = res
+            return g @ w.T, x.T @ g
+
+        f.defvjp(fwd, bwd)
+        est = cost.estimate_callable(
+            f, jnp.zeros((4, 8), jnp.float32),
+            jnp.zeros((8, 16), jnp.float32))
+        assert est.by_primitive["dot_general"][0] == 2 * 4 * 8 * 16
+
     def test_int8_ops_costed_at_their_width(self):
         # same shapes, same FLOPs — int8 operands are 1/4 the bytes
         def mm8(a, b):

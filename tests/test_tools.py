@@ -346,6 +346,52 @@ class TestTraceCapture:
         assert validate_chrome_trace(payload) == []
 
 
+class TestSpmdAuditGate:
+    """ISSUE 11 CI satellite: the SPMD-auditor CLI's demo lane —
+    hand-checkable collective pricing on the host's mesh (no TPU;
+    a CPU mesh of 1 prices ICI to zero, which is the correct verdict)
+    — runs green inside a 10 s budget."""
+
+    def _load(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "spmd_audit", os.path.join(REPO, "tools", "spmd_audit.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_demo_gate_within_budget(self, capsys):
+        import time
+        sa = self._load()
+        t0 = time.monotonic()
+        rc = sa.main([])
+        elapsed = time.monotonic() - t0
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        doc = json.loads(out.strip().splitlines()[-1])
+        assert doc["ok"]
+        # both demo programs priced with the ring formulas
+        (c,) = doc["dp_allreduce"]["collectives"]
+        n = c["group_size"]
+        assert c["kind"] == "all_reduce"
+        assert c["ici_bytes"] == pytest.approx(
+            2 * (n - 1) / n * c["payload_bytes"])
+        assert doc["tp_matmul"]["peak_hbm_bytes"] > 0
+        assert elapsed < 10, f"spmd gate took {elapsed:.1f}s (budget 10s)"
+
+    def test_train_lane_names_dp_collectives(self, capsys):
+        # dp>1 on the virtual CPU mesh: the GSPMD tier must name the
+        # gradient-sync all-reduces with non-zero priced bytes
+        sa = self._load()
+        rc = sa.main(["--train"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        doc = json.loads(out.strip().splitlines()[-1])
+        assert doc["ok"]
+        assert any(c["kind"] == "all_reduce" and c["ici_bytes"] > 0
+                   for c in doc["collectives"])
+
+
 class TestTpuLintGate:
     """ISSUE 3 CI satellite: the anti-pattern linter runs clean against
     its checked-in baseline, inside the tier-1 CPU lane's time budget."""

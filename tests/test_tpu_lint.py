@@ -297,3 +297,130 @@ class TestTrainingLoopSyncRule:
                     v = float(step(batch))
         """)
         assert [f.rule_id for f in found] == ["TPL005"]
+
+
+class TestEagerCollectiveRule:
+    """TPL006 (ISSUE 11 satellite): eager distributed/collective.py
+    wrappers inside jitted / to_static / scanned regions, where the
+    traced psum-family primitive is required."""
+
+    def test_dist_call_under_jit_flagged(self):
+        found = _lint("""
+            import jax
+            import paddle_tpu.distributed as dist
+            @jax.jit
+            def step(g):
+                dist.all_reduce(g)
+                return g
+        """)
+        assert [f.rule_id for f in found] == ["TPL006"]
+        assert found[0].severity == "error"
+        assert "all_reduce" in found[0].message
+
+    def test_scan_body_flagged(self):
+        # a lax.scan body traces exactly like jitted code even when
+        # nothing in the file is decorated
+        found = _lint("""
+            import jax
+            import paddle_tpu.distributed as dist
+            def run(xs):
+                def body(c, x):
+                    dist.all_reduce(x)
+                    return c, x
+                return jax.lax.scan(body, 0, xs)
+        """)
+        assert [f.rule_id for f in found] == ["TPL006"]
+
+    def test_bare_import_under_jit_flagged(self):
+        found = _lint("""
+            import jax
+            from paddle_tpu.distributed import all_gather
+            @jax.jit
+            def step(xs, x):
+                all_gather(xs, x)
+                return x
+        """)
+        assert [f.rule_id for f in found] == ["TPL006"]
+
+    def test_traced_lax_primitives_exempt(self):
+        # jax.lax.psum / all_gather are the SANCTIONED in-program form
+        found = _lint("""
+            import jax
+            @jax.jit
+            def step(g):
+                g = jax.lax.psum(g, 'dp')
+                return jax.lax.all_gather(g, 'dp')
+        """)
+        assert found == []
+
+    def test_eager_scope_and_unrelated_names_exempt(self):
+        # eager (unjitted) collective calls are the API's job; a bare
+        # `reduce` that was never imported from distributed is not ours
+        found = _lint("""
+            import paddle_tpu.distributed as dist
+            from functools import reduce
+            def host_sync(g):
+                dist.all_reduce(g)
+                return reduce(lambda a, b: a + b, [1, 2])
+            import jax
+            @jax.jit
+            def f(x):
+                return reduce(lambda a, b: a + b, [x, x])
+        """)
+        assert found == []
+
+    def test_non_lax_scan_api_callback_exempt(self):
+        # `table.scan(handler)` (a DB/iterator API) must not mark its
+        # callback as traced code — only jax.lax loop bodies count
+        found = _lint("""
+            import paddle_tpu.distributed as dist
+            def handler(row):
+                dist.all_reduce(row)
+                return row
+            def drain(table):
+                return table.scan(handler)
+        """)
+        assert found == []
+
+    def test_local_scan_helper_exempt_but_lax_import_counts(self):
+        # a user-defined bare `scan` helper is not jax.lax.scan; a
+        # `from jax.lax import scan` binding is
+        found = _lint("""
+            import paddle_tpu.distributed as dist
+            def scan(fn, items):
+                return [fn(None, i) for i in items]
+            def body(c, x):
+                dist.all_reduce(x)
+                return c, x
+            def run(items):
+                return scan(body, items)
+        """)
+        assert found == []
+        found = _lint("""
+            from jax.lax import scan
+            import paddle_tpu.distributed as dist
+            def body(c, x):
+                dist.all_reduce(x)
+                return c, x
+            def run(xs):
+                return scan(body, 0, xs)
+        """)
+        assert [f.rule_id for f in found] == ["TPL006"]
+
+    def test_fori_loop_body_flagged(self):
+        found = _lint("""
+            import jax
+            import paddle_tpu.distributed as dist
+            def run(x):
+                def body(i, c):
+                    dist.all_reduce(c)
+                    return c
+                return jax.lax.fori_loop(0, 4, body, x)
+        """)
+        assert [f.rule_id for f in found] == ["TPL006"]
+
+    def test_tree_has_no_tpl006(self):
+        # the ISSUE 11 bar: the ratchet stays EMPTY for this rule
+        findings = lint.lint_paths(os.path.join(REPO, "paddle_tpu"),
+                                   rel_to=REPO)
+        assert [f for f in findings if f.rule_id == "TPL006"] == []

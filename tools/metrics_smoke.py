@@ -10,7 +10,9 @@ model via GET /debug/cost, then scrapes GET /metrics and asserts the
 Prometheus exposition parses and carries the acceptance series —
 requests_total / request_latency_seconds / generated_tokens_total plus
 the ISSUE 10 series (mfu, program_flops_total, program_hbm_bytes,
-trace_captures_total, trace_events_total).  Exit 0 = healthy, 1 =
+trace_captures_total, trace_events_total) and the ISSUE 11 spmd series
+(program_peak_hbm_bytes, collective_bytes_total, ici_time_seconds,
+published by /debug/cost's tier-3 group).  Exit 0 = healthy, 1 =
 broken — the tier-1 suite runs main() via tests/test_tools.py, and
 `python tools/metrics_smoke.py` is the standalone CI lane.
 """
@@ -116,6 +118,13 @@ def main() -> int:
         if not cost.get("program_flops", 0) > 0:
             print(f"FAIL: /debug/cost returned {cost}", file=sys.stderr)
             return 1
+        # ISSUE 11: the spmd group must carry a real static HBM
+        # verdict (collective totals are legitimately zero on the
+        # meshless CPU engine — that IS the correct pricing)
+        if not cost.get("spmd", {}).get("peak_hbm_bytes", 0) > 0:
+            print(f"FAIL: /debug/cost spmd group missing or empty: "
+                  f"{cost.get('spmd')}", file=sys.stderr)
+            return 1
         with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
             ctype = resp.headers.get("Content-Type", "")
             text = resp.read().decode()
@@ -132,7 +141,10 @@ def main() -> int:
                 "request_latency_seconds_count", "generated_tokens_total",
                 # ISSUE 10: trace + cost/MFU series must be scrapeable
                 "mfu", "program_flops_total", "program_hbm_bytes",
-                "trace_captures_total", "trace_events_total")
+                "trace_captures_total", "trace_events_total",
+                # ISSUE 11: the spmd auditor's series must be scrapeable
+                "program_peak_hbm_bytes", "collective_bytes_total",
+                "ici_time_seconds")
     missing = [name for name in required if name not in samples]
     if missing:
         print(f"FAIL: exposition missing {missing}", file=sys.stderr)

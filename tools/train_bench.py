@@ -149,6 +149,22 @@ def run_bench(k: int = 4, dispatches: int = 4, single_steps: int = 8,
                                        name="TrainStep.run_steps",
                                        publish=True)
 
+    # ---- SPMD/memory audit (ISSUE 11): the tier-3 distributed audit
+    # of the SAME fused program (collectives priced — zero on the
+    # single-device CI lane, which is the correct verdict — plus the
+    # static peak-HBM estimate), and the predicted-vs-measured HBM
+    # check on the single-step program: the static estimate must bound
+    # XLA's own compiled memory analysis from above (fusion-blind
+    # upper bound), or the memory-gate pre-verdict would under-plan
+    from paddle_tpu.analysis import spmd as _spmd
+    spmd_audit = _spmd.audit_spmd_fused(s_fused, par_batches,
+                                        compiled=False, publish=True)
+    x0, y0 = par_batches[0]
+    predicted_peak = s_fused.static_peak_hbm(x0, y0)
+    mem = s_fused.memory_analysis(x0, y0)
+    import bench as _bench
+    measured_peak = _bench.planned_peak_bytes(mem)
+
     # ---- BEFORE: single-step dispatch + per-step forced host sync
     bench_step, _ = _build(vocab, hidden, layers, seed=1)
     warm = par_batches[0]
@@ -231,6 +247,21 @@ def run_bench(k: int = 4, dispatches: int = 4, single_steps: int = 8,
         "program_hbm_bytes": cost_est.hbm_bytes,
         "peak_flops": peak,
         "mfu": mfu,
+        # SPMD/memory audit (ISSUE 11): static HBM verdict (fused
+        # program) + predicted-vs-measured on the single-step program
+        "spmd": {
+            "peak_hbm_bytes": spmd_audit.peak_hbm_bytes,
+            "collective_bytes_total": spmd_audit.collective_bytes_total,
+            "ici_time_seconds": spmd_audit.ici_time_seconds,
+            "comm_compute_ratio": spmd_audit.comm_compute_ratio,
+            "mesh_axes": spmd_audit.mesh_axes,
+            "collectives": len(spmd_audit.collectives),
+            "findings": len(spmd_audit.findings),
+        },
+        "static_peak_hbm_bytes": predicted_peak,
+        "measured_peak_hbm_bytes": measured_peak,
+        "peak_hbm_ratio": (predicted_peak / measured_peak
+                           if measured_peak else None),
         # acceptance gates
         "parity_max_abs_diff": parity_diff,
         "parity_ok": parity_ok,
@@ -279,6 +310,22 @@ def main(argv=None) -> int:
     if out["program_flops"] <= 0 or out["mfu"] is None:
         # ISSUE 10 acceptance: the train lane carries the MFU ladder
         print("FAIL: cost analyzer produced no program FLOPs / MFU",
+              file=sys.stderr)
+        return 1
+    if out["spmd"]["peak_hbm_bytes"] <= 0 \
+            or out["static_peak_hbm_bytes"] <= 0:
+        print("FAIL: spmd auditor produced no peak-HBM estimate",
+              file=sys.stderr)
+        return 1
+    if out["measured_peak_hbm_bytes"] > 0 \
+            and out["static_peak_hbm_bytes"] < \
+            out["measured_peak_hbm_bytes"]:
+        # ISSUE 11 acceptance: the static estimate is the memory
+        # gate's pessimistic planner — it must bound XLA's compiled
+        # memory analysis from above on every rung that runs
+        print(f"FAIL: static peak-HBM "
+              f"{out['static_peak_hbm_bytes']:.0f} B under-plans the "
+              f"measured {out['measured_peak_hbm_bytes']:.0f} B",
               file=sys.stderr)
         return 1
     return 0
