@@ -154,45 +154,53 @@ class CommTaskManager:
 
     def _scan_loop(self) -> None:
         while not self._stop.wait(self._scan_interval):
-            now = time.monotonic()
-            with self._lock:
-                hung = [(tid, t) for tid, t in self._tasks.items()
-                        if t.is_timeout(now) and tid not in self._flagged]
-                for tid, _ in hung:
-                    self._flagged.add(tid)
-                _tasks_in_flight.set(len(self._tasks))
-                _oldest_task_age.set(
-                    max((now - t.started_at
-                         for t in self._tasks.values()), default=0.0))
-                beats = list(self._heartbeats.items())
-            for hid, (name, age_fn, timeout, on_timeout) in beats:
-                try:
-                    age = age_fn()
-                except Exception:       # noqa: BLE001 — probe must not
-                    continue            # kill the watchdog thread
-                if age is not None and age > timeout:
-                    fire = False
-                    with self._lock:
-                        if hid not in self._hb_flagged \
-                                and hid in self._heartbeats:
-                            self._hb_flagged.add(hid)
-                            fire = True
-                    if fire:
-                        stale = CommTask(name, timeout)
-                        stale.started_at = now - age
-                        hung.append((None, stale))
-                        if on_timeout is not None:
-                            try:
-                                on_timeout()
-                            except Exception:   # noqa: BLE001 — a
-                                pass            # reactor bug must not
-                                                # kill the watchdog
-                else:
-                    with self._lock:
-                        self._hb_flagged.discard(hid)
-            _heartbeat_ts.set(time.time())
-            for tid, t in hung:
-                self._on_timeout(t)
+            self.scan_once()
+
+    def scan_once(self) -> None:
+        """ONE watchdog scan pass (the loop body): flag hung tasks and
+        expired heartbeats, fire their handlers/callbacks, refresh the
+        gauges.  Public so tests — and operators debugging a wedged
+        process — can force a deterministic scan instead of tuning
+        ``_scan_interval`` races (ISSUE 13 satellite)."""
+        now = time.monotonic()
+        with self._lock:
+            hung = [(tid, t) for tid, t in self._tasks.items()
+                    if t.is_timeout(now) and tid not in self._flagged]
+            for tid, _ in hung:
+                self._flagged.add(tid)
+            _tasks_in_flight.set(len(self._tasks))
+            _oldest_task_age.set(
+                max((now - t.started_at
+                     for t in self._tasks.values()), default=0.0))
+            beats = list(self._heartbeats.items())
+        for hid, (name, age_fn, timeout, on_timeout) in beats:
+            try:
+                age = age_fn()
+            except Exception:       # noqa: BLE001 — probe must not
+                continue            # kill the watchdog thread
+            if age is not None and age > timeout:
+                fire = False
+                with self._lock:
+                    if hid not in self._hb_flagged \
+                            and hid in self._heartbeats:
+                        self._hb_flagged.add(hid)
+                        fire = True
+                if fire:
+                    stale = CommTask(name, timeout)
+                    stale.started_at = now - age
+                    hung.append((None, stale))
+                    if on_timeout is not None:
+                        try:
+                            on_timeout()
+                        except Exception:   # noqa: BLE001 — a
+                            pass            # reactor bug must not
+                                            # kill the watchdog
+            else:
+                with self._lock:
+                    self._hb_flagged.discard(hid)
+        _heartbeat_ts.set(time.time())
+        for tid, t in hung:
+            self._on_timeout(t)
 
     def _on_timeout(self, task: CommTask) -> None:
         _timeouts_total.inc()

@@ -521,6 +521,16 @@ class ContinuousBatchingEngine:
     how long a preempted prefill may hold its page reservation (aging
     boost at half the TTL, reaped with pages reclaimed past it).
 
+    Durability (ISSUE 13): pass ``journal`` (a
+    :class:`~paddle_tpu.inference.journal.RequestJournal`) and every
+    request state transition — admission, one coalesced token-emission
+    record per engine step, retirement — is appended to the
+    write-ahead journal by its dedicated writer thread, so a restarted
+    process reconstructs the live set after a SIGKILL/OOM-kill and
+    resumes every admitted request bit-exactly through the replay
+    admission path (the journal generalizes :meth:`snapshot` from a
+    cooperative cut to an always-current one).
+
     Observability (ISSUE 10): every request carries a stable
     ``request_id`` (``submit(request_id=...)`` or server-assigned,
     preserved across snapshot/restore) keying a bounded result cache
@@ -548,7 +558,8 @@ class ContinuousBatchingEngine:
                  quantize: Optional[str] = None,
                  kv_quant: Optional[str] = None,
                  replay_batch: Optional[bool] = None,
-                 result_cache_size: int = 256):
+                 result_cache_size: int = 256,
+                 journal=None):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_position = int(model.config.max_position_embeddings)
@@ -670,6 +681,19 @@ class ContinuousBatchingEngine:
         # via result_for() / GET /result/<id>
         self.result_cache_size = max(0, int(result_cache_size))
         self._results: "OrderedDict[str, dict]" = OrderedDict()
+        # write-ahead request journal (ISSUE 13): every probe below is
+        # one None check when no journal is attached.  Producers only
+        # ENQUEUE (the journal's writer thread owns all I/O), so the
+        # _cond hot path never waits on a disk.  _jadm/_jrows
+        # accumulate the scheduler thread's per-iteration coalesced
+        # step record (admitted ids + per-row token emissions); admit
+        # and retire records are appended at their own sites.  The
+        # engine's hard stop() path deliberately journals NOTHING —
+        # "engine stopped" is process-death-adjacent, and the journal's
+        # whole point is that a relaunch resumes exactly that state.
+        self.journal = journal
+        self._jadm: List[str] = []
+        self._jrows: List[tuple] = []
         self._cond = threading.Condition()
         self._stop = False
         self._draining = False
@@ -851,6 +875,11 @@ class ContinuousBatchingEngine:
                 err = EngineSaturated(str(e))
                 err.priority_class = e.priority_class
                 raise err from None
+            if self.journal is not None:
+                # journal the admission BEFORE the request is visible
+                # to the scheduler thread, so its step/retire records
+                # can never precede the admit record in the log
+                self.journal.append_admit(self._journal_entry(req))
             _queue_depth.set(len(self._sched))
             _tracer.request_event(
                 req.request_id, "enqueue", cls=req.priority,
@@ -938,6 +967,72 @@ class ContinuousBatchingEngine:
             else:
                 depth = len(self._sched)
         return retry_after_seconds(depth, _decode_p50_seconds())
+
+    # ------------------------------------- write-ahead journal (ISSUE 13)
+    @staticmethod
+    def _entry_fields(r) -> dict:
+        """The request fields BOTH persistence formats — the
+        cooperative snapshot entry and the write-ahead journal's admit
+        record — serialize identically.  One builder, so a field added
+        to the request can never restore on one recovery path and be
+        silently dropped on the other (the formats differ only in how
+        they carry generation state and deadlines)."""
+        return {
+            # the stable client-visible id survives the restart — a
+            # client holding it re-attaches via GET /result/<id> on
+            # the restored process (ISSUE 10)
+            "request_id": r.request_id,
+            "max_new_tokens": r.max_new_tokens,
+            "eos_token_id": (None if r.eos_token_id is None
+                             else int(r.eos_token_id)),
+            "do_sample": r.do_sample,
+            "temperature": r.temperature,
+            "seed": r.seed,
+            "priority": r.priority,
+            "tenant": r.tenant,
+            "draft": bool(r.use_draft),
+        }
+
+    def _journal_entry(self, req) -> dict:
+        """The admit record's payload: the FULL request state in the
+        snapshot-entry shape (a restored request carries its generated
+        tokens + pending next token, making journal replay idempotent
+        by request_id), with deadlines converted to absolute WALL-CLOCK
+        instants — a perf_counter deadline is meaningless in the next
+        process, and the recovery scan converts back to the
+        remaining-seconds fields restore() takes verbatim."""
+        now_p = time.perf_counter()
+        now_w = time.time()
+
+        def wall(d):
+            return None if d is None else now_w + (d - now_p)
+
+        return {
+            **self._entry_fields(req),
+            "prompt": req.prompt,            # np array; writer encodes
+            "generated": list(req.generated),
+            "next_token": (None if req.next_token is None
+                           else int(req.next_token)),
+            "deadline_unix": wall(req.deadline),
+            "queue_deadline_unix": wall(req.queue_deadline),
+        }
+
+    def _journal_retire(self, req) -> None:
+        if self.journal is None:
+            return
+        why = ("done" if req.error is None
+               else type(req.error).__name__)
+        self.journal.append_retire(req.request_id, why=why)
+
+    def _journal_flush_step(self) -> None:
+        """Scheduler thread, end of one loop iteration: ONE coalesced
+        step record — the ids admitted to a slot plus every surviving
+        row's (tokens appended, new pending next_token) — written off
+        the hot path by the journal's writer thread."""
+        if self.journal is not None and (self._jadm or self._jrows):
+            self.journal.append_step(self._jadm, self._jrows)
+        self._jadm = []
+        self._jrows = []
 
     # ---------------------------------------- request-id surface (ISSUE 10)
     def _cache_result_locked(self, req) -> None:
@@ -1028,23 +1123,11 @@ class ContinuousBatchingEngine:
         entries = []
         for r, prompt, generated, next_token in cuts:
             entries.append({
-                # the stable client-visible id survives the restart —
-                # a client holding it re-attaches via GET /result/<id>
-                # on the restored process (ISSUE 10)
-                "request_id": r.request_id,
+                **self._entry_fields(r),
                 "prompt": [int(t) for t in prompt],
                 "generated": [int(t) for t in generated],
                 "next_token": (None if next_token is None
                                else int(next_token)),
-                "max_new_tokens": r.max_new_tokens,
-                "eos_token_id": (None if r.eos_token_id is None
-                                 else int(r.eos_token_id)),
-                "do_sample": r.do_sample,
-                "temperature": r.temperature,
-                "seed": r.seed,
-                "priority": r.priority,
-                "tenant": r.tenant,
-                "draft": bool(r.use_draft),
                 "ttl_remaining_s": (
                     None if r.deadline is None
                     else max(1e-3, r.deadline - now)),
@@ -1148,6 +1231,7 @@ class ContinuousBatchingEngine:
                         "engine draining: request rejected before "
                         "admission (reject_queued fast path)")
                     self._cache_result_locked(r)
+                    self._journal_retire(r)
                 _queue_depth.set(0)
                 _drain_rejected.inc(len(rejected))
             self._cond.notify_all()
@@ -1230,6 +1314,7 @@ class ContinuousBatchingEngine:
             r.error = r._lifecycle_error(now, queued=True)
             self._count_lifecycle(r)
             self._cache_result_locked(r)
+            self._journal_retire(r)
             _tracer.request_event(r.request_id, "retire", ok=False)
             out.append(r)
         if out:
@@ -1374,6 +1459,10 @@ class ContinuousBatchingEngine:
         req.prefill_pos = req.prefix_tokens
         req.admitted_at = time.perf_counter()
         self._sched.note_admitted(req, req.admitted_at)
+        if self.journal is not None:
+            # the admitted marker drops the (satisfied) queue-wait
+            # deadline on recovery — the PR 8 snapshot convention
+            self._jadm.append(req.request_id)
         _tracer.request_event(
             req.request_id, "admitted", cls=req.priority,
             seq_id=req.seq_id, prefix_tokens=req.prefix_tokens,
@@ -1581,7 +1670,7 @@ class ContinuousBatchingEngine:
         else:
             sampling = _null_sampling()
         self._wedged.clear()      # only THIS dispatch may flag itself
-        self._step_started_at = time.monotonic()
+        t0 = self._step_started_at = time.monotonic()
         t_tr = _tracer.now_ns() if _tracer.enabled else 0
         try:
             if req.chunks_done == 0:
@@ -1595,14 +1684,14 @@ class ContinuousBatchingEngine:
         finally:
             self._step_started_at = None
         _last_step_ts.set(time.time())
-        if self._wedged.is_set():
+        try:
+            self._check_wedged(t0)      # same stale-fire guard as decode
+        except _EngineWedged:
             # the watchdog flagged this dispatch as wedged: its writes
-            # are suspect — roll the cache back to the chunk's start so
-            # the caller's rebuild + replay + retry is exact
-            self._wedged.clear()
+            # are suspect — roll the cache back to the chunk's start
+            # so the caller's rebuild + replay + retry is exact
             self.cache.truncate(req.seq_id, k)
-            raise _EngineWedged(
-                "prefill chunk exceeded the watchdog heartbeat timeout")
+            raise
         req.prefill_pos = k + n
         req.chunks_done += 1
         self._sched.note_chunk(req)
@@ -1658,6 +1747,10 @@ class ContinuousBatchingEngine:
         self._sched.note_first_token(req, ttft)
         _tracer.request_event(req.request_id, "first_token",
                               ttft_s=round(ttft, 6))
+        if self.journal is not None:
+            # prefill completion: no tokens appended yet, but the first
+            # pending sample is host state a SIGKILL must not lose
+            self._jrows.append((req.request_id, (), req.next_token))
         return True
 
     def _run_chunks(self, plan) -> None:
@@ -1769,6 +1862,7 @@ class ContinuousBatchingEngine:
             _gen_latency_s.observe(req.finished_at - req.submitted_at)
         self._sched.note_retired(req)   # per-class TPOT (no-op on error)
         self._cache_result_locked(req)
+        self._journal_retire(req)
         _tracer.request_event(
             req.request_id, "retire", ok=req.error is None,
             generated=len(req.generated),
@@ -2067,15 +2161,29 @@ class ContinuousBatchingEngine:
                 r.done.set()
         return caller_owned
 
-    def _check_wedged(self) -> None:
+    def _check_wedged(self, started_at: Optional[float] = None) -> None:
         """Consume the watchdog's wedge flag: raised as a step failure
         so the retry/bisect ladder (plus ``_after_step_failure``'s
-        rebuild) handles it like any other suspect step."""
-        if self._wedged.is_set():
-            self._wedged.clear()
-            raise _EngineWedged(
-                "decode step exceeded the watchdog heartbeat timeout; "
-                "treating its results as suspect")
+        rebuild) handles it like any other suspect step.
+
+        ``started_at`` guards against a STALE fire: the watchdog reads
+        the heartbeat age and invokes ``on_timeout`` as two separate
+        actions, so a fire aimed at a slow dispatch (e.g. a recovery
+        replay compiling a program) can be delivered AFTER the next
+        dispatch already cleared the flag — and without this guard
+        that fresh dispatch would be condemned, quarantining a healthy
+        single-row batch on its second "failure".  A dispatch that ran
+        for less than ``step_timeout_s`` provably did not wedge."""
+        if not self._wedged.is_set():
+            return
+        self._wedged.clear()
+        if started_at is not None and self.step_timeout_s is not None \
+                and time.monotonic() - started_at \
+                <= float(self.step_timeout_s):
+            return                   # stale fire: not this dispatch
+        raise _EngineWedged(
+            "decode step exceeded the watchdog heartbeat timeout; "
+            "treating its results as suspect")
 
     # ------------------------------------------------- decode + isolation
     def _spec_sampling_for(self, reqs, n: int):
@@ -2111,7 +2219,7 @@ class ContinuousBatchingEngine:
         # before its own _check_wedged, or a slow replay) must not
         # condemn this fresh step to a needless rebuild
         self._wedged.clear()
-        self._step_started_at = time.monotonic()
+        t0 = self._step_started_at = time.monotonic()
         try:
             _faults.maybe_fire("decode_step",
                                seq_ids=[r.seq_id for r in reqs])
@@ -2162,7 +2270,7 @@ class ContinuousBatchingEngine:
                             if self.sample_on_device else None)
                 out, accept = self._decoder.verify(
                     self.cache, seq_ids, block, pos, sampling=sampling)
-                self._check_wedged()
+                self._check_wedged(t0)
         finally:
             self._step_started_at = None
         _last_step_ts.set(time.time())
@@ -2237,7 +2345,7 @@ class ContinuousBatchingEngine:
         # per-step device->host transfer.  A wedge flag raised against
         # an earlier dispatch is stale here — drop it
         self._wedged.clear()
-        self._step_started_at = time.monotonic()
+        t0 = self._step_started_at = time.monotonic()
         try:
             _faults.maybe_fire("decode_step", seq_ids=seq_ids[:len(reqs)])
             _faults.maybe_fire("engine_wedge",
@@ -2246,7 +2354,7 @@ class ContinuousBatchingEngine:
                               histogram=_decode_step_s):
                 out_np = self._decoder.step(self.cache, seq_ids, tokens,
                                             pos, sampling=sampling)
-                self._check_wedged()
+                self._check_wedged(t0)
         finally:
             self._step_started_at = None
         _last_step_ts.set(time.time())
@@ -2365,6 +2473,8 @@ class ContinuousBatchingEngine:
                        (self.draft_cache.length(r.seq_id)
                         if self._spec and r.use_draft else None))
             for r in active}
+        jlens = ({id(r): len(r.generated) for r in active}
+                 if self.journal is not None else None)
         for r in active:
             r.generated.append(r.next_token)
         _active_seqs.set(len(active))
@@ -2464,6 +2574,17 @@ class ContinuousBatchingEngine:
             still.append(r)
         if accepted_emitted:
             _tokens_total.inc(accepted_emitted)
+        if self.journal is not None:
+            # one journal row per CONTINUING request: the tokens this
+            # step committed plus the new pending sample.  Retiring
+            # rows need no emission — their retire record (below, via
+            # _retire_locked) drops them from the live set, and a
+            # crash before that record replays their last step
+            # bit-identically anyway.
+            for r in still:
+                self._jrows.append(
+                    (r.request_id, list(r.generated[jlens[id(r)]:]),
+                     r.next_token))
         for r in poisoned:
             # the token recorded for this step never executed
             r.generated.pop()
@@ -2511,6 +2632,9 @@ class ContinuousBatchingEngine:
                     continue
                 r.error = exc
                 self._cache_result_locked(r)
+                # the error IS delivered to the waiter — terminal, so
+                # the journal must not resurrect it after a restart
+                self._journal_retire(r)
                 r.done.set()
             for r in holders:
                 if r.seq_id is not None:
@@ -2579,6 +2703,11 @@ class ContinuousBatchingEngine:
             except BaseException as e:  # noqa: BLE001 — fail loudly, not hang
                 self._fail_all(e)
             finally:
+                # ISSUE 13: the iteration's coalesced journal record —
+                # admitted ids + per-row emissions — enqueued ONCE per
+                # loop pass (rows for requests _fail_all just retired
+                # are ignored at replay: their retire precedes them)
+                self._journal_flush_step()
                 if self._stepping:
                     with self._cond:
                         self._stepping = False

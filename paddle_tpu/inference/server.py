@@ -299,6 +299,22 @@ class GenerationServer(_ServerLifecycle):
     reports ``snapshot_path`` and the restored-request count when the
     knob is set.
 
+    SIGKILL-grade durability (ISSUE 13): ``journal_dir`` supersedes
+    the cooperative snapshot with a WRITE-AHEAD request journal —
+    every admission/step/retirement is CRC-framed to disk as it
+    happens (``journal_fsync``: ``always`` / ``interval_ms`` / ``os``),
+    so a ``kill -9``, OOM-kill or power loss mid-decode loses nothing:
+    the restarted server scans the segments, reconstructs the live set
+    (admitted minus retired, journal deadlines verbatim) and resumes
+    every request bit-exactly before the listener opens, with
+    ``/result/<request_id>`` re-attaching across the hard restart
+    exactly as it does across SIGTERM.  The SIGTERM path collapses
+    onto the same format: the pre-drain "snapshot" is just
+    ``journal.flush(sync=True)`` (the WAL already holds everything)
+    and the post-drain refresh a final compaction.  ``/health``
+    reports the journal path, segment count and fsync policy;
+    ``journal_dir`` and ``snapshot_path`` are mutually exclusive.
+
     Observability (ISSUE 10): a request body may pin ``"request_id"``
     (multi-row bodies get ``<id>/<row>`` per row); the reply always
     carries ``"request_ids"``, and ``GET /result/<id>`` re-attaches to
@@ -333,25 +349,59 @@ class GenerationServer(_ServerLifecycle):
                  preempt_resume_ttl_s: Optional[float] = None,
                  quantize: Optional[str] = None,
                  kv_quant: Optional[str] = None,
-                 replay_batch: Optional[bool] = None):
+                 replay_batch: Optional[bool] = None,
+                 journal_dir: Optional[str] = None,
+                 journal_fsync: str = "interval_ms",
+                 journal_fsync_interval_ms: float = 50.0,
+                 journal_segment_bytes: int = 1 << 20,
+                 journal_fsync_timeout_s: Optional[float] = None):
         from .continuous import (ContinuousBatchingEngine,
                                  DeadlineExceeded, EngineDraining,
                                  EngineSaturated)
         from ..testing import faults as _faults
 
-        self._engine = ContinuousBatchingEngine(
-            model, total_pages=total_pages, page_size=page_size,
-            max_batch=max_batch, sample_on_device=sample_on_device,
-            prefix_cache=prefix_cache, max_queue=max_queue,
-            default_ttl_s=default_ttl_s, step_timeout_s=step_timeout_s,
-            draft_model=draft_model, spec_tokens=spec_tokens,
-            draft_total_pages=draft_total_pages,
-            prefill_chunk_tokens=prefill_chunk_tokens,
-            scheduler_classes=scheduler_classes,
-            min_table_pages=min_table_pages,
-            preempt_resume_ttl_s=preempt_resume_ttl_s,
-            quantize=quantize, kv_quant=kv_quant,
-            replay_batch=replay_batch)
+        if journal_dir and snapshot_path:
+            raise ValueError(
+                "journal_dir and snapshot_path are mutually exclusive: "
+                "the write-ahead journal supersedes the cooperative "
+                "snapshot (one persistence format, ISSUE 13)")
+        self._journal = None
+        self._journal_entries = []
+        if journal_dir:
+            from .journal import RequestJournal
+            # constructing the journal RECOVERS a predecessor's
+            # segments (crash-loop-safe: the live set is re-compacted
+            # into a fresh durable segment before the old ones are
+            # consumed) — the entries are resubmitted after the
+            # listener socket binds, mirroring the snapshot path
+            self._journal = RequestJournal(
+                journal_dir, fsync=journal_fsync,
+                fsync_interval_ms=journal_fsync_interval_ms,
+                segment_bytes=journal_segment_bytes,
+                fsync_timeout_s=journal_fsync_timeout_s)
+            self._journal_entries = self._journal.recovered_requests()
+        try:
+            self._engine = ContinuousBatchingEngine(
+                model, total_pages=total_pages, page_size=page_size,
+                max_batch=max_batch, sample_on_device=sample_on_device,
+                prefix_cache=prefix_cache, max_queue=max_queue,
+                default_ttl_s=default_ttl_s,
+                step_timeout_s=step_timeout_s,
+                draft_model=draft_model, spec_tokens=spec_tokens,
+                draft_total_pages=draft_total_pages,
+                prefill_chunk_tokens=prefill_chunk_tokens,
+                scheduler_classes=scheduler_classes,
+                min_table_pages=min_table_pages,
+                preempt_resume_ttl_s=preempt_resume_ttl_s,
+                quantize=quantize, kv_quant=kv_quant,
+                replay_batch=replay_batch, journal=self._journal)
+        except BaseException:
+            # a rejected engine knob must not leak the journal's
+            # writer thread / open segment / watchdog heartbeat (the
+            # live set stays on disk for the next attempt)
+            if self._journal is not None:
+                self._journal.close()
+            raise
         self._count_lock = threading.Lock()
         self._request_count = 0
         self._drain_thread: Optional[threading.Thread] = None
@@ -402,6 +452,16 @@ class GenerationServer(_ServerLifecycle):
                         if outer._snapshot_path:
                             payload.update({
                                 "snapshot_path": outer._snapshot_path,
+                                "restored_requests":
+                                    outer._restored_requests})
+                        if outer._journal is not None:
+                            # ISSUE 13: the durability posture an
+                            # operator reads off a live replica —
+                            # journal path, segment count, fsync
+                            # policy (and whether a hung fsync
+                            # degraded it)
+                            payload.update({
+                                "journal": outer._journal.info(),
                                 "restored_requests":
                                     outer._restored_requests})
                         if outer._engine._spec:
@@ -573,8 +633,35 @@ class GenerationServer(_ServerLifecycle):
         # predecessor still releasing the port — must not have eaten
         # the journal) but before serve_forever starts: restored
         # requests are decoding by the time the first request arrives
-        if snapshot_path and os.path.exists(snapshot_path):
+        if self._journal is not None:
+            self._restored_requests = self._restore_journal()
+        elif snapshot_path and os.path.exists(snapshot_path):
             self._restored_requests = self._restore_snapshot(snapshot_path)
+
+    # ------------------------------------- write-ahead journal (ISSUE 13)
+    def _restore_journal(self) -> int:
+        """Resubmit the live set the journal recovered — each entry
+        flows through the engine's replay-admission path exactly like
+        a snapshot restore (``strict=False``: one unplaceable request
+        must not abort the whole resume).  Entries the engine rejected
+        are retired in the journal as ``unrestorable`` so they cannot
+        zombie through every future compaction."""
+        entries = self._journal_entries
+        if not entries:
+            return 0
+        try:
+            reqs = self._engine.restore({"version": 1,
+                                         "requests": entries},
+                                        strict=False)
+        except Exception as e:  # noqa: BLE001 — degrade, never block
+            warnings.warn(f"journal restore failed: {e!r}")  # startup
+            return 0
+        ok = {r.request_id for r in reqs}
+        for e in entries:
+            rid = e.get("request_id")
+            if rid is not None and rid not in ok:
+                self._journal.append_retire(rid, why="unrestorable")
+        return len(reqs)
 
     # ----------------------------------------------- snapshot (ISSUE 8)
     def _restore_snapshot(self, path: str) -> int:
@@ -611,7 +698,12 @@ class GenerationServer(_ServerLifecycle):
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(snap, f)
-        os.replace(tmp, path)
+        # durability bugfix (ISSUE 13 satellite): a bare os.replace
+        # never fsyncs the file or the parent directory, so the rename
+        # itself could be lost on power failure — the journal's shared
+        # helper syncs both
+        from .journal import durable_replace
+        durable_replace(tmp, path)
         return len(snap["requests"])
 
     # ------------------------------------------------- graceful shutdown
@@ -673,7 +765,32 @@ class GenerationServer(_ServerLifecycle):
             # and lost if the grace period ends mid-drain
             self._engine.stop_admissions()
             self.begin_drain(timeout=drain_timeout)
-            if self._snapshot_path:
+            if self._journal is not None:
+                # ISSUE 13: the WAL already holds every in-flight
+                # request — the SIGTERM "snapshot" collapses to one
+                # durable flush (the crash floor) plus a final
+                # compaction once the drain truly completed, so a
+                # relaunch resumes exactly what the grace period was
+                # too short to finish and nothing more
+                try:
+                    self._journal.flush(sync=True, timeout=30.0)
+                except Exception as e:  # noqa: BLE001 — drain anyway
+                    warnings.warn(f"pre-drain journal flush failed: "
+                                  f"{e!r}")
+
+                def _refresh_journal():
+                    if self.wait_drained(None) and self._drain_result:
+                        try:
+                            self._journal.compact(wait=True,
+                                                  timeout=30.0)
+                        except Exception as e:  # noqa: BLE001 — keep
+                            # the crash-floor journal rather than none
+                            warnings.warn(
+                                f"post-drain journal compaction "
+                                f"failed: {e!r}")
+                threading.Thread(target=_refresh_journal, daemon=True,
+                                 name="journal-refresh").start()
+            elif self._snapshot_path:
                 try:
                     self.save_snapshot()
                 except Exception as e:   # noqa: BLE001 — the drain
@@ -707,6 +824,11 @@ class GenerationServer(_ServerLifecycle):
         if self._drain_thread is not None:
             self._drain_thread.join(timeout=5)
             self._drain_thread = None
+        if self._journal is not None:
+            # closing flushes + final-fsyncs but deliberately does NOT
+            # retire live entries: a stop without retirement is the
+            # crash floor a relaunched server resumes from
+            self._journal.close()
 
 
 def serve(model_prefix: str, host: str = "127.0.0.1", port: int = 8000,

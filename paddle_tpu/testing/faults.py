@@ -34,6 +34,18 @@ Sites (the names the runtime fires):
                     emulates a wedged compiled call long enough for
                     the watchdog heartbeat to fire and trigger the
                     bounded rebuild + survivor-replay restart path
+  ``journal_write`` durability-fault site (ISSUE 13): fired on the
+                    journal writer thread before each record frame is
+                    written; an ``error`` rule TEARS the write — half
+                    the frame reaches the file, exactly what a crash
+                    mid-write leaves — and the writer rotates to a
+                    fresh segment so recovery's torn-tail truncation
+                    is what loses the record, not the emulation
+  ``journal_fsync`` durability-fault site (ISSUE 13): fired at each
+                    journal fsync point; a ``delay`` rule emulates a
+                    hung fsync (the watchdog heartbeat then degrades
+                    the journal to os-policy instead of stalling), an
+                    ``error`` rule a failed fsync (counted + degraded)
 
 Rule dict fields (JSON-friendly — ``tools/serve_bench.py
 --fault-plan`` takes exactly this as a JSON document):
@@ -69,7 +81,8 @@ __all__ = [
 ]
 
 SITES = ("prefill", "prefill_chunk", "decode_step", "page_alloc",
-         "http_handler", "buffer_loss", "engine_wedge")
+         "http_handler", "buffer_loss", "engine_wedge",
+         "journal_write", "journal_fsync")
 
 
 class FaultError(Exception):

@@ -1,0 +1,468 @@
+"""Write-ahead request journal (ISSUE 13): framing, torn-tail
+tolerance, segment rotation, live-set compaction, fsync policies and
+the watchdog-driven degraded mode, the ``journal_write`` /
+``journal_fsync`` fault sites, and the crash-loop-safety invariants
+(recovery compaction idempotence, consumed-segment renames,
+restart-on-partially-compacted state).
+
+Engine/server integration — mid-stream SIGKILL-equivalent recovery,
+bit-exactness, /result re-attach — lives in tests/test_crash_recovery.py
+(TestJournalRecovery); the subprocess SIGKILL acceptance scenario is
+tools/chaos_smoke.py's hard-kill lane.
+"""
+import os
+import threading
+import time
+import warnings
+
+import pytest
+
+from paddle_tpu import monitor
+from paddle_tpu.inference.journal import (RequestJournal, durable_replace,
+                                          fsync_file_and_dir)
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    faults.clear()
+
+
+def counter_value(name):
+    m = monitor.get_registry().get(name)
+    return 0.0 if m is None else m.value()
+
+
+def admit(rid, **kw):
+    e = {"request_id": rid, "prompt": [1, 2, 3], "generated": [],
+         "next_token": None, "max_new_tokens": 8, "eos_token_id": None,
+         "do_sample": False, "temperature": 1.0, "seed": 0,
+         "priority": "standard", "tenant": "default", "draft": False,
+         "deadline_unix": None, "queue_deadline_unix": None}
+    e.update(kw)
+    return e
+
+
+def segs(d, consumed=False):
+    suffix = ".seg.consumed" if consumed else ".seg"
+    return sorted(f for f in os.listdir(d) if f.endswith(suffix))
+
+
+def wait_for(cond, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestFramingAndReplay:
+    def test_roundtrip_admit_step_retire(self, tmp_path):
+        d = str(tmp_path / "j")
+        with RequestJournal(d, fsync="always") as j:
+            j.append_admit(admit("a"))
+            j.append_admit(admit("b"))
+            j.append_step(["a", "b"], [("a", [5, 6], 7),
+                                       ("b", [], 9)])
+            j.append_retire("b", why="done")
+            assert j.flush(sync=True, timeout=30)
+        with RequestJournal(d) as j2:
+            ent = j2.recovered_requests()
+        assert [e["request_id"] for e in ent] == ["a"]
+        e = ent[0]
+        assert e["generated"] == [5, 6]
+        assert e["next_token"] == 7
+        # no journaled deadline -> None VERBATIM, never engine defaults
+        assert e["ttl_remaining_s"] is None
+        # an admitted request's queue-wait deadline is spent: dropped
+        assert e["queue_timeout_remaining_s"] is None
+
+    def test_readmit_replaces_state_idempotently(self, tmp_path):
+        d = str(tmp_path / "j")
+        with RequestJournal(d, fsync="always") as j:
+            j.append_admit(admit("a"))
+            j.append_step([], [("a", [1], 2)])
+            # a restored request's re-admission carries its state — the
+            # replay must REPLACE, not duplicate or reset
+            j.append_admit(admit("a", generated=[1, 2, 3], next_token=4))
+            j.append_step([], [("a", [4], 5)])
+            j.flush()
+        with RequestJournal(d) as j2:
+            ent = j2.recovered_requests()
+        assert len(ent) == 1
+        assert ent[0]["generated"] == [1, 2, 3, 4]
+        assert ent[0]["next_token"] == 5
+
+    def test_unknown_ids_in_step_and_retire_ignored(self, tmp_path):
+        d = str(tmp_path / "j")
+        with RequestJournal(d, fsync="always") as j:
+            j.append_step(["ghost"], [("ghost", [1], 2)])
+            j.append_retire("ghost")
+            j.append_admit(admit("real"))
+            j.flush()
+        with RequestJournal(d) as j2:
+            assert [e["request_id"] for e in j2.recovered_requests()] \
+                == ["real"]
+
+    def test_deadlines_convert_to_remaining_seconds(self, tmp_path):
+        d = str(tmp_path / "j")
+        with RequestJournal(d, fsync="always") as j:
+            j.append_admit(admit("t", deadline_unix=time.time() + 50.0,
+                                 queue_deadline_unix=time.time() + 20.0))
+            j.flush()
+        with RequestJournal(d) as j2:
+            e = j2.recovered_requests()[0]
+        assert 40.0 < e["ttl_remaining_s"] <= 50.0
+        # never admitted -> the queue deadline still applies
+        assert 10.0 < e["queue_timeout_remaining_s"] <= 20.0
+
+    def test_expired_deadline_clamps_positive(self, tmp_path):
+        d = str(tmp_path / "j")
+        with RequestJournal(d, fsync="always") as j:
+            j.append_admit(admit("t", deadline_unix=time.time() - 5.0))
+            j.flush()
+        with RequestJournal(d) as j2:
+            e = j2.recovered_requests()[0]
+        # clamped tiny-positive: restore admits it, the first reap
+        # expires it — the journal never manufactures a None deadline
+        assert 0 < e["ttl_remaining_s"] <= 1e-3
+
+    def test_in_flight_entries_order_before_queued(self, tmp_path):
+        d = str(tmp_path / "j")
+        with RequestJournal(d, fsync="always") as j:
+            j.append_admit(admit("queued"))
+            j.append_admit(admit("mid_stream"))
+            j.append_step(["mid_stream"], [("mid_stream", [1], 2)])
+            j.flush()
+        with RequestJournal(d) as j2:
+            ids = [e["request_id"] for e in j2.recovered_requests()]
+        assert ids == ["mid_stream", "queued"]
+
+    def test_invalid_fsync_policy_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            RequestJournal(str(tmp_path / "j"), fsync="sometimes")
+
+
+class TestTornTail:
+    def _write(self, d, n=5):
+        with RequestJournal(d, fsync="always") as j:
+            for i in range(n):
+                j.append_admit(admit(f"r{i}"))
+            j.flush()
+
+    @pytest.mark.parametrize("chop", [3, 7, 1])
+    def test_truncated_tail_recovers_full_frames(self, tmp_path, chop):
+        d = str(tmp_path / "j")
+        self._write(d)
+        seg = os.path.join(d, segs(d)[-1])
+        size = os.path.getsize(seg)
+        with open(seg, "r+b") as f:
+            f.truncate(size - chop)      # mid final record
+        before = counter_value("journal_torn_records_total")
+        with RequestJournal(d) as j2:
+            ids = [e["request_id"] for e in j2.recovered_requests()]
+        assert ids == ["r0", "r1", "r2", "r3"]
+        assert counter_value("journal_torn_records_total") == before + 1
+
+    def test_corrupt_crc_truncates_there(self, tmp_path):
+        d = str(tmp_path / "j")
+        self._write(d)
+        seg = os.path.join(d, segs(d)[-1])
+        with open(seg, "r+b") as f:
+            f.seek(-3, os.SEEK_END)
+            f.write(b"\xff")             # flip a payload byte
+        with RequestJournal(d) as j2:
+            ids = [e["request_id"] for e in j2.recovered_requests()]
+        assert ids == ["r0", "r1", "r2", "r3"]
+
+    def test_garbage_segment_recovers_empty_not_crash(self, tmp_path):
+        d = str(tmp_path / "j")
+        os.makedirs(d)
+        with open(os.path.join(d, "wal-00000001.seg"), "wb") as f:
+            f.write(os.urandom(256))
+        before = counter_value("journal_torn_records_total")
+        with RequestJournal(d) as j:
+            assert j.recovered_requests() == []
+        assert counter_value("journal_torn_records_total") == before + 1
+
+
+class TestRotationAndCompaction:
+    def test_rotation_spreads_segments_and_recovery_spans_them(
+            self, tmp_path):
+        d = str(tmp_path / "j")
+        with RequestJournal(d, fsync="always", segment_bytes=256) as j:
+            for i in range(12):
+                j.append_admit(admit(f"r{i}"))
+            j.flush()
+            assert j.segment_count >= 3
+        with RequestJournal(d) as j2:
+            ids = sorted(e["request_id"] for e in j2.recovered_requests())
+        assert ids == sorted(f"r{i}" for i in range(12))
+
+    def test_dead_ratio_compaction_shrinks_log(self, tmp_path):
+        d = str(tmp_path / "j")
+        before = counter_value("journal_compactions_total")
+        with RequestJournal(d, fsync="os", compact_min_records=20,
+                            compact_dead_ratio=0.5) as j:
+            for i in range(30):
+                j.append_admit(admit(f"dead{i}"))
+                j.append_retire(f"dead{i}")
+            j.append_admit(admit("keep"))
+            j.flush(sync=False)
+            wait_for(lambda: counter_value("journal_compactions_total")
+                     > before, msg="auto compaction")
+        with RequestJournal(d) as j2:
+            assert [e["request_id"] for e in j2.recovered_requests()] \
+                == ["keep"]
+
+    def test_explicit_compact_consumes_segments(self, tmp_path):
+        d = str(tmp_path / "j")
+        with RequestJournal(d, fsync="always", segment_bytes=256) as j:
+            for i in range(10):
+                j.append_admit(admit(f"r{i}"))
+                j.append_retire(f"r{i}")
+            j.append_admit(admit("live"))
+            j.flush()
+            n_before = j.segment_count
+            assert j.compact(wait=True, timeout=30)
+            assert j.segment_count < n_before
+            assert segs(d, consumed=True)     # renamed, kept
+            assert j.live_count == 1
+        with RequestJournal(d) as j2:
+            assert [e["request_id"] for e in j2.recovered_requests()] \
+                == ["live"]
+
+    def test_consumed_generations_pruned(self, tmp_path):
+        d = str(tmp_path / "j")
+        with RequestJournal(d, fsync="os") as j:
+            j.append_admit(admit("a"))
+            j.flush(sync=False)
+            assert j.compact(wait=True, timeout=30)
+            first_gen = set(segs(d, consumed=True))
+            j.append_retire("a")
+            j.flush(sync=False)
+            assert j.compact(wait=True, timeout=30)
+            second_gen = set(segs(d, consumed=True))
+        assert second_gen and not (first_gen & second_gen)
+
+
+class TestCrashLoopSafety:
+    """The ISSUE 13 satellite: recovery must be IDEMPOTENT — a restart
+    that dies mid-recovery (or mid-compaction) and restarts again
+    reconstructs the same live set."""
+
+    def _seed(self, d):
+        with RequestJournal(d, fsync="always") as j:
+            j.append_admit(admit("a"))
+            j.append_step(["a"], [("a", [1, 2], 3)])
+            j.append_admit(admit("b"))
+            j.append_retire("nobody")
+            j.flush()
+
+    def _live(self, d):
+        with RequestJournal(d) as j:
+            return {e["request_id"]: e for e in j.recovered_requests()}
+
+    def test_recovery_renames_consumed_and_is_rerunnable(self, tmp_path):
+        d = str(tmp_path / "j")
+        self._seed(d)
+        old = segs(d)
+        first = self._live(d)
+        # the crashed generation was renamed *.consumed, not deleted
+        assert [s + ".consumed" for s in old] == segs(d, consumed=True)
+        # run recovery twice more: same live set every time
+        assert self._live(d) == first
+        assert self._live(d) == first
+        assert set(first) == {"a", "b"}
+        assert first["a"]["generated"] == [1, 2]
+        assert first["a"]["next_token"] == 3
+
+    def test_restart_on_partially_compacted_segments(self, tmp_path):
+        """Simulate dying BETWEEN writing the compacted segment and
+        consuming the old ones: both generations present — replaying
+        old-then-compact must converge to the same live set."""
+        d = str(tmp_path / "j")
+        self._seed(d)
+        first = self._live(d)           # performed a recovery compaction
+        # resurrect the consumed originals next to the compact segment
+        for name in segs(d, consumed=True):
+            p = os.path.join(d, name)
+            os.rename(p, p[:-len(".consumed")])
+        assert self._live(d) == first
+
+    def test_restart_on_torn_compacted_segment(self, tmp_path):
+        """Dying mid-compaction-write leaves a torn compact segment
+        AND the full old generation: the old records must still carry
+        the state."""
+        d = str(tmp_path / "j")
+        self._seed(d)
+        ref = self._live(d)
+        # rebuild the crash state: old segments + a torn compact seg
+        for name in segs(d, consumed=True):
+            p = os.path.join(d, name)
+            os.rename(p, p[:-len(".consumed")])
+        compact = os.path.join(d, segs(d)[-1])
+        with open(compact, "r+b") as f:
+            f.truncate(max(0, os.path.getsize(compact) - 5))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            got = self._live(d)
+        assert got == ref
+
+    def test_recovered_entries_readmitted_then_rerecovered(self,
+                                                           tmp_path):
+        """The full crash loop: recover, re-admit the live set (as the
+        server does), crash again before any progress, recover again —
+        state identical."""
+        d = str(tmp_path / "j")
+        self._seed(d)
+        with RequestJournal(d, fsync="always") as j:
+            ent = j.recovered_requests()
+            for e in ent:
+                # what engine.submit(_restore=...) journals: the full
+                # state, admitted markers re-earned on admission
+                j.append_admit(admit(
+                    e["request_id"], generated=e["generated"],
+                    next_token=e["next_token"]))
+            j.flush()
+        again = self._live(d)
+        assert {r: (e["generated"], e["next_token"])
+                for r, e in again.items()} \
+            == {e["request_id"]: (e["generated"], e["next_token"])
+                for e in ent}
+
+
+class TestFaultSitesAndDegrade:
+    def test_sites_registered(self):
+        assert "journal_write" in faults.SITES
+        assert "journal_fsync" in faults.SITES
+
+    def test_journal_write_tears_one_record_keeps_rest(self, tmp_path):
+        d = str(tmp_path / "j")
+        with faults.installed(faults.FaultPlan(
+                [{"site": "journal_write", "nth": 2}])):
+            with RequestJournal(d, fsync="always") as j:
+                for i in range(4):
+                    j.append_admit(admit(f"w{i}"))
+                j.flush()
+        before = counter_value("journal_torn_records_total")
+        with RequestJournal(d) as j2:
+            ids = sorted(e["request_id"]
+                         for e in j2.recovered_requests())
+        # the torn record is lost; every record after it survived (the
+        # writer rotated) and recovery counted exactly one tear
+        assert ids == ["w0", "w2", "w3"]
+        assert counter_value("journal_torn_records_total") == before + 1
+
+    def test_journal_fsync_error_degrades_not_raises(self, tmp_path):
+        d = str(tmp_path / "j")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.installed(faults.FaultPlan(
+                    [{"site": "journal_fsync", "nth": 1}])):
+                with RequestJournal(d, fsync="always") as j:
+                    j.append_admit(admit("a"))
+                    wait_for(lambda: j.degraded, msg="degrade")
+                    assert j.effective_policy == "os"
+                    assert j.fsync_policy == "always"  # configured kept
+        assert counter_value("journal_degraded") == 1
+
+    def test_hung_fsync_fires_watchdog_and_degrades(self, tmp_path):
+        """The ISSUE 13 watchdog satellite: a hung fsync ages the
+        journal-writer heartbeat; the scan fires comm_timeouts_total
+        AND the on_timeout callback flips the journal to os-policy
+        degraded mode instead of stalling admission behind the disk."""
+        from paddle_tpu.distributed.watchdog import CommTaskManager
+        d = str(tmp_path / "j")
+        before = counter_value("comm_timeouts_total")
+        j = RequestJournal(d, fsync="always", fsync_timeout_s=0.05)
+        try:
+            with faults.installed(faults.FaultPlan(
+                    [{"site": "journal_fsync", "kind": "delay",
+                      "delay_s": 0.6}])):
+                j.append_admit(admit("a"))
+                # wait until the writer is INSIDE the hung fsync, then
+                # force a deterministic watchdog scan
+                wait_for(lambda: j._op_age() is not None
+                         and j._op_age() > 0.05, msg="hung fsync")
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    CommTaskManager.instance().scan_once()
+                wait_for(lambda: j.degraded, msg="watchdog degrade")
+            assert j.effective_policy == "os"
+            assert counter_value("comm_timeouts_total") >= before + 1
+            assert counter_value("journal_degraded") == 1
+            # degraded, not wedged: appends still land
+            j.append_admit(admit("b"))
+            assert j.flush(sync=False, timeout=30)
+        finally:
+            j.close()
+
+    def test_sites_free_when_disabled(self, tmp_path):
+        # no plan installed: the hot path must not pay for the sites
+        assert faults.active() is None
+        with RequestJournal(str(tmp_path / "j"), fsync="always") as j:
+            j.append_admit(admit("a"))
+            assert j.flush(sync=True, timeout=30)
+
+
+class TestDurableHelpers:
+    def test_durable_replace_moves_content(self, tmp_path):
+        tmp = str(tmp_path / "x.tmp")
+        dst = str(tmp_path / "x.json")
+        with open(tmp, "w") as f:
+            f.write("payload")
+        durable_replace(tmp, dst)
+        assert not os.path.exists(tmp)
+        with open(dst) as f:
+            assert f.read() == "payload"
+
+    def test_fsync_file_and_dir_runs(self, tmp_path):
+        p = str(tmp_path / "f")
+        with open(p, "w") as f:
+            f.write("x")
+        fsync_file_and_dir(p)        # must not raise
+
+    def test_save_snapshot_uses_durable_replace(self):
+        # the durability bugfix is load-bearing: a regression back to
+        # bare os.replace would silently lose the rename on power loss
+        import inspect
+        from paddle_tpu.inference.server import GenerationServer
+        src = inspect.getsource(GenerationServer.save_snapshot)
+        assert "durable_replace" in src
+
+
+class TestWriterConcurrency:
+    def test_many_producers_one_writer(self, tmp_path):
+        d = str(tmp_path / "j")
+        with RequestJournal(d, fsync="interval_ms",
+                            fsync_interval_ms=5.0) as j:
+            def produce(tid):
+                for i in range(25):
+                    j.append_admit(admit(f"t{tid}-{i}"))
+                    if i % 3 == 0:
+                        j.append_retire(f"t{tid}-{i}")
+            threads = [threading.Thread(target=produce, args=(t,))
+                       for t in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert j.flush(sync=True, timeout=60)
+        with RequestJournal(d) as j2:
+            ids = {e["request_id"] for e in j2.recovered_requests()}
+        expect = {f"t{t}-{i}" for t in range(4) for i in range(25)
+                  if i % 3 != 0}
+        assert ids == expect
+
+    def test_append_after_close_is_noop(self, tmp_path):
+        d = str(tmp_path / "j")
+        j = RequestJournal(d, fsync="always")
+        j.append_admit(admit("a"))
+        j.close()
+        j.append_retire("a")        # late retire during teardown: no-op
+        with RequestJournal(d) as j2:
+            assert [e["request_id"] for e in j2.recovered_requests()] \
+                == ["a"]

@@ -1,5 +1,5 @@
 """Resilience-counter smoke gate (ISSUE 4 CI satellite; ISSUE 8
-crash-consistency scenarios).
+crash-consistency scenarios; ISSUE 13 SIGKILL hard-kill scenario).
 
 Runs a tiny chaos scenario end to end — a fault plan injecting one
 prefill exception and one sticky decode-step poison into a mixed
@@ -12,9 +12,21 @@ every survivor must complete bit-identically to a fault-free run with
 ``survivor_replays_total``/``engine_rebuilds_total`` counted and an
 ``engine_recovery_seconds`` MTTR sample — and (b) a snapshot→restore
 round trip across a fresh engine resuming mid-stream requests
-bit-exactly.  Exit 0 = healthy, 1 = broken; tests/test_tools.py runs
-main() in the tier-1 lane, `python tools/chaos_smoke.py` is the
-standalone CI lane.
+bit-exactly.
+
+The ISSUE 13 hard-kill lane (``run_hard_kill``; part of the standalone
+``python tools/chaos_smoke.py`` run and its own gate in
+tests/test_tools.py) is the acceptance scenario for the write-ahead
+request journal: a SUBPROCESS GenerationServer with ``journal_dir``
+set serves 4 in-flight requests (greedy + sampled + prefix-hit +
+draft-opted), is SIGKILLed mid-decode, and is relaunched over the same
+journal — the restarted server must complete ALL of them with outputs
+bit-identical to an uninterrupted run, and ``/result/<request_id>``
+must re-attach for every journaled id across the hard restart.
+``--child`` is the subprocess entry point.
+
+Exit 0 = healthy, 1 = broken; tests/test_tools.py runs main() in the
+tier-1 lane, `python tools/chaos_smoke.py` is the standalone CI lane.
 """
 from __future__ import annotations
 
@@ -52,6 +64,14 @@ REQUIRED_SERIES = (
     "mfu",
     "program_flops_total",
     "program_hbm_bytes",
+    # write-ahead request journal (ISSUE 13)
+    "journal_records_total",
+    "journal_bytes",
+    "journal_fsync_seconds",
+    "journal_compactions_total",
+    "journal_torn_records_total",
+    "journal_recovered_requests_total",
+    "journal_degraded",
 )
 
 #: scheduler series (ISSUE 7, README "Scheduling & multi-tenancy") —
@@ -280,6 +300,44 @@ def run_chaos() -> dict:
                      and all(np.array_equal(g, e)
                              for g, e in zip(got, snap_refs)))
 
+    # SIGKILL-grade durability (ISSUE 13), in-process half: mid-stream
+    # requests survive a HARD engine stop — which journals NOTHING
+    # (that is the crash floor a kill -9 leaves) — recover onto a
+    # fresh engine bit-exactly through the write-ahead journal, and
+    # the recovery pass compacts + consumes the crashed generation's
+    # segments.  The subprocess SIGKILL half is run_hard_kill().
+    import tempfile
+    from paddle_tpu.inference.journal import RequestJournal
+    jdir = tempfile.mkdtemp(prefix="chaos-journal-")
+    jrnl = RequestJournal(jdir, fsync="always")
+    engJ = ContinuousBatchingEngine(model, total_pages=64, page_size=8,
+                                    max_batch=4, journal=jrnl)
+    try:
+        with faults.installed(faults.FaultPlan(
+                [{"site": "decode_step", "kind": "delay",
+                  "delay_s": 0.01}])):
+            jl = [engJ.submit(p, max_new_tokens=8) for p in snap_prompts]
+            t0 = _time.monotonic()
+            while _time.monotonic() - t0 < 120 and not all(
+                    len(r.generated) >= 2 for r in jl):
+                _time.sleep(0.005)
+    finally:
+        engJ.stop()
+        jrnl.close()
+    jrnl2 = RequestJournal(jdir, fsync="always")
+    entries = jrnl2.recovered_requests()
+    jref = {r.request_id: ref for r, ref in zip(jl, snap_refs)}
+    with ContinuousBatchingEngine(model, total_pages=64, page_size=8,
+                                  max_batch=4, journal=jrnl2) as engJ2:
+        restored = engJ2.restore({"version": 1, "requests": entries})
+        jgot = {r.request_id: r.result(timeout=600) for r in restored}
+    jrnl2.close()
+    journal_exact = (
+        len(entries) == 2
+        and all(len(e["generated"]) >= 2 for e in entries)
+        and all(np.array_equal(jgot[rid], ref)
+                for rid, ref in jref.items()))
+
     # a failing preemption callback must be counted, not swallowed
     handler = PreemptionHandler(signals=())
 
@@ -305,10 +363,265 @@ def run_chaos() -> dict:
     out["_restore_exact"] = restore_exact
     out["_quant_loss_exact"] = quant_loss_exact
     out["_batched_replay_won"] = batched_replay_won
+    out["_journal_exact"] = journal_exact
     return out
 
 
-def main() -> int:
+# --------------------------------------------------------------------
+# hard-kill scenario (ISSUE 13 acceptance): subprocess server, SIGKILL
+# mid-decode, restart over the same journal, zero lost admitted
+# requests, bit-exact streams, /result re-attach across the restart
+# --------------------------------------------------------------------
+
+def _hk_model():
+    """The hard-kill scenario's model — seeded, so the parent's
+    reference engine, child A and child B all hold IDENTICAL weights
+    across process boundaries."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=2,
+                      num_key_value_heads=2, max_position_embeddings=64)
+    return LlamaForCausalLM(cfg)
+
+
+def serve_child(argv) -> int:
+    """Subprocess entry (``--child``): a GenerationServer with a
+    write-ahead journal, its port published through ``--portfile``
+    (atomic rename so the parent never reads a partial write), an
+    optional decode delay widening the parent's mid-decode kill
+    window.  Runs until killed."""
+    import time as _time
+    from paddle_tpu.inference.server import GenerationServer
+    from paddle_tpu.testing import faults
+
+    def arg(name, default=None):
+        return next((a.split("=", 1)[1] for a in argv
+                     if a.startswith(f"--{name}=")), default)
+
+    journal_dir = arg("journal-dir")
+    portfile = arg("portfile")
+    delay = float(arg("decode-delay", "0"))
+    if delay:
+        faults.install(faults.FaultPlan(
+            [{"site": "decode_step", "kind": "delay",
+              "delay_s": delay}]))
+    model = _hk_model()
+    draft = _hk_model()      # same seed -> identical weights, accept ~1
+    srv = GenerationServer(model, draft_model=draft, spec_tokens=2,
+                           total_pages=128, page_size=8, max_batch=4,
+                           journal_dir=journal_dir,
+                           journal_fsync="always").start()
+    with open(portfile + ".tmp", "w") as f:
+        f.write(str(srv.port))
+    os.replace(portfile + ".tmp", portfile)
+    while True:          # parent SIGKILLs/SIGTERMs us; never exit early
+        _time.sleep(1.0)
+
+
+def run_hard_kill() -> dict:
+    """Drive the SIGKILL scenario; return {check_name: ok} plus
+    observed details for the failure message."""
+    import json
+    import subprocess
+    import tempfile
+    import threading
+    import time as _time
+    import urllib.request
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    work = tempfile.mkdtemp(prefix="chaos-hardkill-")
+    journal_dir = os.path.join(work, "journal")
+    portfile = os.path.join(work, "port")
+    logf = open(os.path.join(work, "child.log"), "ab")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def spawn(delay):
+        if os.path.exists(portfile):
+            os.remove(portfile)
+        return subprocess.Popen(
+            [sys.executable,
+             os.path.join(repo, "tools", "chaos_smoke.py"), "--child",
+             f"--journal-dir={journal_dir}", f"--portfile={portfile}",
+             f"--decode-delay={delay}"],
+            env=env, cwd=repo, stdout=logf, stderr=logf)
+
+    def wait_port(proc, timeout=300.0):
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < timeout:
+            if os.path.exists(portfile):
+                with open(portfile) as f:
+                    return int(f.read())
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"hard-kill child died at startup "
+                    f"(rc={proc.returncode}); see {logf.name}")
+            _time.sleep(0.05)
+        raise RuntimeError("hard-kill child never published its port")
+
+    def get(port, path, timeout=30):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}",
+                    timeout=timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            # /result/<id> 404s until the async POST lands — "not
+            # yet", not a failure; the poll loops keep waiting
+            try:
+                return json.loads(e.read())
+            except Exception:   # noqa: BLE001
+                return {"error": f"http {e.code}"}
+
+    def post_async(port, body):
+        """POST /generate on a background thread; the connection dies
+        with the SIGKILL, which is the point."""
+        def _go():
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/generate",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=600).read()
+            except Exception:   # noqa: BLE001 — killed mid-stream
+                pass
+        t = threading.Thread(target=_go, daemon=True)
+        t.start()
+        return t
+
+    rng = np.random.default_rng(7)
+    shared = rng.integers(0, 64, (16,)).tolist()   # 2 full pages
+    prompts = {
+        "hk-greedy": shared + rng.integers(0, 64, (6,)).tolist(),
+        "hk-sampled": rng.integers(0, 64, (7,)).tolist(),
+        "hk-prefix": shared + rng.integers(0, 64, (5,)).tolist(),
+        "hk-draft": rng.integers(0, 64, (6,)).tolist(),
+    }
+    bodies = {
+        rid: {"input_ids": [prompts[rid]], "max_new_tokens": 12,
+              "request_id": rid, "seed": 100 + i}
+        for i, rid in enumerate(prompts)}
+    bodies["hk-sampled"].update({"do_sample": True, "temperature": 0.8})
+    bodies["hk-greedy"]["draft"] = False
+    bodies["hk-prefix"]["draft"] = False
+    bodies["hk-draft"]["draft"] = True
+    # the speculative row advances ~spec_k+1 tokens per step: a longer
+    # budget keeps it mid-decode at the kill instant
+    bodies["hk-draft"]["max_new_tokens"] = 24
+
+    # the uninterrupted-run oracle: an in-process engine over the SAME
+    # seeded weights and submit parameters (prefix hits and greedy
+    # speculation are output-invariant, locked by the PR 2/6 suites)
+    from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+    refs = {}
+    with ContinuousBatchingEngine(_hk_model(), total_pages=128,
+                                  page_size=8, max_batch=4) as eng:
+        for rid, b in bodies.items():
+            refs[rid] = eng.submit(
+                np.asarray(b["input_ids"][0], np.int32),
+                max_new_tokens=b["max_new_tokens"],
+                do_sample=b.get("do_sample", False),
+                temperature=b.get("temperature", 1.0),
+                seed=b["seed"]).result(timeout=600)
+
+    checks, details = {}, {}
+    proc = spawn(delay=0.1)
+    try:
+        port = wait_port(proc)
+        # greedy first: its prefill registers the shared prefix, so
+        # the prefix request's admission actually HITS the cache
+        post_async(port, bodies["hk-greedy"])
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 120:
+            res = get(port, "/result/hk-greedy")
+            if res.get("generated_tokens", 0) >= 1 \
+                    or res.get("status") == "done":
+                break
+            _time.sleep(0.02)
+        for rid in ("hk-sampled", "hk-prefix", "hk-draft"):
+            post_async(port, bodies[rid])
+        # kill when every stream is mid-decode: >= 2 tokens, none done
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            states = {rid: get(port, f"/result/{rid}")
+                      for rid in bodies}
+            if any(s.get("status") == "done" for s in states.values()):
+                break                     # window missed — fail below
+            if all(s.get("generated_tokens", 0) >= 2
+                   for s in states.values()):
+                break
+            _time.sleep(0.02)
+        checks["all 4 mid-decode at kill time"] = all(
+            s.get("status") == "pending"
+            and s.get("generated_tokens", 0) >= 2
+            for s in states.values())
+        details["states_at_kill"] = states
+    finally:
+        proc.kill()                       # SIGKILL: no cleanup runs
+        proc.wait(timeout=30)
+
+    proc = spawn(delay=0)
+    try:
+        port = wait_port(proc)
+        got = {}
+        deadline = _time.monotonic() + 300
+        for rid in bodies:
+            while _time.monotonic() < deadline:
+                res = get(port, f"/result/{rid}")
+                if res.get("status") == "done":
+                    got[rid] = res["output_ids"]
+                    break
+                if res.get("status") == "error":
+                    details[f"error_{rid}"] = res
+                    break
+                _time.sleep(0.05)
+        checks["zero lost admitted requests"] = len(got) == len(bodies)
+        checks["streams bit-identical to the uninterrupted run"] = all(
+            rid in got and got[rid] == [int(t) for t in refs[rid]]
+            for rid in bodies)
+        health = get(port, "/health")
+        jinfo = health.get("journal", {})
+        checks["/health reports the journal"] = (
+            jinfo.get("path") == journal_dir
+            and jinfo.get("segments", 0) >= 1
+            and jinfo.get("fsync_policy") == "always")
+        checks["restart recovered every journaled id"] = (
+            health.get("restored_requests", 0) >= len(bodies))
+        details["health"] = health
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+        logf.close()
+    return {"checks": checks, "details": details}
+
+
+def hard_kill_main() -> int:
+    out = run_hard_kill()
+    bad = [name for name, ok in out["checks"].items() if not ok]
+    if bad:
+        print(f"FAIL (hard-kill): {bad}; observed {out['details']}",
+              file=sys.stderr)
+        return 1
+    print("OK: SIGKILL mid-decode lost nothing — 4/4 streams resumed "
+          "bit-exactly across the hard restart")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--child" in argv:
+        return serve_child(argv)
+    if "--hard-kill-only" in argv:
+        return hard_kill_main()
+    rc = _counters_main()
+    if rc == 0 and "--skip-hard-kill" not in argv:
+        rc = hard_kill_main()
+    return rc
+
+
+def _counters_main() -> int:
     out = run_chaos()
     missing = [n for n in REQUIRED_SERIES + SCHEDULER_SERIES
                if out.get(n) is None]
@@ -361,6 +674,16 @@ def main() -> int:
          "re-registered with the pages)", out["_quant_loss_exact"]),
         ("batched replay amortized survivors per dispatch",
          out["_batched_replay_won"]),
+        ("write-ahead journal resumed a hard-stopped engine's "
+         "mid-stream requests bit-exactly", out["_journal_exact"]),
+        ("journal_records_total counted the WAL appends",
+         out["journal_records_total"] >= 4),
+        ("journal_recovered_requests_total counted the resume",
+         out["journal_recovered_requests_total"] >= 2),
+        ("journal_compactions_total counted the recovery compaction",
+         out["journal_compactions_total"] >= 1),
+        ("journal fsync cost was measured",
+         out["journal_fsync_seconds"] >= 1),
     ]
     bad = [name for name, ok in checks if not ok]
     if bad:

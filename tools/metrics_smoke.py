@@ -10,9 +10,14 @@ model via GET /debug/cost, then scrapes GET /metrics and asserts the
 Prometheus exposition parses and carries the acceptance series —
 requests_total / request_latency_seconds / generated_tokens_total plus
 the ISSUE 10 series (mfu, program_flops_total, program_hbm_bytes,
-trace_captures_total, trace_events_total) and the ISSUE 11 spmd series
+trace_captures_total, trace_events_total), the ISSUE 11 spmd series
 (program_peak_hbm_bytes, collective_bytes_total, ici_time_seconds,
-published by /debug/cost's tier-3 group).  Exit 0 = healthy, 1 =
+published by /debug/cost's tier-3 group) and the ISSUE 13 journal
+series (journal_records_total / journal_bytes / journal_fsync_seconds
+/ journal_compactions_total / journal_torn_records_total /
+journal_recovered_requests_total / journal_degraded — the server runs
+with a write-ahead journal attached, and /health must report its
+path, segment count and fsync policy).  Exit 0 = healthy, 1 =
 broken — the tier-1 suite runs main() via tests/test_tools.py, and
 `python tools/metrics_smoke.py` is the standalone CI lane.
 """
@@ -51,6 +56,7 @@ def parse_exposition(text: str) -> dict:
 
 
 def main() -> int:
+    import tempfile
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
@@ -70,7 +76,13 @@ def main() -> int:
         with urllib.request.urlopen(req, timeout=120) as resp:
             return json.loads(resp.read())
 
-    with GenerationServer(model, total_pages=32, page_size=8) as srv:
+    # the server runs with a write-ahead journal attached (ISSUE 13)
+    # so the journal_* series and the /health journal section are part
+    # of the scraped observability surface this gate locks
+    jdir = tempfile.mkdtemp(prefix="metrics-smoke-journal-")
+    with GenerationServer(model, total_pages=32, page_size=8,
+                          journal_dir=jdir,
+                          journal_fsync="always") as srv:
         base = f"http://{srv.host}:{srv.port}"
         # the ISSUE 10 observability surface: the generate request runs
         # inside a trace capture window, and the whole capture workflow
@@ -125,6 +137,18 @@ def main() -> int:
             print(f"FAIL: /debug/cost spmd group missing or empty: "
                   f"{cost.get('spmd')}", file=sys.stderr)
             return 1
+        # ISSUE 13: /health must report the durability posture — the
+        # journal path, segment count and fsync policy
+        with urllib.request.urlopen(base + "/health",
+                                    timeout=30) as resp:
+            health = json.loads(resp.read())
+        j = health.get("journal")
+        if (not j or j.get("path") != jdir
+                or j.get("fsync_policy") != "always"
+                or not j.get("segments", 0) >= 1):
+            print(f"FAIL: /health journal section missing or wrong: "
+                  f"{j}", file=sys.stderr)
+            return 1
         with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
             ctype = resp.headers.get("Content-Type", "")
             text = resp.read().decode()
@@ -144,7 +168,13 @@ def main() -> int:
                 "trace_captures_total", "trace_events_total",
                 # ISSUE 11: the spmd auditor's series must be scrapeable
                 "program_peak_hbm_bytes", "collective_bytes_total",
-                "ici_time_seconds")
+                "ici_time_seconds",
+                # ISSUE 13: the write-ahead journal's series
+                "journal_records_total", "journal_bytes",
+                "journal_fsync_seconds_count",
+                "journal_compactions_total",
+                "journal_torn_records_total",
+                "journal_recovered_requests_total", "journal_degraded")
     missing = [name for name in required if name not in samples]
     if missing:
         print(f"FAIL: exposition missing {missing}", file=sys.stderr)
