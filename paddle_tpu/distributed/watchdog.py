@@ -18,7 +18,7 @@ import os
 import threading
 import time
 import warnings
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 from .. import monitor
 from ..framework.flags import define_flag, get_flag
@@ -151,6 +151,15 @@ class CommTaskManager:
         with self._lock:
             self._heartbeats.pop(hid, None)
             self._hb_flagged.discard(hid)
+
+    def heartbeat_names(self) -> List[str]:
+        """Names of every registered liveness probe (ISSUE 14): the
+        replica supervisor and the heartbeat-leak regression tests need
+        to see which probes a dead/stopped component left behind — a
+        stale heartbeat outliving its engine fires
+        ``comm_timeouts_total`` against a corpse."""
+        with self._lock:
+            return [name for name, _, _, _ in self._heartbeats.values()]
 
     def _scan_loop(self) -> None:
         while not self._stop.wait(self._scan_interval):

@@ -421,3 +421,7 @@ __all__ += ["scheduler", "PriorityClass", "WorkloadScheduler",
 from . import speculative  # noqa: E402,F401  (draft-verify decoding)
 from .speculative import SpeculativeGenerator  # noqa: E402,F401
 __all__ += ["speculative", "SpeculativeGenerator"]
+
+from . import fleet  # noqa: E402,F401  (replica supervisor + router)
+from .fleet import FleetRouter, ReplicaSupervisor  # noqa: E402,F401
+__all__ += ["fleet", "FleetRouter", "ReplicaSupervisor"]

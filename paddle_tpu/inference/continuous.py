@@ -1024,6 +1024,25 @@ class ContinuousBatchingEngine:
                else type(req.error).__name__)
         self.journal.append_retire(req.request_id, why=why)
 
+    def _journal_pages(self, req, event: str, n_tokens: int) -> None:
+        """Page-provenance record (ISSUE 14 satellite): the page-
+        aligned prefix ``req`` shares with the prefix cache — its
+        replica-local page indices plus the stable content key.
+        Failover groups the migrating live set by that key (sharers
+        land together, the destination's prefix index warms once); a
+        disaggregated decode tier re-attaches transported pages by it
+        (the ROADMAP slice this record type exists for)."""
+        if self.journal is None:
+            return
+        ps = self.cache.page_size
+        n = (int(n_tokens) // ps) * ps
+        if n <= 0:
+            return
+        pages = self.cache._seq_pages.get(req.seq_id, [])[:n // ps]
+        self.journal.append_pages(
+            req.request_id, event, n, pages,
+            self.cache.prefix_key_hex(req.prompt, n))
+
     def _journal_flush_step(self) -> None:
         """Scheduler thread, end of one loop iteration: ONE coalesced
         step record — the ids admitted to a slot plus every surviving
@@ -1463,6 +1482,11 @@ class ContinuousBatchingEngine:
             # the admitted marker drops the (satisfied) queue-wait
             # deadline on recovery — the PR 8 snapshot convention
             self._jadm.append(req.request_id)
+            if req.prefix_tokens:
+                # page provenance (ISSUE 14 satellite): which cached
+                # prefix pages this admission mapped read-only — the
+                # content key is what survives a replica boundary
+                self._journal_pages(req, "acquired", req.prefix_tokens)
         _tracer.request_event(
             req.request_id, "admitted", cls=req.priority,
             seq_id=req.seq_id, prefix_tokens=req.prefix_tokens,
@@ -1720,6 +1744,7 @@ class ContinuousBatchingEngine:
             # chunk-written pages carry identical KV, so chunked
             # prompts seed the prefix cache exactly like monolithic ones
             self.cache.register_prefix(req.seq_id, req.prompt)
+            self._journal_pages(req, "registered", len(req.prompt))
         if req.use_draft:
             # the draft ingests the WHOLE target (no prefix sharing in
             # its pool) so its cache sits at the same length as the

@@ -1,0 +1,1066 @@
+"""Fault-tolerant serving fleet: replica supervisor + health-gated
+router with journal-backed failover (ISSUE 14).
+
+Everything below the router was built to be fronted — per-replica
+429/503 + Retry-After, graceful drain, quarantine, snapshot/restore and
+the PR 13 write-ahead request journal — and this module is the layer
+that survives a *replica* dying, not just a request or a buffer:
+
+  * :class:`ReplicaSupervisor` owns N ``GenerationServer`` replicas
+    (each with its OWN journal directory), probes their ``/health`` on
+    a fixed cadence, registers one liveness heartbeat per replica with
+    the comm watchdog (``distributed/watchdog.py`` — a replica that
+    stops answering fires the same timeout machinery as a hung
+    collective), and on replica death runs **journal-backed failover**:
+    the dead replica's write-ahead journal is recovered on the
+    supervisor, its live set (mid-stream requests: prompt, generated
+    ids, pending next token, seed, class/tenant, draft opt-in,
+    deadlines — never KV) is MIGRATED to surviving replicas through
+    the existing ``restore(strict=False)`` admission path (the
+    ``POST /admin/migrate`` far side), and the migrated ids are retired
+    in the source journal so a restarted replica over the same
+    directory cannot double-execute them.  Because the replay primitive
+    is bit-exact for greedy AND sampled rows (PR 8/13), a stream
+    resumes token-for-token on a *different* replica; page-provenance
+    records (``pages``, ISSUE 14 satellite) group migrating sharers by
+    their prefix's stable content key so the destination's prefix index
+    warms once.
+
+  * :class:`FleetRouter` is the HTTP front (``/generate`` / ``/health``
+    / ``/metrics`` / ``/result/<id>``) with the robustness kit:
+
+      - **per-replica circuit breaker** — ``breaker_threshold``
+        consecutive transport/5xx failures open the circuit
+        (``router_circuit_open``); after ``breaker_reset_s`` it goes
+        half-open and admits exactly ONE probe request, whose outcome
+        closes or re-opens it;
+      - **bounded admission retry** with exponential backoff + seeded
+        jitter, IDEMPOTENT by ``request_id``: the router pins an id on
+        every forwarded request, so a retried admit that actually
+        landed is rejected by the far engine ("already live") and the
+        router re-attaches through ``/result/<id>`` instead of running
+        the request twice;
+      - **backpressure aggregation** — when every healthy replica is
+        saturated the fleet replies 429 with ``Retry-After`` = min over
+        the healthy replicas' ``retry_after_hint``;
+      - **drain-aware routing** — a replica whose ``/health`` reports
+        ``"draining"`` receives no new work (in-flight generations on
+        it still finish and remain ``/result``-reachable);
+      - **cross-replica ``/result/<id>``** — routed to the replica that
+        owns the id (ownership follows migration), falling back to a
+        fleet-wide scan, so a client's handle survives a failover as if
+        nothing happened.
+
+Series (all ``replica``-labeled, so two engines in one process stay
+separated): ``fleet_replica_up``, ``fleet_failovers_total``,
+``fleet_migrated_requests_total``, ``router_retries_total``,
+``router_circuit_open``.
+
+With in-process replicas (the default ``factory`` path) the process
+shares ONE metrics registry, so the router's ``/metrics`` is the
+aggregated fleet exposition; with external/subprocess replicas
+(:meth:`ReplicaSupervisor.add_replica`) it exposes the router-side
+series and each replica keeps serving its own ``/metrics``.
+
+The scope contract (ROADMAP "Engine fleet"): this is the
+router/robustness HALF of the fleet item — TP-sharding the compiled
+programs over a mesh drops into an already-supervised fleet later.
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+import warnings
+from collections import OrderedDict
+from http.server import ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional
+
+from .. import monitor
+from ..testing import faults as _faults
+from .server import GenerationServer, _JsonHandler, _ServerLifecycle
+
+__all__ = ["CircuitBreaker", "Replica", "ReplicaSupervisor",
+           "FleetRouter"]
+
+# fleet telemetry (ISSUE 14): replica-labeled, so N engines in one
+# process (the in-process supervisor mode) keep their series separated
+_replica_up = monitor.gauge(
+    "fleet_replica_up", "1 while the replica answers health probes "
+    "(draining replicas still count as up), 0 once it is down/dead",
+    ("replica",))
+_failovers_total = monitor.counter(
+    "fleet_failovers_total", "journal-backed failovers executed, "
+    "labeled by the replica that died", ("replica",))
+_migrated_total = monitor.counter(
+    "fleet_migrated_requests_total", "in-flight requests migrated off "
+    "a dead replica's recovered journal onto survivors", ("replica",))
+_router_retries = monitor.counter(
+    "router_retries_total", "admission attempts the router retried "
+    "after a transport/5xx failure, labeled by the replica that "
+    "failed the attempt", ("replica",))
+_circuit_open = monitor.gauge(
+    "router_circuit_open", "1 while the replica's admission circuit "
+    "is open (consecutive-failure threshold crossed; half-open probes "
+    "re-close it), else 0", ("replica",))
+
+
+def _http_json(url: str, body: Optional[dict] = None,
+               timeout: float = 30.0):
+    """One JSON round trip: ``(status, payload, headers)``.  HTTP error
+    statuses are RETURNED (their JSON body parsed when present) —
+    only transport-level failures raise, so callers can tell "the
+    replica answered 429/503" from "the replica is gone"."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={} if body is None else
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"{}"), dict(
+                r.headers)
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except ValueError:
+            payload = {}
+        return e.code, payload, dict(e.headers or {})
+    except urllib.error.URLError as e:
+        # unwrap refused connections: "nothing is listening" is the
+        # one transport failure that PROVES the request never landed,
+        # and the router's retry ladder branches on exactly that
+        if isinstance(e.reason, ConnectionRefusedError):
+            raise e.reason
+        raise
+
+
+class CircuitBreaker:
+    """Per-replica admission circuit (ISSUE 14 tentpole): CLOSED until
+    ``threshold`` CONSECUTIVE failures open it; after ``reset_s`` it
+    half-opens and :meth:`allow` admits exactly ONE probe request —
+    that probe's outcome re-closes (success) or re-opens (failure) the
+    circuit.  Thread-safe; the ``router_circuit_open`` gauge mirrors
+    the state per replica label."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, name: str, threshold: int = 3,
+                 reset_s: float = 1.0):
+        self.name = name
+        self.threshold = max(1, int(threshold))
+        self.reset_s = float(reset_s)
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = self.CLOSED
+        self._opened_at = 0.0
+        self._probing = False
+        _circuit_open.set(0, replica=name)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May an admission attempt be sent to this replica right now?
+        Half-open grants a single in-flight probe; its outcome must be
+        reported back via record_success/record_failure."""
+        now = time.monotonic()
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if now - self._opened_at < self.reset_s:
+                    return False
+                self._state = self.HALF_OPEN
+                self._probing = True
+                return True
+            # HALF_OPEN: one probe at a time
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            self._state = self.CLOSED
+        _circuit_open.set(0, replica=self.name)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            self._probing = False
+            if (self._state == self.HALF_OPEN
+                    or self._failures >= self.threshold):
+                self._state = self.OPEN
+                self._opened_at = time.monotonic()
+        _circuit_open.set(int(self.state == self.OPEN),
+                          replica=self.name)
+
+
+class Replica:
+    """One replica's handle: its address, journal directory and
+    supervision state.  ``server`` is set for in-process replicas (the
+    factory path), ``proc`` for subprocess ones (the chaos lane); both
+    are probed and failed over identically — over HTTP."""
+
+    #: state machine: STARTING -> UP <-> DRAINING; probe-failure
+    #: threshold -> DOWN; failover marks DEAD (terminal until restart)
+    STARTING, UP, DRAINING, DOWN, DEAD = (
+        "starting", "up", "draining", "down", "dead")
+
+    def __init__(self, name: str, url: str,
+                 journal_dir: Optional[str] = None,
+                 server: Optional[GenerationServer] = None,
+                 proc=None, breaker_threshold: int = 3,
+                 breaker_reset_s: float = 1.0):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.journal_dir = journal_dir
+        self.server = server
+        self.proc = proc
+        self.state = self.STARTING
+        self.created_at = time.monotonic()
+        self.last_ok: Optional[float] = None
+        self.probe_failures = 0
+        self.retry_after_hint = 1
+        self.health: dict = {}
+        self.breaker = CircuitBreaker(name, breaker_threshold,
+                                      breaker_reset_s)
+
+    @property
+    def routable(self) -> bool:
+        """May NEW work be routed here?  Health-gated (up, not
+        draining, not down/dead) — the breaker is consulted separately
+        at attempt time so a half-open probe can still go through."""
+        return self.state == self.UP
+
+    def kill(self) -> None:
+        """Hard-kill this replica (test/chaos hook).  Subprocess
+        replicas get a real SIGKILL.  In-process replicas get the
+        closest legal emulation: listener torn down, engine
+        hard-stopped (which deliberately journals NO retirements —
+        the PR 13 crash floor) and the journal closed with its live
+        set intact, so the supervisor's failover recovers exactly what
+        a ``kill -9`` would have left on disk."""
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+        elif self.server is not None:
+            try:
+                self.server.stop()
+            except Exception:  # noqa: BLE001 — dying is the point
+                pass
+
+
+class ReplicaSupervisor:
+    """Owns the fleet's replicas: spawn, probe, heartbeat, failover.
+
+    ``factory(name, journal_dir) -> GenerationServer`` builds one
+    in-process replica (unstarted; the supervisor starts it on port 0
+    and waits on its readiness signal — no sleep-and-poll).  Pass
+    ``replicas=N`` with a factory, or skip the factory and register
+    external/subprocess replicas via :meth:`add_replica`.
+
+    Liveness has two layers (both end in the same idempotent
+    :meth:`failover`): the probe thread marks a replica DOWN after
+    ``probe_failure_threshold`` consecutive failed ``/health`` probes
+    (the fast path), and a per-replica watchdog heartbeat — age =
+    seconds since the last successful probe — backstops it through the
+    standard comm-timeout machinery (``heartbeat_timeout_s``).
+    """
+
+    def __init__(self, factory: Optional[Callable] = None,
+                 replicas: int = 2,
+                 journal_root: Optional[str] = None,
+                 probe_interval_s: float = 0.25,
+                 probe_timeout_s: float = 5.0,
+                 probe_failure_threshold: int = 2,
+                 heartbeat_timeout_s: float = 10.0,
+                 breaker_threshold: int = 3,
+                 breaker_reset_s: float = 1.0):
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.probe_failure_threshold = max(1, int(probe_failure_threshold))
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_reset_s = float(breaker_reset_s)
+        self._factory = factory
+        self._lock = threading.Lock()
+        self.replicas: "OrderedDict[str, Replica]" = OrderedDict()
+        self._hb_ids: Dict[str, int] = {}
+        self._failed_over: set = set()
+        self._migration_listeners: List[Callable] = []
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        if factory is not None:
+            if journal_root is None:
+                import tempfile
+                journal_root = tempfile.mkdtemp(prefix="fleet-journal-")
+            self.journal_root = journal_root
+            import os
+            for i in range(int(replicas)):
+                name = f"r{i}"
+                jdir = os.path.join(journal_root, name)
+                srv = factory(name, jdir)
+                srv.start()
+                srv.wait_ready(30.0)
+                self._register(Replica(
+                    name, f"http://{srv.host}:{srv.port}",
+                    journal_dir=jdir, server=srv,
+                    breaker_threshold=breaker_threshold,
+                    breaker_reset_s=breaker_reset_s))
+        else:
+            self.journal_root = journal_root
+
+    # ------------------------------------------------------- membership
+    def _register(self, rep: Replica) -> None:
+        with self._lock:
+            self.replicas[rep.name] = rep
+        _replica_up.set(0, replica=rep.name)   # until the first probe
+
+    def add_replica(self, name: str, url: str,
+                    journal_dir: Optional[str] = None,
+                    proc=None) -> Replica:
+        """Register an external (typically subprocess) replica.  Its
+        ``journal_dir`` must be reachable from THIS process for
+        journal-backed failover to recover anything."""
+        rep = Replica(name, url, journal_dir=journal_dir, proc=proc,
+                      breaker_threshold=self.breaker_threshold,
+                      breaker_reset_s=self.breaker_reset_s)
+        self._register(rep)
+        if self._probe_thread is not None:
+            self._arm_heartbeat(rep)
+        return rep
+
+    def replica(self, name: str) -> Replica:
+        with self._lock:
+            return self.replicas[name]
+
+    def routable_replicas(self) -> List[Replica]:
+        with self._lock:
+            return [r for r in self.replicas.values() if r.routable]
+
+    def all_replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self.replicas.values())
+
+    def add_migration_listener(self, fn: Callable) -> None:
+        """``fn(request_id, destination_replica_name)`` per migrated
+        request — the router re-points its ownership map here."""
+        self._migration_listeners.append(fn)
+
+    # ------------------------------------------------------- lifecycle
+    def start(self) -> "ReplicaSupervisor":
+        from ..distributed.watchdog import CommTaskManager
+        with self._lock:
+            reps = list(self.replicas.values())
+        for rep in reps:
+            self._arm_heartbeat(rep)
+        CommTaskManager.instance().start()
+        self._stop.clear()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="fleet-supervisor",
+            daemon=True)
+        self._probe_thread.start()
+        return self
+
+    def stop(self, stop_replicas: bool = True) -> None:
+        """Stop probing and deregister every heartbeat; with
+        ``stop_replicas`` the in-process replicas drain-free hard-stop
+        too (their own stop paths deregister their engine/journal
+        heartbeats — the ISSUE 14 satellite contract)."""
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+            self._probe_thread = None
+        from ..distributed.watchdog import CommTaskManager
+        mgr = CommTaskManager.instance()
+        with self._lock:
+            hbs = list(self._hb_ids.values())
+            self._hb_ids.clear()
+            reps = list(self.replicas.values())
+        for hid in hbs:
+            mgr.unregister_heartbeat(hid)
+        if stop_replicas:
+            for rep in reps:
+                if rep.server is not None and rep.state != Replica.DEAD:
+                    try:
+                        rep.server.stop()
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _arm_heartbeat(self, rep: Replica) -> None:
+        from ..distributed.watchdog import CommTaskManager
+
+        def age() -> Optional[float]:
+            if rep.state == Replica.DEAD:
+                return None         # failover done; probe re-arms never
+            t0 = rep.last_ok if rep.last_ok is not None else rep.created_at
+            return time.monotonic() - t0
+
+        hid = CommTaskManager.instance().register_heartbeat(
+            f"fleet/{rep.name}", age, self.heartbeat_timeout_s,
+            on_timeout=lambda: self._failover_async(rep.name))
+        with self._lock:
+            self._hb_ids[rep.name] = hid
+
+    def _disarm_heartbeat(self, name: str) -> None:
+        from ..distributed.watchdog import CommTaskManager
+        with self._lock:
+            hid = self._hb_ids.pop(name, None)
+        if hid is not None:
+            CommTaskManager.instance().unregister_heartbeat(hid)
+
+    # --------------------------------------------------------- probing
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            with self._lock:
+                reps = list(self.replicas.values())
+            for rep in reps:
+                if rep.state == Replica.DEAD:
+                    continue
+                self.probe_once(rep)
+
+    def probe_once(self, rep: Replica) -> bool:
+        """ONE health probe (public so tests drive deterministic
+        scans).  Success refreshes the heartbeat and the routing
+        inputs (draining flag, Retry-After hint); the
+        ``probe_failure_threshold``-th consecutive failure triggers
+        failover."""
+        try:
+            _faults.maybe_fire("replica_probe")
+            status, payload, _ = _http_json(
+                rep.url + "/health", timeout=self.probe_timeout_s)
+            if status != 200:
+                raise RuntimeError(f"health probe returned {status}")
+        except Exception:  # noqa: BLE001 — a probe failure is data
+            rep.probe_failures += 1
+            if rep.probe_failures >= self.probe_failure_threshold \
+                    and rep.state not in (Replica.DOWN, Replica.DEAD):
+                rep.state = Replica.DOWN
+                _replica_up.set(0, replica=rep.name)
+                self._failover_async(rep.name)
+            return False
+        with self._lock:
+            if rep.state == Replica.DEAD \
+                    or rep.name in self._failed_over:
+                # a probe that raced a concurrent failover must not
+                # resurrect the replica: it has been (or is being)
+                # fenced and its streams migrated — only restart()
+                # re-registers it
+                return False
+            rep.last_ok = time.monotonic()
+            rep.probe_failures = 0
+            rep.health = payload
+            rep.retry_after_hint = int(payload.get("retry_after_hint",
+                                                   1))
+            rep.state = (Replica.DRAINING if payload.get("draining")
+                         else Replica.UP)
+        _replica_up.set(1, replica=rep.name)
+        return True
+
+    # -------------------------------------------------------- failover
+    def _failover_async(self, name: str) -> None:
+        """Run failover off the caller's thread (probe loop or the
+        watchdog scan thread must never block on migration HTTP)."""
+        with self._lock:
+            if name in self._failed_over:
+                return
+            self._failed_over.add(name)
+        threading.Thread(target=self.failover, args=(name,),
+                         kwargs={"_pre_claimed": True},
+                         name=f"fleet-failover-{name}",
+                         daemon=True).start()
+
+    def failover(self, name: str, _pre_claimed: bool = False) -> int:
+        """Journal-backed failover (THE tentpole mechanism): declare
+        ``name`` dead, recover its write-ahead journal's live set, and
+        migrate every entry to surviving replicas through their
+        ``restore(strict=False)`` admission path — greedy, sampled,
+        prefix-hit and draft streams all resume bit-exactly elsewhere
+        (the PR 8/13 replay contract).  Migrated ids are retired in the
+        SOURCE journal (``why="migrated"``), so a replica restarted
+        over the same directory resumes nothing twice; ids a
+        destination rejected as already-live (a crashed earlier
+        failover got that far) are retired the same way — the whole
+        pass is re-runnable.  Returns the number of migrated requests.
+        Idempotent per replica."""
+        if not _pre_claimed:
+            with self._lock:
+                if name in self._failed_over:
+                    return 0
+                self._failed_over.add(name)
+        rep = self.replica(name)
+        rep.state = Replica.DEAD
+        _replica_up.set(0, replica=name)
+        _failovers_total.inc(replica=name)
+        self._disarm_heartbeat(name)
+        # FENCE before touching the journal (STONITH): a false-positive
+        # detection — a replica that was merely GIL-stalled or starved
+        # behind slow probes — must not leave a LIVE writer appending
+        # to the directory the recovery below compacts and consumes,
+        # nor keep serving streams that are about to run elsewhere.
+        # kill() is idempotent on a real corpse; with fencing a false
+        # positive costs one replica's availability, never correctness
+        # (its streams migrate bit-exactly like a true death's).  A
+        # URL-only replica with no process/server handle cannot be
+        # fenced here — its journal_dir should only be set when the
+        # supervisor truly owns the replica's lifecycle.
+        try:
+            rep.kill()
+        except Exception:  # noqa: BLE001 — fence is best-effort
+            pass
+        migrated = 0
+        try:
+            migrated = self._migrate_journal(rep)
+        except Exception as e:  # noqa: BLE001 — a failover bug must
+            # not kill the supervisor; the survivors keep serving
+            warnings.warn(f"fleet failover for {name!r} failed: {e!r}")
+        _migrated_total.inc(migrated, replica=name)
+        return migrated
+
+    def _migrate_journal(self, rep: Replica) -> int:
+        import os
+        if not rep.journal_dir or not os.path.isdir(rep.journal_dir):
+            return 0
+        from .journal import RequestJournal
+        # recovering CONSTRUCTS the journal over the dead replica's
+        # segments: torn tails truncated, live set compacted durable —
+        # the same crash-loop-safe scan a relaunched replica would run
+        jrnl = RequestJournal(rep.journal_dir, fsync="os")
+        try:
+            entries = jrnl.recovered_requests()
+            if not entries:
+                return 0
+            migrated = self._place_entries(rep, entries, jrnl)
+            jrnl.flush(sync=True, timeout=30.0)
+            return migrated
+        finally:
+            jrnl.close()
+
+    def _place_entries(self, rep: Replica, entries: List[dict],
+                       jrnl) -> int:
+        """Distribute the recovered live set over routable survivors.
+        Entries are grouped by their page-provenance prefix key
+        (ISSUE 14 satellite) so sharers of one cached prefix land on
+        the SAME destination: the first sharer's replay re-registers
+        the prefix there and the rest hit it — the destination's
+        prefix index is re-warmed once, not N times."""
+        groups: "OrderedDict[str, List[dict]]" = OrderedDict()
+        for i, e in enumerate(entries):
+            key = (e.get("prefix") or {}).get("key") or f"_solo{i}"
+            groups.setdefault(key, []).append(e)
+        migrated = 0
+        gi = 0
+        for key, group in groups.items():
+            placed, duplicates = self._place_group(group, start=gi)
+            gi += 1
+            for rid, dest in placed.items():
+                jrnl.append_retire(rid, why="migrated")
+                for fn in self._migration_listeners:
+                    try:
+                        fn(rid, dest)
+                    except Exception:  # noqa: BLE001 — listener bug
+                        pass
+            for rid, dest in duplicates.items():
+                # the destination already knew the id (a router retry
+                # landed it there first, or an earlier crashed
+                # failover did): retire it in the source journal so a
+                # restarted replica cannot resurrect the duplicate
+                jrnl.append_retire(rid, why="duplicate")
+                for fn in self._migration_listeners:
+                    try:
+                        fn(rid, dest)
+                    except Exception:  # noqa: BLE001
+                        pass
+            migrated += len(placed)
+            lost = [e.get("request_id") for e in group
+                    if e.get("request_id") not in placed
+                    and e.get("request_id") not in duplicates]
+            if lost:
+                warnings.warn(
+                    f"fleet failover for {rep.name!r} could not place "
+                    f"{lost} on any survivor; their journal entries "
+                    "remain for a future restart of the replica")
+        return migrated
+
+    def _place_group(self, group: List[dict], start: int = 0):
+        """POST one prefix-group to survivors until every entry lands
+        (or every survivor refused).  Returns ``(placed, duplicates)``
+        — request_id -> destination name for entries the destination
+        restored, and for ids it already KNEW (the dedup outcome: a
+        router retry landed them there first, or an earlier crashed
+        failover did — re-run safety either way)."""
+        placed: Dict[str, str] = {}
+        duplicates: Dict[str, str] = {}
+        pending = list(group)
+        survivors = self.routable_replicas()
+        if not survivors:
+            return placed, duplicates
+        for k in range(len(survivors)):
+            dest = survivors[(start + k) % len(survivors)]
+            try:
+                status, payload, _ = _http_json(
+                    dest.url + "/admin/migrate",
+                    body={"requests": pending},
+                    timeout=max(60.0, self.probe_timeout_s))
+            except Exception:  # noqa: BLE001 — survivor went away too
+                continue
+            if status != 200:
+                continue
+            for w in payload.get("warnings", ()):
+                warnings.warn(f"fleet migration to {dest.name!r}: {w}")
+            for rid in payload.get("restored", ()):
+                placed[rid] = dest.name
+            for rid in payload.get("live", ()):
+                duplicates[rid] = dest.name
+            done = set(placed) | set(duplicates)
+            pending = [e for e in pending
+                       if e.get("request_id") not in done]
+            if not pending:
+                break
+        return placed, duplicates
+
+    # ------------------------------------------------------------ misc
+    def kill(self, name: str) -> None:
+        """Hard-kill a replica (test/chaos hook) — the supervisor does
+        NOT react here; the probe/heartbeat machinery must detect the
+        death exactly as it would a real one."""
+        self.replica(name).kill()
+
+    def restart(self, name: str) -> Replica:
+        """Replace a DEAD in-process replica with a fresh one from the
+        factory over the same journal directory (post-failover the
+        directory's live set is empty — migrated ids were retired — so
+        the newcomer resumes nothing).  The old heartbeat was
+        deregistered at failover; the replacement gets its own."""
+        if self._factory is None:
+            raise RuntimeError("restart needs a replica factory")
+        old = self.replica(name)
+        if old.state != Replica.DEAD:
+            raise RuntimeError(f"replica {name!r} is {old.state}, "
+                               "not dead; failover first")
+        srv = self._factory(name, old.journal_dir)
+        srv.start()
+        srv.wait_ready(30.0)
+        rep = Replica(name, f"http://{srv.host}:{srv.port}",
+                      journal_dir=old.journal_dir, server=srv,
+                      breaker_threshold=self.breaker_threshold,
+                      breaker_reset_s=self.breaker_reset_s)
+        with self._lock:
+            self.replicas[name] = rep
+            self._failed_over.discard(name)
+        if self._probe_thread is not None:
+            self._arm_heartbeat(rep)
+        return rep
+
+    def info(self) -> dict:
+        """JSON-able fleet state for the router's ``/health``."""
+        with self._lock:
+            reps = list(self.replicas.values())
+        return {
+            "replicas": {
+                r.name: {
+                    "url": r.url,
+                    "state": r.state,
+                    "circuit": r.breaker.state,
+                    "retry_after_hint": r.retry_after_hint,
+                    "journal_dir": r.journal_dir,
+                } for r in reps},
+            "routable": sum(1 for r in reps if r.routable),
+            "size": len(reps),
+        }
+
+
+class FleetRouter(_ServerLifecycle):
+    """HTTP front for a supervised fleet (see the module docstring for
+    the robustness kit).  ``POST /generate`` bodies are the
+    GenerationServer contract verbatim; the router pins a
+    ``request_id`` when the client did not, so every admission is
+    idempotent and every reply carries the ``/result/<id>`` handles."""
+
+    def __init__(self, supervisor: ReplicaSupervisor,
+                 host: str = "127.0.0.1", port: int = 0,
+                 access_log: bool = False,
+                 admit_attempts: int = 6,
+                 backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 1.0,
+                 forward_timeout_s: float = 600.0,
+                 attach_timeout_s: float = 120.0,
+                 result_poll_s: float = 0.05,
+                 owner_map_size: int = 4096,
+                 seed: int = 0):
+        self.supervisor = supervisor
+        supervisor.add_migration_listener(self._note_migrated)
+        self.admit_attempts = max(1, int(admit_attempts))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self.attach_timeout_s = float(attach_timeout_s)
+        self.result_poll_s = float(result_poll_s)
+        self._rng = random.Random(seed)     # backoff jitter (seeded)
+        self._rr = 0                        # round-robin cursor
+        self._owners_lock = threading.Lock()
+        self._owner_map_size = int(owner_map_size)
+        self._owners: "OrderedDict[str, str]" = OrderedDict()
+        self._init_stats(access_log)
+        outer = self
+
+        class Handler(_JsonHandler):
+            server_kind = "fleet"
+            _outer = outer
+
+            def do_GET(self):
+                if self.path == "/health":
+                    with self._track("/health"):
+                        self._reply(200, outer.fleet_health())
+                elif self.path == "/metrics":
+                    with self._track("/metrics"):
+                        self._reply_text(200, monitor.prometheus_text())
+                elif self.path.startswith("/result/"):
+                    with self._track("/result"):
+                        rid = self.path[len("/result/"):]
+                        hit = outer.lookup_result(rid)
+                        if hit is None:
+                            self._reply(404, {
+                                "error": f"unknown request id {rid!r} "
+                                         "on every replica"})
+                        else:
+                            code = (202 if hit.get("status") == "pending"
+                                    else 200)
+                            self._reply(code, hit)
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                with self._track("/generate"):
+                    try:
+                        body = self._read_json()
+                        if not isinstance(body, dict) \
+                                or "input_ids" not in body:
+                            raise ValueError(
+                                "request body must be a JSON object "
+                                "with input_ids")
+                    except (ValueError, json.JSONDecodeError) as e:
+                        self._reply(400, {"error": str(e)})
+                        return
+                    code, payload, headers = outer.route_generate(body)
+                    self._reply(code, payload, headers=headers or None)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # --------------------------------------------------------- helpers
+    def _note_migrated(self, rid: str, dest: str) -> None:
+        self._claim_owner(rid, dest)
+
+    def _claim_owner(self, rid: str, name: str) -> None:
+        with self._owners_lock:
+            self._owners[rid] = name
+            self._owners.move_to_end(rid)
+            while len(self._owners) > self._owner_map_size:
+                self._owners.popitem(last=False)
+
+    def _owner_of(self, rid: str) -> Optional[str]:
+        with self._owners_lock:
+            return self._owners.get(rid)
+
+    @staticmethod
+    def row_ids(request_id: str, rows: int) -> List[str]:
+        """The engine's per-row id convention for a multi-row body."""
+        if rows == 1:
+            return [request_id]
+        return [f"{request_id}/{i}" for i in range(rows)]
+
+    def _candidates(self, prefer: Optional[str] = None
+                    ) -> List[Replica]:
+        """Routable replicas in round-robin order (the cursor advances
+        per call, so consecutive admissions spread).  ``prefer`` moves
+        that replica to the front — the retry-dedup path forwards a
+        pinned id to its recorded owner FIRST, so the far engine's
+        already-live rejection can catch a duplicate."""
+        reps = self.supervisor.routable_replicas()
+        if not reps:
+            return []
+        with self._owners_lock:
+            self._rr += 1
+            k = self._rr
+        out = [reps[(k + i) % len(reps)] for i in range(len(reps))]
+        if prefer is not None:
+            out.sort(key=lambda r: r.name != prefer)
+        return out
+
+    # --------------------------------------------------------- routing
+    def fleet_health(self) -> dict:
+        info = self.supervisor.info()
+        info.update({
+            "status": "ok" if info["routable"] else "unavailable",
+            "uptime_s": round(self.uptime_s, 3),
+            "requests_total": self.requests_served,
+        })
+        return info
+
+    def lookup_result(self, rid: str) -> Optional[dict]:
+        """``/result/<rid>`` across the fleet: the owning replica
+        first, then every live replica (ownership can be stale right
+        after a migration the listener has not delivered yet)."""
+        order: List[Replica] = []
+        owner = self._owner_of(rid)
+        for r in self.supervisor.all_replicas():
+            if r.name == owner:
+                order.insert(0, r)
+            elif r.state not in (Replica.DOWN, Replica.DEAD):
+                order.append(r)
+        for r in order:
+            try:
+                status, payload, _ = _http_json(
+                    r.url + f"/result/{rid}", timeout=10.0)
+            except Exception:  # noqa: BLE001 — replica unreachable
+                continue
+            if status in (200, 202):
+                self._claim_owner(rid, r.name)
+                payload["replica"] = r.name
+                return payload
+        return None
+
+    def route_generate(self, body: dict):
+        """The admission path: returns ``(status, payload, headers)``.
+
+        Bounded retry with exponential backoff + jitter; idempotent by
+        the pinned ``request_id`` — a retried admit that actually
+        landed is detected by the far engine's already-live rejection
+        (or by finding the id on a replica) and RE-ATTACHED through the
+        result surface instead of re-executed.  A replica that dies
+        mid-forward is survived the same way: the router waits for
+        journal-backed failover to land the id on a survivor and
+        returns the completed stream as if nothing happened."""
+        body = dict(body)
+        rid = body.get("request_id")
+        if rid is None:
+            rid = f"fleet-{uuid.uuid4().hex[:16]}"
+            body["request_id"] = rid
+        rid = str(rid)
+        try:
+            rows = len(body["input_ids"])
+            prompt_len = max(len(r) for r in body["input_ids"])
+        except (TypeError, ValueError):
+            return 400, {"error": "input_ids must be 2-D"}, {}
+        row_ids = self.row_ids(rid, rows)
+        eos = body.get("eos_token_id")
+
+        # retry dedup, fleet-wide: a client-pinned id the router has
+        # ALREADY routed may still be live — attaching beats admitting
+        # a second copy onto a different replica (the per-replica
+        # already-live rejection can only catch same-replica retries).
+        # A finished id falls through to normal admission: deliberate
+        # id reuse after completion keeps the engine's resubmit
+        # semantics.
+        owner = self._owner_of(row_ids[0])
+        if owner is not None:
+            hit = self.lookup_result(row_ids[0])
+            if hit is not None and hit.get("status") == "pending":
+                attached = self._attach(row_ids, prompt_len, eos)
+                if attached is not None:
+                    return attached
+
+        saturated_hints: List[int] = []
+        for attempt in range(self.admit_attempts):
+            saturated_hints = []
+            hard_failures = 0
+            routed = False
+            for rep in self._candidates(prefer=owner):
+                if not rep.breaker.allow():
+                    continue
+                routed = True
+                try:
+                    _faults.maybe_fire("route_admit")
+                    # claim ownership BEFORE the (long, blocking)
+                    # forward: a concurrent retry of the same pinned
+                    # id must find the owner and take the attach path
+                    # — claiming only after completion leaves a
+                    # generation-wide window where the retry would
+                    # admit a second copy on another replica.  A claim
+                    # gone stale (this attempt fails) is harmless:
+                    # lookup falls back to the fleet-wide scan and the
+                    # next landing attempt re-claims.
+                    for rr in row_ids:
+                        self._claim_owner(rr, rep.name)
+                    status, payload, headers = _http_json(
+                        rep.url + "/generate", body=body,
+                        timeout=self.forward_timeout_s)
+                except _faults.FaultError:
+                    # injected route failure (testing): before any
+                    # replica saw the request — plain retry
+                    _router_retries.inc(replica=rep.name)
+                    rep.breaker.record_failure()
+                    hard_failures += 1
+                    continue
+                except ConnectionRefusedError:
+                    # nothing listening: the admit DEFINITELY did not
+                    # land — free to retry elsewhere immediately
+                    _router_retries.inc(replica=rep.name)
+                    rep.breaker.record_failure()
+                    hard_failures += 1
+                    continue
+                except Exception:  # noqa: BLE001 — transport died
+                    # MID-FORWARD: the request may have been admitted
+                    # (and journaled) before the replica died.  The id
+                    # is the dedup key: if it surfaces anywhere —
+                    # including on a survivor after journal-backed
+                    # failover migrates it — attach to THAT stream
+                    # rather than running the request twice.
+                    _router_retries.inc(replica=rep.name)
+                    rep.breaker.record_failure()
+                    hard_failures += 1
+                    attached = self._attach(row_ids, prompt_len, eos,
+                                            require_presence=True)
+                    if attached is not None:
+                        return attached
+                    continue
+                if status == 200:
+                    rep.breaker.record_success()
+                    for rr in row_ids:
+                        self._claim_owner(rr, rep.name)
+                    return 200, payload, {}
+                if status == 429:
+                    # saturated, not sick: no breaker penalty — collect
+                    # the class-aware hint for fleet aggregation
+                    try:
+                        saturated_hints.append(int(
+                            headers.get("Retry-After", 1)))
+                    except (TypeError, ValueError):
+                        saturated_hints.append(1)
+                    continue
+                if status == 503:
+                    if "engine stopped" in str(payload.get("error", "")):
+                        # the replica DIED under this forward (its
+                        # in-flight handler errored out during engine
+                        # teardown — the in-process kill emulation
+                        # surfaces death as this 503 before the
+                        # listener drops): same recovery as a dropped
+                        # transport.  The admit may be journaled on
+                        # the corpse, so wait for failover to land it
+                        # on a survivor before considering re-admission
+                        # — re-running a journaled stream is the
+                        # double-execution the id exists to prevent.
+                        _router_retries.inc(replica=rep.name)
+                        rep.breaker.record_failure()
+                        hard_failures += 1
+                        attached = self._attach(row_ids, prompt_len,
+                                                eos,
+                                                require_presence=True)
+                        if attached is not None:
+                            return attached
+                        continue
+                    # draining (or pool-exhausted): route elsewhere;
+                    # the next probe refreshes the state gate
+                    if payload.get("draining"):
+                        rep.state = Replica.DRAINING
+                    continue
+                if status == 400 and "already live" in str(
+                        payload.get("error", "")):
+                    # retry dedup (ISSUE 14 tentpole): an earlier
+                    # attempt landed here — re-attach, never re-run
+                    rep.breaker.record_success()
+                    attached = self._attach(row_ids, prompt_len, eos)
+                    if attached is not None:
+                        return attached
+                    return 500, {"error": "request is live on "
+                                 f"{rep.name} but unreachable"}, {}
+                if 400 <= status < 500:
+                    # the CLIENT's request is wrong everywhere —
+                    # propagate, never retry
+                    rep.breaker.record_success()
+                    return status, payload, {}
+                # 5xx: replica fault
+                _router_retries.inc(replica=rep.name)
+                rep.breaker.record_failure()
+                hard_failures += 1
+            if saturated_hints and not hard_failures:
+                # every routable replica said 429: the fleet is FULL,
+                # not broken — aggregate min Retry-After and stop
+                # burning attempts
+                return 429, {"error": "fleet saturated"}, {
+                    "Retry-After": str(min(saturated_hints))}
+            if not routed and attempt + 1 >= min(2, self.admit_attempts):
+                break           # nothing routable twice: fail fast
+            if attempt + 1 < self.admit_attempts:
+                pause = min(self.backoff_max_s,
+                            self.backoff_base_s * (2 ** attempt))
+                pause += self._rng.uniform(0, self.backoff_base_s)
+                time.sleep(pause)
+        if saturated_hints:
+            return 429, {"error": "fleet saturated"}, {
+                "Retry-After": str(min(saturated_hints))}
+        return 503, {"error": "no healthy replica accepted the "
+                     "request", "draining": False}, {}
+
+    def _attach(self, row_ids: List[str], prompt_len: int, eos,
+                require_presence: bool = False):
+        """Re-attach to an already-admitted generation through the
+        result surface: poll every row id until done, then assemble the
+        GenerationServer /generate reply shape.  With
+        ``require_presence``, give up early (return None) if no replica
+        has EVER seen the ids — the caller may then safely re-admit
+        (the transport died before admission).  Presence is granted a
+        failover-sized grace window: an id journaled on a corpse is
+        invisible until migration lands it on a survivor."""
+        deadline = time.monotonic() + self.attach_timeout_s
+        presence_deadline = time.monotonic() + max(
+            5.0, 4 * self.supervisor.heartbeat_timeout_s)
+        seen = False
+        outs: Dict[str, List[int]] = {}
+        while time.monotonic() < deadline:
+            pending = False
+            for rr in row_ids:
+                if rr in outs:
+                    continue
+                hit = self.lookup_result(rr)
+                if hit is None:
+                    pending = True
+                    continue
+                seen = True
+                if hit.get("status") == "done":
+                    outs[rr] = [int(t) for t in hit["output_ids"]]
+                elif hit.get("status") == "error":
+                    return 500, {"error": hit.get("error", "request "
+                                 "failed"), "request_ids": row_ids}, {}
+                else:
+                    pending = True
+            if not pending:
+                break
+            if require_presence and not seen \
+                    and time.monotonic() > presence_deadline:
+                return None
+            time.sleep(self.result_poll_s)
+        if len(outs) != len(row_ids):
+            return 504, {"error": "re-attach timed out with rows still "
+                         "pending", "request_ids": row_ids}, {}
+        width = max(len(v) for v in outs.values())
+        pad = 0 if eos is None else int(eos)
+        output = [outs[rr] + [pad] * (width - len(outs[rr]))
+                  for rr in row_ids]
+        return 200, {"output_ids": output,
+                     "new_tokens": width - prompt_len,
+                     "request_ids": row_ids,
+                     "reattached": True}, {}

@@ -16,9 +16,16 @@ seed, generated ids, the pending next token — never KV.
     request's record carries its generated tokens, which makes replay
     idempotent by request_id), ``step`` (ONE coalesced record per
     engine iteration: the ids admitted to a slot plus, per surviving
-    row, the tokens appended and the new pending ``next_token``) and
+    row, the tokens appended and the new pending ``next_token``),
     ``retire`` (done/cancel/expire/quarantine/fault — the live set is
-    admitted minus retired);
+    admitted minus retired) and ``pages`` (ISSUE 14 satellite —
+    **page provenance**: which prefix-cache pages a request acquired at
+    admission or registered at prefill completion, with the stable
+    content hash of the shared prefix; the fleet's journal-backed
+    failover groups migrating requests by that key so sharers land on
+    one destination replica and re-warm its prefix index once, and a
+    disaggregated decode tier — the ROADMAP slice this record exists
+    for — can re-attach transported pages after a crash);
   * **a dedicated writer thread** — every engine/record producer only
     appends to an in-memory queue (one lock, no I/O), so journaling
     never rides the ``_cond`` hot path; the writer serializes, frames,
@@ -270,6 +277,23 @@ class _LiveSet:
                 e["admitted"] = True    # emission implies admission
                 self._units[rid] += 1
                 self.live_units += 1
+        elif t == "pages":
+            # page provenance (ISSUE 14 satellite): the latest record
+            # wins — a request acquires at most one cached prefix and
+            # registration supersedes it with the full picture
+            rid = rec.get("id")
+            self.total_units += 1
+            e = self.entries.get(rid)
+            if e is None:
+                return              # retired/compacted-away id
+            e["prefix"] = {
+                "event": rec.get("event"),
+                "tokens": int(rec.get("tokens") or 0),
+                "pages": [int(p) for p in rec.get("pages", ())],
+                "key": rec.get("key"),
+            }
+            self._units[rid] += 1
+            self.live_units += 1
         elif t == "retire":
             for rid in rec.get("ids", ()):
                 self.total_units += 1
@@ -468,6 +492,21 @@ class RequestJournal:
     def append_retire(self, request_id: str, why: str = "done") -> None:
         self._append({"t": "retire", "ids": [str(request_id)],
                       "why": why})
+
+    def append_pages(self, request_id: str, event: str, tokens: int,
+                     pages, key: Optional[str]) -> None:
+        """Page-provenance record (ISSUE 14 satellite): ``event`` is
+        ``"acquired"`` (admission mapped a cached prefix read-only) or
+        ``"registered"`` (prefill completion retained this prompt's
+        page-aligned prefixes), ``tokens`` the page-aligned shared
+        length, ``pages`` the replica-local page indices backing it and
+        ``key`` the stable content hash of the prefix — the only field
+        that means the same thing on a DIFFERENT replica, which is what
+        failover grouping and disaggregated re-attach key on."""
+        self._append({"t": "pages", "id": str(request_id),
+                      "event": str(event), "tokens": int(tokens),
+                      "pages": [int(p) for p in pages],
+                      "key": key})
 
     # ------------------------------------------------------- control
     def flush(self, sync: bool = True,

@@ -91,6 +91,25 @@ class _ServerLifecycle:
         self._started_at = time.monotonic()
         self._requests_lock = threading.Lock()
         self._requests_served = 0
+        # readiness (ISSUE 14 satellite): set once serve_forever is
+        # live — a supervisor starting replicas on port 0 waits on
+        # this instead of sleep-and-polling the socket.  The listener
+        # is BOUND at construction (``port`` is final then, even for
+        # an ephemeral port-0 bind, and any journal/snapshot restore
+        # has completed), so connections made after wait_ready() are
+        # served, never refused.
+        self._ready = threading.Event()
+
+    @property
+    def address(self):
+        """``(host, port)`` of the bound listener — final at
+        construction, port-0 binds resolved to the ephemeral port."""
+        return (self.host, self.port)
+
+    def wait_ready(self, timeout=None) -> bool:
+        """Block until :meth:`start`'s serving thread is live (True),
+        or ``timeout`` elapsed (False)."""
+        return self._ready.wait(timeout)
 
     def _bump_requests(self):
         with self._requests_lock:
@@ -109,10 +128,16 @@ class _ServerLifecycle:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        self._ready.set()
         return self
 
     def stop(self):
-        self._httpd.shutdown()
+        self._ready.clear()
+        if self._thread is not None:
+            # shutdown() handshakes with the serve_forever loop — on a
+            # never-started server it would wait forever, so only the
+            # socket close applies there
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -434,6 +459,13 @@ class GenerationServer(_ServerLifecycle):
                                 outer._engine.sample_on_device,
                             "active_sequences": len(outer._engine._active),
                             "queued_sequences": len(outer._engine._sched),
+                            # fleet routing (ISSUE 14): the same
+                            # backoff hint a 429 would carry, scraped
+                            # per probe so the router can aggregate
+                            # fleet Retry-After = min over healthy
+                            # replicas without a rejected request
+                            "retry_after_hint":
+                                outer._engine.retry_after_hint(),
                             # scheduling & multi-tenancy (ISSUE 7):
                             # per-class queue depths + the active
                             # policy knobs, so an operator can read
@@ -542,11 +574,76 @@ class GenerationServer(_ServerLifecycle):
                         monitor.stop_capture()
                         self._reply(200, {"capturing": False})
                     return
+                if self.path == "/admin/migrate":
+                    with self._track("/admin/migrate"):
+                        self._do_migrate()
+                    return
                 if self.path != "/generate":
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
                 with self._track("/generate"):
                     self._do_generate()
+
+            def _do_migrate(self):
+                """Journal-backed failover's far side (ISSUE 14): the
+                replica supervisor POSTs a dead replica's recovered
+                live set here; each snapshot-format entry flows through
+                the engine's replay-admission path (``strict=False`` —
+                one unplaceable request must not abort the batch; ids
+                ALREADY live here dedup into ``rejected``, which makes
+                a supervisor that crashed between migrate and
+                source-retire safely re-runnable).  Replies with the
+                ids that landed so the caller retires exactly those in
+                the source journal."""
+                if outer._engine.draining:
+                    self._reply(503, {"error": "replica draining; "
+                                      "migrate elsewhere",
+                                      "draining": True})
+                    return
+                try:
+                    body = self._read_json()
+                    entries = body.get("requests", [])
+                    if not isinstance(entries, list):
+                        raise ValueError("requests must be a list")
+                except (ValueError, TypeError,
+                        json.JSONDecodeError) as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                # ids this replica ALREADY knows (live now, or finished
+                # in the result cache) are the dedup outcome, not a
+                # migration failure: a router retry landed them here
+                # first, or an earlier crashed failover got this far —
+                # report them as "live" so the supervisor retires them
+                # in the source journal instead of leaving zombies
+                live, todo = [], []
+                for e in entries:
+                    rid = e.get("request_id")
+                    if rid is not None \
+                            and outer._engine.result_for(rid) is not None:
+                        live.append(rid)
+                    else:
+                        todo.append(e)
+                try:
+                    with warnings.catch_warnings(record=True) as wlog:
+                        warnings.simplefilter("always")
+                        reqs = outer._engine.restore(
+                            {"version": 1, "requests": todo},
+                            strict=False)
+                except Exception as e:  # noqa: BLE001 — server fault
+                    self._reply(500, {"error": str(e)})
+                    return
+                ok = [r.request_id for r in reqs]
+                landed = set(ok) | set(live)
+                self._reply(200, {
+                    "restored": ok,
+                    "live": live,
+                    "rejected": [e.get("request_id") for e in entries
+                                 if e.get("request_id") not in landed],
+                    # per-entry skip reasons (restore warns one line
+                    # per rejected entry) — the supervisor logs these,
+                    # so a failed placement is diagnosable from the
+                    # router side
+                    "warnings": [str(w.message) for w in wlog]})
 
             def _do_generate(self):
                 try:
@@ -624,7 +721,21 @@ class GenerationServer(_ServerLifecycle):
                 except Exception as e:   # noqa: BLE001 — server fault
                     self._reply(500, {"error": str(e)})
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        try:
+            self._httpd = ThreadingHTTPServer((host, port), Handler)
+        except BaseException:
+            # heartbeat-leak fix (ISSUE 14 satellite): a bind failure —
+            # a supervisor restarting a replica in-process on a port
+            # its predecessor is still releasing hits exactly this —
+            # must not leak the already-running engine: its scheduler
+            # thread, its step_timeout_s watchdog heartbeat (which
+            # would fire comm_timeouts_total against a dead engine
+            # forever) and the journal's writer thread + fsync
+            # heartbeat all deregister here
+            self._engine.stop()
+            if self._journal is not None:
+                self._journal.close()
+            raise
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None

@@ -673,6 +673,19 @@ class PagedKVCache:
             added += 1
         return added
 
+    def prefix_key_hex(self, tokens, n_tokens: int) -> Optional[str]:
+        """Stable CONTENT hash (hex) of the page-aligned prefix
+        covering ``n_tokens`` of ``tokens``, or None below one page —
+        the journal's page-provenance records carry it (ISSUE 14): page
+        indices are replica-local, but this key names the same prefix
+        on every replica, so failover can group sharers and a
+        disaggregated tier can re-attach transported pages."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n_pages = int(n_tokens) // self.page_size
+        if n_pages <= 0:
+            return None
+        return self._prefix_keys(tokens, n_pages)[-1].hex()
+
     def _device_pools(self):
         """Every device buffer backing the cache — data pages plus (in
         the int8 mode) the parallel scale pools.  The buffer-loss fault

@@ -46,6 +46,18 @@ Sites (the names the runtime fires):
                     hung fsync (the watchdog heartbeat then degrades
                     the journal to os-policy instead of stalling), an
                     ``error`` rule a failed fsync (counted + degraded)
+  ``route_admit``   router-fault site (ISSUE 14): fired by the fleet
+                    router before each admission FORWARD attempt (every
+                    retry fires again) — an ``error`` rule emulates a
+                    route that fails before reaching any replica, so
+                    the bounded-backoff retry ladder is testable
+                    without killing a replica
+  ``replica_probe`` router-fault site (ISSUE 14): fired by the replica
+                    supervisor before each health probe; a sticky
+                    ``error`` rule makes a healthy replica LOOK dead
+                    (probe failures accrue, the circuit opens, the
+                    heartbeat ages) — the failover path minus the
+                    actual corpse
 
 Rule dict fields (JSON-friendly — ``tools/serve_bench.py
 --fault-plan`` takes exactly this as a JSON document):
@@ -82,7 +94,8 @@ __all__ = [
 
 SITES = ("prefill", "prefill_chunk", "decode_step", "page_alloc",
          "http_handler", "buffer_loss", "engine_wedge",
-         "journal_write", "journal_fsync")
+         "journal_write", "journal_fsync", "route_admit",
+         "replica_probe")
 
 
 class FaultError(Exception):

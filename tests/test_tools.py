@@ -276,6 +276,32 @@ class TestServeBench:
         assert off["jit_recompiles"] == 0
         assert on["jit_recompiles"] == 0
 
+    def test_fleet_lane_gate(self, capsys):
+        # ISSUE 14 acceptance: the --fleet lane runs a 2-replica
+        # supervised fleet behind the router with a replica kill
+        # mid-window — jit_recompiles == 0 in ALL measured windows,
+        # per-replica decode p50 within 5% of the router-free baseline
+        # at the same co-location, router + probes ~free with one
+        # replica, a failover observed, zero failed requests, and the
+        # failure-window TTFT/failover economics quoted in the line
+        sb = self._load()
+        assert sb.main(["--fleet=2"]) == 0
+        lines = [json.loads(ln) for ln in
+                 capsys.readouterr().out.strip().splitlines()
+                 if ln.startswith("{")]
+        out = lines[-1]
+        assert out["fleet"] == 2
+        assert out["jit_recompiles"] == 0
+        assert out["failovers"] >= 1
+        assert out["failed_requests"] == 0
+        assert out["fleet_tokens_per_sec"] > 0
+        assert out["failure_window"]["ttft_p50_s"] is not None
+        assert out["failure_window"]["ttft_p99_s"] is not None
+        assert out["decode_step_p50_s"] \
+            <= out["baseline_n_decode_step_p50_s"] * 1.05
+        assert out["fleet1_decode_step_p50_s"] \
+            <= out["baseline_decode_step_p50_s"] * 1.05
+
 
 class TestTrainBench:
     """ISSUE 5 CI satellite: the training hot-path lane must run a tiny
@@ -340,6 +366,16 @@ class TestChaosSmoke:
         # all of them bit-identically to an uninterrupted run and
         # /result/<id> re-attaches for every journaled id
         assert self._load().main(["--hard-kill-only"]) == 0
+
+    def test_fleet_kill_gate(self):
+        # ISSUE 14 acceptance: SIGKILL one of TWO subprocess replicas
+        # mid-decode behind the supervisor + router — every in-flight
+        # stream completes bit-exactly on the survivor via
+        # journal-backed migration (zero failed requests),
+        # fleet_failovers_total / fleet_migrated_requests_total fire,
+        # every fleet_*/router_* series exists, and /result/<id>
+        # re-attaches through the router for every journaled id
+        assert self._load().main(["--fleet-only"]) == 0
 
 
 class TestTraceCapture:

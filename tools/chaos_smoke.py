@@ -25,6 +25,16 @@ bit-identical to an uninterrupted run, and ``/result/<request_id>``
 must re-attach for every journaled id across the hard restart.
 ``--child`` is the subprocess entry point.
 
+The ISSUE 14 fleet lane (``--fleet`` / ``run_fleet_kill``) is the
+acceptance scenario for the replica supervisor + router: TWO
+subprocess replicas behind an in-parent ``ReplicaSupervisor`` +
+``FleetRouter``, 4 in-flight streams (greedy + sampled + prefix-hit +
+draft-opted) round-robined across them, SIGKILL of the replica owning
+the most streams mid-decode — journal-backed failover must migrate its
+streams to the survivor bit-exactly (zero failed requests),
+``/result/<id>`` must re-attach through the router for every id, and
+the ``fleet_*``/``router_*`` series must exist and fire.
+
 Exit 0 = healthy, 1 = broken; tests/test_tools.py runs main() in the
 tier-1 lane, `python tools/chaos_smoke.py` is the standalone CI lane.
 """
@@ -72,6 +82,16 @@ REQUIRED_SERIES = (
     "journal_torn_records_total",
     "journal_recovered_requests_total",
     "journal_degraded",
+)
+
+#: fleet series (ISSUE 14, README "Fleet") — replica-labeled; the
+#: --fleet replica-kill scenario must populate each
+FLEET_SERIES = (
+    "fleet_replica_up",
+    "fleet_failovers_total",
+    "fleet_migrated_requests_total",
+    "router_retries_total",
+    "router_circuit_open",
 )
 
 #: scheduler series (ISSUE 7, README "Scheduling & multi-tenancy") —
@@ -597,6 +617,226 @@ def run_hard_kill() -> dict:
     return {"checks": checks, "details": details}
 
 
+# --------------------------------------------------------------------
+# fleet replica-kill scenario (ISSUE 14 acceptance): 2 subprocess
+# replicas behind an in-parent supervisor + router, SIGKILL one
+# mid-decode, journal-backed failover migrates its streams to the
+# survivor bit-exactly, /result/<id> re-attaches through the router
+# --------------------------------------------------------------------
+
+def run_fleet_kill() -> dict:
+    import json
+    import subprocess
+    import tempfile
+    import threading
+    import time as _time
+    import urllib.error
+    import urllib.request
+    import numpy as np
+    from paddle_tpu import monitor
+    from paddle_tpu.inference.fleet import FleetRouter, ReplicaSupervisor
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    work = tempfile.mkdtemp(prefix="chaos-fleet-")
+    logf = open(os.path.join(work, "children.log"), "ab")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def spawn(name, delay):
+        jdir = os.path.join(work, name, "journal")
+        portfile = os.path.join(work, name, "port")
+        os.makedirs(os.path.dirname(portfile), exist_ok=True)
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(repo, "tools", "chaos_smoke.py"), "--child",
+             f"--journal-dir={jdir}", f"--portfile={portfile}",
+             f"--decode-delay={delay}"],
+            env=env, cwd=repo, stdout=logf, stderr=logf)
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 300:
+            if os.path.exists(portfile):
+                with open(portfile) as f:
+                    return proc, jdir, int(f.read())
+            if proc.poll() is not None:
+                raise RuntimeError(f"fleet child {name} died at "
+                                   f"startup; see {logf.name}")
+            _time.sleep(0.05)
+        raise RuntimeError(f"fleet child {name} never published a port")
+
+    def get(port_or_url, path, timeout=30):
+        url = (port_or_url if isinstance(port_or_url, str)
+               else f"http://127.0.0.1:{port_or_url}")
+        try:
+            with urllib.request.urlopen(url + path, timeout=timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read())
+            except Exception:   # noqa: BLE001
+                return {"error": f"http {e.code}"}
+
+    rng = np.random.default_rng(11)
+    shared = rng.integers(0, 64, (16,)).tolist()
+    prompts = {
+        "fk-greedy": shared + rng.integers(0, 64, (6,)).tolist(),
+        "fk-sampled": rng.integers(0, 64, (7,)).tolist(),
+        "fk-prefix": shared + rng.integers(0, 64, (5,)).tolist(),
+        "fk-draft": rng.integers(0, 64, (6,)).tolist(),
+    }
+    # budgets are WIDE (vs the hard-kill lane's 12): the two replicas
+    # decode independently, so the kill window must stay open until
+    # the SLOWEST replica's streams have >= 2 tokens while the fastest
+    # has not finished — speculative rows advance ~spec_k+1 per step,
+    # so the draft row gets the widest budget
+    bodies = {
+        rid: {"input_ids": [prompts[rid]], "max_new_tokens": 24,
+              "request_id": rid, "seed": 200 + i}
+        for i, rid in enumerate(prompts)}
+    bodies["fk-sampled"].update({"do_sample": True, "temperature": 0.8})
+    bodies["fk-greedy"]["draft"] = False
+    bodies["fk-prefix"]["draft"] = False
+    bodies["fk-draft"]["draft"] = True
+    bodies["fk-draft"]["max_new_tokens"] = 32
+
+    # the uninterrupted-run oracle over the same seeded weights
+    from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+    refs = {}
+    with ContinuousBatchingEngine(_hk_model(), total_pages=128,
+                                  page_size=8, max_batch=4) as eng:
+        for rid, b in bodies.items():
+            refs[rid] = eng.submit(
+                np.asarray(b["input_ids"][0], np.int32),
+                max_new_tokens=b["max_new_tokens"],
+                do_sample=b.get("do_sample", False),
+                temperature=b.get("temperature", 1.0),
+                seed=b["seed"]).result(timeout=600)
+
+    checks, details = {}, {}
+    snap0 = monitor.snapshot()
+    procs = {}
+    sup = ReplicaSupervisor(probe_interval_s=0.1,
+                            probe_failure_threshold=2,
+                            probe_timeout_s=2.0,
+                            heartbeat_timeout_s=10.0)
+    router = FleetRouter(sup)
+    try:
+        for name in ("r0", "r1"):
+            proc, jdir, port = spawn(name, delay=0.1)
+            procs[name] = proc
+            sup.add_replica(name, f"http://127.0.0.1:{port}",
+                            journal_dir=jdir, proc=proc)
+        sup.start()
+        router.start()
+        rurl = f"http://{router.host}:{router.port}"
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 300 \
+                and len(sup.routable_replicas()) < 2:
+            _time.sleep(0.05)
+        checks["both replicas probed up"] = \
+            len(sup.routable_replicas()) == 2
+
+        # warm BOTH replicas' prefix caches so fk-prefix hits wherever
+        # round-robin lands it (hits are output-invariant — this only
+        # makes the scenario exercise the prefix path, like the
+        # hard-kill lane does on its single server)
+        def post(body, out):
+            def _go():
+                try:
+                    req = urllib.request.Request(
+                        rurl + "/generate",
+                        data=json.dumps(body).encode(),
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=600) as r:
+                        out[body["request_id"]] = json.loads(r.read())
+                except Exception as e:   # noqa: BLE001
+                    out[body["request_id"]] = {"error": repr(e)}
+            t = threading.Thread(target=_go, daemon=True)
+            t.start()
+            return t
+
+        warm_out: dict = {}
+        warm = [dict(bodies["fk-greedy"], request_id=f"warm-{i}",
+                     max_new_tokens=2, draft=False) for i in range(2)]
+        for t in [post(b, warm_out) for b in warm]:
+            t.join(timeout=300)
+
+        outs: dict = {}
+        threads = [post(bodies[rid], outs) for rid in bodies]
+        deadline = _time.monotonic() + 300
+        while _time.monotonic() < deadline:
+            states = {rid: get(rurl, f"/result/{rid}") for rid in bodies}
+            if any(s.get("status") == "done" for s in states.values()):
+                break
+            if all(s.get("generated_tokens", 0) >= 2
+                   for s in states.values()):
+                break
+            _time.sleep(0.02)
+        checks["all 4 mid-decode at kill time"] = all(
+            s.get("status") == "pending"
+            and s.get("generated_tokens", 0) >= 2
+            for s in states.values())
+        details["states_at_kill"] = states
+        # SIGKILL the replica owning the most in-flight streams
+        owners = [states[rid].get("replica") for rid in bodies]
+        victim = max(set(owners), key=owners.count)
+        details["victim"] = victim
+        details["owners"] = dict(zip(bodies, owners))
+        procs[victim].kill()
+        procs[victim].wait(timeout=30)
+
+        for t in threads:
+            t.join(timeout=300)
+        checks["zero failed requests"] = all(
+            "output_ids" in outs.get(rid, {}) for rid in bodies)
+        checks["streams bit-identical to the uninterrupted run"] = all(
+            outs.get(rid, {}).get("output_ids", [[]])[0]
+            == [int(t) for t in refs[rid]] for rid in bodies)
+        reattach = {rid: get(rurl, f"/result/{rid}") for rid in bodies}
+        checks["/result re-attaches through the router for every id"] \
+            = all(r.get("status") == "done"
+                  and r["output_ids"] == [int(t) for t in refs[rid]]
+                  for rid, r in reattach.items())
+        details["migrated_ids"] = [rid for rid, o in outs.items()
+                                   if o.get("reattached")]
+        snap1 = monitor.snapshot()
+        fo = _series_total(snap1, "fleet_failovers_total") or 0
+        mig = _series_total(snap1, "fleet_migrated_requests_total") or 0
+        checks["fleet_failovers_total fired"] = fo >= 1
+        checks["fleet_migrated_requests_total fired"] = mig >= 1
+        missing = [n for n in FLEET_SERIES
+                   if _series_total(snap1, n) is None]
+        checks["fleet/router series all exist"] = not missing
+        details["missing_series"] = missing
+        details["failovers"] = fo
+        details["migrated"] = mig
+        details["snap0_failovers"] = _series_total(
+            snap0, "fleet_failovers_total")
+    finally:
+        try:
+            router.stop()
+            sup.stop()
+        except Exception:   # noqa: BLE001 — teardown best-effort
+            pass
+        for proc in procs.values():
+            proc.kill()
+            proc.wait(timeout=30)
+        logf.close()
+    return {"checks": checks, "details": details}
+
+
+def fleet_main() -> int:
+    out = run_fleet_kill()
+    bad = [name for name, ok in out["checks"].items() if not ok]
+    if bad:
+        print(f"FAIL (fleet): {bad}; observed {out['details']}",
+              file=sys.stderr)
+        return 1
+    print(f"OK: SIGKILL'd replica {out['details']['victim']} lost "
+          f"nothing — {int(out['details']['migrated'])} streams "
+          "migrated to the survivor bit-exactly and /result "
+          "re-attached through the router")
+    return 0
+
+
 def hard_kill_main() -> int:
     out = run_hard_kill()
     bad = [name for name, ok in out["checks"].items() if not ok]
@@ -615,9 +855,17 @@ def main(argv=None) -> int:
         return serve_child(argv)
     if "--hard-kill-only" in argv:
         return hard_kill_main()
+    if "--fleet-only" in argv or "--fleet" in argv:
+        return fleet_main()
     rc = _counters_main()
     if rc == 0 and "--skip-hard-kill" not in argv:
         rc = hard_kill_main()
+    if rc == 0 and "--skip-fleet" not in argv \
+            and "--skip-hard-kill" not in argv:
+        # the fleet lane spawns subprocess replicas like the hard-kill
+        # lane; --skip-hard-kill marks a run that wants no subprocess
+        # scenarios (each gets its own gate in tests/test_tools.py)
+        rc = fleet_main()
     return rc
 
 
