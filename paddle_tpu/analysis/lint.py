@@ -136,7 +136,11 @@ LOCK_CLASSES: Dict[str, Tuple[str, frozenset]] = {
         # crash consistency (ISSUE 8): the snapshot() quiesce barrier —
         # the loop thread and snapshotting threads hand off through
         # these under _cond
-        "_stepping", "_snap_waiters"})),
+        "_stepping", "_snap_waiters",
+        # unified ragged step (ISSUE 17): the repeated-failure latch
+        # that routes iterations back to the legacy composition —
+        # flipped only via _disable_unified_locked
+        "_unified_off"})),
 }
 
 

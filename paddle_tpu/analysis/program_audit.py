@@ -569,9 +569,9 @@ def engine_program_spec(engine, mode: str = "decode", sample=None):
     import jax.numpy as jnp
     from ..inference.paged import next_pow2
 
-    if mode not in ("decode", "verify", "chunk"):
+    if mode not in ("decode", "verify", "chunk", "ragged"):
         raise ValueError(f"engine programs are mode='decode', "
-                         f"'verify' or 'chunk', got {mode!r}")
+                         f"'verify', 'chunk' or 'ragged', got {mode!r}")
     if mode == "verify" and not getattr(engine, "_spec", False):
         raise ValueError("mode='verify' needs an engine built with a "
                          "draft_model")
@@ -584,6 +584,17 @@ def engine_program_spec(engine, mode: str = "decode", sample=None):
     # share one compiled program per bucket shape)
     fn, donate = decoder.program_fn(
         "prefix" if mode == "chunk" else mode, sample)
+    # the unified ragged step (ISSUE 17) prices/audits at its WORST
+    # serving shape: the full decode batch where every row spans the
+    # largest bucket the engine composes — the chunk budget (or the
+    # verify block when speculation is the widest row type); a decode-
+    # only ragged batch is the same program at S=1
+    if mode == "ragged":
+        S_ragged = max(
+            int(engine.prefill_chunk_tokens or 0),
+            (engine.spec_k + 1) if getattr(engine, "_spec", False) else 1,
+            1)
+        S_ragged = next_pow2(S_ragged)
     # the engine's decode buckets are min(next_pow2(active), max_batch),
     # so max_batch IS the largest program shape serving ever compiles —
     # audit that one, not its power-of-two round-up
@@ -644,6 +655,21 @@ def engine_program_spec(engine, mode: str = "decode", sample=None):
         args = (params, sds((B, S), i32), sds((B,), i32),
                 sds((B * S,), i32), sds((B * S,), i32), sds((B,), i32),
                 sds((B, W), i32), s_args, *pools)
+    elif mode == "ragged":
+        # ONE program for the whole mixed step: per-row ctx lengths,
+        # span lengths and draft counts all ride traced — fn signature
+        # (params, ids, ctx_lens, q_lens, pg, sl, ptabs, nd, sampling,
+        # pools, wscales), the _verify_sampling_args 3-tuple (the draw
+        # counter is computed in-program from ctx + span + accept)
+        S = S_ragged
+        if sample == "draw":
+            s_args = (sds((B,), jnp.uint32), sds((B,), jnp.float32),
+                      sds((B,), jnp.bool_))
+        else:
+            s_args = ()
+        args = (params, sds((B, S), i32), sds((B,), i32),
+                sds((B,), i32), sds((B * S,), i32), sds((B * S,), i32),
+                sds((B, W), i32), sds((B,), i32), s_args, *pools)
     else:
         if sample == "draw":
             s_args = (sds((B,), jnp.uint32), sds((B,), i32),

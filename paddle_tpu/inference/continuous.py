@@ -146,6 +146,25 @@ __all__ = [
 _PAD_SEQ = "__pad__"
 
 
+# fault-injection sites whose quarantine semantics are defined against
+# the LEGACY per-mode dispatch granularity (one poisoned chunk fails one
+# request, a decode fault bisects the batch, ...): an iteration running
+# under a plan that targets any of them diverts to the legacy
+# composition so chaos plans keep their documented blast radius
+_ENGINE_FAULT_SITES = frozenset((
+    "prefill", "prefill_chunk", "decode_step", "engine_wedge",
+    "buffer_loss", "page_alloc"))
+# ... EXCEPT pure pacing: a delay-kind rule on a dispatch site injects
+# no failure — the unified step fires these sites itself (same sleep,
+# same seq_id targeting), so benches that throttle decode to build
+# batch occupancy warm the SAME programs the measured window runs.
+# Delay rules on engine_wedge/buffer_loss/page_alloc still divert:
+# those delays are semantic triggers (watchdog wedges, donated-buffer
+# loss windows), defined against the legacy machinery.
+_PACING_FAULT_SITES = frozenset(("prefill", "prefill_chunk",
+                                 "decode_step"))
+
+
 def _null_sampling(n: int = 1):
     """Fused-sampling args whose rows draw nothing (flags all False):
     the argmax-only program tail for dispatches whose sampled value is
@@ -299,6 +318,23 @@ _kv_quant_scale_bytes_g = monitor.gauge(
 _replay_dispatches = monitor.counter(
     "replay_dispatches_total", "compiled dispatches issued by survivor-"
     "KV replay (batched replay amortizes many survivors per dispatch)")
+# ragged unified step (ISSUE 17): dispatch economics.  The legacy step
+# composition issues one compiled dispatch per program mode per
+# iteration (prefill, chunk, decode, draft propose, verify); the
+# unified step folds prefill/chunk/decode/verify rows into ONE "ragged"
+# dispatch, so a mixed iteration's serving cost is quoted straight off
+# this counter's mode split (serve_bench's mixed-batch lane gates on it)
+_dispatches_total = monitor.counter(
+    "engine_dispatches_total", "compiled program dispatches issued by "
+    "the serving loop, per program mode — 'ragged' is the unified "
+    "single-dispatch step; 'prefill'/'chunk'/'decode'/'verify' are the "
+    "legacy composition; 'draft' is the draft model's own propose/"
+    "ingest dispatches (a second model: never foldable)", ("mode",))
+_unified_fallbacks = monitor.counter(
+    "engine_unified_fallbacks_total", "iterations where the unified "
+    "ragged dispatch failed and the engine re-ran the step through the "
+    "legacy multi-dispatch composition (whose retry/bisect isolation "
+    "then owns the failure)")
 
 # request-level tracing (ISSUE 10): the process-wide trace buffer —
 # OFF outside a monitor.start_capture() window, when every probe below
@@ -559,7 +595,8 @@ class ContinuousBatchingEngine:
                  kv_quant: Optional[str] = None,
                  replay_batch: Optional[bool] = None,
                  result_cache_size: int = 256,
-                 journal=None):
+                 journal=None,
+                 unified_step: bool = True):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_position = int(model.config.max_position_embeddings)
@@ -694,6 +731,23 @@ class ContinuousBatchingEngine:
         self.journal = journal
         self._jadm: List[str] = []
         self._jrows: List[tuple] = []
+        # ragged unified step (ISSUE 17): fold each iteration's
+        # prefill/chunk/decode/verify rows into ONE compiled dispatch.
+        # `unified_step=False` is the legacy multi-dispatch escape
+        # hatch (per-mode fault-injection plans also divert an
+        # iteration to it — the chaos sites fire per legacy dispatch,
+        # and their quarantine semantics are defined against that
+        # granularity).  `_unified_off` latches the unified path off
+        # after repeated dispatch failures (lock-guarded: readers are
+        # the scheduler thread, writers hold _cond); `_unified_failures`
+        # and `_disp_n`/`_disp_ragged` (this iteration's dispatch count
+        # and mode for the journal's step record) are scheduler-thread
+        # only, like _jadm/_jrows.
+        self.unified_step = bool(unified_step)
+        self._unified_off = False
+        self._unified_failures = 0
+        self._disp_n = 0
+        self._disp_ragged = False
         self._cond = threading.Condition()
         self._stop = False
         self._draining = False
@@ -1049,9 +1103,24 @@ class ContinuousBatchingEngine:
         row's (tokens appended, new pending next_token) — written off
         the hot path by the journal's writer thread."""
         if self.journal is not None and (self._jadm or self._jrows):
-            self.journal.append_step(self._jadm, self._jrows)
+            self.journal.append_step(
+                self._jadm, self._jrows, dispatches=self._disp_n,
+                mode=(("ragged" if self._disp_ragged else "legacy")
+                      if self._disp_n else None))
         self._jadm = []
         self._jrows = []
+        self._disp_n = 0
+        self._disp_ragged = False
+
+    def _count_dispatch(self, mode: str) -> None:
+        """Scheduler thread: one compiled serving dispatch ATTEMPT —
+        the per-mode fleet counter plus this iteration's accumulator
+        for the journal's step record (retry/bisect probes count again:
+        dispatches issued IS the cost being quoted)."""
+        _dispatches_total.inc(mode=mode)
+        self._disp_n += 1
+        if mode == "ragged":
+            self._disp_ragged = True
 
     # ---------------------------------------- request-id surface (ISSUE 10)
     def _cache_result_locked(self, req) -> None:
@@ -1702,6 +1771,7 @@ class ContinuousBatchingEngine:
                 # existing fault plans' semantics
                 _faults.maybe_fire("prefill", seq_ids=[req.seq_id])
             _faults.maybe_fire("prefill_chunk", seq_ids=[req.seq_id])
+            self._count_dispatch("chunk" if k else "prefill")
             with monitor.span("engine/prefill", histogram=_prefill_s):
                 out = self._ingest(self._decoder, self.cache, req.seq_id,
                                    target, k, n, sampling)
@@ -1733,6 +1803,14 @@ class ContinuousBatchingEngine:
                                   chunk=req.chunks_done)
         if not last:
             return False
+        self._finish_prefill(req, out[0], sampling is not None)
+        return True
+
+    def _finish_prefill(self, req, out_row, sampled: bool) -> None:
+        """Prefill-completion side effects, shared by the legacy chunk
+        path and the unified ragged step: the target is fully resident
+        — register its prefix, ingest the draft's copy, latch the first
+        sampled token, stamp TTFT, journal the pending sample."""
         # ---- target fully resident: finish what monolithic prefill did
         if self.prefix_cache:
             _prefix_lookups.inc()
@@ -1754,8 +1832,10 @@ class ContinuousBatchingEngine:
             # touched the draft pool.  The greedy-tail sampling keeps
             # the transfer at (1,) ids; the value is discarded.
             try:
+                self._count_dispatch("draft")
                 self._draft_decoder.prefill(
-                    self.draft_cache, [req.seq_id], target[None],
+                    self.draft_cache, [req.seq_id],
+                    req.prefill_target[None],
                     bucket=True, sampling=_null_sampling())
             except BaseException:  # noqa: BLE001 — degrade, don't fail
                 self._downgrade_draft([req])
@@ -1764,8 +1844,8 @@ class ContinuousBatchingEngine:
             # replayed final draw equals it by the counter contract);
             # sampled rows on the host-logits path must ALSO keep it —
             # re-picking would burn a host RNG draw
-            req.next_token = (int(out[0]) if sampling is not None
-                              else self._pick(req, out[0]))
+            req.next_token = (int(out_row) if sampled
+                              else self._pick(req, out_row))
         req.first_token_at = time.perf_counter()
         ttft = req.first_token_at - req.submitted_at
         _ttft_s.observe(ttft)
@@ -1776,7 +1856,6 @@ class ContinuousBatchingEngine:
             # prefill completion: no tokens appended yet, but the first
             # pending sample is host state a SIGKILL must not lose
             self._jrows.append((req.request_id, (), req.next_token))
-        return True
 
     def _run_chunks(self, plan) -> None:
         """Execute one iteration's prefill chunk plan (device work —
@@ -1839,6 +1918,348 @@ class ContinuousBatchingEngine:
                     self._active.append(r)
             self._cond.notify_all()
         for r in failed:
+            r.done.set()
+
+    # ------------------------------------------- unified ragged step
+    def _legacy_iteration(self) -> bool:
+        """True when THIS iteration must run the legacy multi-dispatch
+        composition: the ``unified_step=False`` escape hatch, the
+        repeated-failure latch, or an installed fault plan targeting
+        the legacy dispatch sites (chaos plans' quarantine semantics
+        are defined against per-mode dispatch granularity — one
+        poisoned chunk fails one request — which a single fused
+        dispatch would widen).  Delay-kind rules on the dispatch
+        sites themselves (prefill/prefill_chunk/decode_step) are
+        pacing, not failure injection: the unified step fires those
+        sites itself, so they do NOT divert."""
+        if not self.unified_step or self._unified_off:
+            return True
+        plan = _faults.active()
+        return plan is not None and any(
+            r.site in _ENGINE_FAULT_SITES
+            and not (r.kind == "delay"
+                     and r.site in _PACING_FAULT_SITES)
+            for r in plan.rules)
+
+    def _disable_unified_locked(self) -> None:
+        """Caller holds ``self._cond``.  Latch the unified path off
+        after repeated ragged-dispatch failures: the legacy
+        composition — whose retry/bisect isolation just absorbed those
+        failures row by row — serves from here on."""
+        self._unified_off = True
+
+    def _propose_drafts(self, reqs):
+        """Draft-model propose for the unified step — the legacy
+        ``_exec_spec_step`` propose block: ONE compiled scan dispatch
+        for the opted-in rows.  A draft failure downgrades them to
+        plain decode (their drafts stay ``-1``, which never matches:
+        they ride the verify rows with unmatched slots and advance
+        exactly one token, exactly as the legacy path degrades)."""
+        k = self.spec_k
+        drafts = np.full((len(reqs), k), -1, np.int32)
+        d_idx = [i for i, r in enumerate(reqs) if r.use_draft]
+        if not d_idx:
+            return drafts
+        Bd = self._bucket(len(d_idx))
+        d_seqs = [reqs[i].seq_id for i in d_idx]
+        d_tok = np.array([reqs[i].generated[-1] for i in d_idx],
+                         np.int32)
+        d_pos = np.array([self.draft_cache.length(s) for s in d_seqs],
+                         np.int32)
+        if Bd > len(d_idx):
+            self.draft_cache.truncate(_PAD_SEQ, 0)
+            pad_n = Bd - len(d_idx)
+            d_seqs += [_PAD_SEQ] * pad_n
+            d_tok = np.concatenate([d_tok, np.zeros(pad_n, np.int32)])
+            d_pos = np.concatenate([d_pos, np.zeros(pad_n, np.int32)])
+        try:
+            self._count_dispatch("draft")
+            prop = self._draft_decoder.multi_step(
+                self.draft_cache, d_seqs, d_tok, d_pos, k + 1)
+        except BaseException:  # noqa: BLE001 — degrade, don't fail
+            self._downgrade_draft([reqs[i] for i in d_idx])
+        else:
+            for j, i in enumerate(d_idx):
+                drafts[i] = prop[j, :k]
+        return drafts
+
+    def _unified_rollback(self, chunks, active, lens_before) -> None:
+        """Undo the unified composition after a failed (or wedged)
+        ragged dispatch, so the legacy re-run replays the EXACT same
+        step: appended decode tokens pop, every row's cache length
+        returns to its pre-step value (the decoder rolled its own
+        advance back on a host/device error; a wedge's advance stands
+        until this truncate), and speculative rows unwind the draft
+        cache the propose scan advanced."""
+        for req, _target, k, _n, _last in chunks:
+            self.cache.truncate(req.seq_id, k)
+        for r in active:
+            r.generated.pop()
+            tgt, dft = lens_before[r.seq_id]
+            self.cache.truncate(r.seq_id, tgt)
+            if dft is not None and self._spec:
+                self.draft_cache.truncate(r.seq_id, dft)
+
+    def _unified_step(self, plan) -> None:
+        """ONE ragged dispatch for the whole iteration (ISSUE 17): the
+        scheduler's rank-ordered chunk plan feeds prefill/chunk row
+        spans directly, every active row contributes its decode token
+        — or, under speculation, a (k+1)-token verify row of freshly
+        proposed drafts — and the single compiled ``ragged_step`` call
+        replaces the legacy decode-vs-chunk dispatch alternation.
+        Post-processing replays the legacy paths' side effects
+        exactly: chunk bookkeeping and prefill completion
+        (:meth:`_finish_prefill`), retirement/journal/steps accounting
+        from ``_decode_step``, speculative accept consumption with
+        partial rollback from ``_exec_spec_step``.
+
+        On ANY failure the composition unwinds
+        (:meth:`_unified_rollback`), pools rebuild + survivors replay
+        if a device-side loss zeroed them, and the iteration re-runs
+        through the legacy composition — whose retry/bisect machinery
+        owns failure isolation; repeated failures latch the unified
+        path off entirely."""
+        chunks = []
+        for req, n in plan:
+            if req.cancelled or req.done.is_set():
+                continue
+            target = req.prefill_target
+            k = req.prefill_pos
+            n = min(n, len(target) - k)
+            chunks.append((req, target, k, n, k + n == len(target)))
+        active = list(self._active)
+        if not chunks and not active:
+            return
+        spec = self._spec and any(r.use_draft for r in active)
+        k_spec = self.spec_k if spec else 0
+        lens_before = {
+            r.seq_id: (self.cache.length(r.seq_id),
+                       (self.draft_cache.length(r.seq_id)
+                        if self._spec and r.use_draft else None))
+            for r in active}
+        jlens = ({id(r): len(r.generated) for r in active}
+                 if self.journal is not None else None)
+        for r in active:
+            r.generated.append(r.next_token)
+        if active:
+            _active_seqs.set(len(active))
+            _batch_occupancy.observe(len(active) / self.max_batch)
+            _sampling_on_device_g.set(int(self.sample_on_device))
+        drafts = None
+        t_tr = _tracer.now_ns() if _tracer.enabled else 0
+        try:
+            if spec:
+                drafts = self._propose_drafts(active)
+            nchunks = len(chunks)
+            seq_ids, rows, ctxs, nds = [], [], [], []
+            for req, target, k, n, _last in chunks:
+                seq_ids.append(req.seq_id)
+                rows.append(np.asarray(target[k:k + n], np.int32))
+                ctxs.append(k)
+                nds.append(0)
+            for i, r in enumerate(active):
+                seq_ids.append(r.seq_id)
+                if spec:
+                    row = np.empty(k_spec + 1, np.int32)
+                    row[0] = r.generated[-1]
+                    row[1:] = drafts[i]
+                    nds.append(k_spec)
+                else:
+                    row = np.asarray([r.generated[-1]], np.int32)
+                    nds.append(0)
+                rows.append(row)
+                ctxs.append(self.cache.length(r.seq_id))
+            if self.sample_on_device:
+                b = len(seq_ids)
+                seeds = np.zeros(b, np.uint32)
+                temps = np.ones(b, np.float32)
+                flags = np.zeros(b, bool)
+                # the draw counter is computed IN-PROGRAM per row
+                # (ctx + span - drafts + accept), so chunk-final,
+                # decode and verify draws all land on the row's
+                # absolute token position — the replay-stable counter
+                # contract.  Intermediate chunk rows draw nothing.
+                live = [req if last else None
+                        for req, _t, _k, _n, last in chunks] + active
+                for i, r in enumerate(live):
+                    if r is None:
+                        continue
+                    seeds[i] = r.seed
+                    temps[i] = max(r.temperature, 1e-6)
+                    flags[i] = r.do_sample
+                sampling = (seeds, temps, flags)
+            else:
+                sampling = None
+            self._wedged.clear()
+            t0 = self._step_started_at = time.monotonic()
+            try:
+                # only delay-kind pacing rules can be live here
+                # (_legacy_iteration diverts everything else): fire
+                # the legacy sites so throttling plans — per-row
+                # seq_id targeting included — pace the unified step
+                # exactly as they pace the composition it replaces
+                for req, _t, k, _n, _l in chunks:
+                    if not k:
+                        _faults.maybe_fire("prefill",
+                                           seq_ids=[req.seq_id])
+                    _faults.maybe_fire("prefill_chunk",
+                                       seq_ids=[req.seq_id])
+                if active:
+                    _faults.maybe_fire(
+                        "decode_step",
+                        seq_ids=[r.seq_id for r in active])
+                hist = _decode_step_s if active else _prefill_s
+                with monitor.span("engine/ragged_step", histogram=hist):
+                    self._count_dispatch("ragged")
+                    out, accept = self._decoder.ragged_step(
+                        self.cache, seq_ids, rows, ctxs,
+                        n_drafts=(nds if spec else None),
+                        sampling=sampling)
+                    self._check_wedged(t0)
+            finally:
+                self._step_started_at = None
+            _last_step_ts.set(time.time())
+        except BaseException as e:  # noqa: BLE001 — legacy owns isolation
+            self._unified_rollback(chunks, active, lens_before)
+            _unified_fallbacks.inc()
+            self._unified_failures += 1
+            if self._unified_failures >= 3 and not self._unified_off:
+                with self._cond:
+                    self._disable_unified_locked()
+            # a device-side loss zeroed every survivor's KV: rebuild +
+            # replay BEFORE the legacy re-run decodes over zeroed pages
+            # (replay-dead requests are quarantined/ejected in here)
+            self._after_step_failure(e)
+            self._run_chunks(plan)
+            if self._active:
+                self._decode_step()
+            return
+        self._unified_failures = 0
+        now_ns = _tracer.now_ns() if _tracer.enabled and t_tr else 0
+        # ---- chunk rows: the legacy _prefill_chunk bookkeeping
+        completed: List[_Request] = []
+        for i, (req, _target, k, n, last) in enumerate(chunks):
+            req.prefill_pos = k + n
+            req.chunks_done += 1
+            self._sched.note_chunk(req)
+            if _tracer.enabled and t_tr:
+                _tracer.step_record(
+                    "prefill_chunk", self.steps, t_tr, now_ns,
+                    request=req.request_id, tokens=n, pos=k,
+                    cls=req.priority)
+                _tracer.request_event(req.request_id, "prefill_chunk",
+                                      tokens=n, pos=k,
+                                      chunk=req.chunks_done)
+            if last:
+                completed.append(req)
+                self._finish_prefill(req, out[i], sampling is not None)
+        # ---- decode/verify rows: the legacy _decode_step retirement
+        still, retired = [], []
+        accepted_emitted = 0
+        if active:
+            srows = []
+            d_idx = ([i for i, r in enumerate(active) if r.use_draft]
+                     if spec else [])
+            for i, r in enumerate(active):
+                if spec:
+                    a = int(accept[nchunks + i])
+                    # page-granular partial rollback, both caches —
+                    # the _exec_spec_step contract
+                    new_len = lens_before[r.seq_id][0] + a + 1
+                    self.cache.truncate(r.seq_id, new_len)
+                    if r.use_draft:
+                        self.draft_cache.truncate(r.seq_id, new_len)
+                    srows.append(_SpecRow(out[nchunks + i], a,
+                                          drafts[i]))
+                else:
+                    srows.append(out[nchunks + i])
+            if spec:
+                self._last_spec = (
+                    k_spec * len(d_idx),
+                    sum(int(accept[nchunks + i]) for i in d_idx))
+                if d_idx:
+                    _spec_proposed.inc(k_spec * len(d_idx))
+                    _spec_accepted.inc(self._last_spec[1])
+                    rejected = 0
+                    for i in d_idx:
+                        _spec_accept_len.observe(
+                            int(accept[nchunks + i]))
+                        rejected += int(accept[nchunks + i]) < k_spec
+                    if rejected:
+                        _spec_rollback.inc(rejected)
+                _spec_draft_pages.set(self.draft_cache.pinned_pages)
+            else:
+                self._last_spec = (0, 0)
+            if _tracer.enabled and t_tr:
+                comp: dict = {}
+                for r in active:
+                    comp[r.priority] = comp.get(r.priority, 0) + 1
+                prop, acc = self._last_spec
+                _tracer.step_record(
+                    "decode", self.steps, t_tr, now_ns,
+                    batch=len(active), classes=comp,
+                    spec_proposed=prop, spec_accepted=acc, poisoned=0,
+                    requests=[r.request_id for r in active])
+            _tokens_total.inc(len(active))
+            on_device = self.sample_on_device
+            for r, row in zip(active, srows):
+                if _tracer.enabled:
+                    if isinstance(row, _SpecRow):
+                        _tracer.request_event(
+                            r.request_id, "verify_step",
+                            step=self.steps, accept=int(row.accept))
+                    else:
+                        _tracer.request_event(r.request_id,
+                                              "decode_step",
+                                              step=self.steps)
+                eos_hit = (r.eos_token_id is not None
+                           and r.generated[-1] == r.eos_token_id)
+                if eos_hit or len(r.generated) >= r.max_new_tokens:
+                    retired.append(r)
+                    continue
+                if isinstance(row, _SpecRow):
+                    done = False
+                    for t in row.drafts[:row.accept]:
+                        r.generated.append(int(t))
+                        accepted_emitted += 1
+                        if (r.eos_token_id is not None
+                                and int(t) == r.eos_token_id) \
+                                or len(r.generated) >= r.max_new_tokens:
+                            done = True
+                            break
+                    if done:
+                        retired.append(r)
+                        continue
+                    out_row = row.out
+                else:
+                    out_row = row
+                r.next_token = (int(out_row) if on_device
+                                else self._pick(r, out_row))
+                still.append(r)
+            if accepted_emitted:
+                _tokens_total.inc(accepted_emitted)
+            if self.journal is not None:
+                for r in still:
+                    self._jrows.append(
+                        (r.request_id,
+                         list(r.generated[jlens[id(r)]:]),
+                         r.next_token))
+        with self._cond:
+            if active:
+                self.steps += 1
+                for r in retired:
+                    self._retire_locked(r)
+                self._active = still
+                if not still:
+                    self._free_pads_locked()
+            for r in completed:
+                if r in self._prefilling:
+                    self._prefilling.remove(r)
+                    self._active.append(r)
+            self._cond.notify_all()
+        if active:
+            _active_seqs.set(len(still))
+        for r in retired:
             r.done.set()
 
     def _pick(self, req, logits_row) -> int:
@@ -2269,6 +2690,7 @@ class ContinuousBatchingEngine:
                         d_pos = np.concatenate(
                             [d_pos, np.zeros(pad_n, np.int32)])
                     try:
+                        self._count_dispatch("draft")
                         prop = self._draft_decoder.multi_step(
                             self.draft_cache, d_seqs, d_tok, d_pos, k + 1)
                     except BaseException:  # noqa: BLE001 — degrade
@@ -2293,6 +2715,7 @@ class ContinuousBatchingEngine:
                     seq_ids.extend([_PAD_SEQ] * npad)
                 sampling = (self._spec_sampling_for(reqs, B)
                             if self.sample_on_device else None)
+                self._count_dispatch("verify")
                 out, accept = self._decoder.verify(
                     self.cache, seq_ids, block, pos, sampling=sampling)
                 self._check_wedged(t0)
@@ -2377,6 +2800,7 @@ class ContinuousBatchingEngine:
                                seq_ids=seq_ids[:len(reqs)])
             with monitor.span("engine/decode_step",
                               histogram=_decode_step_s):
+                self._count_dispatch("decode")
                 out_np = self._decoder.step(self.cache, seq_ids, tokens,
                                             pos, sampling=sampling)
                 self._check_wedged(t0)
@@ -2717,14 +3141,29 @@ class ContinuousBatchingEngine:
             for r in reaped:
                 r.done.set()
             try:
-                # one iteration = at most ~a chunk budget of prefill,
-                # then ONE decode step for everything active: chunked
-                # prefill interleaves with decode instead of stalling
-                # it (ISSUE 7); per-chunk failures quarantine only
-                # their own request (ISSUE 4 discipline carried over)
-                self._run_chunks(plan)         # device work: outside lock
-                if self._active:
-                    self._decode_step()
+                if self._legacy_iteration():
+                    # legacy composition: at most ~a chunk budget of
+                    # prefill dispatches, then ONE decode step for
+                    # everything active (ISSUE 7 interleaving);
+                    # per-chunk failures quarantine only their own
+                    # request (ISSUE 4 discipline carried over)
+                    self._run_chunks(plan)     # device work: outside lock
+                    if self._active:
+                        self._decode_step()
+                else:
+                    # unified ragged step (ISSUE 17): the chunk plan's
+                    # spans + every active row in ONE compiled dispatch
+                    if self.prefill_chunk_tokens is None and plan:
+                        # unchunked: full-prompt spans would give the
+                        # ragged program an unbounded (rows, max-span)
+                        # bucket space — every novel prompt length a
+                        # recompile.  Keep whole-prompt prefill on the
+                        # legacy length-bucketed program and fold only
+                        # the active rows (span 1 or k+1: bounded)
+                        # into the ragged dispatch.
+                        self._run_chunks(plan)
+                        plan = ()
+                    self._unified_step(plan)
             except BaseException as e:  # noqa: BLE001 — fail loudly, not hang
                 self._fail_all(e)
             finally:

@@ -477,17 +477,31 @@ class RequestJournal:
         makes replay idempotent by request_id."""
         self._append({"t": "admit", "req": entry})
 
-    def append_step(self, admitted_ids, rows) -> None:
+    def append_step(self, admitted_ids, rows, dispatches=None,
+                    mode=None) -> None:
         """ONE coalesced record per engine iteration: ``admitted_ids``
         are requests that took a slot this iteration, ``rows`` is
         ``(request_id, [tokens appended], next_token)`` per surviving
         row (prefill completion is a row with no tokens and the first
-        pending sample)."""
-        self._append({
+        pending sample).
+
+        ``dispatches``/``mode`` (ISSUE 17) describe HOW the iteration
+        executed: the number of compiled dispatches it issued and
+        ``"ragged"`` (the unified single-dispatch step) vs ``"legacy"``
+        (the multi-dispatch composition).  Optional keys — replay
+        ignores them (see :class:`_LiveSet`), so journals written
+        before the unified step restore unchanged, and journals written
+        after it replay on older readers."""
+        rec = {
             "t": "step", "adm": [str(i) for i in admitted_ids],
             "rows": [[str(rid), [int(tk) for tk in toks],
                       None if nxt is None else int(nxt)]
-                     for rid, toks, nxt in rows]})
+                     for rid, toks, nxt in rows]}
+        if dispatches is not None:
+            rec["n"] = int(dispatches)
+        if mode is not None:
+            rec["mode"] = str(mode)
+        self._append(rec)
 
     def append_retire(self, request_id: str, why: str = "done") -> None:
         self._append({"t": "retire", "ids": [str(request_id)],

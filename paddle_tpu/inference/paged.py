@@ -29,6 +29,7 @@ from ..ops.pallas.flash_attention import DEFAULT_MASK_VALUE
 from ..ops.pallas.paged_attention import (PagedKVCache, _gather_dequant,
                                           dequantize_kv, paged_attention,
                                           paged_attention_multi,
+                                          paged_attention_ragged,
                                           quantize_kv)
 from ..testing import faults as _faults
 
@@ -225,7 +226,7 @@ class _TracedPagedContext:
 
     def __init__(self, k_pages, v_pages, pg, sl, lens=None, tables=None,
                  prefill=False, prefix_lens=None, k_scales=None,
-                 v_scales=None):
+                 v_scales=None, q_lens=None):
         self.k_pages = list(k_pages)
         self.v_pages = list(v_pages)
         # int8 KV mode (ISSUE 9): parallel per-slot scale pools carried
@@ -239,6 +240,7 @@ class _TracedPagedContext:
         self.tables = tables
         self.prefill = prefill
         self.prefix_lens = prefix_lens  # (b,) traced, prefix-prefill only
+        self.q_lens = q_lens            # (b,) traced, ragged step only
         self.layer_idx = 0
 
     def _scatter(self, layer, ks, vs):
@@ -293,6 +295,14 @@ class _TracedPagedContext:
             out, _ = F.flash_attention(q, wrap_array(k_att),
                                        wrap_array(v_att), causal=True)
             return out
+        # ragged unified step (ISSUE 17): every row attends its OWN
+        # left-aligned span — decode rows, chunk spans and verify
+        # blocks mix in one kernel call with per-row traced lengths
+        if self.q_lens is not None:
+            out = paged_attention_ragged(q._data, kp, vp, self.lens,
+                                         self.q_lens, self.tables,
+                                         k_scales=ksc, v_scales=vsc)
+            return wrap_array(out)
         # decode / verify: s tokens per row scatter flat (s == 1 is the
         # classic decode step; s > 1 is the speculative verify block)
         if s == 1:
@@ -332,7 +342,8 @@ class JittedPagedDecoder:
     #: see one contract.  The scale-pool slots hold empty tuples (no
     #: leaves) for full-precision caches.
     DONATE_ARGNUMS = {"decode": (8, 9, 10, 11), "prefill": (6, 7, 8, 9),
-                      "prefix": (8, 9, 10, 11), "verify": (8, 9, 10, 11)}
+                      "prefix": (8, 9, 10, 11), "verify": (8, 9, 10, 11),
+                      "ragged": (9, 10, 11, 12)}
 
     def __init__(self, model, min_table_pages: int = 1,
                  quantize: Optional[str] = None):
@@ -561,6 +572,71 @@ class JittedPagedDecoder:
                                            flags)
                         return ids, accept, *pools
                     return bonus, accept, *pools   # logits escape hatch
+                finally:
+                    self._restore_params(saved)
+
+        elif mode == "ragged":
+            def fn(param_arrays, ids, ctx_lens, q_lens, pg, sl, tables,
+                   nd, sampling, k_pages, v_pages, k_scales, v_scales,
+                   wscales):
+                """Ragged UNIFIED serving step (ISSUE 17): one compiled
+                dispatch processes a batch mixing decode rows
+                (q_len 1), prefill/chunk spans, and speculative verify
+                blocks (q_len = nd + 1).  Each row's span sits
+                LEFT-aligned in the (B, S) bucket; ``ctx_lens`` is the
+                pre-write cached length (doubling as the per-row rope
+                offset), ``q_lens`` the span length, ``nd`` the draft
+                count (0 for non-verify rows, which makes the accept
+                arithmetic degenerate to 'pick the last real token').
+                Accept lengths and the output token's position select
+                ON DEVICE, so the host boundary stays (B,) ids + (B,)
+                accepts whatever the batch mixes."""
+                saved = self._swap_params(param_arrays, wscales)
+                try:
+                    ctx = _TracedPagedContext(k_pages, v_pages, pg, sl,
+                                              ctx_lens + q_lens, tables,
+                                              q_lens=q_lens,
+                                              k_scales=k_scales,
+                                              v_scales=v_scales)
+                    with no_grad():
+                        hidden = model.model(wrap_array(ids), ctx_lens,
+                                             paged_ctx=ctx)
+                        logits = model._logits_of(hidden)
+                    lg = logits._data.astype(jnp.float32)   # (B, S, V)
+                    targets = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                    # verify-row accept arithmetic, gated to the first
+                    # nd positions so chunk/decode rows (nd == 0) can
+                    # never 'accept' their own prompt tokens
+                    j = jnp.arange(1, ids.shape[1],
+                                   dtype=jnp.int32)[None, :]
+                    match = ((ids[:, 1:] == targets[:, :-1])
+                             & (j <= nd[:, None])).astype(jnp.int32)
+                    accept = jnp.sum(jnp.cumprod(match, axis=1),
+                                     axis=1).astype(jnp.int32)  # (B,)
+                    # the row's OUTPUT position: last real token for
+                    # decode/chunk rows (q_lens - 1), the bonus
+                    # position (accept) for verify rows
+                    sel = (q_lens - 1 - nd + accept).astype(jnp.int32)
+                    pools = ctx_pools(ctx)
+                    if sample == "greedy":
+                        ids_out = jnp.take_along_axis(
+                            targets, sel[:, None], axis=1)[:, 0]
+                        return ids_out, accept, *pools
+                    lg_sel = jnp.take_along_axis(
+                        lg, sel[:, None, None], axis=1)[:, 0]
+                    if sample == "draw":
+                        seeds, temps, flags = sampling
+                        # absolute position of the emitted token —
+                        # ctx + q_len for decode/chunk rows, the
+                        # bonus position ctx + accept + 1 for verify
+                        # rows: the SAME (seed, position) threefry
+                        # draw every legacy mode replays
+                        ctrs = (ctx_lens + q_lens - nd
+                                + accept).astype(jnp.int32)
+                        ids_out = fused_sample(lg_sel, seeds, ctrs,
+                                               temps, flags)
+                        return ids_out, accept, *pools
+                    return lg_sel, accept, *pools  # logits escape hatch
                 finally:
                     self._restore_params(saved)
 
@@ -946,6 +1022,113 @@ class JittedPagedDecoder:
             raise
         self._store_pools(cache, *pools)
         return np.asarray(out), np.asarray(accept)
+
+    def ragged_step(self, cache: PagedKVCache, seq_ids, rows, ctxs,
+                    n_drafts=None, sampling=None):
+        """ONE compiled dispatch for a RAGGED serving step (ISSUE 17):
+        ``rows[i]`` is a 1-D int32 token span for ``seq_ids[i]`` whose
+        cached context length is ``ctxs[i]`` — a decode row is the one
+        last-sampled token, a prefill/chunk row is the next prompt
+        slice, a speculative verify row is the last fed token followed
+        by ``n_drafts[i]`` draft proposals.  All rows run through the
+        single "ragged" program: per-row traced context lengths, span
+        lengths and draft counts, so ANY mix compiles once per
+        (B, S, W) bucket.
+
+        Spans right-pad to a power-of-two bucket (pad positions scatter
+        to the dropped out-of-bounds page; the ragged kernel clamps pad
+        queries at the row's kv length — finite garbage, discarded) and
+        the batch pads with ctx-0 single-token rows exactly like
+        ``batch_context_prefill``.  Page allocation is all-or-nothing
+        across the batch (per-row counts), and on ANY failure the
+        donated pools recover and every length rolls back to ``ctxs``
+        so the engine can replay or decompose the step.
+
+        Returns ``(out, accept)`` for the real rows: ``accept[i]``
+        counts the leading draft tokens the target reproduced (0 for
+        non-verify rows); ``out`` is the emitted token ids (batch,)
+        int32 under ``sampling=(seeds, temps, flags)`` / greedy, or the
+        selected position's logits rows on the ``sampling=None`` escape
+        hatch.  The CALLER rolls verify rows back to their accepted
+        length with ``cache.truncate`` (same contract as
+        :meth:`verify`)."""
+        b = len(seq_ids)
+        ns = [len(r) for r in rows]
+        if b == 0 or min(ns) < 1:
+            raise ValueError("every row needs at least one token")
+        nds = [0] * b if n_drafts is None else [int(x) for x in n_drafts]
+        before = []
+        for sid, k, n, nd in zip(seq_ids, ctxs, ns, nds):
+            if nd and n != nd + 1:
+                raise ValueError(
+                    f"verify row for {sid!r} must be 1 fed token + "
+                    f"{nd} drafts, got {n} tokens")
+            if cache.length(sid) != int(k):
+                raise ValueError(
+                    f"sequence {sid!r} is at length {cache.length(sid)}, "
+                    f"expected the cached context length {k}")
+            if int(k) + n > self.max_position:
+                raise ValueError(
+                    f"context {k} + span {n} exceeds "
+                    f"max_position_embeddings ({self.max_position})")
+            before.append(int(k))
+        # all-or-nothing page reservation with PER-ROW counts: a
+        # mid-batch exhaustion must not strand earlier rows' pages
+        cache.allocate_batch_atomic(seq_ids, ns)
+        # span bucket: clamp by the deepest context (the
+        # batch_context_prefill discipline) so the round-up never walks
+        # pad positions past the rope table on its own
+        s_b = max(max(ns),
+                  min(next_pow2(max(ns)),
+                      self.max_position - max(int(k) for k in ctxs)))
+        b_b = next_pow2(b)
+        ids = np.zeros((b_b, s_b), np.int32)
+        pg = np.full((b_b, s_b), cache.total_pages, np.int32)  # drop
+        sl = np.zeros((b_b, s_b), np.int32)
+        for i, (sid, row, n) in enumerate(zip(seq_ids, rows, ns)):
+            ids[i, :n] = np.asarray(row, np.int32)
+            rpg, rsl = cache.plan_write([sid], n)
+            pg[i, :n] = rpg
+            sl[i, :n] = rsl
+            cache.advance([sid], n)
+        needed = max(len(cache._seq_pages.get(sid, ()))
+                     for sid in seq_ids)
+        W = max(next_pow2(needed), self.min_table_pages)
+        tabs = np.zeros((b_b, W), np.int32)
+        for i, sid in enumerate(seq_ids):
+            t = cache._seq_pages[sid]
+            tabs[i, :len(t)] = t
+        ctx_arr = np.zeros(b_b, np.int32)
+        ctx_arr[:b] = np.asarray([int(k) for k in ctxs], np.int32)
+        ql = np.ones(b_b, np.int32)          # pad rows: 1-token span,
+        ql[:b] = np.asarray(ns, np.int32)    # ctx 0, dropped scatter
+        nd_arr = np.zeros(b_b, np.int32)
+        nd_arr[:b] = np.asarray(nds, np.int32)
+        if sampling is not None and b_b != b:
+            seeds, temps, flags = sampling
+            pad = b_b - b
+            sampling = (
+                np.concatenate([np.asarray(seeds, np.uint32),
+                                np.zeros(pad, np.uint32)]),
+                np.concatenate([np.asarray(temps, np.float32),
+                                np.ones(pad, np.float32)]),
+                np.concatenate([np.asarray(flags, bool),
+                                np.zeros(pad, bool)]))
+        sample, s_args = self._verify_sampling_args(sampling)
+        try:
+            _maybe_lose_buffers(cache, seq_ids)
+            out, accept, *pools = self._program("ragged", sample)(
+                self._param_arrays(), jnp.asarray(ids),
+                jnp.asarray(ctx_arr), jnp.asarray(ql),
+                jnp.asarray(pg.reshape(-1)), jnp.asarray(sl.reshape(-1)),
+                jnp.asarray(tabs), jnp.asarray(nd_arr), s_args,
+                *self._pool_args(cache), self._wscale_args())
+        except BaseException:
+            self._recover_pools(cache)
+            self._rollback_lengths(cache, seq_ids, before)
+            raise
+        self._store_pools(cache, *pools)
+        return np.asarray(out)[:b], np.asarray(accept)[:b]
 
     def _build_multi(self):
         """Jitted N-step GREEDY decode: lax.scan over the single-step

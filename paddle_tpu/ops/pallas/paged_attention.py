@@ -71,7 +71,7 @@ def dequantize_kv(q, scale, dtype):
 # ------------------------------------------------------------------ kernel
 def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, *rest,
                    scale, page_size, n_query=1, group=1,
-                   quantized=False):
+                   quantized=False, ragged=False):
     """Online-softmax paged attention for ``n_query`` query tokens per
     sequence.  ``n_query == 1`` is the classic decode step; n_query > 1
     is the RAGGED MULTI-QUERY verify path (speculative decoding): the
@@ -79,6 +79,15 @@ def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, *rest,
     them, and query ``s`` of the block attends causally to
     ``cols < length - (n_query - 1 - s)`` — per-row, per-query limits,
     so variable accept lengths cost masking, not padding.
+
+    ``ragged`` (ISSUE 17): ``lens_ref`` is (2, batch) — kv lengths in
+    row 0, PER-ROW query-span lengths in row 1 — and each sequence's
+    real queries sit LEFT-aligned in the n_query bucket.  Query ``j``
+    of row ``b`` attends ``cols < kv - qlen + j + 1``; bucket-pad
+    queries (j >= qlen) clamp at the full kv length, computing finite
+    garbage the caller discards.  One grid shape then serves a batch
+    mixing decode rows (qlen 1), prefill/chunk spans, and verify
+    blocks.
 
     ``quantized`` (ISSUE 9): the K/V page blocks arrive as INT8 with
     per-slot f32 scale blocks riding alongside — dequantization happens
@@ -98,7 +107,7 @@ def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, *rest,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    length = lens_ref[b]
+    length = lens_ref[0, b] if ragged else lens_ref[b]
     valid = p * page_size < length
 
     @pl.when(valid)
@@ -121,7 +130,15 @@ def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, *rest,
         # causal window ends (n_query - 1 - qpos) tokens short of the
         # full length (the later block tokens it must not see)
         qpos = lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
-        limit = length - (n_query - 1 - qpos)
+        if ragged:
+            # per-row span: query j's context is kv - qlen + j + 1
+            # tokens; a full row (qlen == n_query) reduces this to the
+            # verify limit below BIT-EXACTLY, so the unified step can
+            # never drift from the legacy modes it replaces
+            qlen = lens_ref[1, b]
+            limit = jnp.minimum(length, length - qlen + 1 + qpos)
+        else:
+            limit = length - (n_query - 1 - qpos)
         s = jnp.where(cols < limit, s, DEFAULT_MASK_VALUE)
 
         m_prev = m_scr[:, :1]
@@ -152,10 +169,12 @@ def _decode_kernel(lens_ref, tabs_ref, q_ref, k_ref, v_ref, *rest,
 
 def _decode_pallas(q, k_pages, v_pages, lengths, page_tables, scale,
                    interpret=False, n_query=1, k_scales=None,
-                   v_scales=None):
+                   v_scales=None, q_lens=None):
     """``q`` is (batch, q_heads, d) for n_query == 1, else
     (batch, n_query, q_heads, d).  ``k_scales``/``v_scales``
-    (kv_heads, total_pages, page_size, 1) f32 mark the int8 KV mode."""
+    (kv_heads, total_pages, page_size, 1) f32 mark the int8 KV mode.
+    ``q_lens`` (batch,) int32 selects the RAGGED kernel: per-row query
+    spans left-aligned in the n_query bucket (ISSUE 17)."""
     if n_query == 1:
         batch, q_heads, d = q.shape
     else:
@@ -178,9 +197,17 @@ def _decode_pallas(q, k_pages, v_pages, lengths, page_tables, scale,
              .transpose(0, 2, 1, 3, 4).reshape(batch, kv_heads, rows, d)
 
     quantized = k_scales is not None
+    ragged = q_lens is not None
+    if ragged:
+        # both length kinds ride in ONE (2, batch) scalar-prefetch
+        # argument — the index maps never read it, so the grid spec is
+        # unchanged from the uniform path
+        lengths = jnp.stack([jnp.asarray(lengths, jnp.int32),
+                             jnp.asarray(q_lens, jnp.int32)])
     kernel = functools.partial(_decode_kernel, scale=scale,
                                page_size=page_size, n_query=n_query,
-                               group=group, quantized=quantized)
+                               group=group, quantized=quantized,
+                               ragged=ragged)
     in_specs = [
         pl.BlockSpec((1, 1, rows, d),
                      lambda b, h, p, lens, tabs: (b, h, 0, 0)),
@@ -301,6 +328,46 @@ def _multi_xla(q, k_pages, v_pages, lengths, page_tables, scale,
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def _ragged_xla(q, k_pages, v_pages, lengths, q_lens, page_tables, scale,
+                k_scales=None, v_scales=None):
+    """Gather + dense masked attention with PER-ROW query spans (CPU
+    fallback / correctness oracle for the ragged unified step).  Same
+    einsum structure as ``_multi_xla`` — only the causal limit differs
+    — so a row whose span fills the bucket reproduces the verify mask
+    bit-exactly, and masked columns contribute EXACT zeros (exp of the
+    mask value underflows), keeping results identical across bucket
+    widths."""
+    batch, n_query, q_heads, d = q.shape
+    kv_heads, _tot, page_size, _d = k_pages.shape
+    group = q_heads // kv_heads
+    max_tokens = page_tables.shape[1] * page_size
+
+    def gather(pages, scales):
+        return _gather_dequant(pages, scales, page_tables, batch,
+                               kv_heads, max_tokens, d, q.dtype)
+
+    k = gather(k_pages, k_scales)
+    v = gather(v_pages, v_scales)
+    if group != 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    qt = q.transpose(0, 2, 1, 3)                  # (b, qh, nq, d)
+    s = jnp.einsum("bhsd,bhtd->bhst", qt, k,
+                   preferred_element_type=jnp.float32) * scale
+    cols = jnp.arange(max_tokens, dtype=jnp.int32)[None, None, None, :]
+    # row b's real queries sit LEFT-aligned in the bucket: query j sees
+    # cols < kv - qlen + j + 1; bucket pads (j >= qlen) clamp at kv and
+    # compute discarded garbage
+    qpos = jnp.arange(n_query, dtype=jnp.int32)[None, None, :, None]
+    kv = lengths[:, None, None, None].astype(jnp.int32)
+    ql = q_lens[:, None, None, None].astype(jnp.int32)
+    limit = jnp.minimum(kv, kv - ql + 1 + qpos)
+    s = jnp.where(cols < limit, s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhst,bhtd->bhsd", p.astype(v.dtype), v)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
 def paged_attention(q, k_pages, v_pages, lengths, page_tables, scale=None,
                     interpret=False, k_scales=None, v_scales=None):
     """Decode-step attention over a paged KV cache.
@@ -357,6 +424,54 @@ def paged_attention_multi(q, k_pages, v_pages, lengths, page_tables,
                               v_scales=v_scales)
     return _multi_xla(q, k_pages, v_pages, lengths, page_tables, scale,
                       k_scales=k_scales, v_scales=v_scales)
+
+
+def paged_attention_ragged(q, k_pages, v_pages, lengths, q_lens,
+                           page_tables, scale=None, interpret=False,
+                           k_scales=None, v_scales=None):
+    """RAGGED paged attention (ISSUE 17): ONE kernel over a batch whose
+    rows carry DIFFERENT query-span lengths — decode rows (q_len 1),
+    prefill/chunk spans, and speculative verify blocks mix in a single
+    grid, so the serving engine's whole step is one dispatch instead of
+    an alternation of per-mode programs ("Ragged Paged Attention"
+    shape).
+
+    q:           (batch, max_q, q_heads, head_dim) — row ``b``'s
+                 ``q_lens[b]`` real query tokens sit LEFT-aligned in
+                 the ``max_q`` bucket; pad positions compute finite
+                 garbage the caller discards
+    lengths:     (batch,) int32 — valid cached tokens per sequence
+                 INCLUDING the row's whole span (already scattered
+                 into the pages)
+    q_lens:      (batch,) int32 — real query tokens per row; query
+                 ``j`` attends causally to
+                 ``cols < lengths[b] - q_lens[b] + j + 1``
+    page_tables: (batch, max_pages_per_seq) int32
+    k/v_scales:  int8 KV mode scale pools — dequant fuses into the
+                 kernel / gather exactly as in the uniform paths
+
+    A row whose span fills the bucket (``q_lens[b] == max_q``)
+    reproduces :func:`paged_attention_multi`'s verify mask bit-exactly;
+    a ``max_q == 1`` call routes through :func:`paged_attention`
+    itself, so the unified step can never drift from the legacy modes.
+    Returns (batch, max_q, q_heads, head_dim).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if q.shape[1] == 1:
+        # every span is one token: literally the decode step
+        out = paged_attention(q[:, 0], k_pages, v_pages, lengths,
+                              page_tables, scale=scale,
+                              interpret=interpret, k_scales=k_scales,
+                              v_scales=v_scales)
+        return out[:, None]
+    if _use_pallas() or interpret:
+        return _decode_pallas(q, k_pages, v_pages, lengths, page_tables,
+                              scale, interpret=interpret,
+                              n_query=q.shape[1], k_scales=k_scales,
+                              v_scales=v_scales, q_lens=q_lens)
+    return _ragged_xla(q, k_pages, v_pages, lengths, q_lens, page_tables,
+                       scale, k_scales=k_scales, v_scales=v_scales)
 
 
 # ------------------------------------------------------------- page cache
@@ -517,16 +632,24 @@ class PagedKVCache:
         p = self._free.pop()
         self._seq_refs[p] = 1
         return p
-    def allocate_batch_atomic(self, seq_ids, n_tokens: int) -> None:
-        """Reserve pages for n_tokens MORE tokens on EVERY sequence, or
-        none at all: a mid-batch exhaustion rolls back this call's
-        reservations before re-raising, so a caller can fall back to
-        finer-grained allocation against an undrained pool."""
+    def allocate_batch_atomic(self, seq_ids, n_tokens) -> None:
+        """Reserve pages for MORE tokens on EVERY sequence, or none at
+        all: a mid-batch exhaustion rolls back this call's reservations
+        before re-raising, so a caller can fall back to finer-grained
+        allocation against an undrained pool.  ``n_tokens`` is one
+        count for the whole batch, or a per-sequence sequence of counts
+        — the ragged unified step's rows grow by different spans
+        (ISSUE 17)."""
+        seq_ids = list(seq_ids)
+        if isinstance(n_tokens, (int, np.integer)):
+            counts = [int(n_tokens)] * len(seq_ids)
+        else:
+            counts = [int(n) for n in n_tokens]
         before = {sid: len(self._seq_pages.get(sid, ()))
                   for sid in seq_ids}
         try:
-            for sid in seq_ids:
-                self.allocate(sid, n_tokens)
+            for sid, n in zip(seq_ids, counts):
+                self.allocate(sid, n)
         except RuntimeError:
             for sid in seq_ids:
                 pages = self._seq_pages.get(sid, [])

@@ -193,6 +193,28 @@ class TestEngineEstimate:
                   for s in snap["program_flops_total"]["series"]}
         assert series[est.name] == est.flops
 
+    def test_ragged_program_estimate(self, engine):
+        """The unified ragged step prices as ONE program (ISSUE 17).
+        Without chunking or speculation every span is one token, so
+        the ragged program costs what the decode step costs (a few
+        flops of span-index arithmetic aside); a chunked engine's
+        ragged program carries the span bucket and must cost more
+        than its decode step."""
+        est = cost.estimate_engine(engine, mode="ragged")
+        assert est.flops > 0 and est.hbm_bytes > 0
+        assert est.by_primitive["dot_general"][0] > 0
+        assert est.flops == pytest.approx(
+            cost.estimate_engine(engine, mode="decode").flops, rel=1e-3)
+
+        from paddle_tpu.inference.continuous import \
+            ContinuousBatchingEngine
+        with ContinuousBatchingEngine(
+                engine.model, total_pages=32, page_size=8, max_batch=4,
+                prefill_chunk_tokens=8) as chunked:
+            ragged = cost.estimate_engine(chunked, mode="ragged")
+            decode = cost.estimate_engine(chunked, mode="decode")
+            assert ragged.flops > decode.flops
+
     def test_publish_engine_cost_sets_mfu(self, engine):
         out = cost.publish_engine_cost(engine)
         assert out["program_flops"] > 0

@@ -79,6 +79,36 @@ class TestFramingAndReplay:
         # an admitted request's queue-wait deadline is spent: dropped
         assert e["queue_timeout_remaining_s"] is None
 
+    def test_step_record_carries_dispatch_count_and_mode(self, tmp_path):
+        """ISSUE 17 regression lock: a step record written by the
+        unified engine carries ``n`` (dispatches) and ``mode``
+        ("ragged"/"legacy"); both are OPTIONAL — absent when not
+        passed, and replay ignores them in either direction, so
+        journals cross the unified/legacy boundary unchanged."""
+        from paddle_tpu.inference.journal import _read_frames
+
+        d = str(tmp_path / "j")
+        with RequestJournal(d, fsync="always") as j:
+            j.append_admit(admit("a"))
+            j.append_step(["a"], [("a", [5], 6)], dispatches=1,
+                          mode="ragged")
+            j.append_step([], [("a", [6], 7)], dispatches=3,
+                          mode="legacy")
+            j.append_step([], [("a", [7], 8)])      # pre-ISSUE writer
+            j.flush(sync=True, timeout=30)
+        raw = b"".join(
+            open(os.path.join(d, f), "rb").read() for f in segs(d))
+        steps = [r for r in _read_frames(raw) if r["t"] == "step"]
+        assert [(r.get("n"), r.get("mode")) for r in steps] == \
+            [(1, "ragged"), (3, "legacy"), (None, None)]
+        # the unified step is ONE dispatch per iteration — that is the
+        # claim the journal now witnesses per record
+        assert steps[0]["n"] == 1
+        with RequestJournal(d) as j2:
+            ent = j2.recovered_requests()
+        assert ent[0]["generated"] == [5, 6, 7]
+        assert ent[0]["next_token"] == 8
+
     def test_readmit_replaces_state_idempotently(self, tmp_path):
         d = str(tmp_path / "j")
         with RequestJournal(d, fsync="always") as j:

@@ -9,8 +9,8 @@ import jax.numpy as jnp
 
 import paddle_tpu as paddle
 from paddle_tpu.ops.pallas.paged_attention import (
-    PagedKVCache, paged_attention, paged_attention_multi, _decode_xla,
-    _multi_xla)
+    PagedKVCache, paged_attention, paged_attention_multi,
+    paged_attention_ragged, _decode_xla, _multi_xla, _ragged_xla)
 from paddle_tpu.ops.pallas.flash_attention import mha_reference
 from paddle_tpu.ops.pallas.fused_norm_rope import (
     rms_norm_pallas, rms_norm_xla, fused_rope_pallas, fused_rope_xla)
@@ -133,6 +133,129 @@ class TestPagedAttention:
         np.testing.assert_array_equal(dense, paged)
         # pages are reclaimed when the batch finishes
         assert len(gen.cache._free) == gen.cache.total_pages
+
+
+class TestRaggedPagedAttention:
+    """Ragged unified-step kernel (ISSUE 17): per-row query spans —
+    decode rows (q_len 1), prefill/chunk spans and verify blocks in
+    ONE grid — against the XLA oracle and the per-query decode
+    definition."""
+
+    def test_ragged_kernel_matches_oracle_and_per_query_decode(self):
+        rng = np.random.default_rng(10)
+        q_heads, kv_heads, d, page, S = 8, 2, 128, 16, 4
+        cache = PagedKVCache(1, kv_heads, d, total_pages=64,
+                             page_size=page)
+        lens = [37, 6, 64]          # POST-span totals, ragged
+        _fill_cache(rng, cache, lens)
+        q = jnp.asarray(rng.standard_normal((3, S, q_heads, d)),
+                        jnp.float32)
+        tab, lengths = cache.page_table(range(3))
+        # a decode row, a mid-prompt chunk span, a full verify block
+        q_lens = jnp.asarray([1, 3, 4], jnp.int32)
+
+        out_k = paged_attention_ragged(q, cache.k_pages[0],
+                                       cache.v_pages[0], lengths,
+                                       q_lens, tab, interpret=True)
+        out_x = _ragged_xla(q, cache.k_pages[0], cache.v_pages[0],
+                            lengths, q_lens, tab, 1.0 / np.sqrt(d))
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_x),
+                                   rtol=2e-4, atol=2e-4)
+        # definition: row b's query j is a single-token decode at the
+        # interleaved length lengths[b] - q_lens[b] + j + 1
+        for b, qlen in enumerate(int(x) for x in q_lens):
+            for j in range(qlen):
+                ref = _decode_xla(q[b:b + 1, j], cache.k_pages[0],
+                                  cache.v_pages[0],
+                                  lengths[b:b + 1] - qlen + j + 1,
+                                  tab[b:b + 1], 1.0 / np.sqrt(d))
+                np.testing.assert_allclose(np.asarray(out_x[b, j]),
+                                           np.asarray(ref[0]),
+                                           rtol=2e-4, atol=2e-4)
+
+    def test_full_span_rows_reproduce_verify_mask_bitexact(self):
+        """q_lens[b] == max_q on every row is exactly the verify mask:
+        the ragged oracle and kernel must match the multi-query path
+        bit-for-bit — the unified step cannot drift from the legacy
+        verify program."""
+        rng = np.random.default_rng(11)
+        S = 3
+        cache = PagedKVCache(1, 2, 64, total_pages=32, page_size=8)
+        _fill_cache(rng, cache, [17, 9])
+        q = jnp.asarray(rng.standard_normal((2, S, 4, 64)), jnp.float32)
+        tab, lengths = cache.page_table(range(2))
+        q_lens = jnp.full((2,), S, jnp.int32)
+        np.testing.assert_array_equal(
+            np.asarray(_ragged_xla(q, cache.k_pages[0], cache.v_pages[0],
+                                   lengths, q_lens, tab, 0.125)),
+            np.asarray(_multi_xla(q, cache.k_pages[0], cache.v_pages[0],
+                                  lengths, tab, 0.125)))
+        np.testing.assert_array_equal(
+            np.asarray(paged_attention_ragged(
+                q, cache.k_pages[0], cache.v_pages[0], lengths, q_lens,
+                tab, interpret=True)),
+            np.asarray(paged_attention_multi(
+                q, cache.k_pages[0], cache.v_pages[0], lengths, tab,
+                interpret=True)))
+
+    def test_max_q_1_routes_to_decode_bitexact(self):
+        rng = np.random.default_rng(12)
+        cache = PagedKVCache(1, 2, 64, total_pages=16, page_size=8)
+        _fill_cache(rng, cache, [11, 3])
+        q = jnp.asarray(rng.standard_normal((2, 1, 4, 64)), jnp.float32)
+        tab, lengths = cache.page_table(range(2))
+        ragged = paged_attention_ragged(q, cache.k_pages[0],
+                                        cache.v_pages[0], lengths,
+                                        jnp.ones((2,), jnp.int32), tab)
+        single = paged_attention(q[:, 0], cache.k_pages[0],
+                                 cache.v_pages[0], lengths, tab)
+        np.testing.assert_array_equal(np.asarray(ragged[:, 0]),
+                                      np.asarray(single))
+
+    def test_ragged_int8_kv_interpret_matches_oracle(self):
+        """int8 KV dequant fuses into the ragged kernel exactly as in
+        the uniform paths."""
+        rng = np.random.default_rng(13)
+        kvh, total, page, d, S = 2, 8, 8, 16, 3
+        kp = jnp.asarray(rng.integers(-127, 128, (kvh, total, page, d)),
+                         jnp.int8)
+        vp = jnp.asarray(rng.integers(-127, 128, (kvh, total, page, d)),
+                         jnp.int8)
+        ks = jnp.asarray(rng.uniform(0.01, 0.1, (kvh, total, page, 1)),
+                         jnp.float32)
+        vs = jnp.asarray(rng.uniform(0.01, 0.1, (kvh, total, page, 1)),
+                         jnp.float32)
+        q = jnp.asarray(rng.normal(size=(3, S, 4, d)), jnp.float32)
+        tabs = jnp.asarray(rng.permutation(8)[:6].reshape(3, 2),
+                           jnp.int32)
+        lens = jnp.asarray([5, 11, 16], jnp.int32)
+        q_lens = jnp.asarray([1, 2, 3], jnp.int32)
+        ref = _ragged_xla(q, kp, vp, lens, q_lens, tabs, d ** -0.5,
+                          k_scales=ks, v_scales=vs)
+        out = paged_attention_ragged(q, kp, vp, lens, q_lens, tabs,
+                                     k_scales=ks, v_scales=vs,
+                                     interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_allocate_batch_atomic_per_row_counts(self):
+        """Per-row growth (the ragged step's mixed spans) reserves the
+        right page count per sequence, and a mid-batch exhaustion rolls
+        the WHOLE call back."""
+        cache = PagedKVCache(1, 2, 64, total_pages=6, page_size=4)
+        cache.allocate(0, 2)                          # 1 page
+        cache.allocate(1, 4)                          # 1 page
+        cache.allocate_batch_atomic([0, 1], [6, 5])   # +1 page each
+        assert len(cache._seq_pages[0]) == 2
+        assert len(cache._seq_pages[1]) == 2
+        free_before = len(cache._free)
+        with pytest.raises(RuntimeError, match="out of pages"):
+            # seq 0's extra page fits; seq 1 then exhausts the pool —
+            # BOTH reservations must unwind
+            cache.allocate_batch_atomic([0, 1], [12, 20])
+        assert len(cache._free) == free_before
+        assert len(cache._seq_pages[0]) == 2
+        assert len(cache._seq_pages[1]) == 2
 
 
 class TestFusedNormRope:

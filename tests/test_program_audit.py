@@ -224,6 +224,26 @@ class TestEngineChunkAudit:
                 analysis.audit_engine(eng, mode="prefill")
 
 
+class TestEngineRaggedAudit:
+    """ISSUE 17 CI satellite: the unified ragged step — the ONE
+    program a serving iteration dispatches — certified transfer-free
+    with both page pools' donation intact, on the greedy and the
+    fused-draw sampling variants."""
+
+    def test_ragged_program_transfer_free_donation_intact(self):
+        from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+        with ContinuousBatchingEngine(_tiny_model(), total_pages=32,
+                                      page_size=8, max_batch=4,
+                                      prefill_chunk_tokens=8) as eng:
+            audit = analysis.audit_engine(eng, mode="ragged")
+            assert audit.host_transfer_findings == [], audit.report()
+            assert not audit.by_rule("missed-donation"), audit.report()
+            draw = analysis.audit_engine(eng, mode="ragged",
+                                         sample="draw")
+            assert draw.host_transfer_findings == [], draw.report()
+            assert not draw.by_rule("missed-donation"), draw.report()
+
+
 class TestStaticProgramAudit:
     def test_program_audit_clean_math(self):
         prog = paddle.static.Program()

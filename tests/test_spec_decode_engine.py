@@ -164,8 +164,10 @@ class TestSpecScheduling:
             "k=3 draft — the verify step is not advancing multi-token")
 
     def test_verify_is_one_dispatch_per_step(self, target, clone_draft):
-        """No per-proposed-token host loop: exactly ONE decoder.verify
-        call per engine decode step."""
+        """No per-proposed-token host loop: exactly ONE verify-bearing
+        dispatch per engine decode step — a ``decoder.verify`` call on
+        the legacy composition, a ``ragged_step`` call carrying draft
+        rows on the unified step (ISSUE 17)."""
         from paddle_tpu.inference.continuous import ContinuousBatchingEngine
 
         calls = []
@@ -173,13 +175,21 @@ class TestSpecScheduling:
                                       max_batch=2,
                                       draft_model=clone_draft,
                                       spec_tokens=3) as eng:
-            orig = eng._decoder.verify
+            orig_v = eng._decoder.verify
+            orig_r = eng._decoder.ragged_step
 
             def counting_verify(*a, **kw):
                 calls.append(1)
-                return orig(*a, **kw)
+                return orig_v(*a, **kw)
+
+            def counting_ragged(*a, **kw):
+                nds = kw.get("n_drafts")
+                if nds is not None and any(int(x) for x in nds):
+                    calls.append(1)
+                return orig_r(*a, **kw)
 
             eng._decoder.verify = counting_verify
+            eng._decoder.ragged_step = counting_ragged
             eng.submit(_prompts([5], seed=2)[0],
                        max_new_tokens=12).result(timeout=300)
             assert len(calls) == eng.steps

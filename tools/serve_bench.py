@@ -51,6 +51,14 @@ long-prompt flood within 2x of its no-flood baseline (the unchunked
 FIFO run is printed alongside to show the stall chunking removes),
 ``jit_recompiles == 0`` in every measured window, the chunked-prefill
 program audited transfer-free, and batch-class preemption exercised.
+
+Mixed-batch dispatch lane (ISSUE 17): the scenario matrix also runs
+the flood workload through the legacy multi-dispatch composition
+(``unified_step=False``) and prints a ``mixed-batch-unified`` /
+``mixed-batch-legacy`` JSON line pair quoting tokens/s, per-class
+TTFT/TPOT and the ``engine_dispatches_total`` mode split, gating that
+the unified window is single-program (ragged-mode dispatches only,
+strictly fewer than the legacy baseline, zero fallbacks).
 """
 from __future__ import annotations
 
@@ -488,7 +496,8 @@ def _build_tiny_model(vocab=64, hidden=32):
 
 
 def run_scenario_lane(model=None, chunk_tokens=16, use_classes=True,
-                      flood_n=4, rag_n=2, chat_n=6, seed=0) -> dict:
+                      flood_n=4, rag_n=2, chat_n=6, seed=0,
+                      unified=True) -> dict:
     """One scenario-matrix serving run: ``flood_n`` long-prompt
     (96-token, 8x chunk) offline-batch requests, ``rag_n`` shared-
     system-prefix RAG requests, and ``chat_n`` short interactive
@@ -506,7 +515,16 @@ def run_scenario_lane(model=None, chunk_tokens=16, use_classes=True,
     ``monitor.snapshot()`` deltas.  The measured window must be
     compile-free: the warm pass covers every decode bucket and every
     chunk/prefix program shape the (position-derived, never
-    timing-derived) chunk plan can produce."""
+    timing-derived) chunk plan can produce.
+
+    ``unified=False`` flips the engine to the legacy multi-dispatch
+    composition (one prefill/chunk/decode/verify program per phase) —
+    the mixed-batch baseline the unified ragged step is measured
+    against.  Both variants quote the ``engine_dispatches_total`` mode
+    split, steps, tokens/s and wall time over the measured window, so
+    the 5->1 dispatch collapse reads straight off the JSON lines."""
+    import time
+
     import numpy as np
     from paddle_tpu import analysis, monitor
     from paddle_tpu.inference.continuous import ContinuousBatchingEngine
@@ -523,7 +541,8 @@ def run_scenario_lane(model=None, chunk_tokens=16, use_classes=True,
     with ContinuousBatchingEngine(
             model, total_pages=192, page_size=8, max_batch=4,
             prefill_chunk_tokens=chunk_tokens,
-            min_table_pages=16, max_queue=64) as eng:
+            min_table_pages=16, max_queue=64,
+            unified_step=unified) as eng:
         n_sub = [0]
 
         def submit(prompt, max_new, priority, tenant):
@@ -582,14 +601,27 @@ def run_scenario_lane(model=None, chunk_tokens=16, use_classes=True,
         if rag_n:
             rag_req().result(timeout=600)
         wave()
+        if unified:
+            # the unified step buckets (rows, max span) JOINTLY, so
+            # admission timing can realize a bucket combo the first
+            # warm wave missed; a second pass keeps the measured
+            # window compile-free
+            wave()
 
         before = monitor.snapshot()
+        steps0 = eng.steps
+        t0 = time.monotonic()
         reqs = wave()
+        wall_s = time.monotonic() - t0
+        steps = eng.steps - steps0
         after = monitor.snapshot()
         audit_errors = None
         if chunk_tokens:
-            audit = analysis.audit_engine(eng, mode="chunk",
-                                          publish=False)
+            # audit the program that actually served the window: the
+            # unified ragged step, or the legacy chunk program
+            audit = analysis.audit_engine(
+                eng, mode="ragged" if unified else "chunk",
+                publish=False)
             audit_errors = sum(1 for f in audit.findings
                                if f.severity == "error")
 
@@ -598,6 +630,18 @@ def run_scenario_lane(model=None, chunk_tokens=16, use_classes=True,
                   if r.first_token_at is not None]
     _, compile_sum, compile_n = _hist_delta(before, after,
                                             "jit_compile_seconds")
+    tokens = _counter_delta(before, after, "generated_tokens_total")
+    # target-model program dispatches issued in the measured window,
+    # per mode — 'draft' is a second model's own dispatches and never
+    # folds into the unified step, so it is quoted but kept out of
+    # the collapse arithmetic
+    dispatches = {
+        m: int(_counter_delta(before, after, "engine_dispatches_total",
+                              {"mode": m}))
+        for m in ("ragged", "prefill", "chunk", "decode", "verify",
+                  "draft")}
+    dispatches_target = sum(v for m, v in dispatches.items()
+                            if m != "draft")
     per_class = {}
     if use_classes:
         for c in SCENARIO_CLASSES:
@@ -630,10 +674,21 @@ def run_scenario_lane(model=None, chunk_tokens=16, use_classes=True,
         "lane": "scenario-matrix",
         "chunk_tokens": chunk_tokens,
         "classes": bool(use_classes),
+        "unified": bool(unified),
         "flood": flood_n, "rag": rag_n, "chat": chat_n,
         "chat_ttft_p50_s": _p50(chat_ttfts),
         "chat_ttft_mean_s": (sum(chat_ttfts) / len(chat_ttfts)
                              if chat_ttfts else None),
+        "wall_s": wall_s,
+        "generated_tokens": int(tokens),
+        "tokens_per_s": (tokens / wall_s) if wall_s > 0 else None,
+        "steps": int(steps),
+        "dispatches": dispatches,
+        "dispatches_target_model": int(dispatches_target),
+        "dispatches_per_step": ((dispatches_target / steps)
+                                if steps else None),
+        "unified_fallbacks": int(_counter_delta(
+            before, after, "engine_unified_fallbacks_total")),
         "jit_recompiles": int(compile_n),
         "jit_compile_seconds": compile_sum,
         "audit_error_findings": audit_errors,
@@ -642,16 +697,24 @@ def run_scenario_lane(model=None, chunk_tokens=16, use_classes=True,
 
 
 def run_scenario_matrix(argv) -> int:
-    """The ``--scenario-matrix`` lane: three runs of the same mixed
+    """The ``--scenario-matrix`` lane: four runs of the same mixed
     workload — (1) chunked+classes without the flood (the chat-class
-    no-flood TTFT baseline), (2) chunked+classes with the flood (one
-    JSON line per class), (3) unchunked FIFO with the flood (the stall
-    the scheduler exists to prevent).  Gates: chat TTFT under flood
-    within 2x of its no-flood baseline (p50, with the exact mean as
-    the quantization-free backstop); the FIFO baseline demonstrably
-    stalled; zero recompiles in every measured window; the chunked-
-    prefill program audited transfer-free; batch-class preemption
-    actually exercised."""
+    no-flood TTFT baseline), (2) chunked+classes with the flood under
+    the unified ragged step (one JSON line per class), (3) the same
+    flood through the legacy multi-dispatch composition
+    (``unified_step=False`` — the mixed-batch dispatch baseline),
+    (4) unchunked FIFO with the flood (the stall the scheduler exists
+    to prevent).  Gates: chat TTFT under flood within 2x of its
+    no-flood baseline (p50, with the exact mean as the
+    quantization-free backstop); the FIFO baseline demonstrably
+    stalled; zero recompiles in every measured window; the serving
+    program audited transfer-free; batch-class preemption actually
+    exercised; and the dispatch collapse itself — the unified window
+    issues ONLY ragged-mode dispatches (zero prefill/chunk/decode/
+    verify programs), strictly fewer target-model dispatches than the
+    legacy window on the same workload, and zero unified->legacy
+    fallbacks.  Tokens/s and chat TTFT for unified vs legacy are
+    quoted in the summary JSON (not wall-clock gated: CPU CI)."""
     chunk = _int_arg(argv, "chunk-tokens", 16)
     flood_n = _int_arg(argv, "flood", 4)
     rag_n = _int_arg(argv, "rag", 2)
@@ -662,11 +725,38 @@ def run_scenario_matrix(argv) -> int:
                               rag_n=rag_n, chat_n=chat_n)
     mixed = run_scenario_lane(model, chunk_tokens=chunk, flood_n=flood_n,
                               rag_n=rag_n, chat_n=chat_n)
+    legacy = run_scenario_lane(model, chunk_tokens=chunk, flood_n=flood_n,
+                               rag_n=rag_n, chat_n=chat_n, unified=False)
+    # the FIFO stall baseline models the HISTORICAL engine (no
+    # scheduler, no chunking, multi-dispatch composition) — running it
+    # legacy also keeps its unchunked full-prompt rows out of the
+    # unified bucket space
     fifo = run_scenario_lane(model, chunk_tokens=None, use_classes=False,
-                             flood_n=flood_n, rag_n=rag_n, chat_n=chat_n)
+                             flood_n=flood_n, rag_n=rag_n, chat_n=chat_n,
+                             unified=False)
     for c in SCENARIO_CLASSES:
         if c in mixed["per_class"]:
             print(json.dumps(mixed["per_class"][c], sort_keys=True))
+    for lane, tag in ((mixed, "unified"), (legacy, "legacy")):
+        print(json.dumps({
+            "lane": f"mixed-batch-{tag}",
+            "unified": lane["unified"],
+            "tokens_per_s": lane["tokens_per_s"],
+            "generated_tokens": lane["generated_tokens"],
+            "wall_s": lane["wall_s"],
+            "steps": lane["steps"],
+            "dispatches": lane["dispatches"],
+            "dispatches_target_model": lane["dispatches_target_model"],
+            "dispatches_per_step": lane["dispatches_per_step"],
+            "unified_fallbacks": lane["unified_fallbacks"],
+            "chat_ttft_p50_s": lane["chat_ttft_p50_s"],
+            "chat_ttft_mean_s": lane["chat_ttft_mean_s"],
+            "chat_tpot_mean_s": (lane["per_class"]
+                                 .get("interactive", {})
+                                 .get("tpot_mean_s")),
+            "jit_recompiles": lane["jit_recompiles"],
+            "audit_error_findings": lane["audit_error_findings"],
+        }, sort_keys=True))
     preemptions = (mixed["per_class"]["batch"]["preemptions"]
                    + mixed["per_class"]["batch"]["chunk_deferrals"])
     summary = {
@@ -682,7 +772,14 @@ def run_scenario_matrix(argv) -> int:
         "audit_error_findings": mixed["audit_error_findings"],
         "jit_recompiles": (alone["jit_recompiles"]
                            + mixed["jit_recompiles"]
+                           + legacy["jit_recompiles"]
                            + fifo["jit_recompiles"]),
+        "tokens_per_s_unified": mixed["tokens_per_s"],
+        "tokens_per_s_legacy": legacy["tokens_per_s"],
+        "chat_ttft_p50_legacy_s": legacy["chat_ttft_p50_s"],
+        "dispatches_unified": mixed["dispatches_target_model"],
+        "dispatches_legacy": legacy["dispatches_target_model"],
+        "unified_fallbacks": mixed["unified_fallbacks"],
     }
     print(json.dumps(summary, sort_keys=True))
     if not all((alone["chat_ttft_p50_s"], mixed["chat_ttft_p50_s"],
@@ -723,6 +820,34 @@ def run_scenario_matrix(argv) -> int:
         print("FAIL: the flood never preempted/deferred batch-class "
               "prefill — the priority machinery did not engage",
               file=sys.stderr)
+        ok = False
+    # dispatch-collapse gates (ISSUE 17): structural, not wall-clock —
+    # CPU CI cannot gate tokens/s, but it CAN prove the unified window
+    # served every phase through the one ragged program
+    md = mixed["dispatches"]
+    legacy_modes = {m: md[m] for m in ("prefill", "chunk", "decode",
+                                       "verify") if md[m]}
+    if legacy_modes or md["ragged"] <= 0:
+        print("FAIL: the unified window was not single-program — "
+              f"ragged={md['ragged']}, legacy-mode dispatches="
+              f"{legacy_modes}", file=sys.stderr)
+        ok = False
+    if legacy["dispatches"]["ragged"] != 0:
+        print("FAIL: the unified_step=False baseline issued "
+              f"{legacy['dispatches']['ragged']} ragged dispatch(es) "
+              "— it is not a multi-dispatch baseline", file=sys.stderr)
+        ok = False
+    if not (0 < mixed["dispatches_target_model"]
+            < legacy["dispatches_target_model"]):
+        print("FAIL: unified step did not reduce dispatches — "
+              f"{mixed['dispatches_target_model']} unified vs "
+              f"{legacy['dispatches_target_model']} legacy on the "
+              "same workload", file=sys.stderr)
+        ok = False
+    if mixed["unified_fallbacks"] != 0:
+        print(f"FAIL: {mixed['unified_fallbacks']} unified-step "
+              "fallback(s) to the legacy composition inside the "
+              "measured window", file=sys.stderr)
         ok = False
     return 0 if ok else 1
 
