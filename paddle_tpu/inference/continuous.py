@@ -336,6 +336,28 @@ _unified_fallbacks = monitor.counter(
     "legacy multi-dispatch composition (whose retry/bisect isolation "
     "then owns the failure)")
 
+# closed-loop overload protection (ISSUE 19): the controller's own
+# series — materialized at import so existence gates (chaos_smoke) see
+# them before the first overload
+_decode_preempt_total = monitor.counter(
+    "decode_preemptions_total", "decoding rows paused mid-decode "
+    "(pages kept, next token still pending host-side) so an urgent "
+    "waiter could take the slot or an interactive row could get back "
+    "inside its TPOT budget; the row resumes bit-exactly through the "
+    "preempt/resume path")
+_brownout_level_g = monitor.gauge(
+    "engine_brownout_level", "degradation ladder rung: 0 normal, "
+    "1 shed least-urgent class, 2 shed two least-urgent classes, "
+    "3 interactive-only (tightened deadline checks), 4 journal "
+    "fsync flipped to 'os'")
+_brownout_transitions = monitor.counter(
+    "engine_brownout_transitions_total", "brownout ladder rung "
+    "changes (escalations are immediate, de-escalations are damped "
+    "by the hysteresis patience)")
+_decode_preempt_total.inc(0)
+_brownout_level_g.set(0)
+_brownout_transitions.inc(0)
+
 # request-level tracing (ISSUE 10): the process-wide trace buffer —
 # OFF outside a monitor.start_capture() window, when every probe below
 # is a single attribute read (the decode hot path must not notice it)
@@ -596,7 +618,11 @@ class ContinuousBatchingEngine:
                  replay_batch: Optional[bool] = None,
                  result_cache_size: int = 256,
                  journal=None,
-                 unified_step: bool = True):
+                 unified_step: bool = True,
+                 brownout_thresholds=None,
+                 brownout_patience: int = 3,
+                 decode_preempt: bool = True,
+                 tpot_preempt_cooldown_s: float = 0.25):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_position = int(model.config.max_position_embeddings)
@@ -748,6 +774,36 @@ class ContinuousBatchingEngine:
         self._unified_failures = 0
         self._disp_n = 0
         self._disp_ragged = False
+        # closed-loop overload protection (ISSUE 19).  The brownout
+        # ladder is OFF by default (None): rung thresholds are
+        # queue-pressure ratios (depth / max_queue) for rungs 1..4,
+        # ascending.  Escalation is immediate (overload is now);
+        # de-escalation needs `brownout_patience` consecutive calm
+        # iterations below the hysteresis band, and an engine going
+        # idle drops straight to rung 0 (brownout is a property of
+        # load, not a latch).  `decode_preempt` lets the admission loop
+        # pause preemptible DECODING rows when no mid-prefill victim
+        # exists; the TPOT trigger additionally preempts at full
+        # occupancy when the measured step time breaches a running
+        # row's `tpot_budget_s`, rate-limited by the cooldown so a
+        # marginal budget cannot thrash pause/resume every iteration.
+        if brownout_thresholds is not None:
+            brownout_thresholds = tuple(
+                float(t) for t in brownout_thresholds)
+            if len(brownout_thresholds) != 4 \
+                    or list(brownout_thresholds) \
+                    != sorted(brownout_thresholds):
+                raise ValueError(
+                    "brownout_thresholds must be 4 ascending "
+                    f"queue-pressure ratios, got {brownout_thresholds!r}")
+        self.brownout_thresholds = brownout_thresholds
+        self.brownout_patience = max(1, int(brownout_patience))
+        self.decode_preempt = bool(decode_preempt)
+        self.tpot_preempt_cooldown_s = float(tpot_preempt_cooldown_s)
+        self._brownout = 0
+        self._brownout_calm = 0         # scheduler-thread only
+        self._step_ewma: Optional[float] = None   # scheduler-thread only
+        self._tpot_last_preempt = 0.0   # scheduler-thread only
         self._cond = threading.Condition()
         self._stop = False
         self._draining = False
@@ -779,6 +835,14 @@ class ContinuousBatchingEngine:
                 "engine/decode_step", self._step_age,
                 float(step_timeout_s), on_timeout=self._wedged.set)
             mgr.start()
+        # journal co-location (ISSUE 19 satellite): every live engine
+        # registers with the journal module so each journal's writer
+        # scales its flush cadence by the number of engines sharing the
+        # GIL on this host — N colocated writers each waking at the
+        # configured interval steal N x the GIL share one does
+        from . import journal as _journal_mod
+        _journal_mod.engine_started()
+        self._coloc_registered = True
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -922,6 +986,26 @@ class ContinuousBatchingEngine:
                     raise ValueError(
                         f"request_id {req.request_id!r} is already "
                         "live; poll GET /result/<id> or pick a new id")
+            # SLO-aware admission (ISSUE 19): shed a doomed arrival in
+            # microseconds — BEFORE it enters the queue, holds a trace
+            # timeline slot, or journals an admit record — when its
+            # class's deadline budget is already blown by the projected
+            # queue wait, or the brownout ladder sheds the class
+            shed_after = self._shed_decision_locked(pclass)
+            if shed_after is not None:
+                self._sched.note_shed(pclass.name)
+                _saturated_total.inc()
+                _tracer.request_event(
+                    req.request_id, "shed", cls=pclass.name,
+                    retry_after_s=shed_after, brownout=self._brownout)
+                err = EngineSaturated(
+                    f"admission shed for class {pclass.name!r}: "
+                    "projected queue wait exceeds its SLO budget "
+                    f"(brownout level {self._brownout}); retry in "
+                    f"~{shed_after}s")
+                err.priority_class = pclass.name
+                err.retry_after_s = shed_after
+                raise err
             try:
                 self._sched.push(req)
             except QueueFull as e:
@@ -1013,14 +1097,110 @@ class ContinuousBatchingEngine:
         clamped to [1, 30].  With ``priority`` the backlog is the
         REQUESTING CLASS's queue depth (an interactive client behind an
         empty interactive queue is told 1s even while the batch queue
-        is deep), otherwise the global depth."""
+        is deep), otherwise the global depth.
+
+        ISSUE 19 satellite: when the class carries a deadline budget
+        the hint folds in the admission controller's projected-wait
+        estimate — the time for the backlog to drain back UNDER the
+        budget, not the time to drain it entirely — so the fleet
+        router's min-Retry-After aggregation propagates truthful
+        backpressure instead of a depth-only guess."""
         with self._cond:
+            cls = None
             if priority is not None \
                     and priority in {c.name for c in self._sched.classes}:
+                cls = self._sched.resolve(priority)
                 depth = self._sched.depth(priority)
             else:
                 depth = len(self._sched)
-        return retry_after_seconds(depth, _decode_p50_seconds())
+            level = self._brownout
+        p50 = _decode_p50_seconds()
+        hint = retry_after_seconds(depth, p50)
+        if cls is not None and cls.deadline_s is not None \
+                and p50 and p50 > 0:
+            budget = cls.deadline_s * (0.5 if level >= 3 else 1.0)
+            projected = depth * p50
+            if projected > budget:
+                hint = int(min(30.0, max(1.0,
+                                         math.ceil(projected - budget))))
+        return hint
+
+    # ----------------------- closed-loop overload protection (ISSUE 19)
+    def _shed_decision_locked(self, pclass) -> Optional[int]:
+        """Why this arrival must shed, as a truthful Retry-After in
+        seconds — or None to admit.  Two independent controllers:
+
+        * the brownout ladder sheds whole classes: rung L sheds the L
+          least-urgent rank bands (rung >= 3 sheds every non-top rank
+          and HALVES the surviving class's deadline budget, so the
+          interactive-only mode also tightens its own admission);
+        * the class's ``deadline_s`` budget sheds individually doomed
+          requests: projected queue wait (class depth x measured
+          decode-step p50) already past the budget means the request
+          would time out after holding pages — reject it now instead.
+        """
+        level = self._brownout
+        p50 = _decode_p50_seconds()
+        if level >= 1:
+            ranks = sorted({c.rank for c in self._sched.classes})
+            if pclass.rank > ranks[0]:
+                bands = ranks[1:]
+                shed = bands[len(bands) - min(level, len(bands)):]
+                if level >= 3 or pclass.rank in shed:
+                    depth = self._sched.depth(pclass.name)
+                    return retry_after_seconds(max(1, depth), p50)
+        budget = pclass.deadline_s
+        if budget is None or not p50 or p50 <= 0:
+            return None
+        if level >= 3:
+            budget *= 0.5
+        projected = self._sched.depth(pclass.name) * p50
+        if projected <= budget:
+            return None
+        return int(min(30.0, max(1.0, math.ceil(projected - budget))))
+
+    def _set_brownout_locked(self, level: int, pressure: float) -> None:
+        if level == self._brownout:
+            return
+        prev, self._brownout = self._brownout, level
+        _brownout_level_g.set(level)
+        _brownout_transitions.inc()
+        _tracer.request_event(None, "brownout", level=level, prev=prev,
+                              pressure=round(pressure, 4))
+        if self.journal is not None:
+            # the last rung trades the journal's configured durability
+            # for throughput: fsync policy flips to "os" (explicit,
+            # reversible — unlike the watchdog's sticky degrade())
+            if level >= 4:
+                self.journal.set_policy("os")
+            elif prev >= 4:
+                self.journal.set_policy(self.journal.fsync_policy)
+
+    def _update_brownout_locked(self) -> None:
+        """One control-loop evaluation, each scheduler iteration.
+        Pressure is the max of queue-depth ratio and the urgent class's
+        SLO-attainment deficit; rungs escalate immediately and
+        de-escalate only after `brownout_patience` calm iterations
+        below HALF the rung's threshold (hysteresis, so a workload
+        hovering at a threshold cannot thrash the ladder)."""
+        th = self.brownout_thresholds
+        if th is None:
+            return
+        ratio = len(self._sched) / float(max(1, self.max_queue))
+        att = self._sched.urgent_attainment()
+        pressure = ratio if att is None else max(ratio, 1.0 - att)
+        level = self._brownout
+        if level < 4 and pressure >= th[level]:
+            self._brownout_calm = 0
+            self._set_brownout_locked(level + 1, pressure)
+            return
+        if level > 0 and pressure < 0.5 * th[level - 1]:
+            self._brownout_calm += 1
+            if self._brownout_calm >= self.brownout_patience:
+                self._brownout_calm = 0
+                self._set_brownout_locked(level - 1, pressure)
+        else:
+            self._brownout_calm = 0
 
     # ------------------------------------- write-ahead journal (ISSUE 13)
     @staticmethod
@@ -1171,6 +1351,12 @@ class ContinuousBatchingEngine:
                 "tenants_queued": self._sched.tenant_depths(),
                 "prefilling": len(self._prefilling),
                 "preempted": len(self._preempted),
+                # closed-loop overload state (ISSUE 19): the ladder
+                # rung and whether the controllers are armed — the
+                # fleet autoscaler reads these off /health
+                "brownout_level": self._brownout,
+                "brownout_enabled": self.brownout_thresholds is not None,
+                "decode_preempt": self.decode_preempt,
             }
 
     # ------------------------------------------------- snapshot/restore
@@ -1350,6 +1536,10 @@ class ContinuousBatchingEngine:
             self._stop = True
             self._cond.notify_all()
         self._thread.join(timeout=10)
+        if getattr(self, "_coloc_registered", False):
+            self._coloc_registered = False
+            from . import journal as _journal_mod
+            _journal_mod.engine_stopped()
         if self._hb_id is not None:
             from ..distributed.watchdog import CommTaskManager
             CommTaskManager.instance().unregister_heartbeat(self._hb_id)
@@ -1561,46 +1751,155 @@ class ContinuousBatchingEngine:
             seq_id=req.seq_id, prefix_tokens=req.prefix_tokens,
             queue_wait_s=round(req.admitted_at - req.submitted_at, 6))
 
+    def _tpot_parked_locked(self, r) -> bool:
+        """Caller holds ``self._cond``.  True while a row parked by the
+        TPOT trigger must STAY parked: some active row's TPOT budget is
+        still breached by the measured step time.  The aging boost
+        (half the resume TTL) overrides, so TPOT parking can never
+        starve a row past the reservation-bound contract; once no
+        active row is breaching (the interactive burst retired, or the
+        smaller batch brought the step time back under budget) the row
+        resumes through the ordinary path."""
+        if not getattr(r, "_tpot_parked", False):
+            return False
+        if self._preempt_rank_locked(r) < self._sched.class_of(r).rank:
+            return False                       # aging boost won
+        ewma = self._step_ewma
+        if ewma is None:
+            return False
+        for a in self._active:
+            budget = self._sched.class_of(a).tpot_budget_s
+            if budget is not None and ewma > budget:
+                return True
+        return False
+
     def _best_preempted_locked(self) -> Optional[_Request]:
         """Caller holds ``self._cond``.  The paused request that should
         resume first: most urgent EFFECTIVE class (aging boost
-        included), then preemption order."""
-        if not self._preempted:
+        included), then preemption order.  Rows the TPOT trigger parked
+        stay invisible while the budget breach that parked them
+        persists — resuming one into the still-too-slow batch would
+        undo the preemption the very next iteration."""
+        cands = [r for r in self._preempted
+                 if not self._tpot_parked_locked(r)]
+        if not cands:
             return None
-        return min(self._preempted,
+        return min(cands,
                    key=lambda r: (self._preempt_rank_locked(r),
                                   self._preempted.index(r)))
 
     def _preemption_victim_locked(self, rank: int) -> Optional[_Request]:
-        """Caller holds ``self._cond``.  The mid-prefill request to
-        pause so a rank-``rank`` request can take its slot: the LEAST
-        urgent preemptible prefilling request strictly outranked by the
+        """Caller holds ``self._cond``.  The request to pause so a
+        rank-``rank`` request can take its slot: the LEAST urgent
+        preemptible prefilling request strictly outranked by the
         waiter, preferring the least prefill progress (cheapest pause).
         EFFECTIVE rank, so an aging-boosted resumed prefill is immune
-        to re-preemption — a forced resume must stick."""
+        to re-preemption — a forced resume must stick.
+
+        With ``decode_preempt`` (ISSUE 19) and no mid-prefill victim,
+        the search extends to DECODING rows: the least urgent
+        preemptible active row is paused mid-decode — pages kept, its
+        pending ``next_token`` still host-side — and re-enters through
+        the same resume path, so batch-class rows squatting decode
+        slots can no longer wall off urgent admissions."""
         victims = [r for r in self._prefilling
+                   if self._sched.class_of(r).preemptible
+                   and self._preempt_rank_locked(r) > rank]
+        if victims:
+            return max(victims,
+                       key=lambda r: (self._sched.class_of(r).rank,
+                                      -r.prefill_pos))
+        if not self.decode_preempt:
+            return None
+        victims = [r for r in self._active
                    if self._sched.class_of(r).preemptible
                    and self._preempt_rank_locked(r) > rank]
         if not victims:
             return None
         return max(victims,
                    key=lambda r: (self._sched.class_of(r).rank,
-                                  -r.prefill_pos))
+                                  -len(r.generated)))
+
+    def _pause_locked(self, victim, for_rank: int) -> None:
+        """Caller holds ``self._cond``.  Move a preemption victim —
+        mid-prefill or mid-decode — onto the paused list (seq id,
+        pages and reservation all kept)."""
+        if victim in self._prefilling:
+            self._prefilling.remove(victim)
+        else:
+            self._active.remove(victim)
+            _decode_preempt_total.inc()
+        victim.preempted_at = time.perf_counter()
+        self._preempted.append(victim)
+        self._sched.note_preempted(victim)
+        _tracer.request_event(
+            victim.request_id, "preempt", for_rank=for_rank,
+            prefill_pos=victim.prefill_pos,
+            decoded=len(victim.generated))
 
     def _resume_locked(self, pre) -> None:
-        """Caller holds ``self._cond``.  Un-pause a preempted prefill:
+        """Caller holds ``self._cond``.  Un-pause a preempted request:
         its pause time banks into ``paused_total`` (the aging/reap
         clock survives the resume) and chunking continues from
-        ``prefill_pos`` — it never re-prefills."""
+        ``prefill_pos`` — it never re-prefills.  A row preempted
+        MID-DECODE (prefill complete, next token pending host-side)
+        rejoins the decode batch directly: its first token was already
+        emitted, so routing it through _prefilling would strand it —
+        the chunk planner has no work for a finished prefill."""
         self._preempted.remove(pre)
         if pre.preempted_at is not None:
             pre.paused_total += time.perf_counter() - pre.preempted_at
             pre.preempted_at = None
-        self._prefilling.append(pre)
+        pre._tpot_parked = False
+        if pre.first_token_at is not None \
+                and pre.prefill_pos >= len(pre.prefill_target):
+            self._active.append(pre)
+        else:
+            self._prefilling.append(pre)
         self._sched.note_resumed(pre)
         _tracer.request_event(pre.request_id, "resume",
                               prefill_pos=pre.prefill_pos,
+                              decoded=len(pre.generated),
                               paused_s=round(pre.paused_total, 6))
+
+    def _tpot_preempt_locked(self) -> None:
+        """Caller holds ``self._cond``.  The TPOT feedback loop
+        (ISSUE 19): at full occupancy, when the engine's measured
+        iteration time (EWMA over decode-bearing steps — for an active
+        row, one iteration IS one output token) breaches a running
+        row's ``tpot_budget_s``, pause the least-urgent preemptible
+        DECODING row so the smaller batch steps faster.  Rate-limited
+        by ``tpot_preempt_cooldown_s``; the parked row stays invisible
+        to resume while the breach persists (see _tpot_parked_locked)
+        and its pause time still accrues toward the aging/reap
+        clocks."""
+        if not self.decode_preempt or not self._active:
+            return
+        if len(self._active) + len(self._prefilling) < self.max_batch:
+            return
+        ewma = self._step_ewma
+        if ewma is None:
+            return
+        now = time.perf_counter()
+        if now - self._tpot_last_preempt < self.tpot_preempt_cooldown_s:
+            return
+        breached = [r for r in self._active
+                    if self._sched.class_of(r).tpot_budget_s is not None
+                    and ewma > self._sched.class_of(r).tpot_budget_s]
+        if not breached:
+            return
+        urgent = min(self._sched.class_of(r).rank for r in breached)
+        victims = [r for r in self._active
+                   if self._sched.class_of(r).preemptible
+                   and self._preempt_rank_locked(r) > urgent]
+        if not victims:
+            return
+        victim = max(victims,
+                     key=lambda r: (self._sched.class_of(r).rank,
+                                    -len(r.generated)))
+        self._pause_locked(victim, urgent)
+        victim._tpot_parked = True
+        self._tpot_last_preempt = now
 
     def _admit_locked(self) -> None:
         """Caller holds ``self._cond``.  Fill free slots from (a) paused
@@ -1628,13 +1927,7 @@ class ContinuousBatchingEngine:
                 if victim is None or head is None \
                         or self._admission_cost_locked(head) is None:
                     break
-                self._prefilling.remove(victim)
-                victim.preempted_at = time.perf_counter()
-                self._preempted.append(victim)
-                self._sched.note_preempted(victim)
-                _tracer.request_event(
-                    victim.request_id, "preempt", for_rank=qrank,
-                    prefill_pos=victim.prefill_pos)
+                self._pause_locked(victim, qrank)
                 pending_rank = qrank
                 continue
             if pending_rank is None and pre is not None and (
@@ -3107,6 +3400,13 @@ class ContinuousBatchingEngine:
                 while not self._stop and not len(self._sched) \
                         and not self._active and not self._prefilling \
                         and not self._preempted:
+                    # brownout is a property of LOAD: an engine with
+                    # nothing queued and nothing running is not
+                    # browned out, whatever the ladder last latched —
+                    # without this, a drained engine would keep
+                    # shedding the first arrivals of the next burst
+                    if self._brownout:
+                        self._set_brownout_locked(0, 0.0)
                     self._cond.wait(timeout=0.5)
                 if self._stop:
                     self._free_pads_locked()
@@ -3123,6 +3423,13 @@ class ContinuousBatchingEngine:
             try:
                 with self._cond:
                     reaped = self._reap_locked()
+                    # closed-loop overload protection (ISSUE 19): one
+                    # controller evaluation per iteration — the ladder
+                    # first (its level gates this iteration's sheds),
+                    # then the TPOT trigger (its freed slot is visible
+                    # to the admission pass below)
+                    self._update_brownout_locked()
+                    self._tpot_preempt_locked()
                     self._admit_locked()
                     plan = self._plan_chunks_locked()
                     # snapshot barrier (ISSUE 8): a waiting snapshot()
@@ -3140,6 +3447,12 @@ class ContinuousBatchingEngine:
                 continue
             for r in reaped:
                 r.done.set()
+            # TPOT signal (ISSUE 19): for an active row one iteration
+            # is one output token, so the whole iteration's wall time —
+            # chunks included — is the per-token latency the budget is
+            # judged against.  Scheduler-thread only, like _disp_n.
+            had_active = bool(self._active)
+            t_iter = time.perf_counter()
             try:
                 if self._legacy_iteration():
                     # legacy composition: at most ~a chunk budget of
@@ -3167,6 +3480,11 @@ class ContinuousBatchingEngine:
             except BaseException as e:  # noqa: BLE001 — fail loudly, not hang
                 self._fail_all(e)
             finally:
+                if had_active:
+                    dt = time.perf_counter() - t_iter
+                    self._step_ewma = (dt if self._step_ewma is None
+                                       else 0.7 * self._step_ewma
+                                       + 0.3 * dt)
                 # ISSUE 13: the iteration's coalesced journal record —
                 # admitted ids + per-row emissions — enqueued ONCE per
                 # loop pass (rows for requests _fail_all just retired

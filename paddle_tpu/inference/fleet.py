@@ -85,7 +85,7 @@ from ..testing import faults as _faults
 from .server import GenerationServer, _JsonHandler, _ServerLifecycle
 
 __all__ = ["CircuitBreaker", "Replica", "ReplicaSupervisor",
-           "FleetRouter"]
+           "FleetRouter", "FleetAutoscaler"]
 
 # fleet telemetry (ISSUE 14): replica-labeled, so N engines in one
 # process (the in-process supervisor mode) keep their series separated
@@ -107,6 +107,17 @@ _circuit_open = monitor.gauge(
     "router_circuit_open", "1 while the replica's admission circuit "
     "is open (consecutive-failure threshold crossed; half-open probes "
     "re-close it), else 0", ("replica",))
+_scale_events = monitor.counter(
+    "fleet_scale_events_total", "elastic replica-count changes made "
+    "by the autoscaler (ISSUE 19): 'up' spawns a fresh replica when "
+    "the fleet's queue/SLO pressure holds above the scale-up band, "
+    "'down' drain-then-retires the newest surplus replica when load "
+    "subsides", ("direction",))
+_fleet_size_g = monitor.gauge(
+    "fleet_size", "replicas the supervisor currently owns (DEAD "
+    "replicas excluded)")
+_scale_events.inc(0, direction="up")       # materialize the series
+_scale_events.inc(0, direction="down")
 
 
 def _http_json(url: str, body: Optional[dict] = None,
@@ -300,23 +311,14 @@ class ReplicaSupervisor:
         self._migration_listeners: List[Callable] = []
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
+        self._spawn_seq = 0     # next factory replica's ordinal
         if factory is not None:
             if journal_root is None:
                 import tempfile
                 journal_root = tempfile.mkdtemp(prefix="fleet-journal-")
             self.journal_root = journal_root
-            import os
             for i in range(int(replicas)):
-                name = f"r{i}"
-                jdir = os.path.join(journal_root, name)
-                srv = factory(name, jdir)
-                srv.start()
-                srv.wait_ready(30.0)
-                self._register(Replica(
-                    name, f"http://{srv.host}:{srv.port}",
-                    journal_dir=jdir, server=srv,
-                    breaker_threshold=breaker_threshold,
-                    breaker_reset_s=breaker_reset_s))
+                self.spawn_replica()
         else:
             self.journal_root = journal_root
 
@@ -325,6 +327,76 @@ class ReplicaSupervisor:
         with self._lock:
             self.replicas[rep.name] = rep
         _replica_up.set(0, replica=rep.name)   # until the first probe
+        self._note_size()
+
+    def _note_size(self) -> None:
+        with self._lock:
+            n = sum(1 for r in self.replicas.values()
+                    if r.state != Replica.DEAD)
+        _fleet_size_g.set(n)
+
+    def spawn_replica(self) -> Replica:
+        """Build ONE more in-process replica from the factory (elastic
+        scale-up, ISSUE 19): fresh name, fresh journal directory,
+        started on port 0 and registered once its readiness signal
+        fires.  With the probe thread running the newcomer gets its
+        heartbeat armed and an immediate probe, so the router can route
+        to it without waiting out a probe interval."""
+        if self._factory is None:
+            raise RuntimeError("spawn_replica needs a replica factory")
+        import os
+        with self._lock:
+            name = f"r{self._spawn_seq}"
+            self._spawn_seq += 1
+        jdir = (None if self.journal_root is None
+                else os.path.join(self.journal_root, name))
+        srv = self._factory(name, jdir)
+        srv.start()
+        srv.wait_ready(30.0)
+        rep = Replica(name, f"http://{srv.host}:{srv.port}",
+                      journal_dir=jdir, server=srv,
+                      breaker_threshold=self.breaker_threshold,
+                      breaker_reset_s=self.breaker_reset_s)
+        self._register(rep)
+        if self._probe_thread is not None:
+            self._arm_heartbeat(rep)
+            self.probe_once(rep)
+        return rep
+
+    def retire_replica(self, name: str,
+                       timeout_s: float = 30.0) -> bool:
+        """Drain-then-retire one replica (elastic scale-down,
+        ISSUE 19): flip it DRAINING so the router stops sending new
+        work, let every in-flight generation finish, then stop the
+        server and deregister.  The state goes DEAD *before* the
+        listener drops so a probe racing the teardown can never read
+        the dead socket as a failure and trigger a failover — this is
+        a deliberate, clean exit, not a death.  Returns False when the
+        drain timed out (the replica is retired regardless: in-flight
+        work past the timeout is ABANDONED, so size the timeout to the
+        workload)."""
+        rep = self.replica(name)
+        ok = True
+        rep.state = Replica.DRAINING
+        if rep.server is not None:
+            try:
+                rep.server.begin_drain()
+                ok = bool(rep.server.wait_drained(timeout_s))
+            except Exception:  # noqa: BLE001 — retire regardless
+                ok = False
+        rep.state = Replica.DEAD
+        self._disarm_heartbeat(name)
+        if rep.server is not None:
+            try:
+                rep.server.stop()
+            except Exception:  # noqa: BLE001 — already going away
+                pass
+        with self._lock:
+            self.replicas.pop(name, None)
+            self._failed_over.discard(name)
+        _replica_up.set(0, replica=name)
+        self._note_size()
+        return ok
 
     def add_replica(self, name: str, url: str,
                     journal_dir: Optional[str] = None,
@@ -1064,3 +1136,173 @@ class FleetRouter(_ServerLifecycle):
                      "new_tokens": width - prompt_len,
                      "request_ids": row_ids,
                      "reattached": True}, {}
+
+
+class FleetAutoscaler:
+    """Elastic replica count (ISSUE 19 tentpole d): close the loop
+    from the telemetry the supervisor already scrapes — per-replica
+    ``/health`` queue depth, Retry-After hints and the engine's
+    brownout rung — to the replica count, within ``[min_replicas,
+    max_replicas]``.
+
+    The control law is deliberately boring: mean routable-replica
+    queue depth at or above ``scale_up_depth`` (or any replica browned
+    out) for ``up_patience`` consecutive evaluations spawns ONE
+    replica; mean depth at or below ``scale_down_depth`` with every
+    ladder at rung 0 for ``down_patience`` evaluations drain-then-
+    retires the NEWEST routable replica (the oldest replicas hold the
+    warmest prefix caches).  Asymmetric patience plus ``cooldown_s``
+    between any two actions is the hysteresis: scale-up is eager
+    (overload is now), scale-down is reluctant (a flapping workload
+    must not thrash replica churn), and one action per cooldown bounds
+    the rate either way.
+
+    ``evaluate()`` is public so tests and bench lanes can drive the
+    loop deterministically; ``start()`` runs it on a thread every
+    ``interval_s`` against fresh probe data."""
+
+    def __init__(self, supervisor: ReplicaSupervisor,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 scale_up_depth: float = 8.0,
+                 scale_down_depth: float = 0.5,
+                 interval_s: float = 0.25,
+                 up_patience: int = 2, down_patience: int = 8,
+                 cooldown_s: float = 2.0,
+                 drain_timeout_s: float = 30.0):
+        if int(min_replicas) < 1 or int(max_replicas) < int(min_replicas):
+            raise ValueError(
+                "need 1 <= min_replicas <= max_replicas, got "
+                f"[{min_replicas}, {max_replicas}]")
+        self.supervisor = supervisor
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.scale_up_depth = float(scale_up_depth)
+        self.scale_down_depth = float(scale_down_depth)
+        self.interval_s = float(interval_s)
+        self.up_patience = max(1, int(up_patience))
+        self.down_patience = max(1, int(down_patience))
+        self.cooldown_s = float(cooldown_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_scale = 0.0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------- lifecycle
+    def start(self) -> "FleetAutoscaler":
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            # a drain-in-progress holds the loop; the drain timeout
+            # bounds it
+            self._thread.join(timeout=self.drain_timeout_s + 10.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate()
+            except Exception as e:  # noqa: BLE001 — the autoscaler
+                # must never take the fleet down with it; a failed
+                # spawn (OOM, port exhaustion) retries next evaluation
+                warnings.warn(f"fleet autoscaler evaluation failed: "
+                              f"{e!r}")
+
+    # --------------------------------------------------------- control
+    def pressure(self) -> dict:
+        """The loop's current inputs (also handy for bench output)."""
+        reps = self.supervisor.routable_replicas()
+        depth = 0
+        brownout = 0
+        hint = 0
+        for r in reps:
+            h = r.health or {}
+            depth += int(h.get("queued_sequences", 0) or 0)
+            sched = h.get("scheduler") or {}
+            brownout = max(brownout,
+                           int(sched.get("brownout_level", 0) or 0))
+            hint = max(hint, int(r.retry_after_hint or 0))
+        return {
+            "routable": len(reps),
+            "mean_depth": depth / max(1, len(reps)),
+            "max_brownout": brownout,
+            "max_retry_after": hint,
+        }
+
+    def evaluate(self) -> Optional[str]:
+        """One control-loop step: returns ``"up"``/``"down"`` when it
+        scaled, else None."""
+        reps = self.supervisor.routable_replicas()
+        if not reps:
+            # nothing routable means a failover is in flight — that is
+            # the supervisor's emergency, not a capacity signal
+            self._up_streak = self._down_streak = 0
+            return None
+        size = sum(1 for r in self.supervisor.all_replicas()
+                   if r.state != Replica.DEAD)
+        p = self.pressure()
+        overloaded = (p["mean_depth"] >= self.scale_up_depth
+                      or p["max_brownout"] >= 1)
+        calm = (p["mean_depth"] <= self.scale_down_depth
+                and p["max_brownout"] == 0)
+        now = time.monotonic()
+        cooled = now - self._last_scale >= self.cooldown_s
+        if overloaded:
+            self._down_streak = 0
+            self._up_streak += 1
+            if self._up_streak >= self.up_patience \
+                    and size < self.max_replicas and cooled:
+                self._up_streak = 0
+                rep = self.supervisor.spawn_replica()
+                self._last_scale = time.monotonic()
+                self.scale_ups += 1
+                _scale_events.inc(direction="up")
+                warnings.warn(
+                    f"fleet scaled UP to {size + 1} replicas "
+                    f"({rep.name}): mean queue depth "
+                    f"{p['mean_depth']:.1f}, brownout "
+                    f"{p['max_brownout']}")
+                return "up"
+        elif calm:
+            self._up_streak = 0
+            self._down_streak += 1
+            if self._down_streak >= self.down_patience \
+                    and size > self.min_replicas and cooled:
+                self._down_streak = 0
+                victim = max(reps, key=lambda r: r.created_at)
+                self.supervisor.retire_replica(
+                    victim.name, timeout_s=self.drain_timeout_s)
+                self._last_scale = time.monotonic()
+                self.scale_downs += 1
+                _scale_events.inc(direction="down")
+                return "down"
+        else:
+            self._up_streak = self._down_streak = 0
+        return None
+
+    def info(self) -> dict:
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "scale_up_depth": self.scale_up_depth,
+            "scale_down_depth": self.scale_down_depth,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            **self.pressure(),
+        }

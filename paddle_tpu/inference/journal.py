@@ -78,6 +78,7 @@ import struct
 import threading
 import time
 import warnings
+import weakref
 import zlib
 from collections import OrderedDict
 from typing import Dict, List, Optional
@@ -93,6 +94,46 @@ __all__ = [
 ]
 
 FSYNC_POLICIES = ("always", "interval_ms", "os")
+
+# ----------------------------------------------------------------------
+# co-location registry (ISSUE 19 satellite, ROADMAP item (f)): N
+# engines in one process mean N journal writer threads sharing the
+# GIL — each waking at the CONFIGURED interval they steal N x the
+# GIL share one writer does (PR 14 measured the decode step p50 at
+# 4.2 ms solo vs 6.3 ms with two colocated journaling engines).  Every
+# engine registers here on start/stop; every live journal scales its
+# EFFECTIVE flush cadence by the live-engine count, so the per-host
+# writer wake rate stays roughly constant as replicas pack in.
+_coloc_lock = threading.Lock()
+_live_engines = 0
+_journals: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_engines() -> int:
+    with _coloc_lock:
+        return _live_engines
+
+
+def _set_live_engines(delta: int) -> int:
+    global _live_engines
+    with _coloc_lock:
+        _live_engines = max(0, _live_engines + delta)
+        n = _live_engines
+        journals = list(_journals)
+    for j in journals:
+        j._set_colocation(max(1, n))
+    return n
+
+
+def engine_started() -> int:
+    """One more engine is live in this process; returns the new count.
+    Called by the engine constructor (any engine, journaled or not —
+    a journal-less engine still steps on the same GIL)."""
+    return _set_live_engines(+1)
+
+
+def engine_stopped() -> int:
+    return _set_live_engines(-1)
 
 #: frame = MAGIC + <u32 payload length> + <u32 payload crc32> + payload
 _MAGIC = b"RJ"
@@ -333,6 +374,11 @@ class RequestJournal:
         self.fsync_policy = fsync           # configured
         self._policy = fsync                # effective (degrade flips it)
         self.fsync_interval_s = float(fsync_interval_ms) / 1000.0
+        # co-location scaling (ISSUE 19 satellite): the writer's
+        # EFFECTIVE cadence is interval x live engines on this host,
+        # kept current by engine_started()/engine_stopped()
+        self._colocation = max(1, live_engines())
+        _journals.add(self)
         self.segment_bytes = int(segment_bytes)
         self.compact_dead_ratio = float(compact_dead_ratio)
         self.compact_min_records = int(compact_min_records)
@@ -586,6 +632,38 @@ class RequestJournal:
             "degrading to fsync='os' (durability now depends on the OS "
             "page cache)")
 
+    def set_policy(self, policy: str) -> None:
+        """Explicitly set the EFFECTIVE fsync policy (ISSUE 19: the
+        brownout ladder's last rung flips to ``os`` — maximum engine
+        throughput, durability narrowed to the OS page cache — and
+        de-escalation restores the configured policy by passing
+        ``fsync_policy`` back in).  Unlike :meth:`degrade` this is
+        reversible and does not mark the journal degraded; while the
+        watchdog HAS degraded the journal, the sticky ``os`` policy
+        wins and this is a no-op."""
+        if policy not in FSYNC_POLICIES:
+            raise ValueError(
+                f"fsync policy must be one of {FSYNC_POLICIES}, "
+                f"got {policy!r}")
+        with self._lock:
+            if self._degraded:
+                return
+            if policy == self._policy:
+                return
+            self._policy = policy
+            self._lock.notify_all()
+
+    def _set_colocation(self, n: int) -> None:
+        with self._lock:
+            self._colocation = max(1, int(n))
+            self._lock.notify_all()
+
+    @property
+    def effective_fsync_interval_s(self) -> float:
+        """The interval the writer actually flushes at: configured
+        interval x colocated live engines."""
+        return self.fsync_interval_s * self._colocation
+
     @property
     def degraded(self) -> bool:
         return self._degraded
@@ -611,6 +689,9 @@ class RequestJournal:
                 "fsync_policy": self.fsync_policy,
                 "effective_fsync_policy": self._policy,
                 "degraded": self._degraded,
+                "colocated_engines": self._colocation,
+                "effective_fsync_interval_ms": round(
+                    self.effective_fsync_interval_s * 1000.0, 3),
                 "segments": segments,
                 "live_requests": len(self._live.entries),
                 "torn_records": self.torn_records,
@@ -656,8 +737,8 @@ class RequestJournal:
                 while (not self._queue and not self._closing
                        and self._compact_req <= self._compact_done
                        and not (self._dirty and self._sync_due())):
-                    self._lock.wait(min(0.2, max(self.fsync_interval_s,
-                                                 1e-3)))
+                    self._lock.wait(min(
+                        0.2, max(self.effective_fsync_interval_s, 1e-3)))
                 batch = self._queue
                 self._queue = []
                 closing = self._closing
@@ -697,7 +778,7 @@ class RequestJournal:
         if self._policy == "os":
             return False
         return (time.monotonic() - self._last_sync
-                >= self.fsync_interval_s)
+                >= self.effective_fsync_interval_s)
 
     def _write_batch(self, batch: List[dict]) -> None:
         for rec in batch:

@@ -56,15 +56,28 @@ class PriorityClass:
     """One scheduling class.  ``rank`` orders urgency (lower = more
     urgent: chunk budget and slot preemption both favor lower ranks);
     ``weight`` is the class's admission share under weighted DRR;
-    ``preemptible`` marks classes whose chunked prefill the engine may
-    pause for lower-rank traffic; ``max_queue`` overrides the
-    scheduler-wide per-class queue bound."""
+    ``preemptible`` marks classes whose chunked prefill — and, since
+    ISSUE 19, whose in-flight decode — the engine may pause for
+    lower-rank traffic; ``max_queue`` overrides the scheduler-wide
+    per-class queue bound.
+
+    SLO budgets (ISSUE 19, both optional — None disables the control
+    loop for the class): ``deadline_s`` is the class's queue-wait/TTFT
+    budget — admission sheds a request on arrival when the projected
+    queue wait (class depth x measured decode-step p50) already
+    exceeds it, and TTFT <= deadline_s is what the per-class SLO
+    attainment window counts; ``tpot_budget_s`` is the per-token decode
+    budget — when a running row of this class sees the engine's
+    measured step time exceed it at full occupancy, the engine pauses
+    the least-urgent preemptible *decoding* row to shrink the batch."""
 
     name: str
     rank: int
     weight: int = 1
     preemptible: bool = False
     max_queue: Optional[int] = None
+    deadline_s: Optional[float] = None
+    tpot_budget_s: Optional[float] = None
 
 
 #: the default class taxonomy: chat-style traffic outranks everything,
@@ -119,6 +132,18 @@ _preempt_expired_total = monitor.counter(
     "they held their page reservation past the resume TTL without a "
     "slot freeing up (ISSUE 8: the reservation bound), per class",
     ("cls",))
+_shed_total = monitor.counter(
+    "sched_shed_on_arrival_total", "submissions shed at admission by "
+    "the overload controller (ISSUE 19): the class's deadline budget "
+    "was already blown by the projected queue wait, or the brownout "
+    "ladder sheds the class outright — rejected in microseconds with "
+    "a truthful Retry-After instead of timing out holding pages, per "
+    "class", ("cls",))
+
+#: recent per-class SLO attainment window (requests): big enough to
+#: smooth one burst, small enough that recovery is visible within a
+#: bench measurement window
+_ATTAINMENT_WINDOW = 64
 
 
 class QueueFull(RuntimeError):
@@ -144,7 +169,7 @@ class _TenantQueue:
 
 
 class _ClassState:
-    __slots__ = ("spec", "tenants", "deficit", "depth")
+    __slots__ = ("spec", "tenants", "deficit", "depth", "slo_recent")
 
     def __init__(self, spec: PriorityClass):
         self.spec = spec
@@ -152,6 +177,9 @@ class _ClassState:
         self.tenants: "OrderedDict[str, _TenantQueue]" = OrderedDict()
         self.deficit = 0.0
         self.depth = 0
+        # sliding window of per-request SLO outcomes (ISSUE 19): 1 =
+        # TTFT met the class deadline budget, 0 = blown
+        self.slo_recent: Deque[int] = deque(maxlen=_ATTAINMENT_WINDOW)
 
 
 class WorkloadScheduler:
@@ -182,6 +210,7 @@ class WorkloadScheduler:
         self.default_class = default_class
         for name in self._classes:
             _queue_depth_g.set(0, cls=name)
+            _shed_total.inc(0, cls=name)   # materialize for /metrics
 
     # ----------------------------------------------------------- lookup
     def resolve(self, name: Optional[str]) -> PriorityClass:
@@ -232,7 +261,28 @@ class WorkloadScheduler:
             "max_queue": (self.max_queue if cs.spec.max_queue is None
                           else cs.spec.max_queue),
             "queued": cs.depth,
+            "deadline_s": cs.spec.deadline_s,
+            "tpot_budget_s": cs.spec.tpot_budget_s,
+            "slo_attainment": self.attainment(cs.spec.name),
         } for cs in self._by_rank}
+
+    def attainment(self, priority: str) -> Optional[float]:
+        """Fraction of the class's last ``_ATTAINMENT_WINDOW`` retired
+        first tokens that met ``deadline_s`` (None while the class has
+        no budget or no samples).  Feeds the brownout ladder and the
+        fleet autoscaler."""
+        cs = self._classes.get(priority)
+        if cs is None or not cs.slo_recent:
+            return None
+        return sum(cs.slo_recent) / len(cs.slo_recent)
+
+    def urgent_attainment(self) -> Optional[float]:
+        """Attainment of the most urgent class that carries a deadline
+        budget — the brownout ladder's SLO input."""
+        for cs in self._by_rank:
+            if cs.spec.deadline_s is not None:
+                return self.attainment(cs.spec.name)
+        return None
 
     # ------------------------------------------------------------ queues
     def push(self, req) -> None:
@@ -422,6 +472,19 @@ class WorkloadScheduler:
 
     def note_first_token(self, req, ttft_s: float) -> None:
         _ttft_s.observe(ttft_s, cls=req.priority)
+        cs = self._classes[req.priority]
+        if cs.spec.deadline_s is not None:
+            cs.slo_recent.append(1 if ttft_s <= cs.spec.deadline_s
+                                 else 0)
+
+    def note_shed(self, priority: str) -> None:
+        """One arrival shed by the overload controller (ISSUE 19).
+        Sheds do NOT enter the attainment window: attainment is defined
+        over ADMITTED work (a shed is an honest sub-millisecond 429,
+        not a blown promise), and counting them would let rung-3
+        interactive shedding depress the very signal whose recovery
+        de-escalates the ladder."""
+        _shed_total.inc(cls=priority)
 
     def note_retired(self, req) -> None:
         """Observe TPOT at retirement: mean seconds per output token

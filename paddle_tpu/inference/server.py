@@ -379,7 +379,11 @@ class GenerationServer(_ServerLifecycle):
                  journal_fsync: str = "interval_ms",
                  journal_fsync_interval_ms: float = 50.0,
                  journal_segment_bytes: int = 1 << 20,
-                 journal_fsync_timeout_s: Optional[float] = None):
+                 journal_fsync_timeout_s: Optional[float] = None,
+                 brownout_thresholds=None,
+                 brownout_patience: int = 3,
+                 decode_preempt: bool = True,
+                 tpot_preempt_cooldown_s: float = 0.25):
         from .continuous import (ContinuousBatchingEngine,
                                  DeadlineExceeded, EngineDraining,
                                  EngineSaturated)
@@ -419,7 +423,11 @@ class GenerationServer(_ServerLifecycle):
                 min_table_pages=min_table_pages,
                 preempt_resume_ttl_s=preempt_resume_ttl_s,
                 quantize=quantize, kv_quant=kv_quant,
-                replay_batch=replay_batch, journal=self._journal)
+                replay_batch=replay_batch, journal=self._journal,
+                brownout_thresholds=brownout_thresholds,
+                brownout_patience=brownout_patience,
+                decode_preempt=decode_preempt,
+                tpot_preempt_cooldown_s=tpot_preempt_cooldown_s)
         except BaseException:
             # a rejected engine knob must not leak the journal's
             # writer thread / open segment / watchdog heartbeat (the
@@ -706,11 +714,15 @@ class GenerationServer(_ServerLifecycle):
                     # service time (its queue depth x measured
                     # decode-step p50, clamped to [1, 30]s): a chat
                     # client is never told to back off for the batch
-                    # queue's sins
+                    # queue's sins.  An admission SHED (ISSUE 19)
+                    # carries its own projected-wait hint, computed
+                    # at the decision — prefer it over re-deriving
+                    hint = getattr(e, "retry_after_s", None)
                     cls = getattr(e, "priority_class", None) or priority
+                    if hint is None:
+                        hint = outer._engine.retry_after_hint(cls)
                     self._reply(429, {"error": str(e)}, headers={
-                        "Retry-After":
-                            str(outer._engine.retry_after_hint(cls))})
+                        "Retry-After": str(hint)})
                 except EngineDraining as e:
                     self._reply(503, {"error": str(e), "draining": True})
                 except DeadlineExceeded as e:

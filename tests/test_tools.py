@@ -330,6 +330,53 @@ class TestServeBench:
         assert out["fleet1_decode_step_p50_s"] \
             <= out["baseline_decode_step_p50_s"] * 1.05
 
+    def test_overload_lane_gate(self, capsys):
+        # ISSUE 19 acceptance: under a 3x interactive burst on top of a
+        # saturating batch flood, the SLO-aware controlled engine keeps
+        # interactive TTFT attainment >= 0.95 while shedding batch with
+        # truthful Retry-After hints and pausing batch decoders; the
+        # budget-free baseline breaches; both windows compile-free
+        sb = self._load()
+        assert sb.main(["--overload"]) == 0
+        lines = [json.loads(ln) for ln in
+                 capsys.readouterr().out.strip().splitlines()
+                 if ln.startswith("{")]
+        out = next(ln for ln in lines
+                   if ln.get("lane") == "overload"
+                   and ln.get("class") is None)
+        assert out["controlled_attainment"] >= 0.95
+        assert out["baseline_attainment"] < 0.95
+        assert out["baseline_attainment"] < out["controlled_attainment"]
+        assert out["decode_preemptions"] >= 1
+        assert out["brownout_transitions"] >= 1
+        assert out["retry_after_hints"] \
+            and all(1 <= h <= 30 for h in out["retry_after_hints"])
+        assert out["jit_recompiles"] == 0
+        batch = next(ln for ln in lines
+                     if ln.get("lane") == "overload"
+                     and ln.get("class") == "batch")
+        assert batch["sheds"] >= 1
+        assert batch["deadline_s"] == 0.05
+
+    def test_overload_fleet_lane_gate(self, capsys):
+        # ISSUE 19 acceptance (elastic half): a sustained flood drives
+        # the autoscaler to spawn a second replica (scale-up observed,
+        # fleet_scale_events_total fires), the measured window on the
+        # scaled fleet is compile-free, load subsiding drains the
+        # newcomer back down cleanly, and zero requests fail
+        sb = self._load()
+        assert sb.main(["--overload-fleet"]) == 0
+        lines = [json.loads(ln) for ln in
+                 capsys.readouterr().out.strip().splitlines()
+                 if ln.startswith("{")]
+        out = lines[-1]
+        assert out["scale_ups"] >= 1
+        assert out["scale_downs"] >= 1
+        assert out["routable_peak"] == 2
+        assert out["routable_end"] == 1
+        assert out["failed_requests"] == 0
+        assert out["jit_recompiles"] == 0
+
 
 class TestTrainBench:
     """ISSUE 5 CI satellite: the training hot-path lane must run a tiny
@@ -404,6 +451,17 @@ class TestChaosSmoke:
         # every fleet_*/router_* series exists, and /result/<id>
         # re-attaches through the router for every journaled id
         assert self._load().main(["--fleet-only"]) == 0
+
+    def test_overload_kill_gate(self):
+        # ISSUE 19 acceptance: overload AND a replica kill composed —
+        # two in-process replicas with SLO budgets + brownout take a
+        # decode-delayed batch flood plus interactive traffic, one is
+        # hard-killed mid-flood; every interactive request completes,
+        # batch arrivals shed with sched_shed_on_arrival_total
+        # ticking, failover fires, and every OVERLOAD_SERIES metric
+        # (shed counter, brownout gauge, decode preemptions, fleet
+        # scale events) exists in monitor.snapshot()
+        assert self._load().main(["--overload-only"]) == 0
 
 
 class TestTraceCapture:

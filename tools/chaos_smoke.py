@@ -35,6 +35,14 @@ streams to the survivor bit-exactly (zero failed requests),
 ``/result/<id>`` must re-attach through the router for every id, and
 the ``fleet_*``/``router_*`` series must exist and fire.
 
+The ISSUE 19 overload lane (``--overload-only`` / ``run_overload_kill``)
+composes overload with a replica kill: two in-process replicas with
+SLO-budgeted classes and the brownout ladder enabled take a
+decode-delayed batch flood plus interactive traffic, one replica is
+hard-killed mid-flood, and the gate demands zero failed interactive
+requests, >= 1 shed batch arrival, a failover, and the existence of
+every OVERLOAD_SERIES metric.
+
 Exit 0 = healthy, 1 = broken; tests/test_tools.py runs main() in the
 tier-1 lane, `python tools/chaos_smoke.py` is the standalone CI lane.
 """
@@ -92,6 +100,16 @@ FLEET_SERIES = (
     "fleet_migrated_requests_total",
     "router_retries_total",
     "router_circuit_open",
+)
+
+#: overload-protection series (ISSUE 19, README "Overload & graceful
+#: degradation") — the --overload-only replica-kill-under-flood
+#: scenario existence-gates each
+OVERLOAD_SERIES = (
+    "sched_shed_on_arrival_total",
+    "engine_brownout_level",
+    "decode_preemptions_total",
+    "fleet_scale_events_total",
 )
 
 #: scheduler series (ISSUE 7, README "Scheduling & multi-tenancy") —
@@ -823,6 +841,176 @@ def run_fleet_kill() -> dict:
     return {"checks": checks, "details": details}
 
 
+def run_overload_kill() -> dict:
+    """ISSUE 19 satellite: overload AND a replica kill at once.  Two
+    in-process replicas with SLO-budgeted priority classes and the
+    brownout ladder enabled take a decode-delayed batch flood several
+    times their capacity plus a handful of interactive requests; one
+    replica is hard-killed mid-flood.  The gate: every interactive
+    request still completes (batch shedding absorbed the overload,
+    journal-backed failover absorbed the kill), at least one batch
+    arrival was shed with ``sched_shed_on_arrival_total`` ticking,
+    failover fired, and every OVERLOAD_SERIES metric exists in
+    ``monitor.snapshot()``."""
+    import json
+    import tempfile
+    import threading
+    import time as _time
+    import urllib.error
+    import urllib.request
+    from paddle_tpu import monitor
+    from paddle_tpu.testing import faults
+    from paddle_tpu.inference.fleet import FleetRouter, ReplicaSupervisor
+    from paddle_tpu.inference.scheduler import PriorityClass
+    from paddle_tpu.inference.server import GenerationServer
+
+    work = tempfile.mkdtemp(prefix="chaos-overload-")
+    classes = (
+        PriorityClass("interactive", rank=0, weight=8),
+        PriorityClass("standard", rank=1, weight=4),
+        # a deliberately tight budget: once the delayed flood drags the
+        # decode p50 up, queued batch arrivals are doomed-on-arrival,
+        # and the brownout band shed covers the rest
+        PriorityClass("batch", rank=2, weight=1, preemptible=True,
+                      deadline_s=0.05),
+    )
+
+    def factory(name, jdir):
+        return GenerationServer(
+            _hk_model(), total_pages=128, page_size=8, max_batch=2,
+            max_queue=8, journal_dir=jdir, journal_fsync="os",
+            scheduler_classes=classes,
+            brownout_thresholds=(0.2, 0.5, 0.75, 0.95),
+            brownout_patience=2)
+
+    checks, details = {}, {}
+    snap0 = monitor.snapshot()
+    shed0 = _series_total(snap0, "sched_shed_on_arrival_total") or 0.0
+    fo0 = _series_total(snap0, "fleet_failovers_total") or 0.0
+    sup = ReplicaSupervisor(factory=factory, replicas=2,
+                            journal_root=work, probe_interval_s=0.1,
+                            probe_failure_threshold=2,
+                            probe_timeout_s=2.0,
+                            heartbeat_timeout_s=10.0)
+    router = FleetRouter(sup, attach_timeout_s=300.0)
+    outs, threads = {}, []
+
+    def post(body):
+        def _go():
+            try:
+                req = urllib.request.Request(
+                    f"http://{router.host}:{router.port}/generate",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=600) as r:
+                    payload = json.loads(r.read())
+                    payload["_status"] = 200
+            except urllib.error.HTTPError as e:
+                try:
+                    payload = json.loads(e.read())
+                except Exception:   # noqa: BLE001
+                    payload = {}
+                payload["_status"] = e.code
+            except Exception as e:   # noqa: BLE001
+                payload = {"_status": -1, "error": repr(e)}
+            outs[body["request_id"]] = payload
+        t = threading.Thread(target=_go, daemon=True)
+        t.start()
+        threads.append(t)
+
+    inter = [f"ov-inter-{i}" for i in range(4)]
+    try:
+        sup.start()
+        router.start()
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 300 \
+                and len(sup.routable_replicas()) < 2:
+            _time.sleep(0.05)
+        checks["both replicas up"] = len(sup.routable_replicas()) == 2
+
+        # warm/compile outside the overload window (standard class, so
+        # the interactive SLO window starts clean)
+        for i in range(2):
+            post({"input_ids": [[3 + i, 5, 7, 11]],
+                  "max_new_tokens": 4, "priority": "standard",
+                  "request_id": f"ov-warm-{i}"})
+        for t in threads:
+            t.join(timeout=600)
+
+        # the flood decodes slowly, so its queue pressure is real
+        faults.install(faults.FaultPlan(
+            [{"site": "decode_step", "kind": "delay",
+              "delay_s": 0.03}]))
+        try:
+            for i in range(8):
+                post({"input_ids": [[13 + i, 17, 19, 23, 29]],
+                      "max_new_tokens": 12, "priority": "batch",
+                      "request_id": f"ov-batch-{i}"})
+            _time.sleep(1.0)     # let the ladder see the depth
+            # second batch wave arrives INTO the brownout: shed fodder
+            for i in range(8, 16):
+                post({"input_ids": [[13 + i, 17, 19, 23, 29]],
+                      "max_new_tokens": 12, "priority": "batch",
+                      "request_id": f"ov-batch-{i}"})
+            for i, rid in enumerate(inter):
+                post({"input_ids": [[31 + i, 37, 41]],
+                      "max_new_tokens": 4,
+                      "priority": "interactive", "request_id": rid})
+            _time.sleep(0.5)     # streams in flight on both replicas
+            victims = sup.routable_replicas()
+            victim = victims[0].name if victims else "r0"
+            sup.kill(victim)
+            details["victim"] = victim
+            for t in threads:
+                t.join(timeout=600)
+        finally:
+            faults.clear()
+
+        snap1 = monitor.snapshot()
+        inter_bad = [rid for rid in inter
+                     if outs.get(rid, {}).get("_status") != 200
+                     or not outs[rid].get("output_ids")]
+        details["interactive_failed"] = inter_bad
+        details["batch_statuses"] = sorted(
+            str(v.get("_status")) for k, v in outs.items()
+            if k.startswith("ov-batch-"))
+        shed = (_series_total(snap1, "sched_shed_on_arrival_total")
+                or 0.0) - shed0
+        fo = (_series_total(snap1, "fleet_failovers_total")
+              or 0.0) - fo0
+        details["sheds"] = shed
+        details["failovers"] = fo
+        missing = [n for n in OVERLOAD_SERIES
+                   if _series_total(snap1, n) is None]
+        details["missing_series"] = missing
+        checks["every interactive request completed despite the "
+               "flood and the kill"] = not inter_bad
+        checks["batch arrivals shed under pressure"] = shed >= 1
+        checks["failover fired on the killed replica"] = fo >= 1
+        checks["overload series all published"] = not missing
+    finally:
+        try:
+            router.stop()
+            sup.stop()
+        except Exception:   # noqa: BLE001 — teardown best-effort
+            pass
+    return {"checks": checks, "details": details}
+
+
+def overload_main() -> int:
+    out = run_overload_kill()
+    bad = [name for name, ok in out["checks"].items() if not ok]
+    if bad:
+        print(f"FAIL (overload): {bad}; observed {out['details']}",
+              file=sys.stderr)
+        return 1
+    print(f"OK: replica {out['details']['victim']} killed under a 4x "
+          f"batch flood — every interactive request completed, "
+          f"{int(out['details']['sheds'])} batch arrivals shed with "
+          "truthful 429s, and failover recovered the rest")
+    return 0
+
+
 def fleet_main() -> int:
     out = run_fleet_kill()
     bad = [name for name, ok in out["checks"].items() if not ok]
@@ -857,6 +1045,8 @@ def main(argv=None) -> int:
         return hard_kill_main()
     if "--fleet-only" in argv or "--fleet" in argv:
         return fleet_main()
+    if "--overload-only" in argv:
+        return overload_main()
     rc = _counters_main()
     if rc == 0 and "--skip-hard-kill" not in argv:
         rc = hard_kill_main()
@@ -866,6 +1056,11 @@ def main(argv=None) -> int:
         # lane; --skip-hard-kill marks a run that wants no subprocess
         # scenarios (each gets its own gate in tests/test_tools.py)
         rc = fleet_main()
+    if rc == 0 and "--skip-overload" not in argv \
+            and "--skip-hard-kill" not in argv:
+        # overload + replica-kill (ISSUE 19) rides the standalone CI
+        # run; its tier-1 gate is separate like the two lanes above
+        rc = overload_main()
     return rc
 
 
