@@ -162,6 +162,23 @@ class CollectiveCost:
     line: int = 0
     in_scan: bool = False     # fired per-iteration inside scan/while
     source: str = "jaxpr"     # "jaxpr" | "hlo"
+    dtype: str = ""           # payload element dtype ("int8", "f32", …)
+                              # — the width the EQuARX-style comparison
+                              # of quantized vs full-precision
+                              # collectives reads off the audit
+
+    @property
+    def dtype_width(self) -> int:
+        """Payload element bytes; unknown dtypes price as 4 (the same
+        fallback the HLO shape parser uses)."""
+        w = _HLO_DTYPE_BYTES.get(self.dtype)
+        if w is None:
+            import numpy as _np
+            try:
+                w = int(_np.dtype(self.dtype).itemsize)
+            except Exception:   # noqa: BLE001 — opaque dtype token
+                w = 4
+        return w
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -169,7 +186,9 @@ class CollectiveCost:
     def __str__(self) -> str:
         loc = f" [{self.path}:{self.line}]" if self.path else ""
         scan = " (in scan body)" if self.in_scan else ""
-        return (f"{self.kind}[{self.op}] x{self.count:g} n={self.group_size}"
+        dt = f" {self.dtype}" if self.dtype else ""
+        return (f"{self.kind}[{self.op}]{dt} x{self.count:g} "
+                f"n={self.group_size}"
                 f" payload={self.payload_bytes:.3g}B "
                 f"ici={self.ici_bytes:.3g}B/{self.ici_seconds:.3g}s"
                 f"{scan}{loc}")
@@ -203,6 +222,21 @@ class SpmdAudit:
         the MXU, sets this program's floor."""
         return self.ici_time_seconds > self.compute_seconds
 
+    @property
+    def collective_bytes_f32_equiv(self) -> float:
+        """What the SAME collectives would move at f32 width — the
+        denominator of the EQuARX-style quantized-collective win.  A
+        program whose collectives are already f32 quotes its own total
+        (ratio 1); an int8-collective program quotes the bytes its f32
+        twin would have moved, so ``f32_equiv / total`` is the priced
+        bandwidth reduction, known before the program is built."""
+        jaxpr_colls = [c for c in self.collectives if c.source == "jaxpr"]
+        src = jaxpr_colls if (jaxpr_colls and
+                              len(jaxpr_colls) < len(self.collectives)) \
+            else self.collectives
+        return float(sum(
+            c.ici_bytes * (4.0 / max(1, c.dtype_width)) for c in src))
+
     def by_kind(self, kind: str) -> List[CollectiveCost]:
         return [c for c in self.collectives if c.kind == kind]
 
@@ -212,6 +246,7 @@ class SpmdAudit:
             "mesh_axes": dict(self.mesh_axes),
             "collectives": [c.to_dict() for c in self.collectives],
             "collective_bytes_total": self.collective_bytes_total,
+            "collective_bytes_f32_equiv": self.collective_bytes_f32_equiv,
             "ici_time_seconds": self.ici_time_seconds,
             "compute_flops": self.compute_flops,
             "compute_seconds": self.compute_seconds,
@@ -230,6 +265,15 @@ class SpmdAudit:
                 f"peak HBM {self.peak_hbm_bytes / (1 << 20):.1f} MiB, "
                 f"{'comm' if self.comm_bound else 'compute'}-bound")
         lines = [head]
+        equiv = self.collective_bytes_f32_equiv
+        if equiv > self.collective_bytes_total * 1.01:
+            # quantized collectives present: quote the priced EQuARX
+            # win against the f32 twin of the same program
+            lines.append(
+                f"  quantized collectives: {self.collective_bytes_total:.3g}"
+                f" B over ICI vs {equiv:.3g} B at f32 — "
+                f"{equiv / max(1.0, self.collective_bytes_total):.2g}x "
+                f"fewer bytes")
         lines += [f"  {c}" for c in self.collectives]
         lines += [f"  {f}" for f in self.findings]
         return "\n".join(lines)
@@ -384,14 +428,13 @@ def collectives_from_jaxpr(closed, bandwidth: Optional[float] = None
                 n = _group_size(eqn, mesh_axes)
                 # payload at actual dtype width; all_gather prices the
                 # FULL gathered result, reduce_scatter the full input
-                if kind == "all_gather":
-                    payload = float(sum(
-                        _nbytes(a) for v in eqn.outvars
-                        if (a := _aval_of(v)) is not None))
-                else:
-                    payload = float(sum(
-                        _nbytes(a) for v in eqn.invars
-                        if (a := _aval_of(v)) is not None))
+                priced_vars = (eqn.outvars if kind == "all_gather"
+                               else eqn.invars)
+                avals = [a for v in priced_vars
+                         if (a := _aval_of(v)) is not None]
+                payload = float(sum(_nbytes(a) for a in avals))
+                dtype = str(getattr(avals[0], "dtype", "")) \
+                    if avals else ""
                 ici_b, ici_s = price_collective(kind, payload, n, bw)
                 path, line = _eqn_location(eqn)
                 out.append(CollectiveCost(
@@ -399,7 +442,7 @@ def collectives_from_jaxpr(closed, bandwidth: Optional[float] = None
                     group_size=n, count=scale, payload_bytes=payload,
                     ici_bytes=ici_b * scale, ici_seconds=ici_s * scale,
                     path=path, line=line, in_scan=in_scan,
-                    source="jaxpr"))
+                    source="jaxpr", dtype=dtype))
                 continue
             if name == "shard_map":
                 mesh = eqn.params.get("mesh")
@@ -526,11 +569,13 @@ def collectives_from_hlo_text(text: str, n_devices: int = 1,
             meta = _HLO_METADATA_RE.search(line)
             path = meta.group(1) if meta else ""
             lineno = int(meta.group(2)) if meta and meta.group(2) else 0
+            toks = _HLO_SHAPE_RE.findall(m.group("shape"))
             out.append(CollectiveCost(
                 kind=kind, op=op, axes=(), group_size=n, count=1.0,
                 payload_bytes=payload, ici_bytes=ici_b,
                 ici_seconds=ici_s, path=path, line=lineno,
-                in_scan=current_comp in while_bodies, source="hlo"))
+                in_scan=current_comp in while_bodies, source="hlo",
+                dtype=toks[0][0] if toks else ""))
     return out
 
 
@@ -545,7 +590,27 @@ def _donation_pool(donated_avals) -> List[Tuple[Tuple, int]]:
     return pool
 
 
-def estimate_peak_hbm(closed, donated_avals=()) -> float:
+def _leaf_local_nbytes(leaf) -> Optional[int]:
+    """PER-CHIP bytes of a leaf committed to a NamedSharding over a
+    >1 mesh — ``prod(shard_shape) * itemsize`` — or None when the leaf
+    carries no such placement (replicated-or-unplaced leaves price at
+    their global bytes, which IS each chip's cost)."""
+    sh = _sharding_of(leaf)
+    if sh is None:
+        return None
+    aval = _aval_of(leaf)
+    if aval is None or getattr(aval, "shape", None) is None:
+        return None
+    try:
+        import numpy as _np
+        local = sh.shard_shape(tuple(aval.shape))
+        return int(math.prod(local)
+                   * _np.dtype(aval.dtype).itemsize)
+    except Exception:   # noqa: BLE001 — non-divisible / opaque sharding
+        return None
+
+
+def estimate_peak_hbm(closed, donated_avals=(), arg_leaves=()) -> float:
     """Static peak live bytes of one program dispatch: a lifetime walk
     over the jaxpr.  Non-donated inputs (and captured consts) stay
     resident for the whole program (the caller holds them); donated
@@ -553,6 +618,15 @@ def estimate_peak_hbm(closed, donated_avals=()) -> float:
     step exploits.  Intermediates free at last use; sub-jaxpr calls
     (pjit bodies, remat, scan) contribute their own internal peak on
     top of the caller's live set at the call point.
+
+    The estimate is PER-CHIP when shardings are visible (ISSUE 20):
+    ``arg_leaves`` (the example args, flattened, positionally matching
+    the program invars) lets boundary operands committed to a
+    NamedSharding price at their shard bytes — a TP-sharded KV pool
+    costs ``global / tp`` per chip — and a ``shard_map`` eqn's outputs
+    price at the body's LOCAL outvar bytes rather than the global
+    avals the caller sees.  Donation matching stays on global
+    shape/dtype (donated_avals are global ShapeDtypeStructs).
 
     Fusion-blind by construction (XLA fuses elementwise chains whose
     intermediates never materialize), so this is an upper-bound
@@ -562,6 +636,11 @@ def estimate_peak_hbm(closed, donated_avals=()) -> float:
     from jax import core as jcore
     jaxpr = getattr(closed, "jaxpr", closed)
     donate_pool = _donation_pool(donated_avals)
+    local_by_var: Dict[Any, int] = {}
+    for v, leaf in zip(getattr(jaxpr, "invars", ()), arg_leaves):
+        nb = _leaf_local_nbytes(leaf)
+        if nb is not None:
+            local_by_var[v] = nb
 
     def var_bytes(v) -> int:
         a = _aval_of(v)
@@ -579,7 +658,7 @@ def estimate_peak_hbm(closed, donated_avals=()) -> float:
         invars = list(getattr(jpr, "invars", ())) + \
             list(getattr(jpr, "constvars", ()))
         for v in invars:
-            nb = var_bytes(v)
+            nb = local_by_var.get(v, var_bytes(v))
             if freeable_invars:
                 live[v] = nb
                 continue
@@ -642,11 +721,20 @@ def estimate_peak_hbm(closed, donated_avals=()) -> float:
                     peak = max(peak,
                                base + loop_out_bytes
                                + max(0.0, sub_peak - aliased))
+            # a shard_map's outvars carry GLOBAL avals but each chip
+            # materializes only its shard — price them at the body's
+            # local outvar bytes (per-chip accounting, ISSUE 20)
+            if eqn.primitive.name == "shard_map" and subs:
+                body = getattr(subs[0], "jaxpr", subs[0])
+                for gv, lv in zip(eqn.outvars,
+                                  getattr(body, "outvars", ())):
+                    if not isinstance(gv, jcore.DropVar):
+                        local_by_var[gv] = var_bytes(lv)
             # allocate outputs
             for v in eqn.outvars:
                 if isinstance(v, jcore.DropVar):
                     continue
-                live[v] = var_bytes(v)
+                live[v] = local_by_var.get(v, var_bytes(v))
             peak = max(peak, permanent + sum(live.values()))
             # free dead intermediates (and donated/freeable inputs)
             for v in eqn.invars:
@@ -719,9 +807,10 @@ def _check_replicated_params(arg_leaves, findings: List[Finding],
                 f"KV page pool {_shape_str(aval)} ({nb >> 20} MiB) is "
                 f"replicated across the mesh — pool capacity is capped "
                 f"at one chip's HBM",
-                hint="shard the page pools on the head axis "
-                     "(PartitionSpec(None, 'tensor', ...)) so pool "
-                     "bytes scale with the mesh"))
+                hint="shard the page pools on their leading kv-head "
+                     "axis (PartitionSpec('tensor'), what "
+                     "PagedKVCache(mesh=...) commits) so pool bytes "
+                     "scale with the mesh"))
         else:
             n_param += 1
             if n_param > 8:
@@ -890,7 +979,8 @@ def audit_spmd_jaxpr(closed, *, name: str = "<jaxpr>",
         _check_implicit_reshard(closed, arg_leaves, findings, bw)
     _check_scan_collectives(collectives, findings)
 
-    peak_hbm = estimate_peak_hbm(closed, donated_avals=donated_avals)
+    peak_hbm = estimate_peak_hbm(closed, donated_avals=donated_avals,
+                                 arg_leaves=arg_leaves)
     est = _cost.estimate_jaxpr(closed, name=name, publish=False)
     compute_s = est.flops / _cost.peak_flops()
     # totals: when BOTH tiers saw collectives (compiled=True forced on

@@ -119,6 +119,45 @@ def pin_cpu_devices(n: int) -> None:
             ).strip()
 
 
+def _backend_initialized() -> bool:
+    """True once ANY XLA backend client exists — past this point the
+    virtual-CPU-device knobs are read-only for the process."""
+    try:
+        from jax._src import xla_bridge as _xb
+        return bool(getattr(_xb, "_backends", None))
+    except Exception:   # noqa: BLE001 — private surface moved: assume live
+        return True
+
+
+def make_tp_mesh(n: int):
+    """A 1-D tensor-parallel ``Mesh`` over ``n`` devices, axis name
+    ``'tensor'`` — the mesh every TP serving program in this tree
+    shards over.
+
+    Prefers real devices.  When the backend is NOT yet initialized
+    (first jax touch of the process) the CPU host platform is
+    provisioned with ``n`` virtual devices first — the in-process
+    equivalent of ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+    — so tier-1 CI exercises TP=2 programs on one CPU.  Once a backend
+    is live the visible device count is fixed; asking for more than it
+    has is an error naming the pre-init escape hatch."""
+    import numpy as _np
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"tp degree must be >= 1, got {n}")
+    if n > 1 and not _backend_initialized():
+        pin_cpu_devices(max(n, 2))
+    devs = _jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(
+            f"make_tp_mesh({n}): only {len(devs)} device(s) visible. "
+            f"On CPU, call before the first jax operation (or set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n}) so "
+            f"the host platform can be split into virtual devices.")
+    return _jax.sharding.Mesh(_np.asarray(devs[:n]), ("tensor",))
+
+
 __all__ = ["shard_map", "axis_size", "memory_kinds",
            "default_memory_kind", "is_compute_memory", "to_memory_kind",
-           "register_compile_listener", "pin_cpu_devices"]
+           "register_compile_listener", "pin_cpu_devices",
+           "make_tp_mesh"]

@@ -622,7 +622,9 @@ class ContinuousBatchingEngine:
                  brownout_thresholds=None,
                  brownout_patience: int = 3,
                  decode_preempt: bool = True,
-                 tpot_preempt_cooldown_s: float = 0.25):
+                 tpot_preempt_cooldown_s: float = 0.25,
+                 tp: int = 1,
+                 tp_quant_collectives: bool = False):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_position = int(model.config.max_position_embeddings)
@@ -676,12 +678,27 @@ class ContinuousBatchingEngine:
         if replay_batch is None:
             replay_batch = jax.default_backend() != "tpu"
         self.replay_batch = bool(replay_batch)
+        # tensor-parallel serving (ISSUE 20): one engine = one TP
+        # replica.  ``tp > 1`` builds a 1-D ('tensor',) mesh, commits
+        # the model weights to Megatron-style column/row shardings and
+        # shards every KV pool on the kv-head axis, so per-chip HBM for
+        # weights and pages drops by the TP degree while the engine's
+        # batching/scheduling surface is unchanged — supervisors and
+        # routers treat it exactly like a 1-chip replica.
+        self.tp = int(tp)
+        self.tp_quant_collectives = bool(tp_quant_collectives)
+        if self.tp > 1:
+            from ..framework.jax_compat import make_tp_mesh
+            self.mesh = make_tp_mesh(self.tp)
+        else:
+            self.mesh = None
         self.cache = PagedKVCache.from_model(
             model, total_pages=total_pages, page_size=page_size,
-            kv_dtype=kv_quant)
+            kv_dtype=kv_quant, mesh=self.mesh)
         from .paged import JittedPagedDecoder
         self._decoder = JittedPagedDecoder(
-            model, min_table_pages=min_table_pages, quantize=quantize)
+            model, min_table_pages=min_table_pages, quantize=quantize,
+            mesh=self.mesh, tp_quant_collectives=self.tp_quant_collectives)
         _quant_enabled_g.set(int(quantize is not None))
         _kv_quant_enabled_g.set(int(kv_quant is not None))
         _kv_quant_pool_bytes_g.set(self.cache.kv_pool_bytes)

@@ -68,6 +68,64 @@ def _fake_quant_kv(x):
     return dequantize_kv(q, s, x.dtype)
 
 
+def _tp_plan(model, mesh):
+    """Megatron-style tensor-parallel placement plan for a LLaMA-shaped
+    serving model over a 1-D ``('tensor',)`` mesh (ISSUE 20).
+
+    Column-parallel (out-features on 'tensor'; weight layout is
+    ``[in, out]`` so that is dim 1): q/k/v projections and the MLP
+    gate/up — each chip computes its own heads / its own slice of the
+    intermediate activations with NO communication.  Row-parallel
+    (in-features on 'tensor', dim 0): o_proj and down_proj — their
+    matmuls produce partial sums and ONE all-reduce closes each block.
+    Everything else (norms, embedding, lm_head) stays replicated so the
+    logits + fused sampling tail run replicated post-all-reduce.
+
+    Returns ``(spec_by_param_id, row_parallel_layers, attn_layers)``:
+    the per-param PartitionSpec map, the Linears to arm with the
+    ``_tp_reduce`` hook at trace time, and the attention modules whose
+    head counts are patched to their per-chip values during the trace.
+    """
+    from jax.sharding import PartitionSpec as P
+    tp = int(mesh.size)
+    layers = getattr(getattr(model, "model", None), "layers", None)
+    if not layers:
+        raise ValueError(
+            "tensor-parallel serving needs a LLaMA-shaped model "
+            "(model.model.layers with self_attn/mlp blocks)")
+    spec_by_id = {}
+    row_layers = []
+    attn_layers = []
+    col, row = P(None, "tensor"), P("tensor", None)
+    for i, layer in enumerate(layers):
+        attn, mlp = layer.self_attn, layer.mlp
+        if attn.num_heads % tp or attn.num_kv_heads % tp:
+            raise ValueError(
+                f"layer {i}: num_heads ({attn.num_heads}) and "
+                f"num_kv_heads ({attn.num_kv_heads}) must divide the "
+                f"tensor-parallel degree ({tp})")
+        if mlp.gate_proj.out_features % tp:
+            raise ValueError(
+                f"layer {i}: intermediate_size "
+                f"({mlp.gate_proj.out_features}) must divide the "
+                f"tensor-parallel degree ({tp})")
+        for lin in (attn.q_proj, attn.k_proj, attn.v_proj,
+                    mlp.gate_proj, mlp.up_proj):
+            spec_by_id[id(lin.weight)] = col
+        for lin in (attn.o_proj, mlp.down_proj):
+            if lin.bias is not None:
+                # a per-shard bias would be summed tp times by the
+                # closing all-reduce — the serving plan only arms
+                # bias-free row-parallel projections
+                raise ValueError(
+                    "row-parallel projections must be bias-free under "
+                    "tensor parallelism")
+            spec_by_id[id(lin.weight)] = row
+            row_layers.append(lin)
+        attn_layers.append(attn)
+    return spec_by_id, row_layers, attn_layers
+
+
 def fused_sample(logits, seeds, ctrs, temps, flags):
     """On-device fused sampling tail for the compiled decode/prefill
     programs: per row, greedy argmax AND a temperature categorical draw
@@ -346,7 +404,8 @@ class JittedPagedDecoder:
                       "ragged": (9, 10, 11, 12)}
 
     def __init__(self, model, min_table_pages: int = 1,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None, mesh=None,
+                 tp_quant_collectives: bool = False):
         from ..quantization.serving import SERVING_QUANT_MODES
         if quantize not in SERVING_QUANT_MODES:
             raise ValueError(
@@ -356,6 +415,45 @@ class JittedPagedDecoder:
         self.params = model.parameters()
         self.max_position = int(model.config.max_position_embeddings)
         self.quantize = quantize
+        # tensor-parallel serving (ISSUE 20): every compiled program is
+        # shard_map'd over the ('tensor',) mesh — weights land as their
+        # Megatron twins, pools shard on the kv-head axis, and exactly
+        # one all-reduce per block closes the row-parallel matmuls.
+        # Committing the params here (device_put with NamedShardings)
+        # is load-bearing three ways: each chip holds 1/tp of the
+        # sharded weights, the jit input shardings are pinned so no
+        # per-dispatch transfer sneaks in, and the analysis auditor's
+        # engine_program_spec copies the placements into its abstract
+        # args — which is what auto-triggers the tier-3 SPMD audit.
+        if mesh is not None and int(mesh.size) <= 1:
+            mesh = None                  # a mesh of one is the 1-chip path
+        self.mesh = mesh
+        self.tp = int(mesh.size) if mesh is not None else 1
+        self.tp_quant_collectives = bool(tp_quant_collectives and
+                                         mesh is not None)
+        if mesh is not None:
+            if quantize is not None:
+                raise ValueError(
+                    "quantize='w8'/'w8a8' does not compose with a "
+                    "tensor-parallel mesh yet: the int8 weight twins "
+                    "are calibrated per full out-channel and the "
+                    "streaming kernel is single-chip (documented "
+                    "limitation; kv_quant='int8' DOES compose)")
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            spec_by_id, self._tp_row_layers, self._tp_attn = \
+                _tp_plan(model, mesh)
+            self._tp_param_specs = [spec_by_id.get(id(p), P())
+                                    for p in self.params]
+            for p, spec in zip(self.params, self._tp_param_specs):
+                p._data = jax.device_put(p._data,
+                                         NamedSharding(mesh, spec))
+            self._tp_reduce_fn = self._make_tp_reduce()
+        else:
+            self._tp_row_layers = []
+            self._tp_attn = []
+            self._tp_param_specs = []
+            self._tp_reduce_fn = None
         if quantize is not None:
             from ..quantization.serving import quantize_linear_weights
             self._quant = quantize_linear_weights(model)
@@ -424,6 +522,20 @@ class JittedPagedDecoder:
             # program trace
             for (layer, _, _), s in zip(self._quant, wscales):
                 layer._serving_quant = (self.quantize, s)
+        if self.mesh is not None:
+            # TP trace arming (same trace-time pattern as the quant
+            # hook): inside the shard_map body the swapped param arrays
+            # are LOCAL shards, so each attention module's head counts
+            # drop to their per-chip values for the duration of the
+            # trace, and the row-parallel projections get the mesh
+            # all-reduce that closes their partial sums
+            tp = self.tp
+            for attn in self._tp_attn:
+                attn._tp_saved_heads = (attn.num_heads, attn.num_kv_heads)
+                attn.num_heads //= tp
+                attn.num_kv_heads //= tp
+            for layer in self._tp_row_layers:
+                layer._tp_reduce = self._tp_reduce_fn
         return saved
 
     def _restore_params(self, saved):
@@ -431,6 +543,71 @@ class JittedPagedDecoder:
             p._data = s
         for layer, _, _ in self._quant:
             layer._serving_quant = None
+        if self.mesh is not None:
+            for attn in self._tp_attn:
+                attn.num_heads, attn.num_kv_heads = attn._tp_saved_heads
+            for layer in self._tp_row_layers:
+                layer._tp_reduce = None
+
+    def _make_tp_reduce(self):
+        """The all-reduce closing each row-parallel block: a plain f32
+        ``psum`` by default, or (``tp_quant_collectives=True``) the
+        EQuARX-style int8 variant — absmax-scale the local partial sum
+        to s8, all-gather the int8 shards + f32 scales over 'tensor',
+        dequantize and sum locally.  On the ring that moves (n-1)·S
+        bytes against the f32 psum's 2·(n-1)/n·4S — 8/n fewer, the
+        EQuARX 4x at tp=2 — at the cost of one absmax round-trip of
+        numeric error per block, which is why it sits behind a knob
+        that defaults OFF and the logits escape hatch is the parity
+        oracle for it."""
+        if not self.tp_quant_collectives:
+            return lambda x: jax.lax.psum(x, "tensor")
+        tp = self.tp
+
+        def quant_psum(x):
+            amax = jnp.max(jnp.abs(x))
+            scale = jnp.maximum(amax, 1e-8) / 127.0
+            q = jnp.clip(jnp.round(x / scale),
+                         -127.0, 127.0).astype(jnp.int8)
+            qg = jax.lax.all_gather(q, "tensor")        # (tp, ...) s8
+            sg = jax.lax.all_gather(scale, "tensor")    # (tp,) f32
+            return jnp.sum(
+                qg.astype(x.dtype)
+                * sg.astype(x.dtype).reshape((tp,) + (1,) * x.ndim),
+                axis=0)
+
+        return quant_psum
+
+    #: replicated positional args between ``param_arrays`` and the pool
+    #: tuple, per program mode — the shard_map in_specs contract
+    #: (everything host-shaped rides replicated; pools shard on the
+    #: kv-head axis; the param list gets its per-param spec list)
+    _TP_N_REPLICATED = {"decode": 7, "prefill": 5, "prefix": 7,
+                        "verify": 7, "ragged": 8}
+
+    def _mesh_wrap(self, mode, fn):
+        """shard_map a program body over the tensor mesh (identity on
+        the 1-chip decoder).  in/out specs are pytree prefixes: P()
+        broadcasts over the sampling tuple and the (possibly empty)
+        wscales tuple, P('tensor') over each per-layer pool tuple —
+        rank-4 pools shard dim 0, the kv-head axis.  Replication checks
+        are off (the compat wrapper maps check_vma across jax
+        versions): the outputs ARE replicated by construction — every
+        chip holds the full hidden state after each block's closing
+        all-reduce, so logits, accept arithmetic and the fused sampling
+        tail compute identically everywhere."""
+        if self.mesh is None:
+            return fn
+        from jax.sharding import PartitionSpec as P
+        from ..framework.jax_compat import shard_map
+        rep, pool = P(), P("tensor")
+        in_specs = (list(self._tp_param_specs),
+                    *([rep] * self._TP_N_REPLICATED[mode]),
+                    pool, pool, pool, pool, rep)
+        n_out = 2 if mode in ("verify", "ragged") else 1
+        out_specs = (*([rep] * n_out), pool, pool, pool, pool)
+        return shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
 
     def _program(self, mode: str, sample):
         """Lazily build one compiled program per (mode, sample) pair.
@@ -642,6 +819,11 @@ class JittedPagedDecoder:
 
         else:
             raise ValueError(f"unknown program mode {mode!r}")
+        # TP: the shard_map wrapping applies to the RAW fn so the
+        # auditor's program_fn trace sees the sharded program too —
+        # donation stays at the jit level, aliasing the global sharded
+        # pool buffers through the step exactly as on one chip
+        fn = self._mesh_wrap(mode, fn)
         prog = jax.jit(fn, donate_argnums=self.DONATE_ARGNUMS[mode])
         self._program_fns[key] = fn
         self._programs[key] = prog
@@ -1172,6 +1354,16 @@ class JittedPagedDecoder:
             finally:
                 self._restore_params(saved)
 
+        if self.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            from ..framework.jax_compat import shard_map
+            rep, pool = P(), P("tensor")
+            multi_fn = shard_map(
+                multi_fn, mesh=self.mesh,
+                in_specs=(list(self._tp_param_specs), rep, rep, rep,
+                          rep, rep, pool, pool, pool, pool, rep),
+                out_specs=(rep, pool, pool, pool, pool),
+                check_vma=False)
         return jax.jit(multi_fn, donate_argnums=(6, 7, 8, 9))
 
     def multi_step(self, cache: PagedKVCache, seq_ids, tokens_np,

@@ -383,7 +383,9 @@ class GenerationServer(_ServerLifecycle):
                  brownout_thresholds=None,
                  brownout_patience: int = 3,
                  decode_preempt: bool = True,
-                 tpot_preempt_cooldown_s: float = 0.25):
+                 tpot_preempt_cooldown_s: float = 0.25,
+                 tp: int = 1,
+                 tp_quant_collectives: bool = False):
         from .continuous import (ContinuousBatchingEngine,
                                  DeadlineExceeded, EngineDraining,
                                  EngineSaturated)
@@ -427,7 +429,8 @@ class GenerationServer(_ServerLifecycle):
                 brownout_thresholds=brownout_thresholds,
                 brownout_patience=brownout_patience,
                 decode_preempt=decode_preempt,
-                tpot_preempt_cooldown_s=tpot_preempt_cooldown_s)
+                tpot_preempt_cooldown_s=tpot_preempt_cooldown_s,
+                tp=tp, tp_quant_collectives=tp_quant_collectives)
         except BaseException:
             # a rejected engine knob must not leak the journal's
             # writer thread / open segment / watchdog heartbeat (the
@@ -488,6 +491,21 @@ class GenerationServer(_ServerLifecycle):
                             "kv_quant": outer._engine.kv_quant,
                             "kv_pool_bytes": cache.kv_pool_bytes,
                             "kv_scale_bytes": cache.kv_scale_bytes,
+                            # tensor-parallel serving (ISSUE 20): the
+                            # mesh this replica's programs compile onto
+                            # plus PER-CHIP resident-KV bytes — the
+                            # number capacity planning divides by, and
+                            # how a fleet operator tells a TP replica
+                            # from a 1-chip one at a glance
+                            "tp": outer._engine.tp,
+                            "mesh_shape": (
+                                dict(outer._engine.mesh.shape)
+                                if outer._engine.mesh is not None
+                                else None),
+                            "tp_quant_collectives":
+                                outer._engine.tp_quant_collectives,
+                            "kv_pool_bytes_per_chip":
+                                cache.kv_pool_bytes_per_chip,
                             "speculative": outer._engine._spec}
                         if outer._snapshot_path:
                             payload.update({

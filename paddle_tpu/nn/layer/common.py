@@ -34,8 +34,21 @@ class Linear(Layer):
             # per-out-channel scale as a traced value in q — only ever
             # set inside its compiled programs, cleared on exit
             from ...ops.pallas.quant_matmul import quant_linear_forward
-            return quant_linear_forward(self, x, q)
-        return F.linear(x, self.weight, self.bias)
+            out = quant_linear_forward(self, x, q)
+        else:
+            out = F.linear(x, self.weight, self.bias)
+        r = getattr(self, "_tp_reduce", None)
+        if r is not None:
+            # tensor-parallel serving trace (ISSUE 20): this layer is a
+            # row-parallel projection inside a shard_map program — its
+            # matmul produced one shard's PARTIAL sum, and r is the
+            # mesh all-reduce that closes the block.  Armed only during
+            # the paged decoder's program traces (bias-free layers by
+            # construction: a per-shard bias would be summed tp times),
+            # cleared on exit like _serving_quant.
+            from ...framework.tensor import wrap_array
+            out = wrap_array(r(out._data))
+        return out
 
     def extra_repr(self):
         return f"in_features={self.in_features}, out_features={self.out_features}"

@@ -521,10 +521,12 @@ class PagedKVCache:
     @classmethod
     def from_model(cls, model, total_pages: int = 256,
                    page_size: int = 16,
-                   kv_dtype: Optional[str] = None) -> "PagedKVCache":
+                   kv_dtype: Optional[str] = None,
+                   mesh=None) -> "PagedKVCache":
         """Cache sized for a causal-LM model's config (single wiring
         point shared by PagedGenerator and ContinuousBatchingEngine).
-        ``kv_dtype="int8"`` selects the quantized storage mode."""
+        ``kv_dtype="int8"`` selects the quantized storage mode;
+        ``mesh`` shards the pools on the KV-head axis (ISSUE 20)."""
         c = model.config
         return cls(
             num_layers=c.num_hidden_layers,
@@ -532,11 +534,12 @@ class PagedKVCache:
             head_dim=c.hidden_size // c.num_attention_heads,
             total_pages=total_pages, page_size=page_size,
             dtype=model.model.embed_tokens.weight._data.dtype,
-            kv_dtype=kv_dtype)
+            kv_dtype=kv_dtype, mesh=mesh)
 
     def __init__(self, num_layers: int, kv_heads: int, head_dim: int,
                  total_pages: int = 256, page_size: int = 16,
-                 dtype=jnp.float32, kv_dtype: Optional[str] = None):
+                 dtype=jnp.float32, kv_dtype: Optional[str] = None,
+                 mesh=None):
         if kv_dtype not in (None, "int8"):
             raise ValueError(
                 f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
@@ -545,6 +548,25 @@ class PagedKVCache:
         self.head_dim = head_dim
         self.page_size = page_size
         self.total_pages = total_pages
+        # tensor-parallel serving (ISSUE 20): under a ('tensor',) mesh
+        # every pool (data AND scale — both lead with the kv-head axis)
+        # lands as PartitionSpec('tensor'), so each chip holds
+        # kv_heads/tp heads' pages and per-chip pool HBM drops by the
+        # TP degree.  The sharding is re-applied by reset_pools so a
+        # donated-buffer recovery rebuilds the pools on the same mesh.
+        self.mesh = mesh
+        self.tp = 1
+        self._pool_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self.tp = int(mesh.size)
+            if self.tp > 1 and kv_heads % self.tp != 0:
+                raise ValueError(
+                    f"kv_heads ({kv_heads}) must divide evenly over the "
+                    f"tensor mesh ({self.tp} devices) to shard the page "
+                    f"pools on the head axis")
+            self._pool_sharding = NamedSharding(mesh,
+                                                PartitionSpec("tensor"))
         # int8 KV mode (ISSUE 9): pages store int8 values with a
         # parallel per-slot scale pool; ``compute_dtype`` is what the
         # attention kernels dequantize toward (the model's dtype)
@@ -553,12 +575,14 @@ class PagedKVCache:
         store = jnp.int8 if self.kv_quant else dtype
         shape = (kv_heads, total_pages, page_size, head_dim)
         sshape = (kv_heads, total_pages, page_size, 1)
-        self.k_pages = [jnp.zeros(shape, store) for _ in range(num_layers)]
-        self.v_pages = [jnp.zeros(shape, store) for _ in range(num_layers)]
+        self.k_pages = [self._place(jnp.zeros(shape, store))
+                        for _ in range(num_layers)]
+        self.v_pages = [self._place(jnp.zeros(shape, store))
+                        for _ in range(num_layers)]
         if self.kv_quant:
-            self.k_scales = [jnp.zeros(sshape, jnp.float32)
+            self.k_scales = [self._place(jnp.zeros(sshape, jnp.float32))
                              for _ in range(num_layers)]
-            self.v_scales = [jnp.zeros(sshape, jnp.float32)
+            self.v_scales = [self._place(jnp.zeros(sshape, jnp.float32))
                              for _ in range(num_layers)]
         else:
             self.k_scales = []
@@ -581,6 +605,14 @@ class PagedKVCache:
         # across a failed step to tell a host-side fault (KV intact)
         # from a REAL donated-buffer loss (survivors need replay)
         self.generation = 0
+
+    def _place(self, a):
+        """Commit a pool buffer to the cache's mesh placement (identity
+        for the 1-chip cache)."""
+        if self._pool_sharding is None:
+            return a
+        import jax as _jax
+        return _jax.device_put(a, self._pool_sharding)
 
     # ------------------------------------------------------- bookkeeping
     def _decref_seq(self, page: int) -> bool:
@@ -690,18 +722,22 @@ class PagedKVCache:
         shape = (self.kv_heads, self.total_pages, self.page_size,
                  self.head_dim)
         dtype = jnp.int8 if self.kv_quant else self.compute_dtype
-        self.k_pages = [jnp.zeros(shape, dtype)
+        # _place: a TP cache's rebuilt pools must come back SHARDED on
+        # the same mesh, or the next compiled call would silently
+        # re-replicate them (and the decoder's pinned input shardings
+        # would force a transfer per dispatch)
+        self.k_pages = [self._place(jnp.zeros(shape, dtype))
                         for _ in range(self.num_layers)]
-        self.v_pages = [jnp.zeros(shape, dtype)
+        self.v_pages = [self._place(jnp.zeros(shape, dtype))
                         for _ in range(self.num_layers)]
         if self.kv_quant:
             # the scale pools are part of the KV state: a rebuild zeroes
             # them too, and the survivor replay re-registers each page's
             # scales alongside its int8 values
             sshape = (self.kv_heads, self.total_pages, self.page_size, 1)
-            self.k_scales = [jnp.zeros(sshape, jnp.float32)
+            self.k_scales = [self._place(jnp.zeros(sshape, jnp.float32))
                              for _ in range(self.num_layers)]
-            self.v_scales = [jnp.zeros(sshape, jnp.float32)
+            self.v_scales = [self._place(jnp.zeros(sshape, jnp.float32))
                              for _ in range(self.num_layers)]
         while self._prefix_index:
             _, entry = self._prefix_index.popitem(last=False)
@@ -829,6 +865,13 @@ class PagedKVCache:
         cache stores full-precision KV)."""
         return sum(int(a.size) * a.dtype.itemsize
                    for a in list(self.k_scales) + list(self.v_scales))
+
+    @property
+    def kv_pool_bytes_per_chip(self) -> int:
+        """Per-chip resident bytes of the KV data pages: the global
+        pool divided by the TP degree (the head-axis sharding's HBM
+        win; equals ``kv_pool_bytes`` for a 1-chip cache)."""
+        return self.kv_pool_bytes // max(1, self.tp)
 
     @property
     def pinned_pages(self) -> int:

@@ -28,6 +28,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from the tier-1 gate "
+        "(-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(0)

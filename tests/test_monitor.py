@@ -241,8 +241,18 @@ class TestInstrumentedPaths:
         tid = mgr.begin("test_op", timeout=1e9)
         mgr.start()
         try:
-            time.sleep(0.1)
+            # poll instead of a fixed sleep: the scanner thread may not
+            # get a turn within one interval on a saturated CI core
             reg = monitor.get_registry()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if (reg.get("comm_tasks_in_flight").value() >= 1
+                        and reg.get("comm_watchdog_heartbeat_"
+                                    "timestamp_seconds").value() > 0
+                        and reg.get(
+                            "comm_oldest_task_age_seconds").value() > 0):
+                    break
+                time.sleep(0.02)
             assert reg.get("comm_tasks_in_flight").value() >= 1
             assert reg.get(
                 "comm_watchdog_heartbeat_timestamp_seconds").value() > 0

@@ -604,3 +604,134 @@ class TestEngineAndGauges:
             m = s.get("jit_compile_seconds")
             return m["series"][0]["count"] if m and m["series"] else 0
         assert compiles(after) == compiles(before)
+
+
+class TestTensorParallelAudit:
+    """ISSUE 20: the auditor prices the TP engine's programs — every
+    collective NAMED with non-zero bytes on the ('tensor',) axis, the
+    per-chip peak-HBM walk sees the pool shards (global ÷ tp), and the
+    int8 quantized collectives quote >=3x fewer bytes than f32."""
+
+    def _tiny(self, seed=0):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        paddle.seed(seed)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=128)
+        return LlamaForCausalLM(cfg)
+
+    def _engine(self, **kw):
+        from paddle_tpu.inference.continuous import \
+            ContinuousBatchingEngine
+        return ContinuousBatchingEngine(self._tiny(), total_pages=32,
+                                        page_size=8, max_batch=4, **kw)
+
+    @pytest.fixture(scope="class")
+    def audits(self):
+        """One pass over (tp=1, tp=2, tp=2+int8) engines: the fixtures
+        every lock below reads."""
+        engines = {"base": self._engine(),
+                   "tp": self._engine(tp=2),
+                   "quant": self._engine(tp=2, tp_quant_collectives=True)}
+        out = {}
+        try:
+            for name, eng in engines.items():
+                out[name] = {
+                    mode: spmd.audit_spmd_engine(eng, mode=mode,
+                                                 compiled=False,
+                                                 publish=False)
+                    for mode in ("decode", "ragged")}
+                out[name]["kv_pool_bytes"] = eng.cache.kv_pool_bytes
+                out[name]["engine"] = eng
+            yield out
+        finally:
+            for eng in engines.values():
+                eng.stop()
+
+    def test_every_collective_named_and_priced(self, audits):
+        # 2 layers x (o_proj + down_proj) row-parallel closes = 4
+        # psums, nothing unattributed, all on the tensor axis, all f32
+        for mode in ("decode", "ragged"):
+            audit = audits["tp"][mode]
+            colls = [c for c in audit.collectives if c.source == "jaxpr"]
+            assert len(colls) == 4, [str(c) for c in audit.collectives]
+            for c in colls:
+                assert c.kind == "all_reduce"
+                assert tuple(c.axes) == ("tensor",)
+                assert c.ici_bytes > 0
+                assert c.dtype == "float32"
+            assert audit.collective_bytes_total > 0
+
+    def test_meshless_engine_prices_zero(self, audits):
+        for mode in ("decode", "ragged"):
+            assert audits["base"][mode].collective_bytes_total == 0.0
+
+    def test_per_chip_peak_sees_pool_shards(self, audits):
+        # the tp=2 walk prices each pool leaf at its SHARD bytes, so
+        # peak drops by at least half the global pool footprint
+        pool = audits["tp"]["kv_pool_bytes"]
+        base = audits["base"]["decode"].peak_hbm_bytes
+        shard = audits["tp"]["decode"].peak_hbm_bytes
+        assert audits["tp"]["engine"].cache.kv_pool_bytes_per_chip * 2 \
+            == pool
+        assert shard <= base - 0.5 * pool, (base, shard, pool)
+
+    def test_int8_collectives_at_least_3x_fewer_bytes(self, audits):
+        audit = audits["quant"]["decode"]
+        total = audit.collective_bytes_total
+        equiv = audit.collective_bytes_f32_equiv
+        assert total > 0
+        assert equiv / total >= 3.0, (equiv, total)
+        # the quantized step moves STRICTLY fewer bytes than the f32
+        # psum step it replaces would
+        assert total < audits["tp"]["decode"].collective_bytes_total
+        # and the report quotes the ratio for the operator
+        assert "fewer bytes" in audit.report()
+
+    def test_sharded_kv_pool_is_quiet(self):
+        # the hazard rule must NOT fire on a pool committed the way
+        # PagedKVCache(mesh=...) commits it: sharded on the kv-head
+        # axis (>=1 MiB so the planted pool clears _LARGE_PARAM_BYTES)
+        mesh = _mesh(8, "tensor")
+        pool = jax.device_put(
+            jnp.zeros((8, 256, 16, 32), jnp.float32),   # 4 MiB pool
+            NamedSharding(mesh, P("tensor")))
+        q = jax.device_put(jnp.zeros((4, 8, 32), jnp.float32),
+                           NamedSharding(mesh, P()))
+
+        def f(pool, q):
+            return jnp.einsum("bhd,hpsd->bps", q, pool)
+
+        closed = jax.make_jaxpr(f)(pool, q)
+        audit = spmd.audit_spmd_jaxpr(
+            closed, name="kv_sharded", example_args=(pool, q),
+            kv_pool_leaves=(pool,), publish=False)
+        assert [f_ for f_ in audit.findings
+                if f_.rule_id == "unsharded-kv-pool"] == []
+
+    def test_replicated_pool_hint_names_the_fix(self):
+        mesh = _mesh(8, "tensor")
+        pool = jax.device_put(jnp.zeros((256, 16, 8, 32), jnp.float32),
+                              NamedSharding(mesh, P()))
+
+        def f(pool):
+            return pool.sum()
+
+        closed = jax.make_jaxpr(f)(pool)
+        audit = spmd.audit_spmd_jaxpr(
+            closed, name="kv_repl", example_args=(pool,),
+            kv_pool_leaves=(pool,), publish=False)
+        hits = [f_ for f_ in audit.findings
+                if f_.rule_id == "unsharded-kv-pool"]
+        assert len(hits) == 1
+        assert "PagedKVCache(mesh=...)" in hits[0].hint
+
+    def test_audit_engine_autoruns_spmd_on_tp_engine(self, audits):
+        from paddle_tpu.analysis import program_audit
+        audit = program_audit.audit_engine(audits["tp"]["engine"],
+                                           mode="decode", publish=False)
+        assert audit.spmd is not None
+        assert len([c for c in audit.spmd.collectives
+                    if c.source == "jaxpr"]) == 4
+        assert audit.spmd.collective_bytes_total > 0

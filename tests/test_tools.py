@@ -304,6 +304,33 @@ class TestServeBench:
         assert off["jit_recompiles"] == 0
         assert on["jit_recompiles"] == 0
 
+    def test_tp_lane_gate(self, capsys):
+        # ISSUE 20 acceptance: the --tp lane runs the engine TP=2 on
+        # the virtual CPU mesh — bit-exact greedy parity vs 1-chip,
+        # compile-free measured window, per-chip KV pool bytes =
+        # global / tp, every collective named+priced on the tensor
+        # axis, and the int8 quantized collectives quoted at >= 3x
+        # fewer bytes than f32 (exactly 8/n = 4x at n=2 on the ring
+        # model).  tokens/sec/chip is QUOTED, never gated: TP=2 on
+        # virtual CPU devices is the documented lose case.
+        sb = self._load()
+        assert sb.main(["--tp"]) == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert out["lane"] == "tp"
+        assert out["tp"] == 2
+        assert out["greedy_exact"] is True
+        assert out["parity_matches"] == out["parity_requests"] >= 6
+        assert out["jit_recompiles"] == 0
+        assert out["kv_pool_bytes_per_chip"] * 2 == out["kv_pool_bytes"]
+        assert out["collectives"] > 0
+        assert out["collective_bytes"] > 0
+        assert out["mesh_axes"] == {"tensor": 2}
+        assert out["int8_collective_ratio"] >= 3.0
+        assert out["tokens_per_sec_per_chip"] > 0
+        assert out["peak_hbm_bytes_per_chip"] \
+            < out["peak_hbm_bytes_base"]
+
     def test_fleet_lane_gate(self, capsys):
         # ISSUE 14 acceptance: the --fleet lane runs a 2-replica
         # supervised fleet behind the router with a replica kill

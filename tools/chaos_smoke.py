@@ -441,6 +441,12 @@ def serve_child(argv) -> int:
     journal_dir = arg("journal-dir")
     portfile = arg("portfile")
     delay = float(arg("decode-delay", "0"))
+    tp = int(arg("tp", "1"))
+    if tp > 1:
+        # TP replica (ISSUE 20): the virtual CPU devices must exist
+        # BEFORE the model build initializes the backend
+        from paddle_tpu.framework.jax_compat import pin_cpu_devices
+        pin_cpu_devices(max(tp, 2))
     if delay:
         faults.install(faults.FaultPlan(
             [{"site": "decode_step", "kind": "delay",
@@ -450,7 +456,7 @@ def serve_child(argv) -> int:
     srv = GenerationServer(model, draft_model=draft, spec_tokens=2,
                            total_pages=128, page_size=8, max_batch=4,
                            journal_dir=journal_dir,
-                           journal_fsync="always").start()
+                           journal_fsync="always", tp=tp).start()
     with open(portfile + ".tmp", "w") as f:
         f.write(str(srv.port))
     os.replace(portfile + ".tmp", portfile)
@@ -659,7 +665,7 @@ def run_fleet_kill() -> dict:
     logf = open(os.path.join(work, "children.log"), "ab")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
 
-    def spawn(name, delay):
+    def spawn(name, delay, tp=1):
         jdir = os.path.join(work, name, "journal")
         portfile = os.path.join(work, name, "port")
         os.makedirs(os.path.dirname(portfile), exist_ok=True)
@@ -667,7 +673,7 @@ def run_fleet_kill() -> dict:
             [sys.executable,
              os.path.join(repo, "tools", "chaos_smoke.py"), "--child",
              f"--journal-dir={jdir}", f"--portfile={portfile}",
-             f"--decode-delay={delay}"],
+             f"--decode-delay={delay}", f"--tp={tp}"],
             env=env, cwd=repo, stdout=logf, stderr=logf)
         t0 = _time.monotonic()
         while _time.monotonic() - t0 < 300:
@@ -737,8 +743,11 @@ def run_fleet_kill() -> dict:
                             heartbeat_timeout_s=10.0)
     router = FleetRouter(sup)
     try:
-        for name in ("r0", "r1"):
-            proc, jdir, port = spawn(name, delay=0.1)
+        # r1 is a TP=2 replica (ISSUE 20): a sharded engine is one
+        # replica to the fleet — probes, migration and bit-exact
+        # failover must not notice the mesh behind it
+        for name, tp in (("r0", 1), ("r1", 2)):
+            proc, jdir, port = spawn(name, delay=0.1, tp=tp)
             procs[name] = proc
             sup.add_replica(name, f"http://127.0.0.1:{port}",
                             journal_dir=jdir, proc=proc)

@@ -150,7 +150,8 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
               draft_noise: float = 0.0, draft_model=None,
               quantize=None, kv_quant=None, total_pages: int = 128,
               replay_batch=None, journal_dir=None,
-              journal_fsync: str = "interval_ms") -> dict:
+              journal_fsync: str = "interval_ms",
+              tp: int = 1, tp_quant_collectives: bool = False) -> dict:
     """Run the mixed shared-prefix workload; return the metrics dict
     (everything monitor-sourced).  The tiny default model keeps the CI
     gate fast; ``--vocab``/``--hidden`` grow it so the host-boundary
@@ -288,7 +289,8 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
             draft_model=draft_model if draft else None,
             spec_tokens=spec_k, step_timeout_s=step_timeout_s,
             quantize=quantize, kv_quant=kv_quant,
-            replay_batch=replay_batch, journal=journal) as eng:
+            replay_batch=replay_batch, journal=journal,
+            tp=tp, tp_quant_collectives=tp_quant_collectives) as eng:
         # None inherits the engine's backend-aware default (batched
         # everywhere but TPU); report what actually ran
         replay_batch = eng.replay_batch
@@ -352,6 +354,8 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
                                              compiled=False)
         cost_est = spmd_audit.cost
         cost_est.publish()
+        kv_pool_bytes = eng.cache.kv_pool_bytes
+        kv_pool_bytes_per_chip = eng.cache.kv_pool_bytes_per_chip
 
     # the with-exit above closed the journal (final flush + fsync)
     dec_b, dec_sum, dec_n = _hist_delta(before, after,
@@ -472,12 +476,22 @@ def run_bench(model=None, sharers: int = 6, uniques: int = 3,
         "spmd": {
             "peak_hbm_bytes": spmd_audit.peak_hbm_bytes,
             "collective_bytes_total": spmd_audit.collective_bytes_total,
+            "collective_bytes_f32_equiv":
+                spmd_audit.collective_bytes_f32_equiv,
             "ici_time_seconds": spmd_audit.ici_time_seconds,
             "comm_compute_ratio": spmd_audit.comm_compute_ratio,
+            "comm_bound": spmd_audit.comm_bound,
             "mesh_axes": spmd_audit.mesh_axes,
             "collectives": len(spmd_audit.collectives),
             "findings": len(spmd_audit.findings),
         },
+        # tensor-parallel lane (ISSUE 20): the mesh degree the window
+        # ran at + PER-CHIP resident-KV bytes (global / tp — the HBM
+        # win TP buys on the pool side)
+        "tp": int(tp),
+        "tp_quant_collectives": bool(tp_quant_collectives),
+        "kv_pool_bytes": int(kv_pool_bytes),
+        "kv_pool_bytes_per_chip": int(kv_pool_bytes_per_chip),
     }
 
 
@@ -1033,6 +1047,156 @@ def run_quant_lane(argv) -> int:
         print(f"FAIL: quantized tokens/sec is {out['tps_ratio']}x "
               f"baseline (floor {tps_floor}; on CPU int8 is emulated — "
               "the bandwidth win only exists on TPU)", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+# --------------------------------------------------------------------
+# tensor-parallel lane (ISSUE 20): the unified serving step compiled
+# TP-sharded over a ('tensor',) mesh — per-chip HBM divided by the TP
+# degree, every collective named+priced before dispatch, greedy
+# outputs bit-exact against the 1-chip engine
+# --------------------------------------------------------------------
+
+def _tp_parity(tp, vocab=64, hidden=32, seed=0) -> dict:
+    """Greedy A/B on the logits escape hatch: the SAME prompt set
+    through a 1-chip engine and a TP-sharded engine, both on the
+    host-logits path, so the comparison is exact token equality.  TWO
+    same-seed models — the TP decoder COMMITS its model's params to
+    the mesh, so the engines must not share one instance."""
+    import numpy as np
+    from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, vocab, (n,)).astype("int32")
+               for n in (5, 9, 13, 20, 7, 16)]
+    outs = []
+    for kw in (dict(), dict(tp=tp)):
+        with ContinuousBatchingEngine(
+                _build_tiny_model(vocab=vocab, hidden=hidden),
+                total_pages=128, page_size=8, max_batch=4,
+                sample_on_device=False, **kw) as eng:
+            reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            outs.append([r.result(timeout=600) for r in reqs])
+    matches = [bool(np.array_equal(a, b)) for a, b in zip(*outs)]
+    return {
+        "parity_requests": len(matches),
+        "parity_matches": sum(matches),
+        "greedy_exact": all(matches),
+    }
+
+
+def run_tp_lane(argv) -> int:
+    """The ``--tp`` lane: the mixed shared-prefix workload through a
+    1-chip baseline engine and a TP-sharded engine at EQUAL GLOBAL
+    BATCH (same max_batch, same workload), one JSON line quoting
+    tokens/sec/chip vs the baseline, the priced collective bytes and
+    analytic ICI seconds of the sharded decode program, its
+    comm_bound roofline verdict, per-chip kv_pool_bytes, and the int8
+    collective pricing of the same program's quantized-collective
+    twin (static audit — EQuARX's win, priced before it's built).
+
+    Gates: zero recompiles in both measured windows, greedy outputs
+    bit-exact against the 1-chip engine on the logits-parity path,
+    every collective in the sharded program named+priced (nonzero
+    bytes, 'tensor' axes), and at tp=2 the int8-collective variant
+    pricing >= 3x fewer bytes than f32 (ring math: the width-4 win
+    minus the all_gather-vs-all_reduce algorithm change; the ratio is
+    8/n, so the bound is only asserted at n=2).  tokens/sec/chip is
+    QUOTED, never gated: on CPU the mesh is virtual devices on one
+    host (TP=2 runs ~half speed per chip, the documented lose case —
+    TP pays for itself only when the model doesn't fit one chip or
+    ICI is real)."""
+    from paddle_tpu.analysis import spmd as _spmd
+    from paddle_tpu.framework import jax_compat as _jc
+    from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+
+    tp = _int_arg(argv, "tp", 2)
+    # CLI path: the model builds (first jax op) BEFORE the TP engine,
+    # so the virtual CPU devices must be provisioned now, while the
+    # backend is still un-initialized (no-op on real multi-chip hosts
+    # and under the test suite's pre-split conftest)
+    if tp > 1 and not _jc._backend_initialized():
+        _jc.pin_cpu_devices(max(tp, 2))
+    vocab = _int_arg(argv, "vocab", 64)
+    hidden = _int_arg(argv, "hidden", 32)
+    total_pages = _int_arg(argv, "total-pages", 128)
+    kw = dict(sharers=_int_arg(argv, "sharers", 6),
+              uniques=_int_arg(argv, "uniques", 3),
+              system_tokens=_int_arg(argv, "system-tokens", 16),
+              max_new_tokens=_int_arg(argv, "max-new-tokens", 8),
+              vocab=vocab, hidden=hidden, total_pages=total_pages)
+    base = run_bench(model=_build_tiny_model(vocab=vocab, hidden=hidden),
+                     **kw)
+    shard = run_bench(model=_build_tiny_model(vocab=vocab, hidden=hidden),
+                      tp=tp, **kw)
+    parity = _tp_parity(tp, vocab=vocab, hidden=hidden)
+
+    # static int8-collective pricing: the SAME sharded decode program
+    # with quantized all-reduces, audited (never dispatched) — the
+    # f32-equivalent ratio is the EQuARX bandwidth win
+    with ContinuousBatchingEngine(
+            _build_tiny_model(vocab=vocab, hidden=hidden),
+            total_pages=32, page_size=PAGE_SIZE, max_batch=4,
+            sample_on_device=False, tp=tp,
+            tp_quant_collectives=True) as eng_q:
+        audit_q = _spmd.audit_spmd_engine(eng_q, mode="decode",
+                                          compiled=False, publish=False)
+    int8_ratio = (audit_q.collective_bytes_f32_equiv
+                  / audit_q.collective_bytes_total
+                  if audit_q.collective_bytes_total else None)
+
+    out = {
+        "lane": "tp",
+        "tp": tp,
+        "max_batch": base["max_batch"],
+        "tokens_per_sec_base": base["tokens_per_sec"],
+        "tokens_per_sec_tp": shard["tokens_per_sec"],
+        "tokens_per_sec_per_chip": shard["tokens_per_sec"] / tp,
+        "tps_per_chip_ratio": (shard["tokens_per_sec"] / tp
+                               / base["tokens_per_sec"]
+                               if base["tokens_per_sec"] else None),
+        "collective_bytes": shard["spmd"]["collective_bytes_total"],
+        "ici_time_seconds": shard["spmd"]["ici_time_seconds"],
+        "comm_bound": shard["spmd"]["comm_bound"],
+        "collectives": shard["spmd"]["collectives"],
+        "mesh_axes": shard["spmd"]["mesh_axes"],
+        "kv_pool_bytes": shard["kv_pool_bytes"],
+        "kv_pool_bytes_per_chip": shard["kv_pool_bytes_per_chip"],
+        "peak_hbm_bytes_base": base["spmd"]["peak_hbm_bytes"],
+        "peak_hbm_bytes_per_chip": shard["spmd"]["peak_hbm_bytes"],
+        "int8_collective_bytes": audit_q.collective_bytes_total,
+        "int8_collective_f32_equiv": audit_q.collective_bytes_f32_equiv,
+        "int8_collective_ratio": int8_ratio,
+        "jit_recompiles": (base["jit_recompiles"]
+                           + shard["jit_recompiles"]),
+        **parity,
+    }
+    print(json.dumps(out, sort_keys=True))
+    ok = True
+    if not out["greedy_exact"]:
+        print(f"FAIL: greedy outputs diverged between the 1-chip and "
+              f"tp={tp} engines ({out['parity_matches']}/"
+              f"{out['parity_requests']} requests exact) — the sharded "
+              "step is not bit-exact", file=sys.stderr)
+        ok = False
+    if out["jit_recompiles"] != 0:
+        print(f"FAIL: {out['jit_recompiles']} recompile(s) inside "
+              "measured windows", file=sys.stderr)
+        ok = False
+    if out["collectives"] == 0 or out["collective_bytes"] <= 0:
+        print("FAIL: the sharded decode program priced no collectives "
+              "— the audit lost sight of the mesh", file=sys.stderr)
+        ok = False
+    if out["kv_pool_bytes_per_chip"] * tp != out["kv_pool_bytes"]:
+        print(f"FAIL: per-chip pool bytes "
+              f"{out['kv_pool_bytes_per_chip']} x {tp} != global "
+              f"{out['kv_pool_bytes']} — the pools are not sharded by "
+              "the TP degree", file=sys.stderr)
+        ok = False
+    if tp == 2 and (int8_ratio is None or int8_ratio < 3.0):
+        print(f"FAIL: int8 collectives price only {int8_ratio}x fewer "
+              "bytes than f32 (bound: 3x at tp=2)", file=sys.stderr)
         ok = False
     return 0 if ok else 1
 
@@ -1876,6 +2040,12 @@ def main(argv=None) -> int:
         # with journaling on within 5% of off, compile-free, with
         # journal_bytes/journal_fsync_p50 quoted in the JSON line
         return run_journal_lane(argv)
+    if any(a == "--tp" or a.startswith("--tp=") for a in argv):
+        # tensor-parallel lane (ISSUE 20): 1-chip vs TP-sharded engine
+        # at equal global batch — tokens/sec/chip, priced collectives,
+        # per-chip pool bytes, bit-exact greedy parity.  Exact-match on
+        # the flag: --tps-floor belongs to the quant lane.
+        return run_tp_lane(argv)
     if "--overload-fleet" in argv:
         # fleet overload lane (ISSUE 19): sustained overload scales a
         # 1-replica fleet up, the new replica serves a compile-free
